@@ -1,0 +1,393 @@
+"""Typed configuration system for megatron_tpu.
+
+TPU-native replacement for the reference's flat-argparse config
+(ref: megatron/arguments.py:14-1073, megatron/global_vars.py:76-78).
+Instead of ~170 flags stored in a mutable global namespace, configuration is a
+tree of frozen dataclasses: architecture (`ModelConfig`), parallelism layout
+(`ParallelConfig`), optimization (`OptimizerConfig`), training-loop
+(`TrainingConfig`), data pipeline (`DataConfig`) — combined into `MegatronConfig`.
+`validate()` performs the same derivations/consistency checks as the reference's
+`validate_args` (ref: megatron/arguments.py:52-345), and an argparse bridge
+(`parse_cli`) keeps a megatron-compatible flag surface for the entry points.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# dtype policy
+# ---------------------------------------------------------------------------
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "fp16": jnp.float16,
+}
+
+
+def as_dtype(name: str):
+    return _DTYPES[name]
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Transformer architecture config.
+
+    Mirrors the architecture slice of the reference's argument namespace
+    (ref: megatron/arguments.py:367-520) and the assertions made by
+    LlamaModel/FalconModel (ref: megatron/model/llama_model.py:10-43,
+    megatron/model/falcon_model.py:10-41).
+    """
+
+    num_layers: int = 2
+    hidden_size: int = 128
+    ffn_hidden_size: Optional[int] = None  # derived: 4h, or 8/3 h for GLU
+    num_attention_heads: int = 4
+    # GQA/MQA: number of kv heads; == num_attention_heads -> MHA, == 1 -> MQA
+    # (ref: megatron/model/transformer.py:313-333, --num_attention_heads_kv)
+    num_kv_heads: Optional[int] = None
+    kv_channels: Optional[int] = None  # head dim; derived h / n_heads
+    seq_length: int = 512
+    max_position_embeddings: Optional[int] = None
+    vocab_size: int = 32000
+    make_vocab_size_divisible_by: int = 128
+
+    # positional encoding
+    use_rotary_emb: bool = True
+    rope_theta: float = 10000.0
+    # linear position-interpolation scaling (ref: --rope_scaling_factor,
+    # megatron/model/positional_embeddings.py:10-12)
+    rope_scaling_factor: float = 1.0
+    # learned absolute position embedding (GPT/BERT style, ref: language_model.py:155-163)
+    use_position_embedding: bool = False
+
+    # norms / activations / structure
+    norm_type: str = "rmsnorm"  # "rmsnorm" | "layernorm"
+    norm_epsilon: float = 1e-5
+    activation: str = "swiglu"  # swiglu|geglu|reglu|liglu|gelu|relu|squared_relu
+    use_bias: bool = False  # bias on linear layers (ref: --use_bias)
+    use_post_ln: bool = False  # post-LN instead of pre-LN (ref: transformer.py:629-633)
+    # Falcon-style parallel attention+MLP block (ref: transformer.py:647,773-805)
+    parallel_attn: bool = False
+    # dedicated MLP layernorm for Falcon-40B (ref: transformer.py:604,612-628)
+    parallel_layernorm: bool = False
+    tie_embed_logits: bool = False  # tied embedding/lm-head (ref: language_model.py:436-457)
+
+    # dropout / regularization
+    hidden_dropout: float = 0.0
+    attention_dropout: float = 0.0
+    # LIMA-style per-layer dropout ramp (ref: transformer.py:963-970)
+    lima_dropout: bool = False
+
+    # numerics
+    params_dtype: str = "float32"  # master/param dtype
+    compute_dtype: str = "bfloat16"  # activation/matmul dtype
+    softmax_compute_fp32: bool = True  # attention-softmax in fp32
+    # scale q @ k^T by 1/layer_number like apply_query_key_layer_scaling
+    apply_query_key_layer_scaling: bool = False
+    attention_softmax_in_fp32: bool = True
+    init_method_std: float = 0.02
+    use_scaled_init: bool = True  # scale output-layer init by 1/sqrt(2*num_layers)
+
+    # attention implementation: "flash" (blockwise/Pallas) | "dot" (xla einsum)
+    attention_impl: str = "dot"
+    # activation recompute: "none" | "selective" | "full" (ref: arguments.py:601-629)
+    recompute_granularity: str = "none"
+
+    # glu activations double the first MLP projection
+    @property
+    def is_glu(self) -> bool:
+        return self.activation in ("swiglu", "geglu", "reglu", "liglu")
+
+    def derived(self) -> "ModelConfig":
+        """Fill derived fields (ffn size, kv heads, head dim, max positions)."""
+        assert self.attention_impl in ("dot", "flash"), (
+            f"attention_impl must be 'dot' or 'flash', got {self.attention_impl!r}")
+        d: dict[str, Any] = {}
+        if self.num_kv_heads is None:
+            d["num_kv_heads"] = self.num_attention_heads
+        else:
+            assert self.num_attention_heads % self.num_kv_heads == 0, (
+                f"num_attention_heads={self.num_attention_heads} must be a "
+                f"multiple of num_kv_heads={self.num_kv_heads} (GQA groups)")
+        if self.kv_channels is None:
+            assert self.hidden_size % self.num_attention_heads == 0
+            d["kv_channels"] = self.hidden_size // self.num_attention_heads
+        if self.ffn_hidden_size is None:
+            if self.is_glu:
+                # llama convention: 2/3 * 4h rounded to multiple of 256
+                ffn = int(8 * self.hidden_size / 3)
+                ffn = 256 * ((ffn + 255) // 256)
+                d["ffn_hidden_size"] = ffn
+            else:
+                d["ffn_hidden_size"] = 4 * self.hidden_size
+        if self.max_position_embeddings is None:
+            d["max_position_embeddings"] = self.seq_length
+        return dataclasses.replace(self, **d)
+
+    @property
+    def padded_vocab_size(self) -> int:
+        """Vocab padded for clean sharding (ref: tokenizer.py:42-62 pads to
+        make_vocab_size_divisible_by * tp; we pad to the lcm-friendly multiple
+        independent of tp so checkpoints are layout-free)."""
+        m = self.make_vocab_size_divisible_by
+        return m * ((self.vocab_size + m - 1) // m)
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Device-mesh layout.
+
+    The reference builds explicit NCCL process groups for tp/pp/dp
+    (ref: megatron/core/parallel_state.py:51-205). Here the same grid is one
+    `jax.sharding.Mesh` with axes ('dp', 'pp', 'tp'); sequence parallelism
+    shards activations along 'tp' outside attention/MLP blocks
+    (ref: --sequence_parallel, arguments.py:681-682) and context parallelism
+    adds a 'cp' axis for ring attention (absent in the reference; see
+    SURVEY.md §2.8).
+    """
+
+    tensor_parallel: int = 1
+    pipeline_parallel: int = 1
+    data_parallel: Optional[int] = None  # derived from world size
+    context_parallel: int = 1
+    expert_parallel: int = 1
+    sequence_parallel: bool = False
+    # virtual pipeline (interleaved 1F1B) chunks per stage (ref: arguments.py:117-128)
+    virtual_pipeline_chunks: int = 1
+    # ZeRO-1-style optimizer state sharding over dp (ref: optimizer/distrib_optimizer.py)
+    use_distributed_optimizer: bool = False
+
+    def world_size(self, n_devices: int) -> int:
+        return n_devices
+
+    def derive_dp(self, n_devices: int) -> int:
+        denom = (self.tensor_parallel * self.pipeline_parallel *
+                 self.context_parallel)
+        assert n_devices % denom == 0, (
+            f"world size {n_devices} not divisible by tp*pp*cp={denom}")
+        return n_devices // denom
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    """Adam/SGD + lr schedule + clipping + loss scaling.
+
+    (ref: megatron/optimizer/__init__.py:63-144, optimizer_param_scheduler.py,
+    grad_scaler.py:40-120, clip_grads.py:16-136)
+    """
+
+    optimizer: str = "adam"
+    lr: float = 3e-4
+    min_lr: float = 0.0
+    lr_decay_style: str = "cosine"  # constant|linear|cosine|inverse-square-root
+    lr_decay_iters: Optional[int] = None
+    lr_warmup_iters: int = 0
+    lr_warmup_fraction: Optional[float] = None
+    weight_decay: float = 0.01
+    start_weight_decay: Optional[float] = None
+    end_weight_decay: Optional[float] = None
+    weight_decay_incr_style: str = "constant"
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_eps: float = 1e-8
+    sgd_momentum: float = 0.9
+    clip_grad: float = 1.0
+    # loss scaling (needed only for fp16; bf16 trains unscaled)
+    loss_scale: Optional[float] = None  # None -> dynamic if fp16
+    initial_loss_scale: float = 2.0 ** 32
+    min_loss_scale: float = 1.0
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    log_num_zeros_in_grad: bool = False
+    override_opt_param_scheduler: bool = False
+    use_checkpoint_opt_param_scheduler: bool = False
+
+
+@dataclass(frozen=True)
+class TrainingConfig:
+    """Training-loop config (ref: megatron/training.py, microbatches.py)."""
+
+    micro_batch_size: int = 1
+    global_batch_size: Optional[int] = None
+    rampup_batch_size: Optional[tuple[int, int, int]] = None  # (start, incr, samples)
+    train_iters: int = 100
+    eval_interval: int = 1000
+    eval_iters: int = 10
+    log_interval: int = 10
+    save_interval: Optional[int] = None
+    exit_interval: Optional[int] = None
+    exit_duration_in_mins: Optional[float] = None
+    seed: int = 1234
+    checkpoint_dir: Optional[str] = None
+    load_dir: Optional[str] = None
+    finetune: bool = False  # load weights only, reset iteration/optimizer
+    no_load_optim: bool = False
+    no_load_rng: bool = False
+    wandb_logger: bool = False
+    tensorboard_dir: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Data pipeline config (ref: megatron/data/*, tokenizer/*)."""
+
+    data_path: Optional[Sequence[Any]] = None  # [weight, prefix, ...] or [prefix]
+    split: str = "969,30,1"
+    tokenizer_type: str = "SentencePieceTokenizer"
+    vocab_file: Optional[str] = None
+    merge_file: Optional[str] = None
+    tokenizer_model: Optional[str] = None
+    dataloader_type: str = "single"  # single | cyclic
+    num_workers: int = 2
+    reset_position_ids: bool = False
+    reset_attention_mask: bool = False
+    eod_mask_loss: bool = False
+    vocab_extra_ids: int = 0
+    vocab_extra_ids_list: Optional[str] = None
+    new_tokens: bool = True
+    data_impl: str = "mmap"
+    mmap_warmup: bool = False
+
+
+@dataclass(frozen=True)
+class MegatronConfig:
+    model: ModelConfig = field(default_factory=ModelConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    training: TrainingConfig = field(default_factory=TrainingConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+
+    def validate(self, n_devices: Optional[int] = None) -> "MegatronConfig":
+        """Derive + consistency-check, mirroring validate_args
+        (ref: megatron/arguments.py:52-345)."""
+        model = self.model.derived()
+        par = self.parallel
+        tr = self.training
+        assert model.num_attention_heads % par.tensor_parallel == 0 or \
+            par.tensor_parallel % model.num_attention_heads == 0, (
+            "attention heads must shard evenly over tp")
+        if model.num_kv_heads is not None and par.tensor_parallel > 1:
+            q_per_kv = model.num_attention_heads // max(model.num_kv_heads, 1)
+            del q_per_kv  # kv heads may be < tp; they get replicated
+        if par.sequence_parallel:
+            assert par.tensor_parallel >= 1
+            assert model.seq_length % max(par.tensor_parallel, 1) == 0, (
+                "sequence parallel requires seq_length divisible by tp")
+        assert model.num_layers % par.pipeline_parallel == 0, (
+            f"num_layers {model.num_layers} must divide evenly into "
+            f"pp={par.pipeline_parallel} stages")
+        if par.virtual_pipeline_chunks > 1:
+            per_stage = model.num_layers // par.pipeline_parallel
+            assert per_stage % par.virtual_pipeline_chunks == 0
+        gbs = tr.global_batch_size
+        if gbs is None:
+            dp = par.data_parallel or (par.derive_dp(n_devices) if n_devices else 1)
+            gbs = tr.micro_batch_size * dp
+            tr = dataclasses.replace(tr, global_batch_size=gbs)
+        if n_devices is not None and par.data_parallel is None:
+            par = dataclasses.replace(par, data_parallel=par.derive_dp(n_devices))
+        if par.data_parallel:
+            assert tr.global_batch_size % (tr.micro_batch_size * par.data_parallel) == 0, (
+                f"global batch {tr.global_batch_size} must be divisible by "
+                f"micro_batch*dp={tr.micro_batch_size * par.data_parallel}")
+        return dataclasses.replace(self, model=model, parallel=par, training=tr)
+
+    @property
+    def num_microbatches(self) -> int:
+        dp = self.parallel.data_parallel or 1
+        return self.training.global_batch_size // (self.training.micro_batch_size * dp)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), default=str, indent=2)
+
+    @staticmethod
+    def from_dict(d: dict) -> "MegatronConfig":
+        def build(cls, sub):
+            fields = {f.name for f in dataclasses.fields(cls)}
+            return cls(**{k: v for k, v in sub.items() if k in fields})
+        return MegatronConfig(
+            model=build(ModelConfig, d.get("model", {})),
+            parallel=build(ParallelConfig, d.get("parallel", {})),
+            optimizer=build(OptimizerConfig, d.get("optimizer", {})),
+            training=build(TrainingConfig, d.get("training", {})),
+            data=build(DataConfig, d.get("data", {})),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Model presets (ref: weights2megatron/weights2megatron.py:16-261 per-size
+# configs; llama_model.py / falcon_model.py assertions)
+# ---------------------------------------------------------------------------
+
+def llama2_config(size: str = "7b", **overrides) -> ModelConfig:
+    presets = {
+        "tiny": dict(num_layers=2, hidden_size=256, num_attention_heads=4,
+                     vocab_size=32000, seq_length=512),
+        "7b": dict(num_layers=32, hidden_size=4096, num_attention_heads=32,
+                   ffn_hidden_size=11008, vocab_size=32000, seq_length=4096),
+        "13b": dict(num_layers=40, hidden_size=5120, num_attention_heads=40,
+                    ffn_hidden_size=13824, vocab_size=32000, seq_length=4096),
+        "70b": dict(num_layers=80, hidden_size=8192, num_attention_heads=64,
+                    num_kv_heads=8, ffn_hidden_size=28672, vocab_size=32000,
+                    seq_length=4096),
+    }
+    base = dict(
+        use_rotary_emb=True, norm_type="rmsnorm", norm_epsilon=1e-5,
+        activation="swiglu", use_bias=False, use_post_ln=False,
+        parallel_attn=False, tie_embed_logits=False,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return ModelConfig(**base).derived()
+
+
+def falcon_config(size: str = "7b", **overrides) -> ModelConfig:
+    presets = {
+        "tiny": dict(num_layers=2, hidden_size=256, num_attention_heads=4,
+                     num_kv_heads=1, vocab_size=65024, seq_length=512),
+        "7b": dict(num_layers=32, hidden_size=4544, num_attention_heads=71,
+                   num_kv_heads=1, vocab_size=65024, seq_length=2048),
+        "40b": dict(num_layers=60, hidden_size=8192, num_attention_heads=128,
+                    num_kv_heads=8, vocab_size=65024, seq_length=2048,
+                    parallel_layernorm=True),
+    }
+    base = dict(
+        use_rotary_emb=True, norm_type="layernorm", norm_epsilon=1e-5,
+        activation="gelu", use_bias=False, use_post_ln=False,
+        parallel_attn=True, tie_embed_logits=True,
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    return ModelConfig(**base).derived()
+
+
+def gpt_config(**overrides) -> ModelConfig:
+    base = dict(
+        num_layers=12, hidden_size=768, num_attention_heads=12,
+        vocab_size=50257, seq_length=1024, use_rotary_emb=False,
+        use_position_embedding=True, norm_type="layernorm",
+        activation="gelu", use_bias=True, tie_embed_logits=True,
+    )
+    base.update(overrides)
+    return ModelConfig(**base).derived()
+
+
+MODEL_PRESETS = {
+    "llama2-tiny": lambda: llama2_config("tiny"),
+    "llama2-7b": lambda: llama2_config("7b"),
+    "llama2-13b": lambda: llama2_config("13b"),
+    "llama2-70b": lambda: llama2_config("70b"),
+    "falcon-tiny": lambda: falcon_config("tiny"),
+    "falcon-7b": lambda: falcon_config("7b"),
+    "falcon-40b": lambda: falcon_config("40b"),
+    "gpt2": gpt_config,
+}

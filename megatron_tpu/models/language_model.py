@@ -1,0 +1,161 @@
+"""Embedding, LM head, and the full causal language model.
+
+TPU-native equivalent of TransformerLanguageModel / Embedding /
+parallel_lm_logits / GPTModel (ref: megatron/model/language_model.py:329-638,
+:133-326, :24-53; megatron/model/gpt_model.py:18-100).
+
+- VocabParallelEmbedding's mask-ids-outside-shard + all-reduce
+  (ref: core/tensor_parallel/layers.py:187-210) is a plain gather whose table
+  carries 'vocab'-axis sharding; GSPMD emits the same collective.
+- Untied lm_head (`not tie_embed_logits`) is a separate ('embed','vocab')
+  parameter (ref: language_model.py:436-457); tied mode reuses the embedding
+  table like parallel_lm_logits (ref: language_model.py:24-53).
+- The vocab-parallel cross-entropy with its three TP all-reduces
+  (ref: core/tensor_parallel/cross_entropy.py:14-143) is a
+  shard-friendly log-softmax cross-entropy in megatron_tpu/ops/cross_entropy.py.
+- Activations are [batch, seq, hidden] (batch-major): the reference's
+  [s, b, h] transpose (ref: language_model.py:248) existed for NCCL-contiguity
+  of sequence-parallel scatters, which GSPMD makes unnecessary.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models import transformer as tfm
+from megatron_tpu.models.norms import apply_norm, norm_axes, norm_init
+from megatron_tpu.models.rope import precompute_freqs
+from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+from megatron_tpu.ops.dropout import dropout
+
+
+def model_init(rng, cfg: ModelConfig, dtype=None):
+    """Full-model parameter tree."""
+    from megatron_tpu.config import as_dtype
+    dtype = dtype or as_dtype(cfg.params_dtype)
+    k_emb, k_stack, k_head, k_pos = jax.random.split(rng, 4)
+    v = cfg.padded_vocab_size
+    h = cfg.hidden_size
+    params = {
+        "embedding": {
+            "word_embeddings": jax.random.normal(k_emb, (v, h), dtype) * cfg.init_method_std,
+        },
+        "transformer": tfm.stack_init(k_stack, cfg, dtype=dtype),
+        "final_norm": norm_init(cfg.norm_type, h, dtype),
+    }
+    if cfg.use_position_embedding:
+        params["embedding"]["position_embeddings"] = (
+            jax.random.normal(k_pos, (cfg.max_position_embeddings, h), dtype)
+            * cfg.init_method_std)
+    if not cfg.tie_embed_logits:
+        params["lm_head"] = jax.random.normal(k_head, (h, v), dtype) * cfg.init_method_std
+    return params
+
+
+def model_axes(cfg: ModelConfig):
+    axes = {
+        "embedding": {"word_embeddings": ("vocab", "embed")},
+        "transformer": tfm.stack_axes(cfg),
+        "final_norm": norm_axes(cfg.norm_type),
+    }
+    if cfg.use_position_embedding:
+        axes["embedding"]["position_embeddings"] = (None, "embed")
+    if not cfg.tie_embed_logits:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+class RopeTables(NamedTuple):
+    cos: jax.Array
+    sin: jax.Array
+
+
+def make_rope(cfg: ModelConfig, max_len: Optional[int] = None) -> Optional[RopeTables]:
+    if not cfg.use_rotary_emb:
+        return None
+    max_len = max_len or cfg.max_position_embeddings
+    cos, sin = precompute_freqs(
+        cfg.kv_channels, max_len, theta=cfg.rope_theta,
+        scaling_factor=cfg.rope_scaling_factor)
+    return RopeTables(cos, sin)
+
+
+def model_forward(
+    params,
+    tokens,  # [b, s] int32
+    cfg: ModelConfig,
+    *,
+    position_ids=None,
+    kv_caches=None,
+    rope: Optional[RopeTables] = None,
+    rng=None,
+    deterministic: bool = True,
+    logits_dtype=jnp.float32,
+):
+    """Forward to logits [b, s, padded_vocab]. Returns (logits, kv_caches)."""
+    from megatron_tpu.config import as_dtype
+    compute_dtype = as_dtype(cfg.compute_dtype)
+    emb = params["embedding"]["word_embeddings"]
+    x = emb[tokens].astype(compute_dtype)
+    if cfg.use_position_embedding:
+        if position_ids is None:
+            pos = jnp.arange(tokens.shape[1])[None, :]
+            if kv_caches is not None:
+                # incremental decode: positions continue from the cache offset
+                # (all layers share one offset; ref: InferenceParams keeps a
+                # single sequence_len_offset, forward_step.py:17-42)
+                pos = pos + kv_caches.offset[0]
+        else:
+            pos = position_ids
+        x = x + params["embedding"]["position_embeddings"][pos].astype(compute_dtype)
+    if rope is None:
+        rope = make_rope(cfg)
+    if rng is not None and not deterministic and cfg.hidden_dropout > 0.0:
+        rng, r_emb = jax.random.split(rng)
+        x = dropout(r_emb, x, cfg.hidden_dropout)
+
+    x, kv_caches = tfm.stack_apply(
+        params["transformer"], x, cfg,
+        rope_cos=rope.cos if rope else None,
+        rope_sin=rope.sin if rope else None,
+        position_ids=position_ids, kv_caches=kv_caches,
+        rng=rng, deterministic=deterministic)
+
+    x = apply_norm(cfg.norm_type, params["final_norm"], x, cfg.norm_epsilon)
+
+    if cfg.tie_embed_logits:
+        w_out = params["embedding"]["word_embeddings"].T
+    else:
+        w_out = params["lm_head"]
+    logits = (x @ w_out.astype(compute_dtype)).astype(logits_dtype)
+    return logits, kv_caches
+
+
+def loss_fn(
+    params,
+    tokens,  # [b, s+1] or (inputs [b,s], labels [b,s])
+    cfg: ModelConfig,
+    *,
+    loss_mask=None,
+    rope=None,
+    rng=None,
+    deterministic: bool = True,
+):
+    """Causal LM loss: mean CE over unmasked positions
+    (ref: finetune.py:83 loss_func — masked mean)."""
+    if isinstance(tokens, tuple):
+        inputs, labels = tokens
+    else:
+        inputs, labels = tokens[:, :-1], tokens[:, 1:]
+        if loss_mask is not None and loss_mask.shape[1] == tokens.shape[1]:
+            loss_mask = loss_mask[:, 1:]
+    logits, _ = model_forward(params, inputs, cfg, rope=rope, rng=rng,
+                              deterministic=deterministic)
+    losses = cross_entropy_loss(logits, labels, vocab_size=cfg.vocab_size)
+    if loss_mask is None:
+        return jnp.mean(losses)
+    loss_mask = loss_mask.astype(losses.dtype)
+    return jnp.sum(losses * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)

@@ -1,0 +1,60 @@
+"""Rotary position embeddings (RoPE) with linear position-interpolation scaling.
+
+TPU-native equivalent of the reference's complex-multiplication RoPE
+(ref: megatron/model/positional_embeddings.py:7-51 `precompute_freqs_cis` /
+`apply_rotary_emb`, applied at megatron/model/transformer.py:373-379,500-501).
+
+Convention: the *interleaved-pair* (Meta/Llama) layout — head-dim elements
+(2i, 2i+1) form the complex pair. The reference keeps the same convention and
+permutes HF checkpoints into it during conversion
+(ref: weights2megatron/permute_qkv.py:12-81); our converter does the same, so
+numerics line up with the reference end-to-end.
+
+Instead of complex arithmetic (poorly supported on the TPU vector unit) we use
+the equivalent real-valued rotation on the de-interleaved halves, which XLA
+fuses into the surrounding attention ops.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def precompute_freqs(
+    head_dim: int,
+    max_seq_len: int,
+    theta: float = 10000.0,
+    scaling_factor: float = 1.0,
+    dtype=jnp.float32,
+):
+    """cos/sin tables of shape [max_seq_len, head_dim // 2].
+
+    `scaling_factor` implements linear position interpolation: positions are
+    divided by the factor so a model trained at 4k attends coherently at
+    4k * factor (ref: positional_embeddings.py:10-12, --rope_scaling_factor
+    arguments.py:460-461)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32) / scaling_factor
+    freqs = jnp.outer(t, inv_freq)  # [s, hd/2]
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary(x, cos, sin, position_ids=None):
+    """Rotate [batch, seq, heads, head_dim] by position.
+
+    Supports non-monotonic `position_ids` [batch, seq] the same way the
+    reference indexes freqs_cis by position_ids
+    (ref: positional_embeddings.py:34-43)."""
+    b, s, n, d = x.shape
+    if position_ids is None:
+        c = cos[:s][None, :, None, :]  # [1, s, 1, d/2]
+        sn = sin[:s][None, :, None, :]
+    else:
+        c = cos[position_ids][:, :, None, :]  # [b, s, 1, d/2]
+        sn = sin[position_ids][:, :, None, :]
+    # interleaved pairs: (x0, x1), (x2, x3), ...
+    xr = x.astype(jnp.float32).reshape(b, s, n, d // 2, 2)
+    x0, x1 = xr[..., 0], xr[..., 1]
+    out0 = x0 * c - x1 * sn
+    out1 = x1 * c + x0 * sn
+    out = jnp.stack([out0, out1], axis=-1).reshape(b, s, n, d)
+    return out.astype(x.dtype)

@@ -1,0 +1,228 @@
+"""Transformer layer and scan-stacked transformer.
+
+TPU-native equivalent of ParallelTransformerLayer / ParallelTransformer
+(ref: megatron/model/transformer.py:581-815 and :896-1251). Structural
+features carried over:
+
+- pre-LN (default) vs post-LN (`use_post_ln`, ref: transformer.py:629-633)
+- Falcon-style parallel attention+MLP sharing one input norm, with no
+  attention residual-dropout (`parallel_attn`, ref: transformer.py:647,773-805)
+- dedicated MLP layernorm for Falcon-40B (`parallel_layernorm`,
+  ref: transformer.py:604,612-628,770-771)
+- LIMA per-layer dropout ramp p_l = l/L * p (ref: transformer.py:963-970)
+- activation recompute: 'full' remats each layer, 'selective' saves GEMM
+  outputs but recomputes the attention softmax — the jax.checkpoint
+  formulation of the reference's tensor_parallel.checkpoint machinery
+  (ref: megatron/core/tensor_parallel/random.py:175-252, transformer.py:357,
+  1079-1145). No RNG save/restore is needed: jax.random keys are pure.
+
+TPU-first design choices: all layers share one set of stacked parameters
+(leading 'layers' dim) applied via `lax.scan` — one compiled layer body
+regardless of depth, which keeps compile time flat for 80-layer models and
+gives the pipeline partitioner a natural chunking axis.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models.attention import attention_apply, attention_axes, attention_init
+from megatron_tpu.models.mlp import mlp_apply, mlp_axes, mlp_init
+from megatron_tpu.models.norms import apply_norm, norm_axes, norm_init
+from megatron_tpu.ops.dropout import dropout as _dropout
+
+
+# ---------------------------------------------------------------------------
+# single layer
+# ---------------------------------------------------------------------------
+
+def layer_init(rng, cfg: ModelConfig, dtype=jnp.float32):
+    """Norm layout mirrors ref: transformer.py:606-633 —
+    pre-LN: input_layernorm + post_attention_layernorm (output_layernorm=Id);
+    post-LN: input_layernorm=Id, post_attention_layernorm + output_layernorm;
+    parallel_attn drops post_attention_layernorm; parallel_layernorm adds a
+    dedicated mlp norm."""
+    k_attn, k_mlp = jax.random.split(rng)
+    params = {
+        "attention": attention_init(k_attn, cfg, dtype),
+        "mlp": mlp_init(k_mlp, cfg, dtype),
+    }
+    if not cfg.use_post_ln:
+        params["input_norm"] = norm_init(cfg.norm_type, cfg.hidden_size, dtype)
+    else:
+        params["output_norm"] = norm_init(cfg.norm_type, cfg.hidden_size, dtype)
+    if not cfg.parallel_attn:
+        params["post_attn_norm"] = norm_init(cfg.norm_type, cfg.hidden_size, dtype)
+    if cfg.parallel_layernorm:
+        params["mlp_norm"] = norm_init(cfg.norm_type, cfg.hidden_size, dtype)
+    return params
+
+
+def layer_axes(cfg: ModelConfig):
+    axes = {
+        "attention": attention_axes(cfg),
+        "mlp": mlp_axes(cfg),
+    }
+    if not cfg.use_post_ln:
+        axes["input_norm"] = norm_axes(cfg.norm_type)
+    else:
+        axes["output_norm"] = norm_axes(cfg.norm_type)
+    if not cfg.parallel_attn:
+        axes["post_attn_norm"] = norm_axes(cfg.norm_type)
+    if cfg.parallel_layernorm:
+        axes["mlp_norm"] = norm_axes(cfg.norm_type)
+    return axes
+
+
+def layer_apply(
+    params,
+    x,
+    cfg: ModelConfig,
+    *,
+    rope_cos=None,
+    rope_sin=None,
+    position_ids=None,
+    kv_cache=None,
+    layer_number: int = 1,
+    hidden_dropout: Optional[float] = None,
+    rng=None,
+    deterministic: bool = True,
+):
+    """One transformer layer. x: [b, s, h]. Returns (x, kv_cache).
+
+    Residual structure follows ref: transformer.py:754-815 exactly:
+      ln_out = input_norm(x)            (Identity when post-LN)
+      attn   = attention(ln_out)
+      parallel_attn:  out = output-ish residual handled below
+      else:  ln_in  = x + drop(attn)
+             ln_out = post_attn_norm(ln_in)
+             mlp    = mlp(ln_out)
+             out    = ln_in + drop(mlp)
+      out = output_norm(out)            (Identity when pre-LN)
+    """
+    eps = cfg.norm_epsilon
+    p_drop = cfg.hidden_dropout if hidden_dropout is None else hidden_dropout
+    if deterministic:
+        rng = None
+    r_attn = r_mlp = r_score = None
+    if rng is not None:
+        r_attn, r_mlp, r_score = jax.random.split(rng, 3)
+
+    residual = x
+    if cfg.use_post_ln:
+        ln_out = x  # input_layernorm = Identity (ref: transformer.py:630-631)
+    else:
+        ln_out = apply_norm(cfg.norm_type, params["input_norm"], x, eps)
+
+    attn_out, kv_cache = attention_apply(
+        params["attention"], ln_out, cfg,
+        rope_cos=rope_cos, rope_sin=rope_sin, position_ids=position_ids,
+        kv_cache=kv_cache, layer_number=layer_number,
+        dropout_rng=r_score, deterministic=deterministic)
+
+    if cfg.parallel_attn:
+        # Falcon block: no dropout-add after attention
+        # (ref: transformer.py:781-782 layernorm_input = attention_output);
+        # mlp input is mlp_norm(x) (Falcon-40B) or the shared input norm
+        # (ref: transformer.py:770-771, 796-801)
+        if cfg.parallel_layernorm:
+            mlp_in = apply_norm(cfg.norm_type, params["mlp_norm"], residual, eps)
+        else:
+            mlp_in = ln_out
+        mlp_out = mlp_apply(params["mlp"], mlp_in, cfg)
+        out = residual + _dropout(r_mlp, mlp_out + attn_out, p_drop)
+    else:
+        ln_in = residual + _dropout(r_attn, attn_out, p_drop)
+        ln2 = apply_norm(cfg.norm_type, params["post_attn_norm"], ln_in, eps)
+        mlp_out = mlp_apply(params["mlp"], ln2, cfg)
+        out = ln_in + _dropout(r_mlp, mlp_out, p_drop)
+
+    if cfg.use_post_ln:
+        out = apply_norm(cfg.norm_type, params["output_norm"], out, eps)
+    return out, kv_cache
+
+
+# ---------------------------------------------------------------------------
+# stacked transformer (scan over layers)
+# ---------------------------------------------------------------------------
+
+def stack_init(rng, cfg: ModelConfig, num_layers: Optional[int] = None,
+               dtype=jnp.float32):
+    """Stacked params with leading 'layers' dim via vmap over per-layer init."""
+    n = num_layers if num_layers is not None else cfg.num_layers
+    keys = jax.random.split(rng, n)
+    return jax.vmap(lambda k: layer_init(k, cfg, dtype))(keys)
+
+
+def stack_axes(cfg: ModelConfig):
+    """Logical axes for stacked params: prepend 'layers'."""
+    per_layer = layer_axes(cfg)
+    return jax.tree.map(lambda ax: ("layers",) + ax, per_layer,
+                        is_leaf=lambda x: isinstance(x, tuple))
+
+
+def lima_dropout_rates(cfg: ModelConfig, num_layers: int):
+    """LIMA ramp: linspace(0, p_hidden, L) — first layer exactly 0.0
+    (ref: transformer.py:963-970 torch.linspace(0, hidden_dropout, L))."""
+    if not cfg.lima_dropout:
+        return jnp.full((num_layers,), cfg.hidden_dropout, jnp.float32)
+    return jnp.linspace(0.0, cfg.hidden_dropout, num_layers, dtype=jnp.float32)
+
+
+def stack_apply(
+    stacked_params,
+    x,
+    cfg: ModelConfig,
+    *,
+    rope_cos=None,
+    rope_sin=None,
+    position_ids=None,
+    kv_caches=None,  # stacked KVCache with leading layers dim, or None
+    rng=None,
+    deterministic: bool = True,
+    layer_offset: int = 0,
+):
+    """Apply all (or a pipeline stage's worth of) layers via lax.scan.
+
+    `layer_offset` preserves layer_number-dependent behavior across pipeline
+    stages (ref: transformer.py:1014-1044 layer offsets for vpp)."""
+    num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    drop_rates = lima_dropout_rates(cfg, cfg.num_layers)
+    drop_rates = jax.lax.dynamic_slice_in_dim(drop_rates, layer_offset, num_layers)
+    layer_ids = layer_offset + jnp.arange(num_layers)
+
+    def body(carry, scanned):
+        h = carry
+        p, rate, lid, cache = scanned
+        layer_rng = None
+        if rng is not None and not deterministic:
+            layer_rng = jax.random.fold_in(rng, lid)
+        h, new_cache = layer_apply(
+            p, h, cfg, rope_cos=rope_cos, rope_sin=rope_sin,
+            position_ids=position_ids, kv_cache=cache,
+            layer_number=lid + 1, hidden_dropout=rate, rng=layer_rng,
+            deterministic=deterministic)
+        return h, new_cache
+
+    if cfg.recompute_granularity == "full":
+        body = jax.checkpoint(body, prevent_cse=False)
+    elif cfg.recompute_granularity == "selective":
+        # save GEMM outputs, recompute the attention softmax — the analogue of
+        # the reference's selective core-attention recompute (transformer.py:357)
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            prevent_cse=False)
+
+    xs = (stacked_params, drop_rates, layer_ids, kv_caches)
+    if kv_caches is None:
+        def body_nocache(carry, scanned):
+            p, rate, lid = scanned
+            h, _ = body(carry, (p, rate, lid, None))
+            return h, None
+        x, _ = jax.lax.scan(body_nocache, x, (stacked_params, drop_rates, layer_ids))
+        return x, None
+    x, new_caches = jax.lax.scan(body, x, xs)
+    return x, new_caches

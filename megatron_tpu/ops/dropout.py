@@ -1,0 +1,16 @@
+"""Shared dropout primitive (single definition for all call sites)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dropout(rng, x, rate):
+    """Inverted dropout. `rate` may be a traced scalar (LIMA ramp is scanned).
+
+    rng=None means deterministic/eval mode: identity (the functional analogue
+    of the reference's `self.training` switch)."""
+    if rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))

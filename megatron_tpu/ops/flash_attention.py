@@ -1,0 +1,91 @@
+"""Flash attention: blockwise online-softmax attention, O(seq) memory.
+
+TPU-native replacement for the reference's FlashAttention-2 integration
+(ref: megatron/model/transformer.py:514-522 `flash_attn_func` from the
+external CUDA `flash_attn` package) and, transitively, for the fused
+scaled-masked-softmax CUDA kernels it superseded (ref: megatron/fused_kernels/
+scaled_*_softmax*.cu, K1-K3 in SURVEY.md §2.2).
+
+This module provides the flash *algorithm* (tiled K/V loop with online
+softmax renormalization) expressed in XLA ops via `lax.scan` — it runs on any
+backend and is the numerics reference. The hand-tuned Pallas TPU kernel
+(`megatron_tpu.ops.flash_attention_pallas`) overrides it on TPU when
+available; both share this module's interface:
+
+    flash_attention(q, k, v, *, causal, scale) -> out
+      q: [b, sq, nq, d], k/v: [b, skv, nkv, d], GQA by nq % nkv == 0.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BLOCK_KV = 512
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_kv", "use_pallas"))
+def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
+                    block_kv: int = DEFAULT_BLOCK_KV, use_pallas: bool | None = None):
+    """Blockwise attention with online softmax. Returns [b, sq, nq, d]."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        try:
+            from megatron_tpu.ops.flash_attention_pallas import pallas_flash_attention
+            return pallas_flash_attention(q, k, v, causal=causal, scale=scale)
+        except ImportError:
+            pass
+    return _blockwise_attention(q, k, v, causal=causal, scale=scale,
+                                block_kv=block_kv)
+
+
+def _blockwise_attention(q, k, v, *, causal, scale, block_kv):
+    b, sq, nq, d = q.shape
+    skv, nkv = k.shape[1], k.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+    g = nq // nkv
+    block_kv = min(block_kv, skv)
+    # pad kv to a multiple of block_kv
+    n_blocks = -(-skv // block_kv)
+    pad = n_blocks * block_kv - skv
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+    qg = (q.astype(jnp.float32) * scale).reshape(b, sq, nkv, g, d)
+    kb = k.astype(jnp.float32).reshape(b, n_blocks, block_kv, nkv, d)
+    vb = v.astype(jnp.float32).reshape(b, n_blocks, block_kv, nkv, d)
+    q_pos = jnp.arange(sq)
+
+    def body(carry, blk):
+        acc, m, l = carry  # acc [b,sq,nkv,g,d], m/l [b,sq,nkv,g]
+        kj, vj, j = blk    # kj/vj [b,block_kv,nkv,d]
+        s = jnp.einsum("bsngd,btnd->bsngt", qg, kj)  # [b,sq,nkv,g,block_kv]
+        kv_pos = j * block_kv + jnp.arange(block_kv)
+        valid = kv_pos < skv
+        if causal:
+            valid = valid[None, :] & (q_pos[:, None] >= kv_pos[None, :])
+            s = jnp.where(valid[None, :, None, None, :], s, -jnp.inf)
+        else:
+            s = jnp.where(valid[None, None, None, None, :], s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) -> use 0
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum("bsngt,btnd->bsngd", p, vj)
+        return (acc_new, m_new, l_new), None
+
+    acc0 = jnp.zeros((b, sq, nkv, g, d), jnp.float32)
+    m0 = jnp.full((b, sq, nkv, g), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, sq, nkv, g), jnp.float32)
+    (acc, m, l), _ = jax.lax.scan(
+        body, (acc0, m0, l0),
+        (jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0), jnp.arange(n_blocks)))
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.reshape(b, sq, nq, d).astype(q.dtype)

@@ -1,0 +1,35 @@
+"""Test harness: hermetic multi-chip simulation on CPU.
+
+The reference has no below-hardware multi-node story (SURVEY.md §4 — all
+distributed tests need real GPUs + NCCL under torchrun). Here every
+parallelism test runs on an 8-device virtual CPU mesh via
+`--xla_force_host_platform_device_count`, so TP/PP/DP/SP semantics are
+CI-testable with no accelerator.
+"""
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# persistent compilation cache makes repeated suite runs fast
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# Numerical-equivalence tests compare different contraction orders of the same
+# math; run matmuls at full precision so tolerances reflect algorithms, not
+# the backend's default bf16-ish matmul mode.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,90 @@
+"""Transformer-layer structural variants: post-LN, parallel_attn,
+parallel_layernorm, LIMA dropout — contracts from
+ref: megatron/model/transformer.py:581-815,963-970.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models.transformer import (
+    layer_apply, layer_init, lima_dropout_rates)
+
+
+def cfg_with(**kw):
+    base = dict(num_layers=4, hidden_size=64, num_attention_heads=4,
+                vocab_size=128, seq_length=32, compute_dtype="float32")
+    base.update(kw)
+    return ModelConfig(**base).derived()
+
+
+def test_pre_ln_param_structure():
+    cfg = cfg_with()
+    p = layer_init(jax.random.PRNGKey(0), cfg)
+    assert "input_norm" in p and "post_attn_norm" in p and "output_norm" not in p
+
+
+def test_post_ln_param_structure():
+    """post-LN: input norm is Identity, output_layernorm exists
+    (ref: transformer.py:630-633)."""
+    cfg = cfg_with(use_post_ln=True, norm_type="layernorm")
+    p = layer_init(jax.random.PRNGKey(0), cfg)
+    assert "input_norm" not in p
+    assert "post_attn_norm" in p and "output_norm" in p
+
+
+def test_parallel_attn_param_structure():
+    cfg = cfg_with(parallel_attn=True, norm_type="layernorm", activation="gelu")
+    p = layer_init(jax.random.PRNGKey(0), cfg)
+    assert "post_attn_norm" not in p
+    cfg40 = cfg_with(parallel_attn=True, parallel_layernorm=True,
+                     norm_type="layernorm", activation="gelu")
+    p40 = layer_init(jax.random.PRNGKey(0), cfg40)
+    assert "mlp_norm" in p40
+
+
+def test_post_ln_output_is_normalized():
+    """Output of a post-LN layer must have ~zero mean / unit variance
+    (the defining property: output_layernorm closes the layer)."""
+    cfg = cfg_with(use_post_ln=True, norm_type="layernorm")
+    p = layer_init(jax.random.PRNGKey(0), cfg)
+    from megatron_tpu.models.language_model import make_rope
+    rope = make_rope(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 64)) * 3
+    y, _ = layer_apply(p, x, cfg, rope_cos=rope.cos, rope_sin=rope.sin)
+    y = np.asarray(y)
+    np.testing.assert_allclose(y.mean(-1), 0.0, atol=1e-4)
+    np.testing.assert_allclose(y.var(-1), 1.0, atol=1e-2)
+
+
+def test_parallel_attn_single_residual():
+    """Falcon block: out = x + attn(ln(x)) + mlp(ln(x)) — verify additivity by
+    zeroing each branch's output projection."""
+    cfg = cfg_with(parallel_attn=True, norm_type="layernorm", activation="gelu")
+    from megatron_tpu.models.language_model import make_rope
+    rope = make_rope(cfg)
+    p = layer_init(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 64))
+    y_full, _ = layer_apply(p, x, cfg, rope_cos=rope.cos, rope_sin=rope.sin)
+    p_noattn = jax.tree.map(lambda a: a, p)
+    p_noattn["attention"] = dict(p["attention"], wo=jnp.zeros_like(p["attention"]["wo"]))
+    y_mlp, _ = layer_apply(p_noattn, x, cfg, rope_cos=rope.cos, rope_sin=rope.sin)
+    p_nomlp = jax.tree.map(lambda a: a, p)
+    p_nomlp["mlp"] = dict(p["mlp"], w2=jnp.zeros_like(p["mlp"]["w2"]))
+    y_attn, _ = layer_apply(p_nomlp, x, cfg, rope_cos=rope.cos, rope_sin=rope.sin)
+    np.testing.assert_allclose(
+        np.asarray(y_full), np.asarray(y_mlp + y_attn - x), atol=1e-5)
+
+
+def test_lima_ramp_matches_linspace():
+    """(ref: transformer.py:963-970 torch.linspace(0, p, L))"""
+    cfg = cfg_with(lima_dropout=True, hidden_dropout=0.1)
+    rates = np.asarray(lima_dropout_rates(cfg, 4))
+    np.testing.assert_allclose(rates, np.linspace(0.0, 0.1, 4), rtol=1e-6)
+    assert rates[0] == 0.0
+
+
+def test_lima_off_is_constant():
+    cfg = cfg_with(hidden_dropout=0.1)
+    rates = np.asarray(lima_dropout_rates(cfg, 4))
+    np.testing.assert_allclose(rates, 0.1)
