@@ -1,0 +1,114 @@
+"""Benchmark: training-step throughput on the available accelerator.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: tokens/sec on a Llama-2-architecture training step (bf16 compute,
+fp32 params/Adam), sized to the chip. vs_baseline compares achieved MFU
+against the reference's published A100 number — Llama2-7B at 890 tokens/s/GPU
+(ref: docs/guide/getting_started.md:200-201), i.e. 6*7e9*890/312e12 = 12.0%
+MFU on A100-80GB bf16 — so the ratio is hardware-normalized.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# bf16 peak FLOP/s per chip by device kind (public spec sheets)
+PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6e": 918e12,
+    "TPU v6 lite": 918e12,
+    "TPU7x": 2307e12,
+    "cpu": 1e11,
+}
+
+A100_BASELINE_MFU = 6 * 7.0e9 * 890 / 312e12  # = 0.1198
+
+
+def detect_peak(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for k, v in PEAK_FLOPS.items():
+        if kind.lower().startswith(k.lower()):
+            return v
+    return PEAK_FLOPS.get("TPU v4")
+
+
+def main():
+    from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
+                                     TrainingConfig, llama2_config)
+    from megatron_tpu.training import init_train_state, make_train_step
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        # ~1.1B llama-architecture model: fits 1 chip with fp32 Adam state
+        model = llama2_config(
+            "tiny", num_layers=16, hidden_size=2048, num_attention_heads=16,
+            num_kv_heads=16, ffn_hidden_size=5504, vocab_size=32000,
+            seq_length=2048, compute_dtype="bfloat16",
+            attention_impl="flash", recompute_granularity="selective")
+        micro_bs, n_micro, iters, warmup = 4, 2, 10, 3
+    else:  # smoke mode for CPU dev runs
+        model = llama2_config("tiny", seq_length=256,
+                              compute_dtype="bfloat16")
+        micro_bs, n_micro, iters, warmup = 2, 1, 3, 1
+
+    cfg = MegatronConfig(
+        model=model,
+        optimizer=OptimizerConfig(lr=1e-4, clip_grad=1.0),
+        training=TrainingConfig(micro_batch_size=micro_bs,
+                                global_batch_size=micro_bs * n_micro,
+                                train_iters=iters),
+    ).validate(n_devices=1)
+
+    rng = jax.random.PRNGKey(0)
+    state = init_train_state(rng, cfg)
+    step = make_train_step(cfg)
+    seq = cfg.model.seq_length
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (n_micro, micro_bs, seq + 1), 0,
+        cfg.model.vocab_size, dtype=jnp.int32)
+    batch = {"tokens": tokens,
+             "loss_mask": jnp.ones((n_micro, micro_bs, seq), jnp.float32)}
+
+    # param count for the FLOP model
+    n_params = sum(p.size for p in jax.tree.leaves(state.params))
+
+    for i in range(warmup):
+        state, m = step(state, batch, jax.random.fold_in(rng, i))
+    jax.block_until_ready(m["lm_loss"])
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, m = step(state, batch, jax.random.fold_in(rng, warmup + i))
+    jax.block_until_ready(m["lm_loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_iter = n_micro * micro_bs * seq
+    tok_s = tokens_per_iter * iters / dt
+    flops_per_token = 6 * n_params  # fwd+bwd dense FLOPs, attention excluded
+    mfu = tok_s * flops_per_token / detect_peak(dev)
+    vs_baseline = mfu / A100_BASELINE_MFU
+
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": f"tok/s ({n_params/1e9:.2f}B params, {dev.device_kind}, "
+                f"MFU={mfu:.3f})",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,221 @@
+"""Megatron-compatible CLI flag surface -> MegatronConfig.
+
+TPU-native bridge for the reference's argparse config system
+(ref: megatron/arguments.py:14-1073 — ~170 flags in 16 groups, stored in a
+mutable global namespace). Here flags parse into the frozen dataclass tree
+(megatron_tpu/config.py); the flag NAMES match the reference so launch
+scripts port by changing only the launcher. `extra_args_provider` mirrors
+the extension hook (ref: megatron/arguments.py:14-20, finetune.py:129-138).
+Validation/derivation lives in MegatronConfig.validate
+(ref: arguments.py:52-345 validate_args).
+"""
+from __future__ import annotations
+
+import argparse
+from typing import Callable, Optional
+
+from megatron_tpu.config import (DataConfig, MegatronConfig, ModelConfig,
+                                 OptimizerConfig, ParallelConfig,
+                                 TrainingConfig)
+
+
+def build_parser(extra_args_provider: Optional[Callable] = None
+                 ) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="megatron_tpu",
+                                allow_abbrev=False)
+
+    g = p.add_argument_group("model")
+    g.add_argument("--num_layers", type=int, default=2)
+    g.add_argument("--hidden_size", type=int, default=128)
+    g.add_argument("--ffn_hidden_size", type=int, default=None)
+    g.add_argument("--num_attention_heads", type=int, default=4)
+    g.add_argument("--num_attention_heads_kv", type=int, default=None,
+                   dest="num_kv_heads")
+    g.add_argument("--kv_channels", type=int, default=None)
+    # default None so model presets keep their native seq_length
+    g.add_argument("--seq_length", type=int, default=None)
+    g.add_argument("--max_position_embeddings", type=int, default=None)
+    g.add_argument("--make_vocab_size_divisible_by", type=int, default=128)
+    g.add_argument("--layernorm_epsilon", type=float, default=1e-5,
+                   dest="norm_epsilon")
+    g.add_argument("--use_rms_norm", action="store_true")
+    g.add_argument("--use_post_ln", action="store_true")
+    g.add_argument("--use_bias", action="store_true")
+    g.add_argument("--parallel_attn", action="store_true")
+    g.add_argument("--parallel_layernorm", action="store_true")
+    g.add_argument("--use_rotary_emb", action="store_true", default=True)
+    g.add_argument("--no_rotary_emb", dest="use_rotary_emb",
+                   action="store_false")
+    g.add_argument("--position_embedding", action="store_true",
+                   dest="use_position_embedding")
+    g.add_argument("--rope_theta", type=float, default=10000.0)
+    g.add_argument("--rope_scaling_factor", type=float, default=1.0)
+    g.add_argument("--glu_activation", type=str, default=None,
+                   choices=["swiglu", "geglu", "reglu", "liglu"])
+    g.add_argument("--activation", type=str, default=None)
+    g.add_argument("--hidden_dropout", type=float, default=0.0)
+    g.add_argument("--attention_dropout", type=float, default=0.0)
+    g.add_argument("--lima_dropout", action="store_true")
+    g.add_argument("--tie_embed_logits", action="store_true")
+    g.add_argument("--init_method_std", type=float, default=0.02)
+    g.add_argument("--bf16", action="store_true")
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--fp32", action="store_true")
+    g.add_argument("--use_flash_attn", action="store_true")
+    g.add_argument("--recompute_granularity", type=str, default="none",
+                   choices=["none", "selective", "full"])
+    g.add_argument("--model", type=str, default=None,
+                   help="preset name (llama2-7b, falcon-40b, gpt2, ...)")
+
+    g = p.add_argument_group("parallel")
+    g.add_argument("--tensor_model_parallel_size", type=int, default=1,
+                   dest="tensor_parallel")
+    g.add_argument("--pipeline_model_parallel_size", type=int, default=1,
+                   dest="pipeline_parallel")
+    g.add_argument("--context_parallel_size", type=int, default=1,
+                   dest="context_parallel")
+    g.add_argument("--num_layers_per_virtual_pipeline_stage", type=int,
+                   default=None)
+    g.add_argument("--sequence_parallel", action="store_true")
+    g.add_argument("--use_distributed_optimizer", action="store_true")
+
+    g = p.add_argument_group("training")
+    g.add_argument("--micro_batch_size", type=int, default=1)
+    g.add_argument("--global_batch_size", type=int, default=None)
+    g.add_argument("--rampup_batch_size", nargs=3, type=int, default=None)
+    g.add_argument("--train_iters", type=int, default=100)
+    g.add_argument("--eval_interval", type=int, default=1000)
+    g.add_argument("--eval_iters", type=int, default=10)
+    g.add_argument("--log_interval", type=int, default=10)
+    g.add_argument("--save_interval", type=int, default=None)
+    g.add_argument("--exit_interval", type=int, default=None)
+    g.add_argument("--exit_duration_in_mins", type=float, default=None)
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--save", type=str, default=None, dest="checkpoint_dir")
+    g.add_argument("--load", type=str, default=None, dest="load_dir")
+    g.add_argument("--finetune", action="store_true")
+    g.add_argument("--no_load_optim", action="store_true")
+    g.add_argument("--no_load_rng", action="store_true")
+    g.add_argument("--use_checkpoint_args", action="store_true")
+    g.add_argument("--wandb_logger", action="store_true")
+    g.add_argument("--tensorboard_dir", type=str, default=None)
+
+    g = p.add_argument_group("optimizer")
+    g.add_argument("--optimizer", type=str, default="adam",
+                   choices=["adam", "sgd"])
+    g.add_argument("--lr", type=float, default=3e-4)
+    g.add_argument("--min_lr", type=float, default=0.0)
+    g.add_argument("--lr_decay_style", type=str, default="cosine")
+    g.add_argument("--lr_decay_iters", type=int, default=None)
+    g.add_argument("--lr_warmup_iters", type=int, default=0)
+    g.add_argument("--lr_warmup_fraction", type=float, default=None)
+    g.add_argument("--weight_decay", type=float, default=0.01)
+    g.add_argument("--start_weight_decay", type=float, default=None)
+    g.add_argument("--end_weight_decay", type=float, default=None)
+    g.add_argument("--weight_decay_incr_style", type=str, default="constant")
+    g.add_argument("--adam_beta1", type=float, default=0.9)
+    g.add_argument("--adam_beta2", type=float, default=0.999)
+    g.add_argument("--adam_eps", type=float, default=1e-8)
+    g.add_argument("--sgd_momentum", type=float, default=0.9)
+    g.add_argument("--clip_grad", type=float, default=1.0)
+    g.add_argument("--loss_scale", type=float, default=None)
+    g.add_argument("--initial_loss_scale", type=float, default=2.0 ** 32)
+    g.add_argument("--min_loss_scale", type=float, default=1.0)
+    g.add_argument("--loss_scale_window", type=int, default=1000)
+    g.add_argument("--hysteresis", type=int, default=2)
+    g.add_argument("--log_num_zeros_in_grad", action="store_true")
+
+    g = p.add_argument_group("data")
+    g.add_argument("--data_path", nargs="*", default=None)
+    g.add_argument("--split", type=str, default="969,30,1")
+    g.add_argument("--tokenizer_type", type=str,
+                   default="SentencePieceTokenizer")
+    g.add_argument("--vocab_file", type=str, default=None)
+    g.add_argument("--merge_file", type=str, default=None)
+    g.add_argument("--tokenizer_model", type=str, default=None,
+                   dest="tokenizer_model")
+    g.add_argument("--vocab_size", type=int, default=32000)
+    g.add_argument("--dataloader_type", type=str, default="single",
+                   choices=["single", "cyclic"])
+    g.add_argument("--num_workers", type=int, default=2)
+    g.add_argument("--reset_position_ids", action="store_true")
+    g.add_argument("--reset_attention_mask", action="store_true")
+    g.add_argument("--eod_mask_loss", action="store_true")
+    g.add_argument("--vocab_extra_ids", type=int, default=0)
+    g.add_argument("--vocab_extra_ids_list", type=str, default=None)
+    g.add_argument("--no_new_tokens", dest="new_tokens",
+                   action="store_false", default=True)
+    g.add_argument("--data_impl", type=str, default="mmap")
+
+    if extra_args_provider is not None:
+        p = extra_args_provider(p)
+    return p
+
+
+def _pick(ns: argparse.Namespace, cls, **renames):
+    import dataclasses
+    fields = {f.name for f in dataclasses.fields(cls)}
+    d = {k: v for k, v in vars(ns).items() if k in fields}
+    d.update({k: v for k, v in renames.items() if v is not None})
+    return d
+
+
+def config_from_args(args: argparse.Namespace,
+                     n_devices: Optional[int] = None) -> MegatronConfig:
+    from megatron_tpu.config import MODEL_PRESETS
+
+    if args.model:
+        model = MODEL_PRESETS[args.model]()
+        import dataclasses
+        model = dataclasses.replace(
+            model, seq_length=args.seq_length or model.seq_length,
+            recompute_granularity=args.recompute_granularity,
+            attention_impl="flash" if args.use_flash_attn
+            else model.attention_impl)
+    else:
+        activation = (args.glu_activation or args.activation or
+                      ("swiglu" if args.use_rms_norm else "gelu"))
+        params_dtype = ("bfloat16" if args.bf16 else
+                        "float16" if args.fp16 else "float32")
+        md = _pick(args, ModelConfig)
+        if md.get("seq_length") is None:
+            md["seq_length"] = 512
+        md.update(dict(
+            norm_type="rmsnorm" if args.use_rms_norm else "layernorm",
+            activation=activation,
+            params_dtype=params_dtype,
+            compute_dtype="bfloat16" if args.bf16 or args.fp16 else "float32",
+            attention_impl="flash" if args.use_flash_attn else "dot",
+        ))
+        model = ModelConfig(**md)
+
+    vpp = 1
+    if args.num_layers_per_virtual_pipeline_stage:
+        per_stage = model.num_layers // max(args.pipeline_parallel, 1)
+        vpp = per_stage // args.num_layers_per_virtual_pipeline_stage
+
+    cfg = MegatronConfig(
+        model=model,
+        parallel=ParallelConfig(
+            tensor_parallel=args.tensor_parallel,
+            pipeline_parallel=args.pipeline_parallel,
+            context_parallel=args.context_parallel,
+            sequence_parallel=args.sequence_parallel,
+            virtual_pipeline_chunks=vpp,
+            use_distributed_optimizer=args.use_distributed_optimizer,
+        ),
+        optimizer=OptimizerConfig(**_pick(args, OptimizerConfig)),
+        training=TrainingConfig(**{
+            **_pick(args, TrainingConfig),
+            "rampup_batch_size": tuple(args.rampup_batch_size)
+            if args.rampup_batch_size else None}),
+        data=DataConfig(**_pick(args, DataConfig)),
+    )
+    return cfg.validate(n_devices=n_devices)
+
+
+def parse_cli(argv=None, extra_args_provider=None, n_devices=None
+              ) -> tuple[MegatronConfig, argparse.Namespace]:
+    parser = build_parser(extra_args_provider)
+    args = parser.parse_args(argv)
+    return config_from_args(args, n_devices=n_devices), args

@@ -1,0 +1,78 @@
+"""ctypes loader for the native data helpers.
+
+TPU-native replacement for the reference's runtime-compiled pybind11 module
+(ref: megatron/data/Makefile:1-9, megatron/data/dataset_utils.py:82-92
+`compile_helper`). Same compile-on-first-use behavior, but via g++ + ctypes —
+pybind11 is not available in this image.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "helpers.cpp")
+_SO = os.path.join(_HERE, "_helpers.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(_SO)
+        lib.build_sample_idx.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.build_sample_idx.restype = None
+        lib.build_blending_indices.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int32, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64)]
+        lib.build_blending_indices.restype = None
+        _lib = lib
+        return lib
+
+
+def _ptr(arr, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def build_sample_idx_native(sizes: np.ndarray, doc_idx: np.ndarray,
+                            seq_length: int, num_epochs: int,
+                            tokens_per_epoch: int) -> np.ndarray:
+    lib = _load()
+    sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+    doc_idx = np.ascontiguousarray(doc_idx, dtype=np.int32)
+    num_samples = (num_epochs * tokens_per_epoch - 1) // seq_length
+    out = np.zeros((num_samples + 1, 2), dtype=np.int32)
+    lib.build_sample_idx(
+        _ptr(sizes, ctypes.c_int32), _ptr(doc_idx, ctypes.c_int32),
+        ctypes.c_int64(len(doc_idx)), ctypes.c_int32(seq_length),
+        ctypes.c_int32(num_epochs), ctypes.c_int64(tokens_per_epoch),
+        _ptr(out, ctypes.c_int32))
+    return out
+
+
+def build_blending_indices_native(weights: np.ndarray, size: int):
+    lib = _load()
+    weights = np.ascontiguousarray(weights, dtype=np.float64)
+    assert len(weights) <= 256
+    dataset_index = np.zeros(size, dtype=np.uint8)
+    dataset_sample_index = np.zeros(size, dtype=np.int64)
+    lib.build_blending_indices(
+        _ptr(weights, ctypes.c_double), ctypes.c_int32(len(weights)),
+        ctypes.c_int64(size), _ptr(dataset_index, ctypes.c_uint8),
+        _ptr(dataset_sample_index, ctypes.c_int64))
+    return dataset_index, dataset_sample_index

@@ -1,0 +1,201 @@
+"""Pretraining samplers and the batch feeder.
+
+TPU-native port of megatron/data/data_samplers.py (:48-95
+MegatronPretrainingSampler, :119-186 random variant, :14-45
+build_pretraining_data_loader). Semantics kept:
+
+- sequential sampler resumes from `consumed_samples` (checkpoint resume
+  fast-forwards the stream, ref: data_samplers.py:50-60);
+- the random variant reshuffles per epoch with seed = base_seed + epoch
+  (ref: data_samplers.py:119-166) and equally dp-shards the pool;
+- drop_last batching.
+
+Difference by design: the reference yields per-dp-rank microbatches from a
+per-rank torch DataLoader and broadcasts over TP (ref: training.py:855-939).
+Single-controller JAX wants the GLOBAL batch on the host: `BatchIterator`
+yields {"tokens": [n_micro, micro_bs*dp, seq+1]} ready for device_put against
+the dp-sharded spec — the tp/pp broadcast machinery dissolves.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class MegatronPretrainingSampler:
+    """Sequential dp-sharded sampler (ref: data_samplers.py:48-95).
+    Yields lists of global dataset indices, one per (micro_bs * dp) chunk."""
+
+    def __init__(self, total_samples: int, consumed_samples: int,
+                 micro_batch_size: int, data_parallel_size: int,
+                 drop_last: bool = True):
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self.micro_batch_times_dp = micro_batch_size * data_parallel_size
+        self.drop_last = drop_last
+        assert self.total_samples > 0
+        assert self.consumed_samples < self.total_samples
+
+    def __len__(self):
+        return self.total_samples
+
+    def __iter__(self):
+        batch = []
+        for idx in range(self.consumed_samples, self.total_samples):
+            batch.append(idx)
+            if len(batch) == self.micro_batch_times_dp:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+
+class MegatronPretrainingRandomSampler:
+    """Per-epoch reshuffling sampler (ref: data_samplers.py:119-186)."""
+
+    def __init__(self, total_samples: int, consumed_samples: int,
+                 micro_batch_size: int, data_parallel_size: int,
+                 seed: int = 1234):
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self.micro_batch_times_dp = micro_batch_size * data_parallel_size
+        self.seed = seed
+        self.last_batch_size = (self.total_samples
+                                % self.micro_batch_times_dp)
+
+    def __len__(self):
+        return self.total_samples
+
+    def __iter__(self):
+        active_total = self.total_samples - self.last_batch_size
+        self.epoch = self.consumed_samples // active_total
+        current_epoch_samples = self.consumed_samples % active_total
+        assert current_epoch_samples % self.micro_batch_times_dp == 0
+
+        g = np.random.RandomState(self.seed + self.epoch)
+        idx_range = g.permutation(active_total)[current_epoch_samples:]
+
+        batch = []
+        for idx in idx_range:
+            batch.append(int(idx))
+            if len(batch) == self.micro_batch_times_dp:
+                self.consumed_samples += self.micro_batch_times_dp
+                yield batch
+                batch = []
+
+
+class BatchIterator:
+    """Assemble {"tokens", "loss_mask", "position_ids"} global batches of
+    shape [n_micro, micro_bs*dp, ...] from a map-style dataset.
+
+    The train loop's view of the data pipeline; replaces torch DataLoader +
+    get_batch/broadcast_data (ref: finetune.py:65-90,
+    core/tensor_parallel/data.py:65)."""
+
+    def __init__(self, dataset, micro_batch_size: int, data_parallel: int,
+                 num_microbatches: int, consumed_samples: int = 0,
+                 dataloader_type: str = "single", seed: int = 1234,
+                 drop_last: bool = True,
+                 eod_token: Optional[int] = None,
+                 reset_position_ids: bool = False,
+                 reset_attention_mask: bool = False,
+                 eod_mask_loss: bool = False):
+        self.dataset = dataset
+        self.num_microbatches = num_microbatches
+        self.eod_token = eod_token
+        self.reset_position_ids = reset_position_ids
+        self.reset_attention_mask = reset_attention_mask
+        self.eod_mask_loss = eod_mask_loss
+        self._sampler_args = (micro_batch_size, data_parallel, seed,
+                              drop_last)
+        self._dataloader_type = dataloader_type
+        self.sampler = self._make_sampler(consumed_samples)
+        self._it = iter(self.sampler)
+
+    def _make_sampler(self, consumed_samples: int):
+        mbs, dp, seed, drop_last = self._sampler_args
+        if self._dataloader_type == "single":
+            return MegatronPretrainingSampler(
+                len(self.dataset), consumed_samples, mbs, dp, drop_last)
+        if self._dataloader_type == "cyclic":
+            return MegatronPretrainingRandomSampler(
+                len(self.dataset), consumed_samples, mbs, dp, seed)
+        raise ValueError(f"unknown dataloader_type {self._dataloader_type!r}")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        micro = []
+        for _ in range(self.num_microbatches):
+            try:
+                idxs = next(self._it)
+            except StopIteration:
+                if self._dataloader_type == "cyclic":
+                    # the random sampler's consumed_samples advanced during
+                    # iteration; re-iterating it starts the NEXT epoch with a
+                    # fresh seed+epoch permutation (ref: data_samplers.py:
+                    # 119-166)
+                    self._it = iter(self.sampler)
+                else:
+                    # sequential wrap: restart from sample 0, NOT from the
+                    # resume offset — otherwise samples [0, consumed) would
+                    # be excluded from every later epoch
+                    self.sampler = self._make_sampler(0)
+                    self._it = iter(self.sampler)
+                idxs = next(self._it)
+            micro.append(np.stack(
+                [np.asarray(self.dataset[i]["text"]) for i in idxs]))
+        tokens = np.stack(micro).astype(np.int32)  # [n_micro, b, seq+1]
+        batch = {"tokens": tokens}
+        n_micro, b, sp1 = tokens.shape
+        if ((self.reset_position_ids or self.reset_attention_mask or
+             self.eod_mask_loss) and self.eod_token is not None):
+            # helper runs on the INPUT tokens (tokens[:-1]); its loss_mask
+            # zeroes positions whose input is EOD — i.e. it suppresses
+            # predicting the next document's first token FROM the EOD,
+            # matching ref: megatron/utils.py:137-194
+            flat = tokens[..., :-1].reshape(n_micro * b, sp1 - 1)
+            loss_mask, pos, seg = get_ltor_masks_and_position_ids(
+                flat, self.eod_token,
+                reset_position_ids=self.reset_position_ids,
+                reset_attention_mask=self.reset_attention_mask,
+                eod_mask_loss=self.eod_mask_loss)
+            batch["loss_mask"] = loss_mask.reshape(n_micro, b, sp1 - 1)
+            if self.reset_position_ids:
+                batch["position_ids"] = pos.reshape(n_micro, b, sp1 - 1)
+            if self.reset_attention_mask:
+                batch["segment_ids"] = seg.reshape(n_micro, b, sp1 - 1)
+        else:
+            batch["loss_mask"] = np.ones(tokens[..., 1:].shape, np.float32)
+        return batch
+
+
+def get_ltor_masks_and_position_ids(
+    tokens: np.ndarray, eod_token: int,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+):
+    """Loss mask / position ids with optional EOD resets
+    (ref: megatron/utils.py:137-194 — the attention mask itself is built
+    inside the attention op on TPU, so only its EOD-reset boundaries are
+    returned here as segment ids for a block-diagonal mask)."""
+    b, s = tokens.shape
+    loss_mask = np.ones((b, s), np.float32)
+    if eod_mask_loss:
+        loss_mask[tokens == eod_token] = 0.0
+    position_ids = np.tile(np.arange(s, dtype=np.int32), (b, 1))
+    segment_ids = np.zeros((b, s), np.int32)
+    if reset_position_ids or reset_attention_mask:
+        for bi in range(b):
+            eods = np.where(tokens[bi] == eod_token)[0]
+            prev = 0
+            for si, e in enumerate(eods):
+                if reset_position_ids:
+                    position_ids[bi, e + 1:] -= (e + 1 - prev)
+                if reset_attention_mask:
+                    segment_ids[bi, e + 1:] = si + 1
+                prev = e + 1
+    return loss_mask, position_ids, segment_ids

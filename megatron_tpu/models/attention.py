@@ -84,13 +84,15 @@ def attention_axes(cfg: ModelConfig):
 
 def _dot_attention(q, k, v, *, causal: bool, softmax_fp32: bool,
                    scale: float, q_offset=None, dropout_rate: float = 0.0,
-                   dropout_rng=None):
+                   dropout_rng=None, segment_ids=None):
     """Unfused attention: einsum QK^T -> mask -> softmax -> einsum AV.
 
     q: [b, s, nq, hd]; k, v: [b, t, nkv, hd]. GQA handled by reshaping q into
     [b, s, nkv, q_per_kv, hd] (equivalent of the reference's kv broadcast at
     transformer.py:448-455, but without materializing the broadcast).
-    `q_offset` (scalar) shifts the causal mask for incremental decoding."""
+    `q_offset` (scalar) shifts the causal mask for incremental decoding.
+    `segment_ids` [b, s] makes the mask block-diagonal across EOD-separated
+    documents (ref: --reset_attention_mask, megatron/utils.py:137-194)."""
     b, s, nq, hd = q.shape
     t, nkv = k.shape[1], k.shape[2]
     g = nq // nkv
@@ -104,7 +106,11 @@ def _dot_attention(q, k, v, *, causal: bool, softmax_fp32: bool,
             q_pos = q_pos + q_offset
         kv_pos = jnp.arange(t)[None, :]
         mask = q_pos >= kv_pos  # [s, t]
-        scores = jnp.where(mask[None, None, None], scores, jnp.finfo(scores.dtype).min)
+        mask = jnp.broadcast_to(mask[None], (b, s, t))
+        if segment_ids is not None:
+            assert s == t, "segment masking requires full (non-cached) attn"
+            mask = mask & (segment_ids[:, :, None] == segment_ids[:, None, :])
+        scores = jnp.where(mask[:, None, None], scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores, axis=-1)
     probs = probs.astype(v.dtype)
     if dropout_rate > 0.0 and dropout_rng is not None:
@@ -125,6 +131,7 @@ def attention_apply(
     layer_number: int = 1,
     dropout_rng=None,
     deterministic: bool = True,
+    segment_ids=None,
 ):
     """Forward pass. x: [b, s, h]. Returns (out [b, s, h], new_kv_cache)."""
     b, s, h = x.shape
@@ -171,7 +178,7 @@ def attention_apply(
     # (attention_softmax_in_fp32), so the trick is unnecessary and the flag
     # intentionally has no numerical effect.
 
-    if cfg.attention_impl == "flash" and kv_cache is None:
+    if cfg.attention_impl == "flash" and kv_cache is None and segment_ids is None:
         from megatron_tpu.ops.flash_attention import flash_attention
         out = flash_attention(q, k, v, causal=True, scale=scale)
     else:
@@ -179,7 +186,7 @@ def attention_apply(
         out = _dot_attention(
             q, k, v, causal=True, softmax_fp32=cfg.attention_softmax_in_fp32,
             scale=scale, q_offset=q_offset, dropout_rate=rate,
-            dropout_rng=dropout_rng)
+            dropout_rng=dropout_rng, segment_ids=segment_ids)
 
     out = out.reshape(b, s, nq * hd)
     out = out @ params["wo"].astype(dtype)

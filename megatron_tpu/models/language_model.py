@@ -94,6 +94,7 @@ def model_forward(
     rng=None,
     deterministic: bool = True,
     logits_dtype=jnp.float32,
+    segment_ids=None,
 ):
     """Forward to logits [b, s, padded_vocab]. Returns (logits, kv_caches)."""
     from megatron_tpu.config import as_dtype
@@ -122,7 +123,7 @@ def model_forward(
         rope_cos=rope.cos if rope else None,
         rope_sin=rope.sin if rope else None,
         position_ids=position_ids, kv_caches=kv_caches,
-        rng=rng, deterministic=deterministic)
+        rng=rng, deterministic=deterministic, segment_ids=segment_ids)
 
     x = apply_norm(cfg.norm_type, params["final_norm"], x, cfg.norm_epsilon)
 
@@ -143,6 +144,8 @@ def loss_fn(
     rope=None,
     rng=None,
     deterministic: bool = True,
+    position_ids=None,
+    segment_ids=None,
 ):
     """Causal LM loss: mean CE over unmasked positions
     (ref: finetune.py:83 loss_func — masked mean)."""
@@ -153,7 +156,9 @@ def loss_fn(
         if loss_mask is not None and loss_mask.shape[1] == tokens.shape[1]:
             loss_mask = loss_mask[:, 1:]
     logits, _ = model_forward(params, inputs, cfg, rope=rope, rng=rng,
-                              deterministic=deterministic)
+                              deterministic=deterministic,
+                              position_ids=position_ids,
+                              segment_ids=segment_ids)
     losses = cross_entropy_loss(logits, labels, vocab_size=cfg.vocab_size)
     if loss_mask is None:
         return jnp.mean(losses)

@@ -90,6 +90,7 @@ def layer_apply(
     hidden_dropout: Optional[float] = None,
     rng=None,
     deterministic: bool = True,
+    segment_ids=None,
 ):
     """One transformer layer. x: [b, s, h]. Returns (x, kv_cache).
 
@@ -121,7 +122,8 @@ def layer_apply(
         params["attention"], ln_out, cfg,
         rope_cos=rope_cos, rope_sin=rope_sin, position_ids=position_ids,
         kv_cache=kv_cache, layer_number=layer_number,
-        dropout_rng=r_score, deterministic=deterministic)
+        dropout_rng=r_score, deterministic=deterministic,
+        segment_ids=segment_ids)
 
     if cfg.parallel_attn:
         # Falcon block: no dropout-add after attention
@@ -184,6 +186,7 @@ def stack_apply(
     rng=None,
     deterministic: bool = True,
     layer_offset: int = 0,
+    segment_ids=None,
 ):
     """Apply all (or a pipeline stage's worth of) layers via lax.scan.
 
@@ -204,7 +207,7 @@ def stack_apply(
             p, h, cfg, rope_cos=rope_cos, rope_sin=rope_sin,
             position_ids=position_ids, kv_cache=cache,
             layer_number=lid + 1, hidden_dropout=rate, rng=layer_rng,
-            deterministic=deterministic)
+            deterministic=deterministic, segment_ids=segment_ids)
         return h, new_cache
 
     if cfg.recompute_granularity == "full":

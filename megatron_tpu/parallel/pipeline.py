@@ -1,0 +1,240 @@
+"""Pipeline parallelism: collective-permute pipeline over the 'pp' mesh axis.
+
+TPU-native equivalent of the reference's pipeline stack — p2p layer
+(ref: megatron/p2p_communication.py:101-405), 1F1B schedules
+(ref: megatron/schedules.py:213-722), and per-stage model construction
+(ref: megatron/model/transformer.py:844-893 _get_num_layers,
+megatron/training.py:204-219). Mapping:
+
+- *Stage partitioning*: the scan-stacked layer params are reshaped to
+  [pp, layers_per_stage, ...] and sharded over 'pp' on dim 0 — the analogue
+  of each pipeline rank owning its contiguous layer slice.
+- *P2P send/recv* (batched isend/irecv + shape handshakes) becomes ONE
+  `lax.ppermute` per pipeline tick rotating activations stage i -> i+1.
+  No shape handshake is ever needed: shapes are static under jit.
+- *Schedule*: microbatch j enters stage i at tick t = i + j; the scan runs
+  T = n_micro + pp - 1 ticks (fill + steady + drain). The backward pipeline
+  is DERIVED by jax.grad — reverse-mode turns the forward ppermute rotation
+  into the mirrored backward rotation, giving the fill-drain schedule's
+  backward for free. The reference's hand-written warmup/steady/cooldown
+  bookkeeping (schedules.py:606-722) and `deallocate_output_tensor` /
+  `custom_backward` memory hacks (schedules.py:36-88) have no equivalent:
+  remat policy (`jax.checkpoint` on the stage body) bounds live activations
+  instead.
+- *Bubble*: identical to 1F1B's (pp-1)/(n_micro+pp-1) fill-drain fraction for
+  the forward; peak activation memory is bounded by remat, which on TPU
+  (HBM-rich, recompute-cheap on MXU) is the idiomatic trade. A true
+  interleaved-1F1B (virtual stages, ref: schedules.py:253-502) maps to
+  chunked stage params [pp, vpp, layers/(pp*vpp), ...] with a modulo-chunk
+  schedule — planned on top of this same primitive.
+- *Embedding/LM-head*: computed OUTSIDE the pipelined region, replicated
+  over 'pp' (each pp rank redundantly embeds — cheap — instead of the
+  reference's embedding-group all-reduce of tied-embedding grads,
+  ref: optimizer.py:203-229; with GSPMD the tied-weight grad contributions
+  from first/last "stage" meet automatically because it is one parameter).
+
+The shard_map is manual over 'pp' ONLY; 'dp'/'cp'/'tp' stay automatic, so
+GSPMD still inserts the TP/SP collectives inside each stage body.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models import transformer as tfm
+
+
+def stage_params_reshape(stacked_params, pp: int):
+    """[L, ...] stacked layer params -> [pp, L//pp, ...]."""
+    def r(x):
+        L = x.shape[0]
+        assert L % pp == 0, f"num_layers {L} not divisible by pp {pp}"
+        return x.reshape(pp, L // pp, *x.shape[1:])
+    return jax.tree.map(r, stacked_params)
+
+
+def stage_params_flatten(staged_params):
+    """Inverse of stage_params_reshape."""
+    return jax.tree.map(
+        lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
+        staged_params)
+
+
+def pipeline_apply(
+    staged_params,
+    x_micro,  # [n_micro, b, s, h] activations after embedding
+    cfg: ModelConfig,
+    mesh,
+    *,
+    rope_cos=None,
+    rope_sin=None,
+    rng=None,
+    deterministic: bool = True,
+    position_ids=None,  # [n_micro, b, s] or None
+    segment_ids=None,   # [n_micro, b, s] or None
+):
+    """Run the pipelined transformer stack. Returns [n_micro, b, s, h].
+
+    Equivalent of forward_backward_pipelining_without_interleaving's forward
+    half (ref: schedules.py:606-722); its backward half is jax.grad of this.
+    """
+    pp = mesh.shape["pp"]
+    n_micro = x_micro.shape[0]
+    layers_per_stage = cfg.num_layers // pp
+    T = n_micro + pp - 1
+
+    def stage_fn(params_1stage, h, pos, seg, stage_idx, tick_rng):
+        """Apply this stage's layer slice (inner scan over its layers)."""
+        return tfm.stack_apply(
+            params_1stage, h, cfg,
+            rope_cos=rope_cos, rope_sin=rope_sin,
+            position_ids=pos, segment_ids=seg,
+            rng=tick_rng, deterministic=deterministic,
+            layer_offset=stage_idx * layers_per_stage)[0]
+
+    compute_dtype = x_micro.dtype
+    # Keep the shard_map boundary in f32: the replicated-input cotangent in
+    # the derived backward is a psum over 'pp', and XLA's CPU partitioner
+    # CHECK-fails on bf16 psum in partial-manual regions (same bug as below).
+    x_micro = x_micro.astype(jnp.float32)
+    n_b, n_s = x_micro.shape[1], x_micro.shape[2]
+    if position_ids is None:
+        position_ids = jnp.broadcast_to(
+            jnp.arange(n_s, dtype=jnp.int32), (n_micro, n_b, n_s))
+    if segment_ids is None:
+        segment_ids = jnp.zeros((n_micro, n_b, n_s), jnp.int32)
+
+    def per_stage(params_shard, x_all, pos_all, seg_all):
+        # inside shard_map: params_shard [1, layers_per_stage, ...]; x_all is
+        # the full microbatch stream (replicated over 'pp')
+        x_all = x_all.astype(compute_dtype)
+        params_1 = jax.tree.map(lambda p: p[0], params_shard)
+        stage = jax.lax.axis_index("pp")
+        is_first = stage == 0
+        is_last = stage == pp - 1
+        perm = [(i, i + 1) for i in range(pp - 1)]
+
+        def tick(carry, t):
+            buf, outputs = carry
+            # first stage pulls microbatch t from the host stream (clamped;
+            # out-of-range ticks do garbage work that is masked at collect)
+            mb_idx = jnp.clip(t, 0, n_micro - 1)
+            mb_in = jax.lax.dynamic_index_in_dim(x_all, mb_idx, axis=0,
+                                                 keepdims=False)
+            # pos/seg ids for the microbatch THIS STAGE is processing at
+            # tick t: stage s works on microbatch t - s
+            my_mb = jnp.clip(t - stage, 0, n_micro - 1)
+            pos = jax.lax.dynamic_index_in_dim(pos_all, my_mb, axis=0,
+                                               keepdims=False)
+            seg = jax.lax.dynamic_index_in_dim(seg_all, my_mb, axis=0,
+                                               keepdims=False)
+            h = jnp.where(is_first, mb_in, buf)
+            tick_rng = (jax.random.fold_in(rng, t)
+                        if rng is not None and not deterministic else None)
+            out = stage_fn(params_1, h, pos, seg, stage, tick_rng)
+            # collect finished microbatch on the last stage
+            out_idx = t - (pp - 1)
+            valid = is_last & (out_idx >= 0)
+            outputs = jax.lax.cond(
+                valid,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, out, jnp.clip(out_idx, 0, n_micro - 1), axis=0),
+                lambda o: o,
+                outputs)
+            # rotate activations stage i -> i+1 (the p2p send/recv)
+            buf_next = jax.lax.ppermute(out, "pp", perm) if pp > 1 else out
+            return (buf_next, outputs), None
+
+        buf0 = jnp.zeros_like(x_all[0])
+        outputs0 = jnp.zeros_like(x_all)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (buf0, outputs0), jnp.arange(T))
+        # replicate the last stage's outputs to every pp rank so the
+        # (pp-replicated) LM head can consume them. psum in f32: XLA's CPU
+        # SPMD partitioner CHECK-fails on bf16 psum inside a partial-manual
+        # region ("Invalid binary instruction opcode copy"); f32 psum is also
+        # the numerically safer reduction.
+        dtype = outputs.dtype
+        outputs = jax.lax.psum(
+            jnp.where(is_last, outputs,
+                      jnp.zeros_like(outputs)).astype(jnp.float32), "pp")
+        return outputs.astype(dtype)
+
+    # Partial-manual shard_map: manual over 'pp' only; dp/cp/tp stay
+    # automatic (GSPMD). Constraints of this mode (jax 0.9): must run under
+    # jit, with the ambient mesh set via `jax.set_mesh(mesh)` OUTSIDE jit —
+    # the caller (train loop / tests) owns both.
+    shmap = jax.shard_map(
+        per_stage,
+        in_specs=(P("pp"), P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+        axis_names={"pp"},
+    )
+    return shmap(staged_params, x_micro, position_ids, segment_ids)
+
+
+def pipeline_loss_fn(
+    params,
+    tokens,  # [n_micro, b, s+1]
+    cfg: ModelConfig,
+    mesh,
+    *,
+    loss_mask=None,  # [n_micro, b, s]
+    rope=None,
+    rng=None,
+    deterministic: bool = True,
+    position_ids=None,  # [n_micro, b, s]
+    segment_ids=None,   # [n_micro, b, s]
+):
+    """Full-model loss with the transformer stack pipelined over 'pp'.
+
+    Embedding / final-norm / LM-head / CE run outside the shard_map,
+    pp-replicated (see module docstring). Returns scalar mean loss over all
+    microbatches — identical semantics to the sequential microbatch scan in
+    training/train_step.py, so pp=1 and pp>1 train identically.
+    """
+    from megatron_tpu.config import as_dtype
+    from megatron_tpu.models import language_model as lm
+    from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+
+    if rope is None:
+        rope = lm.make_rope(cfg)
+    compute_dtype = as_dtype(cfg.compute_dtype)
+    inputs = tokens[..., :-1]
+    labels = tokens[..., 1:]
+    if loss_mask is None:
+        loss_mask = jnp.ones(labels.shape, jnp.float32)
+
+    emb = params["embedding"]["word_embeddings"]
+    x = emb[inputs].astype(compute_dtype)  # [n_micro, b, s, h]
+    if cfg.use_position_embedding:
+        pos = (position_ids if position_ids is not None
+               else jnp.arange(inputs.shape[-1]))
+        x = x + params["embedding"]["position_embeddings"][pos].astype(
+            compute_dtype)
+
+    pp = mesh.shape["pp"]
+    staged = stage_params_reshape(params["transformer"], pp)
+    x = pipeline_apply(
+        staged, x, cfg, mesh,
+        rope_cos=rope.cos if rope else None,
+        rope_sin=rope.sin if rope else None,
+        rng=rng, deterministic=deterministic,
+        position_ids=position_ids, segment_ids=segment_ids)
+
+    from megatron_tpu.models.norms import apply_norm
+    x = apply_norm(cfg.norm_type, params["final_norm"], x, cfg.norm_epsilon)
+    if cfg.tie_embed_logits:
+        w_out = params["embedding"]["word_embeddings"].T
+    else:
+        w_out = params["lm_head"]
+    logits = (x @ w_out.astype(compute_dtype)).astype(jnp.float32)
+    losses = cross_entropy_loss(logits, labels, vocab_size=cfg.vocab_size)
+    loss_mask = loss_mask.astype(losses.dtype)
+    return jnp.sum(losses * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)

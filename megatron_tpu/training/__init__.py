@@ -1,0 +1,7 @@
+from megatron_tpu.training.optimizer import (  # noqa: F401
+    OptState, ScalerState, apply_optimizer, clip_by_global_norm,
+    global_grad_norm, init_optimizer, weight_decay_mask)
+from megatron_tpu.training.scheduler import learning_rate, weight_decay  # noqa: F401
+from megatron_tpu.training.train_step import (  # noqa: F401
+    TrainState, init_train_state, make_train_step, train_step)
+from megatron_tpu.training.microbatches import MicrobatchCalculator  # noqa: F401

@@ -1,0 +1,225 @@
+"""Training driver: the `pretrain` loop.
+
+TPU-native equivalent of megatron/training.py — `pretrain` (:54-167), the
+`_train` loop (:639-751), `training_log` (:452-626), `evaluate` (:754-807) —
+plus the SIGTERM checkpoint-and-exit and timed-exit semantics
+(ref: megatron/dist_signal_handler.py:50-81, training.py:712-748).
+
+Differences by design:
+- One process drives all local devices (single-controller JAX); the
+  "dataloader only on tp-rank-0 then broadcast flags" machinery
+  (ref: training.py:855-939) dissolves — the host feeds a globally-sharded
+  batch via jax.device_put against the dp-sharded spec.
+- train_step is one compiled program (training/train_step.py); timers wrap it
+  with block_until_ready instead of CUDA syncs.
+"""
+from __future__ import annotations
+
+import signal
+import time
+from typing import Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import MegatronConfig
+from megatron_tpu.training import train_step as ts
+from megatron_tpu.training.microbatches import MicrobatchCalculator
+from megatron_tpu.utils.logging import make_writer, print_rank_0
+from megatron_tpu.utils.timers import Timers
+
+
+class SignalState:
+    """SIGTERM -> graceful checkpoint-and-exit
+    (ref: dist_signal_handler.py:50-81). Single-controller: no all-gather of
+    the signal needed — one process decision is globally consistent."""
+
+    def __init__(self):
+        self.received = False
+
+    def install(self):
+        def handler(signum, frame):
+            self.received = True
+        try:
+            signal.signal(signal.SIGTERM, handler)
+        except ValueError:
+            pass  # non-main thread (tests)
+        return self
+
+
+def training_log(metrics: dict, iteration: int, consumed_samples: int,
+                 elapsed_per_iter: float, tokens_per_sec: float,
+                 writer, skipped_total: int, nan_total: int) -> str:
+    """Format + emit the per-interval dashboard line
+    (ref: training.py:452-626)."""
+    loss = float(metrics["lm_loss"])
+    lr = float(metrics["lr"])
+    gnorm = float(metrics["grad_norm"])
+    lscale = float(metrics.get("loss_scale", 1.0))
+    line = (f"iteration {iteration} | consumed samples {consumed_samples} | "
+            f"elapsed time per iteration (ms): {elapsed_per_iter*1000:.1f} | "
+            f"tokens/s: {tokens_per_sec:.1f} | learning rate: {lr:.3E} | "
+            f"lm loss: {loss:.6E} | loss scale: {lscale:.1f} | "
+            f"grad norm: {gnorm:.3f} | skipped iterations: {skipped_total} | "
+            f"nan iterations: {nan_total}")
+    writer.add_scalar("lm-loss-training/lm loss", loss, iteration)
+    writer.add_scalar("learning-rate/learning rate", lr, iteration)
+    writer.add_scalar("grad-norm/grad norm", gnorm, iteration)
+    writer.add_scalar("loss-scale/loss scale", lscale, iteration)
+    writer.add_scalar("throughput/tokens per sec", tokens_per_sec, iteration)
+    return line
+
+
+def evaluate(state: ts.TrainState, eval_iterator, eval_step_fn,
+             eval_iters: int) -> dict:
+    """(ref: training.py:754-807) mean lm loss + ppl over eval_iters batches."""
+    total = 0.0
+    for _ in range(eval_iters):
+        batch = next(eval_iterator)
+        loss = eval_step_fn(state.params, batch)
+        total += float(loss)
+    mean = total / max(eval_iters, 1)
+    return {"lm loss": mean, "lm loss ppl": float(np.exp(min(mean, 20.0)))}
+
+
+def train(
+    cfg: MegatronConfig,
+    train_iterator: Iterator[dict],
+    valid_iterator: Optional[Iterator[dict]] = None,
+    mesh=None,
+    state: Optional[ts.TrainState] = None,
+    rng=None,
+    start_iteration: int = 0,
+    consumed_samples: int = 0,
+    save_fn: Optional[Callable] = None,
+):
+    """The `_train` loop (ref: training.py:639-751). `train_iterator` yields
+    {"tokens": [n_micro, mbs, seq+1], "loss_mask": [n_micro, mbs, seq]}.
+    Returns (state, consumed_samples)."""
+    timers = Timers()
+    writer = make_writer(cfg.training.tensorboard_dir,
+                         use_wandb=cfg.training.wandb_logger)
+    signals = SignalState().install()
+
+    if rng is None:
+        rng = jax.random.PRNGKey(cfg.training.seed)
+    if state is None:
+        with jax.default_device(jax.devices()[0]) if mesh is None else _nullcontext():
+            state = ts.init_train_state(rng, cfg)
+
+    step_fn = ts.make_train_step(cfg, mesh=mesh)
+
+    calc = MicrobatchCalculator(
+        cfg.training.global_batch_size, cfg.training.micro_batch_size,
+        cfg.parallel.data_parallel or 1, cfg.training.rampup_batch_size)
+
+    iteration = start_iteration
+    skipped_total = 0
+    nan_total = 0
+    eval_step_fn = None  # built lazily once, reused across eval intervals
+    t_start = time.perf_counter()
+    interval_t0 = time.perf_counter()
+    interval_iters = 0
+    seq_len = cfg.model.seq_length
+
+    while iteration < cfg.training.train_iters:
+        calc.update(consumed_samples)
+        # batch-size rampup: propagate the current microbatch count into the
+        # iterator so the yielded batch matches what we account for below.
+        # Each ramp phase changes the batch shape -> one jit recompile per
+        # phase (bounded by the ramp step count).
+        if hasattr(train_iterator, "num_microbatches"):
+            train_iterator.num_microbatches = calc.num_microbatches
+        batch = next(train_iterator)
+        step_rng = jax.random.fold_in(rng, iteration)
+        timers("train-step", log_level=0).start()
+        state, metrics = step_fn(state, batch, step_rng)
+        jax.block_until_ready(metrics["lm_loss"])
+        timers("train-step").stop()
+
+        iteration += 1
+        interval_iters += 1
+        consumed_samples += calc.global_batch_size
+        if bool(metrics["found_inf"]):
+            skipped_total += 1
+        if not np.isfinite(float(metrics["lm_loss"])):
+            nan_total += 1
+
+        if iteration % cfg.training.log_interval == 0:
+            dt = (time.perf_counter() - interval_t0) / max(interval_iters, 1)
+            toks = calc.global_batch_size * seq_len / dt
+            line = training_log(metrics, iteration, consumed_samples, dt, toks,
+                                writer, skipped_total, nan_total)
+            print_rank_0(line)
+            print_rank_0(timers.log())
+            interval_t0 = time.perf_counter()
+            interval_iters = 0
+
+        if (valid_iterator is not None and cfg.training.eval_interval and
+                iteration % cfg.training.eval_interval == 0):
+            if eval_step_fn is None:
+                eval_step_fn = _make_eval_step(cfg, mesh)
+            results = evaluate(state, valid_iterator, eval_step_fn,
+                               cfg.training.eval_iters)
+            print_rank_0(f"validation at iteration {iteration}: {results}")
+            for k, v in results.items():
+                writer.add_scalar(f"lm-loss-validation/{k}", v, iteration)
+
+        should_save = (save_fn is not None and cfg.training.save_interval and
+                       iteration % cfg.training.save_interval == 0)
+        # exit conditions (ref: training.py:712-748)
+        exiting = False
+        if signals.received:
+            print_rank_0("SIGTERM received: checkpointing and exiting")
+            exiting = True
+        if (cfg.training.exit_interval and
+                iteration % cfg.training.exit_interval == 0):
+            print_rank_0(f"exiting at iteration {iteration} (exit_interval)")
+            exiting = True
+        if cfg.training.exit_duration_in_mins is not None:
+            mins = (time.perf_counter() - t_start) / 60.0
+            if mins > cfg.training.exit_duration_in_mins:
+                print_rank_0(f"exiting after {mins:.1f} min (exit_duration)")
+                exiting = True
+        if should_save or (exiting and save_fn is not None):
+            save_fn(state, iteration, consumed_samples)
+        if exiting:
+            break
+
+    writer.flush()
+    return state, consumed_samples
+
+
+class _nullcontext:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
+def _make_eval_step(cfg: MegatronConfig, mesh=None):
+    from megatron_tpu.models import language_model as lm
+    rope = lm.make_rope(cfg.model)
+
+    @jax.jit
+    def eval_step(params, batch):
+        tokens = batch["tokens"]
+        n_micro = tokens.shape[0]
+
+        def body(acc, xs):
+            tok, mask = xs
+            loss = lm.loss_fn(params, tok, cfg.model, loss_mask=mask,
+                              rope=rope, deterministic=True)
+            return acc + loss, None
+
+        mask = batch.get("loss_mask")
+        if mask is None:
+            mask = jnp.ones((n_micro, tokens.shape[1], tokens.shape[2] - 1),
+                            jnp.float32)
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                (tokens, mask))
+        return total / n_micro
+
+    return eval_step

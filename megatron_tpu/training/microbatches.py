@@ -1,0 +1,61 @@
+"""Microbatch calculator: constant and ramped global batch size.
+
+TPU-native port of the *contract* of build_num_microbatches_calculator
+(ref: megatron/microbatches.py:9-144, global_vars.py:28-38). The reference
+keeps a mutable global; here the calculator is a small object owned by the
+training loop. Rampup semantics match ConstantNumMicroBatches /
+RampupBatchsizeNumMicroBatches: batch size starts at `start`, increases by
+`increment` every `ramp_samples / ((gbs-start)/increment)` consumed samples,
+and must stay divisible by micro_batch_size * dp.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class MicrobatchCalculator:
+    def __init__(self, global_batch_size: int, micro_batch_size: int,
+                 data_parallel: int,
+                 rampup: Optional[Sequence[int]] = None):
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel = data_parallel
+        self.final_gbs = global_batch_size
+        per_step = micro_batch_size * data_parallel
+        assert global_batch_size % per_step == 0, (
+            f"global_batch_size {global_batch_size} not divisible by "
+            f"micro*dp={per_step}")
+        if rampup is None:
+            self._ramp = None
+            self._gbs = global_batch_size
+        else:
+            start, incr, ramp_samples = rampup
+            assert start % per_step == 0 and incr % per_step == 0, (
+                "rampup start/increment must divide micro*dp")
+            # (ref: microbatches.py:97-116): constant samples per bs increment
+            steps = (global_batch_size - start) // incr
+            assert steps > 0
+            self._ramp = (start, incr, ramp_samples, ramp_samples // steps)
+            self._gbs = start
+        self.update(0)
+
+    def update(self, consumed_samples: int) -> None:
+        """(ref: microbatches.py:118-144 RampupBatchsizeNumMicroBatches.update)"""
+        if self._ramp is not None:
+            start, incr, ramp_samples, samples_per_incr = self._ramp
+            if consumed_samples > ramp_samples:
+                self._gbs = self.final_gbs
+            else:
+                steps = consumed_samples // samples_per_incr
+                self._gbs = min(start + steps * incr, self.final_gbs)
+        per_step = self.micro_batch_size * self.data_parallel
+
+        assert self._gbs % per_step == 0
+        self._num_micro = self._gbs // per_step
+
+    @property
+    def global_batch_size(self) -> int:
+        return self._gbs
+
+    @property
+    def num_microbatches(self) -> int:
+        return self._num_micro

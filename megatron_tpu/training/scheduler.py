@@ -1,0 +1,86 @@
+"""Learning-rate / weight-decay schedules.
+
+TPU-native equivalent of OptimizerParamScheduler
+(ref: megatron/optimizer_param_scheduler.py:10-228). The reference mutates
+param-group lr/wd in place each step; here the schedule is a pure function
+iteration -> (lr, wd), usable both traced (inside the jitted train step) and
+untraced (logging). Checkpoint override semantics
+(`override_opt_param_scheduler` / `use_checkpoint_opt_param_scheduler`,
+ref: optimizer_param_scheduler.py:151-183) are handled at load time by
+choosing whose config wins.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from megatron_tpu.config import OptimizerConfig, TrainingConfig
+
+
+def _resolve(cfg: OptimizerConfig, train: TrainingConfig):
+    decay_iters = cfg.lr_decay_iters or train.train_iters
+    if cfg.lr_warmup_fraction is not None:
+        warmup = int(cfg.lr_warmup_fraction * decay_iters)
+    else:
+        warmup = cfg.lr_warmup_iters
+    return decay_iters, warmup
+
+
+def learning_rate(iteration, cfg: OptimizerConfig, train: TrainingConfig):
+    """lr at `iteration` (0-based, traced or int).
+
+    Mirrors get_lr (ref: optimizer_param_scheduler.py:61-107): linear warmup
+    to max lr, then constant/linear/cosine/inverse-square-root decay to
+    min_lr over decay_iters.
+    """
+    decay_iters, warmup = _resolve(cfg, train)
+    it = jnp.asarray(iteration, jnp.float32)
+    max_lr = jnp.asarray(cfg.lr, jnp.float32)
+    min_lr = jnp.asarray(cfg.min_lr, jnp.float32)
+
+    warm_lr = max_lr * (it + 1.0) / max(warmup, 1)
+
+    # decay ratio in [0, 1] over the post-warmup region
+    num = jnp.clip(it - warmup, 0.0, None)
+    den = max(decay_iters - warmup, 1)
+    ratio = jnp.clip(num / den, 0.0, 1.0)
+
+    style = cfg.lr_decay_style
+    if style == "constant":
+        decayed = max_lr
+    elif style == "linear":
+        decayed = max_lr - (max_lr - min_lr) * ratio
+    elif style == "cosine":
+        coeff = 0.5 * (jnp.cos(jnp.pi * ratio) + 1.0)
+        decayed = min_lr + coeff * (max_lr - min_lr)
+    elif style == "inverse-square-root":
+        # (ref: optimizer_param_scheduler.py:77-84) lr * sqrt(warmup) / sqrt(it)
+        w = jnp.asarray(max(warmup, 1), jnp.float32)
+        decayed = jnp.minimum(max_lr, max_lr * jnp.sqrt(w) / jnp.sqrt(
+            jnp.maximum(it + 1.0, w)))
+        decayed = jnp.maximum(decayed, min_lr)
+    else:
+        raise ValueError(f"unknown lr_decay_style {style!r}")
+
+    if warmup > 0:
+        return jnp.where(it < warmup, warm_lr, decayed)
+    return decayed
+
+
+def weight_decay(iteration, cfg: OptimizerConfig, train: TrainingConfig):
+    """wd at `iteration` — constant / linear / cosine ramp from
+    start_weight_decay to end_weight_decay
+    (ref: optimizer_param_scheduler.py:36-59)."""
+    start = cfg.start_weight_decay if cfg.start_weight_decay is not None else cfg.weight_decay
+    end = cfg.end_weight_decay if cfg.end_weight_decay is not None else cfg.weight_decay
+    if cfg.weight_decay_incr_style == "constant" or start == end:
+        return jnp.asarray(end, jnp.float32)
+    decay_iters, _ = _resolve(cfg, train)
+    ratio = jnp.clip(jnp.asarray(iteration, jnp.float32) / max(decay_iters, 1), 0.0, 1.0)
+    if cfg.weight_decay_incr_style == "linear":
+        coeff = ratio
+    elif cfg.weight_decay_incr_style == "cosine":
+        coeff = 0.5 * (jnp.cos(jnp.pi * (1.0 - ratio)) + 1.0)
+    else:
+        raise ValueError(
+            f"unknown weight_decay_incr_style {cfg.weight_decay_incr_style!r}")
+    return start + coeff * (end - start)

@@ -1,0 +1,244 @@
+"""The jitted training step: microbatch accumulation + optimizer apply.
+
+TPU-native equivalent of train_step + the no-pipelining forward-backward
+schedule (ref: megatron/training.py:391-449, megatron/schedules.py:213-250).
+The reference's step is an imperative pipeline —
+zero grad buffers -> per-microbatch fwd/bwd accumulating into `main_grad`
+buffers -> reduce_model_grads (DP allreduce) -> optimizer.step -> lr step.
+Here the same dataflow is one jitted function:
+
+- microbatch loop = `lax.scan` over the leading microbatch dim, accumulating
+  fp32 grads (== the contiguous main_grad buffer of model/distributed.py:75-171
+  without the buffer bookkeeping);
+- the DP grad all-reduce (ref: distributed.py:202-232) is emitted by GSPMD
+  because batch activations are 'dp'-sharded while params are replicated;
+- loss scaling per microbatch matches schedules.py:176-186
+  (loss * scale / num_microbatches);
+- lr/wd come from the pure scheduler, optimizer apply from
+  training/optimizer.py with identical skip-on-inf semantics.
+
+Pipeline-parallel steps replace the scan body with the 1F1B schedule from
+megatron_tpu/parallel/pipeline.py; everything else is unchanged.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import MegatronConfig
+from megatron_tpu.models import language_model as lm
+from megatron_tpu.training import optimizer as opt
+from megatron_tpu.training import scheduler
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: opt.OptState
+    iteration: jax.Array  # i32: completed iterations (incl. skipped)
+
+
+def init_train_state(rng, cfg: MegatronConfig) -> TrainState:
+    params = lm.model_init(rng, cfg.model)
+    return TrainState(
+        params=params,
+        opt_state=opt.init_optimizer(
+            params, cfg.optimizer,
+            compute_dtype=jnp.dtype(cfg.model.compute_dtype)
+            if cfg.model.compute_dtype in ("float16",) else jnp.float32),
+        iteration=jnp.zeros((), jnp.int32),
+    )
+
+
+def _tree_add(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+def train_step(
+    state: TrainState,
+    batch: dict,
+    rng,
+    cfg: MegatronConfig,
+    rope: Optional[lm.RopeTables] = None,
+    wd_mask=None,
+):
+    """One full iteration over `num_microbatches` microbatches.
+
+    batch: {"tokens": [n_micro, micro_bs, seq+1] int32,
+            "loss_mask": optional [n_micro, micro_bs, seq] }
+    Returns (new_state, metrics).
+    """
+    mcfg = cfg.model
+    n_micro = batch["tokens"].shape[0]
+    loss_scale = state.opt_state.scaler.scale
+
+    if rope is None:
+        rope = lm.make_rope(mcfg)
+
+    deterministic = (mcfg.hidden_dropout == 0.0 and mcfg.attention_dropout == 0.0)
+
+    def micro_loss(params, mb, mb_rng):
+        loss = lm.loss_fn(params, mb["tokens"], mcfg,
+                          loss_mask=mb["loss_mask"], rope=rope,
+                          rng=mb_rng, deterministic=deterministic,
+                          position_ids=mb.get("position_ids"),
+                          segment_ids=mb.get("segment_ids"))
+        # scaled loss for backward (ref: schedules.py:176-186): the optimizer
+        # unscales; dividing by n_micro here makes the accumulated grad the
+        # mean over microbatches.
+        return loss * loss_scale / n_micro, loss
+
+    grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
+
+    def body(acc, xs):
+        grads_acc, loss_acc = acc
+        mb, i = xs
+        mb_rng = jax.random.fold_in(rng, i) if rng is not None else None
+        (_, loss), grads = grad_fn(state.params, mb, mb_rng)
+        return (_tree_add(grads_acc, jax.tree.map(
+            lambda g: g.astype(jnp.float32), grads)), loss_acc + loss), None
+
+    zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+    mb_stream = dict(batch)
+    if mb_stream.get("loss_mask") is None:
+        mb_stream["loss_mask"] = jnp.ones(
+            (n_micro,) + (batch["tokens"].shape[1], batch["tokens"].shape[2] - 1),
+            jnp.float32)
+    (grads, loss_sum), _ = jax.lax.scan(
+        body, (zeros, jnp.zeros((), jnp.float32)),
+        (mb_stream, jnp.arange(n_micro)))
+
+    lr = scheduler.learning_rate(state.iteration, cfg.optimizer, cfg.training)
+    wd = scheduler.weight_decay(state.iteration, cfg.optimizer, cfg.training)
+
+    new_params, new_opt_state, ometrics = opt.apply_optimizer(
+        state.params, grads, state.opt_state, cfg.optimizer, lr, wd,
+        wd_mask=wd_mask)
+
+    new_state = TrainState(
+        params=new_params,
+        opt_state=new_opt_state,
+        iteration=state.iteration + 1,
+    )
+    metrics = {
+        "lm_loss": loss_sum / n_micro,
+        "lr": lr,
+        "wd": wd,
+        **ometrics,
+    }
+    return new_state, metrics
+
+
+def pipelined_train_step(
+    state: TrainState,
+    batch: dict,
+    rng,
+    cfg: MegatronConfig,
+    mesh,
+    rope: Optional[lm.RopeTables] = None,
+    wd_mask=None,
+):
+    """Train step with the transformer stack pipelined over 'pp'
+    (ref: schedules.py:606-722 1F1B — see parallel/pipeline.py). The
+    microbatch loop IS the pipeline tick loop, so grads over the full global
+    batch come from one backward pass through the pipelined graph."""
+    from megatron_tpu.parallel.pipeline import pipeline_loss_fn
+
+    mcfg = cfg.model
+    loss_scale = state.opt_state.scaler.scale
+    deterministic = (mcfg.hidden_dropout == 0.0 and
+                     mcfg.attention_dropout == 0.0)
+    if rope is None:
+        rope = lm.make_rope(mcfg)
+
+    def total_loss(params):
+        loss = pipeline_loss_fn(
+            params, batch["tokens"], mcfg, mesh,
+            loss_mask=batch.get("loss_mask"), rope=rope,
+            rng=None if deterministic else rng,
+            deterministic=deterministic,
+            position_ids=batch.get("position_ids"),
+            segment_ids=batch.get("segment_ids"))
+        return loss * loss_scale, loss
+
+    grad_fn = jax.value_and_grad(total_loss, has_aux=True)
+    (_, loss), grads = grad_fn(state.params)
+    grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+
+    lr = scheduler.learning_rate(state.iteration, cfg.optimizer, cfg.training)
+    wd = scheduler.weight_decay(state.iteration, cfg.optimizer, cfg.training)
+    new_params, new_opt_state, ometrics = opt.apply_optimizer(
+        state.params, grads, state.opt_state, cfg.optimizer, lr, wd,
+        wd_mask=wd_mask)
+    new_state = TrainState(params=new_params, opt_state=new_opt_state,
+                           iteration=state.iteration + 1)
+    return new_state, {"lm_loss": loss, "lr": lr, "wd": wd, **ometrics}
+
+
+class _MeshContextStep:
+    """Callable wrapping a jitted step so each call runs with the ambient
+    mesh set (required by the partial-manual shard_map inside)."""
+
+    def __init__(self, fn, mesh):
+        self._fn = fn
+        self._mesh = mesh
+
+    def __call__(self, *args, **kwargs):
+        with jax.set_mesh(self._mesh):
+            return self._fn(*args, **kwargs)
+
+
+def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True):
+    """Build the jitted train step, optionally sharded over `mesh`.
+
+    With a mesh, parameters/optimizer state get shardings from the model's
+    logical axes via the rules table, and the batch is 'dp'-sharded on the
+    microbatch-batch dim — GSPMD then inserts the TP psums and the DP grad
+    all-reduce the reference hand-codes. pp>1 dispatches to the pipelined
+    step (collective-permute 1F1B, parallel/pipeline.py).
+    """
+    rope = lm.make_rope(cfg.model)
+    wd_mask = None  # computed per-call from params (cheap, static)
+
+    pipelined = mesh is not None and cfg.parallel.pipeline_parallel > 1
+    if pipelined:
+        fn = functools.partial(pipelined_train_step, cfg=cfg, mesh=mesh,
+                               rope=rope, wd_mask=wd_mask)
+    else:
+        fn = functools.partial(train_step, cfg=cfg, rope=rope,
+                               wd_mask=wd_mask)
+    if mesh is None:
+        return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from megatron_tpu.parallel import sharding as shd
+
+    if rules is None:
+        rules = shd.make_logical_rules(cfg.parallel.sequence_parallel)
+
+    axes = lm.model_axes(cfg.model)
+    param_sh = shd.tree_logical_to_sharding(mesh, axes, rules)
+    scalar_sh = NamedSharding(mesh, P())
+    opt_sh = opt.OptState(
+        step=scalar_sh,
+        mu=param_sh,
+        nu=param_sh if cfg.optimizer.optimizer == "adam" else None,
+        scaler=opt.ScalerState(scalar_sh, scalar_sh, scalar_sh),
+    )
+    state_sh = TrainState(params=param_sh, opt_state=opt_sh,
+                          iteration=scalar_sh)
+    # pytree-prefix sharding: every batch leaf is [n_micro, batch, seq(+1)],
+    # dp-sharded on the batch dim — works for any key set (tokens, loss_mask,
+    # position_ids, segment_ids)
+    batch_sh = NamedSharding(mesh, P(None, "dp", None))
+    jitted = jax.jit(
+        fn,
+        in_shardings=(state_sh, batch_sh, scalar_sh),
+        out_shardings=(state_sh, None),
+        donate_argnums=(0,) if donate else (),
+    )
+    if pipelined:
+        return _MeshContextStep(jitted, mesh)
+    return jitted

@@ -1,0 +1,90 @@
+"""Named-span wall-clock timers.
+
+TPU-native equivalent of the Timers registry (ref: megatron/timers.py:56-307).
+The reference's CUDA-sync + barrier semantics become `block_until_ready` on a
+representative array (XLA is async the same way CUDA streams are); min/max
+across ranks via `_all_gather_base` is unnecessary in a single-controller
+JAX program — every host sees the same timeline. The log-level scheme (0-2)
+and the elapsed/reset accounting match timers.py.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self._elapsed = 0.0
+        self._count = 0
+        self._started = False
+        self._start_time = 0.0
+
+    def start(self, barrier: bool = False, sync_on=None):
+        assert not self._started, f"timer {self.name} already started"
+        if sync_on is not None:
+            jax.block_until_ready(sync_on)
+        self._start_time = time.perf_counter()
+        self._started = True
+
+    def stop(self, barrier: bool = False, sync_on=None):
+        assert self._started, f"timer {self.name} not started"
+        if sync_on is not None:
+            jax.block_until_ready(sync_on)
+        self._elapsed += time.perf_counter() - self._start_time
+        self._count += 1
+        self._started = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        was_started = self._started
+        if was_started:
+            self.stop()
+        e = self._elapsed
+        if reset:
+            self._elapsed = 0.0
+            self._count = 0
+        if was_started:
+            self.start()
+        return e
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+
+class Timers:
+    """(ref: timers.py:136-307) registry with log levels and a write() dump."""
+
+    def __init__(self, log_level: int = 2):
+        self._timers: dict[str, _Timer] = {}
+        self._levels: dict[str, int] = {}
+        self.log_level = log_level
+
+    def __call__(self, name: str, log_level: int = 0) -> _Timer:
+        if name not in self._timers:
+            self._timers[name] = _Timer(name)
+            self._levels[name] = log_level
+        return self._timers[name]
+
+    def log(self, names: Optional[list] = None, normalizer: float = 1.0,
+            reset: bool = True) -> str:
+        """Format elapsed times in ms (ref: timers.py:264-307)."""
+        names = names or [n for n, lvl in self._levels.items()
+                          if lvl <= self.log_level]
+        parts = []
+        for name in names:
+            if name not in self._timers:
+                continue
+            t = self._timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+            parts.append(f"{name}: {t:.2f}")
+        return "time (ms) | " + " | ".join(parts)
+
+    def write(self, names, writer, iteration, normalizer: float = 1.0,
+              reset: bool = False):
+        for name in names:
+            if name in self._timers:
+                value = self._timers[name].elapsed(reset=reset) / normalizer
+                writer.add_scalar(f"timers/{name}", value, iteration)

@@ -8,8 +8,10 @@ CI-testable with no accelerator.
 """
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere. Hard override: the driver
+# environment presets JAX_PLATFORMS=axon (single real TPU chip via tunnel),
+# but the hermetic suite runs on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,6 +23,13 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# The axon (TPU-tunnel) plugin registers itself in sitecustomize at
+# interpreter start and force-sets jax_platforms="axon,cpu" at the CONFIG
+# level, which overrides the env var. When the tunnel is unreachable its
+# backend init retries forever, hanging any jax.devices() call. Re-pin the
+# config to cpu-only before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
 
 # Numerical-equivalence tests compare different contraction orders of the same
 # math; run matmuls at full precision so tolerances reflect algorithms, not

@@ -1,0 +1,127 @@
+"""Checkpoint save/load semantics tests.
+
+Contract ports of the reference's checkpoint behavior
+(ref: megatron/checkpointing.py): tracker file, resume restores
+iteration/consumed_samples/optimizer state bit-exactly, finetune loads
+weights only, release checkpoints reset iteration, config embedding.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import (MegatronConfig, ModelConfig, OptimizerConfig,
+                                 TrainingConfig)
+from megatron_tpu.training import init_train_state, make_train_step
+from megatron_tpu.training.checkpointing import (load_checkpoint,
+                                                 load_config_from_checkpoint,
+                                                 read_tracker, save_checkpoint)
+
+
+def tiny_cfg():
+    model = ModelConfig(num_layers=2, hidden_size=32, num_attention_heads=2,
+                        vocab_size=64, seq_length=16).derived()
+    return MegatronConfig(
+        model=model,
+        optimizer=OptimizerConfig(lr=1e-3),
+        training=TrainingConfig(micro_batch_size=1, global_batch_size=2,
+                                train_iters=4),
+    ).validate(n_devices=1)
+
+
+def _batch(cfg, key=1):
+    tokens = jax.random.randint(jax.random.PRNGKey(key), (2, 1, 17), 0, 64)
+    return {"tokens": tokens, "loss_mask": jnp.ones((2, 1, 16), jnp.float32)}
+
+
+class TestCheckpointing:
+    def test_save_load_roundtrip(self, tmp_path):
+        cfg = tiny_cfg()
+        rng = jax.random.PRNGKey(0)
+        state = init_train_state(rng, cfg)
+        step = make_train_step(cfg, donate=False)
+        state, _ = step(state, _batch(cfg), rng)
+        save_checkpoint(str(tmp_path), state, cfg, iteration=1,
+                        consumed_samples=2)
+        assert read_tracker(str(tmp_path)) == "1"
+
+        example = init_train_state(jax.random.PRNGKey(9), cfg)
+        loaded, it, consumed = load_checkpoint(str(tmp_path), example)
+        assert it == 1 and consumed == 2
+        for a, b in zip(jax.tree.leaves(loaded.params),
+                        jax.tree.leaves(state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(loaded.opt_state.mu),
+                        jax.tree.leaves(state.opt_state.mu)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert int(loaded.opt_state.step) == int(state.opt_state.step)
+
+    def test_resume_training_continues_identically(self, tmp_path):
+        """Save at iter 2, reload, continue 2 more — must equal an
+        uninterrupted 4-iter run (the resume contract,
+        ref: checkpointing.py:600-607)."""
+        cfg = tiny_cfg()
+        rng = jax.random.PRNGKey(0)
+        step = make_train_step(cfg, donate=False)
+        batches = [_batch(cfg, k) for k in range(4)]
+
+        s_full = init_train_state(rng, cfg)
+        for i in range(4):
+            s_full, m_full = step(s_full, batches[i], jax.random.fold_in(rng, i))
+
+        s_a = init_train_state(rng, cfg)
+        for i in range(2):
+            s_a, _ = step(s_a, batches[i], jax.random.fold_in(rng, i))
+        save_checkpoint(str(tmp_path), s_a, cfg, iteration=2,
+                        consumed_samples=4)
+        example = init_train_state(jax.random.PRNGKey(7), cfg)
+        s_b, it, _ = load_checkpoint(str(tmp_path), example)
+        for i in range(it, 4):
+            s_b, m_b = step(s_b, batches[i], jax.random.fold_in(rng, i))
+
+        np.testing.assert_allclose(float(m_b["lm_loss"]),
+                                   float(m_full["lm_loss"]), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(s_b.params),
+                        jax.tree.leaves(s_full.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_finetune_loads_weights_only(self, tmp_path):
+        cfg = tiny_cfg()
+        state = init_train_state(jax.random.PRNGKey(0), cfg)
+        state = state._replace(iteration=jnp.asarray(7, jnp.int32))
+        save_checkpoint(str(tmp_path), state, cfg, iteration=7,
+                        consumed_samples=100)
+        example = init_train_state(jax.random.PRNGKey(1), cfg)
+        loaded, it, consumed = load_checkpoint(str(tmp_path), example,
+                                               finetune=True)
+        assert it == 0 and consumed == 0
+        # params from checkpoint, optimizer state untouched (example's)
+        np.testing.assert_array_equal(
+            np.asarray(jax.tree.leaves(loaded.params)[0]),
+            np.asarray(jax.tree.leaves(state.params)[0]))
+
+    def test_release_checkpoint(self, tmp_path):
+        cfg = tiny_cfg()
+        state = init_train_state(jax.random.PRNGKey(0), cfg)
+        save_checkpoint(str(tmp_path), state, cfg, iteration=0, release=True)
+        assert read_tracker(str(tmp_path)) == "release"
+        example = init_train_state(jax.random.PRNGKey(1), cfg)
+        loaded, it, consumed = load_checkpoint(str(tmp_path), example)
+        assert it == 0 and consumed == 0
+        assert loaded is not None
+
+    def test_config_embedding(self, tmp_path):
+        cfg = tiny_cfg()
+        state = init_train_state(jax.random.PRNGKey(0), cfg)
+        save_checkpoint(str(tmp_path), state, cfg, iteration=3)
+        cfg2 = load_config_from_checkpoint(str(tmp_path))
+        assert cfg2.model.hidden_size == cfg.model.hidden_size
+        assert cfg2.model.num_layers == cfg.model.num_layers
+        assert cfg2.optimizer.lr == cfg.optimizer.lr
+
+    def test_missing_checkpoint(self, tmp_path):
+        cfg = tiny_cfg()
+        example = init_train_state(jax.random.PRNGKey(0), cfg)
+        state, it, consumed = load_checkpoint(str(tmp_path / "nope"), example)
+        assert state is None and it == 0 and consumed == 0
