@@ -17,6 +17,9 @@ import time
 import jax
 import jax.numpy as jnp
 
+from megatron_tpu.utils.platform import ensure_env_platform
+ensure_env_platform()
+
 # bf16 peak FLOP/s per chip by device kind (public spec sheets)
 PEAK_FLOPS = {
     "TPU v2": 45e12,

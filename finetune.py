@@ -1,0 +1,123 @@
+"""Main training entry point: pretrain/finetune GPT, Llama, or Falcon.
+
+TPU-native equivalent of the reference's finetune.py (the primary entry,
+ref: /root/reference/finetune.py:92-151) and the `pretrain` driver it calls
+(ref: megatron/training.py:54-167). One process drives all local devices —
+no torchrun; the mesh replaces process groups (SURVEY.md §7).
+
+  python finetune.py --model llama2-7b --data_path 1.0 /data/corpus_document \
+      --tokenizer_type SentencePieceTokenizer --tokenizer_model tok.model \
+      --tensor_model_parallel_size 8 --train_iters 1000 --save ckpts/run1
+"""
+from __future__ import annotations
+
+import sys
+
+import jax
+import numpy as np
+
+from megatron_tpu.utils.platform import ensure_env_platform
+ensure_env_platform()
+
+
+def build_data(cfg, tokenizer, consumed_samples: int):
+    """(ref: megatron/training.py:855-939 build_train_valid_test_data_iterators
+    + finetune.py:107 dataset provider)"""
+    from megatron_tpu.data import BatchIterator, build_train_valid_test_datasets
+
+    tr = cfg.training
+    dp = cfg.parallel.data_parallel or 1
+    eval_iters = ((tr.train_iters // max(tr.eval_interval, 1)) + 1) * tr.eval_iters
+    samples = (tr.train_iters * tr.global_batch_size,
+               eval_iters * tr.global_batch_size,
+               tr.eval_iters * tr.global_batch_size)
+    train_ds, valid_ds, test_ds = build_train_valid_test_datasets(
+        cfg.data.data_path, cfg.data.split, cfg.model.seq_length,
+        tr.seed, *samples)
+
+    def make_iter(ds, consumed):
+        if ds is None:
+            return None
+        return BatchIterator(
+            ds, tr.micro_batch_size, dp, cfg.num_microbatches,
+            consumed_samples=consumed, dataloader_type=cfg.data.dataloader_type,
+            seed=tr.seed, eod_token=tokenizer.eod if tokenizer else None,
+            reset_position_ids=cfg.data.reset_position_ids,
+            reset_attention_mask=cfg.data.reset_attention_mask,
+            eod_mask_loss=cfg.data.eod_mask_loss)
+
+    return (make_iter(train_ds, consumed_samples), make_iter(valid_ds, 0),
+            make_iter(test_ds, 0))
+
+
+def main(argv=None):
+    from megatron_tpu.arguments import parse_cli
+    from megatron_tpu.config import MegatronConfig
+    from megatron_tpu.data import build_tokenizer
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.training import init_train_state
+    from megatron_tpu.training import checkpointing as ckpt
+    from megatron_tpu.training.loop import train
+    from megatron_tpu.utils.logging import print_rank_0
+
+    n_devices = len(jax.devices())
+    cfg, args = parse_cli(argv, n_devices=n_devices)
+
+    # --use_checkpoint_args: architecture comes from the checkpoint
+    # (ref: megatron/checkpointing.py:476-558)
+    if args.use_checkpoint_args and cfg.training.load_dir:
+        loaded_cfg = ckpt.load_config_from_checkpoint(cfg.training.load_dir)
+        if loaded_cfg is not None:
+            import dataclasses
+            cfg = dataclasses.replace(cfg, model=loaded_cfg.model)
+            cfg = cfg.validate(n_devices=n_devices)
+
+    print_rank_0(f"devices: {n_devices} | mesh: tp={cfg.parallel.tensor_parallel} "
+                 f"pp={cfg.parallel.pipeline_parallel} "
+                 f"dp={cfg.parallel.data_parallel} "
+                 f"sp={cfg.parallel.sequence_parallel}")
+    mesh = build_mesh(cfg.parallel) if n_devices > 1 else None
+
+    tokenizer = None
+    if cfg.data.tokenizer_model or cfg.data.vocab_file:
+        tokenizer = build_tokenizer(
+            cfg.data.tokenizer_type, vocab_file=cfg.data.vocab_file,
+            merge_file=cfg.data.merge_file,
+            tokenizer_model=cfg.data.tokenizer_model,
+            vocab_extra_ids=cfg.data.vocab_extra_ids,
+            vocab_extra_ids_list=cfg.data.vocab_extra_ids_list,
+            new_tokens=cfg.data.new_tokens)
+        import dataclasses
+        cfg = dataclasses.replace(cfg, model=dataclasses.replace(
+            cfg.model, vocab_size=tokenizer.vocab_size))
+
+    rng = jax.random.PRNGKey(cfg.training.seed)
+    state = init_train_state(rng, cfg)
+    start_iteration, consumed = 0, 0
+    load_dir = cfg.training.load_dir or cfg.training.checkpoint_dir
+    if load_dir:
+        loaded, start_iteration, consumed = ckpt.load_checkpoint(
+            load_dir, state, finetune=cfg.training.finetune,
+            no_load_optim=cfg.training.no_load_optim)
+        if loaded is not None:
+            state = loaded
+
+    train_it, valid_it, _ = build_data(cfg, tokenizer, consumed)
+    assert train_it is not None, "--data_path produced no training data"
+
+    save_fn = None
+    if cfg.training.checkpoint_dir:
+        def save_fn(st, iteration, consumed_samples):
+            ckpt.save_checkpoint(cfg.training.checkpoint_dir, st, cfg,
+                                 iteration, consumed_samples)
+
+    state, consumed = train(
+        cfg, train_it, valid_it, mesh=mesh, state=state, rng=rng,
+        start_iteration=start_iteration, consumed_samples=consumed,
+        save_fn=save_fn)
+    print_rank_0(f"training done at consumed_samples={consumed}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,2 @@
+from megatron_tpu.convert.hf import (  # noqa: F401
+    hf_falcon_to_params, hf_llama_to_params, params_to_hf_llama)

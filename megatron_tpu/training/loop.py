@@ -24,7 +24,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from megatron_tpu.config import MegatronConfig
-from megatron_tpu.training import train_step as ts
+# NOTE: the package __init__ re-exports the train_step FUNCTION under the
+# same name as its module, so `import ...train_step as ts` would resolve to
+# the function attribute — import the symbols directly instead
+from megatron_tpu.training.train_step import (TrainState, init_train_state,
+                                              make_train_step)
 from megatron_tpu.training.microbatches import MicrobatchCalculator
 from megatron_tpu.utils.logging import make_writer, print_rank_0
 from megatron_tpu.utils.timers import Timers
@@ -71,7 +75,7 @@ def training_log(metrics: dict, iteration: int, consumed_samples: int,
     return line
 
 
-def evaluate(state: ts.TrainState, eval_iterator, eval_step_fn,
+def evaluate(state: TrainState, eval_iterator, eval_step_fn,
              eval_iters: int) -> dict:
     """(ref: training.py:754-807) mean lm loss + ppl over eval_iters batches."""
     total = 0.0
@@ -88,7 +92,7 @@ def train(
     train_iterator: Iterator[dict],
     valid_iterator: Optional[Iterator[dict]] = None,
     mesh=None,
-    state: Optional[ts.TrainState] = None,
+    state: Optional[TrainState] = None,
     rng=None,
     start_iteration: int = 0,
     consumed_samples: int = 0,
@@ -106,9 +110,9 @@ def train(
         rng = jax.random.PRNGKey(cfg.training.seed)
     if state is None:
         with jax.default_device(jax.devices()[0]) if mesh is None else _nullcontext():
-            state = ts.init_train_state(rng, cfg)
+            state = init_train_state(rng, cfg)
 
-    step_fn = ts.make_train_step(cfg, mesh=mesh)
+    step_fn = make_train_step(cfg, mesh=mesh)
 
     calc = MicrobatchCalculator(
         cfg.training.global_batch_size, cfg.training.micro_batch_size,

@@ -1,0 +1,23 @@
+"""Platform pinning for CLI entry points.
+
+The axon (TPU-tunnel) plugin registers in sitecustomize at interpreter start
+and force-sets jax_platforms="axon,cpu" at the CONFIG level, which silently
+overrides the JAX_PLATFORMS env var. When the tunnel is unreachable its
+backend init retries forever, hanging any jax.devices() call. Every entry
+point calls `ensure_env_platform()` before first device use so an explicit
+JAX_PLATFORMS env choice always wins.
+"""
+from __future__ import annotations
+
+import os
+
+
+def ensure_env_platform() -> None:
+    env = os.environ.get("JAX_PLATFORMS")
+    if not env:
+        return
+    try:
+        import jax
+        jax.config.update("jax_platforms", env)
+    except Exception:
+        pass

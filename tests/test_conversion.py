@@ -1,0 +1,97 @@
+"""Weight-conversion correctness: HF Llama <-> megatron_tpu.
+
+Port of the reference's golden-model gate (ref: tests/test_llama_weights.py:
+129-180 + verify_correctness.py) made hermetic: instead of multi-GB Llama-2
+weights it uses a RANDOM HF LlamaForCausalLM — the conversion path and the
+numerics comparison are identical, no download needed.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def synthetic():
+    from verify_correctness import make_synthetic_hf_llama
+    return make_synthetic_hf_llama()
+
+
+class TestLlamaConversion:
+    def test_logits_match_hf(self, synthetic):
+        """avg max-abs logit error <= 1e-3 in fp32, the reference CI gate
+        (ref: tests/test_llama_weights.py:106)."""
+        from verify_correctness import compare_llama
+        model, cfg = synthetic
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, cfg.vocab_size, (2, 48)).astype(np.int32)
+        r = compare_llama(model, cfg, tokens)
+        assert r["avg_max_abs_err"] <= 1e-3, r
+        assert abs(r["loss_ours"] - r["loss_hf"]) < 1e-3, r
+
+    def test_roundtrip_ours_hf_ours(self, synthetic):
+        """ours -> HF -> ours is the identity (ref: shard/unshard/mega2hf
+        roundtrip chain, tests/test_llama_weights.py:129-180)."""
+        from megatron_tpu.convert import (hf_llama_to_params,
+                                          params_to_hf_llama)
+        model, cfg = synthetic
+        sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+        params = hf_llama_to_params(sd, cfg)
+        sd2 = params_to_hf_llama(params, cfg)
+        params2 = hf_llama_to_params(sd2, cfg)
+        import jax
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_hf_state_dict_covered(self, synthetic):
+        """Every HF tensor is consumed / reproduced (no silently dropped
+        weights — conversion bugs are silent quality-killers,
+        SURVEY.md §7 hard parts)."""
+        from megatron_tpu.convert import params_to_hf_llama, hf_llama_to_params
+        model, cfg = synthetic
+        sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+        sd2 = params_to_hf_llama(hf_llama_to_params(sd, cfg), cfg)
+        missing = set(sd) - set(sd2) - {"model.rotary_emb.inv_freq"}
+        assert not missing, f"weights dropped by roundtrip: {missing}"
+        for k in sd2:
+            np.testing.assert_allclose(sd2[k], sd[k], rtol=1e-6, atol=1e-7,
+                                       err_msg=k)
+
+
+class TestFalconConversion:
+    def test_falcon_logits_match_hf(self):
+        from transformers import FalconConfig, FalconForCausalLM
+        import dataclasses
+        import jax.numpy as jnp
+        from megatron_tpu.config import ModelConfig
+        from megatron_tpu.convert import hf_falcon_to_params
+        from megatron_tpu.models import language_model as lm
+
+        torch.manual_seed(1)
+        hidden, layers, heads, kv, vocab = 64, 2, 4, 2, 96
+        hf_cfg = FalconConfig(
+            vocab_size=vocab, hidden_size=hidden, num_hidden_layers=layers,
+            num_attention_heads=heads, num_kv_heads=kv,
+            new_decoder_architecture=True, parallel_attn=True, bias=False,
+            alibi=False, rotary_base=10000.0)
+        model = FalconForCausalLM(hf_cfg).eval()
+        cfg = ModelConfig(
+            num_layers=layers, hidden_size=hidden, num_attention_heads=heads,
+            num_kv_heads=kv, ffn_hidden_size=4 * hidden, vocab_size=vocab,
+            make_vocab_size_divisible_by=1, seq_length=32,
+            activation="gelu", norm_type="layernorm", use_rotary_emb=True,
+            use_bias=False, parallel_attn=True, parallel_layernorm=True,
+            tie_embed_logits=True, compute_dtype="float32").derived()
+        sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+        params = hf_falcon_to_params(sd, cfg)
+
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, vocab, (2, 24)).astype(np.int32)
+        with torch.no_grad():
+            want = model(torch.tensor(tokens)).logits.float().numpy()
+        logits, _ = lm.model_forward(params, jnp.asarray(tokens), cfg,
+                                     logits_dtype=jnp.float32)
+        got = np.asarray(logits)[..., :vocab]
+        err = np.abs(got - want).max(axis=-1).mean()
+        assert err <= 1e-3, f"avg max-abs err {err}"

@@ -1,0 +1,44 @@
+"""Merge multiple indexed datasets into one.
+
+TPU-native port of /root/reference/tools/merge_datasets.py: concatenates all
+`*_document.bin/.idx` pairs under --input into a single indexed dataset.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_tpu.data.indexed_dataset import (IndexedDatasetBuilder,
+                                               MMapIndexedDataset)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", type=str, required=True,
+                   help="directory containing .bin/.idx pairs")
+    p.add_argument("--output_prefix", type=str, required=True)
+    args = p.parse_args(argv)
+
+    prefixes = sorted(
+        os.path.join(args.input, f[:-4])
+        for f in os.listdir(args.input)
+        if f.endswith(".idx")
+        and os.path.exists(os.path.join(args.input, f[:-4] + ".bin")))
+    assert prefixes, f"no .bin/.idx pairs in {args.input}"
+
+    first = MMapIndexedDataset(prefixes[0])
+    builder = IndexedDatasetBuilder(args.output_prefix, dtype=first.dtype)
+    for prefix in prefixes:
+        print(f"merging {prefix}")
+        builder.merge_file(prefix)
+    builder.finalize()
+    out = MMapIndexedDataset(args.output_prefix)
+    print(f"done: {len(out)} sequences, "
+          f"{int(out.sizes.sum())} tokens -> {args.output_prefix}.bin/.idx")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""Correctness gate: megatron_tpu vs the HuggingFace reference implementation.
+
+TPU-native equivalent of the reference's verify_correctness.py
+(ref: /root/reference/verify_correctness.py:107-194), which runs the Megatron
+model and a trusted baseline (HF/Meta) on identical batches and reports the
+max-abs logit error and loss delta, with the CI tolerance avg-max-abs <= 1e-3
+in fp32 (ref: tests/test_llama_weights.py:106).
+
+Usage:
+  python verify_correctness.py --hf_path <dir-or-name> --model_size 7b
+  python verify_correctness.py --synthetic          # no weights needed:
+      builds a small random HF Llama, converts it, compares logits.
+
+The synthetic mode makes the gate hermetic (no multi-GB downloads) while
+exercising exactly the same conversion + numerics path as real weights.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+
+import numpy as np
+
+from megatron_tpu.utils.platform import ensure_env_platform
+ensure_env_platform()
+
+
+def compare_llama(hf_model, cfg, tokens: np.ndarray) -> dict:
+    """Run HF (torch, fp32) and megatron_tpu (jax, fp32) on `tokens`.
+
+    Returns {max_abs_err, avg_max_abs_err, loss_hf, loss_ours}
+    (ref: verify_correctness.py:143-194 reports the same quantities)."""
+    import jax
+    import jax.numpy as jnp
+    import torch
+
+    from megatron_tpu.convert import hf_llama_to_params
+    from megatron_tpu.models import language_model as lm
+    from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+
+    cfg = dataclasses.replace(cfg, compute_dtype="float32")
+    sd = {k: v.detach().cpu().numpy() for k, v in hf_model.state_dict().items()}
+    params = hf_llama_to_params(sd, cfg)
+
+    with torch.no_grad():
+        out = hf_model(torch.tensor(tokens)).logits.float().numpy()
+
+    logits, _ = lm.model_forward(
+        params, jnp.asarray(tokens), cfg, logits_dtype=jnp.float32)
+    ours = np.asarray(logits)[..., :cfg.vocab_size]
+
+    abs_err = np.abs(ours - out)
+    labels = tokens[:, 1:]
+    loss_ours = float(np.mean(np.asarray(cross_entropy_loss(
+        jnp.asarray(ours[:, :-1]), jnp.asarray(labels),
+        vocab_size=cfg.vocab_size))))
+    lp = torch.nn.functional.cross_entropy(
+        torch.tensor(out[:, :-1]).reshape(-1, out.shape[-1]),
+        torch.tensor(labels).reshape(-1).long())
+    return {
+        "max_abs_err": float(abs_err.max()),
+        "avg_max_abs_err": float(abs_err.max(axis=-1).mean()),
+        "loss_ours": loss_ours,
+        "loss_hf": float(lp),
+    }
+
+
+def make_synthetic_hf_llama(vocab=128, hidden=64, layers=4, heads=4, kv=2,
+                            ffn=176, seq=64, seed=0):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(seed)
+    hf_cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=hidden, num_hidden_layers=layers,
+        num_attention_heads=heads, num_key_value_heads=kv,
+        intermediate_size=ffn, max_position_embeddings=seq,
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+        attention_bias=False, mlp_bias=False)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    from megatron_tpu.config import ModelConfig
+    cfg = ModelConfig(
+        num_layers=layers, hidden_size=hidden, num_attention_heads=heads,
+        num_kv_heads=kv, ffn_hidden_size=ffn, vocab_size=vocab,
+        make_vocab_size_divisible_by=1, seq_length=seq,
+        activation="swiglu", norm_type="rmsnorm", use_rotary_emb=True,
+        use_bias=False, tie_embed_logits=False,
+        compute_dtype="float32").derived()
+    return model, cfg
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--hf_path", type=str, default=None)
+    p.add_argument("--model_size", type=str, default="7b")
+    p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--seq", type=int, default=64)
+    p.add_argument("--tolerance", type=float, default=1e-3)
+    args = p.parse_args(argv)
+
+    if args.synthetic or args.hf_path is None:
+        model, cfg = make_synthetic_hf_llama(seq=args.seq)
+    else:
+        from transformers import AutoModelForCausalLM
+        from megatron_tpu.config import llama2_config
+        model = AutoModelForCausalLM.from_pretrained(
+            args.hf_path, torch_dtype="float32").eval()
+        cfg = llama2_config(args.model_size, compute_dtype="float32")
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size,
+                          (args.batch, args.seq)).astype(np.int32)
+    r = compare_llama(model, cfg, tokens)
+    print(f"max abs logit error:     {r['max_abs_err']:.2e}")
+    print(f"avg max-abs logit error: {r['avg_max_abs_err']:.2e}")
+    print(f"loss ours / hf:          {r['loss_ours']:.6f} / {r['loss_hf']:.6f}")
+    ok = r["avg_max_abs_err"] <= args.tolerance
+    print("PASS" if ok else "FAIL",
+          f"(tolerance {args.tolerance:.0e}, "
+          f"ref gate: tests/test_llama_weights.py:106)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
