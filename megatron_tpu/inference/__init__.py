@@ -1,0 +1,5 @@
+from megatron_tpu.inference.generation import (  # noqa: F401
+    Generator, SamplingParams, beam_search, init_kv_caches)
+from megatron_tpu.inference.sampling import sample  # noqa: F401
+from megatron_tpu.inference.api import (  # noqa: F401
+    beam_search_and_post_process, generate_and_post_process)

@@ -1,0 +1,66 @@
+"""High-level text generation API: tokenize -> generate -> detokenize.
+
+TPU-native port of the reference's api/tokenization layer
+(ref: megatron/text_generation/api.py:19-186 generate_and_post_process /
+beam_search_and_post_process, tokenization.py:13-118). The rank-0
+tokenize-and-broadcast machinery dissolves in a single-controller program;
+what remains is prompt batching/padding and segment detokenization.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from megatron_tpu.inference.generation import (Generator, SamplingParams,
+                                               beam_search)
+
+
+def generate_and_post_process(
+    generator: Generator,
+    tokenizer,
+    prompts: Sequence[str],
+    tokens_to_generate: int = 64,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    add_BOS: bool = False,
+    return_output_log_probs: bool = False,
+    seed: int = 0,
+):
+    """(ref: api.py:19-102). Returns (texts, tokens, logprobs|None)."""
+    prompt_ids = []
+    for p in prompts:
+        ids = tokenizer.tokenize(p)
+        if add_BOS and tokenizer.bos is not None:
+            ids = [tokenizer.bos] + ids
+        prompt_ids.append(ids)
+    sp = SamplingParams(temperature=temperature, top_k=top_k, top_p=top_p)
+    tokens, lengths, logprobs = generator.generate(
+        prompt_ids, tokens_to_generate, sampling=sp, seed=seed)
+    texts = [tokenizer.detokenize(tokens[i, :lengths[i]].tolist())
+             for i in range(len(prompts))]
+    out_tokens = [tokens[i, :lengths[i]].tolist() for i in range(len(prompts))]
+    if return_output_log_probs:
+        lps = [logprobs[i, :lengths[i]].tolist() for i in range(len(prompts))]
+        return texts, out_tokens, lps
+    return texts, out_tokens, None
+
+
+def beam_search_and_post_process(
+    generator: Generator,
+    tokenizer,
+    prompt: str,
+    tokens_to_generate: int = 64,
+    beam_size: int = 4,
+    length_penalty: float = 1.0,
+    add_BOS: bool = False,
+):
+    """(ref: api.py:106-186)."""
+    ids = tokenizer.tokenize(prompt)
+    if add_BOS and tokenizer.bos is not None:
+        ids = [tokenizer.bos] + ids
+    tokens, lengths, scores = beam_search(
+        generator, ids, beam_size, tokens_to_generate,
+        length_penalty=length_penalty)
+    texts = [tokenizer.detokenize(tokens[i, :lengths[i]].tolist())
+             for i in range(len(tokens))]
+    return texts, scores.tolist()

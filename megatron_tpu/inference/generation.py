@@ -1,0 +1,268 @@
+"""Autoregressive generation engine with KV cache.
+
+TPU-native equivalent of the reference's generation stack
+(ref: megatron/text_generation/generation.py:89-285
+`generate_tokens_probs_and_return_on_first_stage`, forward_step.py:17-204
+InferenceParams/ForwardStep, beam_utils.py). Structural mapping:
+
+- *InferenceParams KV dict* -> the functional `KVCache` pytree
+  (models/attention.py) stacked over layers, threaded through `lax.scan`.
+- *Incremental context growth* (the reference re-runs the model on
+  tokens[prev:cur] per step) -> one PREFILL pass over the padded prompts,
+  then a jitted per-token decode loop. Shapes are static (max_len fixed at
+  trace time): no recompilation per request length bucket.
+- *Early termination* (done-flag broadcast, generation.py:260-263) -> the
+  loop still runs to max_len under jit (static bound) but finished rows keep
+  emitting pad via the done mask — same outputs, no host sync per token.
+- *Per-step last-stage sample + broadcast to first stage*
+  (generation.py:179-263, communication.py:111) -> nothing: single program,
+  GSPMD owns placement.
+- *Scoring path* (generation.py:20-86) -> `score_tokens` returning per-token
+  logprobs.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.inference.sampling import sample
+from megatron_tpu.models import language_model as lm
+from megatron_tpu.models.attention import KVCache
+
+
+class SamplingParams(NamedTuple):
+    """(ref: api.py:70-102 broadcast_float_list of sampling knobs)"""
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 0.0
+
+
+def init_kv_caches(cfg: ModelConfig, batch: int, max_len: int,
+                   dtype=jnp.bfloat16) -> KVCache:
+    """Stacked-over-layers KV cache [L, b, max_len, nkv, hd]."""
+    L = cfg.num_layers
+    return KVCache(
+        k=jnp.zeros((L, batch, max_len, cfg.num_kv_heads, cfg.kv_channels),
+                    dtype),
+        v=jnp.zeros((L, batch, max_len, cfg.num_kv_heads, cfg.kv_channels),
+                    dtype),
+        offset=jnp.zeros((L,), jnp.int32),
+    )
+
+
+def _decode_fn(params, tokens, lengths, rng, *, cfg: ModelConfig,
+               max_len: int, min_prompt: int, sp: SamplingParams,
+               eos_id: int, pad_id: int, rope):
+    """tokens: [b, max_len] prompts right-padded; lengths: [b] prompt lens.
+    `min_prompt` is static (host-computed): the prefill length.
+    Returns (tokens [b, max_len], logprobs [b, max_len])."""
+    b = tokens.shape[0]
+
+    caches = init_kv_caches(cfg, b, max_len)
+
+    # PREFILL on the common prefix [0, min_prompt) — mirrors the reference
+    # starting generation at the min prompt length and re-using prompt tokens
+    # for the longer rows (ref: generation.py:179-199)
+    prefill = tokens[:, :min_prompt]
+    logits, caches = lm.model_forward(params, prefill, cfg, kv_caches=caches,
+                                      rope=rope, logits_dtype=jnp.float32)
+
+    def step(carry, pos):
+        tokens, caches, last_logits, rng, done = carry
+        rng, r = jax.random.split(rng)
+        sampled = sample(r, last_logits, top_k=sp.top_k, top_p=sp.top_p,
+                         temperature=sp.temperature,
+                         vocab_size=cfg.vocab_size)
+        # rows still inside their prompt keep their prompt token
+        # (ref: generation.py:210-214 "context tokens are kept")
+        in_prompt = pos < lengths
+        prompt_tok = jax.lax.dynamic_index_in_dim(tokens, pos, axis=1,
+                                                  keepdims=False)
+        cur = jnp.where(in_prompt, prompt_tok, sampled)
+        cur = jnp.where(done, pad_id, cur)
+        tokens = jax.lax.dynamic_update_index_in_dim(tokens, cur, pos, axis=1)
+        logprob = jax.nn.log_softmax(last_logits, axis=-1)
+        lp = jnp.take_along_axis(logprob, cur[:, None], axis=-1)[:, 0]
+        done = done | ((cur == eos_id) & ~in_prompt)
+        logits, caches = lm.model_forward(
+            params, cur[:, None], cfg, kv_caches=caches, rope=rope,
+            logits_dtype=jnp.float32)
+        return (tokens, caches, logits[:, 0], rng, done), lp
+
+    done0 = jnp.zeros((b,), bool)
+    (tokens, _, _, _, done), lps = jax.lax.scan(
+        step, (tokens, caches, logits[:, -1], rng, done0),
+        min_prompt + jnp.arange(max_len - min_prompt))
+    logprobs = jnp.zeros((b, max_len), jnp.float32)
+    logprobs = jax.lax.dynamic_update_slice_in_dim(
+        logprobs, lps.T, min_prompt, axis=1)
+    return tokens, logprobs
+
+
+class Generator:
+    """Jit-cached generation engine. One compile per (batch, max_len) bucket
+    (the reference instead pays a fresh CUDA graph per request shape)."""
+
+    def __init__(self, params, cfg: ModelConfig, eos_id: int,
+                 pad_id: Optional[int] = None):
+        self.params = params
+        self.cfg = cfg
+        self.eos_id = eos_id
+        self.pad_id = pad_id if pad_id is not None else eos_id
+        self.rope = lm.make_rope(cfg, max_len=cfg.max_position_embeddings)
+        self._decode = {}
+
+        def _score_fn(params, tokens):
+            logits, _ = lm.model_forward(params, tokens, self.cfg,
+                                         rope=self.rope,
+                                         logits_dtype=jnp.float32)
+            lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+            return jnp.take_along_axis(
+                lp, tokens[:, 1:, None], axis=-1)[..., 0]
+
+        # one cached jit; retraces only on new (batch, len) shapes
+        self._score_fn = jax.jit(_score_fn)
+
+    def _get_decode(self, max_len: int, min_prompt: int,
+                    sp: SamplingParams):
+        key = (max_len, min_prompt, sp)
+        if key not in self._decode:
+            self._decode[key] = jax.jit(functools.partial(
+                _decode_fn, cfg=self.cfg, max_len=max_len,
+                min_prompt=min_prompt, sp=sp,
+                eos_id=self.eos_id, pad_id=self.pad_id, rope=self.rope))
+        return self._decode[key]
+
+    def generate(self, prompts: list[list[int]], max_new_tokens: int,
+                 sampling: SamplingParams = SamplingParams(),
+                 seed: int = 0):
+        """prompts: list of token id lists. Returns (tokens, lengths,
+        logprobs) as numpy, one row per prompt
+        (ref: generation.py:89-285)."""
+        b = len(prompts)
+        lengths = np.array([len(p) for p in prompts], np.int32)
+        max_len = int(lengths.max()) + max_new_tokens
+        max_pos = self.cfg.max_position_embeddings
+        if max_len > max_pos:
+            raise ValueError(
+                f"prompt ({int(lengths.max())}) + max_new_tokens "
+                f"({max_new_tokens}) = {max_len} exceeds "
+                f"max_position_embeddings={max_pos}; positions past the RoPE "
+                "table would silently clamp")
+        # bucket shapes so the jit cache actually hits across request sizes:
+        # max_len rounds UP to 64, prefill length DOWN to 16
+        max_len = min(-(-max_len // 64) * 64, max_pos)
+        min_prompt = max((int(lengths.min()) // 16) * 16, 1)
+        toks = np.full((b, max_len), self.pad_id, np.int32)
+        for i, p in enumerate(prompts):
+            toks[i, :len(p)] = p
+        fn = self._get_decode(max_len, min_prompt, sampling)
+        tokens, logprobs = fn(self.params, jnp.asarray(toks),
+                              jnp.asarray(lengths),
+                              jax.random.PRNGKey(seed))
+        tokens = np.asarray(tokens)
+        logprobs = np.asarray(logprobs)
+        out_lens = []
+        for i in range(b):
+            # the decode ran to the BUCKETED max_len; the caller asked for at
+            # most lengths[i] + max_new_tokens
+            requested = int(lengths[i]) + max_new_tokens
+            row = tokens[i, lengths[i]:requested]
+            hits = np.where(row == self.eos_id)[0]
+            end = int(lengths[i]) + (int(hits[0]) + 1 if len(hits)
+                                     else requested - int(lengths[i]))
+            out_lens.append(end)
+        return tokens, np.asarray(out_lens, np.int32), logprobs
+
+    def score(self, token_rows: list[list[int]]):
+        """Per-token logprobs of given sequences (ref: generation.py:20-86
+        score_and_return_on_first_stage)."""
+        b = len(token_rows)
+        lengths = np.array([len(t) for t in token_rows], np.int32)
+        max_len = int(lengths.max())
+        toks = np.full((b, max_len), self.pad_id, np.int32)
+        for i, t in enumerate(token_rows):
+            toks[i, :len(t)] = t
+        return np.asarray(self._score_fn(self.params, jnp.asarray(toks)))
+
+
+def beam_search(generator: Generator, prompt: list[int], beam_width: int,
+                max_new_tokens: int, length_penalty: float = 1.0):
+    """Beam search decode (ref: generation.py:288-415 + beam_utils.py:19-64).
+
+    Jit-friendly formulation: all `beam_width` hypotheses run as one batch;
+    each step expands to beam_width^2 candidates and keeps the top
+    beam_width by cumulative logprob (length-penalized at finalization,
+    matching the reference's scoring)."""
+    cfg = generator.cfg
+    eos = generator.eos_id
+    params = generator.params
+    rope = generator.rope
+    prompt_len = len(prompt)
+    max_len = prompt_len + max_new_tokens
+    bw = beam_width
+
+    toks = np.full((bw, max_len), generator.pad_id, np.int32)
+    toks[:, :prompt_len] = prompt
+
+    @jax.jit
+    def prefill(params, tokens):
+        caches = init_kv_caches(cfg, bw, max_len)
+        logits, caches = lm.model_forward(
+            params, tokens[:, :prompt_len], cfg, kv_caches=caches, rope=rope,
+            logits_dtype=jnp.float32)
+        return logits[:, -1], caches
+
+    @jax.jit
+    def step(params, tokens, caches, scores, done, pos, last_logits):
+        lp = jax.nn.log_softmax(last_logits, axis=-1)  # [bw, V]
+        V = lp.shape[-1]
+        iota = jnp.arange(V)
+        lp = jnp.where(iota[None, :] < cfg.vocab_size, lp, -jnp.inf)
+        # finished beams only extend with pad at no cost
+        cand = jnp.where(done[:, None], -jnp.inf, lp) + scores[:, None]
+        cand = cand.reshape(-1)
+        # keep finished beams alive as single candidates
+        keep_done = jnp.where(done, scores, -jnp.inf)
+        all_scores = jnp.concatenate([cand, keep_done])
+        top = jax.lax.top_k(all_scores, bw)[1]
+        is_kept_done = top >= bw * V
+        parent = jnp.where(is_kept_done, top - bw * V, top // V)
+        token = jnp.where(is_kept_done, generator.pad_id, top % V)
+        scores = all_scores[top]
+        tokens = tokens[parent]
+        caches = KVCache(k=caches.k[:, parent], v=caches.v[:, parent],
+                         offset=caches.offset)
+        tokens = jax.lax.dynamic_update_index_in_dim(
+            tokens, token.astype(jnp.int32), pos, axis=1)
+        done = done[parent] | (token == eos)
+        logits, caches = lm.model_forward(
+            params, tokens[:, pos][:, None], cfg, kv_caches=caches,
+            rope=rope, logits_dtype=jnp.float32)
+        return tokens, caches, scores, done, logits[:, 0]
+
+    last_logits, caches = prefill(params, jnp.asarray(toks))
+    tokens = jnp.asarray(toks)
+    scores = jnp.asarray([0.0] + [-1e9] * (bw - 1), jnp.float32)
+    done = jnp.zeros((bw,), bool)
+    for pos in range(prompt_len, max_len):
+        tokens, caches, scores, done, last_logits = step(
+            params, tokens, caches, scores, done, pos, last_logits)
+        if bool(done.all()):
+            break
+    # length-penalized final ranking (ref: beam_utils.py:19-64)
+    tokens = np.asarray(tokens)
+    out_len = np.full((bw,), max_len)
+    for i in range(bw):
+        hits = np.where(tokens[i, prompt_len:] == eos)[0]
+        if len(hits):
+            out_len[i] = prompt_len + hits[0] + 1
+    gen_len = np.maximum(out_len - prompt_len, 1)
+    final = np.asarray(scores) / (gen_len ** length_penalty)
+    order = np.argsort(-final)
+    return tokens[order], out_len[order], final[order]

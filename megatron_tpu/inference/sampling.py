@@ -1,0 +1,52 @@
+"""Token sampling: temperature / top-k / top-p.
+
+TPU-native port of the reference's sampler
+(ref: megatron/text_generation/sampling.py:14-93 `modify_logits_for_top_k/p_
+filtering` + `sample`): greedy when top_k==0 and top_p==0 and temperature==0;
+otherwise temperature-scaled logits filtered by top-k then top-p. In-place
+masking becomes functional `jnp.where`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_filter(logits, k: int):
+    """Keep the k largest logits per row (ref: sampling.py:14-23)."""
+    if k <= 0:
+        return logits
+    kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def top_p_filter(logits, p: float):
+    """Nucleus filtering (ref: sampling.py:26-42): drop the tail whose
+    cumulative probability exceeds 1-p (keeping at least the top token)."""
+    if p <= 0.0 or p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # a sorted position is kept while the cumulative mass BEFORE it is < p
+    keep_sorted = (cum - probs) < p
+    min_kept = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                       axis=-1, keepdims=True)
+    return jnp.where(logits < min_kept, -jnp.inf, logits)
+
+
+def sample(rng, logits, *, top_k: int = 0, top_p: float = 0.0,
+           temperature: float = 1.0, vocab_size: int | None = None):
+    """One sampling step over [batch, vocab] logits
+    (ref: sampling.py:45-93). Returns int32 [batch]."""
+    logits = logits.astype(jnp.float32)
+    if vocab_size is not None and vocab_size < logits.shape[-1]:
+        iota = jnp.arange(logits.shape[-1])
+        logits = jnp.where(iota < vocab_size, logits, -jnp.inf)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if temperature == 0.0 or (top_k == 1):
+        return greedy
+    logits = logits / max(temperature, 1e-6)
+    logits = top_k_filter(logits, top_k)
+    logits = top_p_filter(logits, top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,126 @@
+"""REST text-generation server.
+
+TPU-native port of the reference's Flask server
+(ref: megatron/text_generation_server.py:17-241 + tools/
+run_text_generation_server.py:60-84): same `/api` PUT contract —
+{"prompts": [...], "tokens_to_generate": N, "temperature": ..,
+ "top_k": .., "top_p": .., "logprobs": bool, "beam_width": int|absent} ->
+{"text": [...], "segments"/"logprobs": ...}.
+
+The reference needs a rank-0 Flask thread that broadcasts a GENERATE/BEAM
+signal to all other ranks sitting in a receive loop
+(ref: text_generation_server.py:22-31); single-controller JAX needs none of
+that — one process serves and drives all chips. Flask is used when
+available, else the stdlib http.server (this image has no flask).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+
+from megatron_tpu.inference.api import (beam_search_and_post_process,
+                                        generate_and_post_process)
+from megatron_tpu.inference.generation import Generator
+from megatron_tpu.utils.logging import print_rank_0
+
+
+class MegatronServer:
+    """(ref: text_generation_server.py:229-241 MegatronServer)"""
+
+    def __init__(self, generator: Generator, tokenizer):
+        self.generator = generator
+        self.tokenizer = tokenizer
+        self._lock = threading.Lock()  # one generation at a time (ref: :37)
+        self._request_counter = itertools.count()
+
+    def handle(self, payload: dict) -> dict:
+        """(ref: text_generation_server.py:31-228 MegatronGenerate.put)"""
+        if "prompts" not in payload:
+            return {"message": "prompts argument required"}
+        prompts = payload["prompts"]
+        if not isinstance(prompts, list) or not prompts:
+            return {"message": "prompts must be a non-empty list"}
+        if len(prompts) > 128:
+            return {"message": "Maximum number of prompts is 128"}
+        n = int(payload.get("tokens_to_generate", 64))
+        if n < 0:
+            return {"message": "tokens_to_generate must be >= 0"}
+        with self._lock:
+            if payload.get("beam_width"):
+                if len(prompts) > 1:
+                    # (ref: text_generation_server.py beam-search rejects
+                    # multi-prompt requests)
+                    return {"message":
+                            "With beam_search only one prompt is allowed"}
+                texts, scores = beam_search_and_post_process(
+                    self.generator, self.tokenizer, prompts[0],
+                    tokens_to_generate=n,
+                    beam_size=int(payload["beam_width"]),
+                    length_penalty=float(payload.get("length_penalty", 1.0)),
+                    add_BOS=bool(payload.get("add_BOS", False)))
+                return {"text": texts, "score": scores}
+            texts, tokens, logprobs = generate_and_post_process(
+                self.generator, self.tokenizer, prompts,
+                tokens_to_generate=n,
+                temperature=float(payload.get("temperature", 1.0)),
+                top_k=int(payload.get("top_k", 0)),
+                top_p=float(payload.get("top_p", 0.0)),
+                add_BOS=bool(payload.get("add_BOS", False)),
+                return_output_log_probs=bool(payload.get("logprobs", False)),
+                # unseeded requests must differ run-to-run (the reference
+                # leaves sampling unseeded unless random_seed is given)
+                seed=int(payload.get("random_seed",
+                                     next(self._request_counter))))
+            out = {"text": texts, "segments": tokens}
+            if logprobs is not None:
+                out["logprobs"] = logprobs
+            return out
+
+    def run(self, host: str = "0.0.0.0", port: int = 5000):
+        try:
+            self._run_flask(host, port)
+        except ImportError:
+            self._run_stdlib(host, port)
+
+    def _run_flask(self, host, port):
+        from flask import Flask, jsonify, request
+        app = Flask(__name__)
+        server = self
+
+        @app.route("/api", methods=["PUT"])
+        def api():
+            return jsonify(server.handle(request.get_json()))
+
+        print_rank_0(f"serving (flask) on {host}:{port}/api")
+        app.run(host=host, port=port, threaded=True)
+
+    def _run_stdlib(self, host, port):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_PUT(self):
+                if self.path.rstrip("/") != "/api":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    result = server.handle(payload)
+                    body = json.dumps(result).encode()
+                    self.send_response(200)
+                except Exception as e:  # mirror flask's 500-with-message
+                    body = json.dumps({"message": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *a):
+                pass
+
+        print_rank_0(f"serving (http.server) on {host}:{port}/api")
+        httpd = ThreadingHTTPServer((host, port), Handler)
+        httpd.serve_forever()

@@ -80,9 +80,12 @@ def main(argv=None):
 
     t0 = time.time()
     n = 0
-    def consume(encoded, f):
-        nonlocal n
+    total_bytes = 0
+
+    def consume(encoded):
+        nonlocal n, total_bytes
         for doc, nbytes in encoded:
+            total_bytes += nbytes
             if doc is None:
                 continue
             for key, ids in doc.items():
@@ -91,16 +94,16 @@ def main(argv=None):
                     builders[key].end_document()
             n += 1
             if n % args.log_interval == 0:
-                mbs = f.tell() / 1e6 / (time.time() - t0)
+                mbs = total_bytes / 1e6 / (time.time() - t0)
                 print(f"processed {n} documents ({mbs:.1f} MB/s)")
 
     with open(args.input, encoding="utf-8") as f:
         if args.workers > 1:
             with mp.Pool(args.workers, initializer=_init_worker,
                          initargs=(args,)) as pool:
-                consume(pool.imap(_encode, f, chunksize=32), f)
+                consume(pool.imap(_encode, f, chunksize=32))
         else:
-            consume(map(_encode, f), f)
+            consume(map(_encode, f))
     for b in builders.values():
         b.finalize()
     print(f"done: {n} documents in {time.time()-t0:.1f}s "

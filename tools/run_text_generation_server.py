@@ -1,0 +1,58 @@
+"""Launch the REST text-generation server from a checkpoint.
+
+TPU-native port of /root/reference/tools/run_text_generation_server.py:60-84.
+
+  python tools/run_text_generation_server.py --load ckpts/llama7b \
+      --tokenizer_type SentencePieceTokenizer --tokenizer_model tok.model \
+      --port 5000
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_tpu.utils.platform import ensure_env_platform
+ensure_env_platform()
+
+
+def main(argv=None):
+    import jax
+
+    from megatron_tpu.data import build_tokenizer
+    from megatron_tpu.inference.generation import Generator
+    from megatron_tpu.inference.server import MegatronServer
+    from megatron_tpu.models import language_model as lm
+    from megatron_tpu.training import checkpointing as ckpt
+    from megatron_tpu.training.train_step import TrainState
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--load", required=True)
+    p.add_argument("--tokenizer_type", default="SentencePieceTokenizer")
+    p.add_argument("--tokenizer_model", default=None)
+    p.add_argument("--vocab_file", default=None)
+    p.add_argument("--merge_file", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=5000)
+    args = p.parse_args(argv)
+
+    cfg = ckpt.load_config_from_checkpoint(args.load)
+    assert cfg is not None, f"no checkpoint under {args.load}"
+    mcfg = cfg.model
+    example = TrainState(
+        params=jax.eval_shape(lambda: lm.model_init(jax.random.PRNGKey(0),
+                                                    mcfg)),
+        opt_state=None, iteration=0)
+    state, _, _ = ckpt.load_checkpoint(args.load, example, no_load_optim=True)
+    assert state is not None, f"failed to load checkpoint from {args.load}"
+    tokenizer = build_tokenizer(
+        args.tokenizer_type, vocab_file=args.vocab_file,
+        merge_file=args.merge_file, tokenizer_model=args.tokenizer_model)
+    gen = Generator(state.params, mcfg, eos_id=tokenizer.eod)
+    MegatronServer(gen, tokenizer).run(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Interactive CLI client for the text-generation server.
+
+TPU-native port of /root/reference/tools/text_generation_cli.py: reads
+prompts from stdin, PUTs them to <url>/api, prints the generated text.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+
+def main():
+    if len(sys.argv) != 2:
+        print("usage: text_generation_cli.py <host:port>")
+        return 1
+    url = f"http://{sys.argv[1]}/api"
+    while True:
+        try:
+            prompt = input("Enter prompt: ")
+        except EOFError:
+            return 0
+        n = input("Enter number of tokens to generate: ")
+        payload = json.dumps({"prompts": [prompt],
+                              "tokens_to_generate": int(n)}).encode()
+        req = urllib.request.Request(
+            url, data=payload, method="PUT",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            data = json.loads(resp.read())
+        print("Megatron Response:")
+        print(data["text"][0])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
