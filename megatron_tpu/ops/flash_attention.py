@@ -31,10 +31,15 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
     """Blockwise attention with online softmax. Returns [b, sq, nq, d]."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
+    if use_pallas and (q.shape[1] % 128 != 0 or k.shape[1] % 128 != 0):
+        # kernel blocks need 128-divisible sequence lengths; odd shapes take
+        # the XLA blockwise path
+        use_pallas = False
     if use_pallas:
         try:
             from megatron_tpu.ops.flash_attention_pallas import pallas_flash_attention
-            return pallas_flash_attention(q, k, v, causal=causal, scale=scale)
+            # positional: custom_vjp functions reject keyword arguments
+            return pallas_flash_attention(q, k, v, causal, scale)
         except ImportError:
             pass
     return _blockwise_attention(q, k, v, causal=causal, scale=scale,

@@ -1,0 +1,309 @@
+"""Pallas TPU flash attention: causal + GQA, forward and backward.
+
+TPU-native replacement for the reference's CUDA attention kernels — the
+external FlashAttention-2 package (ref: megatron/model/transformer.py:514-522
+`flash_attn_func`) and the fused scaled-masked-softmax kernels it superseded
+(ref: megatron/fused_kernels/scaled_*_softmax*.cu — K1-K3 in SURVEY.md §2.2).
+
+Kernel shape (FlashAttention-2 algorithm on the TPU memory hierarchy):
+- grid (batch, q_heads, q_blocks, kv_blocks); the kv axis is innermost, so
+  TPU's sequential grid execution lets a VMEM scratch accumulator carry the
+  online-softmax state (m, l, acc) across kv steps — the analogue of the
+  CUDA kernel's per-CTA registers.
+- Q/K/V blocks are DMA'd HBM->VMEM by BlockSpec; the MXU does the two GEMMs
+  per tile; softmax renormalization runs on the VPU in fp32.
+- Causality skips whole kv blocks past the diagonal (`pl.when`), the partial
+  diagonal block is masked by lane iota.
+- GQA: the kv-head BlockSpec index maps q-head h -> kv-head h // group, so
+  MQA/GQA never materialize broadcast K/V (the reference materializes the
+  broadcast at transformer.py:448-455 in the unfused path).
+- Backward is a custom VJP with the standard flash recomputation: saved
+  per-row logsumexp + delta = rowsum(dO*O), one kernel for dQ (grid over q
+  blocks) and one for dK/dV (grid over kv blocks).
+
+Layout: [b, s, n, d] at the API boundary (matching models/attention.py);
+kernels run head-major [b, n, s, d].
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_KV = 512
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_kv, num_kv):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # whole block beyond the diagonal -> skip (causal)
+    run = True
+    if causal:
+        run = ki * block_kv <= qi * block_q + block_q - 1
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32) * scale      # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)              # [bkv, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:] + jnp.log(l_safe)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, block_q, block_kv, num_kv):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = ki * block_kv <= qi * block_q + block_q - 1
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, block_q, block_kv, num_q):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        # q block entirely above the diagonal contributes nothing
+        run = qi * block_q + block_q - 1 >= ki * block_kv
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                    # [bq, bkv]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _pick_blocks(sq, sk, block_q, block_kv):
+    bq = min(block_q, sq)
+    bkv = min(block_kv, sk)
+    assert sq % bq == 0 and sk % bkv == 0, (
+        f"seq lengths ({sq},{sk}) must divide into blocks ({bq},{bkv})")
+    return bq, bkv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def pallas_flash_attention(q, k, v, causal=True, scale=None,
+                           block_q=DEFAULT_BLOCK_Q, block_kv=DEFAULT_BLOCK_KV,
+                           interpret=False):
+    """q [b, sq, nq, d], k/v [b, sk, nkv, d] -> [b, sq, nq, d]."""
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+    b, sq, nq, d = q.shape
+    sk, nkv = k.shape[1], k.shape[2]
+    g = nq // nkv
+    if scale is None:
+        scale = d ** -0.5
+    bq, bkv = _pick_blocks(sq, sk, block_q, block_kv)
+    num_q, num_kv = sq // bq, sk // bkv
+
+    qT = q.transpose(0, 2, 1, 3)  # [b, nq, sq, d]
+    kT = k.transpose(0, 2, 1, 3)  # [b, nkv, sk, d]
+    vT = v.transpose(0, 2, 1, 3)
+
+    grid = (b, nq, num_q, num_kv)
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, h, qi, ki: (bi, h, qi, 0))
+    kv_spec = pl.BlockSpec((1, 1, bkv, d),
+                           lambda bi, h, qi, ki: (bi, h // g, ki, 0))
+    o_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, h, qi, ki: (bi, h, qi, 0))
+    lse_spec = pl.BlockSpec((1, 1, bq), lambda bi, h, qi, ki: (bi, h, qi))
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_kv=bkv, num_kv=num_kv),
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=[o_spec, lse_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, nq, sq, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, nq, sq), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                        pltpu.VMEM((bq,), jnp.float32),
+                        pltpu.VMEM((bq,), jnp.float32)],
+        interpret=interpret,
+    )(qT, kT, vT)
+    out = out.transpose(0, 2, 1, 3)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, dout):
+    q, k, v, out, lse = res
+    b, sq, nq, d = q.shape
+    sk, nkv = k.shape[1], k.shape[2]
+    g = nq // nkv
+    if scale is None:
+        scale = d ** -0.5
+    bq, bkv = _pick_blocks(sq, sk, block_q, block_kv)
+    num_q, num_kv = sq // bq, sk // bkv
+
+    qT = q.transpose(0, 2, 1, 3)
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+    doT = dout.transpose(0, 2, 1, 3)
+    # delta = rowsum(dO * O) [b, nq, sq] (flash-2 backward precomputation)
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).transpose(0, 2, 1)
+
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, h, qi, ki: (bi, h, qi, 0))
+    kv_spec = pl.BlockSpec((1, 1, bkv, d),
+                           lambda bi, h, qi, ki: (bi, h // g, ki, 0))
+    row_spec = pl.BlockSpec((1, 1, bq), lambda bi, h, qi, ki: (bi, h, qi))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_kv=bkv, num_kv=num_kv),
+        grid=(b, nq, num_q, num_kv),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda bi, h, qi, ki: (bi, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, nq, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qT, kT, vT, doT, lse, delta)
+
+    # dk/dv: grid swaps the roles — kv blocks outer, q blocks inner; every
+    # q-head contributes to its kv-head, so run per Q-HEAD and sum groups
+    # after (keeps the kernel free of cross-head reductions)
+    q_spec2 = pl.BlockSpec((1, 1, bq, d),
+                           lambda bi, h, ki, qi: (bi, h, qi, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, bkv, d),
+                            lambda bi, h, ki, qi: (bi, h // g, ki, 0))
+    row_spec2 = pl.BlockSpec((1, 1, bq), lambda bi, h, ki, qi: (bi, h, qi))
+    dk_spec = pl.BlockSpec((1, 1, bkv, d),
+                           lambda bi, h, ki, qi: (bi, h, ki, 0))
+
+    dk_per_head, dv_per_head = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_kv=bkv, num_q=num_q),
+        grid=(b, nq, num_kv, num_q),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[dk_spec, dk_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, nq, sk, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b, nq, sk, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bkv, d), jnp.float32),
+                        pltpu.VMEM((bkv, d), jnp.float32)],
+        interpret=interpret,
+    )(qT, kT, vT, doT, lse, delta)
+
+    # GQA: sum the per-q-head dk/dv into kv heads
+    dk = dk_per_head.reshape(b, nkv, g, sk, d).sum(axis=2)
+    dv = dv_per_head.reshape(b, nkv, g, sk, d).sum(axis=2)
+
+    return (dq.transpose(0, 2, 1, 3),
+            dk.transpose(0, 2, 1, 3).astype(k.dtype),
+            dv.transpose(0, 2, 1, 3).astype(v.dtype))
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_kv, interpret):
+    out, res = _flash_fwd(q, k, v, causal, scale, block_q, block_kv,
+                          interpret)
+    return out, res
+
+
+pallas_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd)

@@ -100,3 +100,38 @@ def with_sharding(x, mesh: Mesh, logical_axes: tuple, rules):
     scatter/gather mapping functions (ref: mappings.py:253-278)."""
     return jax.lax.with_sharding_constraint(
         x, logical_sharding(mesh, logical_axes, rules))
+
+
+def distributed_opt_sharding(mesh: Mesh, logical_axes: tuple, rules,
+                             shape: tuple) -> NamedSharding:
+    """ZeRO-1 optimizer-state sharding (ref: megatron/optimizer/
+    distrib_optimizer.py:32-610 DistributedOptimizer).
+
+    The reference shards Adam state across DP ranks over the *flattened* grad
+    buffer (ranges ignore parameter boundaries) and hand-codes grad
+    reduce-scatter + param all-gather. The GSPMD formulation: give each
+    optimizer-state leaf its parameter's spec PLUS 'dp' on the first
+    dimension that is unsharded and dp-divisible. XLA then reduce-scatters
+    the grads feeding the update and all-gathers the updated params — the
+    same collectives, derived from the placement (SURVEY.md §7)."""
+    spec = list(logical_to_spec(logical_axes, rules))
+    spec += [None] * (len(shape) - len(spec))
+    dp = mesh.shape[DATA_AXIS]
+    if dp > 1:
+        for i, (ax, dim) in enumerate(zip(spec, shape)):
+            if ax is None and dim % dp == 0:
+                spec[i] = DATA_AXIS
+                break
+    while spec and spec[-1] is None:
+        spec.pop()
+    return NamedSharding(mesh, P(*spec))
+
+
+def tree_distributed_opt_sharding(mesh: Mesh, logical_tree, rules,
+                                  shape_tree):
+    return jax.tree.map(
+        lambda ax, sh: distributed_opt_sharding(mesh, ax, rules,
+                                                tuple(sh.shape)),
+        logical_tree, shape_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )

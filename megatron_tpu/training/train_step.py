@@ -221,10 +221,20 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True):
     axes = lm.model_axes(cfg.model)
     param_sh = shd.tree_logical_to_sharding(mesh, axes, rules)
     scalar_sh = NamedSharding(mesh, P())
+    if cfg.parallel.use_distributed_optimizer:
+        # ZeRO-1: Adam moments additionally sharded over 'dp'
+        # (ref: optimizer/distrib_optimizer.py; see
+        # parallel/sharding.py:distributed_opt_sharding)
+        shapes = jax.eval_shape(
+            lambda: lm.model_init(jax.random.PRNGKey(0), cfg.model))
+        moment_sh = shd.tree_distributed_opt_sharding(mesh, axes, rules,
+                                                      shapes)
+    else:
+        moment_sh = param_sh
     opt_sh = opt.OptState(
         step=scalar_sh,
-        mu=param_sh,
-        nu=param_sh if cfg.optimizer.optimizer == "adam" else None,
+        mu=moment_sh,
+        nu=moment_sh if cfg.optimizer.optimizer == "adam" else None,
         scaler=opt.ScalerState(scalar_sh, scalar_sh, scalar_sh),
     )
     state_sh = TrainState(params=param_sh, opt_state=opt_sh,

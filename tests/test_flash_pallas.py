@@ -1,0 +1,92 @@
+"""Pallas flash-attention kernel vs the XLA blockwise/dot references.
+
+The kernel is the TPU replacement for flash_attn (SURVEY.md K1-K3 +
+flash_attn); on CPU it runs in pallas interpret mode, so the same numerics
+checks run hermetically in CI.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.ops.flash_attention import _blockwise_attention
+from megatron_tpu.ops.flash_attention_pallas import pallas_flash_attention
+
+
+def ref_attention(q, k, v, causal=True):
+    b, sq, nq, d = q.shape
+    nkv = k.shape[2]
+    g = nq // nkv
+    qg = q.astype(jnp.float32).reshape(b, sq, nkv, g, d)
+    s = jnp.einsum("bsngd,btnd->bngst", qg, k.astype(jnp.float32)) * d**-0.5
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, k.shape[1]), bool))
+        s = jnp.where(mask[None, None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bngst,btnd->bsngd", p, v.astype(jnp.float32))
+    return o.reshape(b, sq, nq, d)
+
+
+@pytest.mark.parametrize("nq,nkv", [(4, 4), (4, 2), (4, 1)])
+def test_forward_matches_reference(nq, nkv):
+    b, s, d = 2, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, s, nq, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, nkv, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, nkv, d), jnp.float32)
+    got = pallas_flash_attention(q, k, v, True, None, 128, 128, True)
+    want = ref_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_noncausal_forward():
+    b, s, d = 1, 128, 64
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (b, s, 4, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, 2, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, 2, d), jnp.float32)
+    got = pallas_flash_attention(q, k, v, False, None, 64, 64, True)
+    want = ref_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("nq,nkv", [(4, 4), (4, 2)])
+def test_backward_matches_reference(nq, nkv):
+    b, s, d = 1, 128, 64
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (b, s, nq, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, nkv, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, nkv, d), jnp.float32)
+
+    def loss_pallas(q, k, v):
+        o = pallas_flash_attention(q, k, v, True, None, 64, 64, True)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = ref_attention(q, k, v)
+        return jnp.sum(o * jnp.cos(o))
+
+    g_got = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_dispatch_through_flash_attention():
+    """ops.flash_attention uses the pallas kernel on TPU; on CPU the XLA
+    blockwise path and the (interpreted) kernel must agree."""
+    from megatron_tpu.ops.flash_attention import flash_attention
+    b, s, d = 1, 128, 64
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (b, s, 4, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, 2, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, 2, d), jnp.float32)
+    xla = flash_attention(q, k, v, causal=True, use_pallas=False)
+    pallas = pallas_flash_attention(q, k, v, True, None, 64, 64, True)
+    np.testing.assert_allclose(np.asarray(pallas), np.asarray(xla),
+                               rtol=2e-5, atol=2e-5)
