@@ -97,7 +97,8 @@ class ModelConfig:
     init_method_std: float = 0.02
     use_scaled_init: bool = True  # scale output-layer init by 1/sqrt(2*num_layers)
 
-    # attention implementation: "flash" (blockwise/Pallas) | "dot" (xla einsum)
+    # attention implementation: "flash" (blockwise/Pallas) | "dot" (xla
+    # einsum) | "ring" (context-parallel ring attention over 'cp')
     attention_impl: str = "dot"
     # activation recompute: "none" | "selective" | "full" (ref: arguments.py:601-629)
     recompute_granularity: str = "none"
@@ -109,8 +110,9 @@ class ModelConfig:
 
     def derived(self) -> "ModelConfig":
         """Fill derived fields (ffn size, kv heads, head dim, max positions)."""
-        assert self.attention_impl in ("dot", "flash"), (
-            f"attention_impl must be 'dot' or 'flash', got {self.attention_impl!r}")
+        assert self.attention_impl in ("dot", "flash", "ring"), (
+            f"attention_impl must be 'dot', 'flash' or 'ring', "
+            f"got {self.attention_impl!r}")
         d: dict[str, Any] = {}
         if self.num_kv_heads is None:
             d["num_kv_heads"] = self.num_attention_heads

@@ -1,0 +1,113 @@
+"""Ring attention: context parallelism over the 'cp' mesh axis.
+
+The reference has NO context parallelism — no ring attention, no Ulysses, no
+blockwise sequence sharding (SURVEY.md §2.8: explicitly absent; long context
+is reached only via FlashAttention-2 + Megatron-SP + RoPE scaling, §5). This
+module is the idiomatic TPU upgrade called for by SURVEY.md §7 stage 9:
+sequences shard along 'cp', and K/V blocks rotate around the ring
+(`lax.ppermute` over ICI) while each device's Q stays resident — attention
+memory per chip drops by 1/cp and the KV transfers overlap with the
+per-block attention compute (RingAttention, Liu et al. 2023; the public
+"How to Scale Your Model" recipe).
+
+Formulation: one partial-manual shard_map (manual over 'cp' only, dp/tp stay
+GSPMD-automatic), cp steps of blockwise attention with online-softmax
+merging — the same merge the flash kernel does across kv blocks, here across
+ring hops. Causality uses global positions derived from the ring rank, so
+rotating blocks never breaks the causal mask.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from megatron_tpu.ops.flash_attention import flash_attention
+
+NEG_INF = -1e30
+
+
+def _local_block_attention(q, k, v, q_off, kv_off, *, scale, causal):
+    """Blockwise attention of local q [b,s,nq,d] against one rotating kv
+    block [b,c,nkv,d]; returns (unnormalized acc [b,s,nq,d] f32,
+    m [b,s,nq] f32, l [b,s,nq] f32) for online merging."""
+    b, s, nq, d = q.shape
+    c, nkv = k.shape[1], k.shape[2]
+    g = nq // nkv
+    qg = (q.astype(jnp.float32) * scale).reshape(b, s, nkv, g, d)
+    scores = jnp.einsum("bsngd,btnd->bsngt", qg, k.astype(jnp.float32))
+    if causal:
+        q_pos = q_off + jnp.arange(s)
+        kv_pos = kv_off + jnp.arange(c)
+        mask = q_pos[:, None] >= kv_pos[None, :]  # [s, c]
+        scores = jnp.where(mask[None, :, None, None, :], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)  # [b,s,nkv,g]
+    p = jnp.exp(scores - m[..., None])
+    p = jnp.where(m[..., None] <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bsngt,btnd->bsngd", p, v.astype(jnp.float32))
+    return (acc.reshape(b, s, nq, d), m.reshape(b, s, nq),
+            l.reshape(b, s, nq))
+
+
+def ring_attention(q, k, v, mesh, *, causal: bool = True,
+                   scale: float | None = None, axis: str = "cp"):
+    """q/k/v [b, S, n, d] with S the GLOBAL sequence length, sharded over
+    `axis` on dim 1. Returns [b, S, nq, d] with the same sharding.
+
+    Must run under jit with the ambient mesh set (same contract as the
+    pipeline shard_map)."""
+    cp = mesh.shape[axis]
+    if cp == 1:
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    d = q.shape[-1]
+    if scale is None:
+        scale = d ** -0.5
+    out_dtype = q.dtype
+
+    def per_rank(q, k, v):
+        # local shards: q [b, s_loc, nq, d], k/v [b, s_loc, nkv, d]
+        r = jax.lax.axis_index(axis)
+        s_loc = q.shape[1]
+        b, _, nq, _ = q.shape
+        perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+        def hop(carry, step):
+            acc, m, l, k_cur, v_cur = carry
+            # after `step` rotations this rank holds the block that
+            # originated at rank (r - step) mod cp
+            src = (r - step) % cp
+            a_new, m_new, l_new = _local_block_attention(
+                q, k_cur, v_cur, r * s_loc, src * s_loc,
+                scale=scale, causal=causal)
+            m_tot = jnp.maximum(m, m_new)
+            m_safe = jnp.where(m_tot <= NEG_INF / 2, 0.0, m_tot)
+            c1 = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
+            c2 = jnp.where(m_new <= NEG_INF / 2, 0.0,
+                           jnp.exp(m_new - m_safe))
+            acc = acc * c1[..., None] + a_new * c2[..., None]
+            l = l * c1 + l_new * c2
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return (acc, m_tot, l, k_nxt, v_nxt), None
+
+        acc0 = jnp.zeros(q.shape, jnp.float32)
+        m0 = jnp.full((b, s_loc, nq), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, s_loc, nq), jnp.float32)
+        (acc, m, l, _, _), _ = jax.lax.scan(
+            hop, (acc0, m0, l0,
+                  k.astype(jnp.float32), v.astype(jnp.float32)),
+            jnp.arange(cp))
+        out = acc / jnp.maximum(l[..., None], 1e-30)
+        return out.astype(out_dtype)
+
+    shmap = jax.shard_map(
+        per_rank,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+        check_vma=False,
+        axis_names={axis},
+    )
+    return shmap(q, k, v)
