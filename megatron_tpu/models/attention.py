@@ -100,17 +100,22 @@ def _dot_attention(q, k, v, *, causal: bool, softmax_fp32: bool,
     scores = jnp.einsum("bsngd,btnd->bngst", qg, k) * scale
     if softmax_fp32:
         scores = scores.astype(jnp.float32)
-    if causal:
-        q_pos = jnp.arange(s)[:, None]
-        if q_offset is not None:
-            q_pos = q_pos + q_offset
-        kv_pos = jnp.arange(t)[None, :]
-        mask = q_pos >= kv_pos  # [s, t]
-        mask = jnp.broadcast_to(mask[None], (b, s, t))
+    if causal or segment_ids is not None:
+        if causal:
+            q_pos = jnp.arange(s)[:, None]
+            if q_offset is not None:
+                q_pos = q_pos + q_offset
+            kv_pos = jnp.arange(t)[None, :]
+            mask = jnp.broadcast_to((q_pos >= kv_pos)[None], (b, s, t))
+        else:
+            mask = jnp.ones((b, s, t), bool)
         if segment_ids is not None:
             assert s == t, "segment masking requires full (non-cached) attn"
             mask = mask & (segment_ids[:, :, None] == segment_ids[:, None, :])
         scores = jnp.where(mask[:, None, None], scores, jnp.finfo(scores.dtype).min)
+        # fully-masked rows (e.g. pad queries in their own segment... none
+        # here since a pad attends itself) would softmax to NaN; segments
+        # always include self so every row keeps >=1 valid entry
     probs = jax.nn.softmax(scores, axis=-1)
     probs = probs.astype(v.dtype)
     if dropout_rate > 0.0 and dropout_rng is not None:
@@ -132,21 +137,30 @@ def attention_apply(
     dropout_rng=None,
     deterministic: bool = True,
     segment_ids=None,
+    causal: bool = True,
+    kv_input=None,
 ):
-    """Forward pass. x: [b, s, h]. Returns (out [b, s, h], new_kv_cache)."""
+    """Forward pass. x: [b, s, h]. Returns (out [b, s, h], new_kv_cache).
+
+    `causal=False` gives a bidirectional encoder (BERT/T5-encoder,
+    ref: megatron/model/transformer.py AttnMaskType.padding).
+    `kv_input` switches to CROSS-attention: keys/values projected from the
+    encoder output, no rotary on k (ref: transformer.py:664-683 decoder
+    cross-attention)."""
     b, s, h = x.shape
     hd = cfg.kv_channels
     nq = cfg.num_attention_heads
     nkv = cfg.num_kv_heads
     dtype = x.dtype
+    cross = kv_input is not None
 
     q = x @ params["wq"].astype(dtype)
-    kv = x @ params["wkv"].astype(dtype)
+    kv = (kv_input if cross else x) @ params["wkv"].astype(dtype)
     if cfg.use_bias:
         q = q + params["bq"].astype(dtype)
         kv = kv + params["bkv"].astype(dtype)
     q = q.reshape(b, s, nq, hd)
-    kv = kv.reshape(b, s, 2, nkv, hd)
+    kv = kv.reshape(b, kv.shape[1], 2, nkv, hd)
     k, v = kv[:, :, 0], kv[:, :, 1]
 
     q_offset = None
@@ -156,7 +170,7 @@ def attention_apply(
             position_ids = kv_cache.offset + jnp.arange(s)[None, :]
             position_ids = jnp.broadcast_to(position_ids, (b, s))
 
-    if cfg.use_rotary_emb:
+    if cfg.use_rotary_emb and not cross:
         assert rope_cos is not None and rope_sin is not None, (
             "cfg.use_rotary_emb=True requires rope_cos/rope_sin tables "
             "(build them with models.language_model.make_rope)")
@@ -179,7 +193,7 @@ def attention_apply(
     # intentionally has no numerical effect.
 
     if (cfg.attention_impl == "ring" and kv_cache is None
-            and segment_ids is None):
+            and segment_ids is None and causal):
         # context-parallel ring attention over the 'cp' mesh axis (absent in
         # the reference — SURVEY.md §2.8; see parallel/ring_attention.py)
         from megatron_tpu.parallel.ring_attention import ring_attention
@@ -191,11 +205,12 @@ def attention_apply(
             out = flash_attention(q, k, v, causal=True, scale=scale)
     elif cfg.attention_impl == "flash" and kv_cache is None and segment_ids is None:
         from megatron_tpu.ops.flash_attention import flash_attention
-        out = flash_attention(q, k, v, causal=True, scale=scale)
+        out = flash_attention(q, k, v, causal=causal, scale=scale)
     else:
         rate = 0.0 if deterministic else cfg.attention_dropout
         out = _dot_attention(
-            q, k, v, causal=True, softmax_fp32=cfg.attention_softmax_in_fp32,
+            q, k, v, causal=causal,
+            softmax_fp32=cfg.attention_softmax_in_fp32,
             scale=scale, q_offset=q_offset, dropout_rate=rate,
             dropout_rng=dropout_rng, segment_ids=segment_ids)
 

@@ -1,0 +1,154 @@
+"""BERT: bidirectional encoder with MLM + NSP heads.
+
+TPU-native equivalent of the reference's BertModel
+(ref: megatron/model/bert_model.py — BertLMHead :55-91, bert_position_ids,
+post_language_model_processing :94-121, BertModel :124-242) over the shared
+transformer stack. Structure:
+
+- embeddings: word + learned position + tokentype (ref: language_model.py:
+  133-326 Embedding with num_tokentypes=2)
+- encoder: post-LN bidirectional transformer (causal=False)
+- pooler: dense+tanh over [CLS] (ref: language_model.py Pooler)
+- MLM head: dense+gelu+LN then decode against the (tied) embedding matrix
+  (ref: bert_model.py:55-91)
+- NSP head: binary dense over the pooled output (ref: bert_model.py:171-176)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models import transformer as tfm
+from megatron_tpu.models.norms import apply_norm, norm_axes, norm_init
+from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+
+
+def bert_config(**overrides) -> ModelConfig:
+    """bert-base-ish defaults (ref: examples/pretrain_bert.sh flags)."""
+    base = dict(
+        num_layers=12, hidden_size=768, num_attention_heads=12,
+        vocab_size=30522, seq_length=512, use_rotary_emb=False,
+        use_position_embedding=True, norm_type="layernorm",
+        activation="gelu", use_bias=True, use_post_ln=True,
+        tie_embed_logits=True,
+    )
+    base.update(overrides)
+    return ModelConfig(**base).derived()
+
+
+def bert_init(rng, cfg: ModelConfig, num_tokentypes: int = 2,
+              dtype=jnp.float32):
+    ks = jax.random.split(rng, 7)
+    h = cfg.hidden_size
+    v = cfg.padded_vocab_size
+    std = cfg.init_method_std
+    params = {
+        "embedding": {
+            "word_embeddings": jax.random.normal(ks[0], (v, h), dtype) * std,
+            "position_embeddings": jax.random.normal(
+                ks[1], (cfg.max_position_embeddings, h), dtype) * std,
+            "tokentype_embeddings": jax.random.normal(
+                ks[2], (num_tokentypes, h), dtype) * std,
+        },
+        # BERT is post-LN but still normalizes the embeddings
+        # (ref: language_model.py embedding dropout + encoder's initial LN
+        # in the post-LN arrangement)
+        "embedding_norm": norm_init(cfg.norm_type, h, dtype),
+        "transformer": tfm.stack_init(ks[3], cfg, dtype=dtype),
+        "pooler": {"w": jax.random.normal(ks[4], (h, h), dtype) * std,
+                   "b": jnp.zeros((h,), dtype)},
+        "lm_head": {  # transform before tied decode (ref: bert_model.py:55-91)
+            "dense": {"w": jax.random.normal(ks[5], (h, h), dtype) * std,
+                      "b": jnp.zeros((h,), dtype)},
+            "norm": norm_init(cfg.norm_type, h, dtype),
+            "bias": jnp.zeros((v,), dtype),
+        },
+        "binary_head": {"w": jax.random.normal(ks[6], (h, 2), dtype) * std,
+                        "b": jnp.zeros((2,), dtype)},
+    }
+    return params
+
+
+def bert_axes(cfg: ModelConfig):
+    return {
+        "embedding": {
+            "word_embeddings": ("vocab", "embed"),
+            "position_embeddings": (None, "embed"),
+            "tokentype_embeddings": (None, "embed"),
+        },
+        "embedding_norm": norm_axes(cfg.norm_type),
+        "transformer": tfm.stack_axes(cfg),
+        "pooler": {"w": ("embed", "embed"), "b": ("embed",)},
+        "lm_head": {
+            "dense": {"w": ("embed", "embed"), "b": ("embed",)},
+            "norm": norm_axes(cfg.norm_type),
+            "bias": ("vocab",),
+        },
+        "binary_head": {"w": ("embed", None), "b": (None,)},
+    }
+
+
+def bert_forward(params, tokens, cfg: ModelConfig, *, tokentype_ids=None,
+                 padding_mask=None, rng=None, deterministic: bool = True):
+    """tokens [b, s] -> (lm_logits [b, s, V], nsp_logits [b, 2]).
+
+    `padding_mask` [b, s] 1=real: padded positions are excluded from
+    attention via segment isolation (pad gets its own segment)."""
+    from megatron_tpu.config import as_dtype
+    compute_dtype = as_dtype(cfg.compute_dtype)
+    b, s = tokens.shape
+    emb = params["embedding"]
+    x = emb["word_embeddings"][tokens]
+    x = x + emb["position_embeddings"][jnp.arange(s)][None]
+    if tokentype_ids is not None:
+        x = x + emb["tokentype_embeddings"][tokentype_ids]
+    x = x.astype(compute_dtype)
+    x = apply_norm(cfg.norm_type, params["embedding_norm"], x,
+                   cfg.norm_epsilon)
+    seg = None
+    if padding_mask is not None:
+        # real tokens segment 0; each pad position its own segment id
+        seg = jnp.where(padding_mask > 0, 0,
+                        2 + jnp.arange(s)[None, :]).astype(jnp.int32)
+    x, _ = tfm.stack_apply(params["transformer"], x, cfg, causal=False,
+                           segment_ids=seg, rng=rng,
+                           deterministic=deterministic)
+
+    pooled = jnp.tanh(x[:, 0] @ params["pooler"]["w"].astype(compute_dtype)
+                      + params["pooler"]["b"].astype(compute_dtype))
+    nsp_logits = (pooled @ params["binary_head"]["w"].astype(compute_dtype)
+                  + params["binary_head"]["b"].astype(compute_dtype))
+
+    lh = params["lm_head"]
+    y = x @ lh["dense"]["w"].astype(compute_dtype) + \
+        lh["dense"]["b"].astype(compute_dtype)
+    y = jax.nn.gelu(y, approximate=False)
+    y = apply_norm(cfg.norm_type, lh["norm"], y, cfg.norm_epsilon)
+    w_out = params["embedding"]["word_embeddings"].T.astype(compute_dtype)
+    lm_logits = (y @ w_out).astype(jnp.float32) + \
+        lh["bias"].astype(jnp.float32)
+    return lm_logits, nsp_logits.astype(jnp.float32)
+
+
+def bert_loss(params, batch, cfg: ModelConfig, *, rng=None,
+              deterministic: bool = True):
+    """MLM + NSP loss (ref: bert_model.py post_language_model_processing +
+    pretrain_bert.py forward_step). batch: {tokens, labels, loss_mask,
+    tokentype_ids?, padding_mask?, is_random?}."""
+    lm_logits, nsp_logits = bert_forward(
+        params, batch["tokens"], cfg,
+        tokentype_ids=batch.get("tokentype_ids"),
+        padding_mask=batch.get("padding_mask"),
+        rng=rng, deterministic=deterministic)
+    losses = cross_entropy_loss(lm_logits, batch["labels"],
+                                vocab_size=cfg.vocab_size)
+    mask = batch["loss_mask"].astype(jnp.float32)
+    lm_loss = jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    total = lm_loss
+    if "is_random" in batch:
+        nsp = cross_entropy_loss(nsp_logits, batch["is_random"])
+        total = total + jnp.mean(nsp)
+    return total

@@ -1,0 +1,123 @@
+"""T5: encoder-decoder transformer with cross-attention.
+
+TPU-native equivalent of the reference's T5Model
+(ref: megatron/model/t5_model.py — t5_extended_attention_mask,
+T5LMHead :36-60, T5Model :63-198) over the shared transformer stack:
+bidirectional encoder, causal decoder with per-layer cross-attention
+(models/transformer.py `encoder_output=`), shared embedding, tied LM head.
+The reference realizes the encoder/decoder split through
+ModelType.encoder_and_decoder + pipeline split-rank machinery
+(ref: core/parallel_state.py split_rank); here both stacks are plain
+parameter subtrees — the mesh lays them out.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models import transformer as tfm
+from megatron_tpu.models.norms import apply_norm, norm_axes, norm_init
+from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+
+
+def t5_config(**overrides) -> ModelConfig:
+    """t5-base-ish defaults (ref: examples/pretrain_t5 flags)."""
+    base = dict(
+        num_layers=12, hidden_size=768, num_attention_heads=12,
+        vocab_size=32128, seq_length=512, use_rotary_emb=False,
+        use_position_embedding=True, norm_type="layernorm",
+        activation="gelu", use_bias=True, use_post_ln=False,
+        tie_embed_logits=True,
+    )
+    base.update(overrides)
+    return ModelConfig(**base).derived()
+
+
+def t5_init(rng, cfg: ModelConfig, decoder_layers: Optional[int] = None,
+            dtype=jnp.float32):
+    ks = jax.random.split(rng, 5)
+    h = cfg.hidden_size
+    v = cfg.padded_vocab_size
+    std = cfg.init_method_std
+    return {
+        "embedding": {
+            "word_embeddings": jax.random.normal(ks[0], (v, h), dtype) * std,
+            "position_embeddings": jax.random.normal(
+                ks[1], (cfg.max_position_embeddings, h), dtype) * std,
+        },
+        "encoder": tfm.stack_init(ks[2], cfg, dtype=dtype),
+        "encoder_norm": norm_init(cfg.norm_type, h, dtype),
+        "decoder": tfm.stack_init(ks[3], cfg,
+                                  num_layers=decoder_layers or cfg.num_layers,
+                                  dtype=dtype, cross_attn=True),
+        "decoder_norm": norm_init(cfg.norm_type, h, dtype),
+        # T5LMHead bias (tied decode weight, ref: t5_model.py:36-60)
+        "lm_head_bias": jnp.zeros((v,), dtype),
+    }
+
+
+def t5_axes(cfg: ModelConfig):
+    return {
+        "embedding": {"word_embeddings": ("vocab", "embed"),
+                      "position_embeddings": (None, "embed")},
+        "encoder": tfm.stack_axes(cfg),
+        "encoder_norm": norm_axes(cfg.norm_type),
+        "decoder": tfm.stack_axes(cfg, cross_attn=True),
+        "decoder_norm": norm_axes(cfg.norm_type),
+        "lm_head_bias": ("vocab",),
+    }
+
+
+def _embed(params, tokens, cfg, compute_dtype):
+    emb = params["embedding"]
+    s = tokens.shape[1]
+    x = emb["word_embeddings"][tokens] + \
+        emb["position_embeddings"][jnp.arange(s)][None]
+    return x.astype(compute_dtype)
+
+
+def t5_forward(params, enc_tokens, dec_tokens, cfg: ModelConfig, *,
+               enc_padding_mask=None, rng=None, deterministic: bool = True):
+    """-> lm_logits [b, s_dec, V] (ref: t5_model.py:117-170 forward)."""
+    from megatron_tpu.config import as_dtype
+    compute_dtype = as_dtype(cfg.compute_dtype)
+
+    x = _embed(params, enc_tokens, cfg, compute_dtype)
+    seg = None
+    if enc_padding_mask is not None:
+        s = enc_tokens.shape[1]
+        seg = jnp.where(enc_padding_mask > 0, 0,
+                        2 + jnp.arange(s)[None, :]).astype(jnp.int32)
+    enc, _ = tfm.stack_apply(params["encoder"], x, cfg, causal=False,
+                             segment_ids=seg, rng=rng,
+                             deterministic=deterministic)
+    enc = apply_norm(cfg.norm_type, params["encoder_norm"], enc,
+                     cfg.norm_epsilon)
+
+    y = _embed(params, dec_tokens, cfg, compute_dtype)
+    dec, _ = tfm.stack_apply(params["decoder"], y, cfg, causal=True,
+                             encoder_output=enc, rng=rng,
+                             deterministic=deterministic)
+    dec = apply_norm(cfg.norm_type, params["decoder_norm"], dec,
+                     cfg.norm_epsilon)
+
+    w_out = params["embedding"]["word_embeddings"].T.astype(compute_dtype)
+    logits = (dec @ w_out).astype(jnp.float32) + \
+        params["lm_head_bias"].astype(jnp.float32)
+    return logits
+
+
+def t5_loss(params, batch, cfg: ModelConfig, *, rng=None,
+            deterministic: bool = True):
+    """(ref: pretrain_t5.py forward_step): batch {text_enc, text_dec,
+    labels, loss_mask, enc_mask?}."""
+    logits = t5_forward(params, batch["text_enc"], batch["text_dec"], cfg,
+                        enc_padding_mask=batch.get("enc_mask"),
+                        rng=rng, deterministic=deterministic)
+    losses = cross_entropy_loss(logits, batch["labels"],
+                                vocab_size=cfg.vocab_size)
+    mask = batch["loss_mask"].astype(jnp.float32)
+    return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)

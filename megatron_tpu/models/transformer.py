@@ -39,17 +39,24 @@ from megatron_tpu.ops.dropout import dropout as _dropout
 # single layer
 # ---------------------------------------------------------------------------
 
-def layer_init(rng, cfg: ModelConfig, dtype=jnp.float32):
+def layer_init(rng, cfg: ModelConfig, dtype=jnp.float32,
+               cross_attn: bool = False):
     """Norm layout mirrors ref: transformer.py:606-633 —
     pre-LN: input_layernorm + post_attention_layernorm (output_layernorm=Id);
     post-LN: input_layernorm=Id, post_attention_layernorm + output_layernorm;
     parallel_attn drops post_attention_layernorm; parallel_layernorm adds a
     dedicated mlp norm."""
-    k_attn, k_mlp = jax.random.split(rng)
+    k_attn, k_mlp, k_inter = jax.random.split(rng, 3)
     params = {
         "attention": attention_init(k_attn, cfg, dtype),
         "mlp": mlp_init(k_mlp, cfg, dtype),
     }
+    if cross_attn:
+        # decoder cross-attention + its input norm
+        # (ref: transformer.py:664-683,782-794)
+        params["inter_attention"] = attention_init(k_inter, cfg, dtype)
+        params["post_inter_norm"] = norm_init(cfg.norm_type,
+                                              cfg.hidden_size, dtype)
     if not cfg.use_post_ln:
         params["input_norm"] = norm_init(cfg.norm_type, cfg.hidden_size, dtype)
     else:
@@ -61,11 +68,14 @@ def layer_init(rng, cfg: ModelConfig, dtype=jnp.float32):
     return params
 
 
-def layer_axes(cfg: ModelConfig):
+def layer_axes(cfg: ModelConfig, cross_attn: bool = False):
     axes = {
         "attention": attention_axes(cfg),
         "mlp": mlp_axes(cfg),
     }
+    if cross_attn:
+        axes["inter_attention"] = attention_axes(cfg)
+        axes["post_inter_norm"] = norm_axes(cfg.norm_type)
     if not cfg.use_post_ln:
         axes["input_norm"] = norm_axes(cfg.norm_type)
     else:
@@ -91,8 +101,13 @@ def layer_apply(
     rng=None,
     deterministic: bool = True,
     segment_ids=None,
+    causal: bool = True,
+    encoder_output=None,
 ):
     """One transformer layer. x: [b, s, h]. Returns (x, kv_cache).
+
+    `encoder_output` enables the decoder cross-attention sublayer between
+    self-attention and the MLP (ref: transformer.py:782-794).
 
     Residual structure follows ref: transformer.py:754-815 exactly:
       ln_out = input_norm(x)            (Identity when post-LN)
@@ -123,7 +138,7 @@ def layer_apply(
         rope_cos=rope_cos, rope_sin=rope_sin, position_ids=position_ids,
         kv_cache=kv_cache, layer_number=layer_number,
         dropout_rng=r_score, deterministic=deterministic,
-        segment_ids=segment_ids)
+        segment_ids=segment_ids, causal=causal)
 
     if cfg.parallel_attn:
         # Falcon block: no dropout-add after attention
@@ -138,6 +153,15 @@ def layer_apply(
         out = residual + _dropout(r_mlp, mlp_out + attn_out, p_drop)
     else:
         ln_in = residual + _dropout(r_attn, attn_out, p_drop)
+        if encoder_output is not None and "inter_attention" in params:
+            # decoder cross-attention sublayer (ref: transformer.py:782-794)
+            ln_x = apply_norm(cfg.norm_type, params["post_inter_norm"],
+                              ln_in, eps)
+            inter_out, _ = attention_apply(
+                params["inter_attention"], ln_x, cfg,
+                deterministic=deterministic, causal=False,
+                kv_input=encoder_output)
+            ln_in = ln_in + _dropout(r_attn, inter_out, p_drop)
         ln2 = apply_norm(cfg.norm_type, params["post_attn_norm"], ln_in, eps)
         mlp_out = mlp_apply(params["mlp"], ln2, cfg)
         out = ln_in + _dropout(r_mlp, mlp_out, p_drop)
@@ -152,16 +176,17 @@ def layer_apply(
 # ---------------------------------------------------------------------------
 
 def stack_init(rng, cfg: ModelConfig, num_layers: Optional[int] = None,
-               dtype=jnp.float32):
+               dtype=jnp.float32, cross_attn: bool = False):
     """Stacked params with leading 'layers' dim via vmap over per-layer init."""
     n = num_layers if num_layers is not None else cfg.num_layers
     keys = jax.random.split(rng, n)
-    return jax.vmap(lambda k: layer_init(k, cfg, dtype))(keys)
+    return jax.vmap(lambda k: layer_init(k, cfg, dtype,
+                                         cross_attn=cross_attn))(keys)
 
 
-def stack_axes(cfg: ModelConfig):
+def stack_axes(cfg: ModelConfig, cross_attn: bool = False):
     """Logical axes for stacked params: prepend 'layers'."""
-    per_layer = layer_axes(cfg)
+    per_layer = layer_axes(cfg, cross_attn=cross_attn)
     return jax.tree.map(lambda ax: ("layers",) + ax, per_layer,
                         is_leaf=lambda x: isinstance(x, tuple))
 
@@ -187,6 +212,8 @@ def stack_apply(
     deterministic: bool = True,
     layer_offset: int = 0,
     segment_ids=None,
+    causal: bool = True,
+    encoder_output=None,
 ):
     """Apply all (or a pipeline stage's worth of) layers via lax.scan.
 
@@ -207,7 +234,8 @@ def stack_apply(
             p, h, cfg, rope_cos=rope_cos, rope_sin=rope_sin,
             position_ids=position_ids, kv_cache=cache,
             layer_number=lid + 1, hidden_dropout=rate, rng=layer_rng,
-            deterministic=deterministic, segment_ids=segment_ids)
+            deterministic=deterministic, segment_ids=segment_ids,
+            causal=causal, encoder_output=encoder_output)
         return h, new_cache
 
     if cfg.recompute_granularity == "full":

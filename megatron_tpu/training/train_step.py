@@ -63,6 +63,7 @@ def train_step(
     cfg: MegatronConfig,
     rope: Optional[lm.RopeTables] = None,
     wd_mask=None,
+    loss_fn=None,
 ):
     """One full iteration over `num_microbatches` microbatches.
 
@@ -80,11 +81,17 @@ def train_step(
     deterministic = (mcfg.hidden_dropout == 0.0 and mcfg.attention_dropout == 0.0)
 
     def micro_loss(params, mb, mb_rng):
-        loss = lm.loss_fn(params, mb["tokens"], mcfg,
-                          loss_mask=mb["loss_mask"], rope=rope,
-                          rng=mb_rng, deterministic=deterministic,
-                          position_ids=mb.get("position_ids"),
-                          segment_ids=mb.get("segment_ids"))
+        if loss_fn is not None:
+            # pluggable per-microbatch loss — the analogue of the reference's
+            # forward_step_func extension point (ref: training.py:54 pretrain
+            # signature; pretrain_bert.py / pretrain_t5.py forward_step)
+            loss = loss_fn(params, mb, mb_rng)
+        else:
+            loss = lm.loss_fn(params, mb["tokens"], mcfg,
+                              loss_mask=mb["loss_mask"], rope=rope,
+                              rng=mb_rng, deterministic=deterministic,
+                              position_ids=mb.get("position_ids"),
+                              segment_ids=mb.get("segment_ids"))
         # scaled loss for backward (ref: schedules.py:176-186): the optimizer
         # unscales; dividing by n_micro here makes the accumulated grad the
         # mean over microbatches.
@@ -190,7 +197,8 @@ class _MeshContextStep:
             return self._fn(*args, **kwargs)
 
 
-def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True):
+def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True,
+                    loss_fn=None, init_params_fn=None, axes_fn=None):
     """Build the jitted train step, optionally sharded over `mesh`.
 
     With a mesh, parameters/optimizer state get shardings from the model's
@@ -204,11 +212,13 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True):
 
     pipelined = mesh is not None and cfg.parallel.pipeline_parallel > 1
     if pipelined:
+        assert loss_fn is None, (
+            "custom losses are not pipelined yet; use pp=1 for bert/t5")
         fn = functools.partial(pipelined_train_step, cfg=cfg, mesh=mesh,
                                rope=rope, wd_mask=wd_mask)
     else:
         fn = functools.partial(train_step, cfg=cfg, rope=rope,
-                               wd_mask=wd_mask)
+                               wd_mask=wd_mask, loss_fn=loss_fn)
     if mesh is None:
         return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
@@ -218,15 +228,16 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True):
     if rules is None:
         rules = shd.make_logical_rules(cfg.parallel.sequence_parallel)
 
-    axes = lm.model_axes(cfg.model)
+    axes = axes_fn(cfg.model) if axes_fn else lm.model_axes(cfg.model)
     param_sh = shd.tree_logical_to_sharding(mesh, axes, rules)
     scalar_sh = NamedSharding(mesh, P())
     if cfg.parallel.use_distributed_optimizer:
         # ZeRO-1: Adam moments additionally sharded over 'dp'
         # (ref: optimizer/distrib_optimizer.py; see
         # parallel/sharding.py:distributed_opt_sharding)
-        shapes = jax.eval_shape(
+        init = init_params_fn or (
             lambda: lm.model_init(jax.random.PRNGKey(0), cfg.model))
+        shapes = jax.eval_shape(init)
         moment_sh = shd.tree_distributed_opt_sharding(mesh, axes, rules,
                                                       shapes)
     else:
@@ -239,10 +250,10 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True):
     )
     state_sh = TrainState(params=param_sh, opt_state=opt_sh,
                           iteration=scalar_sh)
-    # pytree-prefix sharding: every batch leaf is [n_micro, batch, seq(+1)],
-    # dp-sharded on the batch dim — works for any key set (tokens, loss_mask,
-    # position_ids, segment_ids)
-    batch_sh = NamedSharding(mesh, P(None, "dp", None))
+    # pytree-prefix sharding: every batch leaf is [n_micro, batch, ...],
+    # dp-sharded on the batch dim — rank-2 spec so 2-D leaves (e.g. BERT's
+    # is_random) and 3-D leaves (tokens, masks) both accept it
+    batch_sh = NamedSharding(mesh, P(None, "dp"))
     jitted = jax.jit(
         fn,
         in_shardings=(state_sh, batch_sh, scalar_sh),

@@ -1,0 +1,179 @@
+"""BERT / T5 model-family tests.
+
+Contracts from the reference (SURVEY.md M14, D7): bidirectional encoder
+(future tokens DO influence earlier positions), MLM+NSP losses train, T5
+decoder is causal w.r.t. its own input but attends the full encoder output,
+masked-LM datasets respect the 80/10/10 rule and determinism.
+"""
+import dataclasses as dc
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.models.bert import (bert_config, bert_forward, bert_init,
+                                      bert_loss)
+from megatron_tpu.models.t5 import t5_config, t5_forward, t5_init, t5_loss
+
+
+@pytest.fixture(scope="module")
+def tiny_bert():
+    cfg = bert_config(num_layers=2, hidden_size=64, num_attention_heads=4,
+                      vocab_size=100, seq_length=32,
+                      make_vocab_size_divisible_by=4,
+                      compute_dtype="float32")
+    params = bert_init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+@pytest.fixture(scope="module")
+def tiny_t5():
+    cfg = t5_config(num_layers=2, hidden_size=64, num_attention_heads=4,
+                    vocab_size=100, seq_length=32,
+                    make_vocab_size_divisible_by=4, compute_dtype="float32")
+    params = t5_init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+class TestBert:
+    def test_bidirectional(self, tiny_bert):
+        """Changing a LATER token changes EARLIER positions' outputs —
+        impossible under a causal mask."""
+        params, cfg = tiny_bert
+        a = jnp.asarray([[5, 6, 7, 8, 9, 10]])
+        b = a.at[0, 5].set(55)
+        la, _ = bert_forward(params, a, cfg)
+        lb, _ = bert_forward(params, b, cfg)
+        assert np.abs(np.asarray(la)[0, 0] - np.asarray(lb)[0, 0]).max() > 1e-4
+
+    def test_padding_isolation(self, tiny_bert):
+        """Padded positions must not affect real positions."""
+        params, cfg = tiny_bert
+        toks = jnp.asarray([[5, 6, 7, 0, 0, 0]])
+        toks2 = jnp.asarray([[5, 6, 7, 93, 94, 95]])
+        mask = jnp.asarray([[1, 1, 1, 0, 0, 0]])
+        la, _ = bert_forward(params, toks, cfg, padding_mask=mask)
+        lb, _ = bert_forward(params, toks2, cfg, padding_mask=mask)
+        np.testing.assert_allclose(np.asarray(la)[0, :3],
+                                   np.asarray(lb)[0, :3],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_mlm_nsp_loss_trains(self, tiny_bert):
+        params, cfg = tiny_bert
+        rng = np.random.default_rng(0)
+        batch = {
+            "tokens": jnp.asarray(rng.integers(0, 100, (2, 16))),
+            "labels": jnp.asarray(rng.integers(0, 100, (2, 16))),
+            "loss_mask": jnp.asarray((rng.random((2, 16)) < 0.2)
+                                     .astype(np.float32)),
+            "is_random": jnp.asarray([0, 1]),
+            "padding_mask": jnp.ones((2, 16), jnp.int32),
+        }
+        loss, grads = jax.value_and_grad(
+            lambda p: bert_loss(p, batch, cfg))(params)
+        assert np.isfinite(float(loss))
+        gn = sum(float(jnp.sum(jnp.abs(g))) for g in jax.tree.leaves(grads))
+        assert gn > 0
+
+
+class TestT5:
+    def test_decoder_causal_encoder_visible(self, tiny_t5):
+        """Decoder position t must see encoder fully but not its own
+        future."""
+        params, cfg = tiny_t5
+        enc = jnp.asarray([[5, 6, 7, 8]])
+        dec_a = jnp.asarray([[1, 10, 11, 12]])
+        dec_b = dec_a.at[0, 3].set(55)  # change last decoder token
+        la = t5_forward(params, enc, dec_a, cfg)
+        lb = t5_forward(params, enc, dec_b, cfg)
+        # earlier decoder positions unchanged (causal)
+        np.testing.assert_allclose(np.asarray(la)[0, :3],
+                                   np.asarray(lb)[0, :3], rtol=1e-5,
+                                   atol=1e-6)
+        # changing the ENCODER changes all decoder positions (cross-attn)
+        enc2 = enc.at[0, 0].set(50)
+        lc = t5_forward(params, enc2, dec_a, cfg)
+        assert np.abs(np.asarray(la) - np.asarray(lc)).max() > 1e-4
+
+    def test_t5_loss_trains(self, tiny_t5):
+        params, cfg = tiny_t5
+        rng = np.random.default_rng(0)
+        batch = {
+            "text_enc": jnp.asarray(rng.integers(0, 100, (2, 12))),
+            "text_dec": jnp.asarray(rng.integers(0, 100, (2, 8))),
+            "labels": jnp.asarray(rng.integers(0, 100, (2, 8))),
+            "loss_mask": jnp.ones((2, 8), jnp.float32),
+        }
+        loss, grads = jax.value_and_grad(
+            lambda p: t5_loss(p, batch, cfg))(params)
+        assert np.isfinite(float(loss))
+        # cross-attention params received gradient
+        g = grads["decoder"]["inter_attention"]["wkv"]
+        assert float(jnp.sum(jnp.abs(g))) > 0
+
+
+class TestMaskedDatasets:
+    def _corpus(self, tmp_path, n=30):
+        from megatron_tpu.data.indexed_dataset import IndexedDatasetBuilder, \
+            MMapIndexedDataset
+        rng = np.random.default_rng(0)
+        prefix = str(tmp_path / "mlm")
+        b = IndexedDatasetBuilder(prefix)
+        for _ in range(n):
+            b.add_item(rng.integers(5, 90, rng.integers(20, 60)).tolist())
+            b.end_document()
+        b.finalize()
+        return MMapIndexedDataset(prefix)
+
+    def test_masked_lm_predictions(self):
+        from megatron_tpu.data.masked_dataset import \
+            create_masked_lm_predictions
+        tokens = np.arange(10, 110)
+        rng = np.random.RandomState(0)
+        masked, labels, loss_mask = create_masked_lm_predictions(
+            tokens, vocab_size=200, mask_id=3, rng=rng)
+        n_pred = int(loss_mask.sum())
+        assert 10 <= n_pred <= 20  # ~15% of 100
+        # labels hold originals at predicted positions
+        idx = np.where(loss_mask > 0)[0]
+        np.testing.assert_array_equal(labels[idx], tokens[idx])
+        # most predicted positions are [MASK]
+        assert (masked[idx] == 3).mean() > 0.5
+        # unpredicted positions untouched
+        rest = np.where(loss_mask == 0)[0]
+        np.testing.assert_array_equal(masked[rest], tokens[rest])
+
+    def test_bert_dataset(self, tmp_path):
+        from megatron_tpu.data.masked_dataset import BertDataset
+        ds = BertDataset(self._corpus(tmp_path), num_samples=20,
+                         max_seq_length=64, vocab_size=100, cls_id=1,
+                         sep_id=2, mask_id=3, pad_id=0)
+        s = ds[0]
+        assert s["tokens"].shape == (64,)
+        assert s["tokens"][0] == 1  # [CLS]
+        assert s["is_random"] in (0, 1)
+        assert s["loss_mask"].sum() > 0
+        # deterministic per index
+        s2 = ds[0]
+        np.testing.assert_array_equal(s["tokens"], s2["tokens"])
+        # tokentypes: 0 then 1
+        tt = s["tokentype_ids"][s["padding_mask"] > 0]
+        assert tt[0] == 0 and tt[-1] == 1
+
+    def test_t5_dataset(self, tmp_path):
+        from megatron_tpu.data.masked_dataset import T5Dataset
+        sentinels = list(range(90, 100))
+        ds = T5Dataset(self._corpus(tmp_path), num_samples=20,
+                       max_seq_length=64, max_seq_length_dec=32,
+                       vocab_size=100, sentinel_ids=sentinels,
+                       bos_id=1, eos_id=2, pad_id=0)
+        s = ds[0]
+        assert s["text_enc"].shape == (64,)
+        assert s["text_dec"][0] == 1  # BOS
+        # decoder contains at least one sentinel
+        assert np.isin(s["text_dec"], sentinels).any()
+        # labels are decoder shifted left
+        nd = int(s["loss_mask"].sum())
+        np.testing.assert_array_equal(s["labels"][:nd - 1],
+                                      s["text_dec"][1:nd])
