@@ -30,6 +30,7 @@ from megatron_tpu.models.norms import apply_norm, norm_axes, norm_init
 from megatron_tpu.models.rope import precompute_freqs
 from megatron_tpu.ops.cross_entropy import cross_entropy_loss
 from megatron_tpu.ops.dropout import dropout
+from megatron_tpu.parallel.sharding import constrain
 
 
 def model_init(rng, cfg: ModelConfig, dtype=None):
@@ -117,6 +118,9 @@ def model_forward(
     if rng is not None and not deterministic and cfg.hidden_dropout > 0.0:
         rng, r_emb = jax.random.split(rng)
         x = dropout(r_emb, x, cfg.hidden_dropout)
+    # SP: scatter the embedding output along seq (ref: language_model.py:
+    # 255-258 scatter_to_sequence_parallel_region); no-op without a mesh ctx
+    x = constrain(x, tfm.RESIDUAL_AXES)
 
     x, kv_caches = tfm.stack_apply(
         params["transformer"], x, cfg,
@@ -126,13 +130,18 @@ def model_forward(
         rng=rng, deterministic=deterministic, segment_ids=segment_ids)
 
     x = apply_norm(cfg.norm_type, params["final_norm"], x, cfg.norm_epsilon)
+    # gather seq from 'tp' before the vocab-parallel LM head: logits shard
+    # the vocab dim over 'tp', so the seq dim must come off it (the SP
+    # gather the reference places before parallel_lm_logits,
+    # ref: language_model.py:24-53 + mappings.py:191-230)
+    x = constrain(x, ("batch", "seq", "act_embed"))
 
     if cfg.tie_embed_logits:
         w_out = params["embedding"]["word_embeddings"].T
     else:
         w_out = params["lm_head"]
     logits = (x @ w_out.astype(compute_dtype)).astype(logits_dtype)
-    return logits, kv_caches
+    return constrain(logits, ("batch", "seq", "vocab")), kv_caches
 
 
 def loss_fn(

@@ -33,6 +33,12 @@ from megatron_tpu.models.attention import attention_apply, attention_axes, atten
 from megatron_tpu.models.mlp import mlp_apply, mlp_axes, mlp_init
 from megatron_tpu.models.norms import apply_norm, norm_axes, norm_init
 from megatron_tpu.ops.dropout import dropout as _dropout
+from megatron_tpu.parallel.sharding import constrain
+
+# Residual-stream activations between TP blocks live seq-sharded when
+# sequence parallelism is on (ref: layers.py:225-296 — the SP all-gather/
+# reduce-scatter pair); `constrain` is a no-op outside a mesh context.
+RESIDUAL_AXES = ("batch", "seq_sp", "act_embed")
 
 
 # ---------------------------------------------------------------------------
@@ -152,7 +158,8 @@ def layer_apply(
         mlp_out = mlp_apply(params["mlp"], mlp_in, cfg)
         out = residual + _dropout(r_mlp, mlp_out + attn_out, p_drop)
     else:
-        ln_in = residual + _dropout(r_attn, attn_out, p_drop)
+        ln_in = constrain(residual + _dropout(r_attn, attn_out, p_drop),
+                          RESIDUAL_AXES)
         if encoder_output is not None and "inter_attention" in params:
             # decoder cross-attention sublayer (ref: transformer.py:782-794)
             ln_x = apply_norm(cfg.norm_type, params["post_inter_norm"],
@@ -168,7 +175,7 @@ def layer_apply(
 
     if cfg.use_post_ln:
         out = apply_norm(cfg.norm_type, params["output_norm"], out, eps)
-    return out, kv_cache
+    return constrain(out, RESIDUAL_AXES), kv_cache
 
 
 # ---------------------------------------------------------------------------

@@ -18,11 +18,14 @@ available; both share this module's interface:
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
 
 DEFAULT_BLOCK_KV = 512
+logger = logging.getLogger(__name__)
+_warned_shapes = set()
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_kv", "use_pallas"))
@@ -33,7 +36,15 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas and (q.shape[1] % 128 != 0 or k.shape[1] % 128 != 0):
         # kernel blocks need 128-divisible sequence lengths; odd shapes take
-        # the XLA blockwise path
+        # the XLA blockwise path. Warn once per shape — this is a perf cliff,
+        # not a correctness issue.
+        key = (q.shape, k.shape)
+        if key not in _warned_shapes:
+            _warned_shapes.add(key)
+            logger.warning(
+                "flash_attention: seq lengths %s/%s not 128-divisible; "
+                "falling back to the (slower) XLA blockwise path",
+                q.shape[1], k.shape[1])
         use_pallas = False
     if use_pallas:
         try:

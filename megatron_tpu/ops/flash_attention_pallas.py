@@ -36,6 +36,13 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_KV = 512
 NEG_INF = -1e30
+# Per-row stats (lse, delta) carry a trailing lanes dim: TPU lowering requires
+# the last two block dims be (8k, 128k) or equal to the array dims, so a
+# rank-3 [b, n, s] stat with block (1, 1, bq) cannot lower. Stats are stored
+# [b, n, s, STAT_LANES] with the row value broadcast across lanes (the
+# official jax TPU flash kernel does the same with 128 lanes; 8 == one f32
+# sublane keeps the HBM footprint 16x smaller, which matters at 32k seq).
+STAT_LANES = 8
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
@@ -68,23 +75,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                 jnp.int32, (block_q, block_kv), 1)
             s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
 
-        m_prev = m_ref[:]
-        m_cur = jnp.max(s, axis=-1)
+        m_prev = m_ref[:, :1]                            # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1)
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)                  # [bq, 1]
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        m_ref[:] = m_new
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
     @pl.when(ki == num_kv - 1)
     def _finalize():
-        l = l_ref[:]
+        l = l_ref[:, :1]
         l_safe = jnp.where(l > 0.0, l, 1.0)
-        o_ref[0, 0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_ref[:] + jnp.log(l_safe)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m_ref[:, :1] + jnp.log(l_safe), lse_ref.shape[2:])
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -106,8 +114,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]                       # [bq, 1]
+        delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
@@ -116,10 +124,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
             s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -151,8 +159,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]                       # [bq, 1]
+        delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
@@ -161,13 +169,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
             s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                    # [bq, bkv]
+        p = jnp.exp(s - lse)                             # [bq, bkv]
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -178,12 +186,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _pick_block(s: int, bmax: int) -> int:
+    """Largest block <= bmax that tiles s: the requested block if it divides
+    s exactly, else the largest 128-multiple divisor of s. Handles
+    128-divisible-but-not-512-divisible lengths like 640/768/1280 by
+    shrinking instead of asserting."""
+    bmax = min(bmax, s)
+    if s % bmax == 0:
+        return bmax
+    for b in range(bmax - bmax % 128, 0, -128):
+        if s % b == 0:
+            return b
+    raise ValueError(
+        f"sequence length {s} has no 128-multiple block divisor <= {bmax}; "
+        "pad the sequence to a multiple of 128 or use the XLA fallback path")
+
+
 def _pick_blocks(sq, sk, block_q, block_kv):
-    bq = min(block_q, sq)
-    bkv = min(block_kv, sk)
-    assert sq % bq == 0 and sk % bkv == 0, (
-        f"seq lengths ({sq},{sk}) must divide into blocks ({bq},{bkv})")
-    return bq, bkv
+    return _pick_block(sq, block_q), _pick_block(sk, block_kv)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -213,7 +233,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
     kv_spec = pl.BlockSpec((1, 1, bkv, d),
                            lambda bi, h, qi, ki: (bi, h // g, ki, 0))
     o_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, h, qi, ki: (bi, h, qi, 0))
-    lse_spec = pl.BlockSpec((1, 1, bq), lambda bi, h, qi, ki: (bi, h, qi))
+    lse_spec = pl.BlockSpec((1, 1, bq, STAT_LANES),
+                            lambda bi, h, qi, ki: (bi, h, qi, 0))
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
@@ -222,10 +243,10 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
         in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=[o_spec, lse_spec],
         out_shape=[jax.ShapeDtypeStruct((b, nq, sq, d), q.dtype),
-                   jax.ShapeDtypeStruct((b, nq, sq), jnp.float32)],
+                   jax.ShapeDtypeStruct((b, nq, sq, STAT_LANES), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
-                        pltpu.VMEM((bq,), jnp.float32),
-                        pltpu.VMEM((bq,), jnp.float32)],
+                        pltpu.VMEM((bq, STAT_LANES), jnp.float32),
+                        pltpu.VMEM((bq, STAT_LANES), jnp.float32)],
         interpret=interpret,
     )(qT, kT, vT)
     out = out.transpose(0, 2, 1, 3)
@@ -246,14 +267,17 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, dout):
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
     doT = dout.transpose(0, 2, 1, 3)
-    # delta = rowsum(dO * O) [b, nq, sq] (flash-2 backward precomputation)
+    # delta = rowsum(dO * O) [b, nq, sq] (flash-2 backward precomputation),
+    # broadcast to STAT_LANES like the lse residual
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).transpose(0, 2, 1)
+    delta = jnp.broadcast_to(delta[..., None], (b, nq, sq, STAT_LANES))
 
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, h, qi, ki: (bi, h, qi, 0))
     kv_spec = pl.BlockSpec((1, 1, bkv, d),
                            lambda bi, h, qi, ki: (bi, h // g, ki, 0))
-    row_spec = pl.BlockSpec((1, 1, bq), lambda bi, h, qi, ki: (bi, h, qi))
+    row_spec = pl.BlockSpec((1, 1, bq, STAT_LANES),
+                            lambda bi, h, qi, ki: (bi, h, qi, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -274,7 +298,8 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, dout):
                            lambda bi, h, ki, qi: (bi, h, qi, 0))
     kv_spec2 = pl.BlockSpec((1, 1, bkv, d),
                             lambda bi, h, ki, qi: (bi, h // g, ki, 0))
-    row_spec2 = pl.BlockSpec((1, 1, bq), lambda bi, h, ki, qi: (bi, h, qi))
+    row_spec2 = pl.BlockSpec((1, 1, bq, STAT_LANES),
+                             lambda bi, h, ki, qi: (bi, h, qi, 0))
     dk_spec = pl.BlockSpec((1, 1, bkv, d),
                            lambda bi, h, ki, qi: (bi, h, ki, 0))
 

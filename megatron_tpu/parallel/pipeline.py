@@ -211,6 +211,8 @@ def pipeline_loss_fn(
     if loss_mask is None:
         loss_mask = jnp.ones(labels.shape, jnp.float32)
 
+    from megatron_tpu.parallel.sharding import constrain
+
     emb = params["embedding"]["word_embeddings"]
     x = emb[inputs].astype(compute_dtype)  # [n_micro, b, s, h]
     if cfg.use_position_embedding:
@@ -218,6 +220,9 @@ def pipeline_loss_fn(
                else jnp.arange(inputs.shape[-1]))
         x = x + params["embedding"]["position_embeddings"][pos].astype(
             compute_dtype)
+    # SP: embedding output seq-scattered, mirroring model_forward
+    # (ref: language_model.py:255-258)
+    x = constrain(x, (None, "batch", "seq_sp", "act_embed"))
 
     pp = mesh.shape["pp"]
     staged = stage_params_reshape(params["transformer"], pp)
@@ -230,11 +235,15 @@ def pipeline_loss_fn(
 
     from megatron_tpu.models.norms import apply_norm
     x = apply_norm(cfg.norm_type, params["final_norm"], x, cfg.norm_epsilon)
+    # gather seq off 'tp' before the vocab-parallel LM head, then shard
+    # logits on vocab — mirrors model_forward's constraints exactly
+    x = constrain(x, (None, "batch", "seq", "act_embed"))
     if cfg.tie_embed_logits:
         w_out = params["embedding"]["word_embeddings"].T
     else:
         w_out = params["lm_head"]
     logits = (x @ w_out.astype(compute_dtype)).astype(jnp.float32)
+    logits = constrain(logits, (None, "batch", "seq", "vocab"))
     losses = cross_entropy_loss(logits, labels, vocab_size=cfg.vocab_size)
     loss_mask = loss_mask.astype(losses.dtype)
     return jnp.sum(losses * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)

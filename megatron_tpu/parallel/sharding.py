@@ -23,6 +23,8 @@ mesh axes. XLA then inserts exactly the collectives the reference hand-codes:
 """
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Optional
 
 import jax
@@ -57,7 +59,13 @@ def make_logical_rules(sequence_parallel: bool = False):
         ("mlp", TENSOR_AXIS),
         ("vocab", TENSOR_AXIS),
         ("seq", CONTEXT_AXIS),
-        ("seq_sp", TENSOR_AXIS if sequence_parallel else None),
+        # Megatron-SP: the residual-stream sequence dim is sharded over 'tp'
+        # outside attention/MLP (ref: core/tensor_parallel/layers.py:225-296,
+        # mappings.py:191-246). With context parallelism the same dim is
+        # additionally split over 'cp' (ring attention), so the full rule is
+        # ('cp','tp') when SP is on and 'cp' alone when it is off.
+        ("seq_sp", (CONTEXT_AXIS, TENSOR_AXIS) if sequence_parallel
+         else CONTEXT_AXIS),
         ("embed", None),
         ("act_embed", None),
         ("head_dim", None),
@@ -100,6 +108,44 @@ def with_sharding(x, mesh: Mesh, logical_axes: tuple, rules):
     scatter/gather mapping functions (ref: mappings.py:253-278)."""
     return jax.lax.with_sharding_constraint(
         x, logical_sharding(mesh, logical_axes, rules))
+
+
+# ---------------------------------------------------------------------------
+# Activation-sharding context: lets pure model code place
+# with_sharding_constraint hints without threading a mesh through every call.
+#
+# make_train_step enters the context around tracing; model code calls
+# `constrain(x, logical_axes)`, a no-op outside the context (single-device
+# runs, inference decode). This is how sequence parallelism becomes REAL: the
+# residual stream is pinned to [b, s/(cp*tp), h] between TP blocks, and GSPMD
+# inserts the all-gather on entry to QKV/MLP-in and the reduce-scatter on
+# exit of the row-parallel projections — exactly the collective placement the
+# reference hand-codes (ref: layers.py:225-296, mappings.py:191-246).
+# ---------------------------------------------------------------------------
+
+_ACT_CTX = threading.local()
+
+
+@contextlib.contextmanager
+def activation_shardings(mesh: Mesh, rules):
+    prev = getattr(_ACT_CTX, "cur", None)
+    _ACT_CTX.cur = (mesh, rules)
+    try:
+        yield
+    finally:
+        _ACT_CTX.cur = prev
+
+
+def constrain(x, logical_axes: tuple):
+    """Pin activation `x` to the sharding its logical axes imply, if an
+    activation-sharding context is active; identity otherwise."""
+    cur = getattr(_ACT_CTX, "cur", None)
+    if cur is None:
+        return x
+    mesh, rules = cur
+    if all(a is None for a in logical_to_spec(logical_axes, rules)):
+        return x
+    return with_sharding(x, mesh, logical_axes, rules)
 
 
 def distributed_opt_sharding(mesh: Mesh, logical_axes: tuple, rules,

@@ -228,6 +228,15 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True,
     if rules is None:
         rules = shd.make_logical_rules(cfg.parallel.sequence_parallel)
 
+    # run tracing under the activation-sharding context so model-level
+    # `constrain` calls (sequence parallelism, logits vocab sharding) become
+    # real with_sharding_constraint ops — see parallel/sharding.py
+    base_fn = fn
+
+    def fn(*args, **kwargs):
+        with shd.activation_shardings(mesh, rules):
+            return base_fn(*args, **kwargs)
+
     axes = axes_fn(cfg.model) if axes_fn else lm.model_axes(cfg.model)
     param_sh = shd.tree_logical_to_sharding(mesh, axes, rules)
     scalar_sh = NamedSharding(mesh, P())

@@ -1,0 +1,162 @@
+"""Sequence-parallelism tests — the round-1 VERDICT gate (item 2).
+
+SP must (a) leave the math untouched and (b) ACTUALLY shard the residual
+stream's sequence dim over 'tp' between attention/MLP blocks. The reference
+hand-codes this as an all-gather on entry to every TP linear and a
+reduce-scatter on its exit (ref: megatron/core/tensor_parallel/
+layers.py:225-296, mappings.py:191-246); under GSPMD the same pair must be
+*emitted by the compiler* because model code pins the residual stream to
+[b, s/tp, h] via with_sharding_constraint. These tests assert on the
+compiled HLO, not just on loss values, so SP can never silently regress to
+a no-op again.
+"""
+import dataclasses as dc
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.config import (MegatronConfig, ModelConfig, OptimizerConfig,
+                                 ParallelConfig, TrainingConfig)
+from megatron_tpu.parallel.mesh import build_mesh
+from megatron_tpu.training import init_train_state, make_train_step
+
+
+def sp_cfg(tp: int, sp: bool, *, seq: int = 32, n_devices: int = 8,
+           optimizer: str = "adam"):
+    model = ModelConfig(num_layers=2, hidden_size=64, num_attention_heads=4,
+                        vocab_size=128, seq_length=seq, hidden_dropout=0.0,
+                        attention_dropout=0.0).derived()
+    return MegatronConfig(
+        model=model,
+        optimizer=OptimizerConfig(lr=1e-3, clip_grad=1.0,
+                                  optimizer=optimizer),
+        parallel=ParallelConfig(tensor_parallel=tp, sequence_parallel=sp),
+        training=TrainingConfig(micro_batch_size=n_devices // tp,
+                                global_batch_size=2 * (n_devices // tp),
+                                train_iters=4),
+    ).validate(n_devices=n_devices)
+
+
+def make_batch(cfg, rng_seed=1):
+    n_micro = (cfg.training.global_batch_size
+               // cfg.training.micro_batch_size
+               // cfg.parallel.data_parallel)
+    b = cfg.training.micro_batch_size * cfg.parallel.data_parallel
+    s = cfg.model.seq_length
+    tokens = jax.random.randint(jax.random.PRNGKey(rng_seed),
+                                (n_micro, b, s + 1), 0, cfg.model.vocab_size)
+    return {"tokens": tokens, "loss_mask": jnp.ones((n_micro, b, s),
+                                                    jnp.float32)}
+
+
+class TestSequenceParallel:
+    def test_sp_loss_and_params_match_no_sp(self, devices):
+        """SP is a layout change, not a math change: loss and updated params
+        must be identical to sp=False (ref contract: sequence parallelism
+        is exact, not approximate)."""
+        results = []
+        for sp in (False, True):
+            # sgd: Adam's g/sqrt(g^2) normalization turns reassociation noise
+            # on near-zero grads into O(lr) update differences, which would
+            # make a param comparison meaningless
+            cfg = sp_cfg(tp=4, sp=sp, optimizer="sgd")
+            mesh = build_mesh(cfg.parallel)
+            rng = jax.random.PRNGKey(0)
+            state = init_train_state(rng, cfg)
+            step = make_train_step(cfg, mesh=mesh, donate=False)
+            batch = make_batch(cfg)
+            for i in range(2):
+                state, m = step(state, batch, jax.random.fold_in(rng, i))
+            results.append((state, float(m["lm_loss"])))
+        (s_off, loss_off), (s_on, loss_on) = results
+        # reduce-scatter changes the reduction ORDER, not the math: tolerances
+        # cover float32 reassociation only
+        np.testing.assert_allclose(loss_on, loss_off, rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(s_off.params),
+                        jax.tree.leaves(s_on.params)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_sp_emits_resharding_collectives_in_hlo(self, devices):
+        """With sp=True on a tp=8 mesh the compiled module must reshard the
+        residual stream between seq-sharded (outside TP blocks) and
+        heads/mlp-sharded (inside). The reference hand-codes this as an
+        all-gather/reduce-scatter pair (ref: mappings.py:191-246); GSPMD is
+        free to choose the equivalent (cheaper) all-to-all. Either way the
+        collective count must JUMP vs sp=False — if it doesn't, SP is a
+        no-op again (round-1 VERDICT item 2)."""
+        counts = {}
+        for sp in (False, True):
+            cfg = sp_cfg(tp=8, sp=sp)
+            assert cfg.parallel.data_parallel == 1
+            mesh = build_mesh(cfg.parallel)
+            state = init_train_state(jax.random.PRNGKey(0), cfg)
+            step = make_train_step(cfg, mesh=mesh, donate=False)
+            batch = make_batch(cfg)
+            hlo = step.lower(state, batch,
+                             jax.random.PRNGKey(0)).compile().as_text()
+            counts[sp] = {op: hlo.count(op) for op in
+                          ("reduce-scatter", "all-gather", "all-to-all")}
+        resharding_on = (counts[True]["all-to-all"]
+                         + counts[True]["reduce-scatter"])
+        resharding_off = (counts[False]["all-to-all"]
+                          + counts[False]["reduce-scatter"])
+        assert resharding_on >= resharding_off + 2 * 2, (  # >=2 per layer
+            f"sp=True emitted no extra seq-resharding collectives: "
+            f"{counts[True]} vs sp=False {counts[False]}")
+        assert counts[True]["all-gather"] > counts[False]["all-gather"], (
+            f"sp=True must gather the sequence dim entering TP blocks: "
+            f"{counts[True]} vs {counts[False]}")
+
+    def test_sp_shrinks_activation_memory(self, devices):
+        """Per-device temp (activation) memory must shrink when the residual
+        stream is seq-sharded. Uses XLA's memory analysis on the compiled
+        executable; skips if the backend doesn't report it."""
+        sizes = {}
+        for sp in (False, True):
+            cfg = sp_cfg(tp=8, sp=sp, seq=128)
+            mesh = build_mesh(cfg.parallel)
+            state = init_train_state(jax.random.PRNGKey(0), cfg)
+            step = make_train_step(cfg, mesh=mesh, donate=False)
+            batch = make_batch(cfg)
+            compiled = step.lower(state, batch,
+                                  jax.random.PRNGKey(0)).compile()
+            try:
+                mem = compiled.memory_analysis()
+            except Exception:
+                pytest.skip("backend has no memory_analysis")
+            if mem is None or not hasattr(mem, "temp_size_in_bytes"):
+                pytest.skip("backend reports no temp size")
+            sizes[sp] = mem.temp_size_in_bytes
+        assert sizes[True] < sizes[False], (
+            f"sp=True temp {sizes[True]} not smaller than sp=False "
+            f"{sizes[False]}")
+
+    def test_sp_with_pipeline(self, devices):
+        """SP constraints inside the pp shard_map body (partial-manual mode)
+        must compose: pp=2 x tp=4 with sp=True runs and matches sp=False."""
+        losses = {}
+        for sp in (False, True):
+            model = ModelConfig(num_layers=4, hidden_size=64,
+                                num_attention_heads=4, vocab_size=128,
+                                seq_length=32, hidden_dropout=0.0,
+                                attention_dropout=0.0).derived()
+            cfg = MegatronConfig(
+                model=model,
+                optimizer=OptimizerConfig(lr=1e-3, clip_grad=1.0),
+                parallel=ParallelConfig(tensor_parallel=4,
+                                        pipeline_parallel=2,
+                                        sequence_parallel=sp),
+                training=TrainingConfig(micro_batch_size=2,
+                                        global_batch_size=4, train_iters=4),
+            ).validate(n_devices=8)
+            mesh = build_mesh(cfg.parallel)
+            rng = jax.random.PRNGKey(0)
+            state = init_train_state(rng, cfg)
+            step = make_train_step(cfg, mesh=mesh, donate=False)
+            batch = make_batch(cfg)
+            state, m = step(state, batch, rng)
+            losses[sp] = float(m["lm_loss"])
+        np.testing.assert_allclose(losses[True], losses[False], rtol=1e-6)
