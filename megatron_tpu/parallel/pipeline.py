@@ -2,36 +2,50 @@
 
 TPU-native equivalent of the reference's pipeline stack — p2p layer
 (ref: megatron/p2p_communication.py:101-405), 1F1B schedules
-(ref: megatron/schedules.py:213-722), and per-stage model construction
-(ref: megatron/model/transformer.py:844-893 _get_num_layers,
-megatron/training.py:204-219). Mapping:
+(ref: megatron/schedules.py:213-722), virtual-stage interleaving
+(ref: megatron/schedules.py:253-502), and per-stage model construction
+(ref: megatron/model/transformer.py:844-893,1014-1044 _get_num_layers +
+vpp layer offsets; megatron/training.py:204-219). Mapping:
 
 - *Stage partitioning*: the scan-stacked layer params are reshaped to
-  [pp, layers_per_stage, ...] and sharded over 'pp' on dim 0 — the analogue
-  of each pipeline rank owning its contiguous layer slice.
+  [pp, vpp, layers_per_chunk, ...] and sharded over 'pp' on dim 0 — each
+  pipeline rank owns vpp interleaved layer chunks; chunk c of stage s covers
+  layers [(c*pp + s)*Lc, ...), exactly the reference's interleaved offset
+  arithmetic (ref: transformer.py:1014-1044).
 - *P2P send/recv* (batched isend/irecv + shape handshakes) becomes ONE
-  `lax.ppermute` per pipeline tick rotating activations stage i -> i+1.
-  No shape handshake is ever needed: shapes are static under jit.
-- *Schedule*: microbatch j enters stage i at tick t = i + j; the scan runs
-  T = n_micro + pp - 1 ticks (fill + steady + drain). The backward pipeline
-  is DERIVED by jax.grad — reverse-mode turns the forward ppermute rotation
-  into the mirrored backward rotation, giving the fill-drain schedule's
-  backward for free. The reference's hand-written warmup/steady/cooldown
-  bookkeeping (schedules.py:606-722) and `deallocate_output_tensor` /
-  `custom_backward` memory hacks (schedules.py:36-88) have no equivalent:
-  remat policy (`jax.checkpoint` on the stage body) bounds live activations
-  instead.
-- *Bubble*: identical to 1F1B's (pp-1)/(n_micro+pp-1) fill-drain fraction for
-  the forward; peak activation memory is bounded by remat, which on TPU
-  (HBM-rich, recompute-cheap on MXU) is the idiomatic trade. A true
-  interleaved-1F1B (virtual stages, ref: schedules.py:253-502) maps to
-  chunked stage params [pp, vpp, layers/(pp*vpp), ...] with a modulo-chunk
-  schedule — planned on top of this same primitive.
-- *Embedding/LM-head*: computed OUTSIDE the pipelined region, replicated
-  over 'pp' (each pp rank redundantly embeds — cheap — instead of the
-  reference's embedding-group all-reduce of tied-embedding grads,
-  ref: optimizer.py:203-229; with GSPMD the tied-weight grad contributions
-  from first/last "stage" meet automatically because it is one parameter).
+  `lax.ppermute` per pipeline tick rotating all vpp buffers stage i -> i+1
+  around a ring; the pp-1 -> 0 wraparound edge promotes a microbatch to the
+  next virtual chunk. No shape handshake is ever needed: shapes are static
+  under jit.
+- *Schedule*: microbatch j enters the ring at tick j; at tick t, stage s
+  holds microbatch t - s - c*pp in chunk-c's buffer. The scan runs
+  T = n_micro + pp*vpp - 1 ticks (fill + steady + drain). The backward
+  pipeline is DERIVED by jax.grad — reverse-mode turns the forward ppermute
+  rotation into the mirrored backward rotation. The reference's hand-written
+  warmup/steady/cooldown bookkeeping (schedules.py:606-722) and
+  `deallocate_output_tensor` / `custom_backward` memory hacks
+  (schedules.py:36-88) have no equivalent: remat policy (`jax.checkpoint`
+  on the stage body) bounds live activations instead.
+- *Memory*: only the int32 token/position/segment streams are replicated
+  over 'pp' (tiny); embedding lookup happens inside stage 0's tick, so the
+  [n_micro, b, s, h] activation stream is never materialized replicated.
+  The last stage's collected outputs leave the shard_map via an out_spec
+  P('pp') concatenation (no psum of activations), and the LM head + CE run
+  OUTSIDE with the microbatch dim resharded over 'pp' — logits are computed
+  once, with the work spread across pipeline stages, instead of redundantly
+  per stage (the reference computes them on the last stage only while other
+  stages idle in the bubble).
+- *Bubble*: fill-drain fraction (pp*vpp - 1)/(n_micro + pp*vpp - 1) in this
+  lockstep formulation. NOTE an honest divergence from the reference: in a
+  single jitted lockstep schedule, virtual stages do NOT shrink the bubble
+  the way async 1F1B interleaving does (every stage already runs all its
+  chunks every tick); vpp>1 here provides the reference's interleaved
+  layer->stage assignment (checkpoint-layout parity, memory balance) while
+  the bubble lever on TPU is n_micro, which remat makes cheap to raise.
+- *Embedding/LM-head*: the tied embedding is one parameter used inside the
+  shard_map (stage-0 intake) and outside (head); its gradient contributions
+  meet automatically under GSPMD — the reference needs an explicit
+  embedding-group all-reduce (ref: optimizer.py:203-229).
 
 The shard_map is manual over 'pp' ONLY; 'dp'/'cp'/'tp' stay automatic, so
 GSPMD still inserts the TP/SP collectives inside each stage body.
@@ -50,12 +64,10 @@ from megatron_tpu.models import transformer as tfm
 
 
 def stage_params_reshape(stacked_params, pp: int):
-    """[L, ...] stacked layer params -> [pp, L//pp, ...]."""
-    def r(x):
-        L = x.shape[0]
-        assert L % pp == 0, f"num_layers {L} not divisible by pp {pp}"
-        return x.reshape(pp, L // pp, *x.shape[1:])
-    return jax.tree.map(r, stacked_params)
+    """[L, ...] stacked layer params -> [pp, L//pp, ...] (contiguous
+    per-stage slices — stage_params_chunked with a single virtual chunk)."""
+    return jax.tree.map(lambda x: x[:, 0],
+                        stage_params_chunked(stacked_params, pp, 1))
 
 
 def stage_params_flatten(staged_params):
@@ -65,12 +77,36 @@ def stage_params_flatten(staged_params):
         staged_params)
 
 
-def pipeline_apply(
-    staged_params,
-    x_micro,  # [n_micro, b, s, h] activations after embedding
+def stage_params_chunked(stacked_params, pp: int, vpp: int):
+    """[L, ...] -> [pp, vpp, L/(pp*vpp), ...] with the INTERLEAVED
+    assignment: element [s, c] holds layers [(c*pp + s)*Lc, ...) — the
+    reference's virtual-stage layer offsets (ref: transformer.py:1014-1044).
+    """
+    def r(x):
+        L = x.shape[0]
+        assert L % (pp * vpp) == 0, (
+            f"num_layers {L} not divisible by pp*vpp {pp}x{vpp}")
+        Lc = L // (pp * vpp)
+        # reshape [vpp, pp, Lc, ...]: index [c, s, l] = (c*pp + s)*Lc + l
+        return x.reshape(vpp, pp, Lc, *x.shape[1:]).swapaxes(0, 1)
+    return jax.tree.map(r, stacked_params)
+
+
+def _embed(emb_params, tok, cfg: ModelConfig, dtype, pos):
+    """Token (+ absolute position) embedding for one microbatch [b, s]."""
+    x = emb_params["word_embeddings"][tok].astype(dtype)
+    if cfg.use_position_embedding:
+        x = x + emb_params["position_embeddings"][pos].astype(dtype)
+    return x
+
+
+def pipeline_transformer(
+    params,          # full model param tree (embedding used for intake)
+    inputs,          # [n_micro, b, s] int32 token stream
     cfg: ModelConfig,
     mesh,
     *,
+    vpp: int = 1,
     rope_cos=None,
     rope_sin=None,
     rng=None,
@@ -78,92 +114,116 @@ def pipeline_apply(
     position_ids=None,  # [n_micro, b, s] or None
     segment_ids=None,   # [n_micro, b, s] or None
 ):
-    """Run the pipelined transformer stack. Returns [n_micro, b, s, h].
+    """Embed + run the pipelined transformer stack over 'pp'.
 
-    Equivalent of forward_backward_pipelining_without_interleaving's forward
-    half (ref: schedules.py:606-722); its backward half is jax.grad of this.
+    Returns the last stage's outputs [n_micro, b, s, h] (final norm / head /
+    loss are the caller's job). Equivalent of the forward half of the
+    reference's pipelined schedules (ref: schedules.py:253-502,606-722);
+    the backward half is jax.grad of this.
     """
     pp = mesh.shape["pp"]
-    n_micro = x_micro.shape[0]
-    layers_per_stage = cfg.num_layers // pp
-    T = n_micro + pp - 1
+    n_micro, n_b, n_s = inputs.shape
+    Lc = cfg.num_layers // (pp * vpp)
+    T = n_micro + pp * vpp - 1
 
-    def stage_fn(params_1stage, h, pos, seg, stage_idx, tick_rng):
-        """Apply this stage's layer slice (inner scan over its layers)."""
-        return tfm.stack_apply(
-            params_1stage, h, cfg,
-            rope_cos=rope_cos, rope_sin=rope_sin,
-            position_ids=pos, segment_ids=seg,
-            rng=tick_rng, deterministic=deterministic,
-            layer_offset=stage_idx * layers_per_stage)[0]
+    from megatron_tpu.config import as_dtype
+    compute_dtype = as_dtype(cfg.compute_dtype)
+    # The XLA *CPU* SPMD partitioner CHECK-fails on bf16 psum inside
+    # partial-manual regions ("Invalid binary instruction opcode copy"),
+    # which the derived backward's replicated-param cotangents hit. Pay the
+    # f32-boundary cost only there; on TPU the ring runs in compute dtype.
+    boundary_dtype = (jnp.float32 if jax.default_backend() == "cpu"
+                      else compute_dtype)
 
-    compute_dtype = x_micro.dtype
-    # Keep the shard_map boundary in f32: the replicated-input cotangent in
-    # the derived backward is a psum over 'pp', and XLA's CPU partitioner
-    # CHECK-fails on bf16 psum in partial-manual regions (same bug as below).
-    x_micro = x_micro.astype(jnp.float32)
-    n_b, n_s = x_micro.shape[1], x_micro.shape[2]
     if position_ids is None:
         position_ids = jnp.broadcast_to(
             jnp.arange(n_s, dtype=jnp.int32), (n_micro, n_b, n_s))
     if segment_ids is None:
         segment_ids = jnp.zeros((n_micro, n_b, n_s), jnp.int32)
 
-    def per_stage(params_shard, x_all, pos_all, seg_all):
-        # inside shard_map: params_shard [1, layers_per_stage, ...]; x_all is
-        # the full microbatch stream (replicated over 'pp')
-        x_all = x_all.astype(compute_dtype)
-        params_1 = jax.tree.map(lambda p: p[0], params_shard)
+    chunked = stage_params_chunked(params["transformer"], pp, vpp)
+    emb_params = params["embedding"]
+
+    # separate rng streams for embedding dropout (per microbatch) and layer
+    # dropout (per tick/chunk) so the folds can't collide
+    rng_emb = rng_layers = None
+    if rng is not None and not deterministic:
+        rng_emb, rng_layers = jax.random.split(rng)
+
+    def per_stage(emb_p, chunk_shard, inp_all, pos_all, seg_all):
+        # inside shard_map: chunk_shard [1, vpp, Lc, ...]; token/pos/seg
+        # streams are replicated over 'pp' (int32 — tiny)
+        chunks = jax.tree.map(lambda p: p[0], chunk_shard)  # [vpp, Lc, ...]
         stage = jax.lax.axis_index("pp")
         is_first = stage == 0
         is_last = stage == pp - 1
-        perm = [(i, i + 1) for i in range(pp - 1)]
+        ring = [(i, (i + 1) % pp) for i in range(pp)]
 
         def tick(carry, t):
-            buf, outputs = carry
-            # first stage pulls microbatch t from the host stream (clamped;
-            # out-of-range ticks do garbage work that is masked at collect)
-            mb_idx = jnp.clip(t, 0, n_micro - 1)
-            mb_in = jax.lax.dynamic_index_in_dim(x_all, mb_idx, axis=0,
-                                                 keepdims=False)
-            # pos/seg ids for the microbatch THIS STAGE is processing at
-            # tick t: stage s works on microbatch t - s
-            my_mb = jnp.clip(t - stage, 0, n_micro - 1)
-            pos = jax.lax.dynamic_index_in_dim(pos_all, my_mb, axis=0,
-                                               keepdims=False)
-            seg = jax.lax.dynamic_index_in_dim(seg_all, my_mb, axis=0,
-                                               keepdims=False)
-            h = jnp.where(is_first, mb_in, buf)
-            tick_rng = (jax.random.fold_in(rng, t)
-                        if rng is not None and not deterministic else None)
-            out = stage_fn(params_1, h, pos, seg, stage, tick_rng)
-            # collect finished microbatch on the last stage
-            out_idx = t - (pp - 1)
+            bufs, outputs = carry  # bufs [vpp, b, s, h]; outputs [n, b,s,h]
+            # stage-0 chunk-0 intake: embed microbatch t (clamped; garbage
+            # ticks are masked at collect)
+            mb_in = jnp.clip(t, 0, n_micro - 1)
+            tok = jax.lax.dynamic_index_in_dim(inp_all, mb_in, 0, False)
+            pos_in = jax.lax.dynamic_index_in_dim(pos_all, mb_in, 0, False)
+            x0 = _embed(emb_p, tok, cfg, compute_dtype, pos_in)
+            if rng_emb is not None and cfg.hidden_dropout > 0.0:
+                # embedding-output dropout, matching the sequential path
+                # (model_forward, language_model.py:117-120; ref:
+                # language_model.py:255-258 forked-RNG embedding dropout)
+                from megatron_tpu.ops.dropout import dropout as _drop
+                x0 = _drop(jax.random.fold_in(rng_emb, mb_in), x0,
+                           cfg.hidden_dropout)
+            ins = bufs.at[0].set(
+                jnp.where(is_first, x0.astype(boundary_dtype), bufs[0]))
+
+            def chunk_body(_, xs):
+                cp, h_in, c = xs
+                # chunk c of stage s processes microbatch t - s - c*pp
+                my_mb = jnp.clip(t - stage - c * pp, 0, n_micro - 1)
+                pos = jax.lax.dynamic_index_in_dim(pos_all, my_mb, 0, False)
+                seg = jax.lax.dynamic_index_in_dim(seg_all, my_mb, 0, False)
+                offset = (c * pp + stage) * Lc
+                tick_rng = None
+                if rng_layers is not None:
+                    tick_rng = jax.random.fold_in(rng_layers, t * vpp + c)
+                out = tfm.stack_apply(
+                    cp, h_in.astype(compute_dtype), cfg,
+                    rope_cos=rope_cos, rope_sin=rope_sin,
+                    position_ids=pos, segment_ids=seg,
+                    rng=tick_rng, deterministic=deterministic,
+                    layer_offset=offset)[0]
+                return None, out.astype(boundary_dtype)
+
+            _, outs = jax.lax.scan(chunk_body, None,
+                                   (chunks, ins, jnp.arange(vpp)))
+
+            # collect the microbatch finishing its last hop (stage pp-1,
+            # chunk vpp-1) at this tick
+            out_idx = t - (pp * vpp - 1)
             valid = is_last & (out_idx >= 0)
             outputs = jax.lax.cond(
                 valid,
                 lambda o: jax.lax.dynamic_update_index_in_dim(
-                    o, out, jnp.clip(out_idx, 0, n_micro - 1), axis=0),
+                    o, outs[vpp - 1], jnp.clip(out_idx, 0, n_micro - 1), 0),
                 lambda o: o,
                 outputs)
-            # rotate activations stage i -> i+1 (the p2p send/recv)
-            buf_next = jax.lax.ppermute(out, "pp", perm) if pp > 1 else out
-            return (buf_next, outputs), None
+            # rotate all chunk buffers one stage down the ring; the
+            # wraparound edge pp-1 -> 0 carries chunk c into chunk c+1
+            # (the roll below); stage 0's buffer 0 is refilled by intake.
+            rotated = jax.lax.ppermute(outs, "pp", ring) if pp > 1 else outs
+            shifted = jnp.where(is_first, jnp.roll(rotated, 1, axis=0),
+                                rotated) if vpp > 1 else rotated
+            return (shifted, outputs), None
 
-        buf0 = jnp.zeros_like(x_all[0])
-        outputs0 = jnp.zeros_like(x_all)
-        (_, outputs), _ = jax.lax.scan(
-            tick, (buf0, outputs0), jnp.arange(T))
-        # replicate the last stage's outputs to every pp rank so the
-        # (pp-replicated) LM head can consume them. psum in f32: XLA's CPU
-        # SPMD partitioner CHECK-fails on bf16 psum inside a partial-manual
-        # region ("Invalid binary instruction opcode copy"); f32 psum is also
-        # the numerically safer reduction.
-        dtype = outputs.dtype
-        outputs = jax.lax.psum(
-            jnp.where(is_last, outputs,
-                      jnp.zeros_like(outputs)).astype(jnp.float32), "pp")
-        return outputs.astype(dtype)
+        bufs0 = jnp.zeros((vpp, n_b, n_s, cfg.hidden_size), boundary_dtype)
+        outputs0 = jnp.zeros((n_micro, n_b, n_s, cfg.hidden_size),
+                             boundary_dtype)
+        (_, outputs), _ = jax.lax.scan(tick, (bufs0, outputs0),
+                                       jnp.arange(T))
+        # leave via concatenation over 'pp' (NOT a psum of activations):
+        # the caller slices out the last stage's block
+        return outputs[None]
 
     # Partial-manual shard_map: manual over 'pp' only; dp/cp/tp stay
     # automatic (GSPMD). Constraints of this mode (jax 0.9): must run under
@@ -171,12 +231,14 @@ def pipeline_apply(
     # the caller (train loop / tests) owns both.
     shmap = jax.shard_map(
         per_stage,
-        in_specs=(P("pp"), P(), P(), P()),
-        out_specs=P(),
+        in_specs=(P(), P("pp"), P(), P(), P()),
+        out_specs=P("pp"),
         check_vma=False,
         axis_names={"pp"},
     )
-    return shmap(staged_params, x_micro, position_ids, segment_ids)
+    stacked_out = shmap(emb_params, chunked, inputs, position_ids,
+                        segment_ids)  # [pp, n_micro, b, s, h]
+    return stacked_out[-1].astype(compute_dtype)
 
 
 def pipeline_loss_fn(
@@ -185,6 +247,7 @@ def pipeline_loss_fn(
     cfg: ModelConfig,
     mesh,
     *,
+    vpp: int = 1,
     loss_mask=None,  # [n_micro, b, s]
     rope=None,
     rng=None,
@@ -194,14 +257,18 @@ def pipeline_loss_fn(
 ):
     """Full-model loss with the transformer stack pipelined over 'pp'.
 
-    Embedding / final-norm / LM-head / CE run outside the shard_map,
-    pp-replicated (see module docstring). Returns scalar mean loss over all
-    microbatches — identical semantics to the sequential microbatch scan in
-    training/train_step.py, so pp=1 and pp>1 train identically.
+    Final-norm / LM-head / CE run OUTSIDE the shard_map with the microbatch
+    dim resharded over 'pp' (logits computed once, work spread over stages —
+    see module docstring). Loss is the mean over microbatches of each
+    microbatch's masked mean, matching the sequential train_step and the
+    reference's per-microbatch loss averaging (ref: schedules.py:176-186) —
+    so pp=1 and pp>1 train identically even with non-uniform loss masks.
     """
     from megatron_tpu.config import as_dtype
     from megatron_tpu.models import language_model as lm
+    from megatron_tpu.models.norms import apply_norm
     from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+    from megatron_tpu.parallel.sharding import constrain
 
     if rope is None:
         rope = lm.make_rope(cfg)
@@ -211,39 +278,27 @@ def pipeline_loss_fn(
     if loss_mask is None:
         loss_mask = jnp.ones(labels.shape, jnp.float32)
 
-    from megatron_tpu.parallel.sharding import constrain
-
-    emb = params["embedding"]["word_embeddings"]
-    x = emb[inputs].astype(compute_dtype)  # [n_micro, b, s, h]
-    if cfg.use_position_embedding:
-        pos = (position_ids if position_ids is not None
-               else jnp.arange(inputs.shape[-1]))
-        x = x + params["embedding"]["position_embeddings"][pos].astype(
-            compute_dtype)
-    # SP: embedding output seq-scattered, mirroring model_forward
-    # (ref: language_model.py:255-258)
-    x = constrain(x, (None, "batch", "seq_sp", "act_embed"))
-
-    pp = mesh.shape["pp"]
-    staged = stage_params_reshape(params["transformer"], pp)
-    x = pipeline_apply(
-        staged, x, cfg, mesh,
+    x = pipeline_transformer(
+        params, inputs, cfg, mesh, vpp=vpp,
         rope_cos=rope.cos if rope else None,
         rope_sin=rope.sin if rope else None,
         rng=rng, deterministic=deterministic,
         position_ids=position_ids, segment_ids=segment_ids)
 
-    from megatron_tpu.models.norms import apply_norm
+    # head work spread over the idle-in-the-bubble stages: microbatch dim
+    # resharded onto 'pp'
+    x = constrain(x, ("microbatch", "batch", "seq_sp", "act_embed"))
     x = apply_norm(cfg.norm_type, params["final_norm"], x, cfg.norm_epsilon)
-    # gather seq off 'tp' before the vocab-parallel LM head, then shard
-    # logits on vocab — mirrors model_forward's constraints exactly
-    x = constrain(x, (None, "batch", "seq", "act_embed"))
+    x = constrain(x, ("microbatch", "batch", "seq", "act_embed"))
     if cfg.tie_embed_logits:
         w_out = params["embedding"]["word_embeddings"].T
     else:
         w_out = params["lm_head"]
     logits = (x @ w_out.astype(compute_dtype)).astype(jnp.float32)
-    logits = constrain(logits, (None, "batch", "seq", "vocab"))
+    logits = constrain(logits, ("microbatch", "batch", "seq", "vocab"))
     losses = cross_entropy_loss(logits, labels, vocab_size=cfg.vocab_size)
     loss_mask = loss_mask.astype(losses.dtype)
-    return jnp.sum(losses * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+    # per-microbatch masked mean, then mean over microbatches (== train_step)
+    per_mb = (jnp.sum(losses * loss_mask, axis=(1, 2))
+              / jnp.maximum(jnp.sum(loss_mask, axis=(1, 2)), 1.0))
+    return jnp.mean(per_mb)

@@ -54,6 +54,9 @@ def make_logical_rules(sequence_parallel: bool = False):
         ("batch", DATA_AXIS),
         ("layers", PIPELINE_AXIS),
         ("stage", PIPELINE_AXIS),
+        # microbatch stream dim: resharded over 'pp' for the post-pipeline
+        # LM-head/CE so the head's FLOPs spread across stages
+        ("microbatch", PIPELINE_AXIS),
         ("heads", TENSOR_AXIS),
         ("kv_heads", TENSOR_AXIS),
         ("mlp", TENSOR_AXIS),

@@ -163,6 +163,7 @@ def pipelined_train_step(
     def total_loss(params):
         loss = pipeline_loss_fn(
             params, batch["tokens"], mcfg, mesh,
+            vpp=cfg.parallel.virtual_pipeline_chunks,
             loss_mask=batch.get("loss_mask"), rope=rope,
             rng=None if deterministic else rng,
             deterministic=deterministic,
