@@ -152,7 +152,8 @@ def constrain(x, logical_axes: tuple):
 
 
 def distributed_opt_sharding(mesh: Mesh, logical_axes: tuple, rules,
-                             shape: tuple) -> NamedSharding:
+                             shape: tuple,
+                             pipelined: bool = False) -> NamedSharding:
     """ZeRO-1 optimizer-state sharding (ref: megatron/optimizer/
     distrib_optimizer.py:32-610 DistributedOptimizer).
 
@@ -162,7 +163,16 @@ def distributed_opt_sharding(mesh: Mesh, logical_axes: tuple, rules,
     optimizer-state leaf its parameter's spec PLUS 'dp' on the first
     dimension that is unsharded and dp-divisible. XLA then reduce-scatters
     the grads feeding the update and all-gathers the updated params — the
-    same collectives, derived from the placement (SURVEY.md §7)."""
+    same collectives, derived from the placement (SURVEY.md §7).
+
+    `pipelined`: with pp>1 the non-stacked params (embedding / final norm /
+    lm_head) enter the pipeline shard_map pp-replicated and their grads exit
+    as pp-psums; dp-sharding THEIR moments trips a CHECK in XLA's SPMD
+    partitioner (spmd_partitioner_util.cc partition-group mismatch), so
+    ZeRO sharding is applied to the 'layers'-stacked params only — which at
+    scale is >98% of the state."""
+    if pipelined and "layers" not in logical_axes:
+        return logical_sharding(mesh, logical_axes, rules)
     spec = list(logical_to_spec(logical_axes, rules))
     spec += [None] * (len(shape) - len(spec))
     dp = mesh.shape[DATA_AXIS]
@@ -177,10 +187,11 @@ def distributed_opt_sharding(mesh: Mesh, logical_axes: tuple, rules,
 
 
 def tree_distributed_opt_sharding(mesh: Mesh, logical_tree, rules,
-                                  shape_tree):
+                                  shape_tree, pipelined: bool = False):
     return jax.tree.map(
         lambda ax, sh: distributed_opt_sharding(mesh, ax, rules,
-                                                tuple(sh.shape)),
+                                                tuple(sh.shape),
+                                                pipelined=pipelined),
         logical_tree, shape_tree,
         is_leaf=lambda x: isinstance(x, tuple),
     )

@@ -14,16 +14,20 @@ TPU-native equivalent of megatron/checkpointing.py (ref: :77-140 layout,
   (ref: --finetune, checkpointing.py:568-580).
 
 Differences by design:
-- ONE checkpoint regardless of device layout. The reference writes per-rank
-  `mp_rank_{tp}_{pp}` shards whose contents depend on the parallel config,
-  requiring the offline resharder (ref: tools/checkpoint_util.py) to change
-  tp/pp. Here the tree is saved in logical (unsharded) form and re-laid-out
-  at load by `jax.device_put` against the current mesh — tp/pp/dp resharding
-  is a load-time no-op, which deletes the C3 tool (SURVEY.md §2.7).
+- ONE logical checkpoint regardless of device layout. The reference writes
+  per-rank `mp_rank_{tp}_{pp}` shards whose contents depend on the parallel
+  config, requiring the offline resharder (ref: tools/checkpoint_util.py) to
+  change tp/pp. Here the tree is saved in logical form and re-laid-out at
+  load against the current mesh's shardings — tp/pp/dp resharding is a
+  load-time no-op, which deletes the C3 tool (SURVEY.md §2.7).
 - No CUDA/torch RNG blobs: jax PRNG keys live inside the saved state.
-- Format: one `.npz` per top-level group + a JSON manifest. Single-host
-  multi-chip writes once; a pod-scale orbax backend can slot in behind the
-  same interface.
+- Backend: orbax (TensorStore/OCDBT) — each device writes its own shards,
+  so a dp x pp x tp-sharded 70B state never materializes on one host, and
+  `async_save=True` overlaps the write with training (the iteration only
+  becomes visible in the tracker once the write is durable; see
+  `finalize_async_saves`). The reference's equivalent is the torch.save of
+  a full state dict per rank (ref: checkpointing.py:304-337) — synchronous
+  and layout-bound. Legacy `.npz` checkpoints from round 1 remain readable.
 """
 from __future__ import annotations
 
@@ -41,6 +45,37 @@ from megatron_tpu.training.train_step import TrainState
 from megatron_tpu.utils.logging import print_rank_0
 
 TRACKER = "latest_checkpointed_iteration.txt"
+STATE_DIR = "state"  # orbax pytree directory inside an iteration dir
+
+# one async checkpointer per process; saves are serialized through it
+_ASYNC_CKPTR = None
+_PENDING_TRACKERS: list[tuple[str, str]] = []
+
+
+def _orbax():
+    import orbax.checkpoint as ocp
+    return ocp
+
+
+def _get_async_checkpointer():
+    global _ASYNC_CKPTR
+    if _ASYNC_CKPTR is None:
+        ocp = _orbax()
+        _ASYNC_CKPTR = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return _ASYNC_CKPTR
+
+
+def finalize_async_saves() -> None:
+    """Block until in-flight async saves are durable, then publish their
+    tracker entries. Called automatically before the next save and must be
+    called before process exit (the train loop does)."""
+    global _PENDING_TRACKERS
+    if _ASYNC_CKPTR is not None:
+        _ASYNC_CKPTR.wait_until_finished()
+    for root, tag in _PENDING_TRACKERS:
+        with open(os.path.join(root, TRACKER), "w") as f:
+            f.write(tag)
+    _PENDING_TRACKERS = []
 
 
 def _iter_dir(root: str, iteration: int, release: bool = False) -> str:
@@ -95,26 +130,61 @@ def save_checkpoint(
     iteration: int,
     consumed_samples: int = 0,
     release: bool = False,
+    backend: str = "orbax",
+    async_save: bool = False,
 ) -> str:
-    """(ref: checkpointing.py:243-337 save_checkpoint)"""
+    """(ref: checkpointing.py:243-337 save_checkpoint)
+
+    backend="orbax" (default) writes per-device shards via TensorStore —
+    a sharded state never gathers onto one host. backend="npz" keeps the
+    round-1 single-file format. async_save=True returns once the save is
+    scheduled; the tracker is published by `finalize_async_saves()` (run
+    automatically before the next save), so a crash mid-write can never
+    leave the tracker naming a torn checkpoint."""
+    finalize_async_saves()  # serialize with any in-flight save (all
+    # backends: an npz tracker written now must not be regressed by a
+    # pending async tracker publishing later)
     d = _iter_dir(root, iteration, release)
     os.makedirs(d, exist_ok=True)
-    np.savez(os.path.join(d, "params.npz"), **_flatten(state.params))
+    tag = "release" if release else str(iteration)
+
+    tree = {"params": state.params}
     if state.opt_state is not None and not release:
-        np.savez(os.path.join(d, "opt_state.npz"), **_flatten(state.opt_state))
+        tree["opt_state"] = state.opt_state
+
+    if backend == "orbax":
+        ckptr = _get_async_checkpointer()
+        ocp = _orbax()
+        state_path = os.path.join(os.path.abspath(d), STATE_DIR)
+        ckptr.save(state_path, args=ocp.args.StandardSave(tree), force=True)
+        if async_save:
+            _PENDING_TRACKERS.append((root, tag))
+        else:
+            ckptr.wait_until_finished()
+    elif backend == "npz":
+        np.savez(os.path.join(d, "params.npz"), **_flatten(state.params))
+        if state.opt_state is not None and not release:
+            np.savez(os.path.join(d, "opt_state.npz"),
+                     **_flatten(state.opt_state))
+    else:
+        raise ValueError(f"unknown checkpoint backend {backend!r}")
+
     meta = {
         "iteration": int(iteration),
         "consumed_samples": int(consumed_samples),
         "release": release,
-        "format_version": 1,
+        "has_opt_state": "opt_state" in tree,
+        "format_version": 2 if backend == "orbax" else 1,
     }
     with open(os.path.join(d, "metadata.json"), "w") as f:
         json.dump(meta, f, indent=2)
     with open(os.path.join(d, "config.json"), "w") as f:
         f.write(cfg.to_json())
-    with open(os.path.join(root, TRACKER), "w") as f:
-        f.write("release" if release else str(iteration))
-    print_rank_0(f"saved checkpoint to {d} (iteration {iteration})")
+    if not (backend == "orbax" and async_save):
+        with open(os.path.join(root, TRACKER), "w") as f:
+            f.write(tag)
+    print_rank_0(f"saved checkpoint to {d} (iteration {iteration}"
+                 f"{', async' if async_save else ''})")
     return d
 
 
@@ -148,19 +218,61 @@ def load_checkpoint(
     with open(os.path.join(d, "metadata.json")) as f:
         meta = json.load(f)
 
-    flat_p = dict(np.load(os.path.join(d, "params.npz")))
-    params = _unflatten_like(
-        example_state.params, flat_p,
-        shardings.params if shardings is not None else None)
+    load_optim = (not finetune and not no_load_optim and not release
+                  and example_state.opt_state is not None)
+    state_path = os.path.join(os.path.abspath(d), STATE_DIR)
+    if os.path.isdir(state_path):
+        # orbax sharded restore: each leaf lands directly on its target
+        # sharding — load-time resharding to any tp/pp/dp layout
+        ocp = _orbax()
 
-    opt_state = example_state.opt_state
-    opt_path = os.path.join(d, "opt_state.npz")
-    if (not finetune and not no_load_optim and not release
-            and os.path.exists(opt_path)):
-        flat_o = dict(np.load(opt_path))
-        opt_state = _unflatten_like(
-            example_state.opt_state, flat_o,
-            shardings.opt_state if shardings is not None else None)
+        def abstract(tree, sh_tree):
+            sh_leaves = (jax.tree.leaves(sh_tree) if sh_tree is not None
+                         else [None] * len(jax.tree.leaves(tree)))
+            return jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(tree),
+                [jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+                 for x, s in zip(jax.tree.leaves(tree), sh_leaves)])
+
+        target = {"params": abstract(
+            example_state.params,
+            shardings.params if shardings is not None else None)}
+        # orbax restore targets must match the on-disk structure, so when the
+        # checkpoint carries optimizer state it is restored even if unwanted
+        # (finetune / no_load_optim / params-only callers like the inference
+        # server) and then discarded
+        on_disk_opt = meta.get("has_opt_state", not release)
+        with ocp.StandardCheckpointer() as ckptr:
+            if on_disk_opt:
+                if example_state.opt_state is not None:
+                    target["opt_state"] = abstract(
+                        example_state.opt_state,
+                        shardings.opt_state if shardings is not None
+                        else None)
+                else:
+                    # caller has no opt-state template (e.g. inference):
+                    # build a throwaway target from the saved metadata
+                    saved = ckptr.metadata(state_path)["opt_state"]
+                    target["opt_state"] = jax.tree.map(
+                        lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype),
+                        saved)
+            restored = ckptr.restore(state_path, target)
+        params = restored["params"]
+        opt_state = (restored["opt_state"] if load_optim and on_disk_opt
+                     else example_state.opt_state)
+    else:
+        # legacy round-1 .npz format
+        flat_p = dict(np.load(os.path.join(d, "params.npz")))
+        params = _unflatten_like(
+            example_state.params, flat_p,
+            shardings.params if shardings is not None else None)
+        opt_state = example_state.opt_state
+        opt_path = os.path.join(d, "opt_state.npz")
+        if load_optim and os.path.exists(opt_path):
+            flat_o = dict(np.load(opt_path))
+            opt_state = _unflatten_like(
+                example_state.opt_state, flat_o,
+                shardings.opt_state if shardings is not None else None)
 
     if finetune or release:
         iteration, consumed = 0, 0

@@ -127,70 +127,76 @@ def train(
     interval_iters = 0
     seq_len = cfg.model.seq_length
 
-    while iteration < cfg.training.train_iters:
-        calc.update(consumed_samples)
-        # batch-size rampup: propagate the current microbatch count into the
-        # iterator so the yielded batch matches what we account for below.
-        # Each ramp phase changes the batch shape -> one jit recompile per
-        # phase (bounded by the ramp step count).
-        if hasattr(train_iterator, "num_microbatches"):
-            train_iterator.num_microbatches = calc.num_microbatches
-        batch = next(train_iterator)
-        step_rng = jax.random.fold_in(rng, iteration)
-        timers("train-step", log_level=0).start()
-        state, metrics = step_fn(state, batch, step_rng)
-        jax.block_until_ready(metrics["lm_loss"])
-        timers("train-step").stop()
+    try:
+        while iteration < cfg.training.train_iters:
+            calc.update(consumed_samples)
+            # batch-size rampup: propagate the current microbatch count into the
+            # iterator so the yielded batch matches what we account for below.
+            # Each ramp phase changes the batch shape -> one jit recompile per
+            # phase (bounded by the ramp step count).
+            if hasattr(train_iterator, "num_microbatches"):
+                train_iterator.num_microbatches = calc.num_microbatches
+            batch = next(train_iterator)
+            step_rng = jax.random.fold_in(rng, iteration)
+            timers("train-step", log_level=0).start()
+            state, metrics = step_fn(state, batch, step_rng)
+            jax.block_until_ready(metrics["lm_loss"])
+            timers("train-step").stop()
 
-        iteration += 1
-        interval_iters += 1
-        consumed_samples += calc.global_batch_size
-        if bool(metrics["found_inf"]):
-            skipped_total += 1
-        if not np.isfinite(float(metrics["lm_loss"])):
-            nan_total += 1
+            iteration += 1
+            interval_iters += 1
+            consumed_samples += calc.global_batch_size
+            if bool(metrics["found_inf"]):
+                skipped_total += 1
+            if not np.isfinite(float(metrics["lm_loss"])):
+                nan_total += 1
 
-        if iteration % cfg.training.log_interval == 0:
-            dt = (time.perf_counter() - interval_t0) / max(interval_iters, 1)
-            toks = calc.global_batch_size * seq_len / dt
-            line = training_log(metrics, iteration, consumed_samples, dt, toks,
-                                writer, skipped_total, nan_total)
-            print_rank_0(line)
-            print_rank_0(timers.log())
-            interval_t0 = time.perf_counter()
-            interval_iters = 0
+            if iteration % cfg.training.log_interval == 0:
+                dt = (time.perf_counter() - interval_t0) / max(interval_iters, 1)
+                toks = calc.global_batch_size * seq_len / dt
+                line = training_log(metrics, iteration, consumed_samples, dt, toks,
+                                    writer, skipped_total, nan_total)
+                print_rank_0(line)
+                print_rank_0(timers.log())
+                interval_t0 = time.perf_counter()
+                interval_iters = 0
 
-        if (valid_iterator is not None and cfg.training.eval_interval and
-                iteration % cfg.training.eval_interval == 0):
-            if eval_step_fn is None:
-                eval_step_fn = _make_eval_step(cfg, mesh)
-            results = evaluate(state, valid_iterator, eval_step_fn,
-                               cfg.training.eval_iters)
-            print_rank_0(f"validation at iteration {iteration}: {results}")
-            for k, v in results.items():
-                writer.add_scalar(f"lm-loss-validation/{k}", v, iteration)
+            if (valid_iterator is not None and cfg.training.eval_interval and
+                    iteration % cfg.training.eval_interval == 0):
+                if eval_step_fn is None:
+                    eval_step_fn = _make_eval_step(cfg, mesh)
+                results = evaluate(state, valid_iterator, eval_step_fn,
+                                   cfg.training.eval_iters)
+                print_rank_0(f"validation at iteration {iteration}: {results}")
+                for k, v in results.items():
+                    writer.add_scalar(f"lm-loss-validation/{k}", v, iteration)
 
-        should_save = (save_fn is not None and cfg.training.save_interval and
-                       iteration % cfg.training.save_interval == 0)
-        # exit conditions (ref: training.py:712-748)
-        exiting = False
-        if signals.received:
-            print_rank_0("SIGTERM received: checkpointing and exiting")
-            exiting = True
-        if (cfg.training.exit_interval and
-                iteration % cfg.training.exit_interval == 0):
-            print_rank_0(f"exiting at iteration {iteration} (exit_interval)")
-            exiting = True
-        if cfg.training.exit_duration_in_mins is not None:
-            mins = (time.perf_counter() - t_start) / 60.0
-            if mins > cfg.training.exit_duration_in_mins:
-                print_rank_0(f"exiting after {mins:.1f} min (exit_duration)")
+            should_save = (save_fn is not None and cfg.training.save_interval and
+                           iteration % cfg.training.save_interval == 0)
+            # exit conditions (ref: training.py:712-748)
+            exiting = False
+            if signals.received:
+                print_rank_0("SIGTERM received: checkpointing and exiting")
                 exiting = True
-        if should_save or (exiting and save_fn is not None):
-            save_fn(state, iteration, consumed_samples)
-        if exiting:
-            break
-
+            if (cfg.training.exit_interval and
+                    iteration % cfg.training.exit_interval == 0):
+                print_rank_0(f"exiting at iteration {iteration} (exit_interval)")
+                exiting = True
+            if cfg.training.exit_duration_in_mins is not None:
+                mins = (time.perf_counter() - t_start) / 60.0
+                if mins > cfg.training.exit_duration_in_mins:
+                    print_rank_0(f"exiting after {mins:.1f} min (exit_duration)")
+                    exiting = True
+            if should_save or (exiting and save_fn is not None):
+                save_fn(state, iteration, consumed_samples)
+            if exiting:
+                break
+    finally:
+        # publish any in-flight async checkpoint even on abnormal
+        # exit: the write is durable, only the tracker is pending
+        from megatron_tpu.training.checkpointing import \
+            finalize_async_saves
+        finalize_async_saves()
     writer.flush()
     return state, consumed_samples
 

@@ -249,7 +249,8 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True,
             lambda: lm.model_init(jax.random.PRNGKey(0), cfg.model))
         shapes = jax.eval_shape(init)
         moment_sh = shd.tree_distributed_opt_sharding(mesh, axes, rules,
-                                                      shapes)
+                                                      shapes,
+                                                      pipelined=pipelined)
     else:
         moment_sh = param_sh
     opt_sh = opt.OptState(

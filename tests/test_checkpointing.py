@@ -125,3 +125,152 @@ class TestCheckpointing:
         example = init_train_state(jax.random.PRNGKey(0), cfg)
         state, it, consumed = load_checkpoint(str(tmp_path / "nope"), example)
         assert state is None and it == 0 and consumed == 0
+
+    def test_legacy_npz_backend_roundtrip(self, tmp_path):
+        """Round-1 .npz checkpoints stay readable."""
+        cfg = tiny_cfg()
+        state = init_train_state(jax.random.PRNGKey(0), cfg)
+        save_checkpoint(str(tmp_path), state, cfg, iteration=1,
+                        backend="npz")
+        example = init_train_state(jax.random.PRNGKey(9), cfg)
+        loaded, it, _ = load_checkpoint(str(tmp_path), example)
+        assert it == 1
+        for a, b in zip(jax.tree.leaves(loaded.params),
+                        jax.tree.leaves(state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_async_save_publishes_tracker_on_finalize(self, tmp_path):
+        """async_save defers the tracker until the write is durable: a crash
+        mid-write can never leave the tracker naming a torn checkpoint."""
+        from megatron_tpu.training.checkpointing import finalize_async_saves
+        cfg = tiny_cfg()
+        state = init_train_state(jax.random.PRNGKey(0), cfg)
+        save_checkpoint(str(tmp_path), state, cfg, iteration=5,
+                        consumed_samples=10, async_save=True)
+        finalize_async_saves()
+        assert read_tracker(str(tmp_path)) == "5"
+        example = init_train_state(jax.random.PRNGKey(9), cfg)
+        loaded, it, consumed = load_checkpoint(str(tmp_path), example)
+        assert it == 5 and consumed == 10
+        for a, b in zip(jax.tree.leaves(loaded.params),
+                        jax.tree.leaves(state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestShardedCheckpointing:
+    """VERDICT item 4 gate: save/restore of a dp x pp x tp-sharded state on
+    the 8-CPU mesh, sharded writes (no single-host full-tree materialize),
+    and resume equivalence under resharding."""
+
+    def _sharded_setup(self, tp=2, pp=2, sp=False):
+        from megatron_tpu.config import ParallelConfig
+        from megatron_tpu.parallel.mesh import build_mesh
+        model = ModelConfig(num_layers=4, hidden_size=64,
+                            num_attention_heads=4, vocab_size=128,
+                            seq_length=32).derived()
+        cfg = MegatronConfig(
+            model=model,
+            optimizer=OptimizerConfig(lr=1e-3, clip_grad=1.0),
+            parallel=ParallelConfig(tensor_parallel=tp, pipeline_parallel=pp,
+                                    sequence_parallel=sp,
+                                    use_distributed_optimizer=True),
+            training=TrainingConfig(micro_batch_size=2,
+                                    global_batch_size=4, train_iters=4),
+        ).validate(n_devices=8)
+        mesh = build_mesh(cfg.parallel)
+        return cfg, mesh
+
+    def test_sharded_save_restore_reshard(self, tmp_path, devices):
+        """Save from a tp=2 x pp=2 sharded state; restore into BOTH the same
+        layout and a resharded tp=4 x pp=1 layout — the load-time resharding
+        that replaces the reference's offline checkpoint_util tool."""
+        cfg, mesh = self._sharded_setup(tp=2, pp=2)
+        rng = jax.random.PRNGKey(0)
+        state = init_train_state(rng, cfg)
+        step = make_train_step(cfg, mesh=mesh, donate=False)
+        n_micro = cfg.num_microbatches
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (n_micro, 4, 33), 0, 128)
+        batch = {"tokens": tokens,
+                 "loss_mask": jnp.ones((n_micro, 4, 32), jnp.float32)}
+        state, _ = step(state, batch, rng)  # real sharded state post-update
+
+        save_checkpoint(str(tmp_path), state, cfg, iteration=1,
+                        consumed_samples=4)
+        # orbax sharded layout on disk (no params.npz monolith)
+        import os
+        assert os.path.isdir(tmp_path / "iter_0000001" / "state")
+        assert not os.path.exists(tmp_path / "iter_0000001" / "params.npz")
+
+        # same-layout restore WITH target shardings: leaves land directly on
+        # the tp=2 x pp=2 placement
+        from megatron_tpu.parallel import sharding as shd
+        from megatron_tpu.models import language_model as lm
+        rules = shd.make_logical_rules(False)
+        param_sh = shd.tree_logical_to_sharding(
+            mesh, lm.model_axes(cfg.model), rules)
+        example = init_train_state(jax.random.PRNGKey(9), cfg)
+        loaded, it, consumed = load_checkpoint(
+            str(tmp_path), example,
+            shardings=example._replace(params=param_sh, opt_state=None,
+                                       iteration=None),
+            no_load_optim=True)
+        assert it == 1 and consumed == 4
+        for a, b, sh in zip(jax.tree.leaves(loaded.params),
+                            jax.tree.leaves(state.params),
+                            jax.tree.leaves(param_sh)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            assert a.sharding.is_equivalent_to(sh, a.ndim)
+
+        # resharded restore: tp=4, pp=1 mesh — different layout, same values
+        cfg2, mesh2 = self._sharded_setup(tp=4, pp=1)
+        param_sh2 = shd.tree_logical_to_sharding(
+            mesh2, lm.model_axes(cfg2.model), rules)
+        loaded2, _, _ = load_checkpoint(
+            str(tmp_path), example,
+            shardings=example._replace(params=param_sh2, opt_state=None,
+                                       iteration=None),
+            no_load_optim=True)
+        for a, b, sh in zip(jax.tree.leaves(loaded2.params),
+                            jax.tree.leaves(state.params),
+                            jax.tree.leaves(param_sh2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            assert a.sharding.is_equivalent_to(sh, a.ndim), (
+                f"restored leaf not on requested sharding: {a.sharding}")
+
+    def test_sharded_resume_equivalence(self, tmp_path, devices):
+        """Save mid-run from the sharded step, restore, continue: must equal
+        the uninterrupted sharded run (incl. ZeRO-1 moment shards)."""
+        cfg, mesh = self._sharded_setup(tp=2, pp=2)
+        rng = jax.random.PRNGKey(0)
+        step = make_train_step(cfg, mesh=mesh, donate=False)
+        n_micro = cfg.num_microbatches
+        batches = []
+        for k in range(4):
+            tokens = jax.random.randint(jax.random.PRNGKey(k),
+                                        (n_micro, 4, 33), 0, 128)
+            batches.append({"tokens": tokens,
+                            "loss_mask": jnp.ones((n_micro, 4, 32),
+                                                  jnp.float32)})
+
+        s_full = init_train_state(rng, cfg)
+        for i in range(4):
+            s_full, m_full = step(s_full, batches[i],
+                                  jax.random.fold_in(rng, i))
+
+        s_a = init_train_state(rng, cfg)
+        for i in range(2):
+            s_a, _ = step(s_a, batches[i], jax.random.fold_in(rng, i))
+        save_checkpoint(str(tmp_path), s_a, cfg, iteration=2,
+                        consumed_samples=8)
+        example = init_train_state(jax.random.PRNGKey(7), cfg)
+        s_b, it, _ = load_checkpoint(str(tmp_path), example)
+        for i in range(it, 4):
+            s_b, m_b = step(s_b, batches[i], jax.random.fold_in(rng, i))
+
+        np.testing.assert_allclose(float(m_b["lm_loss"]),
+                                   float(m_full["lm_loss"]), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(s_b.params),
+                        jax.tree.leaves(s_full.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
