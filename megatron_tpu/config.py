@@ -236,6 +236,13 @@ class TrainingConfig:
     no_load_rng: bool = False
     wandb_logger: bool = False
     tensorboard_dir: Optional[str] = None
+    # jax.profiler trace capture over a step window (SURVEY.md §5: the TPU
+    # equivalent of the reference's named-span-only profiling). Traces are
+    # viewable in TensorBoard / Perfetto.
+    profile: bool = False
+    profile_step_start: int = 10
+    profile_step_end: int = 12
+    profile_dir: Optional[str] = None  # defaults to tensorboard_dir or /tmp
 
 
 @dataclass(frozen=True)

@@ -21,6 +21,12 @@ _lock = threading.Lock()
 _lib = None
 
 
+def _compile():
+    subprocess.run(
+        ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+        check=True, capture_output=True)
+
+
 def _load():
     global _lib
     with _lock:
@@ -28,10 +34,13 @@ def _load():
             return _lib
         if (not os.path.exists(_SO)
                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
-                check=True, capture_output=True)
-        lib = ctypes.CDLL(_SO)
+            _compile()
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # stale artifact from a different arch/libc: rebuild from source
+            _compile()
+            lib = ctypes.CDLL(_SO)
         lib.build_sample_idx.argtypes = [
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
             ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,

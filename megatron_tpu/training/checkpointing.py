@@ -237,26 +237,25 @@ def load_checkpoint(
         target = {"params": abstract(
             example_state.params,
             shardings.params if shardings is not None else None)}
-        # orbax restore targets must match the on-disk structure, so when the
-        # checkpoint carries optimizer state it is restored even if unwanted
-        # (finetune / no_load_optim / params-only callers like the inference
-        # server) and then discarded
         on_disk_opt = meta.get("has_opt_state", not release)
-        with ocp.StandardCheckpointer() as ckptr:
-            if on_disk_opt:
-                if example_state.opt_state is not None:
-                    target["opt_state"] = abstract(
-                        example_state.opt_state,
-                        shardings.opt_state if shardings is not None
-                        else None)
-                else:
-                    # caller has no opt-state template (e.g. inference):
-                    # build a throwaway target from the saved metadata
-                    saved = ckptr.metadata(state_path)["opt_state"]
-                    target["opt_state"] = jax.tree.map(
-                        lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype),
-                        saved)
-            restored = ckptr.restore(state_path, target)
+        if load_optim and on_disk_opt:
+            target["opt_state"] = abstract(
+                example_state.opt_state,
+                shardings.opt_state if shardings is not None else None)
+
+        def _restore_args(leaf):
+            return ocp.ArrayRestoreArgs(
+                sharding=getattr(leaf, "sharding", None) or None,
+                global_shape=leaf.shape, dtype=leaf.dtype)
+
+        # partial_restore: unwanted subtrees (optimizer moments for
+        # finetune / inference loads) are never read off disk — a 70B
+        # Adam state must not materialize just to be discarded
+        with ocp.PyTreeCheckpointer() as ckptr:
+            restored = ckptr.restore(state_path, args=ocp.args.PyTreeRestore(
+                item=target,
+                restore_args=jax.tree.map(_restore_args, target),
+                partial_restore=True))
         params = restored["params"]
         opt_state = (restored["opt_state"] if load_optim and on_disk_opt
                      else example_state.opt_state)

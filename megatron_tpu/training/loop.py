@@ -126,6 +126,7 @@ def train(
     interval_t0 = time.perf_counter()
     interval_iters = 0
     seq_len = cfg.model.seq_length
+    trace_active = False
 
     try:
         while iteration < cfg.training.train_iters:
@@ -138,10 +139,22 @@ def train(
                 train_iterator.num_microbatches = calc.num_microbatches
             batch = next(train_iterator)
             step_rng = jax.random.fold_in(rng, iteration)
+            if (cfg.training.profile and not trace_active
+                    and iteration == cfg.training.profile_step_start):
+                jax.profiler.start_trace(cfg.training.profile_dir
+                                         or cfg.training.tensorboard_dir
+                                         or "/tmp/megatron_tpu_trace")
+                trace_active = True
             timers("train-step", log_level=0).start()
             state, metrics = step_fn(state, batch, step_rng)
             jax.block_until_ready(metrics["lm_loss"])
             timers("train-step").stop()
+            if trace_active and iteration >= cfg.training.profile_step_end:
+                jax.profiler.stop_trace()
+                trace_active = False
+                print_rank_0(f"profiler trace written "
+                             f"({cfg.training.profile_step_start}.."
+                             f"{cfg.training.profile_step_end})")
 
             iteration += 1
             interval_iters += 1
@@ -192,6 +205,9 @@ def train(
             if exiting:
                 break
     finally:
+        # flush an in-flight profiler trace so early exits still produce it
+        if trace_active:
+            jax.profiler.stop_trace()
         # publish any in-flight async checkpoint even on abnormal
         # exit: the write is durable, only the tracker is pending
         from megatron_tpu.training.checkpointing import \
@@ -210,26 +226,54 @@ class _nullcontext:
 
 
 def _make_eval_step(cfg: MegatronConfig, mesh=None):
+    """Jitted eval loss with the SAME mesh/sharding treatment as the train
+    step — without in_shardings, eval of a sharded state would re-layout or
+    OOM (round-1 VERDICT item 10). pp>1 evaluates through the pipelined
+    loss so the stage-sharded params are consumed in place."""
     from megatron_tpu.models import language_model as lm
     rope = lm.make_rope(cfg.model)
+    pipelined = mesh is not None and cfg.parallel.pipeline_parallel > 1
 
-    @jax.jit
     def eval_step(params, batch):
         tokens = batch["tokens"]
         n_micro = tokens.shape[0]
-
-        def body(acc, xs):
-            tok, mask = xs
-            loss = lm.loss_fn(params, tok, cfg.model, loss_mask=mask,
-                              rope=rope, deterministic=True)
-            return acc + loss, None
-
         mask = batch.get("loss_mask")
         if mask is None:
             mask = jnp.ones((n_micro, tokens.shape[1], tokens.shape[2] - 1),
                             jnp.float32)
+        if pipelined:
+            from megatron_tpu.parallel.pipeline import pipeline_loss_fn
+            return pipeline_loss_fn(
+                params, tokens, cfg.model, mesh,
+                vpp=cfg.parallel.virtual_pipeline_chunks,
+                loss_mask=mask, rope=rope, deterministic=True)
+
+        def body(acc, xs):
+            tok, m = xs
+            loss = lm.loss_fn(params, tok, cfg.model, loss_mask=m,
+                              rope=rope, deterministic=True)
+            return acc + loss, None
+
         total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
                                 (tokens, mask))
         return total / n_micro
 
-    return eval_step
+    if mesh is None:
+        return jax.jit(eval_step)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from megatron_tpu.parallel import sharding as shd
+    from megatron_tpu.training.train_step import (_MeshContextStep,
+                                                  param_shardings)
+    rules = shd.make_logical_rules(cfg.parallel.sequence_parallel)
+
+    def eval_with_ctx(params, batch):
+        with shd.activation_shardings(mesh, rules):
+            return eval_step(params, batch)
+
+    jitted = jax.jit(
+        eval_with_ctx,
+        in_shardings=(param_shardings(cfg, mesh, rules=rules),
+                      NamedSharding(mesh, P(None, "dp"))),
+    )
+    return _MeshContextStep(jitted, mesh) if pipelined else jitted

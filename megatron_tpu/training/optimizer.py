@@ -77,11 +77,47 @@ def init_optimizer(params, cfg: OptimizerConfig,
     )
 
 
-def weight_decay_mask(params):
-    """True where weight decay applies: >=2-D params only — biases and norm
-    scales are exempt (ref: optimizer/__init__.py:36-42 `no_weight_decay_params`
-    collects bias / ndim==1 tensors)."""
-    return jax.tree.map(lambda p: p.ndim >= 2, params)
+# Param names that never take weight decay, matching the reference's
+# name-based `.bias` exemption plus norm scale/offset
+# (ref: optimizer/__init__.py:36-42 no_weight_decay_params). Needed on top
+# of the rank rule because GLU biases are [2, ffn] (rank 2) by layout.
+_NO_DECAY_NAMES = frozenset(
+    {"b1", "b2", "bq", "bkv", "bo", "bias", "scale", "offset"})
+
+
+def _leaf_name(path) -> str:
+    last = path[-1]
+    for attr in ("key", "name", "idx"):
+        if hasattr(last, attr):
+            return str(getattr(last, attr))
+    return str(last)
+
+
+def weight_decay_mask(params, axes=None):
+    """True where weight decay applies: named biases/norm params are always
+    exempt, and otherwise params that are >=2-D PER LAYER
+    (ref: optimizer/__init__.py:36-42 `no_weight_decay_params` collects
+    bias / ndim==1 tensors).
+
+    `axes`: optional logical-axes tree (same structure, tuple leaves). The
+    scan-stacked transformer params carry a leading 'layers' dim, which must
+    not count toward the rank — a stacked norm scale [L, h] is still a 1-D
+    parameter per layer and stays decay-exempt. Without `axes` the plain
+    ndim rule applies (correct for unstacked trees only)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    if axes is None:
+        ax_leaves = [()] * len(flat)
+    else:
+        ax_leaves = jax.tree.leaves(
+            axes, is_leaf=lambda x: isinstance(x, tuple))
+        assert len(flat) == len(ax_leaves), "params/axes trees differ"
+    mask = []
+    for (path, p), ax in zip(flat, ax_leaves):
+        if _leaf_name(path) in _NO_DECAY_NAMES:
+            mask.append(False)
+        else:
+            mask.append(p.ndim - (1 if "layers" in ax else 0) >= 2)
+    return jax.tree_util.tree_unflatten(treedef, mask)
 
 
 def global_grad_norm(grads) -> jax.Array:

@@ -185,6 +185,16 @@ def pipelined_train_step(
     return new_state, {"lm_loss": loss, "lr": lr, "wd": wd, **ometrics}
 
 
+def param_shardings(cfg: MegatronConfig, mesh, rules=None, axes_fn=None):
+    """NamedShardings for the model param tree on `mesh` — the same mapping
+    make_train_step uses (shared by the eval step and inference)."""
+    from megatron_tpu.parallel import sharding as shd
+    if rules is None:
+        rules = shd.make_logical_rules(cfg.parallel.sequence_parallel)
+    axes = axes_fn(cfg.model) if axes_fn else lm.model_axes(cfg.model)
+    return shd.tree_logical_to_sharding(mesh, axes, rules)
+
+
 class _MeshContextStep:
     """Callable wrapping a jitted step so each call runs with the ambient
     mesh set (required by the partial-manual shard_map inside)."""
@@ -209,7 +219,17 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True,
     step (collective-permute 1F1B, parallel/pipeline.py).
     """
     rope = lm.make_rope(cfg.model)
-    wd_mask = None  # computed per-call from params (cheap, static)
+    # weight-decay mask from logical axes: the stacked 'layers' dim must not
+    # count toward the >=2-D decay rule (a stacked norm scale [L, h] is 1-D
+    # per layer and decay-exempt — ref: optimizer/__init__.py:36-42)
+    axes = axes_fn(cfg.model) if axes_fn else lm.model_axes(cfg.model)
+    init = init_params_fn or (
+        lambda: lm.model_init(jax.random.PRNGKey(0), cfg.model))
+    if loss_fn is not None and axes_fn is None:
+        wd_mask = None  # unknown custom param structure: in-step ndim rule
+    else:
+        # ONE rule source: the shared helper, fed abstract shapes
+        wd_mask = opt.weight_decay_mask(jax.eval_shape(init), axes)
 
     pipelined = mesh is not None and cfg.parallel.pipeline_parallel > 1
     if pipelined:
@@ -238,15 +258,12 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True,
         with shd.activation_shardings(mesh, rules):
             return base_fn(*args, **kwargs)
 
-    axes = axes_fn(cfg.model) if axes_fn else lm.model_axes(cfg.model)
     param_sh = shd.tree_logical_to_sharding(mesh, axes, rules)
     scalar_sh = NamedSharding(mesh, P())
     if cfg.parallel.use_distributed_optimizer:
         # ZeRO-1: Adam moments additionally sharded over 'dp'
         # (ref: optimizer/distrib_optimizer.py; see
         # parallel/sharding.py:distributed_opt_sharding)
-        init = init_params_fn or (
-            lambda: lm.model_init(jax.random.PRNGKey(0), cfg.model))
         shapes = jax.eval_shape(init)
         moment_sh = shd.tree_distributed_opt_sharding(mesh, axes, rules,
                                                       shapes,

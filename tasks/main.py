@@ -1,0 +1,101 @@
+"""Task-evaluation entry point (ref: tasks/main.py).
+
+Usage:
+  python -m tasks.main --task WIKITEXT103 --valid_data wiki.test.tokens \
+      --load <checkpoint_root> --tokenizer_type HFTokenizer \
+      --tokenizer_model <name-or-path> [--overlapping_eval 32]
+  python -m tasks.main --task LAMBADA --valid_data lambada.jsonl \
+      --load <checkpoint_root> [--strict_lambada]
+
+The model config comes from the checkpoint (`use_checkpoint_args`
+semantics, ref: checkpointing.py:476-558); metrics print in the
+reference's schema (ref: tasks/zeroshot_gpt/evaluate.py:146-174).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+from megatron_tpu.utils.platform import ensure_env_platform
+
+
+def get_tasks_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("tasks", description=__doc__)
+    p.add_argument("--task", required=True,
+                   choices=["WIKITEXT103", "LAMBADA"],
+                   help="Task name (ref: tasks/main.py:19).")
+    p.add_argument("--valid_data", nargs="+", required=True)
+    p.add_argument("--load", required=True,
+                   help="checkpoint root (tracker + iter dirs)")
+    p.add_argument("--tokenizer_type", default="HFTokenizer")
+    p.add_argument("--tokenizer_model", default=None)
+    p.add_argument("--vocab_file", default=None)
+    p.add_argument("--merge_file", default=None)
+    p.add_argument("--overlapping_eval", type=int, default=32,
+                   help="sliding-window stride (ref: tasks/main.py:33-34)")
+    p.add_argument("--strict_lambada", action="store_true")
+    p.add_argument("--micro_batch_size", type=int, default=8)
+    p.add_argument("--seq_length", type=int, default=None,
+                   help="override eval window (default: model seq_length)")
+    return p
+
+
+def run_task(args) -> dict:
+    import jax
+
+    from megatron_tpu.data.tokenizers import build_tokenizer
+    from megatron_tpu.training import init_train_state
+    from megatron_tpu.training.checkpointing import (
+        load_checkpoint, load_config_from_checkpoint)
+    from megatron_tpu.training.train_step import TrainState
+    from tasks.zeroshot_gpt import evaluate as ev
+    from tasks.zeroshot_gpt.datasets import (build_lambada_dataset,
+                                             build_wikitext_dataset)
+
+    cfg = load_config_from_checkpoint(args.load)
+    if cfg is None:
+        raise SystemExit(f"no checkpoint found under {args.load}")
+    tokenizer = build_tokenizer(
+        args.tokenizer_type, vocab_file=args.vocab_file,
+        merge_file=args.merge_file, tokenizer_model=args.tokenizer_model)
+
+    example = init_train_state(jax.random.PRNGKey(0), cfg)
+    state, _, _ = load_checkpoint(args.load, example, no_load_optim=True)
+    state = TrainState(params=state.params, opt_state=None,
+                       iteration=state.iteration)
+
+    seq_len = args.seq_length or cfg.model.seq_length
+    path = args.valid_data[0]
+    if args.task == "WIKITEXT103":
+        ds = build_wikitext_dataset(path, tokenizer, seq_len,
+                                    overlapping_eval=args.overlapping_eval)
+        stats = ev.evaluate_dataset(state.params, ds, cfg,
+                                    batch_size=args.micro_batch_size,
+                                    log_every=10)
+        metrics = ev.wikitext_metrics(stats, ds)
+    else:
+        ds = build_lambada_dataset(path, tokenizer, seq_len,
+                                   strict=args.strict_lambada)
+        stats = ev.evaluate_dataset(state.params, ds, cfg,
+                                    batch_size=args.micro_batch_size,
+                                    log_every=10)
+        metrics = ev.lambada_metrics(stats)
+
+    line = f" validation results on {args.task} | " + " | ".join(
+        f"{k}: {v:.4E}" if isinstance(v, float) else f"{k}: {v}"
+        for k, v in metrics.items())
+    print("-" * (len(line) + 1))
+    print(line)
+    print("-" * (len(line) + 1))
+    print(json.dumps({"task": args.task, **metrics}))
+    return metrics
+
+
+def main():
+    ensure_env_platform()
+    args = get_tasks_parser().parse_args()
+    run_task(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""GPT zero-shot evaluation: wikitext-style perplexity and LAMBADA accuracy.
+
+TPU-native equivalent of the reference's zero-shot harness
+(ref: tasks/zeroshot_gpt/evaluate.py). Metric semantics kept exactly:
+
+- 'loss' (WIKITEXT103): sum of per-token CE over pad-masked positions,
+  normalized by (num_tokenized_tokens - 1); ppl = exp(min(20, loss));
+  adjusted ppl re-normalizes by the original-token ratio so numbers are
+  comparable across tokenizers (ref: evaluate.py:149-160).
+- 'accuracy' (LAMBADA): a sample counts as correct iff EVERY masked target
+  token is the argmax prediction (the `correct.prod(-1)` at
+  ref: evaluate.py:105-109).
+
+One jitted forward computes both statistics; the pp/tp-aware path reuses
+the training param shardings. No pipeline send/recv machinery is needed —
+the sharded forward is one program (ref needs recv_forward/send_forward at
+evaluate.py:84-92).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import MegatronConfig
+from megatron_tpu.models import language_model as lm
+from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+from tasks.zeroshot_gpt.datasets import iterate_batches
+
+
+def _make_forward(cfg: MegatronConfig, mesh=None):
+    mcfg = cfg.model
+    rope = lm.make_rope(mcfg)
+
+    def fwd(params, text, pad_mask, valid):
+        tokens = text[:, :-1]
+        labels = text[:, 1:]
+        logits, _ = lm.model_forward(params, tokens, mcfg, rope=rope,
+                                     deterministic=True)
+        losses = cross_entropy_loss(logits, labels,
+                                    vocab_size=mcfg.vocab_size)
+        loss_sum = jnp.sum(losses * pad_mask)
+        preds = jnp.argmax(logits[..., :mcfg.vocab_size], axis=-1)
+        tok_ok = jnp.where(pad_mask > 0, (preds == labels), True)
+        sample_ok = jnp.all(tok_ok, axis=-1).astype(jnp.float32) * valid
+        return loss_sum, jnp.sum(sample_ok)
+
+    if mesh is None:
+        return jax.jit(fwd)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from megatron_tpu.parallel import sharding as shd
+    from megatron_tpu.training.train_step import param_shardings
+    rules = shd.make_logical_rules(cfg.parallel.sequence_parallel)
+
+    def fwd_ctx(params, text, pad_mask, valid):
+        with shd.activation_shardings(mesh, rules):
+            return fwd(params, text, pad_mask, valid)
+
+    dp = NamedSharding(mesh, P("dp"))
+    return jax.jit(fwd_ctx, in_shardings=(
+        param_shardings(cfg, mesh, rules=rules), dp, dp, dp))
+
+
+def evaluate_dataset(params, dataset, cfg: MegatronConfig, *,
+                     batch_size: int = 8, mesh=None,
+                     log_every: Optional[int] = None) -> dict:
+    """Run the full dataset; returns both raw statistics."""
+    fwd = _make_forward(cfg, mesh)
+    loss_sum = 0.0
+    correct_sum = 0.0
+    for i, batch in enumerate(iterate_batches(dataset, batch_size)):
+        ls, ok = fwd(params, jnp.asarray(batch["text"], jnp.int32),
+                     jnp.asarray(batch["pad_mask"]),
+                     jnp.asarray(batch["valid"]))
+        loss_sum += float(ls)
+        correct_sum += float(ok)
+        if log_every and i % log_every == 0:
+            print(f"> zeroshot eval: batch {i}")
+    return {"loss_sum": loss_sum, "correct": correct_sum,
+            "num_examples": len(dataset)}
+
+
+def wikitext_metrics(stats: dict, dataset) -> dict:
+    """(ref: evaluate.py:149-160) — identical schema."""
+    val_loss = stats["loss_sum"] / (dataset.num_tokenized_tokens - 1)
+    ratio = ((dataset.num_tokenized_tokens - 1)
+             / (dataset.num_original_tokens - 1))
+    return {
+        "avg loss": val_loss,
+        "ppl": math.exp(min(20, val_loss)),
+        "adjusted ppl": math.exp(min(20, val_loss * ratio)),
+        "token ratio": ratio,
+    }
+
+
+def lambada_metrics(stats: dict) -> dict:
+    """(ref: evaluate.py:162-168) — identical schema."""
+    return {
+        "number correct": stats["correct"],
+        "total examples": float(stats["num_examples"]),
+        "avg accuracy": stats["correct"] / max(stats["num_examples"], 1),
+    }

@@ -210,6 +210,32 @@ def test_pipeline_loss_mask_semantics_match_train_step(devices):
     np.testing.assert_allclose(got, want, rtol=2e-4)
 
 
+def test_sharded_eval_step(devices):
+    """_make_eval_step must consume a mesh-sharded state in place (VERDICT
+    item 10): pp=2 x tp=2 x dp=2 eval runs and matches the unpipelined
+    per-microbatch mean loss."""
+    from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
+                                     ParallelConfig, TrainingConfig)
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.training import init_train_state
+    from megatron_tpu.training.loop import _make_eval_step
+    cfg = MegatronConfig(
+        model=make_cfg(num_layers=4),
+        parallel=ParallelConfig(tensor_parallel=2, pipeline_parallel=2),
+        optimizer=OptimizerConfig(lr=1e-3),
+        training=TrainingConfig(micro_batch_size=2, global_batch_size=8,
+                                train_iters=3),
+    ).validate(n_devices=8)
+    mesh = build_mesh(cfg.parallel)
+    state = init_train_state(jax.random.PRNGKey(0), cfg)
+    eval_step = _make_eval_step(cfg, mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 4, 33), 0, 128)
+    batch = {"tokens": tokens}
+    got = float(eval_step(state.params, batch))
+    want = float(ref_loss(state.params, tokens, cfg.model))
+    np.testing.assert_allclose(got, want, rtol=2e-4)
+
+
 def test_pipelined_train_step(devices):
     """Full train step (grads + Adam) through the pp=2 x dp=2 x tp=2 mesh."""
     from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
