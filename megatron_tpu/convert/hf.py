@@ -222,3 +222,71 @@ def hf_falcon_to_params(sd: Mapping[str, np.ndarray], cfg: ModelConfig,
                        "bias": get("transformer.ln_f.bias")},
     }
     return params
+
+
+def params_to_hf_falcon(params, cfg: ModelConfig, dtype=np.float32) -> dict:
+    """megatron_tpu param tree -> HF FalconForCausalLM state dict — the
+    inverse of hf_falcon_to_params, completing the export direction the
+    reference covers at megatron2hf.py:60-471 (Falcon branch).
+
+    Rebuilds the fused grouped QKV [nkv*(q_per_kv+2)*hd, h] with each
+    group's K and V as its last two heads, and un-permutes the rotary row
+    order back to HF rotate-half convention."""
+    if cfg.use_post_ln or not cfg.parallel_attn or cfg.use_bias:
+        # mirror of the import-side guard (hf_falcon_to_params): other
+        # layouts would silently drop norm/bias tensors
+        raise NotImplementedError(
+            "falcon export expects parallel_attn, pre-LN, no biases")
+    hd = cfg.kv_channels
+    nq = cfg.num_attention_heads
+    nkv = cfg.num_kv_heads
+    qpg = nq // nkv
+    h = cfg.hidden_size
+    L = cfg.num_layers
+    t = params["transformer"]
+    v = cfg.vocab_size
+
+    sd = {}
+    sd["transformer.word_embeddings.weight"] = np.asarray(
+        params["embedding"]["word_embeddings"], dtype)[:v]
+    if cfg.tie_embed_logits:
+        sd["lm_head.weight"] = sd["transformer.word_embeddings.weight"]
+    else:
+        sd["lm_head.weight"] = _t(np.asarray(params["lm_head"], dtype))[:v]
+    sd["transformer.ln_f.weight"] = np.asarray(params["final_norm"]["scale"],
+                                               dtype)
+    sd["transformer.ln_f.bias"] = np.asarray(params["final_norm"]["bias"],
+                                             dtype)
+    for i in range(L):
+        p = f"transformer.h.{i}."
+        q = deinterleave_rope_rows(
+            _t(np.asarray(t["attention"]["wq"][i], dtype)), nq, hd)
+        wkv = np.asarray(t["attention"]["wkv"][i], dtype)  # [h, 2*nkv*hd]
+        k = deinterleave_rope_rows(_t(wkv[:, :nkv * hd]), nkv, hd)
+        vv = _t(wkv[:, nkv * hd:])
+        qkv = np.concatenate(
+            [q.reshape(nkv, qpg, hd, h), k.reshape(nkv, 1, hd, h),
+             vv.reshape(nkv, 1, hd, h)], axis=1)
+        sd[p + "self_attention.query_key_value.weight"] = qkv.reshape(
+            nkv * (qpg + 2) * hd, h)
+        sd[p + "self_attention.dense.weight"] = _t(
+            np.asarray(t["attention"]["wo"][i], dtype))
+        sd[p + "mlp.dense_h_to_4h.weight"] = _t(
+            np.asarray(t["mlp"]["w1"][i], dtype))
+        sd[p + "mlp.dense_4h_to_h.weight"] = _t(
+            np.asarray(t["mlp"]["w2"][i], dtype))
+        if cfg.parallel_layernorm:  # falcon-40b
+            sd[p + "ln_attn.weight"] = np.asarray(
+                t["input_norm"]["scale"][i], dtype)
+            sd[p + "ln_attn.bias"] = np.asarray(
+                t["input_norm"]["bias"][i], dtype)
+            sd[p + "ln_mlp.weight"] = np.asarray(
+                t["mlp_norm"]["scale"][i], dtype)
+            sd[p + "ln_mlp.bias"] = np.asarray(
+                t["mlp_norm"]["bias"][i], dtype)
+        else:  # falcon-7b
+            sd[p + "input_layernorm.weight"] = np.asarray(
+                t["input_norm"]["scale"][i], dtype)
+            sd[p + "input_layernorm.bias"] = np.asarray(
+                t["input_norm"]["bias"][i], dtype)
+    return sd

@@ -1,0 +1,126 @@
+"""Raw Meta-llama checkpoint import: multi-shard merge + param mapping.
+
+TPU-native equivalent of the reference's Meta-format path
+(ref: weights2megatron/merge_llama.py:59-86 merge_meta_llama + :117
+merge_llama dispatch, weights2megatron/weights2megatron.py:80-147
+llama_to_megatron with source="meta").
+
+Meta ships `consolidated.{00..NN}.pth` shards cut along the original
+tensor-parallel axes. Per-tensor shard axis (the published llama layout,
+ref: merge_llama.py:21-34 key_to_dim):
+
+  dim 0 (row-stacked):   attention wq/wk/wv, feed_forward w1/w3, output
+  dim 1 (col-stacked):   attention wo, feed_forward w2, tok_embeddings
+  replicated:            attention_norm, ffn_norm, norm; rope.freqs skipped
+
+RoPE convention: Meta weights already use the interleaved-pair rotary
+layout this model family implements (the reference's permute_qkv is a
+no-op for source="meta", ref: weights2megatron.py:82-86), so unlike the
+HF path no row permutation is applied.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.convert.hf import _pad_vocab, _t
+
+# short param name (second-to-last dotted component) -> shard concat axis
+_SHARD_AXIS = {
+    "wq": 0, "wk": 0, "wv": 0, "w1": 0, "w3": 0, "output": 0,
+    "wo": 1, "w2": 1, "tok_embeddings": 1,
+    "attention_norm": None, "ffn_norm": None, "norm": None,
+}
+
+
+def _short(name: str) -> str:
+    parts = name.split(".")
+    return parts[-2] if len(parts) >= 2 else parts[0]
+
+
+def list_meta_shards(root_dir: str) -> list[str]:
+    names = [n for n in os.listdir(root_dir)
+             if re.fullmatch(r"consolidated\.[0-9]+\.pth", n)]
+    if not names:
+        raise FileNotFoundError(
+            f"no consolidated.NN.pth shards under {root_dir}")
+    # numeric sort: lexicographic order would misplace consolidated.10.pth
+    # before consolidated.2.pth for unpadded indices
+    names.sort(key=lambda n: int(n.split(".")[1]))
+    return [os.path.join(root_dir, n) for n in names]
+
+
+def merge_meta_llama(root_dir: str) -> dict:
+    """Load + merge all consolidated shards into full numpy tensors
+    (ref: merge_llama.py:59-86). Streams one shard at a time."""
+    import torch
+
+    paths = list_meta_shards(root_dir)
+    per_key: dict[str, list] = {}
+    for path in paths:
+        shard = torch.load(path, map_location="cpu", weights_only=True)
+        for name, tensor in shard.items():
+            if _short(name) == "rope":  # rope.freqs: recomputed, not stored
+                continue
+            per_key.setdefault(name, []).append(
+                tensor.to(torch.float32).numpy())
+        del shard
+    merged = {}
+    for name, pieces in per_key.items():
+        short = _short(name)
+        if short not in _SHARD_AXIS:
+            raise KeyError(
+                f"unrecognized meta checkpoint tensor {name!r}: no shard "
+                "axis known — refusing to merge silently")
+        axis = _SHARD_AXIS[short]
+        if axis is None or len(pieces) == 1:
+            merged[name] = pieces[0]
+        else:
+            merged[name] = np.concatenate(pieces, axis=axis)
+    return merged
+
+
+def meta_llama_to_params(sd: Mapping[str, np.ndarray], cfg: ModelConfig,
+                         dtype=np.float32) -> dict:
+    """Merged Meta state dict -> megatron_tpu param tree
+    (ref: weights2megatron.py:80-147, source="meta": no rotary permute)."""
+    L = cfg.num_layers
+
+    def get(name):
+        return np.asarray(sd[name], dtype=dtype)
+
+    layers = {"attention": {"wq": [], "wkv": [], "wo": []},
+              "mlp": {"w1": [], "w2": []},
+              "input_norm": {"scale": []},
+              "post_attn_norm": {"scale": []}}
+    for i in range(L):
+        p = f"layers.{i}."
+        wq = _t(get(p + "attention.wq.weight"))           # [h, nq*hd]
+        wk = _t(get(p + "attention.wk.weight"))
+        wv = _t(get(p + "attention.wv.weight"))
+        layers["attention"]["wq"].append(wq)
+        layers["attention"]["wkv"].append(np.concatenate([wk, wv], axis=1))
+        layers["attention"]["wo"].append(_t(get(p + "attention.wo.weight")))
+        gate = _t(get(p + "feed_forward.w1.weight"))      # [h, ffn]
+        up = _t(get(p + "feed_forward.w3.weight"))
+        layers["mlp"]["w1"].append(np.stack([gate, up], axis=1))
+        layers["mlp"]["w2"].append(_t(get(p + "feed_forward.w2.weight")))
+        layers["input_norm"]["scale"].append(get(p + "attention_norm.weight"))
+        layers["post_attn_norm"]["scale"].append(get(p + "ffn_norm.weight"))
+
+    stacked = {k: {kk: np.stack(vv) for kk, vv in v.items()}
+               for k, v in layers.items()}
+    params = {
+        "embedding": {"word_embeddings": _pad_vocab(
+            get("tok_embeddings.weight"), cfg.padded_vocab_size)},
+        "transformer": stacked,
+        "final_norm": {"scale": get("norm.weight")},
+    }
+    if not cfg.tie_embed_logits:
+        params["lm_head"] = _t(_pad_vocab(get("output.weight"),
+                                          cfg.padded_vocab_size))
+    return params

@@ -45,13 +45,25 @@ def do_import(args):
     from megatron_tpu.training.train_step import TrainState
 
     mcfg = _model_cfg(args.family, args.size)
-    print(f"loading HF model from {args.hf_path}")
-    model = AutoModelForCausalLM.from_pretrained(
-        args.hf_path, torch_dtype=torch.float32)
-    sd = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
-    del model
-    conv = hf_llama_to_params if args.family == "llama" else hf_falcon_to_params
-    params = conv(sd, mcfg, dtype=np.float32)
+    if args.source == "meta":
+        # raw consolidated.NN.pth shards: merge then map, no rotary permute
+        # (ref: weights2megatron/merge_llama.py:117 merge_llama dispatch)
+        from megatron_tpu.convert import (merge_meta_llama,
+                                          meta_llama_to_params)
+        assert args.family == "llama", "meta format is llama-only"
+        print(f"merging meta shards from {args.hf_path}")
+        sd = merge_meta_llama(args.hf_path)
+        params = meta_llama_to_params(sd, mcfg, dtype=np.float32)
+    else:
+        print(f"loading HF model from {args.hf_path}")
+        model = AutoModelForCausalLM.from_pretrained(
+            args.hf_path, torch_dtype=torch.float32)
+        sd = {k: v.detach().cpu().numpy()
+              for k, v in model.state_dict().items()}
+        del model
+        conv = (hf_llama_to_params if args.family == "llama"
+                else hf_falcon_to_params)
+        params = conv(sd, mcfg, dtype=np.float32)
     state = TrainState(params=params, opt_state=None, iteration=0)
     cfg = MegatronConfig(model=mcfg)
     d = save_checkpoint(args.out, state, cfg, iteration=0, release=True)
@@ -59,10 +71,6 @@ def do_import(args):
 
 
 def do_export(args):
-    import numpy as np
-
-    from megatron_tpu.config import MegatronConfig
-    from megatron_tpu.convert import params_to_hf_llama
     from megatron_tpu.models import language_model as lm
     from megatron_tpu.training import checkpointing as ckpt
     from megatron_tpu.training.train_step import TrainState
@@ -74,29 +82,45 @@ def do_export(args):
     saved_cfg = ckpt.load_config_from_checkpoint(args.load)
     mcfg = (saved_cfg.model if saved_cfg is not None
             else _model_cfg(args.family, args.size))
-    assert args.family == "llama", "export currently supports llama"
     example = TrainState(
         params=jax.eval_shape(
             lambda: lm.model_init(jax.random.PRNGKey(0), mcfg)),
         opt_state=None, iteration=0)
     state, _, _ = ckpt.load_checkpoint(args.load, example, no_load_optim=True)
     assert state is not None, f"no checkpoint under {args.load}"
-    sd = params_to_hf_llama(state.params, mcfg)
     os.makedirs(args.hf_out, exist_ok=True)
     import torch
+    if args.family == "llama":
+        from megatron_tpu.convert import params_to_hf_llama
+        from transformers import LlamaConfig
+        sd = params_to_hf_llama(state.params, mcfg)
+        hf_cfg = LlamaConfig(
+            vocab_size=mcfg.vocab_size, hidden_size=mcfg.hidden_size,
+            num_hidden_layers=mcfg.num_layers,
+            num_attention_heads=mcfg.num_attention_heads,
+            num_key_value_heads=mcfg.num_kv_heads,
+            intermediate_size=mcfg.ffn_hidden_size,
+            max_position_embeddings=mcfg.max_position_embeddings,
+            rms_norm_eps=mcfg.norm_epsilon,
+            tie_word_embeddings=mcfg.tie_embed_logits,
+        )
+    else:
+        from megatron_tpu.convert import params_to_hf_falcon
+        from transformers import FalconConfig
+        sd = params_to_hf_falcon(state.params, mcfg)
+        hf_cfg = FalconConfig(
+            vocab_size=mcfg.vocab_size, hidden_size=mcfg.hidden_size,
+            num_hidden_layers=mcfg.num_layers,
+            num_attention_heads=mcfg.num_attention_heads,
+            num_kv_heads=mcfg.num_kv_heads,
+            new_decoder_architecture=mcfg.parallel_layernorm,
+            multi_query=mcfg.num_kv_heads == 1,
+            parallel_attn=mcfg.parallel_attn, bias=mcfg.use_bias,
+            layer_norm_epsilon=mcfg.norm_epsilon,
+        )
     torch.save({k: torch.tensor(v) for k, v in sd.items()},
                os.path.join(args.hf_out, "pytorch_model.bin"))
-    from transformers import LlamaConfig
-    LlamaConfig(
-        vocab_size=mcfg.vocab_size, hidden_size=mcfg.hidden_size,
-        num_hidden_layers=mcfg.num_layers,
-        num_attention_heads=mcfg.num_attention_heads,
-        num_key_value_heads=mcfg.num_kv_heads,
-        intermediate_size=mcfg.ffn_hidden_size,
-        max_position_embeddings=mcfg.max_position_embeddings,
-        rms_norm_eps=mcfg.norm_epsilon,
-        tie_word_embeddings=mcfg.tie_embed_logits,
-    ).save_pretrained(args.hf_out)
+    hf_cfg.save_pretrained(args.hf_out)
     print(f"wrote HF checkpoint to {args.hf_out}")
 
 
@@ -104,10 +128,14 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     sub = p.add_subparsers(dest="cmd", required=True)
     pi = sub.add_parser("import")
-    pi.add_argument("--hf_path", required=True)
+    pi.add_argument("--hf_path", required=True,
+                    help="HF model path, or a dir of consolidated.NN.pth "
+                         "shards with --source meta")
     pi.add_argument("--out", required=True)
     pi.add_argument("--family", default="llama", choices=["llama", "falcon"])
     pi.add_argument("--size", default="7b")
+    pi.add_argument("--source", default="hf", choices=["hf", "meta"],
+                    help="meta = raw Meta-llama consolidated shards")
     pe = sub.add_parser("export")
     pe.add_argument("--load", required=True)
     pe.add_argument("--hf_out", required=True)

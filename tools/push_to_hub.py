@@ -1,0 +1,69 @@
+"""Push an HF-format export to the HuggingFace Hub.
+
+TPU-native port of the reference's upload tool (ref: tools/push_to_hub.py):
+loads a transformers checkpoint directory (e.g. produced by
+`tools/convert_hf_checkpoint.py export`), optionally converts dtype, and
+uploads model + tokenizer with sharded safetensor serialization.
+
+  python tools/push_to_hub.py /path/to/hf_export \
+      --hf_repo_name org/model --auth_token hf_... [--dtype bf16]
+"""
+from __future__ import annotations
+
+import argparse
+
+
+DTYPES = {"auto": "auto", "bf16": "bfloat16", "fp16": "float16",
+          "fp32": "float32"}
+
+
+def parse_args():
+    p = argparse.ArgumentParser(
+        description="Push an HF-format checkpoint to the HuggingFace Hub.")
+    p.add_argument("model_name", help="path to HF checkpoint or model name")
+    p.add_argument("--dtype", choices=sorted(DTYPES), default="auto")
+    p.add_argument("--hf_repo_name", required=True)
+    p.add_argument("--auth_token", default=None)
+    p.add_argument("--output_folder", default=None,
+                   help="also save locally (e.g. after dtype conversion)")
+    p.add_argument("--max_shard_size", default="10GB")
+    p.add_argument("--unsafe", action="store_true",
+                   help="disable safetensor serialization")
+    return p.parse_args()
+
+
+def main():
+    import torch
+    from transformers import AutoModelForCausalLM, AutoTokenizer
+
+    args = parse_args()
+    dtype = DTYPES[args.dtype]
+    torch_dtype = dtype if dtype == "auto" else getattr(torch, dtype)
+    model = AutoModelForCausalLM.from_pretrained(
+        args.model_name, torch_dtype=torch_dtype)
+    try:
+        tokenizer = AutoTokenizer.from_pretrained(args.model_name)
+    except (OSError, ValueError):
+        # exports from convert_hf_checkpoint.py carry weights + config only;
+        # push the model anyway and say so
+        tokenizer = None
+        print(f"note: no tokenizer files at {args.model_name}; "
+              "pushing weights/config only")
+
+    if args.output_folder:
+        model.save_pretrained(args.output_folder,
+                              max_shard_size=args.max_shard_size,
+                              safe_serialization=not args.unsafe)
+        if tokenizer is not None:
+            tokenizer.save_pretrained(args.output_folder)
+
+    model.push_to_hub(args.hf_repo_name, token=args.auth_token,
+                      max_shard_size=args.max_shard_size,
+                      safe_serialization=not args.unsafe)
+    if tokenizer is not None:
+        tokenizer.push_to_hub(args.hf_repo_name, token=args.auth_token)
+    print(f"pushed {args.model_name} to {args.hf_repo_name}")
+
+
+if __name__ == "__main__":
+    main()
