@@ -221,6 +221,11 @@ def hf_falcon_to_params(sd: Mapping[str, np.ndarray], cfg: ModelConfig,
         "final_norm": {"scale": get("transformer.ln_f.weight"),
                        "bias": get("transformer.ln_f.bias")},
     }
+    if not cfg.tie_embed_logits:
+        # released falcons tie embeddings; an untied config (e.g. after
+        # finetuning with untied head) round-trips through lm_head.weight
+        params["lm_head"] = _t(_pad_vocab(get("lm_head.weight"),
+                                          cfg.padded_vocab_size))
     return params
 
 

@@ -66,8 +66,11 @@ def merge_meta_llama(root_dir: str) -> dict:
         for name, tensor in shard.items():
             if _short(name) == "rope":  # rope.freqs: recomputed, not stored
                 continue
-            per_key.setdefault(name, []).append(
-                tensor.to(torch.float32).numpy())
+            # merge in the checkpoint's native dtype where numpy can hold
+            # it (fp16/fp32); only bf16 (no numpy dtype) upcasts
+            if tensor.dtype == torch.bfloat16:
+                tensor = tensor.to(torch.float32)
+            per_key.setdefault(name, []).append(tensor.numpy())
         del shard
     merged = {}
     for name, pieces in per_key.items():

@@ -95,8 +95,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             m_ref[:, :1] + jnp.log(l_safe), lse_ref.shape[2:])
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, block_q, block_kv, num_kv):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   *refs, scale, causal, block_q, block_kv, num_kv,
+                   has_dlse=False):
+    # refs: [dlse_ref]? dq_ref, dq_acc — dlse is only an input when a real
+    # lse cotangent exists (ring attention); the plain path skips the DMA
+    if has_dlse:
+        dlse_ref, dq_ref, dq_acc = refs
+    else:
+        dq_ref, dq_acc = refs
+        dlse_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -127,7 +135,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        # dlse term: d(lse)/d(s) = p, so an lse cotangent adds p*dlse
+        # (used by ring attention's online merge weights)
+        rest = dp - delta
+        if has_dlse:
+            rest = rest + dlse_ref[0, 0][:, :1]
+        ds = p * rest
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -138,8 +151,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, causal, block_q, block_kv, num_q):
+                    *refs, scale, causal, block_q, block_kv, num_q,
+                    has_dlse=False):
+    if has_dlse:
+        dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = refs
+        dlse_ref = None
     ki = pl.program_id(2)
     qi = pl.program_id(3)
 
@@ -175,7 +193,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        rest = dp - delta
+        if has_dlse:
+            rest = rest + dlse_ref[0, 0][:, :1]
+        ds = p * rest
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -253,7 +274,10 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, dout):
+def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
+                    dlse=None):
+    """Shared backward. `dlse` [b, sq, nq] is the cotangent of the exposed
+    logsumexp (ring attention's merge weights use it); None means zero."""
     q, k, v, out, lse = res
     b, sq, nq, d = q.shape
     sk, nkv = k.shape[1], k.shape[2]
@@ -272,6 +296,12 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, dout):
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).transpose(0, 2, 1)
     delta = jnp.broadcast_to(delta[..., None], (b, nq, sq, STAT_LANES))
+    has_dlse = dlse is not None
+    extra = []
+    if has_dlse:
+        extra = [jnp.broadcast_to(
+            dlse.astype(jnp.float32).transpose(0, 2, 1)[..., None],
+            (b, nq, sq, STAT_LANES))]
 
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, h, qi, ki: (bi, h, qi, 0))
     kv_spec = pl.BlockSpec((1, 1, bkv, d),
@@ -281,15 +311,17 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, dout):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_kv=bkv, num_kv=num_kv),
+                          block_q=bq, block_kv=bkv, num_kv=num_kv,
+                          has_dlse=has_dlse),
         grid=(b, nq, num_q, num_kv),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec]
+        + [row_spec] * has_dlse,
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda bi, h, qi, ki: (bi, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, nq, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(qT, kT, vT, doT, lse, delta)
+    )(qT, kT, vT, doT, lse, delta, *extra)
 
     # dk/dv: grid swaps the roles — kv blocks outer, q blocks inner; every
     # q-head contributes to its kv-head, so run per Q-HEAD and sum groups
@@ -305,16 +337,18 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, dout):
 
     dk_per_head, dv_per_head = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_kv=bkv, num_q=num_q),
+                          block_q=bq, block_kv=bkv, num_q=num_q,
+                          has_dlse=has_dlse),
         grid=(b, nq, num_kv, num_q),
-        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2]
+        + [row_spec2] * has_dlse,
         out_specs=[dk_spec, dk_spec],
         out_shape=[jax.ShapeDtypeStruct((b, nq, sk, d), jnp.float32),
                    jax.ShapeDtypeStruct((b, nq, sk, d), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((bkv, d), jnp.float32),
                         pltpu.VMEM((bkv, d), jnp.float32)],
         interpret=interpret,
-    )(qT, kT, vT, doT, lse, delta)
+    )(qT, kT, vT, doT, lse, delta, *extra)
 
     # GQA: sum the per-q-head dk/dv into kv heads
     dk = dk_per_head.reshape(b, nkv, g, sk, d).sum(axis=2)
@@ -325,6 +359,11 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, dout):
             dv.transpose(0, 2, 1, 3).astype(v.dtype))
 
 
+def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, dout):
+    return _flash_bwd_core(causal, scale, block_q, block_kv, interpret,
+                           res, dout)
+
+
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_kv, interpret):
     out, res = _flash_fwd(q, k, v, causal, scale, block_q, block_kv,
                           interpret)
@@ -332,3 +371,32 @@ def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_kv, interpret):
 
 
 pallas_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def pallas_flash_attention_with_lse(q, k, v, causal=True, scale=None,
+                                    block_q=DEFAULT_BLOCK_Q,
+                                    block_kv=DEFAULT_BLOCK_KV,
+                                    interpret=False):
+    """Like pallas_flash_attention but also returns the per-row logsumexp
+    [b, sq, nq] — differentiable, for online merging across blocks that
+    live on different devices (ring attention hops)."""
+    (out, lse), _ = _with_lse_fwd(q, k, v, causal, scale, block_q, block_kv,
+                                  interpret)
+    return out, lse
+
+
+def _with_lse_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+    out, res = _flash_fwd(q, k, v, causal, scale, block_q, block_kv,
+                          interpret)
+    lse4 = res[4]  # [b, nq, sq, STAT_LANES]
+    return (out, lse4[..., 0].transpose(0, 2, 1)), res
+
+
+def _with_lse_bwd(causal, scale, block_q, block_kv, interpret, res, cot):
+    dout, dlse = cot
+    return _flash_bwd_core(causal, scale, block_q, block_kv, interpret,
+                           res, dout, dlse)
+
+
+pallas_flash_attention_with_lse.defvjp(_with_lse_fwd, _with_lse_bwd)

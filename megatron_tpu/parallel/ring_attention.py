@@ -11,10 +11,16 @@ per-block attention compute (RingAttention, Liu et al. 2023; the public
 "How to Scale Your Model" recipe).
 
 Formulation: one partial-manual shard_map (manual over 'cp' only, dp/tp stay
-GSPMD-automatic), cp steps of blockwise attention with online-softmax
-merging — the same merge the flash kernel does across kv blocks, here across
-ring hops. Causality uses global positions derived from the ring rank, so
-rotating blocks never breaks the causal mask.
+GSPMD-automatic), cp hops of blockwise attention merged online via
+(out, logsumexp) pairs — out_total = Σ_i out_i · exp(lse_i − lse_total).
+The inner block is the Pallas flash kernel (scores never materialize in
+HBM; `pallas_flash_attention_with_lse` exposes a differentiable lse whose
+cotangent feeds back through the merge weights); the XLA einsum block
+remains as the fallback for odd shapes / non-TPU backends. Causality per
+hop: the block from rank r itself is the causal diagonal, blocks from
+earlier ranks attend fully, later ranks are excluded via a −inf lse (their
+compute is the standard causal-ring waste; zigzag balancing is a possible
+future refinement).
 """
 from __future__ import annotations
 
@@ -30,9 +36,9 @@ NEG_INF = -1e30
 
 
 def _local_block_attention(q, k, v, q_off, kv_off, *, scale, causal):
-    """Blockwise attention of local q [b,s,nq,d] against one rotating kv
-    block [b,c,nkv,d]; returns (unnormalized acc [b,s,nq,d] f32,
-    m [b,s,nq] f32, l [b,s,nq] f32) for online merging."""
+    """XLA fallback: blockwise attention of local q [b,s,nq,d] against one
+    rotating kv block [b,c,nkv,d]; returns (out [b,s,nq,d] f32 normalized,
+    lse [b,s,nq] f32) for online merging."""
     b, s, nq, d = q.shape
     c, nkv = k.shape[1], k.shape[2]
     g = nq // nkv
@@ -48,59 +54,102 @@ def _local_block_attention(q, k, v, q_off, kv_off, *, scale, causal):
     p = jnp.where(m[..., None] <= NEG_INF / 2, 0.0, p)
     l = jnp.sum(p, axis=-1)
     acc = jnp.einsum("bsngt,btnd->bsngd", p, v.astype(jnp.float32))
-    return (acc.reshape(b, s, nq, d), m.reshape(b, s, nq),
-            l.reshape(b, s, nq))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+    return out.reshape(b, s, nq, d), lse.reshape(b, s, nq)
+
+
+def _flash_ok(s_loc: int) -> bool:
+    from megatron_tpu.ops.flash_attention_pallas import _pick_block
+    try:
+        _pick_block(s_loc, 512)
+        return True
+    except ValueError:
+        return False
 
 
 def ring_attention(q, k, v, mesh, *, causal: bool = True,
-                   scale: float | None = None, axis: str = "cp"):
+                   scale: float | None = None, axis: str = "cp",
+                   impl: str = "auto"):
     """q/k/v [b, S, n, d] with S the GLOBAL sequence length, sharded over
     `axis` on dim 1. Returns [b, S, nq, d] with the same sharding.
 
-    Must run under jit with the ambient mesh set (same contract as the
-    pipeline shard_map)."""
+    impl: "flash" forces the Pallas inner block (interpret mode off-TPU),
+    "xla" forces the einsum fallback, "auto" picks flash on TPU when the
+    local shard length tiles. Must run under jit with the ambient mesh set
+    (same contract as the pipeline shard_map)."""
     cp = mesh.shape[axis]
     if cp == 1:
         return flash_attention(q, k, v, causal=causal, scale=scale)
     d = q.shape[-1]
+    s_loc = q.shape[1] // cp
     if scale is None:
         scale = d ** -0.5
     out_dtype = q.dtype
+    on_tpu = jax.default_backend() == "tpu"
+    if impl == "auto":
+        use_flash = on_tpu and _flash_ok(s_loc)
+    else:
+        use_flash = impl == "flash"
+    interpret = not on_tpu
+    # the CPU SPMD partitioner CHECK-fails on bf16 collectives in
+    # partial-manual regions; ring K/V in compute dtype on TPU only
+    ring_dtype = q.dtype if on_tpu else jnp.float32
 
     def per_rank(q, k, v):
         # local shards: q [b, s_loc, nq, d], k/v [b, s_loc, nkv, d]
         r = jax.lax.axis_index(axis)
-        s_loc = q.shape[1]
-        b, _, nq, _ = q.shape
+        b, s_loc, nq, _ = q.shape
         perm = [(i, (i + 1) % cp) for i in range(cp)]
 
+        def inner_flash(k_cur, v_cur, src):
+            from megatron_tpu.ops.flash_attention_pallas import (
+                pallas_flash_attention_with_lse as fl)
+            kd, vd = k_cur.astype(q.dtype), v_cur.astype(q.dtype)
+            if not causal:
+                return fl(q, kd, vd, False, scale, 512, 512, interpret)
+            # diagonal hop -> causal kernel; others -> full kernel (later
+            # ranks are zero-weighted at merge)
+            return jax.lax.cond(
+                src == r,
+                lambda a, bb, c: fl(a, bb, c, True, scale, 512, 512,
+                                    interpret),
+                lambda a, bb, c: fl(a, bb, c, False, scale, 512, 512,
+                                    interpret),
+                q, kd, vd)
+
         def hop(carry, step):
-            acc, m, l, k_cur, v_cur = carry
+            out_tot, lse_tot, k_cur, v_cur = carry
             # after `step` rotations this rank holds the block that
             # originated at rank (r - step) mod cp
             src = (r - step) % cp
-            a_new, m_new, l_new = _local_block_attention(
-                q, k_cur, v_cur, r * s_loc, src * s_loc,
-                scale=scale, causal=causal)
-            m_tot = jnp.maximum(m, m_new)
-            m_safe = jnp.where(m_tot <= NEG_INF / 2, 0.0, m_tot)
-            c1 = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
-            c2 = jnp.where(m_new <= NEG_INF / 2, 0.0,
-                           jnp.exp(m_new - m_safe))
-            acc = acc * c1[..., None] + a_new * c2[..., None]
-            l = l * c1 + l_new * c2
+            if use_flash:
+                out_i, lse_i = inner_flash(k_cur, v_cur, src)
+                out_i = out_i.astype(jnp.float32)
+                if causal:
+                    # exclude blocks from later ranks
+                    lse_i = jnp.where(src <= r, lse_i, NEG_INF)
+            else:
+                out_i, lse_i = _local_block_attention(
+                    q, k_cur, v_cur, r * s_loc, src * s_loc,
+                    scale=scale, causal=causal)
+            new_tot = jnp.logaddexp(lse_tot, lse_i)
+            safe = jnp.where(new_tot <= NEG_INF / 2, 0.0, new_tot)
+            alpha = jnp.where(lse_tot <= NEG_INF / 2, 0.0,
+                              jnp.exp(lse_tot - safe))
+            beta = jnp.where(lse_i <= NEG_INF / 2, 0.0,
+                             jnp.exp(lse_i - safe))
+            out_tot = (out_tot * alpha[..., None]
+                       + out_i * beta[..., None])
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-            return (acc, m_tot, l, k_nxt, v_nxt), None
+            return (out_tot, new_tot, k_nxt, v_nxt), None
 
-        acc0 = jnp.zeros(q.shape, jnp.float32)
-        m0 = jnp.full((b, s_loc, nq), NEG_INF, jnp.float32)
-        l0 = jnp.zeros((b, s_loc, nq), jnp.float32)
-        (acc, m, l, _, _), _ = jax.lax.scan(
-            hop, (acc0, m0, l0,
-                  k.astype(jnp.float32), v.astype(jnp.float32)),
+        out0 = jnp.zeros(q.shape, jnp.float32)
+        lse0 = jnp.full((b, s_loc, nq), NEG_INF, jnp.float32)
+        (out, _, _, _), _ = jax.lax.scan(
+            hop, (out0, lse0, k.astype(ring_dtype), v.astype(ring_dtype)),
             jnp.arange(cp))
-        out = acc / jnp.maximum(l[..., None], 1e-30)
         return out.astype(out_dtype)
 
     shmap = jax.shard_map(

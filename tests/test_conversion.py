@@ -74,7 +74,7 @@ class TestFalconConversion:
             vocab_size=vocab, hidden_size=hidden, num_hidden_layers=layers,
             num_attention_heads=heads, num_kv_heads=kv,
             new_decoder_architecture=True, parallel_attn=True, bias=False,
-            alibi=False, rotary_base=10000.0)
+            alibi=False, rope_theta=10000.0)
         model = FalconForCausalLM(hf_cfg).eval()
         cfg = ModelConfig(
             num_layers=layers, hidden_size=hidden, num_attention_heads=heads,
@@ -108,7 +108,7 @@ class TestFalconConversion:
             num_attention_heads=heads, num_kv_heads=kv,
             multi_query=kv == 1,
             new_decoder_architecture=parallel_layernorm, parallel_attn=True,
-            bias=False, alibi=False, rotary_base=10000.0)
+            bias=False, alibi=False, rope_theta=10000.0)
         model = FalconForCausalLM(hf_cfg).eval()
         cfg = ModelConfig(
             num_layers=layers, hidden_size=hidden, num_attention_heads=heads,

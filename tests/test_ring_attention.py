@@ -97,3 +97,48 @@ def test_model_forward_with_ring_attention(devices):
             params, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("cp,nq,nkv,causal", [
+    (2, 4, 2, True), (2, 4, 4, False)])
+def test_ring_flash_inner_matches_full(devices, cp, nq, nkv, causal):
+    """impl='flash': the Pallas inner block (interpret mode on CPU) must
+    match full attention — the VERDICT round-1 item 7 upgrade path."""
+    mesh = make_mesh(1, cp, 1, devices)
+    b, s, d = 1, 128 * cp, 32
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (b, s, nq, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, nkv, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, nkv, d), jnp.float32)
+    want = ref_attention(q, k, v, causal=causal)
+    with jax.set_mesh(mesh):
+        got = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, mesh, causal=causal, impl="flash"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_flash_inner_gradients_match(devices):
+    """Gradients through the flash inner block (incl. the dlse term feeding
+    the merge weights) must match the XLA einsum path."""
+    cp = 2
+    mesh = make_mesh(1, cp, 1, devices)
+    b, s, nq, d = 1, 128 * cp, 4, 32
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = jax.random.normal(ks[0], (b, s, nq, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, nq, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, nq, d), jnp.float32)
+
+    def loss(impl):
+        def f(q, k, v):
+            return jnp.sum(jnp.tanh(ring_attention(
+                q, k, v, mesh, causal=True, impl=impl)))
+        return f
+
+    with jax.set_mesh(mesh):
+        g_flash = jax.jit(jax.grad(loss("flash"), argnums=(0, 1, 2)))(q, k, v)
+        g_xla = jax.jit(jax.grad(loss("xla"), argnums=(0, 1, 2)))(q, k, v)
+    for a, bb, name in zip(g_flash, g_xla, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"d{name}")

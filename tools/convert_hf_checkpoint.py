@@ -113,6 +113,9 @@ def do_export(args):
             num_hidden_layers=mcfg.num_layers,
             num_attention_heads=mcfg.num_attention_heads,
             num_kv_heads=mcfg.num_kv_heads,
+            ffn_hidden_size=mcfg.ffn_hidden_size,
+            max_position_embeddings=mcfg.max_position_embeddings,
+            rope_theta=mcfg.rope_theta,
             new_decoder_architecture=mcfg.parallel_layernorm,
             multi_query=mcfg.num_kv_heads == 1,
             parallel_attn=mcfg.parallel_attn, bias=mcfg.use_bias,
