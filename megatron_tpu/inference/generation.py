@@ -42,15 +42,24 @@ class SamplingParams(NamedTuple):
     top_p: float = 0.0
 
 
+KV_CACHE_AXES = ("layers", None, None, "kv_heads", None)
+
+
 def init_kv_caches(cfg: ModelConfig, batch: int, max_len: int,
                    dtype=jnp.bfloat16) -> KVCache:
-    """Stacked-over-layers KV cache [L, b, max_len, nkv, hd]."""
+    """Stacked-over-layers KV cache [L, b, max_len, nkv, hd].
+
+    Under a mesh context the cache is sharded over 'tp' on the kv-head dim
+    (and 'pp' on layers) — the TP-sharded serving layout the reference
+    reaches with per-rank InferenceParams dicts
+    (ref: text_generation_server.py + forward_step.py:17-42). Batch stays
+    replicated like the reference's broadcast-to-all-ranks tokens."""
+    from megatron_tpu.parallel.sharding import constrain
     L = cfg.num_layers
+    shape = (L, batch, max_len, cfg.num_kv_heads, cfg.kv_channels)
     return KVCache(
-        k=jnp.zeros((L, batch, max_len, cfg.num_kv_heads, cfg.kv_channels),
-                    dtype),
-        v=jnp.zeros((L, batch, max_len, cfg.num_kv_heads, cfg.kv_channels),
-                    dtype),
+        k=constrain(jnp.zeros(shape, dtype), KV_CACHE_AXES),
+        v=constrain(jnp.zeros(shape, dtype), KV_CACHE_AXES),
         offset=jnp.zeros((L,), jnp.int32),
     )
 
@@ -106,16 +115,30 @@ def _decode_fn(params, tokens, lengths, rng, *, cfg: ModelConfig,
 
 class Generator:
     """Jit-cached generation engine. One compile per (batch, max_len) bucket
-    (the reference instead pays a fresh CUDA graph per request shape)."""
+    (the reference instead pays a fresh CUDA graph per request shape).
+
+    `mesh`: serve a sharded model in place — params consume their
+    tp/pp-sharded layout via in_shardings (no re-layout on every call), the
+    KV cache shards over 'tp' on kv-heads, logits shard over 'tp' on vocab.
+    The reference's equivalent is the 8-GPU TP text_generation_server with
+    broadcast tokens (ref: megatron/text_generation_server.py)."""
 
     def __init__(self, params, cfg: ModelConfig, eos_id: int,
-                 pad_id: Optional[int] = None):
+                 pad_id: Optional[int] = None, mesh=None):
         self.params = params
         self.cfg = cfg
         self.eos_id = eos_id
         self.pad_id = pad_id if pad_id is not None else eos_id
         self.rope = lm.make_rope(cfg, max_len=cfg.max_position_embeddings)
+        self.mesh = mesh
         self._decode = {}
+        self._rules = None
+        self._param_sh = None
+        if mesh is not None:
+            from megatron_tpu.parallel import sharding as shd
+            self._rules = shd.make_logical_rules(False)
+            self._param_sh = shd.tree_logical_to_sharding(
+                mesh, lm.model_axes(cfg), self._rules)
 
         def _score_fn(params, tokens):
             logits, _ = lm.model_forward(params, tokens, self.cfg,
@@ -126,16 +149,35 @@ class Generator:
                 lp, tokens[:, 1:, None], axis=-1)[..., 0]
 
         # one cached jit; retraces only on new (batch, len) shapes
-        self._score_fn = jax.jit(_score_fn)
+        self._score_fn = self._jit(_score_fn, n_array_args=1)
+
+    def _jit(self, fn, n_array_args: int):
+        """jit with the mesh treatment: params consumed in their sharded
+        layout, activation ctx active during trace. The `None` in_shardings
+        entries mean 'inherit the argument's own sharding' (host numpy
+        inputs land replicated, which is the broadcast-tokens serving
+        layout; a pre-sharded array would be consumed as-is)."""
+        if self.mesh is None:
+            return jax.jit(fn)
+        from megatron_tpu.parallel import sharding as shd
+        mesh, rules = self.mesh, self._rules
+
+        def fn_ctx(*args, **kwargs):
+            with shd.activation_shardings(mesh, rules):
+                return fn(*args, **kwargs)
+
+        return jax.jit(fn_ctx,
+                       in_shardings=(self._param_sh,) + (None,) * n_array_args)
 
     def _get_decode(self, max_len: int, min_prompt: int,
                     sp: SamplingParams):
         key = (max_len, min_prompt, sp)
         if key not in self._decode:
-            self._decode[key] = jax.jit(functools.partial(
+            self._decode[key] = self._jit(functools.partial(
                 _decode_fn, cfg=self.cfg, max_len=max_len,
                 min_prompt=min_prompt, sp=sp,
-                eos_id=self.eos_id, pad_id=self.pad_id, rope=self.rope))
+                eos_id=self.eos_id, pad_id=self.pad_id, rope=self.rope),
+                n_array_args=3)
         return self._decode[key]
 
     def generate(self, prompts: list[list[int]], max_new_tokens: int,
@@ -210,7 +252,6 @@ def beam_search(generator: Generator, prompt: list[int], beam_width: int,
     toks = np.full((bw, max_len), generator.pad_id, np.int32)
     toks[:, :prompt_len] = prompt
 
-    @jax.jit
     def prefill(params, tokens):
         caches = init_kv_caches(cfg, bw, max_len)
         logits, caches = lm.model_forward(
@@ -218,7 +259,6 @@ def beam_search(generator: Generator, prompt: list[int], beam_width: int,
             logits_dtype=jnp.float32)
         return logits[:, -1], caches
 
-    @jax.jit
     def step(params, tokens, caches, scores, done, pos, last_logits):
         lp = jax.nn.log_softmax(last_logits, axis=-1)  # [bw, V]
         V = lp.shape[-1]
@@ -245,6 +285,11 @@ def beam_search(generator: Generator, prompt: list[int], beam_width: int,
             params, tokens[:, pos][:, None], cfg, kv_caches=caches,
             rope=rope, logits_dtype=jnp.float32)
         return tokens, caches, scores, done, logits[:, 0]
+
+    # route through the generator's mesh-aware jit so TP-sharded serving
+    # applies to beam decode too (same treatment as generate/score)
+    prefill = generator._jit(prefill, n_array_args=1)
+    step = generator._jit(step, n_array_args=6)
 
     last_logits, caches = prefill(params, jnp.asarray(toks))
     tokens = jnp.asarray(toks)

@@ -42,3 +42,13 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+def make_test_mesh(devices, dp=1, pp=1, cp=1, tp=1):
+    """Shared (dp, pp, cp, tp) mesh factory for parallelism tests."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from megatron_tpu.parallel.mesh import MESH_AXES
+    n = dp * pp * cp * tp
+    return Mesh(np.asarray(devices[:n]).reshape(dp, pp, cp, tp), MESH_AXES)

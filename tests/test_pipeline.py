@@ -31,8 +31,8 @@ def make_cfg(num_layers=4, **kw):
 
 
 def make_mesh(dp, pp, tp, devices):
-    n = dp * pp * tp
-    return Mesh(np.asarray(devices[:n]).reshape(dp, pp, 1, tp), MESH_AXES)
+    from conftest import make_test_mesh
+    return make_test_mesh(devices, dp=dp, pp=pp, tp=tp)
 
 
 def ref_loss(params, tokens, cfg, loss_mask=None):

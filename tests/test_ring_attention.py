@@ -19,8 +19,8 @@ from megatron_tpu.parallel.ring_attention import ring_attention
 
 
 def make_mesh(dp, cp, tp, devices):
-    n = dp * cp * tp
-    return Mesh(np.asarray(devices[:n]).reshape(dp, 1, cp, tp), MESH_AXES)
+    from conftest import make_test_mesh
+    return make_test_mesh(devices, dp=dp, cp=cp, tp=tp)
 
 
 def ref_attention(q, k, v, causal=True):
