@@ -50,6 +50,18 @@ def _load():
             ctypes.POINTER(ctypes.c_double), ctypes.c_int32, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64)]
         lib.build_blending_indices.restype = None
+        lib.build_mapping.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_uint64,
+            ctypes.c_int32, ctypes.c_double, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.build_mapping.restype = ctypes.c_int64
+        lib.build_blocks_mapping.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int64)]
+        lib.build_blocks_mapping.restype = ctypes.c_int64
         _lib = lib
         return lib
 
@@ -71,6 +83,52 @@ def build_sample_idx_native(sizes: np.ndarray, doc_idx: np.ndarray,
         ctypes.c_int64(len(doc_idx)), ctypes.c_int32(seq_length),
         ctypes.c_int32(num_epochs), ctypes.c_int64(tokens_per_epoch),
         _ptr(out, ctypes.c_int32))
+    return out
+
+
+def build_mapping_native(docs: np.ndarray, sizes: np.ndarray, *,
+                         num_epochs: int, max_num_samples: int,
+                         max_seq_length: int, short_seq_prob: float,
+                         seed: int, min_num_sent: int = 2) -> np.ndarray:
+    """Sentence-pair sample map [n, 3] of (start sentence, end sentence,
+    target seq len) — the reference's build_mapping contract
+    (ref: megatron/data/helpers.cpp:188-451)."""
+    lib = _load()
+    docs = np.ascontiguousarray(docs, dtype=np.int64)
+    sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+    args = [_ptr(docs, ctypes.c_int64), ctypes.c_int64(len(docs) - 1),
+            _ptr(sizes, ctypes.c_int32), ctypes.c_int32(num_epochs),
+            ctypes.c_uint64(max_num_samples),
+            ctypes.c_int32(max_seq_length),
+            ctypes.c_double(short_seq_prob), ctypes.c_int32(seed),
+            ctypes.c_int32(min_num_sent)]
+    n = lib.build_mapping(*args, None)
+    out = np.zeros((n, 3), dtype=np.int64)
+    lib.build_mapping(*args, _ptr(out, ctypes.c_int64))
+    return out
+
+
+def build_blocks_mapping_native(docs: np.ndarray, sizes: np.ndarray,
+                                titles_sizes: np.ndarray, *,
+                                num_epochs: int, max_num_samples: int,
+                                max_seq_length: int, seed: int,
+                                use_one_sent_blocks: bool = False
+                                ) -> np.ndarray:
+    """ICT/REALM block map [n, 4] of (start sentence, end sentence, doc,
+    block id) — the reference's build_blocks_mapping contract
+    (ref: megatron/data/helpers.cpp:453-670)."""
+    lib = _load()
+    docs = np.ascontiguousarray(docs, dtype=np.int64)
+    sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+    titles_sizes = np.ascontiguousarray(titles_sizes, dtype=np.int32)
+    args = [_ptr(docs, ctypes.c_int64), ctypes.c_int64(len(docs) - 1),
+            _ptr(sizes, ctypes.c_int32), _ptr(titles_sizes, ctypes.c_int32),
+            ctypes.c_int32(num_epochs), ctypes.c_uint64(max_num_samples),
+            ctypes.c_int32(max_seq_length), ctypes.c_int32(seed),
+            ctypes.c_int32(int(use_one_sent_blocks))]
+    n = lib.build_blocks_mapping(*args, None)
+    out = np.zeros((n, 4), dtype=np.int64)
+    lib.build_blocks_mapping(*args, _ptr(out, ctypes.c_int64))
     return out
 
 

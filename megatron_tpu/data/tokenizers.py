@@ -273,6 +273,165 @@ def _bytes_to_unicode():
     return dict(zip(bs, (chr(c) for c in cs)))
 
 
+class BertWordPieceTokenizer(AbstractTokenizer):
+    """Self-contained BERT WordPiece tokenizer
+    (ref: megatron/tokenizer/tokenizer.py:123-253 _BertWordPieceTokenizer
+    wrapping the original Google FullTokenizer). Pipeline: clean + optional
+    lowercase -> whitespace/punctuation basic tokenization -> greedy
+    longest-match-first wordpiece with '##' continuation prefix.
+
+    vocab_file: one token per line (standard BERT vocab.txt)."""
+
+    name = "BertWordPiece"
+
+    def __init__(self, vocab_file: str, lower_case: bool = True,
+                 vocab_extra_ids: int = 0):
+        self.lower_case = lower_case
+        with open(vocab_file, encoding="utf-8") as f:
+            tokens = [line.rstrip("\n") for line in f if line.rstrip("\n")]
+        self._vocab = {t: i for i, t in enumerate(tokens)}
+        # T5-style extra ids appended on top (ref: tokenizer.py:246-253)
+        for i in range(vocab_extra_ids):
+            self._add_token(f"<extra_id_{i}>")
+        self._inv = {i: t for t, i in self._vocab.items()}
+        for tok in ("[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"):
+            assert tok in self._vocab, f"vocab missing {tok}"
+
+    def _add_token(self, tok: str):
+        if tok not in self._vocab:
+            self._vocab[tok] = len(self._vocab)
+
+    # -- basic tokenization ------------------------------------------------
+    @staticmethod
+    def _is_punct(ch: str) -> bool:
+        import unicodedata
+        cp = ord(ch)
+        if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+                or 123 <= cp <= 126):
+            return True
+        return unicodedata.category(ch).startswith("P")
+
+    @staticmethod
+    def _is_cjk(ch: str) -> bool:
+        # the CJK Unified Ideograph blocks the original BERT BasicTokenizer
+        # splits per-character (standard BERT vocabs carry individual chars)
+        cp = ord(ch)
+        return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+                or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+                or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+                or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+    @staticmethod
+    def _is_control(ch: str) -> bool:
+        import unicodedata
+        if ch in ("\t", "\n", "\r"):
+            return False
+        return unicodedata.category(ch).startswith("C")
+
+    def _basic_tokenize(self, text: str) -> list[str]:
+        import unicodedata
+        # clean: drop control chars and the replacement char, normalize
+        # whitespace (the original BasicTokenizer's _clean_text)
+        text = "".join(" " if ch.isspace() else ch for ch in text
+                       if ord(ch) != 0 and ord(ch) != 0xFFFD
+                       and not self._is_control(ch))
+        if self.lower_case:
+            text = text.lower()
+            text = "".join(c for c in unicodedata.normalize("NFD", text)
+                           if unicodedata.category(c) != "Mn")
+        out: list[str] = []
+        word: list[str] = []
+
+        def flush():
+            if word:
+                out.append("".join(word))
+                word.clear()
+
+        for ch in text:
+            if ch.isspace():
+                flush()
+            elif self._is_punct(ch) or self._is_cjk(ch):
+                flush()
+                out.append(ch)
+            else:
+                word.append(ch)
+        flush()
+        return out
+
+    def _wordpiece(self, word: str) -> list[str]:
+        """Greedy longest-match-first (the published WordPiece algorithm)."""
+        if len(word) > 200:
+            return ["[UNK]"]
+        pieces: list[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self._vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return ["[UNK]"]
+            pieces.append(piece)
+            start = end
+        return pieces
+
+    # -- AbstractTokenizer surface ------------------------------------------
+    @property
+    def vocab_size(self) -> int:
+        return len(self._vocab)
+
+    @property
+    def vocab(self):
+        return self._vocab
+
+    @property
+    def inv_vocab(self):
+        return self._inv
+
+    def tokenize(self, text: str) -> list[int]:
+        ids = []
+        for word in self._basic_tokenize(text):
+            for piece in self._wordpiece(word):
+                ids.append(self._vocab[piece])
+        return ids
+
+    def detokenize(self, ids: Sequence[int]) -> str:
+        toks = [self._inv[int(i)] for i in ids]
+        out = []
+        for t in toks:
+            if t.startswith("##") and out:
+                out[-1] = out[-1] + t[2:]
+            else:
+                out.append(t)
+        return " ".join(out)
+
+    @property
+    def cls(self) -> int:
+        return self._vocab["[CLS]"]
+
+    @property
+    def sep(self) -> int:
+        return self._vocab["[SEP]"]
+
+    @property
+    def mask(self) -> int:
+        return self._vocab["[MASK]"]
+
+    @property
+    def pad(self) -> int:
+        return self._vocab["[PAD]"]
+
+    @property
+    def eod(self) -> int:
+        return self._vocab["[SEP]"]  # (ref: tokenizer.py eod == sep)
+
+
 def build_tokenizer(tokenizer_type: str, *, vocab_file=None, merge_file=None,
                     tokenizer_model=None, vocab_extra_ids=0,
                     vocab_extra_ids_list=None, new_tokens=True,
@@ -282,6 +441,12 @@ def build_tokenizer(tokenizer_type: str, *, vocab_file=None, merge_file=None,
     if t in ("GPT2BPETokenizer",):
         assert vocab_file and merge_file
         return GPT2BPETokenizer(vocab_file, merge_file)
+    if t in ("BertWordPieceTokenizer", "BertWordPieceLowerCase",
+             "BertWordPieceCase"):
+        assert vocab_file
+        return BertWordPieceTokenizer(
+            vocab_file, lower_case=t != "BertWordPieceCase",
+            vocab_extra_ids=vocab_extra_ids)
     if t in ("SentencePieceTokenizer",):
         assert tokenizer_model
         return SentencePieceTokenizer(

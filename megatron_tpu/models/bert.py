@@ -91,12 +91,20 @@ def bert_axes(cfg: ModelConfig):
     }
 
 
-def bert_forward(params, tokens, cfg: ModelConfig, *, tokentype_ids=None,
-                 padding_mask=None, rng=None, deterministic: bool = True):
-    """tokens [b, s] -> (lm_logits [b, s, V], nsp_logits [b, 2]).
+def strip_pretraining_heads(tree):
+    """Drop the MLM/NSP heads, keeping the encoder+pooler — the base for
+    classification / multiple-choice / biencoder towers
+    (ref: bert_model.py add_lm_head/add_binary_head toggles)."""
+    return {k: v for k, v in tree.items()
+            if k not in ("lm_head", "binary_head")}
 
-    `padding_mask` [b, s] 1=real: padded positions are excluded from
-    attention via segment isolation (pad gets its own segment)."""
+
+def bert_encode(params, tokens, cfg: ModelConfig, *, tokentype_ids=None,
+                padding_mask=None, rng=None, deterministic: bool = True):
+    """Shared encoder: tokens [b, s] -> (hidden [b, s, h], pooled [b, h]).
+    The building block for the MLM model, classification / multiple-choice
+    heads, and the ICT biencoder towers (ref: bert_model.py:124-242 with
+    add_binary_head/add_lm_head toggles)."""
     from megatron_tpu.config import as_dtype
     compute_dtype = as_dtype(cfg.compute_dtype)
     b, s = tokens.shape
@@ -116,9 +124,22 @@ def bert_forward(params, tokens, cfg: ModelConfig, *, tokentype_ids=None,
     x, _ = tfm.stack_apply(params["transformer"], x, cfg, causal=False,
                            segment_ids=seg, rng=rng,
                            deterministic=deterministic)
-
     pooled = jnp.tanh(x[:, 0] @ params["pooler"]["w"].astype(compute_dtype)
                       + params["pooler"]["b"].astype(compute_dtype))
+    return x, pooled
+
+
+def bert_forward(params, tokens, cfg: ModelConfig, *, tokentype_ids=None,
+                 padding_mask=None, rng=None, deterministic: bool = True):
+    """tokens [b, s] -> (lm_logits [b, s, V], nsp_logits [b, 2]).
+
+    `padding_mask` [b, s] 1=real: padded positions are excluded from
+    attention via segment isolation (pad gets its own segment)."""
+    from megatron_tpu.config import as_dtype
+    compute_dtype = as_dtype(cfg.compute_dtype)
+    x, pooled = bert_encode(params, tokens, cfg, tokentype_ids=tokentype_ids,
+                            padding_mask=padding_mask, rng=rng,
+                            deterministic=deterministic)
     nsp_logits = (pooled @ params["binary_head"]["w"].astype(compute_dtype)
                   + params["binary_head"]["b"].astype(compute_dtype))
 

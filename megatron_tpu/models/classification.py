@@ -1,0 +1,126 @@
+"""Classification and multiple-choice heads over the BERT encoder.
+
+TPU-native equivalents of the reference's finetuning heads
+(ref: megatron/model/classification.py:1-107 Classification,
+megatron/model/multiple_choice.py:1-120 MultipleChoice). Both are the BERT
+encoder + pooler with a dropout + dense head over the pooled output; the
+multiple-choice variant flattens [b, num_choices, s] to a batch of
+[b*num_choices, s], scores each choice with a 1-unit head, and reshapes
+back to [b, num_choices] (ref: multiple_choice.py:84-113).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models.bert import (bert_axes, bert_encode, bert_init,
+                                      strip_pretraining_heads as
+                                      _strip_lm_heads)
+from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+from megatron_tpu.ops.dropout import dropout
+
+
+def classification_init(rng, cfg: ModelConfig, num_classes: int,
+                        dtype=jnp.float32):
+    """(ref: classification.py:33-45 — encoder + classification_head)."""
+    k_bert, k_head = jax.random.split(rng)
+    params = _strip_lm_heads(bert_init(k_bert, cfg, dtype=dtype))
+    params["classification_head"] = {
+        "w": jax.random.normal(k_head, (cfg.hidden_size, num_classes),
+                               dtype) * cfg.init_method_std,
+        "b": jnp.zeros((num_classes,), dtype),
+    }
+    return params
+
+
+def classification_axes(cfg: ModelConfig):
+    axes = _strip_lm_heads(bert_axes(cfg))
+    axes["classification_head"] = {"w": ("embed", None), "b": (None,)}
+    return axes
+
+
+def classification_forward(params, tokens, cfg: ModelConfig, *,
+                           tokentype_ids=None, padding_mask=None, rng=None,
+                           deterministic: bool = True):
+    """tokens [b, s] -> logits [b, num_classes]
+    (ref: classification.py:62-88: pooled -> dropout -> dense)."""
+    from megatron_tpu.config import as_dtype
+    compute_dtype = as_dtype(cfg.compute_dtype)
+    r_enc = r_drop = None
+    if rng is not None and not deterministic:
+        r_enc, r_drop = jax.random.split(rng)
+    _, pooled = bert_encode(params, tokens, cfg, tokentype_ids=tokentype_ids,
+                            padding_mask=padding_mask, rng=r_enc,
+                            deterministic=deterministic)
+    if not deterministic and cfg.hidden_dropout > 0.0:
+        pooled = dropout(r_drop, pooled, cfg.hidden_dropout)
+    head = params["classification_head"]
+    logits = pooled @ head["w"].astype(compute_dtype) + \
+        head["b"].astype(compute_dtype)
+    return logits.astype(jnp.float32)
+
+
+def classification_loss(params, batch, cfg: ModelConfig, *, rng=None,
+                        deterministic: bool = True):
+    """batch: {tokens, label, tokentype_ids?, padding_mask?}
+    (ref: tasks/finetune_utils.py cross-entropy over class logits)."""
+    logits = classification_forward(
+        params, batch["tokens"], cfg,
+        tokentype_ids=batch.get("tokentype_ids"),
+        padding_mask=batch.get("padding_mask"),
+        rng=rng, deterministic=deterministic)
+    return jnp.mean(cross_entropy_loss(logits, batch["label"]))
+
+
+def multiple_choice_init(rng, cfg: ModelConfig, dtype=jnp.float32):
+    """(ref: multiple_choice.py:37-48 — 1-unit head over pooled output)."""
+    k_bert, k_head = jax.random.split(rng)
+    params = _strip_lm_heads(bert_init(k_bert, cfg, dtype=dtype))
+    params["multichoice_head"] = {
+        "w": jax.random.normal(k_head, (cfg.hidden_size, 1),
+                               dtype) * cfg.init_method_std,
+        "b": jnp.zeros((1,), dtype),
+    }
+    return params
+
+
+def multiple_choice_axes(cfg: ModelConfig):
+    axes = _strip_lm_heads(bert_axes(cfg))
+    axes["multichoice_head"] = {"w": ("embed", None), "b": (None,)}
+    return axes
+
+
+def multiple_choice_forward(params, tokens, cfg: ModelConfig, *,
+                            tokentype_ids=None, padding_mask=None, rng=None,
+                            deterministic: bool = True):
+    """tokens [b, num_choices, s] -> logits [b, num_choices]
+    (ref: multiple_choice.py:84-113 flatten/score/reshape)."""
+    from megatron_tpu.config import as_dtype
+    compute_dtype = as_dtype(cfg.compute_dtype)
+    b, c, s = tokens.shape
+    flat = lambda x: None if x is None else x.reshape(b * c, s)  # noqa: E731
+    r_enc = r_drop = None
+    if rng is not None and not deterministic:
+        r_enc, r_drop = jax.random.split(rng)
+    _, pooled = bert_encode(params, flat(tokens), cfg,
+                            tokentype_ids=flat(tokentype_ids),
+                            padding_mask=flat(padding_mask), rng=r_enc,
+                            deterministic=deterministic)
+    if not deterministic and cfg.hidden_dropout > 0.0:
+        pooled = dropout(r_drop, pooled, cfg.hidden_dropout)
+    head = params["multichoice_head"]
+    scores = pooled @ head["w"].astype(compute_dtype) + \
+        head["b"].astype(compute_dtype)
+    return scores.reshape(b, c).astype(jnp.float32)
+
+
+def multiple_choice_loss(params, batch, cfg: ModelConfig, *, rng=None,
+                         deterministic: bool = True):
+    """batch: {tokens [b,c,s], label [b], ...}."""
+    logits = multiple_choice_forward(
+        params, batch["tokens"], cfg,
+        tokentype_ids=batch.get("tokentype_ids"),
+        padding_mask=batch.get("padding_mask"),
+        rng=rng, deterministic=deterministic)
+    return jnp.mean(cross_entropy_loss(logits, batch["label"]))

@@ -1,0 +1,243 @@
+"""BERT/ICT completion tests: WordPiece tokenizer, sentence-pair/block
+mappings, classification heads, biencoder + MIPS index.
+
+Contract ports: reference tokenizer.py:123-253 (BertWordPiece),
+helpers.cpp:188-670 (build_mapping/build_blocks_mapping invariants),
+classification.py / multiple_choice.py (head shapes + learnability),
+biencoder_model.py + realm_index.py (retrieval loss, exact top-k search).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.data.helpers import (build_blocks_mapping_native,
+                                       build_mapping_native)
+from megatron_tpu.data.ict_dataset import BertSentencePairDataset, ICTDataset
+from megatron_tpu.data.tokenizers import BertWordPieceTokenizer
+from megatron_tpu.models.bert import bert_config
+
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+         "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over",
+         "lazy", "dog", ",", ".", "un", "##able"]
+
+
+@pytest.fixture()
+def wp(tmp_path):
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n")
+    return BertWordPieceTokenizer(str(p))
+
+
+class TestWordPiece:
+    def test_greedy_longest_match(self, wp):
+        ids = wp.tokenize("jumped")
+        assert [wp.inv_vocab[i] for i in ids] == ["jump", "##ed"]
+        ids = wp.tokenize("unable")
+        assert [wp.inv_vocab[i] for i in ids] == ["un", "##able"]
+
+    def test_punctuation_split_and_lowercase(self, wp):
+        ids = wp.tokenize("The quick, brown.")
+        toks = [wp.inv_vocab[i] for i in ids]
+        assert toks == ["the", "quick", ",", "brown", "."]
+
+    def test_unknown_word(self, wp):
+        assert [wp.inv_vocab[i] for i in wp.tokenize("zzz")] == ["[UNK]"]
+
+    def test_detokenize_joins_pieces(self, wp):
+        ids = wp.tokenize("jumps over")
+        assert wp.detokenize(ids) == "jumps over"
+
+    def test_special_ids(self, wp):
+        assert wp.cls == 2 and wp.sep == 3 and wp.mask == 4 and wp.pad == 0
+
+    def test_factory(self, tmp_path):
+        from megatron_tpu.data.tokenizers import build_tokenizer
+        p = tmp_path / "vocab.txt"
+        p.write_text("\n".join(VOCAB) + "\n")
+        t = build_tokenizer("BertWordPieceLowerCase", vocab_file=str(p))
+        assert isinstance(t, BertWordPieceTokenizer)
+
+
+def _toy_corpus(n_docs=6, sents_per_doc=5, sent_len=7, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    sentences = []
+    docs = [0]
+    for _ in range(n_docs):
+        for _ in range(sents_per_doc):
+            sentences.append(rng.integers(5, vocab,
+                                          size=sent_len).astype(np.int64))
+        docs.append(len(sentences))
+    return sentences, np.asarray(docs, np.int64)
+
+
+class TestMappings:
+    def test_mapping_rows_within_documents(self):
+        sentences, docs = _toy_corpus()
+        sizes = np.asarray([len(s) for s in sentences], np.int32)
+        m = build_mapping_native(docs, sizes, num_epochs=2,
+                                 max_num_samples=10**6, max_seq_length=20,
+                                 short_seq_prob=0.1, seed=5)
+        assert len(m) > 0
+        doc_of = np.searchsorted(docs, m[:, 0], side="right") - 1
+        for (start, end, tgt), d in zip(m, doc_of):
+            assert docs[d] <= start < end <= docs[d + 1]
+            assert 2 <= tgt <= 20
+
+    def test_mapping_deterministic_and_shuffled(self):
+        sentences, docs = _toy_corpus()
+        sizes = np.asarray([len(s) for s in sentences], np.int32)
+        kw = dict(num_epochs=2, max_num_samples=10**6, max_seq_length=20,
+                  short_seq_prob=0.1, seed=5)
+        a = build_mapping_native(docs, sizes, **kw)
+        b = build_mapping_native(docs, sizes, **kw)
+        np.testing.assert_array_equal(a, b)
+        # shuffled: not sorted by start index (overwhelmingly likely)
+        assert not np.all(np.diff(a[:, 0]) >= 0)
+
+    def test_single_sentence_docs_excluded(self):
+        docs = np.asarray([0, 1, 3], np.int64)  # doc0 has one sentence
+        sizes = np.asarray([5, 5, 5], np.int32)
+        m = build_mapping_native(docs, sizes, num_epochs=1,
+                                 max_num_samples=10**6, max_seq_length=20,
+                                 short_seq_prob=0.0, seed=3)
+        assert all(s >= 1 for s in m[:, 0])  # nothing from doc0
+
+    def test_blocks_mapping_doc_and_title_budget(self):
+        sentences, docs = _toy_corpus()
+        sizes = np.asarray([len(s) for s in sentences], np.int32)
+        titles = np.full(len(docs) - 1, 4, np.int32)
+        bm = build_blocks_mapping_native(docs, sizes, titles, num_epochs=1,
+                                         max_num_samples=10**6,
+                                         max_seq_length=24, seed=7)
+        assert len(bm) > 0
+        for start, end, doc, block_id in bm:
+            assert docs[doc] <= start < end <= docs[doc + 1]
+
+
+class TestPairAndICTDatasets:
+    def test_bert_pair_dataset_shapes_and_masking(self):
+        sentences, docs = _toy_corpus()
+        ds = BertSentencePairDataset(
+            sentences, docs, num_epochs=1, max_num_samples=10**6,
+            max_seq_length=32, short_seq_prob=0.1, vocab_size=64,
+            cls_id=2, sep_id=3, mask_id=4, pad_id=0, seed=11)
+        assert len(ds) > 0
+        item = ds[0]
+        assert item["tokens"].shape == (32,)
+        assert item["tokens"][0] == 2  # [CLS]
+        assert item["loss_mask"].sum() >= 1  # something is masked
+        n_real = int(item["padding_mask"].sum())
+        assert item["tokens"][n_real - 1] == 3  # final [SEP]
+        # tokentypes: segment A zeros then segment B ones within real span
+        tt = item["tokentype_ids"][:n_real]
+        assert tt[0] == 0 and tt[-1] == 1
+
+    def test_ict_dataset_query_from_block(self):
+        sentences, docs = _toy_corpus()
+        titles = [np.asarray([60, 61], np.int64)] * (len(docs) - 1)
+        ds = ICTDataset(sentences, docs, titles, max_seq_length=48,
+                        query_in_block_prob=0.0, cls_id=2, sep_id=3,
+                        pad_id=0, seed=13)
+        assert len(ds) > 0
+        item = ds[5 % len(ds)]
+        assert item["query_tokens"][0] == 2
+        assert item["context_tokens"][0] == 2
+        # title tokens prepended to context
+        assert item["context_tokens"][1] == 60
+        # query removed from block (prob 0.0 keeps it out): the query body
+        # must not appear contiguously in the context body
+        nq = int(item["query_pad_mask"].sum())
+        q = item["query_tokens"][1:nq - 1]
+        ctx = item["context_tokens"][:int(item["context_pad_mask"].sum())]
+        s = " ".join(map(str, ctx))
+        assert " ".join(map(str, q)) not in s
+
+
+def tiny_bert_cfg():
+    return bert_config(num_layers=2, hidden_size=64, num_attention_heads=4,
+                       vocab_size=96, seq_length=32,
+                       make_vocab_size_divisible_by=32,
+                       compute_dtype="float32")
+
+
+class TestClassificationHeads:
+    def test_classification_learns(self):
+        from megatron_tpu.models.classification import (classification_init,
+                                                        classification_loss)
+        cfg = tiny_bert_cfg()
+        params = classification_init(jax.random.PRNGKey(0), cfg,
+                                     num_classes=3)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(5, 96, (8, 32)))
+        labels = jnp.asarray(rng.integers(0, 3, (8,)))
+        batch = {"tokens": tokens, "label": labels}
+
+        loss_fn = jax.jit(lambda p: classification_loss(p, batch, cfg))
+        grad_fn = jax.jit(jax.grad(lambda p: classification_loss(p, batch,
+                                                                 cfg)))
+        l0 = float(loss_fn(params))
+        for _ in range(30):
+            g = grad_fn(params)
+            params = jax.tree.map(lambda p, gg: p - 0.05 * gg, params, g)
+        l1 = float(loss_fn(params))
+        assert np.isfinite(l0) and l1 < l0 * 0.5
+
+    def test_multiple_choice_shapes(self):
+        from megatron_tpu.models.classification import (
+            multiple_choice_forward, multiple_choice_init)
+        cfg = tiny_bert_cfg()
+        params = multiple_choice_init(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(5, 96, (3, 4, 32)))
+        logits = multiple_choice_forward(params, tokens, cfg)
+        assert logits.shape == (3, 4)
+        assert np.isfinite(np.asarray(logits)).all()
+
+
+class TestBiencoder:
+    @pytest.mark.parametrize("shared", [False, True])
+    def test_retrieval_loss_learns(self, shared):
+        import optax
+        from megatron_tpu.models.biencoder import (biencoder_init,
+                                                   retrieval_loss)
+        cfg = tiny_bert_cfg()
+        params = biencoder_init(jax.random.PRNGKey(0), cfg,
+                                ict_head_size=32, shared=shared)
+        rng = np.random.default_rng(1)
+        batch = {
+            "query_tokens": jnp.asarray(rng.integers(5, 96, (6, 32))),
+            "context_tokens": jnp.asarray(rng.integers(5, 96, (6, 32))),
+        }
+        loss_fn = jax.jit(lambda p: retrieval_loss(p, batch, cfg)[0])
+        opt = optax.adam(3e-3)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(p, s):
+            g = jax.grad(lambda pp: retrieval_loss(pp, batch, cfg)[0])(p)
+            updates, s = opt.update(g, s)
+            return optax.apply_updates(p, updates), s
+
+        l0 = float(loss_fn(params))
+        for _ in range(40):
+            params, opt_state = step(params, opt_state)
+        l1 = float(loss_fn(params))
+        assert np.isfinite(l0) and l1 < l0 * 0.5
+        _, acc = jax.jit(lambda p: retrieval_loss(p, batch, cfg))(params)
+        assert float(acc) > 0.8  # in-batch positives retrieved
+
+    def test_mips_index_exact_topk(self):
+        from megatron_tpu.models.biencoder import MIPSIndex
+        rng = np.random.default_rng(2)
+        embeds = rng.normal(size=(50, 16)).astype(np.float32)
+        idx = MIPSIndex(16)
+        idx.add_block_data(np.arange(0, 30), embeds[:30])
+        idx.add_block_data(np.arange(30, 50), embeds[30:])
+        assert len(idx) == 50
+        q = rng.normal(size=(4, 16)).astype(np.float32)
+        scores, ids = idx.search_mips_index(q, top_k=5)
+        assert scores.shape == (4, 5) and ids.shape == (4, 5)
+        want = np.argsort(-(q @ embeds.T), axis=-1)[:, :5]
+        np.testing.assert_array_equal(ids, want)
