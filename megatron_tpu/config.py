@@ -262,6 +262,10 @@ class DataConfig:
     eod_mask_loss: bool = False
     vocab_extra_ids: int = 0
     vocab_extra_ids_list: Optional[str] = None
+    # masked-LM data knobs (ref: arguments.py --mask_prob,
+    # --max_seq_length_dec for T5)
+    masked_lm_prob: float = 0.15
+    max_seq_length_dec: int = 128
     new_tokens: bool = True
     data_impl: str = "mmap"
     mmap_warmup: bool = False

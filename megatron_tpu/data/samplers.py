@@ -126,25 +126,29 @@ class BatchIterator:
     def __iter__(self):
         return self
 
+    def _next_indices(self):
+        """One micro-batch of sample indices, wrapping epochs."""
+        try:
+            return next(self._it)
+        except StopIteration:
+            if self._dataloader_type == "cyclic":
+                # the random sampler's consumed_samples advanced during
+                # iteration; re-iterating it starts the NEXT epoch with a
+                # fresh seed+epoch permutation (ref: data_samplers.py:
+                # 119-166)
+                self._it = iter(self.sampler)
+            else:
+                # sequential wrap: restart from sample 0, NOT from the
+                # resume offset — otherwise samples [0, consumed) would
+                # be excluded from every later epoch
+                self.sampler = self._make_sampler(0)
+                self._it = iter(self.sampler)
+            return next(self._it)
+
     def __next__(self) -> dict:
         micro = []
         for _ in range(self.num_microbatches):
-            try:
-                idxs = next(self._it)
-            except StopIteration:
-                if self._dataloader_type == "cyclic":
-                    # the random sampler's consumed_samples advanced during
-                    # iteration; re-iterating it starts the NEXT epoch with a
-                    # fresh seed+epoch permutation (ref: data_samplers.py:
-                    # 119-166)
-                    self._it = iter(self.sampler)
-                else:
-                    # sequential wrap: restart from sample 0, NOT from the
-                    # resume offset — otherwise samples [0, consumed) would
-                    # be excluded from every later epoch
-                    self.sampler = self._make_sampler(0)
-                    self._it = iter(self.sampler)
-                idxs = next(self._it)
+            idxs = self._next_indices()
             micro.append(np.stack(
                 [np.asarray(self.dataset[i]["text"]) for i in idxs]))
         tokens = np.stack(micro).astype(np.int32)  # [n_micro, b, seq+1]
@@ -170,6 +174,43 @@ class BatchIterator:
         else:
             batch["loss_mask"] = np.ones(tokens[..., 1:].shape, np.float32)
         return batch
+
+
+class DictBatchIterator:
+    """Assemble [n_micro, micro_bs*dp, ...] batches from ANY map-style
+    dataset yielding dict samples (BERT pairs, T5 spans, ICT query/context)
+    — the generic counterpart of BatchIterator for non-GPT losses
+    (ref: megatron/data/data_samplers.py build_pretraining_data_loader used
+    by pretrain_bert/t5/ict)."""
+
+    def __init__(self, dataset, micro_batch_size: int, data_parallel: int,
+                 num_microbatches: int, consumed_samples: int = 0,
+                 dataloader_type: str = "single", seed: int = 1234,
+                 drop_last: bool = True):
+        self.dataset = dataset
+        self.num_microbatches = num_microbatches
+        self._sampler_args = (micro_batch_size, data_parallel, seed,
+                              drop_last)
+        self._dataloader_type = dataloader_type
+        # resume offset is the within-epoch position: the global count may
+        # exceed the dataset when pretraining loops epochs
+        self.sampler = self._make_sampler(consumed_samples % len(dataset))
+        self._it = iter(self.sampler)
+
+    _make_sampler = BatchIterator._make_sampler
+    _next_indices = BatchIterator._next_indices
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        micro = []
+        for _ in range(self.num_microbatches):
+            idxs = self._next_indices()
+            items = [self.dataset[i] for i in idxs]
+            micro.append({k: np.stack([it[k] for it in items])
+                          for k in items[0]})
+        return {k: np.stack([m[k] for m in micro]) for k in micro[0]}
 
 
 def get_ltor_masks_and_position_ids(

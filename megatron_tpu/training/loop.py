@@ -97,9 +97,13 @@ def train(
     start_iteration: int = 0,
     consumed_samples: int = 0,
     save_fn: Optional[Callable] = None,
+    step_kwargs: Optional[dict] = None,
 ):
     """The `_train` loop (ref: training.py:639-751). `train_iterator` yields
     {"tokens": [n_micro, mbs, seq+1], "loss_mask": [n_micro, mbs, seq]}.
+    `step_kwargs` forwards to make_train_step (loss_fn / init_params_fn /
+    axes_fn — the pretrain_bert/t5/ict entry points' extension hook,
+    mirroring the reference's forward_step_func argument to `pretrain`).
     Returns (state, consumed_samples)."""
     timers = Timers()
     writer = make_writer(cfg.training.tensorboard_dir,
@@ -109,10 +113,21 @@ def train(
     if rng is None:
         rng = jax.random.PRNGKey(cfg.training.seed)
     if state is None:
+        init_params_fn = (step_kwargs or {}).get("init_params_fn")
         with jax.default_device(jax.devices()[0]) if mesh is None else _nullcontext():
-            state = init_train_state(rng, cfg)
+            if init_params_fn is not None:
+                # custom model family (BERT/T5/ICT): build state from ITS
+                # param tree, not the GPT default
+                from megatron_tpu.training import optimizer as _opt
+                params = init_params_fn()
+                state = TrainState(
+                    params=params,
+                    opt_state=_opt.init_optimizer(params, cfg.optimizer),
+                    iteration=jnp.zeros((), jnp.int32))
+            else:
+                state = init_train_state(rng, cfg)
 
-    step_fn = make_train_step(cfg, mesh=mesh)
+    step_fn = make_train_step(cfg, mesh=mesh, **(step_kwargs or {}))
 
     calc = MicrobatchCalculator(
         cfg.training.global_batch_size, cfg.training.micro_batch_size,

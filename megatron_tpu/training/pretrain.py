@@ -1,0 +1,72 @@
+"""Shared driver for the non-GPT pretraining entry points.
+
+The reference exposes pretrain_bert.py / pretrain_t5.py / pretrain_ict.py
+as thin wrappers over `pretrain(datasets_provider, model_provider,
+forward_step)` (ref: megatron/training.py:54-167, pretrain_bert.py,
+pretrain_t5.py, pretrain_ict.py). Here the same extension surface is
+(dataset, init_params_fn, loss_fn, axes_fn): the jitted train step and the
+loop are shared with the GPT path, only the model family plugs in.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from megatron_tpu.config import MegatronConfig
+
+
+def run_pretrain(
+    cfg: MegatronConfig,
+    dataset,
+    *,
+    init_params_fn: Callable,
+    loss_fn: Callable,
+    axes_fn: Optional[Callable] = None,
+    mesh=None,
+) -> int:
+    """Build state + iterator and run the training loop. `loss_fn` has the
+    make_train_step contract: (params, microbatch_dict, rng) -> scalar."""
+    from megatron_tpu.data.samplers import DictBatchIterator
+    from megatron_tpu.training import checkpointing as ckpt
+    from megatron_tpu.training import optimizer as opt
+    from megatron_tpu.training.loop import train
+    from megatron_tpu.training.train_step import TrainState
+    from megatron_tpu.utils.logging import print_rank_0
+
+    rng = jax.random.PRNGKey(cfg.training.seed)
+    params = init_params_fn()
+    state = TrainState(
+        params=params,
+        opt_state=opt.init_optimizer(params, cfg.optimizer),
+        iteration=jax.numpy.zeros((), jax.numpy.int32))
+
+    start_iteration, consumed = 0, 0
+    load_dir = cfg.training.load_dir or cfg.training.checkpoint_dir
+    if load_dir:
+        loaded, start_iteration, consumed = ckpt.load_checkpoint(
+            load_dir, state, finetune=cfg.training.finetune,
+            no_load_optim=cfg.training.no_load_optim)
+        if loaded is not None:
+            state = loaded
+
+    train_it = DictBatchIterator(
+        dataset, cfg.training.micro_batch_size,
+        cfg.parallel.data_parallel or 1, cfg.num_microbatches,
+        consumed_samples=consumed,
+        dataloader_type=cfg.data.dataloader_type, seed=cfg.training.seed)
+
+    save_fn = None
+    if cfg.training.checkpoint_dir:
+        def save_fn(st, iteration, consumed_samples):
+            ckpt.save_checkpoint(cfg.training.checkpoint_dir, st, cfg,
+                                 iteration, consumed_samples)
+
+    state, consumed = train(
+        cfg, train_it, valid_iterator=None, mesh=mesh, state=state, rng=rng,
+        start_iteration=start_iteration, consumed_samples=consumed,
+        save_fn=save_fn,
+        step_kwargs={"loss_fn": loss_fn, "init_params_fn": init_params_fn,
+                     "axes_fn": axes_fn})
+    print_rank_0(f"pretraining done at consumed_samples={consumed}")
+    return 0

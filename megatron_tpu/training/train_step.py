@@ -72,7 +72,9 @@ def train_step(
     Returns (new_state, metrics).
     """
     mcfg = cfg.model
-    n_micro = batch["tokens"].shape[0]
+    # any leaf's leading dim is the microbatch count (custom losses may
+    # have no "tokens" key — e.g. T5's text_enc/text_dec)
+    n_micro = jax.tree.leaves(batch)[0].shape[0]
     loss_scale = state.opt_state.scaler.scale
 
     if rope is None:
@@ -109,7 +111,7 @@ def train_step(
 
     zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
     mb_stream = dict(batch)
-    if mb_stream.get("loss_mask") is None:
+    if "tokens" in mb_stream and mb_stream.get("loss_mask") is None:
         mb_stream["loss_mask"] = jnp.ones(
             (n_micro,) + (batch["tokens"].shape[1], batch["tokens"].shape[2] - 1),
             jnp.float32)

@@ -1,0 +1,135 @@
+"""Task finetuning + accuracy evaluation (ref: tasks/finetune_utils.py,
+tasks/eval_utils.py).
+
+Epoch-based finetune over a classification or multiple-choice head with
+per-epoch validation accuracy — the reference's `finetune(...)` +
+`accuracy_func_provider` contract, driven by the shared jitted train step.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import MegatronConfig
+
+
+def _batches(dataset, batch_size: int, shuffle_rng=None):
+    idxs = np.arange(len(dataset))
+    if shuffle_rng is not None:
+        shuffle_rng.shuffle(idxs)
+    for lo in range(0, len(idxs) - batch_size + 1, batch_size):
+        items = [dataset[int(i)] for i in idxs[lo:lo + batch_size]]
+        yield {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+
+def evaluate_accuracy(params, dataset, forward_fn, batch_size: int) -> float:
+    """argmax-accuracy over a labeled dataset
+    (ref: tasks/eval_utils.py accuracy_func_provider)."""
+    correct = total = 0
+    for batch in _batches(dataset, batch_size):
+        logits = forward_fn(params, batch)
+        pred = np.asarray(jnp.argmax(logits, axis=-1))
+        correct += int((pred == batch["label"]).sum())
+        total += len(pred)
+    return correct / max(total, 1)
+
+
+def finetune_and_evaluate(
+    cfg: MegatronConfig,
+    train_ds,
+    valid_ds,
+    *,
+    kind: str,                      # "classification" | "multichoice"
+    num_classes: int = 2,
+    epochs: int = 3,
+    mesh=None,
+    pretrained_checkpoint: Optional[str] = None,
+    seed: int = 1234,
+) -> dict:
+    """(ref: tasks/finetune_utils.py finetune): epoch loop + per-epoch
+    validation accuracy. Returns {"best_accuracy", "last_accuracy",
+    "params"}."""
+    from megatron_tpu.models import classification as cls
+    from megatron_tpu.training import optimizer as opt
+    from megatron_tpu.training.train_step import (TrainState,
+                                                  make_train_step)
+    from megatron_tpu.utils.logging import print_rank_0
+
+    mcfg = cfg.model
+    if kind == "classification":
+        init_fn = functools.partial(cls.classification_init,
+                                    jax.random.PRNGKey(seed), mcfg,
+                                    num_classes)
+        loss = cls.classification_loss
+        fwd = cls.classification_forward
+        axes_fn = functools.partial(cls.classification_axes)
+    elif kind == "multichoice":
+        init_fn = functools.partial(cls.multiple_choice_init,
+                                    jax.random.PRNGKey(seed), mcfg)
+        loss = cls.multiple_choice_loss
+        fwd = cls.multiple_choice_forward
+        axes_fn = functools.partial(cls.multiple_choice_axes)
+    else:
+        raise ValueError(f"unknown finetune kind {kind!r}")
+
+    params = init_fn()
+    if pretrained_checkpoint:
+        # load encoder weights from a BERT pretraining checkpoint; head
+        # stays freshly initialized (ref: finetune_utils.py
+        # --pretrained_checkpoint load with strict=False)
+        from megatron_tpu.training import checkpointing as ckpt
+        example = TrainState(params=params, opt_state=None, iteration=0)
+        loaded, _, _ = ckpt.load_checkpoint(
+            pretrained_checkpoint, example, finetune=True)
+        if loaded is not None:
+            for k, v in loaded.params.items():
+                if k in params:
+                    params[k] = v
+
+    state = TrainState(params=params,
+                       opt_state=opt.init_optimizer(params, cfg.optimizer),
+                       iteration=jnp.zeros((), jnp.int32))
+
+    def loss_fn(p, mb, mb_rng):
+        return loss(p, mb, mcfg, rng=mb_rng,
+                    deterministic=mcfg.hidden_dropout == 0.0)
+
+    # size the lr schedule to the actual finetuning length — otherwise the
+    # decay (keyed to cfg.training.train_iters) hits min_lr immediately
+    import dataclasses
+    bs_total = cfg.training.micro_batch_size * (cfg.parallel.data_parallel
+                                                or 1)
+    steps_per_epoch = max(len(train_ds) // bs_total, 1)
+    cfg = dataclasses.replace(cfg, training=dataclasses.replace(
+        cfg.training, train_iters=max(epochs * steps_per_epoch, 1)))
+
+    step = make_train_step(cfg, mesh=mesh, loss_fn=loss_fn,
+                           init_params_fn=init_fn, axes_fn=axes_fn,
+                           donate=False)
+    fwd_jit = jax.jit(lambda p, b: fwd(
+        p, jnp.asarray(b["tokens"]), mcfg,
+        tokentype_ids=jnp.asarray(b["tokentype_ids"]),
+        padding_mask=jnp.asarray(b["padding_mask"])))
+
+    bs = cfg.training.micro_batch_size * (cfg.parallel.data_parallel or 1)
+    rng = jax.random.PRNGKey(seed)
+    shuffle = np.random.RandomState(seed)
+    best = last = 0.0
+    it = 0
+    metrics = {"lm_loss": float("nan")}  # eval-only runs never train
+    for epoch in range(epochs):
+        for batch in _batches(train_ds, bs, shuffle):
+            mb = {k: v[None] for k, v in batch.items()}  # n_micro = 1
+            state, metrics = step(state, mb, jax.random.fold_in(rng, it))
+            it += 1
+        if valid_ds is not None:
+            last = evaluate_accuracy(state.params, valid_ds, fwd_jit, bs)
+            best = max(best, last)
+            print_rank_0(f"epoch {epoch}: loss {float(metrics['lm_loss']):.4f}"
+                         f" val accuracy {last:.4f}")
+    return {"best_accuracy": best, "last_accuracy": last,
+            "params": state.params}

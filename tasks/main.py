@@ -22,11 +22,16 @@ from megatron_tpu.utils.platform import ensure_env_platform
 def get_tasks_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("tasks", description=__doc__)
     p.add_argument("--task", required=True,
-                   choices=["WIKITEXT103", "LAMBADA"],
+                   choices=["WIKITEXT103", "LAMBADA", "MNLI", "QQP", "RACE"],
                    help="Task name (ref: tasks/main.py:19).")
     p.add_argument("--valid_data", nargs="+", required=True)
-    p.add_argument("--load", required=True,
-                   help="checkpoint root (tracker + iter dirs)")
+    p.add_argument("--train_data", nargs="*", default=None,
+                   help="finetuning data (MNLI/QQP/RACE)")
+    p.add_argument("--load", default=None,
+                   help="checkpoint root (tracker + iter dirs); required "
+                        "for zero-shot tasks")
+    p.add_argument("--pretrained_checkpoint", default=None,
+                   help="BERT pretraining checkpoint for finetune tasks")
     p.add_argument("--tokenizer_type", default="HFTokenizer")
     p.add_argument("--tokenizer_model", default=None)
     p.add_argument("--vocab_file", default=None)
@@ -37,7 +42,79 @@ def get_tasks_parser() -> argparse.ArgumentParser:
     p.add_argument("--micro_batch_size", type=int, default=8)
     p.add_argument("--seq_length", type=int, default=None,
                    help="override eval window (default: model seq_length)")
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=5e-5)
+    # model shape for finetune tasks without a checkpoint config
+    p.add_argument("--num_layers", type=int, default=12)
+    p.add_argument("--hidden_size", type=int, default=768)
+    p.add_argument("--num_attention_heads", type=int, default=12)
     return p
+
+
+def run_finetune_task(args) -> dict:
+    """GLUE (MNLI/QQP) classification and RACE multiple-choice finetuning
+    (ref: tasks/glue/finetune.py, tasks/race/finetune.py)."""
+    from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
+                                     TrainingConfig)
+    from megatron_tpu.data.tokenizers import build_tokenizer
+    from megatron_tpu.models.bert import bert_config
+    from tasks.finetune_utils import finetune_and_evaluate
+
+    tok_type = args.tokenizer_type
+    if tok_type == "HFTokenizer" and args.vocab_file:
+        # finetune tasks need a [CLS]/[SEP]-style tokenizer; a bare
+        # --vocab_file implies WordPiece
+        tok_type = "BertWordPieceLowerCase"
+    tokenizer = build_tokenizer(
+        tok_type, vocab_file=args.vocab_file,
+        merge_file=args.merge_file, tokenizer_model=args.tokenizer_model)
+    for attr in ("cls", "sep", "pad"):
+        if getattr(tokenizer, attr, None) is None:
+            raise SystemExit(
+                f"--task {args.task} needs a tokenizer with [CLS]/[SEP]/"
+                f"[PAD] ids (e.g. --tokenizer_type BertWordPieceLowerCase "
+                f"--vocab_file vocab.txt); {tok_type} has no {attr!r}")
+    seq = args.seq_length or 512
+    model = bert_config(
+        num_layers=args.num_layers, hidden_size=args.hidden_size,
+        num_attention_heads=args.num_attention_heads,
+        vocab_size=tokenizer.vocab_size, seq_length=seq,
+        max_position_embeddings=seq)
+    cfg = MegatronConfig(
+        model=model,
+        optimizer=OptimizerConfig(lr=args.lr, clip_grad=1.0),
+        training=TrainingConfig(micro_batch_size=args.micro_batch_size,
+                                global_batch_size=args.micro_batch_size,
+                                train_iters=1),
+    ).validate(n_devices=1)
+
+    if args.task in ("MNLI", "QQP"):
+        from tasks.glue.data import GlueDataset, read_mnli, read_qqp
+        read = read_mnli if args.task == "MNLI" else read_qqp
+        train_rows = [r for p in (args.train_data or []) for r in read(p)]
+        valid_rows = [r for p in args.valid_data for r in read(p)]
+        train_ds = GlueDataset(train_rows, tokenizer, seq)
+        valid_ds = GlueDataset(valid_rows, tokenizer, seq)
+        kind = "classification"
+        num_classes = 3 if args.task == "MNLI" else 2
+    else:  # RACE
+        from tasks.race.data import RaceDataset, read_race
+        train_rows = [r for p in (args.train_data or [])
+                      for r in read_race(p)]
+        valid_rows = [r for p in args.valid_data for r in read_race(p)]
+        train_ds = RaceDataset(train_rows, tokenizer, seq)
+        valid_ds = RaceDataset(valid_rows, tokenizer, seq)
+        kind = "multichoice"
+        num_classes = 4
+
+    result = finetune_and_evaluate(
+        cfg, train_ds, valid_ds, kind=kind, num_classes=num_classes,
+        epochs=args.epochs,
+        pretrained_checkpoint=args.pretrained_checkpoint)
+    metrics = {"best accuracy": result["best_accuracy"],
+               "last accuracy": result["last_accuracy"]}
+    print(json.dumps({"task": args.task, **metrics}))
+    return metrics
 
 
 def run_task(args) -> dict:
@@ -94,7 +171,11 @@ def run_task(args) -> dict:
 def main():
     ensure_env_platform()
     args = get_tasks_parser().parse_args()
-    run_task(args)
+    if args.task in ("MNLI", "QQP", "RACE"):
+        run_finetune_task(args)
+    else:
+        assert args.load, "--load required for zero-shot tasks"
+        run_task(args)
 
 
 if __name__ == "__main__":
