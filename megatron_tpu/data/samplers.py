@@ -192,9 +192,20 @@ class DictBatchIterator:
         self._sampler_args = (micro_batch_size, data_parallel, seed,
                               drop_last)
         self._dataloader_type = dataloader_type
-        # resume offset is the within-epoch position: the global count may
-        # exceed the dataset when pretraining loops epochs
-        self.sampler = self._make_sampler(consumed_samples % len(dataset))
+        # sequential resume offset is the within-epoch position (the
+        # sampler asserts consumed < total). One drop_last epoch emits
+        # only the batch-aligned prefix, so the modulus is that epoch
+        # length — len(dataset) would leak dropped tail samples into the
+        # resumed stream. The random sampler takes the GLOBAL count: its
+        # epoch arithmetic is internal.
+        if dataloader_type == "cyclic":
+            resume = consumed_samples
+        else:
+            chunk = micro_batch_size * data_parallel
+            epoch_len = (len(dataset) - len(dataset) % chunk
+                         if drop_last else len(dataset))
+            resume = consumed_samples % max(epoch_len, 1)
+        self.sampler = self._make_sampler(resume)
         self._it = iter(self.sampler)
 
     _make_sampler = BatchIterator._make_sampler

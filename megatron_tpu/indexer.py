@@ -1,0 +1,73 @@
+"""Evidence-index builder: one pass of the context tower over a corpus.
+
+TPU-native equivalent of the reference's IndexBuilder
+(ref: megatron/indexer.py:17-123): embed every evidence block with the
+biencoder's context model and persist {row_id: embedding} shards that merge
+into an OpenRetrievalDataStore. The reference distributes the pass over dp
+ranks with one process per GPU; here one process owns the whole pass and
+`shard`/`num_shards` slice the corpus for multi-host runs (merge with
+OpenRetrievalDataStore.merge_shards_and_save).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.data.orqa_dataset import OpenRetrievalEvidenceDataset
+from megatron_tpu.data.realm_index import OpenRetrievalDataStore
+
+
+class IndexBuilder:
+    """Embed evidence blocks and fill a datastore
+    (ref: megatron/indexer.py:17-123 IndexBuilder.build_and_save_index)."""
+
+    def __init__(self, params, cfg: ModelConfig, dataset:
+                 OpenRetrievalEvidenceDataset, *, embedding_path: str,
+                 batch_size: int = 128, shard: int = 0, num_shards: int = 1,
+                 log_interval: int = 10):
+        from megatron_tpu.models.biencoder import _towers, embed_text
+        self.params = params
+        self.cfg = cfg
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shard, self.num_shards = shard, num_shards
+        self.log_interval = log_interval
+        self.store = OpenRetrievalDataStore(
+            embedding_path, load_from_path=False, rank=shard)
+
+        _, context_tower = _towers(params)
+
+        def embed(tokens, types, pad_mask):
+            return embed_text(context_tower, tokens, cfg,
+                              padding_mask=pad_mask, tokentype_ids=types,
+                              deterministic=True)
+
+        self._embed = jax.jit(embed)
+
+    def build_and_save_index(self, save: bool = True) -> \
+            OpenRetrievalDataStore:
+        """(ref: indexer.py:77-123): batched embedding pass; each batch's
+        embeddings land in the datastore keyed by evidence row id."""
+        total = 0
+        for it, batch in enumerate(self.dataset.batches(
+                self.batch_size, shard=self.shard,
+                num_shards=self.num_shards)):
+            embeds = self._embed(jnp.asarray(batch["context"]),
+                                 jnp.asarray(batch["context_types"]),
+                                 jnp.asarray(batch["context_pad_mask"]))
+            n = batch["n_real"]
+            self.store.add_block_data(batch["row_id"][:n],
+                                      np.asarray(embeds)[:n])
+            total += n
+            if self.log_interval and (it + 1) % self.log_interval == 0:
+                print(f"indexer: embedded {total} blocks", flush=True)
+        if save:
+            if self.num_shards > 1:
+                self.store.save_shard()
+            else:
+                self.store.save()
+        return self.store

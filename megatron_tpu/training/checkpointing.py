@@ -226,36 +226,59 @@ def load_checkpoint(
         # sharding — load-time resharding to any tp/pp/dp layout
         ocp = _orbax()
 
-        def abstract(tree, sh_tree):
+        def abstract(tree, sh_tree, default=None):
             sh_leaves = (jax.tree.leaves(sh_tree) if sh_tree is not None
-                         else [None] * len(jax.tree.leaves(tree)))
+                         else [default] * len(jax.tree.leaves(tree)))
             return jax.tree_util.tree_unflatten(
                 jax.tree_util.tree_structure(tree),
                 [jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
                  for x, s in zip(jax.tree.leaves(tree), sh_leaves)])
 
-        target = {"params": abstract(
-            example_state.params,
-            shardings.params if shardings is not None else None)}
         on_disk_opt = meta.get("has_opt_state", not release)
-        if load_optim and on_disk_opt:
-            target["opt_state"] = abstract(
-                example_state.opt_state,
-                shardings.opt_state if shardings is not None else None)
+
+        def make_target(default_sharding):
+            target = {"params": abstract(
+                example_state.params,
+                shardings.params if shardings is not None else None,
+                default_sharding)}
+            if load_optim and on_disk_opt:
+                target["opt_state"] = abstract(
+                    example_state.opt_state,
+                    shardings.opt_state if shardings is not None else None,
+                    default_sharding)
+            return target
 
         def _restore_args(leaf):
             return ocp.ArrayRestoreArgs(
                 sharding=getattr(leaf, "sharding", None) or None,
                 global_shape=leaf.shape, dtype=leaf.dtype)
 
-        # partial_restore: unwanted subtrees (optimizer moments for
-        # finetune / inference loads) are never read off disk — a 70B
-        # Adam state must not materialize just to be discarded
-        with ocp.PyTreeCheckpointer() as ckptr:
-            restored = ckptr.restore(state_path, args=ocp.args.PyTreeRestore(
-                item=target,
-                restore_args=jax.tree.map(_restore_args, target),
-                partial_restore=True))
+        def do_restore(target):
+            # partial_restore: unwanted subtrees (optimizer moments for
+            # finetune / inference loads) are never read off disk — a 70B
+            # Adam state must not materialize just to be discarded
+            with ocp.PyTreeCheckpointer() as ckptr:
+                return ckptr.restore(
+                    state_path, args=ocp.args.PyTreeRestore(
+                        item=target,
+                        restore_args=jax.tree.map(_restore_args, target),
+                        partial_restore=True))
+
+        try:
+            # no explicit shardings: let orbax re-apply the layout from
+            # the save-time sharding file (sharded resume on one mesh)
+            restored = do_restore(make_target(None))
+        except ValueError as e:
+            # the sharding file names devices that don't exist here (e.g.
+            # TPU-saved checkpoint restored on CPU, or a resized mesh):
+            # checkpoints are topology-free, so land everything on local
+            # device 0 and let the caller's jit re-shard. Only the
+            # sharding-resolution failure is retried — tree/shape
+            # mismatches must surface as-is.
+            if "sharding" not in str(e).lower():
+                raise
+            restored = do_restore(make_target(
+                jax.sharding.SingleDeviceSharding(jax.devices()[0])))
         params = restored["params"]
         opt_state = (restored["opt_state"] if load_optim and on_disk_opt
                      else example_state.opt_state)

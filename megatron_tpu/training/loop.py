@@ -118,12 +118,9 @@ def train(
             if init_params_fn is not None:
                 # custom model family (BERT/T5/ICT): build state from ITS
                 # param tree, not the GPT default
-                from megatron_tpu.training import optimizer as _opt
-                params = init_params_fn()
-                state = TrainState(
-                    params=params,
-                    opt_state=_opt.init_optimizer(params, cfg.optimizer),
-                    iteration=jnp.zeros((), jnp.int32))
+                from megatron_tpu.training.train_step import \
+                    state_from_params
+                state = state_from_params(init_params_fn(), cfg)
             else:
                 state = init_train_state(rng, cfg)
 

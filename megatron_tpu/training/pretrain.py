@@ -29,17 +29,12 @@ def run_pretrain(
     make_train_step contract: (params, microbatch_dict, rng) -> scalar."""
     from megatron_tpu.data.samplers import DictBatchIterator
     from megatron_tpu.training import checkpointing as ckpt
-    from megatron_tpu.training import optimizer as opt
     from megatron_tpu.training.loop import train
-    from megatron_tpu.training.train_step import TrainState
+    from megatron_tpu.training.train_step import state_from_params
     from megatron_tpu.utils.logging import print_rank_0
 
     rng = jax.random.PRNGKey(cfg.training.seed)
-    params = init_params_fn()
-    state = TrainState(
-        params=params,
-        opt_state=opt.init_optimizer(params, cfg.optimizer),
-        iteration=jax.numpy.zeros((), jax.numpy.int32))
+    state = state_from_params(init_params_fn(), cfg)
 
     start_iteration, consumed = 0, 0
     load_dir = cfg.training.load_dir or cfg.training.checkpoint_dir

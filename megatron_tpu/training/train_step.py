@@ -40,16 +40,22 @@ class TrainState(NamedTuple):
     iteration: jax.Array  # i32: completed iterations (incl. skipped)
 
 
-def init_train_state(rng, cfg: MegatronConfig) -> TrainState:
-    params = lm.model_init(rng, cfg.model)
+def state_from_params(params, cfg: MegatronConfig) -> TrainState:
+    """Fresh TrainState around an existing param tree (any model family).
+    fp16 compute seeds the dynamic loss scaler (ref: Float16Optimizer
+    grad-scaler wiring, optimizer.py:469-530)."""
     return TrainState(
         params=params,
         opt_state=opt.init_optimizer(
             params, cfg.optimizer,
-            compute_dtype=jnp.dtype(cfg.model.compute_dtype)
-            if cfg.model.compute_dtype in ("float16",) else jnp.float32),
+            compute_dtype=jnp.float16
+            if cfg.model.compute_dtype == "float16" else jnp.float32),
         iteration=jnp.zeros((), jnp.int32),
     )
+
+
+def init_train_state(rng, cfg: MegatronConfig) -> TrainState:
+    return state_from_params(lm.model_init(rng, cfg.model), cfg)
 
 
 def _tree_add(a, b):

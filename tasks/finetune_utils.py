@@ -17,11 +17,13 @@ import numpy as np
 from megatron_tpu.config import MegatronConfig
 
 
-def _batches(dataset, batch_size: int, shuffle_rng=None):
+def _batches(dataset, batch_size: int, shuffle_rng=None,
+             drop_last: bool = True):
     idxs = np.arange(len(dataset))
     if shuffle_rng is not None:
         shuffle_rng.shuffle(idxs)
-    for lo in range(0, len(idxs) - batch_size + 1, batch_size):
+    stop = len(idxs) - batch_size + 1 if drop_last else len(idxs)
+    for lo in range(0, stop, batch_size):
         items = [dataset[int(i)] for i in idxs[lo:lo + batch_size]]
         yield {k: np.stack([it[k] for it in items]) for k in items[0]}
 
@@ -30,7 +32,10 @@ def evaluate_accuracy(params, dataset, forward_fn, batch_size: int) -> float:
     """argmax-accuracy over a labeled dataset
     (ref: tasks/eval_utils.py accuracy_func_provider)."""
     correct = total = 0
-    for batch in _batches(dataset, batch_size):
+    # keep the tail batch: dropping it would silently exclude samples
+    # from every reported accuracy (the smaller final batch costs one
+    # extra jit specialization)
+    for batch in _batches(dataset, batch_size, drop_last=False):
         logits = forward_fn(params, batch)
         pred = np.asarray(jnp.argmax(logits, axis=-1))
         correct += int((pred == batch["label"]).sum())
@@ -86,8 +91,14 @@ def finetune_and_evaluate(
         loaded, _, _ = ckpt.load_checkpoint(
             pretrained_checkpoint, example, finetune=True)
         if loaded is not None:
+            def _concrete(tree):
+                # orbax partial_restore returns ShapeDtypeStruct
+                # placeholders for subtrees absent on disk (the fresh
+                # head); installing those would crash the first step
+                return all(isinstance(x, (jax.Array, np.ndarray))
+                           for x in jax.tree.leaves(tree))
             for k, v in loaded.params.items():
-                if k in params:
+                if k in params and _concrete(v):
                     params[k] = v
 
     state = TrainState(params=params,
@@ -115,7 +126,7 @@ def finetune_and_evaluate(
         tokentype_ids=jnp.asarray(b["tokentype_ids"]),
         padding_mask=jnp.asarray(b["padding_mask"])))
 
-    bs = cfg.training.micro_batch_size * (cfg.parallel.data_parallel or 1)
+    bs = bs_total
     rng = jax.random.PRNGKey(seed)
     shuffle = np.random.RandomState(seed)
     best = last = 0.0

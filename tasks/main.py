@@ -22,8 +22,10 @@ from megatron_tpu.utils.platform import ensure_env_platform
 def get_tasks_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("tasks", description=__doc__)
     p.add_argument("--task", required=True,
-                   choices=["WIKITEXT103", "LAMBADA", "MNLI", "QQP", "RACE"],
-                   help="Task name (ref: tasks/main.py:19).")
+                   choices=["WIKITEXT103", "LAMBADA", "MNLI", "QQP", "RACE",
+                            "NQ"],
+                   help="Task name (ref: tasks/main.py:19; NQ = ORQA "
+                        "retriever eval, ref: tasks/orqa/evaluate_orqa.py).")
     p.add_argument("--valid_data", nargs="+", required=True)
     p.add_argument("--train_data", nargs="*", default=None,
                    help="finetuning data (MNLI/QQP/RACE)")
@@ -48,7 +50,77 @@ def get_tasks_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_layers", type=int, default=12)
     p.add_argument("--hidden_size", type=int, default=768)
     p.add_argument("--num_attention_heads", type=int, default=12)
+    # retriever eval (ref: tasks/main.py:38-51 retriever args)
+    p.add_argument("--evidence_data_path", default=None,
+                   help="DPR-style evidence TSV (id, text, title)")
+    p.add_argument("--embedding_path", default=None,
+                   help="evidence embedding store (.npz) built by "
+                        "tools/create_doc_index.py")
+    p.add_argument("--retriever_seq_length", type=int, default=256)
+    p.add_argument("--faiss_topk_retrievals", type=int, default=100)
+    p.add_argument("--faiss_match", default="string",
+                   choices=["string", "regex"])
+    p.add_argument("--ict_head_size", type=int, default=128)
+    p.add_argument("--biencoder_shared_query_context_model",
+                   action="store_true")
     return p
+
+
+def load_biencoder(args, vocab_size: int, seq_length: int):
+    """Biencoder checkpoint -> (params, ModelConfig)
+    (ref: checkpointing.py load_biencoder_checkpoint)."""
+    import jax
+
+    from megatron_tpu.models import biencoder
+    from megatron_tpu.models.bert import bert_config
+    from megatron_tpu.training.checkpointing import (
+        load_checkpoint, load_config_from_checkpoint)
+    from megatron_tpu.training.train_step import TrainState
+
+    cfg = load_config_from_checkpoint(args.load)
+    mcfg = cfg.model if cfg is not None else bert_config(
+        num_layers=args.num_layers, hidden_size=args.hidden_size,
+        num_attention_heads=args.num_attention_heads,
+        vocab_size=vocab_size, seq_length=seq_length,
+        max_position_embeddings=seq_length)
+    params = biencoder.biencoder_init(
+        jax.random.PRNGKey(0), mcfg, ict_head_size=args.ict_head_size,
+        shared=args.biencoder_shared_query_context_model)
+    example = TrainState(params=params, opt_state=None, iteration=0)
+    state, _, _ = load_checkpoint(args.load, example, no_load_optim=True)
+    if state is None:
+        raise SystemExit(f"no biencoder checkpoint under {args.load}")
+    return state.params, mcfg
+
+
+def run_nq_task(args) -> dict:
+    """ORQA retriever eval: NQ top-k retrieval accuracy
+    (ref: tasks/orqa/evaluate_orqa.py + evaluate_utils.py)."""
+    from megatron_tpu.data.orqa_dataset import OpenRetrievalEvidenceDataset
+    from megatron_tpu.data.tokenizers import build_tokenizer
+    from tasks.orqa.evaluate import ORQAEvaluator
+
+    assert args.load, "--task NQ needs --load (biencoder checkpoint)"
+    assert args.evidence_data_path and args.embedding_path, \
+        "--task NQ needs --evidence_data_path and --embedding_path"
+    tokenizer = build_tokenizer(
+        args.tokenizer_type, vocab_file=args.vocab_file,
+        merge_file=args.merge_file, tokenizer_model=args.tokenizer_model)
+    params, mcfg = load_biencoder(args, tokenizer.vocab_size,
+                                  args.retriever_seq_length)
+    evidence = OpenRetrievalEvidenceDataset(
+        args.evidence_data_path, tokenizer, args.retriever_seq_length)
+    evaluator = ORQAEvaluator(params, mcfg, evidence_dataset=evidence,
+                              embedding_path=args.embedding_path)
+    metrics = {}
+    for path in args.valid_data:
+        metrics[path] = evaluator.evaluate(
+            path, tokenizer, seq_length=args.retriever_seq_length,
+            top_k=args.faiss_topk_retrievals,
+            batch_size=args.micro_batch_size,
+            match_type=args.faiss_match)
+    print(json.dumps({"task": "NQ", **metrics}))
+    return metrics
 
 
 def run_finetune_task(args) -> dict:
@@ -173,6 +245,8 @@ def main():
     args = get_tasks_parser().parse_args()
     if args.task in ("MNLI", "QQP", "RACE"):
         run_finetune_task(args)
+    elif args.task == "NQ":
+        run_nq_task(args)
     else:
         assert args.load, "--load required for zero-shot tasks"
         run_task(args)

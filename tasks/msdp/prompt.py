@@ -1,0 +1,178 @@
+"""Multi-stage dialogue prompting: knowledge + response generation.
+
+TPU-native equivalent of the reference's MSDP prompting stage
+(ref: tasks/msdp/prompt.py:38-308): few-shot prompts ++ the dialogue
+context are fed to a pretrained GPT model (in-process Generator or a
+running REST server), one greedy generation per test sample, first line
+kept.
+
+Test file format (WoW/WoI preprocessed): TAB-separated
+`topic\tdialogue turns ([SEP]-joined)[\tknowledge]` per line. Knowledge
+prompts file: JSONL {"<topic> <last turn>": [example, ...]}; response
+prompts file: plain text, one example per line.
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Callable, Dict, List, Optional
+
+
+def _simple_word_tokenize(text: str) -> List[str]:
+    """Whitespace+punctuation splitter standing in for nltk.word_tokenize
+    in the response-prompt construction (ref: prompt.py:122-124)."""
+    return re.findall(r"\w+|[^\w\s]", text, re.UNICODE)
+
+
+def read_prompts(prompt_path: str, prompt_type: str,
+                 n_example: int):
+    """(ref: prompt.py:38-72): knowledge prompts are a per-key dict of
+    example lists; response prompts are one fixed few-shot string."""
+    if prompt_type == "knowledge":
+        prompt_dict: Dict[str, str] = {}
+        with open(prompt_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                (key, examples), = d.items()
+                if key not in prompt_dict:
+                    prompt_dict[key] = "".join(
+                        ex.strip() + " \n"
+                        for ex in examples[:n_example])
+        return prompt_dict
+    with open(prompt_path, encoding="utf-8") as f:
+        examples = f.readlines()[:n_example]
+    return "".join(ex.strip() + " \n" for ex in examples)
+
+
+def build_input(test_line: str, prompt_type: str, prompts) -> str:
+    """One test row -> full model input
+    (ref: prompt.py:96-130,216-238): knowledge mode appends
+    `( last_turn ) topic =>`; response mode appends the
+    Topic/User-says/We-know-that/System-replies template."""
+    splits = test_line.strip().split("\t")
+    topic = splits[0]
+    turns = splits[1].split(" [SEP] ")
+    last_turn = turns[-1]
+    if prompt_type == "knowledge":
+        key = topic + " " + last_turn
+        base = prompts[key]
+        return base + "( " + last_turn + " ) " + topic + " =>"
+    knowledge = " ".join(_simple_word_tokenize(splits[2])).strip()
+    last = " ".join(_simple_word_tokenize(last_turn)).strip()
+    return (prompts + "Topic: " + topic + ". "
+            + "User says: " + last + " "
+            + "We know that: " + knowledge + " "
+            + "System replies:")
+
+
+def _first_line(generation: str, input_text: str) -> str:
+    """Strip the echoed prompt, keep the first generated line
+    (ref: prompt.py:31-35,266-272)."""
+    out = generation[len(input_text):] if \
+        generation.startswith(input_text) else generation
+    return out.split("\n")[0].strip()
+
+
+def generate_samples(test_lines: List[str], *, prompt_type: str,
+                     prompts, generate_fn: Callable[[str, int], str],
+                     out_seq_length: int = 100,
+                     log_interval: int = 20) -> List[str]:
+    """Prompt the model once per test sample
+    (ref: prompt.py:154-288 generate_samples_by_prompting_input_from_file).
+    `generate_fn(input_text, max_new_tokens) -> full generation text`."""
+    assert prompt_type in ("knowledge", "response"), \
+        "Please input a correct prompt type!"
+    outputs = []
+    for i, line in enumerate(test_lines):
+        if not line.strip():
+            continue
+        inputs = build_input(line, prompt_type, prompts)
+        generation = generate_fn(inputs, out_seq_length)
+        outputs.append(_first_line(generation, inputs))
+        if log_interval and (i + 1) % log_interval == 0:
+            print(f"msdp: generated {i + 1}/{len(test_lines)}",
+                  flush=True)
+    return outputs
+
+
+def make_generator_fn(generator, tokenizer) -> Callable[[str, int], str]:
+    """In-process greedy generation (the reference's non-api path uses
+    top_k=1 greedy sampling, ref: prompt.py:240-265). Returns ONLY the
+    continuation: the prompt is stripped at the token boundary, so lossy
+    tokenizer roundtrips can't leave prompt fragments in the output."""
+    from megatron_tpu.inference.generation import SamplingParams
+
+    def fn(text: str, max_new: int) -> str:
+        prompt_ids = tokenizer.tokenize(text)
+        tokens, lengths, _ = generator.generate(
+            [prompt_ids], max_new, sampling=SamplingParams(top_k=1))
+        new_ids = tokens[0, len(prompt_ids):lengths[0]].tolist()
+        # the caller strips nothing further: hand back prompt + completion
+        # shaped like the api path so _first_line works uniformly
+        return text + tokenizer.detokenize(new_ids)
+
+    return fn
+
+
+def make_api_fn(url: str) -> Callable[[str, int], str]:
+    """REST-server generation against our /api contract
+    (ref: prompt.py:19-35 call_model_api)."""
+    import requests
+
+    def fn(text: str, max_new: int) -> str:
+        r = requests.put(
+            url, headers={"Content-Type":
+                          "application/json; charset=UTF-8"},
+            data=json.dumps({"prompts": [text],
+                             "tokens_to_generate": max_new,
+                             "top_k": 1}))
+        return r.json()["text"][0]
+
+    return fn
+
+
+def run_prompting(args) -> int:
+    """CLI body shared with tasks/msdp/main.py."""
+    with open(args.sample_input_file, encoding="utf-8") as f:
+        test_lines = f.readlines()
+    prompts = read_prompts(args.prompt_file, args.prompt_type,
+                           args.num_prompt_examples)
+
+    if args.megatron_api_url:
+        generate_fn = make_api_fn(args.megatron_api_url)
+    else:
+        import jax
+
+        from megatron_tpu.data.tokenizers import build_tokenizer
+        from megatron_tpu.inference.generation import Generator
+        from megatron_tpu.training import init_train_state
+        from megatron_tpu.training.checkpointing import (
+            load_checkpoint, load_config_from_checkpoint)
+
+        cfg = load_config_from_checkpoint(args.load)
+        if cfg is None:
+            raise SystemExit(f"no checkpoint under {args.load}")
+        tokenizer = build_tokenizer(
+            args.tokenizer_type, vocab_file=args.vocab_file,
+            merge_file=args.merge_file,
+            tokenizer_model=args.tokenizer_model)
+        example = init_train_state(jax.random.PRNGKey(0), cfg)
+        state, _, _ = load_checkpoint(args.load, example,
+                                      no_load_optim=True)
+        eos = tokenizer.eod if tokenizer.eod is not None else 0
+        generator = Generator(state.params, cfg.model, eos)
+        generate_fn = make_generator_fn(generator, tokenizer)
+
+    outputs = generate_samples(
+        test_lines, prompt_type=args.prompt_type, prompts=prompts,
+        generate_fn=generate_fn, out_seq_length=args.out_seq_length)
+    out_path = args.sample_output_file or \
+        args.sample_input_file + ".out"
+    with open(out_path, "w", encoding="utf-8") as f:
+        for line in outputs:
+            f.write(line + "\n")
+    print(f"msdp: wrote {len(outputs)} generations -> {out_path}")
+    return 0

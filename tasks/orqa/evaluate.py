@@ -1,0 +1,86 @@
+"""ORQA retriever evaluation: NQ top-k retrieval accuracy.
+
+TPU-native equivalent of the reference's ORQAEvaluator
+(ref: tasks/orqa/evaluate_utils.py:19-191, evaluate_orqa.py): embed every
+NQ question with the biencoder's query tower, exact-MIPS search the
+evidence embedding store, and score answer presence in the retrieved
+passages. The reference splits the FAISS search across nodes and
+all-gathers; on TPU the whole index is one matmul per query batch.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.data.orqa_dataset import (NQDataset,
+                                            OpenRetrievalEvidenceDataset)
+from megatron_tpu.data.realm_index import (OpenRetrievalDataStore,
+                                           build_mips_index)
+from tasks.orqa.qa_utils import calculate_matches
+
+
+class ORQAEvaluator:
+    """(ref: tasks/orqa/evaluate_utils.py:19-191)"""
+
+    def __init__(self, params, cfg: ModelConfig, *,
+                 evidence_dataset: OpenRetrievalEvidenceDataset,
+                 embedding_path: str):
+        from megatron_tpu.models.biencoder import _towers, embed_text
+        self.cfg = cfg
+        self.evidence_dataset = evidence_dataset
+        store = OpenRetrievalDataStore(embedding_path, load_from_path=True)
+        assert len(store), f"empty embedding store at {embedding_path}"
+        self.mips_index = build_mips_index(store)
+
+        query_tower, _ = _towers(params)
+
+        def embed(tokens, types, pad_mask):
+            return embed_text(query_tower, tokens, cfg,
+                              padding_mask=pad_mask, tokentype_ids=types,
+                              deterministic=True)
+
+        self._embed = jax.jit(embed)
+
+    def generate_query_vectors(self, qa_path: str, tokenizer,
+                               seq_length: int, batch_size: int = 64):
+        """(ref: evaluate_utils.py:77-108 generate_query_vectors)"""
+        dataset = NQDataset(qa_path, tokenizer, seq_length)
+        vecs, references = [], []
+        for batch in dataset.batches(batch_size):
+            q = self._embed(jnp.asarray(batch["token_ids"]),
+                            jnp.asarray(batch["token_types"]),
+                            jnp.asarray(batch["token_mask"]))
+            vecs.append(np.asarray(q)[:batch["n_real"]])
+            references.extend(batch["reference"])
+        query = np.concatenate(vecs, axis=0)
+        assert len(query) == len(dataset)
+        return query, references
+
+    def evaluate(self, qa_path: str, tokenizer, *, seq_length: int = 64,
+                 top_k: int = 100, batch_size: int = 64,
+                 match_type: str = "string", split: str = "test") -> dict:
+        """-> {"top1": ..., "top5": ..., "top20": ..., "top100": ...}
+        fractional retrieval accuracies
+        (ref: evaluate_utils.py:110-191 evaluate + retrieval_results
+        top-k reporting)."""
+        query, references = self.generate_query_vectors(
+            qa_path, tokenizer, seq_length, batch_size)
+        scores, ids = self.mips_index.search_mips_index(query, top_k)
+        closest = [(list(ids[i]), list(scores[i]))
+                   for i in range(len(query))]
+        stats = calculate_matches(self.evidence_dataset.id2text,
+                                  references, closest,
+                                  match_type=match_type)
+        n = len(query)
+        metrics = {}
+        for k in sorted({1, 5, 20, 100} | {top_k}):
+            if k <= len(stats.top_k_hits):
+                metrics[f"top{k}"] = stats.top_k_hits[k - 1] / n
+        line = f"Retriever eval ({split}): " + " | ".join(
+            f"top-{k.lstrip('top')}: {v:.4f}" for k, v in metrics.items())
+        print(line, flush=True)
+        return metrics

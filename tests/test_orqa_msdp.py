@@ -1,0 +1,275 @@
+"""ORQA retrieval + MSDP prompting harness tests.
+
+Contract ports: reference tasks/orqa/unsupervised/qa_utils.py (answer
+matching + top-k hit accounting), megatron/data/realm_index.py
+(datastore shard/merge persistence), megatron/indexer.py (context-tower
+index pass), tasks/msdp/metrics.py (normalized token F1) and
+tasks/msdp/prompt.py (prompt construction).
+"""
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from megatron_tpu.data.orqa_dataset import (NQDataset,
+                                            OpenRetrievalEvidenceDataset)
+from megatron_tpu.data.realm_index import (OpenRetrievalDataStore,
+                                           build_mips_index)
+from megatron_tpu.data.tokenizers import BertWordPieceTokenizer
+from megatron_tpu.models.bert import bert_config
+from tasks.msdp.metrics import F1Metric, normalize_answer
+from tasks.orqa.qa_utils import calculate_matches, has_answer
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+         "the", "quick", "brown", "fox", "dog", "cat", "bird", "runs",
+         "paris", "france", "london", "capital", "of", "is", "what"]
+
+
+@pytest.fixture()
+def wp(tmp_path):
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n")
+    return BertWordPieceTokenizer(str(p))
+
+
+@pytest.fixture()
+def evidence_tsv(tmp_path):
+    rows = [("id", "text", "title"),
+            (1, "paris is the capital of france", "France"),
+            (2, "london is the capital", "London"),
+            (3, "the quick brown fox", "Fox")]
+    p = tmp_path / "psgs.tsv"
+    p.write_text("\n".join("\t".join(str(c) for c in r) for r in rows)
+                 + "\n")
+    return str(p)
+
+
+class TestQAUtils:
+    def test_string_match_token_level(self):
+        assert has_answer(["Paris"], "paris is the capital of france")
+        # substring inside a longer word must NOT match at token level
+        assert not has_answer(["par"], "paris is the capital")
+
+    def test_multi_token_answer(self):
+        assert has_answer(["capital of france"],
+                          "paris is the capital of france!")
+        assert not has_answer(["capital of spain"],
+                              "paris is the capital of france")
+
+    def test_unicode_and_case(self):
+        assert has_answer(["café"], "the CAFÉ is open")
+
+    def test_regex_match(self):
+        assert has_answer([r"cap\w+al"], "the capital city",
+                          match_type="regex")
+        assert not has_answer([r"^xyz$"], "the capital city",
+                              match_type="regex")
+
+    def test_calculate_matches_topk_cumulative(self):
+        docs = {1: ("paris is the capital", "t1"),
+                2: ("london town", "t2"),
+                3: ("berlin wall", "t3")}
+        answers = [["paris"], ["berlin"], ["madrid"]]
+        closest = [([1, 2, 3], [9.0, 8.0, 7.0]),   # hit at rank 1
+                   ([2, 1, 3], [9.0, 8.0, 7.0]),   # hit at rank 3
+                   ([1, 2, 3], [9.0, 8.0, 7.0])]   # miss
+        stats = calculate_matches(docs, answers, closest)
+        assert stats.top_k_hits == [1, 1, 2]
+        assert stats.questions_doc_hits[0] == [True, False, False]
+        assert stats.questions_doc_hits[1] == [False, False, True]
+
+
+class TestDataStore:
+    def test_save_load_roundtrip(self, tmp_path):
+        path = str(tmp_path / "emb.npz")
+        store = OpenRetrievalDataStore(path, load_from_path=False)
+        embeds = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        store.add_block_data([3, 1, 4, 7], embeds)
+        store.save()
+        loaded = OpenRetrievalDataStore(path)
+        assert len(loaded) == 4
+        np.testing.assert_allclose(loaded.embed_data[4],
+                                   embeds[2].astype(np.float16))
+
+    def test_shard_merge(self, tmp_path):
+        path = str(tmp_path / "emb.npz")
+        for rank, ids in enumerate(([0, 1], [2, 3])):
+            shard = OpenRetrievalDataStore(path, load_from_path=False,
+                                           rank=rank)
+            shard.add_block_data(ids, np.ones((2, 8)) * rank)
+            shard.save_shard()
+        store = OpenRetrievalDataStore(path, load_from_path=False)
+        store.merge_shards_and_save()
+        assert len(store) == 4
+        loaded = OpenRetrievalDataStore(path)
+        assert loaded.embed_data[3][0] == 1.0
+
+    def test_duplicate_ids_rejected(self, tmp_path):
+        store = OpenRetrievalDataStore(str(tmp_path / "e.npz"),
+                                       load_from_path=False)
+        store.add_block_data([1], np.ones((1, 4)))
+        with pytest.raises(ValueError):
+            store.add_block_data([1], np.ones((1, 4)))
+
+    def test_mips_from_store(self, tmp_path):
+        store = OpenRetrievalDataStore(str(tmp_path / "e.npz"),
+                                       load_from_path=False)
+        mat = np.eye(4, dtype=np.float32)
+        store.add_block_data([10, 20, 30, 40], mat)
+        index = build_mips_index(store)
+        scores, ids = index.search_mips_index(mat[:2], top_k=1)
+        assert list(ids[:, 0]) == [10, 20]
+
+
+class TestEvidenceAndNQDatasets:
+    def test_evidence_rows_and_tokens(self, evidence_tsv, wp):
+        ds = OpenRetrievalEvidenceDataset(evidence_tsv, wp, 16)
+        assert len(ds) == 3
+        s = ds[0]
+        assert s["row_id"] == 1
+        assert s["context"][0] == wp.cls
+        assert s["context_pad_mask"].sum() > 0
+        assert ds.id2text[1][1] == "France"
+
+    def test_evidence_shard_batches_cover_all(self, evidence_tsv, wp):
+        ds = OpenRetrievalEvidenceDataset(evidence_tsv, wp, 16)
+        seen = []
+        for shard in range(2):
+            for b in ds.batches(2, shard=shard, num_shards=2):
+                seen.extend(b["row_id"][:b["n_real"]].tolist())
+        assert sorted(seen) == [1, 2, 3]
+
+    def test_nq_tsv_and_jsonl(self, tmp_path, wp):
+        tsv = tmp_path / "nq.tsv"
+        tsv.write_text("what is the capital of france\t['Paris']\n")
+        ds = NQDataset(str(tsv), wp, 16)
+        assert len(ds) == 1 and ds[0]["reference"] == ["Paris"]
+        jl = tmp_path / "nq.jsonl"
+        jl.write_text(json.dumps({"question": "q", "answers": ["a", "b"]})
+                      + "\n")
+        ds2 = NQDataset(str(jl), wp, 16)
+        assert ds2[0]["reference"] == ["a", "b"]
+
+
+class TestIndexAndEvaluateE2E:
+    def test_index_build_and_nq_eval(self, tmp_path, evidence_tsv, wp):
+        """Tiny biencoder end-to-end: index the evidence, search NQ
+        queries, score answer presence — the full --task NQ path."""
+        from megatron_tpu.indexer import IndexBuilder
+        from megatron_tpu.models.biencoder import biencoder_init
+        from tasks.orqa.evaluate import ORQAEvaluator
+
+        cfg = bert_config(num_layers=2, hidden_size=32,
+                          num_attention_heads=2,
+                          vocab_size=wp.vocab_size, seq_length=16,
+                          max_position_embeddings=16)
+        params = biencoder_init(jax.random.PRNGKey(0), cfg,
+                                ict_head_size=16)
+        evidence = OpenRetrievalEvidenceDataset(evidence_tsv, wp, 16)
+        emb_path = str(tmp_path / "emb.npz")
+        builder = IndexBuilder(params, cfg, evidence,
+                               embedding_path=emb_path, batch_size=2,
+                               log_interval=0)
+        store = builder.build_and_save_index()
+        assert len(store) == 3
+
+        qa = tmp_path / "nq.tsv"
+        qa.write_text(
+            "what is the capital of france\t['paris']\n"
+            "what runs\t['zebra']\n")
+        evaluator = ORQAEvaluator(params, cfg, evidence_dataset=evidence,
+                                  embedding_path=emb_path)
+        metrics = evaluator.evaluate(str(qa), wp, seq_length=16, top_k=3,
+                                     batch_size=2)
+        # with top_k=3 ALL evidence docs are retrieved for every query:
+        # 'paris' is in doc 1 -> hit, 'zebra' is nowhere -> miss => 1/2
+        assert abs(metrics["top3"] - 0.5) < 1e-9
+        assert metrics["top1"] <= metrics["top3"]
+
+    def test_topk_hits_present(self, tmp_path, evidence_tsv, wp):
+        from megatron_tpu.indexer import IndexBuilder
+        from megatron_tpu.models.biencoder import biencoder_init
+        from tasks.orqa.evaluate import ORQAEvaluator
+
+        cfg = bert_config(num_layers=1, hidden_size=32,
+                          num_attention_heads=2,
+                          vocab_size=wp.vocab_size, seq_length=16,
+                          max_position_embeddings=16)
+        params = biencoder_init(jax.random.PRNGKey(1), cfg)
+        evidence = OpenRetrievalEvidenceDataset(evidence_tsv, wp, 16)
+        emb_path = str(tmp_path / "e.npz")
+        IndexBuilder(params, cfg, evidence, embedding_path=emb_path,
+                     batch_size=4, log_interval=0).build_and_save_index()
+        qa = tmp_path / "q.tsv"
+        qa.write_text("capital of france\t['france']\n")
+        ev = ORQAEvaluator(params, cfg, evidence_dataset=evidence,
+                           embedding_path=emb_path)
+        m = ev.evaluate(str(qa), wp, seq_length=16, top_k=3)
+        # 'france' appears in evidence row 1; with all 3 docs retrieved
+        # the answer is found somewhere in the top-3
+        assert m.get("top1", 0.0) in (0.0, 1.0)
+
+
+class TestMSDPMetrics:
+    def test_normalize(self):
+        assert normalize_answer("The Quick, Brown Fox!") == \
+            "quick brown fox"
+
+    def test_perfect_and_zero_f1(self):
+        p, r, f1 = F1Metric.compute_each_pair("the cat", "cat")
+        assert (p, r, f1) == (1.0, 1.0, 1.0)
+        p, r, f1 = F1Metric.compute_each_pair("dog", "cat")
+        assert f1 == 0.0
+
+    def test_partial_overlap(self):
+        p, r, f1 = F1Metric.compute_each_pair("big red dog", "red cat")
+        assert abs(p - 1 / 3) < 1e-9 and abs(r - 0.5) < 1e-9
+
+    def test_empty_reference_skipped(self):
+        p, r, f1 = F1Metric.compute_all_pairs(["x", "red"], ["", "red"])
+        assert f1 == 1.0  # the empty-reference pair is skipped
+
+    def test_evaluate_f1_files(self, tmp_path):
+        from tasks.msdp.evaluate import evaluate_f1
+        g = tmp_path / "g.txt"
+        a = tmp_path / "a.txt"
+        g.write_text("red dog<|endoftext|>\nhello\n")
+        a.write_text("red dog\nno_passages_used\n")
+        out = evaluate_f1(str(g), str(a))
+        assert abs(out["f1"] - 1.0) < 1e-9
+
+
+class TestMSDPPrompt:
+    def test_read_knowledge_prompts(self, tmp_path):
+        from tasks.msdp.prompt import read_prompts
+        p = tmp_path / "k.jsonl"
+        p.write_text(json.dumps(
+            {"topic hi": ["( hi ) topic => fact one"]}) + "\n")
+        d = read_prompts(str(p), "knowledge", 10)
+        assert d["topic hi"].startswith("( hi ) topic => fact one")
+
+    def test_build_inputs_both_modes(self, tmp_path):
+        from tasks.msdp.prompt import build_input, read_prompts
+        kp = {"France hello": "examples \n"}
+        text = build_input("France\thi [SEP] hello", "knowledge", kp)
+        assert text.endswith("( hello ) France =>")
+        rp = tmp_path / "r.txt"
+        rp.write_text("example line\n")
+        prompt = read_prompts(str(rp), "response", 1)
+        text = build_input("France\thello\tparis is big", "response",
+                           prompt)
+        assert "We know that: paris is big" in text
+        assert text.endswith("System replies:")
+
+    def test_generate_samples_greedy_fn(self):
+        from tasks.msdp.prompt import generate_samples
+
+        def fake_gen(text, n):
+            return text + " GENERATED\nsecond line"
+
+        outs = generate_samples(
+            ["France\thi [SEP] hello"], prompt_type="knowledge",
+            prompts={"France hello": "few shot \n"},
+            generate_fn=fake_gen, log_interval=0)
+        assert outs == ["GENERATED"]
