@@ -56,6 +56,7 @@ def build_parser(extra_args_provider: Optional[Callable] = None
     g.add_argument("--hidden_dropout", type=float, default=0.0)
     g.add_argument("--attention_dropout", type=float, default=0.0)
     g.add_argument("--lima_dropout", action="store_true")
+    g.add_argument("--drop_path_rate", type=float, default=0.0)
     g.add_argument("--tie_embed_logits", action="store_true")
     g.add_argument("--init_method_std", type=float, default=0.02)
     g.add_argument("--bf16", action="store_true")

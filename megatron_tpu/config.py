@@ -86,6 +86,9 @@ class ModelConfig:
     attention_dropout: float = 0.0
     # LIMA-style per-layer dropout ramp (ref: transformer.py:963-970)
     lima_dropout: bool = False
+    # stochastic depth, ramped linspace(0, rate, L) over layers
+    # (ref: transformer.py:43-63 DropPath, :961 drop_path_rates)
+    drop_path_rate: float = 0.0
 
     # numerics
     params_dtype: str = "float32"  # master/param dtype

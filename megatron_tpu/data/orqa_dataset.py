@@ -55,8 +55,17 @@ class OpenRetrievalEvidenceDataset:
                 if len(row) < 3:
                     continue
                 self.rows.append((int(row[0]), row[1], row[2]))
-        self.id2text: Dict[int, Tuple[str, str]] = {
-            rid: (text, title) for rid, text, title in self.rows}
+        self._id2text: Optional[Dict[int, Tuple[str, str]]] = None
+
+    @property
+    def id2text(self) -> Dict[int, Tuple[str, str]]:
+        """doc_id -> (text, title), built lazily: only answer matching
+        (evaluation) needs it — the indexing pass over a 21M-passage DPR
+        dump must not pay gigabytes for an unused dict."""
+        if self._id2text is None:
+            self._id2text = {rid: (text, title)
+                             for rid, text, title in self.rows}
+        return self._id2text
 
     def __len__(self):
         return len(self.rows)

@@ -32,6 +32,7 @@ from megatron_tpu.config import ModelConfig
 from megatron_tpu.models.attention import attention_apply, attention_axes, attention_init
 from megatron_tpu.models.mlp import mlp_apply, mlp_axes, mlp_init
 from megatron_tpu.models.norms import apply_norm, norm_axes, norm_init
+from megatron_tpu.ops.dropout import drop_path as _drop_path
 from megatron_tpu.ops.dropout import dropout as _dropout
 from megatron_tpu.parallel.sharding import constrain
 
@@ -104,6 +105,7 @@ def layer_apply(
     kv_cache=None,
     layer_number: int = 1,
     hidden_dropout: Optional[float] = None,
+    drop_path_rate=None,
     rng=None,
     deterministic: bool = True,
     segment_ids=None,
@@ -129,9 +131,18 @@ def layer_apply(
     p_drop = cfg.hidden_dropout if hidden_dropout is None else hidden_dropout
     if deterministic:
         rng = None
-    r_attn = r_mlp = r_score = None
+    r_attn = r_mlp = r_score = r_inter = r_dp1 = r_dp2 = None
     if rng is not None:
-        r_attn, r_mlp, r_score = jax.random.split(rng, 3)
+        (r_attn, r_mlp, r_score, r_inter,
+         r_dp1, r_dp2) = jax.random.split(rng, 6)
+
+    def _branch(r_dp, branch):
+        # residual + drop_path(dropout(branch)) when stochastic depth is
+        # on (ref: transformer.py:723-730); drop_path_rate may be a
+        # traced per-layer scalar from the scanned linspace ramp
+        if drop_path_rate is None or r_dp is None:
+            return branch
+        return _drop_path(r_dp, branch, drop_path_rate)
 
     residual = x
     if cfg.use_post_ln:
@@ -156,10 +167,12 @@ def layer_apply(
         else:
             mlp_in = ln_out
         mlp_out = mlp_apply(params["mlp"], mlp_in, cfg)
-        out = residual + _dropout(r_mlp, mlp_out + attn_out, p_drop)
+        out = residual + _branch(r_dp1,
+                                 _dropout(r_mlp, mlp_out + attn_out, p_drop))
     else:
-        ln_in = constrain(residual + _dropout(r_attn, attn_out, p_drop),
-                          RESIDUAL_AXES)
+        ln_in = constrain(
+            residual + _branch(r_dp1, _dropout(r_attn, attn_out, p_drop)),
+            RESIDUAL_AXES)
         if encoder_output is not None and "inter_attention" in params:
             # decoder cross-attention sublayer (ref: transformer.py:782-794)
             ln_x = apply_norm(cfg.norm_type, params["post_inter_norm"],
@@ -168,10 +181,10 @@ def layer_apply(
                 params["inter_attention"], ln_x, cfg,
                 deterministic=deterministic, causal=False,
                 kv_input=encoder_output)
-            ln_in = ln_in + _dropout(r_attn, inter_out, p_drop)
+            ln_in = ln_in + _dropout(r_inter, inter_out, p_drop)
         ln2 = apply_norm(cfg.norm_type, params["post_attn_norm"], ln_in, eps)
         mlp_out = mlp_apply(params["mlp"], ln2, cfg)
-        out = ln_in + _dropout(r_mlp, mlp_out, p_drop)
+        out = ln_in + _branch(r_dp2, _dropout(r_mlp, mlp_out, p_drop))
 
     if cfg.use_post_ln:
         out = apply_norm(cfg.norm_type, params["output_norm"], out, eps)
@@ -206,6 +219,13 @@ def lima_dropout_rates(cfg: ModelConfig, num_layers: int):
     return jnp.linspace(0.0, cfg.hidden_dropout, num_layers, dtype=jnp.float32)
 
 
+def drop_path_rates(cfg: ModelConfig, num_layers: int):
+    """Stochastic-depth ramp: linspace(0, drop_path_rate, L)
+    (ref: transformer.py:961 drop_path_rates)."""
+    return jnp.linspace(0.0, cfg.drop_path_rate, num_layers,
+                        dtype=jnp.float32)
+
+
 def stack_apply(
     stacked_params,
     x,
@@ -229,18 +249,23 @@ def stack_apply(
     num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
     drop_rates = lima_dropout_rates(cfg, cfg.num_layers)
     drop_rates = jax.lax.dynamic_slice_in_dim(drop_rates, layer_offset, num_layers)
+    dp_rates = jax.lax.dynamic_slice_in_dim(
+        drop_path_rates(cfg, cfg.num_layers), layer_offset, num_layers)
+    use_drop_path = cfg.drop_path_rate > 0.0
     layer_ids = layer_offset + jnp.arange(num_layers)
 
     def body(carry, scanned):
         h = carry
-        p, rate, lid, cache = scanned
+        p, rate, dp_rate, lid, cache = scanned
         layer_rng = None
         if rng is not None and not deterministic:
             layer_rng = jax.random.fold_in(rng, lid)
         h, new_cache = layer_apply(
             p, h, cfg, rope_cos=rope_cos, rope_sin=rope_sin,
             position_ids=position_ids, kv_cache=cache,
-            layer_number=lid + 1, hidden_dropout=rate, rng=layer_rng,
+            layer_number=lid + 1, hidden_dropout=rate,
+            drop_path_rate=dp_rate if use_drop_path else None,
+            rng=layer_rng,
             deterministic=deterministic, segment_ids=segment_ids,
             causal=causal, encoder_output=encoder_output)
         return h, new_cache
@@ -254,13 +279,15 @@ def stack_apply(
             body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             prevent_cse=False)
 
-    xs = (stacked_params, drop_rates, layer_ids, kv_caches)
+    xs = (stacked_params, drop_rates, dp_rates, layer_ids, kv_caches)
     if kv_caches is None:
         def body_nocache(carry, scanned):
-            p, rate, lid = scanned
-            h, _ = body(carry, (p, rate, lid, None))
+            p, rate, dp_rate, lid = scanned
+            h, _ = body(carry, (p, rate, dp_rate, lid, None))
             return h, None
-        x, _ = jax.lax.scan(body_nocache, x, (stacked_params, drop_rates, layer_ids))
+        x, _ = jax.lax.scan(body_nocache, x,
+                            (stacked_params, drop_rates, dp_rates,
+                             layer_ids))
         return x, None
     x, new_caches = jax.lax.scan(body, x, xs)
     return x, new_caches

@@ -14,3 +14,15 @@ def dropout(rng, x, rate):
         return x
     keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
     return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+def drop_path(rng, x, rate):
+    """Stochastic depth: zero the whole residual branch PER SAMPLE, scaled
+    by 1/keep (ref: megatron/model/transformer.py:43-63 DropPath). x is
+    [b, ...]; the keep mask broadcasts over everything but batch. `rate`
+    may be a traced per-layer scalar (linspace ramp is scanned)."""
+    if rng is None:
+        return x
+    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    keep = jax.random.bernoulli(rng, 1.0 - rate, shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))

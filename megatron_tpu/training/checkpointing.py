@@ -272,10 +272,11 @@ def load_checkpoint(
             # the sharding file names devices that don't exist here (e.g.
             # TPU-saved checkpoint restored on CPU, or a resized mesh):
             # checkpoints are topology-free, so land everything on local
-            # device 0 and let the caller's jit re-shard. Only the
-            # sharding-resolution failure is retried — tree/shape
-            # mismatches must surface as-is.
-            if "sharding" not in str(e).lower():
+            # device 0 and let the caller's jit re-shard. Only
+            # sharding/device-resolution failures are retried —
+            # tree/shape mismatches must surface as-is.
+            msg = str(e).lower()
+            if "sharding" not in msg and "device" not in msg:
                 raise
             restored = do_restore(make_target(
                 jax.sharding.SingleDeviceSharding(jax.devices()[0])))

@@ -91,15 +91,25 @@ def finetune_and_evaluate(
         loaded, _, _ = ckpt.load_checkpoint(
             pretrained_checkpoint, example, finetune=True)
         if loaded is not None:
-            def _concrete(tree):
-                # orbax partial_restore returns ShapeDtypeStruct
-                # placeholders for subtrees absent on disk (the fresh
-                # head); installing those would crash the first step
-                return all(isinstance(x, (jax.Array, np.ndarray))
-                           for x in jax.tree.leaves(tree))
-            for k, v in loaded.params.items():
-                if k in params and _concrete(v):
-                    params[k] = v
+            # orbax partial_restore returns ShapeDtypeStruct placeholders
+            # for leaves absent on disk (the fresh head); merge leaf-wise,
+            # keeping the fresh init there, and SAY what was skipped — a
+            # silently random encoder reads as a broken finetune
+            skipped = []
+
+            def _merge(path, fresh, restored):
+                if isinstance(restored, (jax.Array, np.ndarray)):
+                    return restored
+                skipped.append(jax.tree_util.keystr(path))
+                return fresh
+
+            params = jax.tree_util.tree_map_with_path(
+                _merge, params, loaded.params)
+            if skipped:
+                print(f"pretrained_checkpoint: kept fresh init for "
+                      f"{len(skipped)} leaves absent on disk: "
+                      f"{', '.join(skipped[:8])}"
+                      f"{' ...' if len(skipped) > 8 else ''}")
 
     state = TrainState(params=params,
                        opt_state=opt.init_optimizer(params, cfg.optimizer),

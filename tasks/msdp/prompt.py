@@ -88,6 +88,9 @@ def generate_samples(test_lines: List[str], *, prompt_type: str,
     outputs = []
     for i, line in enumerate(test_lines):
         if not line.strip():
+            # keep line alignment with the golden answer file (MSDP-EVAL-F1
+            # scores guesses and answers by line number)
+            outputs.append("")
             continue
         inputs = build_input(line, prompt_type, prompts)
         generation = generate_fn(inputs, out_seq_length)

@@ -88,3 +88,64 @@ def test_lima_off_is_constant():
     cfg = cfg_with(hidden_dropout=0.1)
     rates = np.asarray(lima_dropout_rates(cfg, 4))
     np.testing.assert_allclose(rates, 0.1)
+
+
+class TestDropPath:
+    """Stochastic depth (ref: transformer.py:43-63 DropPath,
+    :961 linspace ramp)."""
+
+    def test_op_per_sample_binary_scaled(self):
+        from megatron_tpu.ops.dropout import drop_path
+        x = jnp.ones((64, 4, 8))
+        y = np.asarray(drop_path(jax.random.PRNGKey(0), x, 0.5))
+        # each sample is entirely kept (scaled by 1/keep) or entirely zero
+        per_sample = y.reshape(64, -1)
+        for row in per_sample:
+            assert np.all(row == 0.0) or np.allclose(row, 2.0)
+        # expectation preserved within statistical tolerance
+        assert 0.3 < per_sample.mean() / 2.0 < 0.7
+
+    def test_deterministic_is_identity(self):
+        from megatron_tpu.ops.dropout import drop_path
+        x = jnp.ones((4, 3))
+        np.testing.assert_array_equal(np.asarray(drop_path(None, x, 0.9)),
+                                      np.asarray(x))
+
+    def test_ramp_and_eval_equivalence(self):
+        """drop_path_rate>0 changes nothing in eval mode; first layer's
+        rate is exactly 0 (linspace ramp)."""
+        from megatron_tpu.models.transformer import (drop_path_rates,
+                                                     stack_apply,
+                                                     stack_init)
+        cfg = cfg_with(drop_path_rate=0.2)
+        rates = np.asarray(drop_path_rates(cfg, 4))
+        np.testing.assert_allclose(rates, np.linspace(0.0, 0.2, 4),
+                                   rtol=1e-6)
+        from megatron_tpu.models.language_model import make_rope
+        rope = make_rope(cfg)
+        p = stack_init(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 64))
+        y1, _ = stack_apply(p, x, cfg, rope_cos=rope.cos,
+                            rope_sin=rope.sin, deterministic=True)
+        cfg0 = cfg_with()
+        y0, _ = stack_apply(p, x, cfg0, rope_cos=rope.cos,
+                            rope_sin=rope.sin, deterministic=True)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                                   atol=1e-6)
+
+    def test_training_mode_drops_some_samples(self):
+        """With rate ~1 on later layers, some samples' branches must
+        differ from the deterministic output."""
+        from megatron_tpu.models.transformer import stack_apply, stack_init
+        cfg = cfg_with(drop_path_rate=0.9)
+        from megatron_tpu.models.language_model import make_rope
+        rope = make_rope(cfg)
+        p = stack_init(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 4, 64))
+        y_det, _ = stack_apply(p, x, cfg, rope_cos=rope.cos,
+                               rope_sin=rope.sin, deterministic=True)
+        y_tr, _ = stack_apply(p, x, cfg, rope_cos=rope.cos,
+                              rope_sin=rope.sin,
+                              rng=jax.random.PRNGKey(2),
+                              deterministic=False)
+        assert not np.allclose(np.asarray(y_det), np.asarray(y_tr))
