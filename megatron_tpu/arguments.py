@@ -92,6 +92,11 @@ def build_parser(extra_args_provider: Optional[Callable] = None
     g.add_argument("--exit_interval", type=int, default=None)
     g.add_argument("--exit_duration_in_mins", type=float, default=None)
     g.add_argument("--seed", type=int, default=1234)
+    # jax.profiler trace window (SURVEY.md §5 profiling)
+    g.add_argument("--profile", action="store_true")
+    g.add_argument("--profile_step_start", type=int, default=10)
+    g.add_argument("--profile_step_end", type=int, default=12)
+    g.add_argument("--profile_dir", type=str, default=None)
     g.add_argument("--save", type=str, default=None, dest="checkpoint_dir")
     g.add_argument("--load", type=str, default=None, dest="load_dir")
     g.add_argument("--finetune", action="store_true")
@@ -162,17 +167,31 @@ def _pick(ns: argparse.Namespace, cls, **renames):
 
 
 def config_from_args(args: argparse.Namespace,
-                     n_devices: Optional[int] = None) -> MegatronConfig:
+                     n_devices: Optional[int] = None,
+                     defaults: Optional[dict] = None) -> MegatronConfig:
     from megatron_tpu.config import MODEL_PRESETS
 
     if args.model:
         model = MODEL_PRESETS[args.model]()
         import dataclasses
+        # a preset is a baseline, not a gag order: any model-field flag the
+        # user EXPLICITLY set (differs from the parser default) overrides
+        # the preset — e.g. --model llama2-7b --drop_path_rate 0.1
+        overrides = {}
+        if defaults:
+            handled = {"seq_length", "recompute_granularity",
+                       "attention_impl"}
+            for f in dataclasses.fields(type(model)):
+                if f.name in handled or f.name not in defaults:
+                    continue
+                v = getattr(args, f.name, None)
+                if v != defaults[f.name]:
+                    overrides[f.name] = v
         model = dataclasses.replace(
             model, seq_length=args.seq_length or model.seq_length,
             recompute_granularity=args.recompute_granularity,
             attention_impl="flash" if args.use_flash_attn
-            else model.attention_impl)
+            else model.attention_impl, **overrides)
     else:
         activation = (args.glu_activation or args.activation or
                       ("swiglu" if args.use_rms_norm else "gelu"))
@@ -217,6 +236,13 @@ def config_from_args(args: argparse.Namespace,
 
 def parse_cli(argv=None, extra_args_provider=None, n_devices=None
               ) -> tuple[MegatronConfig, argparse.Namespace]:
+    # multi-host bring-up first: jax.distributed must initialize before
+    # any backend query so jax.devices() sees the whole pod (no-op on
+    # single-host runs; ref: initialize.py:124-151 ordering)
+    from megatron_tpu.parallel.multihost import initialize_distributed
+    initialize_distributed()
     parser = build_parser(extra_args_provider)
     args = parser.parse_args(argv)
-    return config_from_args(args, n_devices=n_devices), args
+    defaults = {a.dest: a.default for a in parser._actions}
+    return config_from_args(args, n_devices=n_devices,
+                            defaults=defaults), args

@@ -76,11 +76,16 @@ def training_log(metrics: dict, iteration: int, consumed_samples: int,
 
 
 def evaluate(state: TrainState, eval_iterator, eval_step_fn,
-             eval_iters: int) -> dict:
-    """(ref: training.py:754-807) mean lm loss + ppl over eval_iters batches."""
+             eval_iters: int, mesh=None, batch_sh=None) -> dict:
+    """(ref: training.py:754-807) mean lm loss + ppl over eval_iters batches.
+    `batch_sh` lifts host batches to global arrays on multi-host runs (same
+    invariant as the train path)."""
     total = 0.0
     for _ in range(eval_iters):
         batch = next(eval_iterator)
+        if batch_sh is not None:
+            from megatron_tpu.parallel.multihost import make_global_batch
+            batch = make_global_batch(batch, mesh, batch_sh)
         loss = eval_step_fn(state.params, batch)
         total += float(loss)
     mean = total / max(eval_iters, 1)
@@ -140,6 +145,13 @@ def train(
     seq_len = cfg.model.seq_length
     trace_active = False
 
+    # pod-scale feeding: host batches must become globally sharded arrays
+    # when >1 process drives the mesh (single-process: identity)
+    batch_sh = None
+    if mesh is not None and jax.process_count() > 1:
+        from jax.sharding import NamedSharding, PartitionSpec
+        batch_sh = NamedSharding(mesh, PartitionSpec(None, "dp"))
+
     try:
         while iteration < cfg.training.train_iters:
             calc.update(consumed_samples)
@@ -150,6 +162,9 @@ def train(
             if hasattr(train_iterator, "num_microbatches"):
                 train_iterator.num_microbatches = calc.num_microbatches
             batch = next(train_iterator)
+            if batch_sh is not None:
+                from megatron_tpu.parallel.multihost import make_global_batch
+                batch = make_global_batch(batch, mesh, batch_sh)
             step_rng = jax.random.fold_in(rng, iteration)
             if (cfg.training.profile and not trace_active
                     and iteration == cfg.training.profile_step_start):
@@ -191,7 +206,8 @@ def train(
                 if eval_step_fn is None:
                     eval_step_fn = _make_eval_step(cfg, mesh)
                 results = evaluate(state, valid_iterator, eval_step_fn,
-                                   cfg.training.eval_iters)
+                                   cfg.training.eval_iters, mesh=mesh,
+                                   batch_sh=batch_sh)
                 print_rank_0(f"validation at iteration {iteration}: {results}")
                 for k, v in results.items():
                     writer.add_scalar(f"lm-loss-validation/{k}", v, iteration)

@@ -106,10 +106,10 @@ def finetune_and_evaluate(
             params = jax.tree_util.tree_map_with_path(
                 _merge, params, loaded.params)
             if skipped:
-                print(f"pretrained_checkpoint: kept fresh init for "
-                      f"{len(skipped)} leaves absent on disk: "
-                      f"{', '.join(skipped[:8])}"
-                      f"{' ...' if len(skipped) > 8 else ''}")
+                print_rank_0(f"pretrained_checkpoint: kept fresh init for "
+                             f"{len(skipped)} leaves absent on disk: "
+                             f"{', '.join(skipped[:8])}"
+                             f"{' ...' if len(skipped) > 8 else ''}")
 
     state = TrainState(params=params,
                        opt_state=opt.init_optimizer(params, cfg.optimizer),

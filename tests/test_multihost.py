@@ -1,0 +1,53 @@
+"""Multi-host runtime helpers on the virtual CPU mesh.
+
+Contract: global-batch assembly must produce arrays identical to the
+single-process device_put path (the reference's equivalent guarantee is
+that every rank's dataloader shard reassembles the global batch,
+ref: megatron/data/data_samplers.py dp sharding + training.py:855-939).
+Process-count>1 behavior can't run hermetically, but the callback path and
+row-range arithmetic are process-count-independent.
+"""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from megatron_tpu.parallel.mesh import MESH_AXES
+from megatron_tpu.parallel.multihost import (initialize_distributed,
+                                             make_global_batch,
+                                             process_batch_rows)
+
+
+@pytest.fixture()
+def mesh():
+    devs = np.asarray(jax.devices()[:8]).reshape(4, 1, 1, 2)
+    return Mesh(devs, MESH_AXES)
+
+
+def test_initialize_noop_single_host(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("MEGATRON_TPU_MULTIHOST", raising=False)
+    assert initialize_distributed() == jax.process_index() == 0
+
+
+def test_make_global_batch_identity_single_process(mesh):
+    sh = NamedSharding(mesh, P(None, "dp"))
+    batch = {"tokens": np.arange(24).reshape(2, 4, 3)}
+    out = make_global_batch(batch, mesh, sh)
+    assert out is batch  # single process: untouched
+
+
+def test_callback_lift_matches_device_put(mesh):
+    """The make_array_from_callback path (what multi-host uses) must equal
+    plain device_put sharding of the same host array."""
+    sh = NamedSharding(mesh, P(None, "dp"))
+    arr = np.random.RandomState(0).randn(2, 8, 5).astype(np.float32)
+    lifted = jax.make_array_from_callback(arr.shape, sh,
+                                          lambda idx: arr[idx])
+    direct = jax.device_put(arr, sh)
+    assert lifted.sharding.is_equivalent_to(direct.sharding, arr.ndim)
+    np.testing.assert_array_equal(np.asarray(lifted), np.asarray(direct))
+
+
+def test_process_batch_rows_single_process(mesh):
+    assert process_batch_rows(mesh, 16) == (0, 16)
