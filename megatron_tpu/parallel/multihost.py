@@ -98,8 +98,12 @@ def process_batch_rows(mesh, global_rows: int) -> tuple:
     per = global_rows // dp
     # dp coordinate range covered by this process's addressable devices
     # (mesh.devices axis 0 is 'dp')
-    coords = [int(np.argwhere(mesh.devices == d)[0][0])
-              for d in mesh.devices.ravel()
-              if d.process_index == jax.process_index()]
-    lo, hi = min(coords), max(coords)
+    coords = sorted({int(np.argwhere(mesh.devices == d)[0][0])
+                     for d in mesh.devices.ravel()
+                     if d.process_index == jax.process_index()})
+    lo, hi = coords[0], coords[-1]
+    assert coords == list(range(lo, hi + 1)), (
+        f"process {jax.process_index()} owns non-contiguous dp coords "
+        f"{coords}; a row-range slice would cover other hosts' rows — "
+        "lay the mesh out with dp contiguous per process")
     return lo * per, (hi + 1) * per

@@ -319,3 +319,25 @@ def load_config_from_checkpoint(root: str) -> Optional[MegatronConfig]:
     d = os.path.join(root, "release" if tag == "release" else f"iter_{int(tag):07d}")
     with open(os.path.join(d, "config.json")) as f:
         return MegatronConfig.from_dict(json.load(f))
+
+
+def merge_restored_params(fresh, restored, *, label: str = "checkpoint"):
+    """Leaf-wise overlay of a partial restore onto freshly initialized
+    params: orbax partial_restore returns ShapeDtypeStruct placeholders for
+    leaves absent on disk (e.g. a task head the pretraining checkpoint
+    never had) — those keep the fresh init, and the skips are reported
+    (a silently random subtree reads as a broken finetune)."""
+    skipped = []
+
+    def _merge(path, fresh_leaf, restored_leaf):
+        if isinstance(restored_leaf, (jax.Array, np.ndarray)):
+            return restored_leaf
+        skipped.append(jax.tree_util.keystr(path))
+        return fresh_leaf
+
+    merged = jax.tree_util.tree_map_with_path(_merge, fresh, restored)
+    if skipped:
+        print_rank_0(f"{label}: kept fresh init for {len(skipped)} leaves "
+                     f"absent on disk: {', '.join(skipped[:8])}"
+                     f"{' ...' if len(skipped) > 8 else ''}")
+    return merged

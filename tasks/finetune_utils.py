@@ -91,25 +91,8 @@ def finetune_and_evaluate(
         loaded, _, _ = ckpt.load_checkpoint(
             pretrained_checkpoint, example, finetune=True)
         if loaded is not None:
-            # orbax partial_restore returns ShapeDtypeStruct placeholders
-            # for leaves absent on disk (the fresh head); merge leaf-wise,
-            # keeping the fresh init there, and SAY what was skipped — a
-            # silently random encoder reads as a broken finetune
-            skipped = []
-
-            def _merge(path, fresh, restored):
-                if isinstance(restored, (jax.Array, np.ndarray)):
-                    return restored
-                skipped.append(jax.tree_util.keystr(path))
-                return fresh
-
-            params = jax.tree_util.tree_map_with_path(
-                _merge, params, loaded.params)
-            if skipped:
-                print_rank_0(f"pretrained_checkpoint: kept fresh init for "
-                             f"{len(skipped)} leaves absent on disk: "
-                             f"{', '.join(skipped[:8])}"
-                             f"{' ...' if len(skipped) > 8 else ''}")
+            params = ckpt.merge_restored_params(
+                params, loaded.params, label="pretrained_checkpoint")
 
     state = TrainState(params=params,
                        opt_state=opt.init_optimizer(params, cfg.optimizer),

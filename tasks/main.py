@@ -23,9 +23,11 @@ def get_tasks_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("tasks", description=__doc__)
     p.add_argument("--task", required=True,
                    choices=["WIKITEXT103", "LAMBADA", "MNLI", "QQP", "RACE",
-                            "NQ"],
+                            "NQ", "RET-FINETUNE-NQ"],
                    help="Task name (ref: tasks/main.py:19; NQ = ORQA "
-                        "retriever eval, ref: tasks/orqa/evaluate_orqa.py).")
+                        "retriever eval, ref: tasks/orqa/evaluate_orqa.py; "
+                        "RET-FINETUNE-NQ = supervised retriever finetune, "
+                        "ref: tasks/orqa/supervised/finetune.py).")
     p.add_argument("--valid_data", nargs="+", required=True)
     p.add_argument("--train_data", nargs="*", default=None,
                    help="finetuning data (MNLI/QQP/RACE)")
@@ -63,7 +65,60 @@ def get_tasks_parser() -> argparse.ArgumentParser:
     p.add_argument("--ict_head_size", type=int, default=128)
     p.add_argument("--biencoder_shared_query_context_model",
                    action="store_true")
+    # supervised retriever finetuning (ref: tasks/main.py:53-71)
+    p.add_argument("--train_with_neg", action="store_true")
+    p.add_argument("--train_hard_neg", type=int, default=0)
+    p.add_argument("--val_av_rank_hard_neg", type=int, default=30)
+    p.add_argument("--val_av_rank_other_neg", type=int, default=30)
+    p.add_argument("--retriever_score_scaling", action="store_true")
     return p
+
+
+def run_ret_finetune_task(args) -> dict:
+    """Supervised retriever finetune on DPR-format NQ
+    (ref: tasks/orqa/supervised/finetune.py)."""
+    from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
+                                     TrainingConfig)
+    from megatron_tpu.data.tokenizers import build_tokenizer
+    from megatron_tpu.models.bert import bert_config
+    from tasks.orqa.data import NQSupervisedDataset
+    from tasks.orqa.finetune import finetune_retriever
+
+    tokenizer = build_tokenizer(
+        args.tokenizer_type if args.tokenizer_type != "HFTokenizer"
+        or args.tokenizer_model else "BertWordPieceLowerCase",
+        vocab_file=args.vocab_file, merge_file=args.merge_file,
+        tokenizer_model=args.tokenizer_model)
+    seq = args.retriever_seq_length
+    model = bert_config(
+        num_layers=args.num_layers, hidden_size=args.hidden_size,
+        num_attention_heads=args.num_attention_heads,
+        vocab_size=tokenizer.vocab_size, seq_length=seq,
+        max_position_embeddings=seq)
+    cfg = MegatronConfig(
+        model=model,
+        optimizer=OptimizerConfig(lr=args.lr, clip_grad=1.0),
+        training=TrainingConfig(micro_batch_size=args.micro_batch_size,
+                                global_batch_size=args.micro_batch_size,
+                                train_iters=1),
+    ).validate(n_devices=1)
+
+    train_ds = NQSupervisedDataset(
+        args.train_data or [], tokenizer, seq,
+        train_with_neg=args.train_with_neg,
+        train_hard_neg=args.train_hard_neg)
+    valid_ds = NQSupervisedDataset(
+        args.valid_data, tokenizer, seq, evaluate=True,
+        val_av_rank_hard_neg=args.val_av_rank_hard_neg,
+        val_av_rank_other_neg=args.val_av_rank_other_neg)
+    result = finetune_retriever(
+        cfg, train_ds, valid_ds, epochs=args.epochs,
+        score_scaling=args.retriever_score_scaling,
+        pretrained_checkpoint=args.pretrained_checkpoint,
+        ict_head_size=args.ict_head_size,
+        shared=args.biencoder_shared_query_context_model)
+    print(json.dumps({"task": "RET-FINETUNE-NQ", **result["final"]}))
+    return result["final"]
 
 
 def load_biencoder(args, vocab_size: int, seq_length: int):
@@ -247,6 +302,8 @@ def main():
         run_finetune_task(args)
     elif args.task == "NQ":
         run_nq_task(args)
+    elif args.task == "RET-FINETUNE-NQ":
+        run_ret_finetune_task(args)
     else:
         assert args.load, "--load required for zero-shot tasks"
         run_task(args)

@@ -1,0 +1,174 @@
+"""Supervised retriever finetuning on DPR-format NQ (task RET-FINETUNE-NQ).
+
+TPU-native port of the reference's ORQA finetuning
+(ref: tasks/orqa/supervised/finetune.py:47-243). The reference all-gathers
+query/context embeddings across dp ranks to build the global in-batch
+softmax; under a single-controller mesh the loss is written over the global
+batch directly and GSPMD does the rest.
+
+Loss (ref: finetune.py:96-174): scores = q @ c^T over [b] queries ×
+[b + n_neg] contexts (positives on the diagonal, concatenated hard/simple
+negatives as extra columns), optional 1/sqrt(h) score scaling, NLL of the
+diagonal. Validation reports in-batch top-1 accuracy and the DPR "average
+rank" of the positive among its negative pool.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import MegatronConfig
+
+
+def retrieval_scores(params, batch, mcfg, *, score_scaling: bool = False,
+                     rng=None, deterministic: bool = True):
+    """-> [b, b + n_neg] similarity matrix (ref: finetune.py:138-143)."""
+    from megatron_tpu.models.biencoder import _towers, embed_text
+
+    q_tower, c_tower = _towers(params)
+    rq = rc = None
+    if rng is not None and not deterministic:
+        rq, rc = jax.random.split(rng)
+    q = embed_text(q_tower, batch["query"], mcfg,
+                   padding_mask=batch["query_pad_mask"],
+                   tokentype_ids=batch["query_types"], rng=rq,
+                   deterministic=deterministic)
+    ctx = batch["context"]
+    ctx_types = batch["context_types"]
+    ctx_pad = batch["context_pad_mask"]
+    has_negs = "neg_context" in batch and batch["neg_context"].shape[0]
+    if has_negs:
+        ctx = jnp.concatenate([ctx, batch["neg_context"]])
+        ctx_types = jnp.concatenate([ctx_types,
+                                     batch["neg_context_types"]])
+        ctx_pad = jnp.concatenate([ctx_pad,
+                                   batch["neg_context_pad_mask"]])
+    c = embed_text(c_tower, ctx, mcfg, padding_mask=ctx_pad,
+                   tokentype_ids=ctx_types, rng=rc,
+                   deterministic=deterministic)
+    scores = q @ c.T
+    if score_scaling:
+        scores = scores / jnp.sqrt(jnp.float32(mcfg.hidden_size))
+    if has_negs and "neg_valid" in batch:
+        # padded negative slots (fixed-shape batches) never win softmax
+        b = batch["query"].shape[0]
+        neg_mask = jnp.where(batch["neg_valid"] > 0, 0.0, -1e9)
+        scores = scores.at[:, b:].add(neg_mask[None, :])
+    return scores
+
+
+def retrieval_ce_loss(params, batch, mcfg, *, score_scaling: bool = False,
+                      rng=None, deterministic: bool = True):
+    """(loss, top1-correct-count) (ref: finetune.py:145-174)."""
+    scores = retrieval_scores(params, batch, mcfg,
+                              score_scaling=score_scaling, rng=rng,
+                              deterministic=deterministic)
+    b = batch["query"].shape[0]
+    logprobs = jax.nn.log_softmax(scores, axis=-1)
+    labels = jnp.arange(b)
+    loss = -jnp.mean(logprobs[jnp.arange(b), labels])
+    correct = jnp.sum(jnp.argmax(scores, axis=-1) == labels)
+    return loss, correct
+
+
+def average_rank(params, dataset, mcfg, batch_size: int,
+                 score_scaling: bool = False) -> dict:
+    """DPR av-rank validation: mean rank of the positive context among the
+    sample's own negative pool (+1-indexed; lower is better)
+    (ref: eval_utils.py accuracy_func_provider's av-rank mode). Also
+    reports in-batch top-1 accuracy."""
+    ranks, correct, total = [], 0, 0
+    fwd = jax.jit(functools.partial(
+        retrieval_scores, mcfg=mcfg, score_scaling=score_scaling))
+    cap = getattr(dataset, "neg_cap", None) or 0
+    for batch in dataset.batches(batch_size, drop_last=False):
+        dev_batch = {k: jnp.asarray(v) for k, v in batch.items()
+                     if k not in ("reference", "neg_counts")}
+        scores = np.asarray(fwd(params, dev_batch))
+        b = batch["query"].shape[0]
+        labels = np.arange(b)
+        correct += int((np.argmax(scores, axis=-1) == labels).sum())
+        total += b
+        # per-sample positive rank within {positive} U {its own negatives};
+        # negatives live at fixed cap-stride offsets after the b positives
+        if "neg_counts" in batch and cap:
+            for i, n in enumerate(batch["neg_counts"]):
+                pos = scores[i, i]
+                negs = scores[i, b + i * cap:b + i * cap + n]
+                ranks.append(1 + int((negs > pos).sum()))
+    out = {"top1_accuracy": correct / max(total, 1)}
+    if ranks:
+        out["average_rank"] = float(np.mean(ranks))
+    return out
+
+
+def finetune_retriever(cfg: MegatronConfig, train_ds, valid_ds, *,
+                       epochs: int = 1, score_scaling: bool = False,
+                       pretrained_checkpoint: Optional[str] = None,
+                       ict_head_size: Optional[int] = None,
+                       shared: bool = False, seed: int = 1234) -> dict:
+    """Train the biencoder with the in-batch CE objective, evaluate with
+    av-rank (ref: finetune.py:176-243 main/orqa)."""
+    from megatron_tpu.models.biencoder import biencoder_axes, biencoder_init
+    from megatron_tpu.training.train_step import (TrainState,
+                                                  make_train_step,
+                                                  state_from_params)
+    from megatron_tpu.utils.logging import print_rank_0
+
+    mcfg = cfg.model
+    init_fn = functools.partial(
+        biencoder_init, jax.random.PRNGKey(seed), mcfg,
+        ict_head_size=ict_head_size, shared=shared)
+    params = init_fn()
+    if pretrained_checkpoint:
+        from megatron_tpu.training import checkpointing as ckpt
+        example = TrainState(params=params, opt_state=None, iteration=0)
+        loaded, _, _ = ckpt.load_checkpoint(pretrained_checkpoint, example,
+                                            finetune=True)
+        if loaded is not None:
+            # keep fresh init for leaves the checkpoint lacks (ict head /
+            # second tower when loading a plain BERT pretrain)
+            params = ckpt.merge_restored_params(
+                params, loaded.params, label="pretrained_checkpoint")
+
+    bs = cfg.training.micro_batch_size * (cfg.parallel.data_parallel or 1)
+    steps_per_epoch = max(len(train_ds) // bs, 1)
+    cfg = dataclasses.replace(cfg, training=dataclasses.replace(
+        cfg.training, train_iters=max(epochs * steps_per_epoch, 1)))
+
+    def loss_fn(p, mb, mb_rng):
+        loss, _ = retrieval_ce_loss(
+            p, mb, mcfg, score_scaling=score_scaling, rng=mb_rng,
+            deterministic=mcfg.hidden_dropout == 0.0)
+        return loss
+
+    step = make_train_step(cfg, loss_fn=loss_fn, init_params_fn=init_fn,
+                           axes_fn=functools.partial(
+                               biencoder_axes, ict_head_size=ict_head_size,
+                               shared=shared),
+                           donate=False)
+    state = state_from_params(params, cfg)
+    rng = jax.random.PRNGKey(seed)
+    shuffle = np.random.RandomState(seed)
+    history = []
+    metrics = {"lm_loss": float("nan")}  # train set smaller than one batch
+    for epoch in range(epochs):
+        for it, batch in enumerate(train_ds.batches(bs,
+                                                    shuffle_rng=shuffle)):
+            mb = {k: jnp.asarray(v)[None] for k, v in batch.items()
+                  if k not in ("reference", "neg_counts")}
+            state, metrics = step(state, mb,
+                                  jax.random.fold_in(rng, epoch * 10000 + it))
+        results = average_rank(state.params, valid_ds, mcfg,
+                               cfg.training.micro_batch_size,
+                               score_scaling=score_scaling)
+        history.append(results)
+        print_rank_0(f"epoch {epoch}: loss "
+                     f"{float(metrics['lm_loss']):.4f} | {results}")
+    return {"params": state.params, "history": history,
+            "final": history[-1] if history else {}}
