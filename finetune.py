@@ -31,9 +31,24 @@ def build_data(cfg, tokenizer, consumed_samples: int):
     samples = (tr.train_iters * tr.global_batch_size,
                eval_iters * tr.global_batch_size,
                tr.eval_iters * tr.global_batch_size)
-    train_ds, valid_ds, test_ds = build_train_valid_test_datasets(
-        cfg.data.data_path, cfg.data.split, cfg.model.seq_length,
-        tr.seed, *samples)
+    if cfg.data.train_data_path or cfg.data.valid_data_path:
+        # per-split corpora (ref: --train_data_path/--valid_data_path/
+        # --test_data_path; arguments.py validates the two modes are
+        # mutually exclusive with --data_path + --split)
+        def one(paths, n):
+            if not paths:
+                return None
+            ds, _, _ = build_train_valid_test_datasets(
+                list(paths), "1,0,0", cfg.model.seq_length, tr.seed,
+                n, 0, 0)
+            return ds
+        train_ds = one(cfg.data.train_data_path, samples[0])
+        valid_ds = one(cfg.data.valid_data_path, samples[1])
+        test_ds = one(cfg.data.test_data_path, samples[2])
+    else:
+        train_ds, valid_ds, test_ds = build_train_valid_test_datasets(
+            cfg.data.data_path, cfg.data.split, cfg.model.seq_length,
+            tr.seed, *samples)
 
     def make_iter(ds, consumed):
         if ds is None:

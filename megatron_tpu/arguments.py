@@ -152,10 +152,124 @@ def build_parser(extra_args_provider: Optional[Callable] = None
     g.add_argument("--no_new_tokens", dest="new_tokens",
                    action="store_false", default=True)
     g.add_argument("--data_impl", type=str, default="mmap")
+    g.add_argument("--mask_prob", type=float, default=0.15,
+                   dest="masked_lm_prob",
+                   help="masked-LM probability (ref: --mask_prob)")
+    g.add_argument("--short_seq_prob", type=float, default=0.1)
+    g.add_argument("--train_data_path", nargs="*", default=None)
+    g.add_argument("--valid_data_path", nargs="*", default=None)
+    g.add_argument("--test_data_path", nargs="*", default=None)
+
+    g = p.add_argument_group(
+        "reference compat",
+        "reference flags accepted with equivalent TPU semantics")
+    g.add_argument("--train_samples", type=int, default=None,
+                   help="sample-based run length; converted to iters via "
+                        "global_batch_size (ref: --train_samples)")
+    g.add_argument("--lr_decay_samples", type=int, default=None)
+    g.add_argument("--lr_warmup_samples", type=int, default=None)
+    g.add_argument("--position_embedding_type", type=str, default=None,
+                   choices=["rope", "rotary", "learned_absolute",
+                            "absolute"])
+    g.add_argument("--encoder_num_layers", type=int, default=None)
+    g.add_argument("--encoder_seq_length", type=int, default=None)
+    g.add_argument("--decoder_num_layers", type=int, default=None)
+    g.add_argument("--decoder_seq_length", type=int, default=128,
+                   dest="max_seq_length_dec")
+    g.add_argument("--no_save_optim", action="store_true")
+    g.add_argument("--no_save_rng", action="store_true")
+    g.add_argument("--recompute_activations", action="store_true",
+                   help="alias for --recompute_granularity selective")
+    g.add_argument("--recompute_method", type=str, default=None,
+                   choices=["uniform", "block"],
+                   help="accepted; the scan-stacked formulation remats "
+                        "uniformly per layer either way")
+    g.add_argument("--recompute_num_layers", type=int, default=None)
+    g.add_argument("--attention_softmax_in_fp32", action="store_true",
+                   dest="softmax_compute_fp32", default=True)
+    g.add_argument("--exit_signal_handler", action="store_true",
+                   help="accepted; SIGTERM checkpoint-and-exit is always "
+                        "installed")
+    g.add_argument("--override_opt_param_scheduler", action="store_true",
+                   help="accepted; CLI schedule always wins unless "
+                        "--use_checkpoint_args")
+    g.add_argument("--use_checkpoint_opt_param_scheduler",
+                   action="store_true",
+                   help="accepted; subsumed by --use_checkpoint_args")
+    g.add_argument("--log_params_norm", action="store_true")
+    g.add_argument("--log_timers_to_tensorboard", action="store_true")
+    g.add_argument("--log_validation_ppl_to_tensorboard",
+                   action="store_true")
+    g.add_argument("--wandb_project", type=str, default=None)
+    g.add_argument("--wandb_entity", type=str, default=None)
+    g.add_argument("--wandb_id", type=str, default=None)
+    g.add_argument("--wandb_resume", action="store_true")
+    # retrieval stack paths (ref: arguments.py retriever/biencoder args;
+    # the ict-specific ones live on pretrain_ict.py / tasks.main)
+    g.add_argument("--bert_load", type=str, default=None)
+    g.add_argument("--ict_load", type=str, default=None)
+    g.add_argument("--biencoder_projection_dim", type=int, default=0)
+    g.add_argument("--block_data_path", type=str, default=None)
+    g.add_argument("--embedding_path", type=str, default=None)
+    g.add_argument("--evidence_data_path", type=str, default=None)
+    g.add_argument("--indexer_batch_size", type=int, default=128)
+    g.add_argument("--indexer_log_interval", type=int, default=1000)
+    g.add_argument("--retriever_report_topk_accuracies", nargs="+",
+                   type=int, default=[])
+    g.add_argument("--retriever_score_scaling", action="store_true")
+    g.add_argument("--retriever_seq_length", type=int, default=256)
+
+    # CUDA/cluster-mechanics flags that dissolve under XLA/TPU: accepted so
+    # reference launch scripts run unmodified; a note is logged when one is
+    # set (ref: arguments.py — fused-kernel toggles, NCCL/DDP knobs, fp8/TE,
+    # vision/DINO, ADLR autoresume)
+    for flag in _NOOP_FLAGS:
+        p.add_argument(flag, nargs="?", const=True, default=None,
+                       help=argparse.SUPPRESS)
 
     if extra_args_provider is not None:
         p = extra_args_provider(p)
     return p
+
+
+# Reference flags with no TPU-side effect (the mechanism they tune does not
+# exist under XLA: stream ordering, fused CUDA kernels, NCCL backends, fp8
+# Transformer Engine, vision/DINO models, ADLR cluster autoresume).
+_NOOP_FLAGS = [
+    "--accumulate_allreduce_grads_in_fp32",  # grads are always fp32 here
+    "--adlr_autoresume", "--adlr_autoresume_interval",
+    "--apply_residual_connection_post_layernorm",
+    "--classes_fraction", "--data_parallel_random_init",
+    "--data_per_class_fraction",
+    "--dino_bottleneck_size", "--dino_freeze_last_layer",
+    "--dino_head_hidden_size", "--dino_local_crops_number",
+    "--dino_local_img_size", "--dino_norm_last_layer",
+    "--dino_teacher_temp", "--dino_warmup_teacher_temp",
+    "--dino_warmup_teacher_temp_epochs",
+    "--distribute_saved_activations", "--distributed_backend",
+    "--empty_unused_memory_level", "--fp16_lm_cross_entropy",
+    "--fp32_residual_connection",
+    "--fp8_amax_compute_algo", "--fp8_amax_history_len", "--fp8_e4m3",
+    "--fp8_hybrid", "--fp8_interval", "--fp8_margin", "--no_fp8_wgrad",
+    "--head_lr_mult", "--img_h", "--img_w",
+    "--inference_batch_times_seqlen_threshold",
+    "--init_method_xavier_uniform", "--iter_per_epoch", "--local_rank",
+    "--log_batch_size_to_tensorboard", "--log_memory_to_tensorboard",
+    "--log_world_size_to_tensorboard", "--max_tokens_to_oom",
+    "--no_async_tensor_model_parallel_allreduce",
+    "--no_bias_dropout_fusion", "--no_bias_gelu_fusion",
+    "--no_contiguous_buffers_in_local_ddp", "--no_data_sharding",
+    "--no_gradient_accumulation_fusion", "--no_initialization",
+    "--no_masked_softmax_fusion", "--no_persist_layer_norm",
+    "--no_query_key_layer_scaling",
+    "--no_scatter_gather_tensors_in_pipeline",
+    "--num_channels", "--num_classes", "--onnx_safe", "--patch_dim",
+    "--pipeline_model_parallel_split_rank", "--standalone_embedding_stage",
+    "--tensorboard_log_interval", "--tensorboard_queue_size",
+    "--timing_log_level", "--timing_log_option", "--transformer_impl",
+    "--use_cpu_initialization", "--use_one_sent_docs",
+    "--use_ring_exchange_p2p",
+]
 
 
 def _pick(ns: argparse.Namespace, cls, **renames):
@@ -166,10 +280,66 @@ def _pick(ns: argparse.Namespace, cls, **renames):
     return d
 
 
+def _apply_compat(args: argparse.Namespace) -> None:
+    """Resolve reference-compat aliases into the native arg surface and
+    warn for accepted-but-inert CUDA-mechanics flags."""
+    # aliases (mutating the namespace keeps _pick/_preset logic unchanged);
+    # an explicit --num_layers (non-default) beats --encoder_num_layers
+    if getattr(args, "encoder_num_layers", None) is not None and \
+            args.num_layers == 2:
+        args.num_layers = args.encoder_num_layers
+    if getattr(args, "encoder_seq_length", None) and not args.seq_length:
+        args.seq_length = args.encoder_seq_length
+    if getattr(args, "recompute_activations", False) and \
+            args.recompute_granularity == "none":
+        args.recompute_granularity = "selective"
+    pet = getattr(args, "position_embedding_type", None)
+    if pet in ("rope", "rotary"):
+        args.use_rotary_emb = True
+    elif pet in ("learned_absolute", "absolute"):
+        args.use_rotary_emb = False
+        args.use_position_embedding = True
+    # sample-based run length -> iterations (ref: --train_samples; the
+    # reference's samples-mode microbatch calculator is equivalent to this
+    # conversion when no batch rampup is active)
+    if getattr(args, "train_samples", None):
+        assert args.rampup_batch_size is None, (
+            "--train_samples with --rampup_batch_size is not supported; "
+            "use --train_iters")
+        assert args.global_batch_size, (
+            "--train_samples needs an explicit --global_batch_size (the "
+            "derived gbs depends on dp size, which is unknown at parse "
+            "time)")
+        gbs = args.global_batch_size
+        args.train_iters = -(-args.train_samples // gbs)
+        if getattr(args, "lr_decay_samples", None) and \
+                not args.lr_decay_iters:
+            args.lr_decay_iters = -(-args.lr_decay_samples // gbs)
+        if getattr(args, "lr_warmup_samples", None) and \
+                not args.lr_warmup_iters:
+            args.lr_warmup_iters = -(-args.lr_warmup_samples // gbs)
+    if args.data_path and (getattr(args, "train_data_path", None)
+                           or getattr(args, "valid_data_path", None)
+                           or getattr(args, "test_data_path", None)):
+        raise SystemExit(
+            "--data_path (+--split) and the per-split "
+            "--train/valid/test_data_path flags are mutually exclusive "
+            "(ref: arguments.py validate_args)")
+    # inert flags: say so once, loudly enough to audit
+    set_noops = [f for f in _NOOP_FLAGS
+                 if getattr(args, f.lstrip("-"), None) is not None]
+    if set_noops:
+        from megatron_tpu.utils.logging import print_rank_0
+        print_rank_0("compat: accepted with no TPU-side effect: "
+                     + ", ".join(set_noops))
+
+
 def config_from_args(args: argparse.Namespace,
                      n_devices: Optional[int] = None,
                      defaults: Optional[dict] = None) -> MegatronConfig:
     from megatron_tpu.config import MODEL_PRESETS
+
+    _apply_compat(args)
 
     if args.model:
         model = MODEL_PRESETS[args.model]()

@@ -246,6 +246,18 @@ class TrainingConfig:
     profile_step_start: int = 10
     profile_step_end: int = 12
     profile_dir: Optional[str] = None  # defaults to tensorboard_dir or /tmp
+    # checkpoint write scope (ref: --no_save_optim/--no_save_rng)
+    no_save_optim: bool = False
+    no_save_rng: bool = False
+    # extra metrics (ref: --log_params_norm and friends)
+    log_params_norm: bool = False
+    log_timers_to_tensorboard: bool = False
+    log_validation_ppl_to_tensorboard: bool = False
+    # wandb run identity (ref: --wandb_project/_entity/_id/_resume)
+    wandb_project: Optional[str] = None
+    wandb_entity: Optional[str] = None
+    wandb_id: Optional[str] = None
+    wandb_resume: bool = False
 
 
 @dataclass(frozen=True)
@@ -266,9 +278,15 @@ class DataConfig:
     vocab_extra_ids: int = 0
     vocab_extra_ids_list: Optional[str] = None
     # masked-LM data knobs (ref: arguments.py --mask_prob,
-    # --max_seq_length_dec for T5)
+    # --short_seq_prob, --max_seq_length_dec for T5)
     masked_lm_prob: float = 0.15
+    short_seq_prob: float = 0.1
     max_seq_length_dec: int = 128
+    # per-split dataset prefixes; alternative to `split` fractions over one
+    # corpus (ref: --train_data_path/--valid_data_path/--test_data_path)
+    train_data_path: Optional[Sequence[Any]] = None
+    valid_data_path: Optional[Sequence[Any]] = None
+    test_data_path: Optional[Sequence[Any]] = None
     new_tokens: bool = True
     data_impl: str = "mmap"
     mmap_warmup: bool = False

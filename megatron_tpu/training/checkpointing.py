@@ -149,7 +149,8 @@ def save_checkpoint(
     tag = "release" if release else str(iteration)
 
     tree = {"params": state.params}
-    if state.opt_state is not None and not release:
+    if (state.opt_state is not None and not release
+            and not cfg.training.no_save_optim):  # ref: --no_save_optim
         tree["opt_state"] = state.opt_state
 
     if backend == "orbax":

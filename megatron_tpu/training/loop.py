@@ -72,6 +72,13 @@ def training_log(metrics: dict, iteration: int, consumed_samples: int,
     writer.add_scalar("grad-norm/grad norm", gnorm, iteration)
     writer.add_scalar("loss-scale/loss scale", lscale, iteration)
     writer.add_scalar("throughput/tokens per sec", tokens_per_sec, iteration)
+    if "params_norm" in metrics:  # ref: --log_params_norm
+        pn = float(metrics["params_norm"])
+        line += f" | params norm: {pn:.3f}"
+        writer.add_scalar("params-norm/params norm", pn, iteration)
+    if "num_zeros" in metrics:  # ref: --log_num_zeros_in_grad
+        writer.add_scalar("num-zeros/num zeros",
+                          float(metrics["num_zeros"]), iteration)
     return line
 
 
@@ -111,8 +118,16 @@ def train(
     mirroring the reference's forward_step_func argument to `pretrain`).
     Returns (state, consumed_samples)."""
     timers = Timers()
+    wandb_kwargs = {}
+    if cfg.training.wandb_logger:
+        tr = cfg.training
+        wandb_kwargs = {k: v for k, v in dict(
+            project=tr.wandb_project or "megatron_tpu",
+            entity=tr.wandb_entity, run_id=tr.wandb_id,
+            resume=tr.wandb_resume).items() if v}
     writer = make_writer(cfg.training.tensorboard_dir,
-                         use_wandb=cfg.training.wandb_logger)
+                         use_wandb=cfg.training.wandb_logger,
+                         **wandb_kwargs)
     signals = SignalState().install()
 
     if rng is None:
@@ -197,6 +212,9 @@ def train(
                 line = training_log(metrics, iteration, consumed_samples, dt, toks,
                                     writer, skipped_total, nan_total)
                 print_rank_0(line)
+                if cfg.training.log_timers_to_tensorboard:
+                    timers.write(["train-step"], writer, iteration,
+                                 reset=False)
                 print_rank_0(timers.log())
                 interval_t0 = time.perf_counter()
                 interval_iters = 0

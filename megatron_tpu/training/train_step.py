@@ -143,6 +143,8 @@ def train_step(
         "wd": wd,
         **ometrics,
     }
+    if cfg.training.log_params_norm:  # ref: --log_params_norm
+        metrics["params_norm"] = opt.global_grad_norm(new_params)
     return new_state, metrics
 
 
@@ -190,7 +192,10 @@ def pipelined_train_step(
         wd_mask=wd_mask)
     new_state = TrainState(params=new_params, opt_state=new_opt_state,
                            iteration=state.iteration + 1)
-    return new_state, {"lm_loss": loss, "lr": lr, "wd": wd, **ometrics}
+    metrics = {"lm_loss": loss, "lr": lr, "wd": wd, **ometrics}
+    if cfg.training.log_params_norm:  # ref: --log_params_norm
+        metrics["params_norm"] = opt.global_grad_norm(new_params)
+    return new_state, metrics
 
 
 def param_shardings(cfg: MegatronConfig, mesh, rules=None, axes_fn=None):

@@ -62,11 +62,16 @@ class WandbWriter(NullWriter):
     """TB-compatible wandb shim (ref: wandb_logger.py:90-161): buffers scalars
     per step and commits when the step advances."""
 
-    def __init__(self, project: str = "megatron_tpu", name: Optional[str] = None,
-                 config: Optional[dict] = None):
+    def __init__(self, project: str = "megatron_tpu",
+                 name: Optional[str] = None, config: Optional[dict] = None,
+                 entity: Optional[str] = None, run_id: Optional[str] = None,
+                 resume: bool = False):
         import wandb
         self._wandb = wandb
-        self._run = wandb.init(project=project, name=name, config=config or {})
+        self._run = wandb.init(
+            project=project, name=name, config=config or {}, entity=entity,
+            id=run_id, resume="must" if resume and run_id else
+            ("allow" if resume else None))
         self._step = None
         self._buf: dict = {}
 

@@ -71,7 +71,29 @@ def get_tasks_parser() -> argparse.ArgumentParser:
     p.add_argument("--val_av_rank_hard_neg", type=int, default=30)
     p.add_argument("--val_av_rank_other_neg", type=int, default=30)
     p.add_argument("--retriever_score_scaling", action="store_true")
+    p.add_argument("--sample_rate", type=float, default=1.0,
+                   help="subsample fraction of the supervised train set")
     return p
+
+
+def build_cls_sep_tokenizer(args):
+    """A [CLS]/[SEP]/[PAD]-style tokenizer or a clear error — BERT-family
+    tasks (GLUE/RACE/retrieval) cannot run on a GPT-style tokenizer."""
+    from megatron_tpu.data.tokenizers import build_tokenizer
+    tok_type = args.tokenizer_type
+    if tok_type == "HFTokenizer" and args.vocab_file:
+        # a bare --vocab_file implies WordPiece
+        tok_type = "BertWordPieceLowerCase"
+    tokenizer = build_tokenizer(
+        tok_type, vocab_file=args.vocab_file, merge_file=args.merge_file,
+        tokenizer_model=args.tokenizer_model)
+    for attr in ("cls", "sep", "pad"):
+        if getattr(tokenizer, attr, None) is None:
+            raise SystemExit(
+                f"--task {args.task} needs a tokenizer with [CLS]/[SEP]/"
+                f"[PAD] ids (e.g. --tokenizer_type BertWordPieceLowerCase "
+                f"--vocab_file vocab.txt); {tok_type} has no {attr!r}")
+    return tokenizer
 
 
 def run_ret_finetune_task(args) -> dict:
@@ -79,16 +101,11 @@ def run_ret_finetune_task(args) -> dict:
     (ref: tasks/orqa/supervised/finetune.py)."""
     from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
                                      TrainingConfig)
-    from megatron_tpu.data.tokenizers import build_tokenizer
     from megatron_tpu.models.bert import bert_config
     from tasks.orqa.data import NQSupervisedDataset
     from tasks.orqa.finetune import finetune_retriever
 
-    tokenizer = build_tokenizer(
-        args.tokenizer_type if args.tokenizer_type != "HFTokenizer"
-        or args.tokenizer_model else "BertWordPieceLowerCase",
-        vocab_file=args.vocab_file, merge_file=args.merge_file,
-        tokenizer_model=args.tokenizer_model)
+    tokenizer = build_cls_sep_tokenizer(args)
     seq = args.retriever_seq_length
     model = bert_config(
         num_layers=args.num_layers, hidden_size=args.hidden_size,
@@ -106,7 +123,8 @@ def run_ret_finetune_task(args) -> dict:
     train_ds = NQSupervisedDataset(
         args.train_data or [], tokenizer, seq,
         train_with_neg=args.train_with_neg,
-        train_hard_neg=args.train_hard_neg)
+        train_hard_neg=args.train_hard_neg,
+        sample_rate=args.sample_rate)
     valid_ds = NQSupervisedDataset(
         args.valid_data, tokenizer, seq, evaluate=True,
         val_av_rank_hard_neg=args.val_av_rank_hard_neg,
@@ -183,24 +201,10 @@ def run_finetune_task(args) -> dict:
     (ref: tasks/glue/finetune.py, tasks/race/finetune.py)."""
     from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
                                      TrainingConfig)
-    from megatron_tpu.data.tokenizers import build_tokenizer
     from megatron_tpu.models.bert import bert_config
     from tasks.finetune_utils import finetune_and_evaluate
 
-    tok_type = args.tokenizer_type
-    if tok_type == "HFTokenizer" and args.vocab_file:
-        # finetune tasks need a [CLS]/[SEP]-style tokenizer; a bare
-        # --vocab_file implies WordPiece
-        tok_type = "BertWordPieceLowerCase"
-    tokenizer = build_tokenizer(
-        tok_type, vocab_file=args.vocab_file,
-        merge_file=args.merge_file, tokenizer_model=args.tokenizer_model)
-    for attr in ("cls", "sep", "pad"):
-        if getattr(tokenizer, attr, None) is None:
-            raise SystemExit(
-                f"--task {args.task} needs a tokenizer with [CLS]/[SEP]/"
-                f"[PAD] ids (e.g. --tokenizer_type BertWordPieceLowerCase "
-                f"--vocab_file vocab.txt); {tok_type} has no {attr!r}")
+    tokenizer = build_cls_sep_tokenizer(args)
     seq = args.seq_length or 512
     model = bert_config(
         num_layers=args.num_layers, hidden_size=args.hidden_size,
