@@ -191,6 +191,11 @@ def train(
             state, metrics = step_fn(state, batch, step_rng)
             jax.block_until_ready(metrics["lm_loss"])
             timers("train-step").stop()
+            if iteration == start_iteration:
+                # HBM report after the first step (ref: training.py:522-524
+                # report_memory_flag)
+                from megatron_tpu.utils.logging import report_memory
+                report_memory("after first step")
             if trace_active and iteration >= cfg.training.profile_step_end:
                 jax.profiler.stop_trace()
                 trace_active = False

@@ -105,3 +105,30 @@ def make_writer(tensorboard_dir: Optional[str] = None,
         except Exception as e:
             logger.warning(f"tensorboard unavailable ({e})")
     return NullWriter()
+
+
+def report_memory(name: str = "") -> str:
+    """Per-device HBM usage line after the first step
+    (ref: megatron/utils.py:82-96 report_memory; CUDA
+    allocated/reserved becomes PJRT bytes_in_use/peak_bytes_in_use).
+    Returns "" when the backend exposes no stats (CPU, tunneled chips)."""
+    parts = []
+    for d in jax.local_devices():
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            pass
+        if not stats:
+            continue
+        gib = 1024 ** 3
+        used = stats.get("bytes_in_use", 0) / gib
+        peak = stats.get("peak_bytes_in_use", 0) / gib
+        limit = stats.get("bytes_limit", 0) / gib
+        parts.append(f"{d.id}: used {used:.2f} GiB | peak {peak:.2f} GiB"
+                     + (f" | limit {limit:.2f} GiB" if limit else ""))
+    if not parts:
+        return ""
+    line = f"[memory{' ' + name if name else ''}] " + " || ".join(parts)
+    print_rank_0(line)
+    return line
