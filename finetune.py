@@ -31,10 +31,12 @@ def build_data(cfg, tokenizer, consumed_samples: int):
     samples = (tr.train_iters * tr.global_batch_size,
                eval_iters * tr.global_batch_size,
                tr.eval_iters * tr.global_batch_size)
-    if cfg.data.train_data_path or cfg.data.valid_data_path:
+    if cfg.data.train_data_path or cfg.data.valid_data_path \
+            or cfg.data.test_data_path:
         # per-split corpora (ref: --train_data_path/--valid_data_path/
-        # --test_data_path; arguments.py validates the two modes are
-        # mutually exclusive with --data_path + --split)
+        # --test_data_path). The train corpus may also come from
+        # --data_path (arguments.py forbids both train sources at once);
+        # --split is ignored in this mode — each corpus IS its split.
         def one(paths, n):
             if not paths:
                 return None
@@ -42,7 +44,8 @@ def build_data(cfg, tokenizer, consumed_samples: int):
                 list(paths), "1,0,0", cfg.model.seq_length, tr.seed,
                 n, 0, 0)
             return ds
-        train_ds = one(cfg.data.train_data_path, samples[0])
+        train_ds = one(cfg.data.train_data_path or cfg.data.data_path,
+                       samples[0])
         valid_ds = one(cfg.data.valid_data_path, samples[1])
         test_ds = one(cfg.data.test_data_path, samples[2])
     else:

@@ -318,13 +318,12 @@ def _apply_compat(args: argparse.Namespace) -> None:
         if getattr(args, "lr_warmup_samples", None) and \
                 not args.lr_warmup_iters:
             args.lr_warmup_iters = -(-args.lr_warmup_samples // gbs)
-    if args.data_path and (getattr(args, "train_data_path", None)
-                           or getattr(args, "valid_data_path", None)
-                           or getattr(args, "test_data_path", None)):
+    if args.data_path and getattr(args, "train_data_path", None):
         raise SystemExit(
-            "--data_path (+--split) and the per-split "
-            "--train/valid/test_data_path flags are mutually exclusive "
-            "(ref: arguments.py validate_args)")
+            "--data_path and --train_data_path are mutually exclusive — "
+            "pick one train corpus (ref: arguments.py validate_args). "
+            "--valid/test_data_path MAY combine with --data_path: "
+            "data_path trains, the per-split paths evaluate.")
     # inert flags: say so once, loudly enough to audit
     set_noops = [f for f in _NOOP_FLAGS
                  if getattr(args, f.lstrip("-"), None) is not None]

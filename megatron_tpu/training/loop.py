@@ -227,7 +227,10 @@ def train(
             if (valid_iterator is not None and cfg.training.eval_interval and
                     iteration % cfg.training.eval_interval == 0):
                 if eval_step_fn is None:
-                    eval_step_fn = _make_eval_step(cfg, mesh)
+                    sk = step_kwargs or {}
+                    eval_step_fn = _make_eval_step(
+                        cfg, mesh, loss_fn=sk.get("loss_fn"),
+                        axes_fn=sk.get("axes_fn"))
                 results = evaluate(state, valid_iterator, eval_step_fn,
                                    cfg.training.eval_iters, mesh=mesh,
                                    batch_sh=batch_sh)
@@ -276,16 +279,29 @@ class _nullcontext:
         return False
 
 
-def _make_eval_step(cfg: MegatronConfig, mesh=None):
+def _make_eval_step(cfg: MegatronConfig, mesh=None, loss_fn=None,
+                    axes_fn=None):
     """Jitted eval loss with the SAME mesh/sharding treatment as the train
     step — without in_shardings, eval of a sharded state would re-layout or
     OOM (round-1 VERDICT item 10). pp>1 evaluates through the pipelined
-    loss so the stage-sharded params are consumed in place."""
+    loss so the stage-sharded params are consumed in place. A custom
+    `loss_fn` (BERT/T5/ICT families, make_train_step contract) replaces
+    the GPT lm loss; `axes_fn` supplies its param axes."""
     from megatron_tpu.models import language_model as lm
     rope = lm.make_rope(cfg.model)
-    pipelined = mesh is not None and cfg.parallel.pipeline_parallel > 1
+    pipelined = (mesh is not None and cfg.parallel.pipeline_parallel > 1
+                 and loss_fn is None)
 
     def eval_step(params, batch):
+        if loss_fn is not None:
+            n_micro = jax.tree.leaves(batch)[0].shape[0]
+
+            def body(acc, mb):
+                return acc + loss_fn(params, mb, None), None
+
+            total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                    batch)
+            return total / n_micro
         tokens = batch["tokens"]
         n_micro = tokens.shape[0]
         mask = batch.get("loss_mask")
@@ -324,7 +340,8 @@ def _make_eval_step(cfg: MegatronConfig, mesh=None):
 
     jitted = jax.jit(
         eval_with_ctx,
-        in_shardings=(param_shardings(cfg, mesh, rules=rules),
+        in_shardings=(param_shardings(cfg, mesh, rules=rules,
+                                      axes_fn=axes_fn),
                       NamedSharding(mesh, P(None, "dp"))),
     )
     return _MeshContextStep(jitted, mesh) if pipelined else jitted

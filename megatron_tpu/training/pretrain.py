@@ -24,6 +24,7 @@ def run_pretrain(
     loss_fn: Callable,
     axes_fn: Optional[Callable] = None,
     mesh=None,
+    valid_dataset=None,
 ) -> int:
     """Build state + iterator and run the training loop. `loss_fn` has the
     make_train_step contract: (params, microbatch_dict, rng) -> scalar."""
@@ -32,6 +33,12 @@ def run_pretrain(
     from megatron_tpu.training.loop import train
     from megatron_tpu.training.train_step import state_from_params
     from megatron_tpu.utils.logging import print_rank_0
+
+    if cfg.data.test_data_path:
+        # finetune.py's GPT data path honors a test split; these entry
+        # points have no test phase — never let the flag pass silently
+        print_rank_0("warning: --test_data_path is ignored by the "
+                     "BERT/T5/ICT pretrain entry points (no test phase)")
 
     rng = jax.random.PRNGKey(cfg.training.seed)
     state = state_from_params(init_params_fn(), cfg)
@@ -50,6 +57,12 @@ def run_pretrain(
         cfg.parallel.data_parallel or 1, cfg.num_microbatches,
         consumed_samples=consumed,
         dataloader_type=cfg.data.dataloader_type, seed=cfg.training.seed)
+    valid_it = None
+    if valid_dataset is not None:
+        valid_it = DictBatchIterator(
+            valid_dataset, cfg.training.micro_batch_size,
+            cfg.parallel.data_parallel or 1, cfg.num_microbatches,
+            seed=cfg.training.seed)
 
     save_fn = None
     if cfg.training.checkpoint_dir:
@@ -58,7 +71,8 @@ def run_pretrain(
                                  iteration, consumed_samples)
 
     state, consumed = train(
-        cfg, train_it, valid_iterator=None, mesh=mesh, state=state, rng=rng,
+        cfg, train_it, valid_iterator=valid_it, mesh=mesh, state=state,
+        rng=rng,
         start_iteration=start_iteration, consumed_samples=consumed,
         save_fn=save_fn,
         step_kwargs={"loss_fn": loss_fn, "init_params_fn": init_params_fn,

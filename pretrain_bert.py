@@ -22,6 +22,20 @@ from megatron_tpu.utils.platform import ensure_env_platform
 ensure_env_platform()
 
 
+
+def _single_prefix(paths, flag):
+    """BERT/T5/ICT pretraining consumes exactly ONE corpus prefix — the
+    weighted blend syntax is a GPT-dataset feature (finetune.py); fail
+    loudly instead of silently training on paths[-1]."""
+    paths = list(paths)
+    if len(paths) != 1:
+        raise SystemExit(
+            f"{flag} takes exactly one indexed-dataset prefix here "
+            f"(got {paths}); weighted blending is only supported by the "
+            "GPT data pipeline (finetune.py)")
+    return paths[0]
+
+
 def main(argv=None):
     from megatron_tpu.arguments import parse_cli
     from megatron_tpu.data import build_tokenizer
@@ -49,15 +63,25 @@ def main(argv=None):
         n_devices=n_devices)
     mcfg = cfg.model
 
-    prefix = cfg.data.data_path[-1] if cfg.data.data_path else None
-    assert prefix, "--data_path required"
-    indexed = MMapIndexedDataset(str(prefix))
+    src_paths = cfg.data.data_path or cfg.data.train_data_path
+    assert src_paths, "--data_path (or --train_data_path) required"
+    prefix = _single_prefix(src_paths, "--data_path")
+
+    def make_ds(pfx, n_samples):
+        return BertDataset(
+            MMapIndexedDataset(str(pfx)), n_samples, mcfg.seq_length,
+            tokenizer.vocab_size, cls_id=tokenizer.cls,
+            sep_id=tokenizer.sep, mask_id=tokenizer.mask,
+            pad_id=tokenizer.pad, seed=cfg.training.seed,
+            masked_lm_prob=cfg.data.masked_lm_prob)
+
     n_samples = cfg.training.train_iters * cfg.training.global_batch_size
-    dataset = BertDataset(
-        indexed, n_samples, mcfg.seq_length, tokenizer.vocab_size,
-        cls_id=tokenizer.cls, sep_id=tokenizer.sep, mask_id=tokenizer.mask,
-        pad_id=tokenizer.pad, seed=cfg.training.seed,
-        masked_lm_prob=cfg.data.masked_lm_prob)
+    dataset = make_ds(prefix, n_samples)
+    valid_dataset = None
+    if cfg.data.valid_data_path:  # ref: --valid_data_path eval corpus
+        valid_dataset = make_ds(
+            _single_prefix(cfg.data.valid_data_path, "--valid_data_path"),
+            cfg.training.eval_iters * cfg.training.global_batch_size)
 
     init_fn = functools.partial(
         bert.bert_init, jax.random.PRNGKey(cfg.training.seed), mcfg)
@@ -69,7 +93,8 @@ def main(argv=None):
     mesh = build_mesh(cfg.parallel) if n_devices > 1 else None
     return run_pretrain(cfg, dataset, init_params_fn=init_fn,
                         loss_fn=loss_fn,
-                        axes_fn=lambda m: bert.bert_axes(m), mesh=mesh)
+                        axes_fn=lambda m: bert.bert_axes(m), mesh=mesh,
+                        valid_dataset=valid_dataset)
 
 
 if __name__ == "__main__":

@@ -22,6 +22,20 @@ from megatron_tpu.utils.platform import ensure_env_platform
 ensure_env_platform()
 
 
+
+def _single_prefix(paths, flag):
+    """BERT/T5/ICT pretraining consumes exactly ONE corpus prefix — the
+    weighted blend syntax is a GPT-dataset feature (finetune.py); fail
+    loudly instead of silently training on paths[-1]."""
+    paths = list(paths)
+    if len(paths) != 1:
+        raise SystemExit(
+            f"{flag} takes exactly one indexed-dataset prefix here "
+            f"(got {paths}); weighted blending is only supported by the "
+            "GPT data pipeline (finetune.py)")
+    return paths[0]
+
+
 def main(argv=None):
     from megatron_tpu.arguments import parse_cli
     from megatron_tpu.data import build_tokenizer
@@ -33,6 +47,10 @@ def main(argv=None):
 
     def extra_args(p):
         p.add_argument("--titles_data_path", type=str, default=None)
+        p.add_argument("--valid_titles_data_path", type=str, default=None,
+                       help="titles for the --valid_data_path corpus "
+                            "(required with it when --titles_data_path "
+                            "is used: titles index per-corpus doc ids)")
         p.add_argument("--ict_head_size", type=int, default=128)
         p.add_argument("--query_in_block_prob", type=float, default=0.1)
         p.add_argument("--biencoder_shared_query_context_model",
@@ -58,17 +76,33 @@ def main(argv=None):
         n_devices=n_devices)
     mcfg = cfg.model
 
-    prefix = cfg.data.data_path[-1] if cfg.data.data_path else None
-    assert prefix, "--data_path required"
-    sentences = MMapIndexedDataset(str(prefix))
-    titles = (MMapIndexedDataset(args.titles_data_path)
-              if args.titles_data_path else None)
-    dataset = ICTDataset(
-        sentences, sentences.doc_idx, titles,
-        max_seq_length=mcfg.seq_length,
-        query_in_block_prob=args.query_in_block_prob,
-        cls_id=tokenizer.cls, sep_id=tokenizer.sep, pad_id=tokenizer.pad,
-        seed=cfg.training.seed, sizes=sentences.sizes)
+    src_paths = cfg.data.data_path or cfg.data.train_data_path
+    assert src_paths, "--data_path (or --train_data_path) required"
+    prefix = _single_prefix(src_paths, "--data_path")
+
+    def make_ds(pfx, titles_path):
+        sentences = MMapIndexedDataset(str(pfx))
+        titles = (MMapIndexedDataset(titles_path) if titles_path else None)
+        return ICTDataset(
+            sentences, sentences.doc_idx, titles,
+            max_seq_length=mcfg.seq_length,
+            query_in_block_prob=args.query_in_block_prob,
+            cls_id=tokenizer.cls, sep_id=tokenizer.sep,
+            pad_id=tokenizer.pad, seed=cfg.training.seed,
+            sizes=sentences.sizes)
+
+    dataset = make_ds(prefix, args.titles_data_path)
+    valid_dataset = None
+    if cfg.data.valid_data_path:  # ref: --valid_data_path eval corpus
+        if args.titles_data_path and not args.valid_titles_data_path:
+            # titles are indexed by doc id WITHIN a corpus — reusing the
+            # train titles against the valid corpus would silently pair
+            # wrong titles (or crash on a doc-count mismatch)
+            raise SystemExit("--valid_data_path with --titles_data_path "
+                             "requires --valid_titles_data_path")
+        valid_dataset = make_ds(
+            _single_prefix(cfg.data.valid_data_path, "--valid_data_path"),
+            args.valid_titles_data_path)
 
     shared = args.biencoder_shared_query_context_model
     init_fn = functools.partial(
@@ -85,7 +119,8 @@ def main(argv=None):
     return run_pretrain(
         cfg, dataset, init_params_fn=init_fn, loss_fn=loss_fn,
         axes_fn=lambda m: biencoder.biencoder_axes(
-            m, ict_head_size=args.ict_head_size, shared=shared), mesh=mesh)
+            m, ict_head_size=args.ict_head_size, shared=shared), mesh=mesh,
+        valid_dataset=valid_dataset)
 
 
 if __name__ == "__main__":

@@ -16,6 +16,20 @@ from megatron_tpu.utils.platform import ensure_env_platform
 ensure_env_platform()
 
 
+
+def _single_prefix(paths, flag):
+    """BERT/T5/ICT pretraining consumes exactly ONE corpus prefix — the
+    weighted blend syntax is a GPT-dataset feature (finetune.py); fail
+    loudly instead of silently training on paths[-1]."""
+    paths = list(paths)
+    if len(paths) != 1:
+        raise SystemExit(
+            f"{flag} takes exactly one indexed-dataset prefix here "
+            f"(got {paths}); weighted blending is only supported by the "
+            "GPT data pipeline (finetune.py)")
+    return paths[0]
+
+
 def main(argv=None):
     from megatron_tpu.arguments import parse_cli
     from megatron_tpu.data import build_tokenizer
@@ -45,18 +59,28 @@ def main(argv=None):
         n_devices=n_devices)
     mcfg = cfg.model
 
-    prefix = cfg.data.data_path[-1] if cfg.data.data_path else None
-    assert prefix, "--data_path required"
-    indexed = MMapIndexedDataset(str(prefix))
-    n_samples = cfg.training.train_iters * cfg.training.global_batch_size
+    src_paths = cfg.data.data_path or cfg.data.train_data_path
+    assert src_paths, "--data_path (or --train_data_path) required"
+    prefix = _single_prefix(src_paths, "--data_path")
     sentinel_ids = list(range(tokenizer.vocab_size - extra_ids,
                               tokenizer.vocab_size))
-    dataset = T5Dataset(
-        indexed, n_samples, mcfg.seq_length,
-        cfg.data.max_seq_length_dec, tokenizer.vocab_size,
-        sentinel_ids=sentinel_ids, bos_id=tokenizer.cls,
-        eos_id=tokenizer.sep, pad_id=tokenizer.pad,
-        seed=cfg.training.seed, masked_lm_prob=cfg.data.masked_lm_prob)
+
+    def make_ds(pfx, n_samples):
+        return T5Dataset(
+            MMapIndexedDataset(str(pfx)), n_samples, mcfg.seq_length,
+            cfg.data.max_seq_length_dec, tokenizer.vocab_size,
+            sentinel_ids=sentinel_ids, bos_id=tokenizer.cls,
+            eos_id=tokenizer.sep, pad_id=tokenizer.pad,
+            seed=cfg.training.seed,
+            masked_lm_prob=cfg.data.masked_lm_prob)
+
+    n_samples = cfg.training.train_iters * cfg.training.global_batch_size
+    dataset = make_ds(prefix, n_samples)
+    valid_dataset = None
+    if cfg.data.valid_data_path:  # ref: --valid_data_path eval corpus
+        valid_dataset = make_ds(
+            _single_prefix(cfg.data.valid_data_path, "--valid_data_path"),
+            cfg.training.eval_iters * cfg.training.global_batch_size)
 
     init_fn = functools.partial(
         t5.t5_init, jax.random.PRNGKey(cfg.training.seed), mcfg)
@@ -68,7 +92,8 @@ def main(argv=None):
     mesh = build_mesh(cfg.parallel) if n_devices > 1 else None
     return run_pretrain(cfg, dataset, init_params_fn=init_fn,
                         loss_fn=loss_fn,
-                        axes_fn=lambda m: t5.t5_axes(m), mesh=mesh)
+                        axes_fn=lambda m: t5.t5_axes(m), mesh=mesh,
+                        valid_dataset=valid_dataset)
 
 
 if __name__ == "__main__":

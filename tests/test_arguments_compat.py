@@ -85,6 +85,9 @@ def test_save_and_logging_flags():
 def test_split_paths_exclusive_with_data_path():
     with pytest.raises(SystemExit):
         parse(BASE + ["--data_path", "x", "--train_data_path", "y"])
+    # --valid/test_data_path may COMBINE with --data_path (train corpus)
+    cfg, _ = parse(BASE + ["--data_path", "x", "--valid_data_path", "y"])
+    assert cfg.data.valid_data_path == ["y"]
 
 
 def test_mask_and_decoder_flags():

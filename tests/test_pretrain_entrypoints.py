@@ -92,3 +92,18 @@ def test_pretrain_ict_entrypoint(corpus):
     assert pretrain_ict.main(argv) == 0
     from megatron_tpu.training.checkpointing import read_tracker
     assert read_tracker(save) == "3"
+
+
+def test_pretrain_bert_with_validation(corpus, caplog):
+    """--valid_data_path drives in-loop evaluation through the custom
+    BERT loss (ref: pretrain loop eval_interval evaluation)."""
+    import logging
+
+    import pretrain_bert
+    save = str(corpus["tmp"] / "bert_eval_ckpt")
+    argv = _common_argv(corpus, save) + [
+        "--valid_data_path", corpus["docs"],
+        "--eval_interval", "2", "--eval_iters", "1"]
+    with caplog.at_level(logging.INFO):
+        assert pretrain_bert.main(argv) == 0
+    assert "validation at iteration 2" in caplog.text
