@@ -79,6 +79,11 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                    default=None)
     g.add_argument("--sequence_parallel", action="store_true")
     g.add_argument("--use_distributed_optimizer", action="store_true")
+    g.add_argument("--context_parallel_algo", type=str, default="ring",
+                   choices=["ring", "ulysses"],
+                   help="cp>1 attention: K/V-rotation ring (no head "
+                        "constraint) or all-to-all head-parallel ulysses "
+                        "(heads %% cp == 0, lower comm volume)")
 
     g = p.add_argument_group("training")
     g.add_argument("--micro_batch_size", type=int, default=1)
@@ -377,6 +382,14 @@ def config_from_args(args: argparse.Namespace,
             attention_impl="flash" if args.use_flash_attn else "dot",
         ))
         model = ModelConfig(**md)
+
+    if args.context_parallel > 1 and \
+            model.attention_impl not in ("ring", "ulysses"):
+        # cp>1 needs a context-parallel attention impl; the algo flag
+        # picks ring vs ulysses (both run flash on the local block)
+        import dataclasses
+        model = dataclasses.replace(
+            model, attention_impl=args.context_parallel_algo)
 
     vpp = 1
     if args.num_layers_per_virtual_pipeline_stage:

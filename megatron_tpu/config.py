@@ -101,7 +101,8 @@ class ModelConfig:
     use_scaled_init: bool = True  # scale output-layer init by 1/sqrt(2*num_layers)
 
     # attention implementation: "flash" (blockwise/Pallas) | "dot" (xla
-    # einsum) | "ring" (context-parallel ring attention over 'cp')
+    # einsum) | "ring" (context-parallel K/V-rotation over 'cp') |
+    # "ulysses" (context-parallel all-to-all head sharding over 'cp')
     attention_impl: str = "dot"
     # activation recompute: "none" | "selective" | "full" (ref: arguments.py:601-629)
     recompute_granularity: str = "none"
@@ -113,9 +114,10 @@ class ModelConfig:
 
     def derived(self) -> "ModelConfig":
         """Fill derived fields (ffn size, kv heads, head dim, max positions)."""
-        assert self.attention_impl in ("dot", "flash", "ring"), (
-            f"attention_impl must be 'dot', 'flash' or 'ring', "
-            f"got {self.attention_impl!r}")
+        assert self.attention_impl in ("dot", "flash", "ring",
+                                       "ulysses"), (
+            f"attention_impl must be 'dot', 'flash', 'ring' or "
+            f"'ulysses', got {self.attention_impl!r}")
         d: dict[str, Any] = {}
         if self.num_kv_heads is None:
             d["num_kv_heads"] = self.num_attention_heads
@@ -316,6 +318,15 @@ class MegatronConfig:
             assert par.tensor_parallel >= 1
             assert model.seq_length % max(par.tensor_parallel, 1) == 0, (
                 "sequence parallel requires seq_length divisible by tp")
+        if model.attention_impl == "ulysses" and par.context_parallel > 1:
+            # fail at config time, not first jit trace
+            nkv = model.num_kv_heads or model.num_attention_heads
+            assert model.num_attention_heads % par.context_parallel == 0 \
+                and nkv % par.context_parallel == 0, (
+                f"ulysses needs query AND kv head counts divisible by "
+                f"cp={par.context_parallel} (got "
+                f"nq={model.num_attention_heads}, nkv={nkv}); use "
+                f"--context_parallel_algo ring")
         assert model.num_layers % par.pipeline_parallel == 0, (
             f"num_layers {model.num_layers} must divide evenly into "
             f"pp={par.pipeline_parallel} stages")

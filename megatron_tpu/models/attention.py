@@ -192,14 +192,23 @@ def attention_apply(
     # (attention_softmax_in_fp32), so the trick is unnecessary and the flag
     # intentionally has no numerical effect.
 
-    if (cfg.attention_impl == "ring" and kv_cache is None
+    if (cfg.attention_impl in ("ring", "ulysses") and kv_cache is None
             and segment_ids is None and causal):
-        # context-parallel ring attention over the 'cp' mesh axis (absent in
-        # the reference — SURVEY.md §2.8; see parallel/ring_attention.py)
-        from megatron_tpu.parallel.ring_attention import ring_attention
+        # context-parallel attention over the 'cp' mesh axis (absent in
+        # the reference — SURVEY.md §2.8): K/V-rotation ring
+        # (parallel/ring_attention.py) or all-to-all head-parallel Ulysses
+        # (parallel/ulysses.py)
         mesh = jax.sharding.get_abstract_mesh()  # jit-safe ambient mesh
         if "cp" in mesh.axis_names and not mesh.empty:
-            out = ring_attention(q, k, v, mesh, causal=True, scale=scale)
+            if cfg.attention_impl == "ulysses":
+                from megatron_tpu.parallel.ulysses import ulysses_attention
+                out = ulysses_attention(q, k, v, mesh, causal=True,
+                                        scale=scale)
+            else:
+                from megatron_tpu.parallel.ring_attention import \
+                    ring_attention
+                out = ring_attention(q, k, v, mesh, causal=True,
+                                     scale=scale)
         else:
             from megatron_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True, scale=scale)

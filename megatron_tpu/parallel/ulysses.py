@@ -1,0 +1,71 @@
+"""Ulysses-style all-to-all sequence parallelism over the 'cp' mesh axis.
+
+Head-parallel attention (DeepSpeed-Ulysses, arXiv:2309.14509 — absent in
+the reference, SURVEY.md §2.8 "DeepSpeed-Ulysses: ❌"): activations live
+seq-sharded [b, S/cp, n, d]; two all-to-alls re-shard to head-sharded
+[b, S, n/cp, d] around the attention core, so every device runs FULL-
+sequence attention for its slice of heads. Communication is O(S·h/cp) per
+device per all-to-all — cheaper than ring's cp K/V rotations when heads
+divide evenly — at the cost of requiring n_heads % cp == 0.
+
+Complements `parallel/ring_attention.py` (which has no head-count
+constraint and overlaps compute with the K/V rotation); select with
+`--context_parallel_algo {ring,ulysses}`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from megatron_tpu.ops.flash_attention import flash_attention
+
+
+def ulysses_attention(q, k, v, mesh, *, causal: bool = True,
+                      scale: float | None = None, axis: str = "cp"):
+    """q [b, S, nq, d], k/v [b, S, nkv, d], S GLOBAL and sharded over
+    `axis` on dim 1. Returns [b, S, nq, d], same sharding. Must run under
+    jit with the ambient mesh set (same contract as ring_attention)."""
+    cp = mesh.shape[axis]
+    if cp == 1:
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    nq, nkv = q.shape[2], k.shape[2]
+    if nq % cp or nkv % cp:
+        raise ValueError(
+            f"ulysses needs query AND kv head counts divisible by cp={cp} "
+            f"(got nq={nq}, nkv={nkv}); use --context_parallel_algo ring")
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    on_tpu = jax.default_backend() == "tpu"
+    # CPU SPMD partitioner rejects bf16 collectives in partial-manual
+    # regions; keep compute dtype on TPU only (mirrors ring_attention)
+    comm_dtype = q.dtype if on_tpu else jnp.float32
+    out_dtype = q.dtype
+
+    def per_rank(q, k, v):
+        # seq-shard -> head-shard: [b, s_loc, n, d] -> [b, S, n/cp, d]
+        def fwd(x):
+            return jax.lax.all_to_all(x.astype(comm_dtype), axis,
+                                      split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        qh, kh, vh = fwd(q), fwd(k), fwd(v)
+        # full-sequence attention on this device's head slice; the
+        # dispatcher picks the Pallas kernel on TPU (XLA blockwise
+        # otherwise / on non-tiling shapes) — O(S) memory either way
+        out = flash_attention(qh.astype(q.dtype), kh.astype(q.dtype),
+                              vh.astype(q.dtype), causal=causal,
+                              scale=scale)
+        # head-shard -> seq-shard
+        out = jax.lax.all_to_all(out.astype(comm_dtype), axis,
+                                 split_axis=1, concat_axis=2, tiled=True)
+        return out.astype(out_dtype)
+
+    shmap = jax.shard_map(
+        per_rank,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+        check_vma=False,
+        axis_names={axis},
+    )
+    return shmap(q, k, v)

@@ -1,0 +1,107 @@
+"""Ulysses all-to-all context parallelism on the virtual CPU mesh.
+
+No reference counterpart (SURVEY.md §2.8: Ulysses absent) — the contract
+is mathematical: head-parallel attention over 'cp' must equal full
+attention on the gathered sequence, forward and backward, and compose
+with the model's cp-sharded loss path like ring attention does.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.parallel.ulysses import ulysses_attention
+from tests.test_ring_attention import make_mesh, ref_attention
+
+
+@pytest.mark.parametrize("cp,nq,nkv,causal", [
+    (2, 4, 4, True), (4, 4, 4, True), (2, 4, 2, True), (4, 4, 4, False)])
+def test_ulysses_matches_full(devices, cp, nq, nkv, causal):
+    mesh = make_mesh(1, cp, 1, devices)
+    b, s, d = 2, 16 * cp, 32
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, s, nq, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, nkv, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, nkv, d), jnp.float32)
+    want = ref_attention(q, k, v, causal=causal)
+    with jax.set_mesh(mesh):
+        got = jax.jit(lambda q, k, v: ulysses_attention(
+            q, k, v, mesh, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_gradients_match(devices):
+    cp = 4
+    mesh = make_mesh(1, cp, 1, devices)
+    b, s, nq, d = 1, 16 * cp, 4, 32
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (b, s, nq, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, nq, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, nq, d), jnp.float32)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(ref_attention(q, k, v)))
+
+    def loss_uly(q, k, v):
+        return jnp.sum(jnp.square(ulysses_attention(q, k, v, mesh)))
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    with jax.set_mesh(mesh):
+        g_uly = jax.jit(jax.grad(loss_uly, argnums=(0, 1, 2)))(q, k, v)
+    for a, b_ in zip(g_uly, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_ulysses_rejects_indivisible_heads(devices):
+    mesh = make_mesh(1, 4, 1, devices)
+    q = jnp.zeros((1, 64, 2, 16))  # 2 heads, cp=4
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, q, q, mesh)
+
+
+def test_cli_selects_ulysses(devices):
+    from megatron_tpu.arguments import parse_cli
+    cfg, _ = parse_cli(
+        ["--num_layers", "2", "--hidden_size", "64",
+         "--num_attention_heads", "4", "--seq_length", "64",
+         "--max_position_embeddings", "64",
+         "--context_parallel_size", "4",
+         "--context_parallel_algo", "ulysses"], n_devices=8)
+    assert cfg.model.attention_impl == "ulysses"
+    cfg, _ = parse_cli(
+        ["--num_layers", "2", "--hidden_size", "64",
+         "--num_attention_heads", "4", "--seq_length", "64",
+         "--max_position_embeddings", "64",
+         "--context_parallel_size", "4"], n_devices=8)
+    assert cfg.model.attention_impl == "ring"
+
+
+def test_model_loss_with_ulysses_matches_single_device(devices):
+    """End-to-end: the GPT loss with attention_impl='ulysses' on a cp=4
+    mesh equals the same loss computed single-device with dot attention."""
+    import dataclasses as dc
+
+    from megatron_tpu.config import ModelConfig
+    from megatron_tpu.models import language_model as lm
+
+    cp = 4
+    mesh = make_mesh(1, cp, 1, devices)
+    cfg = ModelConfig(num_layers=2, hidden_size=64, num_attention_heads=4,
+                      num_kv_heads=4, vocab_size=128, seq_length=16 * cp,
+                      make_vocab_size_divisible_by=1,
+                      compute_dtype="float32",
+                      attention_impl="dot").derived()
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    rope = lm.make_rope(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                (2, cfg.seq_length + 1), 0, 128,
+                                dtype=jnp.int32)
+    want = lm.loss_fn(params, tokens, cfg, rope=rope, deterministic=True)
+
+    ucfg = dc.replace(cfg, attention_impl="ulysses")
+    with jax.set_mesh(mesh):
+        got = jax.jit(lambda p, t: lm.loss_fn(
+            p, t, ucfg, rope=rope, deterministic=True))(params, tokens)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
