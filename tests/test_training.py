@@ -309,3 +309,31 @@ class TestDistributedOptimizer:
         # moments really are dp-sharded
         mu_leaf = jax.tree.leaves(s_dist.opt_state.mu)[0]
         assert "dp" in str(mu_leaf.sharding.spec)
+
+
+def test_state_from_params_seeds_fp16_scaler():
+    """fp16 compute must seed the dynamic loss scaler for ANY model family
+    (regression: the BERT/T5/ICT path once initialized it at 1.0)."""
+    import dataclasses as dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_tpu.config import (MegatronConfig, ModelConfig,
+                                     OptimizerConfig, TrainingConfig)
+    from megatron_tpu.training.train_step import state_from_params
+
+    params = {"w": jnp.zeros((4, 4))}
+    base = MegatronConfig(
+        model=ModelConfig(num_layers=2, hidden_size=32,
+                          num_attention_heads=2, vocab_size=64,
+                          seq_length=16, compute_dtype="float16"),
+        optimizer=OptimizerConfig(lr=1e-4),
+        training=TrainingConfig(micro_batch_size=1, global_batch_size=1,
+                                train_iters=1))
+    st = state_from_params(params, base)
+    assert float(st.opt_state.scaler.scale) == 2.0 ** 32
+    bf16 = dc.replace(base, model=dc.replace(base.model,
+                                             compute_dtype="bfloat16"))
+    st = state_from_params(params, bf16)
+    assert float(st.opt_state.scaler.scale) == 1.0
