@@ -20,7 +20,7 @@ from megatron_tpu.utils.platform import ensure_env_platform
 ensure_env_platform()
 
 
-def build_data(cfg, tokenizer, consumed_samples: int):
+def build_data(cfg, tokenizer, consumed_samples: int, mesh=None):
     """(ref: megatron/training.py:855-939 build_train_valid_test_data_iterators
     + finetune.py:107 dataset provider)"""
     from megatron_tpu.data import BatchIterator, build_train_valid_test_datasets
@@ -53,6 +53,15 @@ def build_data(cfg, tokenizer, consumed_samples: int):
             cfg.data.data_path, cfg.data.split, cfg.model.seq_length,
             tr.seed, *samples)
 
+    host_rows = None
+    if mesh is not None and jax.process_count() > 1:
+        # pod-scale: this host only tokenizes its own dp rows (see
+        # multihost.make_global_batch — other rows are never read here).
+        # THE mesh from main(): host_rows must match the exact device
+        # layout make_global_batch shards against
+        from megatron_tpu.parallel.multihost import process_batch_rows
+        host_rows = process_batch_rows(mesh, tr.micro_batch_size * dp)
+
     def make_iter(ds, consumed):
         if ds is None:
             return None
@@ -62,7 +71,8 @@ def build_data(cfg, tokenizer, consumed_samples: int):
             seed=tr.seed, eod_token=tokenizer.eod if tokenizer else None,
             reset_position_ids=cfg.data.reset_position_ids,
             reset_attention_mask=cfg.data.reset_attention_mask,
-            eod_mask_loss=cfg.data.eod_mask_loss)
+            eod_mask_loss=cfg.data.eod_mask_loss,
+            host_rows=host_rows)
 
     return (make_iter(train_ds, consumed_samples), make_iter(valid_ds, 0),
             make_iter(test_ds, 0))
@@ -120,7 +130,7 @@ def main(argv=None):
         if loaded is not None:
             state = loaded
 
-    train_it, valid_it, _ = build_data(cfg, tokenizer, consumed)
+    train_it, valid_it, _ = build_data(cfg, tokenizer, consumed, mesh=mesh)
     assert train_it is not None, "--data_path produced no training data"
 
     save_fn = None

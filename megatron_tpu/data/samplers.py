@@ -100,13 +100,22 @@ class BatchIterator:
                  eod_token: Optional[int] = None,
                  reset_position_ids: bool = False,
                  reset_attention_mask: bool = False,
-                 eod_mask_loss: bool = False):
+                 eod_mask_loss: bool = False,
+                 host_rows: Optional[tuple] = None):
         self.dataset = dataset
         self.num_microbatches = num_microbatches
         self.eod_token = eod_token
         self.reset_position_ids = reset_position_ids
         self.reset_attention_mask = reset_attention_mask
         self.eod_mask_loss = eod_mask_loss
+        # pod-scale: (lo, hi) global-batch rows THIS host feeds (from
+        # multihost.process_batch_rows). Rows outside stay zero-filled —
+        # make_array_from_callback never reads them on this host, so the
+        # per-host tokenization cost is O(rows/hosts), replacing the
+        # reference's "tp-rank-0 loads then broadcasts" trick
+        # (ref: training.py:855-939)
+        self.host_rows = host_rows
+        self._zero_row = None  # cached unowned-row template
         self._sampler_args = (micro_batch_size, data_parallel, seed,
                               drop_last)
         self._dataloader_type = dataloader_type
@@ -147,30 +156,60 @@ class BatchIterator:
 
     def __next__(self) -> dict:
         micro = []
+        full_rows = self._sampler_args[0] * self._sampler_args[1]
         for _ in range(self.num_microbatches):
             idxs = self._next_indices()
-            micro.append(np.stack(
-                [np.asarray(self.dataset[i]["text"]) for i in idxs]))
+            rows = self.host_rows
+            if rows is not None and len(idxs) != full_rows:
+                # partial tail batch (drop_last=False): the dp sharding of
+                # the SMALLER array maps hosts to different rows than the
+                # precomputed range — materialize everything rather than
+                # risk feeding zero rows to a device
+                rows = None
+            if rows is not None:
+                lo, hi = rows
+                if self._zero_row is None:
+                    self._zero_row = np.zeros_like(
+                        np.asarray(self.dataset[idxs[0]]["text"]))
+                micro.append(np.stack(
+                    [np.asarray(self.dataset[i]["text"])
+                     if lo <= r < hi else self._zero_row
+                     for r, i in enumerate(idxs)]))
+            else:
+                micro.append(np.stack(
+                    [np.asarray(self.dataset[i]["text"]) for i in idxs]))
         tokens = np.stack(micro).astype(np.int32)  # [n_micro, b, seq+1]
         batch = {"tokens": tokens}
         n_micro, b, sp1 = tokens.shape
+        # owned row range for mask work: zero-filled rows are never read
+        # by this host's devices, and running the EOD scan on them is
+        # waste (pathological when eod_token==0 — every position matches)
+        lo, hi = (0, b) if (self.host_rows is None
+                            or rows is None) else self.host_rows
         if ((self.reset_position_ids or self.reset_attention_mask or
              self.eod_mask_loss) and self.eod_token is not None):
             # helper runs on the INPUT tokens (tokens[:-1]); its loss_mask
             # zeroes positions whose input is EOD — i.e. it suppresses
             # predicting the next document's first token FROM the EOD,
             # matching ref: megatron/utils.py:137-194
-            flat = tokens[..., :-1].reshape(n_micro * b, sp1 - 1)
+            flat = tokens[:, lo:hi, :-1].reshape(n_micro * (hi - lo),
+                                                 sp1 - 1)
             loss_mask, pos, seg = get_ltor_masks_and_position_ids(
                 flat, self.eod_token,
                 reset_position_ids=self.reset_position_ids,
                 reset_attention_mask=self.reset_attention_mask,
                 eod_mask_loss=self.eod_mask_loss)
-            batch["loss_mask"] = loss_mask.reshape(n_micro, b, sp1 - 1)
+
+            def expand(x, fill):
+                out = np.full((n_micro, b, sp1 - 1), fill, x.dtype)
+                out[:, lo:hi] = x.reshape(n_micro, hi - lo, sp1 - 1)
+                return out
+
+            batch["loss_mask"] = expand(loss_mask, 0)
             if self.reset_position_ids:
-                batch["position_ids"] = pos.reshape(n_micro, b, sp1 - 1)
+                batch["position_ids"] = expand(pos, 0)
             if self.reset_attention_mask:
-                batch["segment_ids"] = seg.reshape(n_micro, b, sp1 - 1)
+                batch["segment_ids"] = expand(seg, 0)
         else:
             batch["loss_mask"] = np.ones(tokens[..., 1:].shape, np.float32)
         return batch

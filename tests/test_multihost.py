@@ -51,3 +51,57 @@ def test_callback_lift_matches_device_put(mesh):
 
 def test_process_batch_rows_single_process(mesh):
     assert process_batch_rows(mesh, 16) == (0, 16)
+
+
+def test_batch_iterator_host_rows_zero_fill():
+    """host_rows=(lo,hi): only this host's rows are materialized; other
+    rows are zero (never read by make_array_from_callback on this host)."""
+    import numpy as np
+
+    from megatron_tpu.data.samplers import BatchIterator
+
+    class TinyDs:
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return {"text": np.full(9, i + 1, np.int64)}
+
+    it = BatchIterator(TinyDs(), micro_batch_size=4, data_parallel=1,
+                       num_microbatches=1, host_rows=(1, 3))
+    batch = next(it)
+    toks = batch["tokens"][0]  # [4, 9]
+    assert np.all(toks[0] == 0) and np.all(toks[3] == 0)
+    assert np.all(toks[1] != 0) and np.all(toks[2] != 0)
+    # without host_rows, all rows real
+    it2 = BatchIterator(TinyDs(), micro_batch_size=4, data_parallel=1,
+                        num_microbatches=1)
+    assert np.all(next(it2)["tokens"][0] != 0)
+
+
+def test_batch_iterator_host_rows_masks_only_owned():
+    """EOD mask machinery runs only on owned rows; unowned rows carry
+    zero loss_mask (never read on this host)."""
+    import numpy as np
+
+    from megatron_tpu.data.samplers import BatchIterator
+
+    class EodDs:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            t = np.full(9, i + 1, np.int64)
+            t[4] = 0  # eod mid-sequence
+            return {"text": t}
+
+    it = BatchIterator(EodDs(), micro_batch_size=4, data_parallel=1,
+                       num_microbatches=1, host_rows=(0, 2), eod_token=0,
+                       eod_mask_loss=True, reset_position_ids=True)
+    batch = next(it)
+    # owned rows: eod position masked out of the loss
+    assert batch["loss_mask"][0, 0, 4] == 0.0
+    assert batch["loss_mask"][0, 1].sum() > 0
+    # unowned rows: all-zero mask and positions (placeholder)
+    assert batch["loss_mask"][0, 2].sum() == 0
+    assert batch["position_ids"][0, 3].sum() == 0
