@@ -157,6 +157,7 @@ class BatchIterator:
     def __next__(self) -> dict:
         micro = []
         full_rows = self._sampler_args[0] * self._sampler_args[1]
+        all_full = True  # every microbatch this call was full-size
         for _ in range(self.num_microbatches):
             idxs = self._next_indices()
             rows = self.host_rows
@@ -166,6 +167,7 @@ class BatchIterator:
                 # precomputed range — materialize everything rather than
                 # risk feeding zero rows to a device
                 rows = None
+                all_full = False
             if rows is not None:
                 lo, hi = rows
                 if self._zero_row is None:
@@ -184,8 +186,8 @@ class BatchIterator:
         # owned row range for mask work: zero-filled rows are never read
         # by this host's devices, and running the EOD scan on them is
         # waste (pathological when eod_token==0 — every position matches)
-        lo, hi = (0, b) if (self.host_rows is None
-                            or rows is None) else self.host_rows
+        lo, hi = self.host_rows if (self.host_rows is not None
+                                    and all_full) else (0, b)
         if ((self.reset_position_ids or self.reset_attention_mask or
              self.eod_mask_loss) and self.eod_token is not None):
             # helper runs on the INPUT tokens (tokens[:-1]); its loss_mask
@@ -201,6 +203,8 @@ class BatchIterator:
                 eod_mask_loss=self.eod_mask_loss)
 
             def expand(x, fill):
+                if (lo, hi) == (0, b):  # single-host: zero-copy reshape
+                    return x.reshape(n_micro, b, sp1 - 1)
                 out = np.full((n_micro, b, sp1 - 1), fill, x.dtype)
                 out[:, lo:hi] = x.reshape(n_micro, hi - lo, sp1 - 1)
                 return out

@@ -1,0 +1,116 @@
+"""Kernel microbenchmarks on the current accelerator.
+
+One command for the on-chip A/B numbers PERF_NOTES.md tracks: Pallas vs
+XLA for the fused norms and for flash attention, at transformer shapes.
+Writes human-readable lines to --out (default /tmp/kernel_bench.log) AS
+WELL as stdout — the axon tunnel can kill long runs, and piped output
+dies with the process (see PERF_NOTES "axon remote-compile quirks").
+
+  python tools/bench_kernels.py [--out FILE] [--iters N]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_tpu.utils.platform import ensure_env_platform
+
+
+def main(argv=None):
+    ensure_env_platform()
+    p = argparse.ArgumentParser("bench_kernels", description=__doc__)
+    p.add_argument("--out", default="/tmp/kernel_bench.log")
+    p.add_argument("--iters", type=int, default=50)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_tpu.models.norms import layernorm, rmsnorm
+    from megatron_tpu.ops.flash_attention import (_blockwise_attention,
+                                                  flash_attention)
+    from megatron_tpu.ops.fused_norms import (pallas_layernorm,
+                                              pallas_rmsnorm)
+
+    log = open(args.out, "w", buffering=1)
+
+    def emit(line):
+        print(line, flush=True)
+        log.write(line + "\n")
+
+    dev = jax.devices()[0]
+    emit(f"device: {dev.platform} {getattr(dev, 'device_kind', '?')}")
+
+    def timeit(fn, *a):
+        jax.block_until_ready(fn(*a))  # compile
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = fn(*a)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / args.iters * 1e6  # us
+
+    # --- norms: pallas vs xla-fused jnp, fwd and vjp ---
+    for (b, s, h) in [(4, 2048, 2048), (2, 4096, 4096), (8, 1024, 8192)]:
+        x = jax.random.normal(jax.random.PRNGKey(0), (b, s, h),
+                              jnp.bfloat16)
+        scale = jnp.ones((h,), jnp.bfloat16)
+        bias = jnp.zeros((h,), jnp.bfloat16)
+        dy = jax.random.normal(jax.random.PRNGKey(1), (b, s, h),
+                               jnp.bfloat16)
+        gb = 2 * x.size * 2 / 1e9  # read+write bf16
+
+        pairs = [
+            ("rms fwd",
+             jax.jit(lambda x, s: rmsnorm({"scale": s}, x)),
+             jax.jit(lambda x, s: pallas_rmsnorm(x, s)), (x, scale)),
+            ("ln  fwd",
+             jax.jit(lambda x, s, b2: layernorm({"scale": s, "bias": b2},
+                                                x)),
+             jax.jit(lambda x, s, b2: pallas_layernorm(x, s, b2)),
+             (x, scale, bias)),
+            ("rms vjp",
+             jax.jit(jax.grad(lambda x, s: jnp.sum(
+                 rmsnorm({"scale": s}, x).astype(jnp.float32)
+                 * dy.astype(jnp.float32)), argnums=(0, 1))),
+             jax.jit(jax.grad(lambda x, s: jnp.sum(
+                 pallas_rmsnorm(x, s).astype(jnp.float32)
+                 * dy.astype(jnp.float32)), argnums=(0, 1))), (x, scale)),
+        ]
+        for name, f_xla, f_pal, fargs in pairs:
+            try:
+                t_x = timeit(f_xla, *fargs)
+                t_p = timeit(f_pal, *fargs)
+                emit(f"{name} [{b},{s},{h}] bf16: xla {t_x:8.1f}us "
+                     f"({gb / (t_x * 1e-6):5.0f} GB/s) | pallas "
+                     f"{t_p:8.1f}us ({gb / (t_p * 1e-6):5.0f} GB/s)")
+            except Exception as e:
+                emit(f"{name} [{b},{s},{h}] FAILED: "
+                     f"{type(e).__name__}: {str(e)[:160]}")
+
+    # --- flash attention: pallas kernel vs xla blockwise, fwd ---
+    for (b, s, n, d) in [(2, 2048, 16, 128), (1, 8192, 8, 128),
+                         (1, 32768, 4, 128)]:
+        q = jax.random.normal(jax.random.PRNGKey(2), (b, s, n, d),
+                              jnp.bfloat16)
+        try:
+            t_p = timeit(jax.jit(lambda q: flash_attention(
+                q, q, q, causal=True, use_pallas=True)), q)
+            t_x = timeit(jax.jit(lambda q: _blockwise_attention(
+                q, q, q, causal=True, scale=None, block_kv=512)), q)
+            fl = 4 * b * n * s * s * d / 2  # causal matmul flops
+            emit(f"flash fwd [{b},{s},{n},{d}] bf16: pallas {t_p:9.1f}us "
+                 f"({fl / (t_p * 1e-6) / 1e12:5.1f} TF/s) | xla-block "
+                 f"{t_x:9.1f}us ({fl / (t_x * 1e-6) / 1e12:5.1f} TF/s)")
+        except Exception as e:
+            emit(f"flash [{b},{s},{n},{d}] FAILED: "
+                 f"{type(e).__name__}: {str(e)[:160]}")
+    emit("done")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
