@@ -116,6 +116,13 @@ class BatchIterator:
         # (ref: training.py:855-939)
         self.host_rows = host_rows
         self._zero_row = None  # cached unowned-row template
+        if not drop_last and num_microbatches > 1:
+            # an epoch-tail partial microbatch cannot stack with the
+            # wrapped epoch's full-size ones — the combination has no
+            # rectangular batch; accumulate with drop_last instead
+            raise ValueError(
+                "drop_last=False requires num_microbatches == 1 "
+                f"(got {num_microbatches})")
         self._sampler_args = (micro_batch_size, data_parallel, seed,
                               drop_last)
         self._dataloader_type = dataloader_type
@@ -232,6 +239,12 @@ class DictBatchIterator:
                  drop_last: bool = True):
         self.dataset = dataset
         self.num_microbatches = num_microbatches
+        if not drop_last and num_microbatches > 1:
+            # same rectangularity constraint as BatchIterator: a partial
+            # tail microbatch cannot stack with full wrapped-epoch ones
+            raise ValueError(
+                "drop_last=False requires num_microbatches == 1 "
+                f"(got {num_microbatches})")
         self._sampler_args = (micro_batch_size, data_parallel, seed,
                               drop_last)
         self._dataloader_type = dataloader_type

@@ -31,8 +31,11 @@ def main(argv=None):
     import jax.numpy as jnp
 
     from megatron_tpu.models.norms import layernorm, rmsnorm
-    from megatron_tpu.ops.flash_attention import (_blockwise_attention,
-                                                  flash_attention)
+    from megatron_tpu.ops.flash_attention import _blockwise_attention
+    # direct kernel import: an ImportError must FAIL the pallas arm, not
+    # silently time the XLA fallback under a 'pallas' label
+    from megatron_tpu.ops.flash_attention_pallas import \
+        pallas_flash_attention
     from megatron_tpu.ops.fused_norms import (pallas_layernorm,
                                               pallas_rmsnorm)
 
@@ -61,18 +64,19 @@ def main(argv=None):
         bias = jnp.zeros((h,), jnp.bfloat16)
         dy = jax.random.normal(jax.random.PRNGKey(1), (b, s, h),
                                jnp.bfloat16)
-        gb = 2 * x.size * 2 / 1e9  # read+write bf16
+        gb_fwd = 2 * x.size * 2 / 1e9   # x read + y write, bf16
+        gb_vjp = 3 * x.size * 2 / 1e9   # x + dy reads, dx write
 
         pairs = [
-            ("rms fwd",
+            ("rms fwd", gb_fwd,
              jax.jit(lambda x, s: rmsnorm({"scale": s}, x)),
              jax.jit(lambda x, s: pallas_rmsnorm(x, s)), (x, scale)),
-            ("ln  fwd",
+            ("ln  fwd", gb_fwd,
              jax.jit(lambda x, s, b2: layernorm({"scale": s, "bias": b2},
                                                 x)),
              jax.jit(lambda x, s, b2: pallas_layernorm(x, s, b2)),
              (x, scale, bias)),
-            ("rms vjp",
+            ("rms vjp", gb_vjp,
              jax.jit(jax.grad(lambda x, s: jnp.sum(
                  rmsnorm({"scale": s}, x).astype(jnp.float32)
                  * dy.astype(jnp.float32)), argnums=(0, 1))),
@@ -80,7 +84,7 @@ def main(argv=None):
                  pallas_rmsnorm(x, s).astype(jnp.float32)
                  * dy.astype(jnp.float32)), argnums=(0, 1))), (x, scale)),
         ]
-        for name, f_xla, f_pal, fargs in pairs:
+        for name, gb, f_xla, f_pal, fargs in pairs:
             try:
                 t_x = timeit(f_xla, *fargs)
                 t_p = timeit(f_pal, *fargs)
@@ -97,8 +101,8 @@ def main(argv=None):
         q = jax.random.normal(jax.random.PRNGKey(2), (b, s, n, d),
                               jnp.bfloat16)
         try:
-            t_p = timeit(jax.jit(lambda q: flash_attention(
-                q, q, q, causal=True, use_pallas=True)), q)
+            t_p = timeit(jax.jit(lambda q: pallas_flash_attention(
+                q, q, q, True, None)), q)
             t_x = timeit(jax.jit(lambda q: _blockwise_attention(
                 q, q, q, causal=True, scale=None, block_kv=512)), q)
             fl = 4 * b * n * s * s * d / 2  # causal matmul flops
