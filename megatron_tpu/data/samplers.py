@@ -307,3 +307,87 @@ def get_ltor_masks_and_position_ids(
                     segment_ids[bi, e + 1:] = si + 1
                 prev = e + 1
     return loss_mask, position_ids, segment_ids
+
+
+class PrefetchIterator:
+    """Background-thread batch prefetch: host-side sample assembly
+    (tokenization, masks, index walks) overlaps device compute instead of
+    sitting on the training step's critical path — the reference gets the
+    same overlap from torch DataLoader worker processes
+    (ref: data_samplers.py num_workers). Order-preserving; exceptions from
+    the source iterator re-raise at the consuming call site; exhaustion
+    keeps raising (the sentinel is re-armed). Call `close()` when done —
+    the train loop does in its finally block — or the producer thread
+    stays parked holding `depth` buffered batches.
+
+    NOT safe under batch-size rampup: buffered batches lag a
+    num_microbatches change by up to `depth` steps, skewing the
+    consumed-samples accounting, so loop.py only wraps when rampup is
+    off (num_microbatches is then constant and the forwarding setter is
+    a benign same-value write)."""
+
+    _STOP = object()
+
+    def __init__(self, it, depth: int = 2):
+        import queue
+        import threading
+        self._queue_mod = queue
+        self._it = it
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._err = None
+        self._closed = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    @property
+    def num_microbatches(self):
+        return self._it.num_microbatches
+
+    @num_microbatches.setter
+    def num_microbatches(self, v):
+        self._it.num_microbatches = v
+
+    def _run(self):
+        try:
+            for batch in self._it:
+                while not self._closed.is_set():
+                    try:
+                        self._q.put(batch, timeout=0.2)
+                        break
+                    except self._queue_mod.Full:
+                        continue
+                if self._closed.is_set():
+                    return
+        except BaseException as e:  # re-raised on the consumer side
+            self._err = e
+        finally:
+            # the sentinel MUST land (a lost sentinel deadlocks the
+            # consumer); keep trying unless close() is draining anyway
+            while not self._closed.is_set():
+                try:
+                    self._q.put(self._STOP, timeout=0.2)
+                    break
+                except self._queue_mod.Full:
+                    continue
+
+    def close(self):
+        """Stop the producer and release buffered batches."""
+        self._closed.set()
+        while True:  # drain so a blocked put wakes and sees the flag
+            try:
+                self._q.get_nowait()
+            except self._queue_mod.Empty:
+                break
+        self._thread.join(timeout=2.0)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._STOP:
+            self._q.put(self._STOP)  # re-arm: every later call raises too
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item

@@ -29,6 +29,7 @@ from megatron_tpu.config import MegatronConfig
 # the function attribute — import the symbols directly instead
 from megatron_tpu.training.train_step import (TrainState, init_train_state,
                                               make_train_step)
+from megatron_tpu.data.samplers import PrefetchIterator
 from megatron_tpu.training.microbatches import MicrobatchCalculator
 from megatron_tpu.utils.logging import make_writer, print_rank_0
 from megatron_tpu.utils.timers import Timers
@@ -167,6 +168,15 @@ def train(
         from jax.sharding import NamedSharding, PartitionSpec
         batch_sh = NamedSharding(mesh, PartitionSpec(None, "dp"))
 
+    # host-side batch assembly overlaps device compute (the reference's
+    # DataLoader-worker overlap, ref: data_samplers.py num_workers).
+    # Not under batch-size rampup: prefetched batches would lag the
+    # calculator's phase switch and skew the consumed-samples accounting
+    if (cfg.data.num_workers > 0
+            and cfg.training.rampup_batch_size is None
+            and not isinstance(train_iterator, PrefetchIterator)):
+        train_iterator = PrefetchIterator(train_iterator)
+
     try:
         while iteration < cfg.training.train_iters:
             calc.update(consumed_samples)
@@ -262,6 +272,8 @@ def train(
         # flush an in-flight profiler trace so early exits still produce it
         if trace_active:
             jax.profiler.stop_trace()
+        if isinstance(train_iterator, PrefetchIterator):
+            train_iterator.close()  # stop the producer, free its buffers
         # publish any in-flight async checkpoint even on abnormal
         # exit: the write is durable, only the tracker is pending
         from megatron_tpu.training.checkpointing import \

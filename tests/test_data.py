@@ -299,3 +299,64 @@ class TestDictBatchIteratorResume:
                                dataloader_type="cyclic")
         batch = next(it)  # must not raise AssertionError
         assert batch["x"].shape == (1, 4, 1)
+
+
+class TestPrefetchIterator:
+    def test_order_preserved(self):
+        from megatron_tpu.data.samplers import PrefetchIterator
+        src = iter(range(50))
+        it = PrefetchIterator(src, depth=4)
+        assert list(it) == list(range(50))
+
+    def test_exception_propagates(self):
+        from megatron_tpu.data.samplers import PrefetchIterator
+
+        def gen():
+            yield 1
+            raise RuntimeError("boom")
+
+        it = PrefetchIterator(gen())
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="boom"):
+            next(it)
+
+    def test_num_microbatches_forwarding(self):
+        from megatron_tpu.data.samplers import PrefetchIterator
+
+        class Src:
+            num_microbatches = 2
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                return {"x": np.zeros(1)}
+
+        src = Src()
+        it = PrefetchIterator(src, depth=1)
+        it.num_microbatches = 5
+        assert src.num_microbatches == 5
+        assert "x" in next(it)
+
+    def test_exhaustion_keeps_raising(self):
+        from megatron_tpu.data.samplers import PrefetchIterator
+        it = PrefetchIterator(iter([1]), depth=1)
+        assert next(it) == 1
+        for _ in range(3):  # must re-raise, never deadlock
+            with pytest.raises(StopIteration):
+                next(it)
+
+    def test_close_releases_producer(self):
+        import time
+
+        from megatron_tpu.data.samplers import PrefetchIterator
+
+        def endless():
+            while True:
+                yield {"x": np.zeros(4)}
+
+        it = PrefetchIterator(endless(), depth=2)
+        next(it)
+        it.close()
+        time.sleep(0.1)
+        assert not it._thread.is_alive()
