@@ -1,0 +1,17 @@
+#!/bin/bash
+# ORQA retriever eval on Natural Questions
+# (ref: examples/evaluate_retriever_nq.sh): embed the evidence once, then
+# score top-k retrieval accuracy.
+CKPT=${CKPT:-ckpts/ict}
+EVIDENCE=${EVIDENCE:-psgs_w100.tsv}
+VOCAB=${VOCAB:-vocab.txt}
+
+python tools/create_doc_index.py \
+    --load "$CKPT" --evidence_data_path "$EVIDENCE" \
+    --embedding_path evidence.npz --vocab_file "$VOCAB"
+
+python -m tasks.main --task NQ \
+    --load "$CKPT" --valid_data nq-test.csv \
+    --evidence_data_path "$EVIDENCE" --embedding_path evidence.npz \
+    --tokenizer_type BertWordPieceLowerCase --vocab_file "$VOCAB" \
+    --faiss_topk_retrievals 100

@@ -1,0 +1,13 @@
+#!/bin/bash
+# WikiText-103 PPL + LAMBADA accuracy (ref: examples/evaluate_zeroshot_gpt.sh).
+CKPT=${CKPT:-ckpts/llama2-7b-ft}
+TOK=${TOK:-meta-llama/Llama-2-7b-hf}
+
+python -m tasks.main --task WIKITEXT103 \
+    --valid_data wiki.test.tokens \
+    --load "$CKPT" --tokenizer_type HFTokenizer --tokenizer_model "$TOK" \
+    --overlapping_eval 32
+
+python -m tasks.main --task LAMBADA \
+    --valid_data lambada_test.jsonl --strict_lambada \
+    --load "$CKPT" --tokenizer_type HFTokenizer --tokenizer_model "$TOK"

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Llama-2-7B finetune on a v5e-8 (TP=8 + SP + ZeRO-1) — the TPU-native
+# equivalent of the reference's examples/finetune.sh llama2 recipe.
+# Prereqs: converted weights (tools/convert_hf_checkpoint.py import) and a
+# preprocessed .bin/.idx corpus (tools/preprocess_data.py).
+
+CKPT=${CKPT:-ckpts/llama2-7b}
+DATA=${DATA:-data/corpus}
+SAVE=${SAVE:-ckpts/llama2-7b-ft}
+
+python finetune.py \
+    --model llama2-7b \
+    --load "$CKPT" --finetune \
+    --tensor_model_parallel_size 8 \
+    --sequence_parallel \
+    --use_distributed_optimizer \
+    --bf16 --use_flash_attn --recompute_granularity selective \
+    --data_path "$DATA" --split 989,10,1 \
+    --train_iters 500 --global_batch_size 1000 --micro_batch_size 2 \
+    --lr 1e-5 --lr_decay_style cosine --lr_warmup_iters 50 \
+    --weight_decay 0.1 --clip_grad 1.0 \
+    --log_interval 1 --save_interval 100 --eval_interval 100 \
+    --save "$SAVE" --tensorboard_dir runs/llama2-7b-ft

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Supervised DPR-style retriever finetuning on NQ
+# (ref: examples/finetune_retriever_distributed.sh).
+VOCAB=${VOCAB:-vocab.txt}
+
+python -m tasks.main --task RET-FINETUNE-NQ \
+    --train_data nq-train.json --valid_data nq-dev.json \
+    --pretrained_checkpoint ckpts/ict \
+    --vocab_file "$VOCAB" --retriever_seq_length 256 \
+    --micro_batch_size 8 --epochs 2 --lr 2e-5 \
+    --train_with_neg --train_hard_neg 1 --retriever_score_scaling

@@ -1,0 +1,15 @@
+#!/bin/bash
+# BERT MLM+NSP pretraining (ref: examples/pretrain_bert.sh).
+DATA=${DATA:-data/bert_corpus}
+VOCAB=${VOCAB:-vocab.txt}
+
+python pretrain_bert.py \
+    --num_layers 12 --hidden_size 768 --num_attention_heads 12 \
+    --seq_length 512 --max_position_embeddings 512 \
+    --tokenizer_type BertWordPieceLowerCase --vocab_file "$VOCAB" \
+    --data_path "$DATA" \
+    --train_iters 100000 --global_batch_size 256 --micro_batch_size 8 \
+    --lr 1e-4 --lr_decay_style linear --lr_warmup_fraction 0.01 \
+    --weight_decay 0.01 --clip_grad 1.0 --mask_prob 0.15 \
+    --log_interval 100 --save_interval 2000 \
+    --save ckpts/bert

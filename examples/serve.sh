@@ -1,0 +1,11 @@
+#!/bin/bash
+# Text-generation REST server + CLI client
+# (ref: examples/run_text_generation_server_345M.sh).
+CKPT=${CKPT:-ckpts/llama2-7b-ft}
+TOK=${TOK:-meta-llama/Llama-2-7b-hf}
+
+python tools/run_text_generation_server.py \
+    --load "$CKPT" --tokenizer_type HFTokenizer --tokenizer_model "$TOK" \
+    --port 5000 &
+sleep 30
+python tools/text_generation_cli.py localhost:5000
