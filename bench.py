@@ -24,12 +24,21 @@ import time
 # comes up, pin cpu so a number is still recorded.
 
 
-def _probe_backend(retries: int = 3, sleep_s: float = 15.0) -> str:
-    code = "import jax; print(jax.devices()[0].platform)"
+def _probe_backend(retries: int = 2, sleep_s: float = 15.0) -> str:
+    # a healthy tunnel initializes in ~40 s; a wedged one hangs — keep the
+    # worst-case fallback under ~5 min so the cpu bench still fits in the
+    # driver's window. The probe must honor an inherited JAX_PLATFORMS the
+    # same way the main process will (config-level pin beats the axon
+    # sitecustomize override) or it would probe the wrong platform.
+    code = ("import os, jax\n"
+            "p = os.environ.get('JAX_PLATFORMS')\n"
+            "if p:\n"
+            "    jax.config.update('jax_platforms', p)\n"
+            "print(jax.devices()[0].platform)")
     for attempt in range(retries):
         try:
             r = subprocess.run([sys.executable, "-c", code],
-                               capture_output=True, text=True, timeout=180)
+                               capture_output=True, text=True, timeout=120)
             if r.returncode == 0:
                 return r.stdout.strip().splitlines()[-1]
             print(f"bench: backend probe attempt {attempt + 1} failed:\n"
@@ -43,9 +52,13 @@ def _probe_backend(retries: int = 3, sleep_s: float = 15.0) -> str:
     return "cpu"
 
 
-if "JAX_PLATFORMS" not in os.environ and _probe_backend() == "cpu":
-    os.environ["JAX_PLATFORMS"] = "cpu"  # accelerator unreachable: record a
-    # cpu number rather than rc=1
+_env_platform = os.environ.get("JAX_PLATFORMS", "")
+if _env_platform != "cpu" and _probe_backend() == "cpu":
+    # accelerator unreachable (tunnel wedged/unavailable): pin cpu so a
+    # number is still recorded rather than rc=1 or an unbounded hang —
+    # this overrides even an explicit TPU platform request, because the
+    # probe just demonstrated that platform cannot initialize
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 import jax.numpy as jnp

@@ -2,6 +2,7 @@
 # ORQA retriever eval on Natural Questions
 # (ref: examples/evaluate_retriever_nq.sh): embed the evidence once, then
 # score top-k retrieval accuracy.
+set -e
 CKPT=${CKPT:-ckpts/ict}
 EVIDENCE=${EVIDENCE:-psgs_w100.tsv}
 VOCAB=${VOCAB:-vocab.txt}

@@ -1,5 +1,6 @@
 #!/bin/bash
 # WikiText-103 PPL + LAMBADA accuracy (ref: examples/evaluate_zeroshot_gpt.sh).
+set -e
 CKPT=${CKPT:-ckpts/llama2-7b-ft}
 TOK=${TOK:-meta-llama/Llama-2-7b-hf}
 

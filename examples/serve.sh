@@ -1,11 +1,25 @@
 #!/bin/bash
 # Text-generation REST server + CLI client
 # (ref: examples/run_text_generation_server_345M.sh).
+set -e
 CKPT=${CKPT:-ckpts/llama2-7b-ft}
 TOK=${TOK:-meta-llama/Llama-2-7b-hf}
+PORT=${PORT:-5000}
 
 python tools/run_text_generation_server.py \
     --load "$CKPT" --tokenizer_type HFTokenizer --tokenizer_model "$TOK" \
-    --port 5000 &
-sleep 30
-python tools/text_generation_cli.py localhost:5000
+    --port "$PORT" &
+SERVER_PID=$!
+trap 'kill $SERVER_PID 2>/dev/null' EXIT
+
+# wait for the server (checkpoint load + first compile can take minutes)
+for _ in $(seq 1 120); do
+    if curl -s -o /dev/null "http://localhost:$PORT/api" -X PUT \
+         -H 'Content-Type: application/json' \
+         -d '{"prompts": ["hi"], "tokens_to_generate": 1}'; then
+        break
+    fi
+    sleep 5
+done
+
+python tools/text_generation_cli.py "localhost:$PORT"
