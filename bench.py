@@ -18,46 +18,81 @@ import sys
 import time
 
 # --- robust backend bring-up (round-1 BENCH died with rc=1 on a transient
-# 'axon' tunnel failure at jax.devices(); see VERDICT.md "What's weak" #1).
-# Probe the backend in a SUBPROCESS with retries so a flaky first init can't
-# poison this process's cached jax backend state; if the accelerator never
-# comes up, pin cpu so a number is still recorded.
+# 'axon' tunnel failure at jax.devices(); round-2 fell back to CPU after two
+# 2-minute probes while the tunnel wedge lasted hours — see VERDICT.md r2
+# "What's weak" #4). Probe the backend in a SUBPROCESS with
+# exponential-backoff retries across a LONG budget so a multi-hour-wedge
+# tunnel still gets every reasonable chance; if the accelerator never comes
+# up, fall back to cpu but emit an HONEST record (cpu_fallback: true,
+# vs_baseline: null, no MFU) that cannot be mistaken for a chip number.
+
+_PROBE_LOG: list = []  # (attempt, elapsed_s, cause) for the emitted record
 
 
-def _probe_backend(retries: int = 2, sleep_s: float = 15.0) -> str:
-    # a healthy tunnel initializes in ~40 s; a wedged one hangs — keep the
-    # worst-case fallback under ~5 min so the cpu bench still fits in the
-    # driver's window. The probe must honor an inherited JAX_PLATFORMS the
-    # same way the main process will (config-level pin beats the axon
-    # sitecustomize override) or it would probe the wrong platform.
+def _probe_backend(budget_s: float = None) -> str:
+    """Return the first platform that initializes, probing in a throwaway
+    subprocess (a wedged tunnel can hang jax.devices() forever and poison
+    this process's backend cache). Retries with exponential backoff until
+    `budget_s` (env BENCH_PROBE_BUDGET_S, default 1800 s) is exhausted."""
+    if budget_s is None:
+        budget_s = float(os.environ.get("BENCH_PROBE_BUDGET_S", "1800"))
+    # the probe must honor an inherited JAX_PLATFORMS the same way the main
+    # process will (config-level pin beats the axon sitecustomize override)
+    # or it would probe the wrong platform
     code = ("import os, jax\n"
             "p = os.environ.get('JAX_PLATFORMS')\n"
             "if p:\n"
             "    jax.config.update('jax_platforms', p)\n"
             "print(jax.devices()[0].platform)")
-    for attempt in range(retries):
+    t0 = time.monotonic()
+    attempt = 0
+    sleep_s = 30.0
+    while True:
+        attempt += 1
+        elapsed = time.monotonic() - t0
         try:
+            attempt_timeout = max(min(150.0, budget_s - elapsed), 10.0)
             r = subprocess.run([sys.executable, "-c", code],
-                               capture_output=True, text=True, timeout=120)
+                               capture_output=True, text=True,
+                               timeout=attempt_timeout)
             if r.returncode == 0:
-                return r.stdout.strip().splitlines()[-1]
-            print(f"bench: backend probe attempt {attempt + 1} failed:\n"
-                  f"{r.stderr.strip().splitlines()[-1] if r.stderr else '?'}",
-                  file=sys.stderr)
+                plat = r.stdout.strip().splitlines()[-1]
+                _PROBE_LOG.append((attempt, round(elapsed, 1), f"ok:{plat}"))
+                return plat
+            err_lines = r.stderr.strip().splitlines() if r.stderr else []
+            cause = (err_lines[-1][:200] if err_lines
+                     else f"rc={r.returncode}")
         except subprocess.TimeoutExpired:
-            print(f"bench: backend probe attempt {attempt + 1} timed out",
-                  file=sys.stderr)
-        if attempt < retries - 1:
-            time.sleep(sleep_s)
-    return "cpu"
+            cause = f"timeout({attempt_timeout:.0f}s)"
+        _PROBE_LOG.append((attempt, round(elapsed, 1), cause))
+        print(f"bench: probe attempt {attempt} at t+{elapsed:.0f}s failed: "
+              f"{cause}", file=sys.stderr)
+        remaining = budget_s - (time.monotonic() - t0)
+        if remaining <= 10.0:  # not enough left for a meaningful attempt
+            return "cpu"
+        # clamp the final sleep so the whole budget gets spent probing
+        time.sleep(min(sleep_s, max(remaining - 10.0, 0.0)))
+        sleep_s = min(sleep_s * 2, 600.0)
 
 
 _env_platform = os.environ.get("JAX_PLATFORMS", "")
+_REQUESTED_PLATFORM = _env_platform or "auto"
+_CPU_FALLBACK = False
 if _env_platform != "cpu" and _probe_backend() == "cpu":
-    # accelerator unreachable (tunnel wedged/unavailable): pin cpu so a
-    # number is still recorded rather than rc=1 or an unbounded hang —
-    # this overrides even an explicit TPU platform request, because the
-    # probe just demonstrated that platform cannot initialize
+    # cpu_fallback means "accelerator unreachable after the full backoff
+    # budget" — a probe that SUCCEEDED at cpu (no accelerator present, e.g.
+    # a dev laptop) is an ordinary cpu run, not a tunnel wedge.
+    probe_gave_up = not (_PROBE_LOG and _PROBE_LOG[-1][2] == "ok:cpu")
+    if probe_gave_up:
+        # Pin cpu so a number is still recorded rather than rc=1 or an
+        # unbounded hang — but NEVER silently: the emitted record carries
+        # cpu_fallback/requested_platform/probe_attempts, vs_baseline is
+        # null, and no MFU is printed.
+        _CPU_FALLBACK = True
+        print(f"bench: FALLING BACK TO CPU after {len(_PROBE_LOG)} probe "
+              f"attempts; requested platform was {_REQUESTED_PLATFORM!r}. "
+              "The emitted record is NOT an accelerator number.",
+              file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
@@ -78,7 +113,7 @@ PEAK_FLOPS = {
     "TPU v6e": 918e12,
     "TPU v6 lite": 918e12,
     "TPU7x": 2307e12,
-    "cpu": 1e11,
+    # no "cpu" entry on purpose: a CPU run emits no MFU at all
 }
 
 A100_BASELINE_MFU = 6 * 7.0e9 * 890 / 312e12  # = 0.1198
@@ -130,14 +165,35 @@ def run_config(dev, model, micro_bs, n_micro, iters, warmup):
 
     tokens_per_iter = n_micro * micro_bs * seq
     tok_s = tokens_per_iter * iters / dt
+    kind = getattr(dev, "device_kind", dev.platform)
+    if dev.platform != "tpu" or _CPU_FALLBACK:
+        # CPU (or any non-TPU) run: there is no meaningful peak to compute
+        # an MFU against and no hardware-normalized baseline ratio — a
+        # fallback record must be impossible to mistake for a chip result
+        # (VERDICT r2 "What's weak" #1).
+        note = ("CPU FALLBACK" if _CPU_FALLBACK else f"{dev.platform} run")
+        return {
+            "metric": "train_tokens_per_sec_per_chip",
+            "value": round(tok_s, 1),
+            "unit": f"tok/s ({n_params/1e9:.2f}B params, {kind}, "
+                    f"{note} — not an accelerator number)",
+            "vs_baseline": None,
+            "cpu_fallback": _CPU_FALLBACK,
+            "device_kind": kind,
+            "requested_platform": _REQUESTED_PLATFORM,
+            "probe_attempts": [
+                {"attempt": a, "t_s": t, "cause": c} for a, t, c in _PROBE_LOG
+            ],
+        }
     flops_per_token = 6 * n_params  # fwd+bwd dense FLOPs, attention excluded
     mfu = tok_s * flops_per_token / detect_peak(dev)
     return {
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(tok_s, 1),
-        "unit": f"tok/s ({n_params/1e9:.2f}B params, {dev.device_kind}, "
+        "unit": f"tok/s ({n_params/1e9:.2f}B params, {kind}, "
                 f"MFU={mfu:.3f})",
         "vs_baseline": round(mfu / A100_BASELINE_MFU, 3),
+        "device_kind": kind,
     }
 
 

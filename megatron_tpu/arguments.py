@@ -25,7 +25,10 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                                 allow_abbrev=False)
 
     g = p.add_argument_group("model")
-    g.add_argument("--num_layers", type=int, default=2)
+    # default=None so an EXPLICIT "--num_layers 2" is distinguishable from
+    # a defaulted one (resolved to 2 in _apply_compat after the
+    # --encoder_num_layers alias is considered)
+    g.add_argument("--num_layers", type=int, default=None)
     g.add_argument("--hidden_size", type=int, default=128)
     g.add_argument("--ffn_hidden_size", type=int, default=None)
     g.add_argument("--num_attention_heads", type=int, default=4)
@@ -289,10 +292,18 @@ def _apply_compat(args: argparse.Namespace) -> None:
     """Resolve reference-compat aliases into the native arg surface and
     warn for accepted-but-inert CUDA-mechanics flags."""
     # aliases (mutating the namespace keeps _pick/_preset logic unchanged);
-    # an explicit --num_layers (non-default) beats --encoder_num_layers
-    if getattr(args, "encoder_num_layers", None) is not None and \
-            args.num_layers == 2:
-        args.num_layers = args.encoder_num_layers
+    # an explicit --num_layers (even "--num_layers 2") beats
+    # --encoder_num_layers; unset resolves to the alias, then to 2. The
+    # sentinel tells the preset-override loop a resolved 2 was NOT explicit
+    # (a preset's layer count must not be clobbered by the fallback default).
+    # hasattr-guarded so re-running compat on the same namespace (e.g.
+    # config_from_args called twice) stays idempotent.
+    if not hasattr(args, "_num_layers_defaulted"):
+        args._num_layers_defaulted = False
+        if args.num_layers is None:
+            enc = getattr(args, "encoder_num_layers", None)
+            args.num_layers = enc if enc is not None else 2
+            args._num_layers_defaulted = enc is None
     if getattr(args, "encoder_seq_length", None) and not args.seq_length:
         args.seq_length = args.encoder_seq_length
     if getattr(args, "recompute_activations", False) and \
@@ -358,6 +369,8 @@ def config_from_args(args: argparse.Namespace,
             for f in dataclasses.fields(type(model)):
                 if f.name in handled or f.name not in defaults:
                     continue
+                if f.name == "num_layers" and args._num_layers_defaulted:
+                    continue  # resolved fallback, not a user choice
                 v = getattr(args, f.name, None)
                 if v != defaults[f.name]:
                     overrides[f.name] = v

@@ -168,13 +168,25 @@ class BatchIterator:
         for _ in range(self.num_microbatches):
             idxs = self._next_indices()
             rows = self.host_rows
-            if rows is not None and len(idxs) != full_rows:
-                # partial tail batch (drop_last=False): the dp sharding of
-                # the SMALLER array maps hosts to different rows than the
-                # precomputed range — materialize everything rather than
-                # risk feeding zero rows to a device
-                rows = None
-                all_full = False
+            if len(idxs) != full_rows:
+                # partial tail batch (drop_last=False). The tail must still
+                # divide dp or make_global_batch's P(None,'dp') lift fails
+                # downstream with an inscrutable sharding error; fail here
+                # with an actionable message instead (single- AND multi-host).
+                dp = self._sampler_args[1]
+                if len(idxs) % dp != 0:
+                    raise ValueError(
+                        f"drop_last=False tail batch of {len(idxs)} rows is "
+                        f"not divisible by dp={dp}; either use drop_last="
+                        "True or pad the dataset to a multiple of "
+                        "micro_batch_size*dp")
+                if rows is not None:
+                    # multi-host: the dp sharding of the SMALLER array maps
+                    # hosts to different rows than the precomputed range —
+                    # materialize everything rather than risk feeding zero
+                    # rows to a device
+                    rows = None
+                    all_full = False
             if rows is not None:
                 lo, hi = rows
                 if self._zero_row is None:

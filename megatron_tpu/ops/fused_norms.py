@@ -30,14 +30,23 @@ from jax.experimental import pallas as pl
 
 
 def _pick_rows(rows: int, h: int, vmem_budget: int = 1 << 21) -> int:
-    """Largest row block that tiles `rows`, is a multiple of 8 (TPU sublane)
-    when possible, and keeps the fp32 tile under ~2 MB of VMEM."""
+    """Row block: a multiple of 8 (TPU sublane) whose fp32 tile stays under
+    ~2 MB of VMEM. Divisibility of `rows` is NOT required — callers zero-pad
+    the row dim up to a block multiple (padded rows contribute nothing to
+    the weight-grad partials since dy is zero there), so a prime row count
+    no longer collapses to a 1-row grid."""
     cap = max(vmem_budget // (4 * h), 1)
-    best = 1
-    for b in range(1, min(rows, cap) + 1):
-        if rows % b == 0 and (b % 8 == 0 or b < 8):
-            best = max(best, b)
-    return best
+    if cap < 8:
+        return cap
+    return min(cap // 8 * 8, max(-(-rows // 8) * 8, 8))
+
+
+def _pad_rows(xr, br: int):
+    """Zero-pad [rows, h] up to a multiple of the row block."""
+    pad = (-xr.shape[0]) % br
+    if pad:
+        xr = jnp.pad(xr, ((0, pad), (0, 0)))
+    return xr
 
 
 # ---------------------------------------------------------------------------
@@ -75,17 +84,19 @@ def _rms_fwd(x, scale, eps, interpret):
     xr = x.reshape(-1, h)
     rows = xr.shape[0]
     br = _pick_rows(rows, h)
+    xr = _pad_rows(xr, br)
+    rows_p = xr.shape[0]
     s2 = scale.reshape(1, h)
     out = pl.pallas_call(
         functools.partial(_rms_fwd_kernel, eps=eps),
-        grid=(rows // br,),
+        grid=(rows_p // br,),
         in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
                   pl.BlockSpec((1, h), lambda i: (0, 0))],
         out_specs=pl.BlockSpec((br, h), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((rows, h), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((rows_p, h), x.dtype),
         interpret=interpret,
     )(xr, s2)
-    return out.reshape(orig_shape), (x, scale)
+    return out[:rows].reshape(orig_shape), (x, scale)
 
 
 def _rms_bwd(eps, interpret, res, dy):
@@ -96,7 +107,10 @@ def _rms_bwd(eps, interpret, res, dy):
     dyr = dy.reshape(-1, h)
     rows = xr.shape[0]
     br = _pick_rows(rows, h)
-    grid = rows // br
+    xr = _pad_rows(xr, br)
+    dyr = _pad_rows(dyr, br)
+    rows_p = xr.shape[0]
+    grid = rows_p // br
     dx, ds_part = pl.pallas_call(
         functools.partial(_rms_bwd_kernel, eps=eps),
         grid=(grid,),
@@ -105,12 +119,12 @@ def _rms_bwd(eps, interpret, res, dy):
                   pl.BlockSpec((br, h), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
                    pl.BlockSpec((1, h), lambda i: (i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((rows, h), x.dtype),
+        out_shape=[jax.ShapeDtypeStruct((rows_p, h), x.dtype),
                    jax.ShapeDtypeStruct((grid, h), jnp.float32)],
         interpret=interpret,
     )(xr, scale.reshape(1, h), dyr)
     ds = jnp.sum(ds_part, axis=0).astype(scale.dtype)
-    return dx.reshape(orig_shape), ds
+    return dx[:rows].reshape(orig_shape), ds
 
 
 pallas_rmsnorm.defvjp(_rms_fwd, _rms_bwd)
@@ -160,17 +174,19 @@ def _ln_fwd(x, scale, bias, eps, interpret):
     xr = x.reshape(-1, h)
     rows = xr.shape[0]
     br = _pick_rows(rows, h)
+    xr = _pad_rows(xr, br)
+    rows_p = xr.shape[0]
     out = pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=eps),
-        grid=(rows // br,),
+        grid=(rows_p // br,),
         in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
                   pl.BlockSpec((1, h), lambda i: (0, 0)),
                   pl.BlockSpec((1, h), lambda i: (0, 0))],
         out_specs=pl.BlockSpec((br, h), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((rows, h), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((rows_p, h), x.dtype),
         interpret=interpret,
     )(xr, scale.reshape(1, h), bias.reshape(1, h))
-    return out.reshape(orig_shape), (x, scale)
+    return out[:rows].reshape(orig_shape), (x, scale)
 
 
 def _ln_bwd(eps, interpret, res, dy):
@@ -181,7 +197,10 @@ def _ln_bwd(eps, interpret, res, dy):
     dyr = dy.reshape(-1, h)
     rows = xr.shape[0]
     br = _pick_rows(rows, h)
-    grid = rows // br
+    xr = _pad_rows(xr, br)
+    dyr = _pad_rows(dyr, br)
+    rows_p = xr.shape[0]
+    grid = rows_p // br
     dx, ds_part, db_part = pl.pallas_call(
         functools.partial(_ln_bwd_kernel, eps=eps),
         grid=(grid,),
@@ -191,14 +210,14 @@ def _ln_bwd(eps, interpret, res, dy):
         out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
                    pl.BlockSpec((1, h), lambda i: (i, 0)),
                    pl.BlockSpec((1, h), lambda i: (i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((rows, h), x.dtype),
+        out_shape=[jax.ShapeDtypeStruct((rows_p, h), x.dtype),
                    jax.ShapeDtypeStruct((grid, h), jnp.float32),
                    jax.ShapeDtypeStruct((grid, h), jnp.float32)],
         interpret=interpret,
     )(xr, scale.reshape(1, h), dyr)
     ds = jnp.sum(ds_part, axis=0).astype(scale.dtype)
     db = jnp.sum(db_part, axis=0).astype(scale.dtype)
-    return dx.reshape(orig_shape), ds, db
+    return dx[:rows].reshape(orig_shape), ds, db
 
 
 pallas_layernorm.defvjp(_ln_fwd, _ln_bwd)

@@ -97,8 +97,10 @@ def process_batch_rows(mesh, global_rows: int) -> tuple:
     assert global_rows % dp == 0
     per = global_rows // dp
     # dp coordinate range covered by this process's addressable devices
-    # (mesh.devices axis 0 is 'dp')
-    coords = sorted({int(np.argwhere(mesh.devices == d)[0][0])
+    # (dp axis located by NAME so a mesh-axis reorder can't silently map
+    # hosts to wrong row ranges)
+    dp_dim = mesh.axis_names.index("dp")
+    coords = sorted({int(np.argwhere(mesh.devices == d)[0][dp_dim])
                      for d in mesh.devices.ravel()
                      if d.process_index == jax.process_index()})
     lo, hi = coords[0], coords[-1]

@@ -52,6 +52,19 @@ def test_encoder_aliases():
     assert cfg.model.seq_length == 32
 
 
+def test_explicit_num_layers_beats_encoder_alias():
+    """ADVICE r2 (low): an EXPLICIT --num_layers 2 must not be overridden
+    by --encoder_num_layers, and a preset's layer count must not be
+    clobbered by the resolved fallback default."""
+    cfg, _ = parse(["--num_layers", "2", "--encoder_num_layers", "6",
+                    "--hidden_size", "64", "--num_attention_heads", "4"])
+    assert cfg.model.num_layers == 2
+    cfg, _ = parse(["--model", "llama2-7b"])
+    assert cfg.model.num_layers == 32  # preset survives defaulted fallback
+    cfg, _ = parse(["--model", "llama2-7b", "--num_layers", "2"])
+    assert cfg.model.num_layers == 2  # explicit 2 overrides the preset
+
+
 def test_recompute_activations_alias():
     cfg, _ = parse(BASE + ["--recompute_activations",
                            "--recompute_method", "uniform",

@@ -104,8 +104,63 @@ class TestLayerNorm:
 
 def test_pick_rows_tiles_and_bounds():
     assert _pick_rows(1024, 4096) % 8 == 0
-    assert 1024 % _pick_rows(1024, 4096) == 0
-    # odd row count still tiles
-    assert 6 % _pick_rows(6, 256) == 0
+    # rows need NOT divide the block any more (callers zero-pad): a prime
+    # row count must still get a real multi-row block, not a 1-row grid
+    assert _pick_rows(1021, 4096) % 8 == 0 and _pick_rows(1021, 4096) >= 8
     # huge h: block shrinks to fit VMEM budget
     assert _pick_rows(4096, 16384) * 16384 * 4 <= (1 << 21)
+
+
+def test_prime_row_count_pads_and_matches():
+    """ADVICE r2 (low): prime b*s must not collapse to a 1-row grid; the
+    zero-pad path must stay numerically exact, including weight grads."""
+    from megatron_tpu.ops.fused_norms import pallas_rmsnorm
+    rng = jax.random.PRNGKey(7)
+    x = jax.random.normal(rng, (13, 128), jnp.float32)  # 13 rows: prime
+    scale = jax.random.normal(jax.random.fold_in(rng, 1), (128,))
+    dy = jax.random.normal(jax.random.fold_in(rng, 2), (13, 128))
+
+    def ref(x, s):
+        r = jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-5)
+        return x * r * s
+
+    got = pallas_rmsnorm(x, scale, 1e-5, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref(x, scale)),
+                               rtol=1e-5, atol=1e-5)
+    g_r = jax.grad(lambda x, s: jnp.sum(ref(x, s) * dy),
+                   argnums=(0, 1))(x, scale)
+    g_p = jax.grad(lambda x, s: jnp.sum(
+        pallas_rmsnorm(x, s, 1e-5, True) * dy), argnums=(0, 1))(x, scale)
+    for a, b in zip(g_p, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_prime_row_count_layernorm_pads_and_matches():
+    """Same pad-path exactness for LayerNorm — covers the db bias-grad
+    partial, which has no RMSNorm analogue."""
+    from megatron_tpu.ops.fused_norms import pallas_layernorm
+    rng = jax.random.PRNGKey(11)
+    x = jax.random.normal(rng, (13, 128), jnp.float32)
+    scale = jax.random.normal(jax.random.fold_in(rng, 1), (128,))
+    bias = jax.random.normal(jax.random.fold_in(rng, 2), (128,))
+    dy = jax.random.normal(jax.random.fold_in(rng, 3), (13, 128))
+
+    def ref(x, s, b):
+        mu = jnp.mean(x, -1, keepdims=True)
+        xc = x - mu
+        r = jax.lax.rsqrt(jnp.mean(xc * xc, -1, keepdims=True) + 1e-5)
+        return xc * r * s + b
+
+    got = pallas_layernorm(x, scale, bias, 1e-5, True)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(ref(x, scale, bias)),
+                               rtol=1e-5, atol=1e-5)
+    g_r = jax.grad(lambda x, s, b: jnp.sum(ref(x, s, b) * dy),
+                   argnums=(0, 1, 2))(x, scale, bias)
+    g_p = jax.grad(lambda x, s, b: jnp.sum(
+        pallas_layernorm(x, s, b, 1e-5, True) * dy),
+        argnums=(0, 1, 2))(x, scale, bias)
+    for a, b in zip(g_p, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
