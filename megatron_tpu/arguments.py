@@ -80,6 +80,11 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                    dest="context_parallel")
     g.add_argument("--num_layers_per_virtual_pipeline_stage", type=int,
                    default=None)
+    g.add_argument("--pipeline_schedule", type=str, default="1f1b",
+                   choices=["1f1b", "gpipe"],
+                   help="pp execution schedule: 1f1b bounds per-stage "
+                        "memory by pp; gpipe is the lockstep fallback "
+                        "(required for vpp>1 interleaving)")
     g.add_argument("--sequence_parallel", action="store_true")
     g.add_argument("--use_distributed_optimizer", action="store_true")
     g.add_argument("--context_parallel_algo", type=str, default="ring",
@@ -417,6 +422,7 @@ def config_from_args(args: argparse.Namespace,
             context_parallel=args.context_parallel,
             sequence_parallel=args.sequence_parallel,
             virtual_pipeline_chunks=vpp,
+            pipeline_schedule=args.pipeline_schedule,
             use_distributed_optimizer=args.use_distributed_optimizer,
         ),
         optimizer=OptimizerConfig(**_pick(args, OptimizerConfig)),

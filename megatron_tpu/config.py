@@ -170,6 +170,11 @@ class ParallelConfig:
     sequence_parallel: bool = False
     # virtual pipeline (interleaved 1F1B) chunks per stage (ref: arguments.py:117-128)
     virtual_pipeline_chunks: int = 1
+    # pp execution schedule: "1f1b" = hand-scheduled one-forward-one-backward
+    # with per-stage memory flat in n_micro (ref: schedules.py:606-722);
+    # "gpipe" = lockstep fill-drain with autodiff-derived backward (memory
+    # grows with n_micro; required for vpp>1 interleaving)
+    pipeline_schedule: str = "1f1b"
     # ZeRO-1-style optimizer state sharding over dp (ref: optimizer/distrib_optimizer.py)
     use_distributed_optimizer: bool = False
 
@@ -333,6 +338,20 @@ class MegatronConfig:
         if par.virtual_pipeline_chunks > 1:
             per_stage = model.num_layers // par.pipeline_parallel
             assert per_stage % par.virtual_pipeline_chunks == 0
+        assert par.pipeline_schedule in ("1f1b", "gpipe"), (
+            f"unknown pipeline_schedule {par.pipeline_schedule!r}")
+        if par.virtual_pipeline_chunks > 1 and \
+                par.pipeline_schedule == "1f1b":
+            # vpp interleaving only exists in the lockstep formulation;
+            # resolve LOUDLY rather than silently losing the 1F1B memory
+            # bound the user may be counting on
+            from megatron_tpu.utils.logging import print_rank_0
+            print_rank_0(
+                "warning: pipeline_schedule='1f1b' does not support "
+                f"virtual_pipeline_chunks={par.virtual_pipeline_chunks}; "
+                "using the lockstep 'gpipe' schedule (per-stage activation "
+                "memory grows with num_microbatches)")
+            par = dataclasses.replace(par, pipeline_schedule="gpipe")
         gbs = tr.global_batch_size
         if gbs is None:
             dp = par.data_parallel or (par.derive_dp(n_devices) if n_devices else 1)

@@ -118,9 +118,7 @@ def bert_encode(params, tokens, cfg: ModelConfig, *, tokentype_ids=None,
                    cfg.norm_epsilon)
     seg = None
     if padding_mask is not None:
-        # real tokens segment 0; each pad position its own segment id
-        seg = jnp.where(padding_mask > 0, 0,
-                        2 + jnp.arange(s)[None, :]).astype(jnp.int32)
+        seg = bert_pad_segments(padding_mask)
     x, _ = tfm.stack_apply(params["transformer"], x, cfg, causal=False,
                            segment_ids=seg, rng=rng,
                            deterministic=deterministic)
@@ -152,6 +150,80 @@ def bert_forward(params, tokens, cfg: ModelConfig, *, tokentype_ids=None,
     lm_logits = (y @ w_out).astype(jnp.float32) + \
         lh["bias"].astype(jnp.float32)
     return lm_logits, nsp_logits.astype(jnp.float32)
+
+
+def bert_pad_segments(padding_mask):
+    """padding_mask [.., s] 1=real -> segment ids isolating each pad
+    position (real tokens segment 0)."""
+    s = padding_mask.shape[-1]
+    return jnp.where(padding_mask > 0, 0,
+                     2 + jnp.arange(s)).astype(jnp.int32)
+
+
+def bert_1f1b_fns(cfg: ModelConfig, deterministic: bool = True):
+    """(intake_fn, chunk_fn, head_loss_fn) pipelining BERT over 'pp' via
+    parallel/pipeline.py's generic 1F1B core — the custom-loss pipelining
+    the reference reaches through its forward_step_func plug into the 1F1B
+    schedule (ref: megatron/schedules.py:606-722 + pretrain_bert.py
+    forward_step). Streams come from bert_1f1b_streams."""
+    from megatron_tpu.config import as_dtype
+    from megatron_tpu.ops.dropout import dropout as _drop
+    compute_dtype = as_dtype(cfg.compute_dtype)
+
+    def intake(shared_p, sl, rng_mb):
+        emb = shared_p["embedding"]
+        tok = sl["tokens"]
+        s = tok.shape[-1]
+        x = emb["word_embeddings"][tok]
+        x = x + emb["position_embeddings"][jnp.arange(s)][None]
+        if "tokentype_ids" in sl:
+            x = x + emb["tokentype_embeddings"][sl["tokentype_ids"]]
+        x = x.astype(compute_dtype)
+        x = apply_norm(cfg.norm_type, shared_p["embedding_norm"], x,
+                       cfg.norm_epsilon)
+        if rng_mb is not None and not deterministic and \
+                cfg.hidden_dropout > 0.0:
+            x = _drop(jax.random.fold_in(rng_mb, 0), x, cfg.hidden_dropout)
+        return x
+
+    def chunk(cp, h, sl, offset, rng_mb):
+        layer_rng = (jax.random.fold_in(rng_mb, 1)
+                     if rng_mb is not None and not deterministic else None)
+        seg = bert_pad_segments(sl["padding_mask"]) \
+            if "padding_mask" in sl else None
+        return tfm.stack_apply(cp, h, cfg, causal=False, segment_ids=seg,
+                               rng=layer_rng, deterministic=deterministic,
+                               layer_offset=offset)[0]
+
+    def head_loss(shared_p, h, sl, rng_mb):
+        # pooler + NSP + MLM transform + tied decode + masked-mean losses:
+        # the per-microbatch tail of bert_forward/bert_loss
+        pooled = jnp.tanh(
+            h[:, 0] @ shared_p["pooler"]["w"].astype(compute_dtype)
+            + shared_p["pooler"]["b"].astype(compute_dtype))
+        lh = shared_p["lm_head"]
+        y = h @ lh["dense"]["w"].astype(compute_dtype) + \
+            lh["dense"]["b"].astype(compute_dtype)
+        y = jax.nn.gelu(y, approximate=False)
+        y = apply_norm(cfg.norm_type, lh["norm"], y, cfg.norm_epsilon)
+        w_out = shared_p["embedding"]["word_embeddings"].T.astype(
+            compute_dtype)
+        lm_logits = (y @ w_out).astype(jnp.float32) + \
+            lh["bias"].astype(jnp.float32)
+        losses = cross_entropy_loss(lm_logits, sl["labels"],
+                                    vocab_size=cfg.vocab_size)
+        mask = sl["loss_mask"].astype(jnp.float32)
+        total = jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        if "is_random" in sl:
+            nsp_logits = (
+                pooled @ shared_p["binary_head"]["w"].astype(compute_dtype)
+                + shared_p["binary_head"]["b"].astype(compute_dtype)
+            ).astype(jnp.float32)
+            total = total + jnp.mean(
+                cross_entropy_loss(nsp_logits, sl["is_random"]))
+        return total
+
+    return intake, chunk, head_loss
 
 
 def bert_loss(params, batch, cfg: ModelConfig, *, rng=None,

@@ -88,9 +88,8 @@ def t5_forward(params, enc_tokens, dec_tokens, cfg: ModelConfig, *,
     x = _embed(params, enc_tokens, cfg, compute_dtype)
     seg = None
     if enc_padding_mask is not None:
-        s = enc_tokens.shape[1]
-        seg = jnp.where(enc_padding_mask > 0, 0,
-                        2 + jnp.arange(s)[None, :]).astype(jnp.int32)
+        from megatron_tpu.models.bert import bert_pad_segments
+        seg = bert_pad_segments(enc_padding_mask)
     enc, _ = tfm.stack_apply(params["encoder"], x, cfg, causal=False,
                              segment_ids=seg, rng=rng,
                              deterministic=deterministic)
@@ -121,3 +120,92 @@ def t5_loss(params, batch, cfg: ModelConfig, *, rng=None,
                                 vocab_size=cfg.vocab_size)
     mask = batch["loss_mask"].astype(jnp.float32)
     return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def t5_pipeline_loss_fn(params, batch, cfg: ModelConfig, mesh, *,
+                        vpp: int = 1, rng=None, deterministic: bool = True):
+    """T5 loss with BOTH stacks pipelined over 'pp'.
+
+    The reference pipelines encoder-decoder models by assigning encoder
+    ranks and decoder ranks around a split point and forwarding the encoder
+    output alongside decoder activations
+    (ref: megatron/schedules.py:505-535 + core/parallel_state.py
+    split_rank). Here the same capability is two `pipeline_apply` passes
+    over the SAME 'pp' axis — every stage holds an encoder chunk AND a
+    decoder chunk (layers/(2*pp) each side), the encoder's normed output
+    re-enters the second pass as a per-microbatch stream feeding every
+    decoder chunk's cross-attention, and the backward through both passes
+    is derived by jax.grad. batch leaves are [n_micro, b, ...].
+    """
+    from megatron_tpu.config import as_dtype
+    from megatron_tpu.parallel.pipeline import pipeline_apply
+    from megatron_tpu.parallel.sharding import constrain
+    compute_dtype = as_dtype(cfg.compute_dtype)
+
+    enc_tokens = batch["text_enc"]   # [n_micro, b, s_enc]
+    dec_tokens = batch["text_dec"]   # [n_micro, b, s_dec]
+    n_micro, n_b, s_enc = enc_tokens.shape
+    s_dec = dec_tokens.shape[-1]
+
+    def embed_intake(shared_p, sl, rng_mb):
+        return _embed({"embedding": shared_p}, sl["tokens"], cfg,
+                      compute_dtype)
+
+    def enc_chunk(cp, h, sl, offset, rng_mb):
+        layer_rng = (jax.random.fold_in(rng_mb, 1)
+                     if rng_mb is not None and not deterministic else None)
+        return tfm.stack_apply(cp, h, cfg, causal=False,
+                               segment_ids=sl.get("seg"), rng=layer_rng,
+                               deterministic=deterministic,
+                               layer_offset=offset)[0]
+
+    enc_streams = {"tokens": enc_tokens}
+    if batch.get("enc_mask") is not None:
+        from megatron_tpu.models.bert import bert_pad_segments
+        enc_streams["seg"] = bert_pad_segments(batch["enc_mask"])
+
+    enc = pipeline_apply(
+        params["encoder"], params["embedding"], enc_streams, cfg, mesh,
+        intake_fn=embed_intake, chunk_fn=enc_chunk,
+        batch_shape=(n_b, s_enc), vpp=vpp, rng=rng)
+
+    # encoder-final norm with the microbatch dim spread over the pipeline
+    # stages (they are idle between the two passes)
+    enc = constrain(enc, ("microbatch", "batch", "seq", "act_embed"))
+    enc = apply_norm(cfg.norm_type, params["encoder_norm"], enc,
+                     cfg.norm_epsilon)
+
+    def dec_chunk(cp, h, sl, offset, rng_mb):
+        layer_rng = (jax.random.fold_in(rng_mb, 2)
+                     if rng_mb is not None and not deterministic else None)
+        return tfm.stack_apply(cp, h, cfg, causal=True,
+                               encoder_output=sl["enc"].astype(h.dtype),
+                               rng=layer_rng,
+                               deterministic=deterministic,
+                               layer_offset=offset)[0]
+
+    # the enc stream crosses the shard_map boundary replicated over 'pp';
+    # its derived cotangent is psum'd there — same CPU-partitioner bf16
+    # constraint as pipeline_apply's ring boundary, same f32 workaround
+    boundary_dtype = (jnp.float32 if jax.default_backend() == "cpu"
+                      else enc.dtype)
+    dec_streams = {"tokens": dec_tokens, "enc": enc.astype(boundary_dtype)}
+    dec = pipeline_apply(
+        params["decoder"], params["embedding"], dec_streams, cfg, mesh,
+        intake_fn=embed_intake, chunk_fn=dec_chunk,
+        batch_shape=(n_b, s_dec), vpp=vpp, rng=rng)
+
+    dec = constrain(dec, ("microbatch", "batch", "seq", "act_embed"))
+    dec = apply_norm(cfg.norm_type, params["decoder_norm"], dec,
+                     cfg.norm_epsilon)
+    w_out = params["embedding"]["word_embeddings"].T.astype(compute_dtype)
+    logits = (dec @ w_out).astype(jnp.float32) + \
+        params["lm_head_bias"].astype(jnp.float32)
+    logits = constrain(logits, ("microbatch", "batch", "seq", "vocab"))
+    losses = cross_entropy_loss(logits, batch["labels"],
+                                vocab_size=cfg.vocab_size)
+    mask = batch["loss_mask"].astype(losses.dtype)
+    # per-microbatch masked mean, then mean over microbatches (== train_step)
+    per_mb = (jnp.sum(losses * mask, axis=(1, 2))
+              / jnp.maximum(jnp.sum(mask, axis=(1, 2)), 1.0))
+    return jnp.mean(per_mb)

@@ -35,8 +35,16 @@ def cross_entropy_loss(
     m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
     shifted = logits - m
     lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
-    label_logit = jnp.take_along_axis(
-        shifted, labels[..., None], axis=-1).squeeze(-1)
+    # predicted-logit extraction as a masked REDUCTION, not a gather: under
+    # a 'vocab'-sharded logits layout this lowers to the reference's
+    # predicted-logit all-reduce (ref: cross_entropy.py:54-63) — and the
+    # XLA SPMD partitioner handles sharded reductions everywhere, incl.
+    # inside partial-manual (shard_map) regions where sharded gathers
+    # CHECK-fail on the CPU backend. XLA fuses the select+sum, so the
+    # one-hot is never materialized.
+    iota_v = jnp.arange(padded_vocab)
+    label_logit = jnp.sum(
+        jnp.where(iota_v == labels[..., None], shifted, 0.0), axis=-1)
     loss = lse - label_logit
     if label_smoothing > 0.0:
         # smoothed loss mixes in mean log-prob over the (true) vocab

@@ -108,9 +108,23 @@ def with_sharding(x, mesh: Mesh, logical_axes: tuple, rules):
     """Constrain an intermediate activation's sharding (GSPMD hint).
 
     This is the declarative analogue of the reference's explicit
-    scatter/gather mapping functions (ref: mappings.py:253-278)."""
-    return jax.lax.with_sharding_constraint(
-        x, logical_sharding(mesh, logical_axes, rules))
+    scatter/gather mapping functions (ref: mappings.py:253-278).
+
+    When an ambient abstract mesh is active (jax.set_mesh — the pipelined
+    paths run under one), pass the raw PartitionSpec so jax resolves it
+    against the CONTEXT mesh: inside a partial-manual shard_map region the
+    context mesh marks 'pp' Manual, and a NamedSharding built on the
+    concrete (all-Auto) mesh would poison the value's aval — the next
+    dot_general consuming it unchanged (e.g. post-LN models feed a layer
+    output straight into the next QKV matmul) raises a mesh-mismatch."""
+    spec = logical_to_spec(logical_axes, rules)
+    try:
+        cur = jax.sharding.get_abstract_mesh()
+    except AttributeError:  # older jax: no ambient-mesh API
+        cur = None
+    if cur is not None and not cur.empty:
+        return jax.lax.with_sharding_constraint(x, spec)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 # ---------------------------------------------------------------------------

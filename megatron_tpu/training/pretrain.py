@@ -25,9 +25,13 @@ def run_pretrain(
     axes_fn: Optional[Callable] = None,
     mesh=None,
     valid_dataset=None,
+    pipelined_spec=None,
+    pipelined_loss_fn=None,
 ) -> int:
     """Build state + iterator and run the training loop. `loss_fn` has the
-    make_train_step contract: (params, microbatch_dict, rng) -> scalar."""
+    make_train_step contract: (params, microbatch_dict, rng) -> scalar.
+    `pipelined_spec` / `pipelined_loss_fn` supply the pp>1 formulation of
+    the same model (see make_train_step)."""
     from megatron_tpu.data.samplers import DictBatchIterator
     from megatron_tpu.training import checkpointing as ckpt
     from megatron_tpu.training.loop import train
@@ -76,6 +80,7 @@ def run_pretrain(
         start_iteration=start_iteration, consumed_samples=consumed,
         save_fn=save_fn,
         step_kwargs={"loss_fn": loss_fn, "init_params_fn": init_params_fn,
-                     "axes_fn": axes_fn})
+                     "axes_fn": axes_fn, "pipelined_spec": pipelined_spec,
+                     "pipelined_loss_fn": pipelined_loss_fn})
     print_rank_0(f"pretraining done at consumed_samples={consumed}")
     return 0

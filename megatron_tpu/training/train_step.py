@@ -124,28 +124,77 @@ def train_step(
     (grads, loss_sum), _ = jax.lax.scan(
         body, (zeros, jnp.zeros((), jnp.float32)),
         (mb_stream, jnp.arange(n_micro)))
+    return _finish_step(state, grads, loss_sum / n_micro, cfg, wd_mask)
 
-    lr = scheduler.learning_rate(state.iteration, cfg.optimizer, cfg.training)
+
+def _finish_step(state: TrainState, grads, loss, cfg: MegatronConfig,
+                 wd_mask):
+    """Shared optimizer tail: lr/wd schedule -> apply -> metrics."""
+    lr = scheduler.learning_rate(state.iteration, cfg.optimizer,
+                                 cfg.training)
     wd = scheduler.weight_decay(state.iteration, cfg.optimizer, cfg.training)
-
     new_params, new_opt_state, ometrics = opt.apply_optimizer(
         state.params, grads, state.opt_state, cfg.optimizer, lr, wd,
         wd_mask=wd_mask)
-
-    new_state = TrainState(
-        params=new_params,
-        opt_state=new_opt_state,
-        iteration=state.iteration + 1,
-    )
-    metrics = {
-        "lm_loss": loss_sum / n_micro,
-        "lr": lr,
-        "wd": wd,
-        **ometrics,
-    }
+    new_state = TrainState(params=new_params, opt_state=new_opt_state,
+                           iteration=state.iteration + 1)
+    metrics = {"lm_loss": loss, "lr": lr, "wd": wd, **ometrics}
     if cfg.training.log_params_norm:  # ref: --log_params_norm
         metrics["params_norm"] = opt.global_grad_norm(new_params)
     return new_state, metrics
+
+
+def custom_pipelined_train_step(
+    state: TrainState,
+    batch: dict,
+    rng,
+    cfg: MegatronConfig,
+    mesh,
+    spec,            # factory: (model_cfg, deterministic) -> (intake, chunk, head)
+    wd_mask=None,
+):
+    """Train step for custom-loss models (BERT-family) pipelined via the
+    generic 1F1B core — the reference's forward_step_func plugged into its
+    1F1B schedule (ref: schedules.py:606-722). The batch dict itself is the
+    stream pytree ([n_micro, ...] leaves)."""
+    from megatron_tpu.parallel import pipeline as pl
+
+    mcfg = cfg.model
+    deterministic = (mcfg.hidden_dropout == 0.0 and
+                     mcfg.attention_dropout == 0.0)
+    intake, chunk, head = spec(mcfg, deterministic)
+    tokens = batch["tokens"]
+    loss, grads = pl.pipeline_train_1f1b(
+        state.params, batch, mcfg, mesh,
+        intake_fn=intake, chunk_fn=chunk, head_loss_fn=head,
+        batch_shape=(tokens.shape[1], tokens.shape[2]),
+        rng=None if deterministic else rng,
+        cotangent_seed=state.opt_state.scaler.scale)
+    return _finish_step(state, grads, loss, cfg, wd_mask)
+
+
+def derived_pipelined_train_step(
+    state: TrainState,
+    batch: dict,
+    rng,
+    cfg: MegatronConfig,
+    mesh,
+    pipelined_loss_fn,   # (params, batch, rng) -> scalar, pipelined inside
+    wd_mask=None,
+):
+    """Train step for models that pipeline inside their own loss function
+    (T5's two-pass encoder/decoder, models/t5.py t5_pipeline_loss_fn) with
+    the backward derived by jax.grad."""
+    loss_scale = state.opt_state.scaler.scale
+
+    def total_loss(params):
+        loss = pipelined_loss_fn(params, batch, rng)
+        return loss * loss_scale, loss
+
+    (_, loss), grads = jax.value_and_grad(total_loss,
+                                          has_aux=True)(state.params)
+    grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+    return _finish_step(state, grads, loss, cfg, wd_mask)
 
 
 def pipelined_train_step(
@@ -158,10 +207,13 @@ def pipelined_train_step(
     wd_mask=None,
 ):
     """Train step with the transformer stack pipelined over 'pp'
-    (ref: schedules.py:606-722 1F1B — see parallel/pipeline.py). The
-    microbatch loop IS the pipeline tick loop, so grads over the full global
-    batch come from one backward pass through the pipelined graph."""
-    from megatron_tpu.parallel.pipeline import pipeline_loss_fn
+    (ref: schedules.py:606-722 1F1B — see parallel/pipeline.py).
+
+    Default schedule is hand-written 1F1B: per-stage live memory is flat in
+    n_micro (the reference's 1F1B memory bound). vpp>1 interleaving and
+    schedule="gpipe" use the lockstep scan whose backward is derived by
+    jax.grad (memory grows with n_micro)."""
+    from megatron_tpu.parallel import pipeline as pl
 
     mcfg = cfg.model
     loss_scale = state.opt_state.scaler.scale
@@ -170,32 +222,39 @@ def pipelined_train_step(
     if rope is None:
         rope = lm.make_rope(mcfg)
 
-    def total_loss(params):
-        loss = pipeline_loss_fn(
-            params, batch["tokens"], mcfg, mesh,
-            vpp=cfg.parallel.virtual_pipeline_chunks,
-            loss_mask=batch.get("loss_mask"), rope=rope,
-            rng=None if deterministic else rng,
-            deterministic=deterministic,
+    # config.validate resolves 1f1b + vpp>1 to gpipe with a warning
+    use_1f1b = cfg.parallel.pipeline_schedule == "1f1b"
+    if use_1f1b:
+        intake, chunk, head = pl.gpt_1f1b_fns(mcfg, rope=rope,
+                                              deterministic=deterministic)
+        streams = pl.gpt_1f1b_streams(
+            batch["tokens"], mcfg, loss_mask=batch.get("loss_mask"),
             position_ids=batch.get("position_ids"),
             segment_ids=batch.get("segment_ids"))
-        return loss * loss_scale, loss
+        n_b = batch["tokens"].shape[1]
+        n_s = batch["tokens"].shape[2] - 1
+        loss, grads = pl.pipeline_train_1f1b(
+            state.params, streams, mcfg, mesh,
+            intake_fn=intake, chunk_fn=chunk, head_loss_fn=head,
+            batch_shape=(n_b, n_s),
+            rng=None if deterministic else rng,
+            cotangent_seed=loss_scale)
+    else:
+        def total_loss(params):
+            loss = pl.pipeline_loss_fn(
+                params, batch["tokens"], mcfg, mesh,
+                vpp=cfg.parallel.virtual_pipeline_chunks,
+                loss_mask=batch.get("loss_mask"), rope=rope,
+                rng=None if deterministic else rng,
+                deterministic=deterministic,
+                position_ids=batch.get("position_ids"),
+                segment_ids=batch.get("segment_ids"))
+            return loss * loss_scale, loss
 
-    grad_fn = jax.value_and_grad(total_loss, has_aux=True)
-    (_, loss), grads = grad_fn(state.params)
+        grad_fn = jax.value_and_grad(total_loss, has_aux=True)
+        (_, loss), grads = grad_fn(state.params)
     grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
-
-    lr = scheduler.learning_rate(state.iteration, cfg.optimizer, cfg.training)
-    wd = scheduler.weight_decay(state.iteration, cfg.optimizer, cfg.training)
-    new_params, new_opt_state, ometrics = opt.apply_optimizer(
-        state.params, grads, state.opt_state, cfg.optimizer, lr, wd,
-        wd_mask=wd_mask)
-    new_state = TrainState(params=new_params, opt_state=new_opt_state,
-                           iteration=state.iteration + 1)
-    metrics = {"lm_loss": loss, "lr": lr, "wd": wd, **ometrics}
-    if cfg.training.log_params_norm:  # ref: --log_params_norm
-        metrics["params_norm"] = opt.global_grad_norm(new_params)
-    return new_state, metrics
+    return _finish_step(state, grads, loss, cfg, wd_mask)
 
 
 def param_shardings(cfg: MegatronConfig, mesh, rules=None, axes_fn=None):
@@ -222,7 +281,8 @@ class _MeshContextStep:
 
 
 def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True,
-                    loss_fn=None, init_params_fn=None, axes_fn=None):
+                    loss_fn=None, init_params_fn=None, axes_fn=None,
+                    pipelined_spec=None, pipelined_loss_fn=None):
     """Build the jitted train step, optionally sharded over `mesh`.
 
     With a mesh, parameters/optimizer state get shardings from the model's
@@ -230,6 +290,14 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True,
     microbatch-batch dim — GSPMD then inserts the TP psums and the DP grad
     all-reduce the reference hand-codes. pp>1 dispatches to the pipelined
     step (collective-permute 1F1B, parallel/pipeline.py).
+
+    Custom-loss models pipeline via one of:
+    - `pipelined_spec`: factory (model_cfg, deterministic) ->
+      (intake_fn, chunk_fn, head_loss_fn) plugged into the generic 1F1B
+      core (single-stack models, e.g. models/bert.py bert_1f1b_fns);
+    - `pipelined_loss_fn`: (params, batch, rng) -> scalar that pipelines
+      internally with a derived backward (encoder-decoder models, e.g.
+      models/t5.py t5_pipeline_loss_fn).
     """
     rope = lm.make_rope(cfg.model)
     # weight-decay mask from logical axes: the stacked 'layers' dim must not
@@ -246,10 +314,23 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True,
 
     pipelined = mesh is not None and cfg.parallel.pipeline_parallel > 1
     if pipelined:
-        assert loss_fn is None, (
-            "custom losses are not pipelined yet; use pp=1 for bert/t5")
-        fn = functools.partial(pipelined_train_step, cfg=cfg, mesh=mesh,
-                               rope=rope, wd_mask=wd_mask)
+        if pipelined_spec is not None:
+            fn = functools.partial(custom_pipelined_train_step, cfg=cfg,
+                                   mesh=mesh, spec=pipelined_spec,
+                                   wd_mask=wd_mask)
+        elif pipelined_loss_fn is not None:
+            fn = functools.partial(derived_pipelined_train_step, cfg=cfg,
+                                   mesh=mesh,
+                                   pipelined_loss_fn=pipelined_loss_fn,
+                                   wd_mask=wd_mask)
+        else:
+            assert loss_fn is None, (
+                "pp>1 with a custom loss needs pipelined_spec (single-stack "
+                "models, see models/bert.py bert_1f1b_fns) or "
+                "pipelined_loss_fn (encoder-decoder, see models/t5.py "
+                "t5_pipeline_loss_fn)")
+            fn = functools.partial(pipelined_train_step, cfg=cfg, mesh=mesh,
+                                   rope=rope, wd_mask=wd_mask)
     else:
         fn = functools.partial(train_step, cfg=cfg, rope=rope,
                                wd_mask=wd_mask, loss_fn=loss_fn)

@@ -94,7 +94,11 @@ def main(argv=None):
     return run_pretrain(cfg, dataset, init_params_fn=init_fn,
                         loss_fn=loss_fn,
                         axes_fn=lambda m: bert.bert_axes(m), mesh=mesh,
-                        valid_dataset=valid_dataset)
+                        valid_dataset=valid_dataset,
+                        # pp>1: MLM/NSP pipelined through the generic 1F1B
+                        # core (ref: schedules.py:606-722 + pretrain_bert
+                        # forward_step)
+                        pipelined_spec=bert.bert_1f1b_fns)
 
 
 if __name__ == "__main__":

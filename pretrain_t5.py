@@ -90,10 +90,22 @@ def main(argv=None):
                           deterministic=mcfg.hidden_dropout == 0.0)
 
     mesh = build_mesh(cfg.parallel) if n_devices > 1 else None
+
+    pipelined_loss_fn = None
+    if mesh is not None and cfg.parallel.pipeline_parallel > 1:
+        # pp>1: both stacks pipelined over 'pp' (the reference's split-rank
+        # schedule capability, ref: schedules.py:505-535)
+        def pipelined_loss_fn(params, batch, rng):
+            return t5.t5_pipeline_loss_fn(
+                params, batch, cfg.model, mesh,
+                vpp=cfg.parallel.virtual_pipeline_chunks, rng=rng,
+                deterministic=cfg.model.hidden_dropout == 0.0)
+
     return run_pretrain(cfg, dataset, init_params_fn=init_fn,
                         loss_fn=loss_fn,
                         axes_fn=lambda m: t5.t5_axes(m), mesh=mesh,
-                        valid_dataset=valid_dataset)
+                        valid_dataset=valid_dataset,
+                        pipelined_loss_fn=pipelined_loss_fn)
 
 
 if __name__ == "__main__":

@@ -18,10 +18,26 @@ from megatron_tpu.config import (MegatronConfig, ModelConfig, OptimizerConfig,
                                  ParallelConfig, TrainingConfig)
 from megatron_tpu.models import language_model as lm
 from megatron_tpu.parallel.mesh import MESH_AXES
-from megatron_tpu.parallel.pipeline import (pipeline_loss_fn,
+from megatron_tpu.parallel.pipeline import (gpt_1f1b_fns, gpt_1f1b_streams,
+                                            pipeline_loss_fn,
+                                            pipeline_train_1f1b,
                                             stage_params_chunked,
                                             stage_params_flatten,
                                             stage_params_reshape)
+
+
+def run_1f1b(params, tokens, cfg, mesh, loss_mask=None):
+    """jit-compiled 1F1B (loss, grads) on `mesh` for test configs."""
+    intake, chunk, head = gpt_1f1b_fns(cfg, deterministic=True)
+    streams = gpt_1f1b_streams(tokens, cfg, loss_mask=loss_mask)
+    shape = (tokens.shape[1], tokens.shape[2] - 1)
+
+    def run(p, s):
+        return pipeline_train_1f1b(p, s, cfg, mesh, intake_fn=intake,
+                                   chunk_fn=chunk, head_loss_fn=head,
+                                   batch_shape=shape)
+    with jax.set_mesh(mesh):
+        return jax.jit(run)(params, streams)
 
 
 def make_cfg(num_layers=4, **kw):
@@ -161,6 +177,101 @@ def test_chunked_reshape_interleaved_assignment():
                 np.asarray(cleaf[s, c]), np.asarray(leaf[start:start + Lc]))
 
 
+@pytest.mark.parametrize("pp", [1, 2, 4])
+def test_1f1b_matches_sequential_loss(devices, pp):
+    """Hand-scheduled 1F1B (ref: schedules.py:606-722) must reproduce the
+    sequential per-microbatch mean loss exactly — it is an execution
+    schedule, not a math change."""
+    cfg = make_cfg(num_layers=4)
+    mesh = make_mesh(1, pp, 1, devices)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (3, 2, 33), 0, 128)
+    want = float(ref_loss(params, tokens, cfg))
+    loss, _ = run_1f1b(params, tokens, cfg, mesh)
+    np.testing.assert_allclose(float(loss), want, rtol=2e-4)
+
+
+def test_1f1b_matches_sequential_grads(devices):
+    """The hand-written backward (reverse cotangent ring + per-tick vjp
+    with chunk recompute) must equal autodiff of the sequential model —
+    including the shared-param grads that meet across stages (tied
+    embedding intake + head, ref: optimizer.py:203-229)."""
+    cfg = make_cfg(num_layers=4, compute_dtype="float32")
+    mesh = make_mesh(1, 4, 1, devices)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (3, 2, 33), 0, 128)
+    g_ref = jax.grad(lambda p: ref_loss(p, tokens, cfg))(params)
+    _, g_pp = run_1f1b(params, tokens, cfg, mesh)
+    ref_leaves, ref_def = jax.tree.flatten(g_ref)
+    pp_leaves, pp_def = jax.tree.flatten(g_pp)
+    assert ref_def == pp_def
+    for a, b in zip(ref_leaves, pp_leaves):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_1f1b_with_dp_and_tp(devices):
+    """1F1B on the pp=2 x dp=2 x tp=2 composite mesh (collectives inside
+    the per-stage cond branches stay tp-group-uniform)."""
+    cfg = make_cfg(num_layers=4)
+    mesh = make_mesh(2, 2, 2, devices)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 4, 33), 0, 128)
+    want = float(ref_loss(params, tokens, cfg))
+    loss, _ = run_1f1b(params, tokens, cfg, mesh)
+    np.testing.assert_allclose(float(loss), want, rtol=2e-3)
+
+
+def test_1f1b_loss_mask_semantics(devices):
+    """Non-uniform masks: per-microbatch masked-mean-then-average, matching
+    train_step (the last stage computes each microbatch's masked mean in
+    its own tick)."""
+    cfg = make_cfg(num_layers=4)
+    mesh = make_mesh(1, 2, 1, devices)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 2, 33), 0, 128)
+    mask = np.ones((2, 2, 32), np.float32)
+    mask[0, :, 3:] = 0.0
+    mask = jnp.asarray(mask)
+    want = float(ref_loss(params, tokens, cfg, loss_mask=mask))
+    loss, _ = run_1f1b(params, tokens, cfg, mesh, loss_mask=mask)
+    np.testing.assert_allclose(float(loss), want, rtol=2e-4)
+
+
+def test_1f1b_memory_flat_in_n_micro(devices):
+    """VERDICT r3 gate: at fixed pp, raising n_micro 8 -> 32 must raise
+    per-stage live bytes < 1.3x (the 1F1B memory bound; the lockstep
+    derived schedule grows ~linearly instead)."""
+    cfg = make_cfg(num_layers=4)
+    pp = 4
+    mesh = make_mesh(1, pp, 1, devices)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    intake, chunk, head = gpt_1f1b_fns(cfg, deterministic=True)
+    temps = {}
+    for n_micro in (8, 32):
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (n_micro, 2, 33), 0, 128)
+        streams = gpt_1f1b_streams(tokens, cfg)
+
+        def run(p, s):
+            return pipeline_train_1f1b(
+                p, s, cfg, mesh, intake_fn=intake, chunk_fn=chunk,
+                head_loss_fn=head, batch_shape=(2, 32))
+        with jax.set_mesh(mesh):
+            compiled = jax.jit(run).lower(params, streams).compile()
+        try:
+            mem = compiled.memory_analysis()
+        except Exception:
+            pytest.skip("backend has no memory_analysis")
+        if mem is None or not hasattr(mem, "temp_size_in_bytes"):
+            pytest.skip("backend reports no temp size")
+        temps[n_micro] = mem.temp_size_in_bytes
+    assert temps[32] < 1.3 * temps[8], (
+        f"n_micro 8->32 at pp={pp} grew temp bytes "
+        f"{temps[8]} -> {temps[32]} (>=1.3x): 1F1B memory is not "
+        "bounded by pp")
+
+
 def test_pipeline_memory_scales_with_layers_per_stage(devices):
     """VERDICT item 3 gate: per-stage live activations must scale with
     layers/pp — more stages => smaller per-device temp memory. Also
@@ -236,15 +347,18 @@ def test_sharded_eval_step(devices):
     np.testing.assert_allclose(got, want, rtol=2e-4)
 
 
-def test_pipelined_train_step(devices):
-    """Full train step (grads + Adam) through the pp=2 x dp=2 x tp=2 mesh."""
+@pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+def test_pipelined_train_step(devices, schedule):
+    """Full train step (grads + Adam) through the pp=2 x dp=2 x tp=2 mesh,
+    under both pp schedules."""
     from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
                                      ParallelConfig, TrainingConfig)
     from megatron_tpu.training import init_train_state, make_train_step
     cfg = MegatronConfig(
         model=make_cfg(num_layers=4),
         parallel=ParallelConfig(tensor_parallel=2, pipeline_parallel=2,
-                                sequence_parallel=True),
+                                sequence_parallel=True,
+                                pipeline_schedule=schedule),
         optimizer=OptimizerConfig(lr=1e-3, clip_grad=1.0),
         training=TrainingConfig(micro_batch_size=2, global_batch_size=8,
                                 train_iters=3),

@@ -56,8 +56,6 @@ def _common_argv(corpus, save_dir, seq=32):
         "--num_attention_heads", "4", "--seq_length", str(seq),
         "--max_position_embeddings", str(seq),
         "--micro_batch_size", "2", "--global_batch_size", "4",
-        # tp=2 x pp=... -> dp=2 on the 8-device virtual mesh; pp>1 needs
-        # the pipelined custom-loss path which is GPT-only, so use tp*cp
         "--tensor_model_parallel_size", "4",
         "--train_iters", "3", "--lr", "1e-4",
         "--save", save_dir, "--save_interval", "3",
@@ -90,6 +88,35 @@ def test_pretrain_ict_entrypoint(corpus):
     argv += ["--titles_data_path", corpus["titles"],
              "--ict_head_size", "16"]
     assert pretrain_ict.main(argv) == 0
+    from megatron_tpu.training.checkpointing import read_tracker
+    assert read_tracker(save) == "3"
+
+
+def _pp2_argv(argv):
+    """Swap tp=4 for tp=2 x pp=2 (dp=2 on the 8-device virtual mesh)."""
+    i = argv.index("--tensor_model_parallel_size")
+    return (argv[:i] + ["--tensor_model_parallel_size", "2",
+                        "--pipeline_model_parallel_size", "2"]
+            + argv[i + 2:])
+
+
+def test_pretrain_bert_entrypoint_pp2(corpus):
+    """BERT trains at pp=2 from the CLI: the custom MLM+NSP loss runs
+    through the generic 1F1B pipeline (VERDICT r3 item 3)."""
+    import pretrain_bert
+    save = str(corpus["tmp"] / "bert_pp_ckpt")
+    assert pretrain_bert.main(_pp2_argv(_common_argv(corpus, save))) == 0
+    from megatron_tpu.training.checkpointing import read_tracker
+    assert read_tracker(save) == "3"
+
+
+def test_pretrain_t5_entrypoint_pp2(corpus):
+    """T5 trains at pp=2 from the CLI: encoder and decoder both pipelined
+    (split-rank capability, ref: schedules.py:505-535)."""
+    import pretrain_t5
+    save = str(corpus["tmp"] / "t5_pp_ckpt")
+    argv = _pp2_argv(_common_argv(corpus, save)) + ["--vocab_extra_ids", "8"]
+    assert pretrain_t5.main(argv) == 0
     from megatron_tpu.training.checkpointing import read_tracker
     assert read_tracker(save) == "3"
 
