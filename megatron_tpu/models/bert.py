@@ -116,15 +116,47 @@ def bert_encode(params, tokens, cfg: ModelConfig, *, tokentype_ids=None,
     x = x.astype(compute_dtype)
     x = apply_norm(cfg.norm_type, params["embedding_norm"], x,
                    cfg.norm_epsilon)
+    if rng is not None and not deterministic and cfg.hidden_dropout > 0.0:
+        # embedding-output dropout (ref: language_model.py:226-258
+        # Embedding.forward embedding_dropout) — same placement as the
+        # pipelined intake in bert_1f1b_fns so pp=1 and pp>1 train
+        # identically
+        from megatron_tpu.ops.dropout import dropout as _drop
+        rng, r_emb = jax.random.split(rng)
+        x = _drop(r_emb, x, cfg.hidden_dropout)
     seg = None
     if padding_mask is not None:
         seg = bert_pad_segments(padding_mask)
     x, _ = tfm.stack_apply(params["transformer"], x, cfg, causal=False,
                            segment_ids=seg, rng=rng,
                            deterministic=deterministic)
-    pooled = jnp.tanh(x[:, 0] @ params["pooler"]["w"].astype(compute_dtype)
-                      + params["pooler"]["b"].astype(compute_dtype))
-    return x, pooled
+    return x, bert_pool(params, x, compute_dtype)
+
+
+def bert_pool(params, x, compute_dtype):
+    """dense+tanh over [CLS] (ref: language_model.py Pooler)."""
+    return jnp.tanh(x[:, 0] @ params["pooler"]["w"].astype(compute_dtype)
+                    + params["pooler"]["b"].astype(compute_dtype))
+
+
+def bert_lm_logits(params, x, cfg: ModelConfig, compute_dtype):
+    """MLM head: dense+gelu+LN then tied decode + bias
+    (ref: bert_model.py:55-91). Shared by the sequential forward and the
+    pipelined per-microbatch head so pp=1 and pp>1 run the same math."""
+    lh = params["lm_head"]
+    y = x @ lh["dense"]["w"].astype(compute_dtype) + \
+        lh["dense"]["b"].astype(compute_dtype)
+    y = jax.nn.gelu(y, approximate=False)
+    y = apply_norm(cfg.norm_type, lh["norm"], y, cfg.norm_epsilon)
+    w_out = params["embedding"]["word_embeddings"].T.astype(compute_dtype)
+    return (y @ w_out).astype(jnp.float32) + lh["bias"].astype(jnp.float32)
+
+
+def bert_nsp_logits(params, pooled, compute_dtype):
+    """NSP binary head over the pooled output (ref: bert_model.py:171-176)."""
+    return (pooled @ params["binary_head"]["w"].astype(compute_dtype)
+            + params["binary_head"]["b"].astype(compute_dtype)
+            ).astype(jnp.float32)
 
 
 def bert_forward(params, tokens, cfg: ModelConfig, *, tokentype_ids=None,
@@ -138,18 +170,8 @@ def bert_forward(params, tokens, cfg: ModelConfig, *, tokentype_ids=None,
     x, pooled = bert_encode(params, tokens, cfg, tokentype_ids=tokentype_ids,
                             padding_mask=padding_mask, rng=rng,
                             deterministic=deterministic)
-    nsp_logits = (pooled @ params["binary_head"]["w"].astype(compute_dtype)
-                  + params["binary_head"]["b"].astype(compute_dtype))
-
-    lh = params["lm_head"]
-    y = x @ lh["dense"]["w"].astype(compute_dtype) + \
-        lh["dense"]["b"].astype(compute_dtype)
-    y = jax.nn.gelu(y, approximate=False)
-    y = apply_norm(cfg.norm_type, lh["norm"], y, cfg.norm_epsilon)
-    w_out = params["embedding"]["word_embeddings"].T.astype(compute_dtype)
-    lm_logits = (y @ w_out).astype(jnp.float32) + \
-        lh["bias"].astype(jnp.float32)
-    return lm_logits, nsp_logits.astype(jnp.float32)
+    return (bert_lm_logits(params, x, cfg, compute_dtype),
+            bert_nsp_logits(params, pooled, compute_dtype))
 
 
 def bert_pad_segments(padding_mask):
@@ -196,29 +218,18 @@ def bert_1f1b_fns(cfg: ModelConfig, deterministic: bool = True):
                                layer_offset=offset)[0]
 
     def head_loss(shared_p, h, sl, rng_mb):
-        # pooler + NSP + MLM transform + tied decode + masked-mean losses:
-        # the per-microbatch tail of bert_forward/bert_loss
-        pooled = jnp.tanh(
-            h[:, 0] @ shared_p["pooler"]["w"].astype(compute_dtype)
-            + shared_p["pooler"]["b"].astype(compute_dtype))
-        lh = shared_p["lm_head"]
-        y = h @ lh["dense"]["w"].astype(compute_dtype) + \
-            lh["dense"]["b"].astype(compute_dtype)
-        y = jax.nn.gelu(y, approximate=False)
-        y = apply_norm(cfg.norm_type, lh["norm"], y, cfg.norm_epsilon)
-        w_out = shared_p["embedding"]["word_embeddings"].T.astype(
-            compute_dtype)
-        lm_logits = (y @ w_out).astype(jnp.float32) + \
-            lh["bias"].astype(jnp.float32)
+        # the per-microbatch tail of bert_forward/bert_loss, via the SAME
+        # head helpers the sequential path uses (no drift between pp=1
+        # and pp>1)
+        lm_logits = bert_lm_logits(shared_p, h, cfg, compute_dtype)
         losses = cross_entropy_loss(lm_logits, sl["labels"],
                                     vocab_size=cfg.vocab_size)
         mask = sl["loss_mask"].astype(jnp.float32)
         total = jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
         if "is_random" in sl:
-            nsp_logits = (
-                pooled @ shared_p["binary_head"]["w"].astype(compute_dtype)
-                + shared_p["binary_head"]["b"].astype(compute_dtype)
-            ).astype(jnp.float32)
+            nsp_logits = bert_nsp_logits(
+                shared_p, bert_pool(shared_p, h, compute_dtype),
+                compute_dtype)
             total = total + jnp.mean(
                 cross_entropy_loss(nsp_logits, sl["is_random"]))
         return total

@@ -144,6 +144,27 @@ def model_forward(
     return constrain(logits, ("batch", "seq", "vocab")), kv_caches
 
 
+def head_logits(params, x, cfg: ModelConfig, *, mb_axis: bool = False):
+    """Final norm + (tied/untied) LM head with SP-aware sharding hints —
+    the single implementation behind both pipelined tails (the lockstep
+    pipeline's post-shard_map head and the 1F1B per-microbatch head), so
+    pp schedules cannot drift from each other. `mb_axis` adds the leading
+    'microbatch' logical axis used when the head work is spread over 'pp'.
+    """
+    from megatron_tpu.config import as_dtype
+    compute_dtype = as_dtype(cfg.compute_dtype)
+    pre = ("microbatch",) if mb_axis else ()
+    x = constrain(x, pre + ("batch", "seq_sp", "act_embed"))
+    x = apply_norm(cfg.norm_type, params["final_norm"], x, cfg.norm_epsilon)
+    x = constrain(x, pre + ("batch", "seq", "act_embed"))
+    if cfg.tie_embed_logits:
+        w_out = params["embedding"]["word_embeddings"].T
+    else:
+        w_out = params["lm_head"]
+    logits = (x @ w_out.astype(compute_dtype)).astype(jnp.float32)
+    return constrain(logits, pre + ("batch", "seq", "vocab"))
+
+
 def loss_fn(
     params,
     tokens,  # [b, s+1] or (inputs [b,s], labels [b,s])

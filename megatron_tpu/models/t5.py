@@ -100,13 +100,17 @@ def t5_forward(params, enc_tokens, dec_tokens, cfg: ModelConfig, *,
     dec, _ = tfm.stack_apply(params["decoder"], y, cfg, causal=True,
                              encoder_output=enc, rng=rng,
                              deterministic=deterministic)
+    return t5_lm_logits(params, dec, cfg, compute_dtype)
+
+
+def t5_lm_logits(params, dec, cfg: ModelConfig, compute_dtype):
+    """Decoder-final norm + tied decode + bias (ref: t5_model.py:36-60
+    T5LMHead) — shared by the sequential and pipelined tails."""
     dec = apply_norm(cfg.norm_type, params["decoder_norm"], dec,
                      cfg.norm_epsilon)
-
     w_out = params["embedding"]["word_embeddings"].T.astype(compute_dtype)
-    logits = (dec @ w_out).astype(jnp.float32) + \
+    return (dec @ w_out).astype(jnp.float32) + \
         params["lm_head_bias"].astype(jnp.float32)
-    return logits
 
 
 def t5_loss(params, batch, cfg: ModelConfig, *, rng=None,
@@ -196,11 +200,7 @@ def t5_pipeline_loss_fn(params, batch, cfg: ModelConfig, mesh, *,
         batch_shape=(n_b, s_dec), vpp=vpp, rng=rng)
 
     dec = constrain(dec, ("microbatch", "batch", "seq", "act_embed"))
-    dec = apply_norm(cfg.norm_type, params["decoder_norm"], dec,
-                     cfg.norm_epsilon)
-    w_out = params["embedding"]["word_embeddings"].T.astype(compute_dtype)
-    logits = (dec @ w_out).astype(jnp.float32) + \
-        params["lm_head_bias"].astype(jnp.float32)
+    logits = t5_lm_logits(params, dec, cfg, compute_dtype)
     logits = constrain(logits, ("microbatch", "batch", "seq", "vocab"))
     losses = cross_entropy_loss(logits, batch["labels"],
                                 vocab_size=cfg.vocab_size)

@@ -512,16 +512,7 @@ def gpt_1f1b_fns(cfg: ModelConfig, rope=None, deterministic: bool = True):
             layer_offset=offset)[0]
 
     def head_loss(shared_p, h, sl, rng_mb):
-        x = constrain(h, ("batch", "seq_sp", "act_embed"))
-        x = apply_norm(cfg.norm_type, shared_p["final_norm"], x,
-                       cfg.norm_epsilon)
-        x = constrain(x, ("batch", "seq", "act_embed"))
-        if cfg.tie_embed_logits:
-            w_out = shared_p["embedding"]["word_embeddings"].T
-        else:
-            w_out = shared_p["lm_head"]
-        logits = (x @ w_out.astype(compute_dtype)).astype(jnp.float32)
-        logits = constrain(logits, ("batch", "seq", "vocab"))
+        logits = lm.head_logits(shared_p, h, cfg)
         losses = cross_entropy_loss(logits, sl["labels"],
                                     vocab_size=cfg.vocab_size)
         mask = sl["loss_mask"].astype(losses.dtype)
@@ -595,16 +586,9 @@ def pipeline_loss_fn(
         position_ids=position_ids, segment_ids=segment_ids)
 
     # head work spread over the idle-in-the-bubble stages: microbatch dim
-    # resharded onto 'pp'
-    x = constrain(x, ("microbatch", "batch", "seq_sp", "act_embed"))
-    x = apply_norm(cfg.norm_type, params["final_norm"], x, cfg.norm_epsilon)
-    x = constrain(x, ("microbatch", "batch", "seq", "act_embed"))
-    if cfg.tie_embed_logits:
-        w_out = params["embedding"]["word_embeddings"].T
-    else:
-        w_out = params["lm_head"]
-    logits = (x @ w_out.astype(compute_dtype)).astype(jnp.float32)
-    logits = constrain(logits, ("microbatch", "batch", "seq", "vocab"))
+    # resharded onto 'pp' (mb_axis); same head implementation as the 1F1B
+    # per-microbatch tail
+    logits = lm.head_logits(params, x, cfg, mb_axis=True)
     losses = cross_entropy_loss(logits, labels, vocab_size=cfg.vocab_size)
     loss_mask = loss_mask.astype(losses.dtype)
     # per-microbatch masked mean, then mean over microbatches (== train_step)

@@ -16,11 +16,22 @@ GSPMD-automatic), cp hops of blockwise attention merged online via
 The inner block is the Pallas flash kernel (scores never materialize in
 HBM; `pallas_flash_attention_with_lse` exposes a differentiable lse whose
 cotangent feeds back through the merge weights); the XLA einsum block
-remains as the fallback for odd shapes / non-TPU backends. Causality per
-hop: the block from rank r itself is the causal diagonal, blocks from
-earlier ranks attend fully, later ranks are excluded via a −inf lse (their
-compute is the standard causal-ring waste; zigzag balancing is a possible
-future refinement).
+remains as the fallback for odd shapes / non-TPU backends.
+
+Causal load balance — ZIGZAG layout (default for causal): a contiguous
+sequence split makes rank r's useful causal work proportional to r+1 (the
+last rank attends to everything, the first to almost nothing) — at cp=8
+nearly half the ring's attention FLOPs are masked away. Instead the
+sequence is split into 2·cp chunks and rank r owns chunks {r, 2cp-1-r}
+(one early + one late chunk, the Megatron-LM cp / llama3 zigzag): every
+rank's useful pair count becomes exactly 2cp+1 chunk-pairs (r+1 for the
+head chunk + 2cp-r for the tail chunk), equal by construction —
+`zigzag_pair_counts` asserts this and the flash path's per-pair
+lax.switch SKIPS fully-masked pairs so balanced schedule = balanced
+compute. The permutation in/out of zigzag order happens OUTSIDE the
+shard_map (GSPMD lowers it to a pairwise exchange); integrating the
+permutation into the data loader (tokens pre-permuted, loss
+permutation-invariant) would make it free and is the planned follow-up.
 """
 from __future__ import annotations
 
@@ -35,18 +46,18 @@ from megatron_tpu.ops.flash_attention import flash_attention
 NEG_INF = -1e30
 
 
-def _local_block_attention(q, k, v, q_off, kv_off, *, scale, causal):
+def _local_block_attention(q, k, v, q_pos, kv_pos, *, scale, causal):
     """XLA fallback: blockwise attention of local q [b,s,nq,d] against one
     rotating kv block [b,c,nkv,d]; returns (out [b,s,nq,d] f32 normalized,
-    lse [b,s,nq] f32) for online merging."""
+    lse [b,s,nq] f32) for online merging. `q_pos`/`kv_pos` are the GLOBAL
+    position vectors of the local rows — offsets for a contiguous layout,
+    arbitrary permutations for zigzag."""
     b, s, nq, d = q.shape
     c, nkv = k.shape[1], k.shape[2]
     g = nq // nkv
     qg = (q.astype(jnp.float32) * scale).reshape(b, s, nkv, g, d)
     scores = jnp.einsum("bsngd,btnd->bsngt", qg, k.astype(jnp.float32))
     if causal:
-        q_pos = q_off + jnp.arange(s)
-        kv_pos = kv_off + jnp.arange(c)
         mask = q_pos[:, None] >= kv_pos[None, :]  # [s, c]
         scores = jnp.where(mask[None, :, None, None, :], scores, NEG_INF)
     m = jnp.max(scores, axis=-1)  # [b,s,nkv,g]
@@ -57,6 +68,34 @@ def _local_block_attention(q, k, v, q_off, kv_off, *, scale, causal):
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
     return out.reshape(b, s, nq, d), lse.reshape(b, s, nq)
+
+
+def zigzag_permutation(S: int, cp: int):
+    """Row permutation putting a length-S sequence into zigzag order:
+    rank r's shard = [chunk r ; chunk 2cp-1-r] of the 2cp equal chunks.
+    Returns (perm, inv) index vectors; x_zig = x[perm], x = x_zig[inv]."""
+    import numpy as np
+    c = S // (2 * cp)
+    parts = []
+    for r in range(cp):
+        parts.append(np.arange(r * c, (r + 1) * c))
+        parts.append(np.arange((2 * cp - 1 - r) * c, (2 * cp - r) * c))
+    perm = np.concatenate(parts)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(S)
+    return perm, inv
+
+
+def zigzag_pair_counts(cp: int):
+    """Useful (non-fully-masked) chunk-pairs per rank under the zigzag
+    schedule — equal across ranks by construction (the balance assert)."""
+    counts = []
+    for r in range(cp):
+        head, tail = r, 2 * cp - 1 - r
+        # a q chunk with global index i usefully attends to kv chunks
+        # 0..i: i full pairs + 1 causal diagonal
+        counts.append((head + 1) + (tail + 1))
+    return counts
 
 
 def _flash_ok(s_loc: int) -> bool:
@@ -70,53 +109,128 @@ def _flash_ok(s_loc: int) -> bool:
 
 def ring_attention(q, k, v, mesh, *, causal: bool = True,
                    scale: float | None = None, axis: str = "cp",
-                   impl: str = "auto"):
+                   impl: str = "auto", layout: str = "auto"):
     """q/k/v [b, S, n, d] with S the GLOBAL sequence length, sharded over
     `axis` on dim 1. Returns [b, S, nq, d] with the same sharding.
 
     impl: "flash" forces the Pallas inner block (interpret mode off-TPU),
     "xla" forces the einsum fallback, "auto" picks flash on TPU when the
-    local shard length tiles. Must run under jit with the ambient mesh set
-    (same contract as the pipeline shard_map)."""
+    local shard length tiles. layout: "zigzag" balances causal work across
+    ranks (module docstring), "contiguous" is the plain split, "auto"
+    picks zigzag for causal when S divides 2·cp. Must run under jit with
+    the ambient mesh set (same contract as the pipeline shard_map)."""
     cp = mesh.shape[axis]
     if cp == 1:
         return flash_attention(q, k, v, causal=causal, scale=scale)
     d = q.shape[-1]
-    s_loc = q.shape[1] // cp
+    S = q.shape[1]
+    s_loc = S // cp
     if scale is None:
         scale = d ** -0.5
     out_dtype = q.dtype
     on_tpu = jax.default_backend() == "tpu"
-    if impl == "auto":
-        use_flash = on_tpu and _flash_ok(s_loc)
-    else:
-        use_flash = impl == "flash"
     interpret = not on_tpu
     # the CPU SPMD partitioner CHECK-fails on bf16 collectives in
     # partial-manual regions; ring K/V in compute dtype on TPU only
     ring_dtype = q.dtype if on_tpu else jnp.float32
 
+    if layout == "auto":
+        layout = ("zigzag" if causal and S % (2 * cp) == 0
+                  else "contiguous")
+    zigzag = layout == "zigzag" and causal
+    if zigzag:
+        assert S % (2 * cp) == 0, (
+            f"zigzag layout needs seq {S} divisible by 2*cp={2 * cp} "
+            "(zigzag_permutation would silently truncate); use "
+            "layout='contiguous'")
+    c = s_loc // 2  # zigzag chunk length
+    if impl == "auto":
+        use_flash = on_tpu and _flash_ok(c if zigzag else s_loc)
+    else:
+        use_flash = impl == "flash"
+
+    if zigzag:
+        perm, inv = zigzag_permutation(S, cp)
+        q, k, v = q[:, perm], k[:, perm], v[:, perm]
+
+    from megatron_tpu.ops.flash_attention_pallas import (
+        pallas_flash_attention_with_lse as fl)
+
+    def _merge(out_a, lse_a, out_b, lse_b):
+        """Online (out, lse) merge of two partial attention results."""
+        tot = jnp.logaddexp(lse_a, lse_b)
+        safe = jnp.where(tot <= NEG_INF / 2, 0.0, tot)
+        alpha = jnp.where(lse_a <= NEG_INF / 2, 0.0, jnp.exp(lse_a - safe))
+        beta = jnp.where(lse_b <= NEG_INF / 2, 0.0, jnp.exp(lse_b - safe))
+        return out_a * alpha[..., None] + out_b * beta[..., None], tot
+
     def per_rank(q, k, v):
         # local shards: q [b, s_loc, nq, d], k/v [b, s_loc, nkv, d]
         r = jax.lax.axis_index(axis)
         b, s_loc, nq, _ = q.shape
-        perm = [(i, (i + 1) % cp) for i in range(cp)]
+        perm_ring = [(i, (i + 1) % cp) for i in range(cp)]
+
+        def local_positions(rank):
+            """Global positions of the local rows under the layout."""
+            if zigzag:
+                head = rank * c + jnp.arange(c)
+                tail = (2 * cp - 1 - rank) * c + jnp.arange(c)
+                return jnp.concatenate([head, tail])
+            return rank * s_loc + jnp.arange(s_loc)
+
+        def flash_block(q_blk, k_blk, v_blk, sel):
+            """One (q chunk, kv chunk) pair via lax.switch on the pair
+            class: 0 = fully masked (skip — this is what makes the
+            balanced schedule balanced COMPUTE), 1 = causal diagonal,
+            2 = fully allowed. No collectives inside the branches."""
+            bq = q_blk.shape[1]
+
+            def skip(a, bb, cc):
+                return (jnp.zeros(a.shape, jnp.float32),
+                        jnp.full((b, bq, nq), NEG_INF, jnp.float32))
+
+            def diag(a, bb, cc):
+                o, l = fl(a, bb, cc, True, scale, 512, 512, interpret)
+                return o.astype(jnp.float32), l
+
+            def full(a, bb, cc):
+                o, l = fl(a, bb, cc, False, scale, 512, 512, interpret)
+                return o.astype(jnp.float32), l
+
+            return jax.lax.switch(sel, (skip, diag, full),
+                                  q_blk, k_blk.astype(q.dtype),
+                                  v_blk.astype(q.dtype))
 
         def inner_flash(k_cur, v_cur, src):
-            from megatron_tpu.ops.flash_attention_pallas import (
-                pallas_flash_attention_with_lse as fl)
-            kd, vd = k_cur.astype(q.dtype), v_cur.astype(q.dtype)
             if not causal:
-                return fl(q, kd, vd, False, scale, 512, 512, interpret)
-            # diagonal hop -> causal kernel; others -> full kernel (later
-            # ranks are zero-weighted at merge)
-            return jax.lax.cond(
-                src == r,
-                lambda a, bb, c: fl(a, bb, c, True, scale, 512, 512,
-                                    interpret),
-                lambda a, bb, c: fl(a, bb, c, False, scale, 512, 512,
-                                    interpret),
-                q, kd, vd)
+                o, l = fl(q, k_cur.astype(q.dtype), v_cur.astype(q.dtype),
+                          False, scale, 512, 512, interpret)
+                return o.astype(jnp.float32), l
+            if not zigzag:
+                # contiguous causal: diagonal hop -> causal kernel; earlier
+                # ranks full; later ranks skipped entirely
+                sel = jnp.clip(jnp.sign(r - src) + 1, 0, 2)
+                return flash_block(q, k_cur, v_cur, sel)
+            # zigzag: 2x2 chunk pairs, each full/diag/skip by global
+            # chunk index comparison
+            q_idx = (r, 2 * cp - 1 - r)
+            kv_idx = (src, 2 * cp - 1 - src)
+            outs, lses = [], []
+            for i in range(2):
+                q_blk = q[:, i * c:(i + 1) * c]
+                o_acc = jnp.zeros(q_blk.shape, jnp.float32)
+                l_acc = jnp.full((b, c, nq), NEG_INF, jnp.float32)
+                for j in range(2):
+                    sel = jnp.clip(jnp.sign(q_idx[i] - kv_idx[j]) + 1,
+                                   0, 2)
+                    o_ij, l_ij = flash_block(
+                        q_blk, k_cur[:, j * c:(j + 1) * c],
+                        v_cur[:, j * c:(j + 1) * c], sel)
+                    o_acc, l_acc = _merge(o_acc, l_acc, o_ij, l_ij)
+                outs.append(o_acc)
+                lses.append(l_acc)
+            return (jnp.concatenate(outs, axis=1),
+                    jnp.concatenate(lses, axis=1))
 
         def hop(carry, step):
             out_tot, lse_tot, k_cur, v_cur = carry
@@ -125,25 +239,14 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True,
             src = (r - step) % cp
             if use_flash:
                 out_i, lse_i = inner_flash(k_cur, v_cur, src)
-                out_i = out_i.astype(jnp.float32)
-                if causal:
-                    # exclude blocks from later ranks
-                    lse_i = jnp.where(src <= r, lse_i, NEG_INF)
             else:
                 out_i, lse_i = _local_block_attention(
-                    q, k_cur, v_cur, r * s_loc, src * s_loc,
-                    scale=scale, causal=causal)
-            new_tot = jnp.logaddexp(lse_tot, lse_i)
-            safe = jnp.where(new_tot <= NEG_INF / 2, 0.0, new_tot)
-            alpha = jnp.where(lse_tot <= NEG_INF / 2, 0.0,
-                              jnp.exp(lse_tot - safe))
-            beta = jnp.where(lse_i <= NEG_INF / 2, 0.0,
-                             jnp.exp(lse_i - safe))
-            out_tot = (out_tot * alpha[..., None]
-                       + out_i * beta[..., None])
-            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
-            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-            return (out_tot, new_tot, k_nxt, v_nxt), None
+                    q, k_cur, v_cur, local_positions(r),
+                    local_positions(src), scale=scale, causal=causal)
+            out_tot, lse_tot = _merge(out_tot, lse_tot, out_i, lse_i)
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm_ring)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm_ring)
+            return (out_tot, lse_tot, k_nxt, v_nxt), None
 
         out0 = jnp.zeros(q.shape, jnp.float32)
         lse0 = jnp.full((b, s_loc, nq), NEG_INF, jnp.float32)
@@ -159,4 +262,7 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True,
         check_vma=False,
         axis_names={axis},
     )
-    return shmap(q, k, v)
+    out = shmap(q, k, v)
+    if zigzag:
+        out = out[:, inv]
+    return out

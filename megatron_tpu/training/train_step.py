@@ -315,6 +315,13 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True,
     pipelined = mesh is not None and cfg.parallel.pipeline_parallel > 1
     if pipelined:
         if pipelined_spec is not None:
+            # the spec path runs the 1F1B core only — it has no vpp
+            # interleaving, and silently dropping a vpp request (or the
+            # gpipe schedule config.validate resolved it to) would train a
+            # different layout than asked
+            assert cfg.parallel.virtual_pipeline_chunks == 1, (
+                "pipelined_spec models (BERT-family) support vpp=1 only; "
+                "drop --num_layers_per_virtual_pipeline_stage")
             fn = functools.partial(custom_pipelined_train_step, cfg=cfg,
                                    mesh=mesh, spec=pipelined_spec,
                                    wd_mask=wd_mask)
