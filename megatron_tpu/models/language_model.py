@@ -129,28 +129,22 @@ def model_forward(
         position_ids=position_ids, kv_caches=kv_caches,
         rng=rng, deterministic=deterministic, segment_ids=segment_ids)
 
-    x = apply_norm(cfg.norm_type, params["final_norm"], x, cfg.norm_epsilon)
-    # gather seq from 'tp' before the vocab-parallel LM head: logits shard
-    # the vocab dim over 'tp', so the seq dim must come off it (the SP
-    # gather the reference places before parallel_lm_logits,
-    # ref: language_model.py:24-53 + mappings.py:191-230)
-    x = constrain(x, ("batch", "seq", "act_embed"))
-
-    if cfg.tie_embed_logits:
-        w_out = params["embedding"]["word_embeddings"].T
-    else:
-        w_out = params["lm_head"]
-    logits = (x @ w_out.astype(compute_dtype)).astype(logits_dtype)
-    return constrain(logits, ("batch", "seq", "vocab")), kv_caches
+    # final norm + SP gather + vocab-parallel head: ONE implementation
+    # shared with both pp schedules (head_logits below)
+    return head_logits(params, x, cfg, logits_dtype=logits_dtype), kv_caches
 
 
-def head_logits(params, x, cfg: ModelConfig, *, mb_axis: bool = False):
+def head_logits(params, x, cfg: ModelConfig, *, mb_axis: bool = False,
+                logits_dtype=jnp.float32):
     """Final norm + (tied/untied) LM head with SP-aware sharding hints —
-    the single implementation behind both pipelined tails (the lockstep
-    pipeline's post-shard_map head and the 1F1B per-microbatch head), so
-    pp schedules cannot drift from each other. `mb_axis` adds the leading
-    'microbatch' logical axis used when the head work is spread over 'pp'.
-    """
+    the single implementation behind the sequential forward AND both
+    pipelined tails (the lockstep pipeline's post-shard_map head and the
+    1F1B per-microbatch head), so execution schedules cannot drift.
+    `mb_axis` adds the leading 'microbatch' logical axis used when the
+    head work is spread over 'pp'. The seq constrain is the SP gather the
+    reference places before parallel_lm_logits (ref: language_model.py:
+    24-53 + mappings.py:191-230): logits shard vocab over 'tp', so the
+    seq dim must come off it."""
     from megatron_tpu.config import as_dtype
     compute_dtype = as_dtype(cfg.compute_dtype)
     pre = ("microbatch",) if mb_axis else ()
@@ -161,7 +155,7 @@ def head_logits(params, x, cfg: ModelConfig, *, mb_axis: bool = False):
         w_out = params["embedding"]["word_embeddings"].T
     else:
         w_out = params["lm_head"]
-    logits = (x @ w_out.astype(compute_dtype)).astype(jnp.float32)
+    logits = (x @ w_out.astype(compute_dtype)).astype(logits_dtype)
     return constrain(logits, pre + ("batch", "seq", "vocab"))
 
 

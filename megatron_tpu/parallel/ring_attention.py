@@ -134,9 +134,15 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True,
     # partial-manual regions; ring K/V in compute dtype on TPU only
     ring_dtype = q.dtype if on_tpu else jnp.float32
 
+    flash_zigzag_ok = (impl == "flash"
+                       or (impl == "auto" and on_tpu
+                           and _flash_ok(s_loc // 2)))
     if layout == "auto":
+        # zigzag only pays off when the flash path SKIPS masked pairs; the
+        # XLA fallback masks inside full-score blocks (already balanced),
+        # so the in/out permutation gathers would be pure overhead there
         layout = ("zigzag" if causal and S % (2 * cp) == 0
-                  else "contiguous")
+                  and flash_zigzag_ok else "contiguous")
     zigzag = layout == "zigzag" and causal
     if zigzag:
         assert S % (2 * cp) == 0, (

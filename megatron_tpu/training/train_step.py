@@ -322,6 +322,9 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True,
             assert cfg.parallel.virtual_pipeline_chunks == 1, (
                 "pipelined_spec models (BERT-family) support vpp=1 only; "
                 "drop --num_layers_per_virtual_pipeline_stage")
+            assert cfg.parallel.pipeline_schedule == "1f1b", (
+                "pipelined_spec models run the 1F1B core only; drop "
+                "--pipeline_schedule gpipe")
             fn = functools.partial(custom_pipelined_train_step, cfg=cfg,
                                    mesh=mesh, spec=pipelined_spec,
                                    wd_mask=wd_mask)

@@ -1,0 +1,162 @@
+"""MSDP preprocessing on tiny WoW/WoI fixtures
+(ref: tasks/msdp/preprocessing.py five-stage pipeline)."""
+import json
+
+import numpy as np
+import pytest
+
+from tasks.msdp import preprocessing as pp
+
+
+@pytest.fixture()
+def wow_raw(tmp_path):
+    data = [{
+        "chosen_topic": "Coffee",
+        "dialog": [
+            {"speaker": "0_Apprentice", "text": "I love coffee",
+             "checked_sentence": {}, "checked_passage": {}},
+            {"speaker": "1_Wizard",
+             "text": "Coffee is brewed from roasted beans",
+             "checked_sentence": {
+                 "s1": "Coffee is a brewed drink from roasted beans."},
+             "checked_passage": {"p1": "Coffee"}},
+            {"speaker": "0_Apprentice", "text": "Where is it grown?",
+             "checked_sentence": {}, "checked_passage": {}},
+            {"speaker": "1_Wizard", "text": "Mostly in the tropics",
+             "checked_sentence": {}, "checked_passage": {}},
+        ],
+    }]
+    path = tmp_path / "wow.json"
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_process_wow(tmp_path, wow_raw):
+    out = tmp_path / "proc.tsv"
+    knwl = tmp_path / "knwl.txt"
+    resp = tmp_path / "resp.txt"
+    n = pp.process_wow_dataset(wow_raw, str(out), str(knwl), str(resp))
+    assert n == 2
+    rows = [line.split("\t") for line in out.read_text().splitlines()]
+    assert rows[0][0] == "Coffee"
+    assert rows[0][2].startswith("Coffee is a brewed drink")
+    assert "I love coffee." in rows[0][1]
+    # second wizard turn had no checked sentence -> sentinel + chosen topic
+    assert rows[1][2] == pp.NO_KNOWLEDGE
+    assert rows[1][0] == "Coffee"
+    # context accumulates all prior turns
+    assert rows[1][1].count(" [SEP] ") == 2
+    assert len(knwl.read_text().splitlines()) == 2
+    assert len(resp.read_text().splitlines()) == 2
+
+
+def test_process_woi(tmp_path):
+    record = {"d1": {"dialog_history": [
+        {"action": "Apprentice => Wizard", "text": "Tell me about pandas"},
+        {"action": "Wizard => SearchAgent", "text": "panda habitat"},
+        {"action": "Wizard => Apprentice", "text": "Sure thing",
+         "context": {"contents": [], "selected_contents": [[True]]}},
+        {"action": "Wizard => Apprentice",
+         "text": "Pandas live in bamboo forests",
+         "context": {
+             "contents": [{"content": ["Pandas eat bamboo.",
+                                       "Pandas live in China."]}],
+             "selected_contents": [[False], [False, True]]}},
+    ]}}
+    raw = tmp_path / "woi.jsonl"
+    raw.write_text(json.dumps(record) + "\n")
+    out = tmp_path / "proc.tsv"
+    n = pp.process_woi_dataset(str(raw), str(out))
+    # the apprentice opens, so BOTH wizard turns emit: the first with the
+    # no-knowledge sentinel, the second with the selected sentence
+    assert n == 2
+    rows = [line.split("\t") for line in out.read_text().splitlines()]
+    assert rows[0][0] == "no_topic" and rows[0][2] == pp.NO_KNOWLEDGE
+    assert rows[1][0] == "panda habitat"
+    assert rows[1][2] == "Pandas live in China."
+    assert rows[1][3].startswith("Pandas live in bamboo forests")
+
+
+def _toy_tsv(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write("\t".join(r) + "\n")
+
+
+def _hash_encode(texts):
+    """Deterministic toy encoder: bag-of-words feature hashing."""
+    out = np.zeros((len(texts), 32), np.float32)
+    for i, t in enumerate(texts):
+        for w in t.lower().split():
+            out[i, hash(w) % 32] += 1.0
+    norms = np.linalg.norm(out, axis=1, keepdims=True)
+    return out / np.maximum(norms, 1e-6)
+
+
+def test_knowledge_prompt_selection(tmp_path):
+    train = tmp_path / "train.tsv"
+    test = tmp_path / "test.tsv"
+    _toy_tsv(train, [
+        ["Coffee", "do you like coffee [SEP] yes I do",
+         "Coffee contains caffeine which is Coffee related", "resp a"],
+        ["Coffee", "how is coffee made",
+         "Coffee is brewed from Coffee beans", "resp b"],
+        ["Tea", "tell me about tea", "Tea is made from Tea leaves",
+         "resp c"],
+    ])
+    _toy_tsv(test, [
+        ["Coffee", "what about coffee then", "gold", "gold resp"],
+        ["Space", "what about rockets", "gold", "gold resp"],
+    ])
+    out = tmp_path / "prompts.jsonl"
+    n = pp.prompt_selection_for_knowledge_generation(
+        str(test), str(train), None, str(out), "wow_seen",
+        encode_fn=_hash_encode, n_prompts=2)
+    assert n == 2
+    lines = [json.loads(line) for line in out.read_text().splitlines()]
+    # seen topic: prompts drawn from the Coffee pool
+    (key1, prompts1), = lines[0].items()
+    assert key1.startswith("Coffee")
+    assert all("=>" in p for p in prompts1)
+    # unseen topic: one prompt per distinct topic
+    (_, prompts2), = lines[1].items()
+    assert len(prompts2) == 2
+
+
+def test_response_prompt_selection(tmp_path):
+    knowledge = ("the great wall of china is a series of fortifications "
+                 "built across the northern borders")
+    quoting = ("I read that " + knowledge + " which amazed me")
+    train = tmp_path / "train.tsv"
+    _toy_tsv(train, [
+        ["Wall", "ctx [SEP] last turn", knowledge, quoting],
+        ["Wall", "ctx", knowledge, "Unrelated response entirely."],
+        ["Wall", "ctx", pp.NO_KNOWLEDGE, "whatever"],
+    ])
+    out = tmp_path / "prompts.txt"
+    n = pp.prompt_selection_for_response_generation(str(train), str(out),
+                                                    seed=0)
+    assert n == 1  # only the quoting row passes the overlap window
+    (line,) = out.read_text().splitlines()
+    assert line.startswith("Topic: Wall.")
+    assert "We know that:" in line and "System replies:" in line
+
+
+def test_prepare_input(tmp_path):
+    test = tmp_path / "test.tsv"
+    _toy_tsv(test, [["T", "ctx", "gold knowledge", "resp"]])
+    gen = tmp_path / "gen.txt"
+    gen.write_text("generated knowledge<|endoftext|>\n")
+    out = tmp_path / "merged.tsv"
+    assert pp.prepare_input_for_response_generation(
+        str(test), str(gen), str(out)) == 1
+    (row,) = [line.split("\t") for line in out.read_text().splitlines()]
+    assert row[2] == "generated knowledge"
+    assert row[3] == "resp"
+
+
+def test_cli_dispatch(tmp_path, wow_raw):
+    out = tmp_path / "cli.tsv"
+    assert pp.main(["--func", "process_wow_dataset", "--raw_file", wow_raw,
+                    "--processed_file", str(out)]) == 0
+    assert len(out.read_text().splitlines()) == 2

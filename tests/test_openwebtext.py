@@ -1,0 +1,170 @@
+"""openwebtext corpus-cleaning suite on tiny fixtures
+(ref: tools/openwebtext/*.py pipeline: blacklist -> cleanup -> find/group/
+remove duplicates -> ngram decontamination)."""
+import json
+
+import numpy as np
+import pytest
+
+from tools.openwebtext import (add_id, blacklist_urls, cleanup_dataset,
+                               cleanup_fix_dataset, filter_ngrams,
+                               find_duplicates, group_duplicate_url,
+                               merge_jsons, owt_utils,
+                               remove_group_duplicates)
+
+ENGLISH = ("The quick brown fox jumps over the lazy dog and then the dog "
+           "chases the fox around the big old barn for a while. " * 20)
+
+
+def write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_blacklist_urls(tmp_path):
+    urls = tmp_path / "urls.txt"
+    urls.write_text("\n".join([
+        "https://example.com/article/one.html",
+        "https://imgur.com/gallery/abc",          # blacklisted domain
+        "https://sub.youtube.com/watch?v=1",      # subdomain of blacklisted
+        "https://news.site.co.uk/story",          # two-level suffix ok
+        "https://example.com/photo.jpg",          # blacklisted extension
+        "ftp://example.com/file",                 # non-http
+        "not a url at all",
+    ]) + "\n")
+    out = tmp_path / "clean.txt"
+    kept, dropped = blacklist_urls.filter_urls(str(urls), str(out))
+    clean = out.read_text().splitlines()
+    assert kept == 2 and dropped == 5
+    assert "https://example.com/article/one.html" in clean
+    assert "https://news.site.co.uk/story" in clean
+
+
+def test_registered_domain():
+    rd = owt_utils.registered_domain
+    assert rd("https://a.b.example.com/x") == "example"
+    assert rd("https://www.example.co.uk/x") == "example"
+    assert rd("http://imgur.com") == "imgur"
+
+
+def test_cleanup_dataset(tmp_path):
+    inp = tmp_path / "raw.jsonl"
+    write_jsonl(inp, [
+        {"text": ENGLISH, "url": "u1"},
+        {"text": "Ceci nâest pas anglais. " * 100, "url": "u2"},  # non-en
+        # clearly English but under 128 tokens -> dropped as small
+        {"text": "The dog and the cat like to read the news in the "
+                 "morning with a cup of tea. " * 5, "url": "u3"},
+        # cp1252-visible mojibake for "It's" (curly apostrophe double-
+        # encoded): â€™ == "â€™"
+        {"text": "It\u00e2\u20ac\u2122s broken mojibake text. " + ENGLISH,
+         "url": "u4"},
+    ])
+    out = tmp_path / "clean.jsonl"
+    stats = cleanup_dataset.clean_corpus(str(inp), str(out))
+    recs = read_jsonl(out)
+    kept_urls = {r["url"] for r in recs}
+    assert kept_urls == {"u1", "u4"}
+    assert stats["non_english"] == 1 and stats["small"] == 1
+    # mojibake repaired: the cp1252 round-trip restores the real curly
+    # apostrophe (U+2019)
+    (u4,) = [r for r in recs if r["url"] == "u4"]
+    assert "\u00e2" not in u4["text"] and "It\u2019s" in u4["text"]
+
+
+def test_cleanup_fix_dataset(tmp_path):
+    inp = tmp_path / "raw.jsonl"
+    write_jsonl(inp, [
+        {"text": "tiny", "url": "a"},
+        {"text": "Please enable javascript to view this page.", "url": "b"},
+        {"text": ENGLISH + "!!!!!!!!!!!!", "url": "c"},
+    ])
+    kept_f = tmp_path / "kept.jsonl"
+    drop_f = tmp_path / "dropped.jsonl"
+    stats = cleanup_fix_dataset.process_files(
+        [str(inp)], str(kept_f), str(drop_f),
+        ["remove_512", "general_cleaning"])
+    assert stats["remove_512"] == 2 and stats["written"] == 1
+    (c,) = read_jsonl(kept_f)
+    assert "!!!!" not in c["text"]  # punctuation run collapsed
+
+
+def test_duplicate_pipeline(tmp_path):
+    """find -> group -> remove end-to-end: near-duplicates detected, one
+    keeper per group survives."""
+    base = ENGLISH
+    near = base.replace("lazy", "sleepy")   # ~identical shingles
+    other = ("Completely different content about astronomy, telescopes "
+             "and the motion of planets across the night sky. " * 25)
+    corpus = tmp_path / "corpus.jsonl"
+    write_jsonl(corpus, [
+        {"text": base, "url": "u1"},
+        {"text": near, "url": "u2"},
+        {"text": other, "url": "u3"},
+    ])
+    dups = tmp_path / "dups.jsonl"
+    n = find_duplicates.find_duplicates([(str(corpus), "url")], str(dups))
+    assert n == 1
+    groups = tmp_path / "groups.jsonl"
+    assert group_duplicate_url.group_urls(str(dups), str(groups), 0.7) == 1
+    out = tmp_path / "dedup.jsonl"
+    written, removed = remove_group_duplicates.remove_duplicates(
+        str(groups), str(corpus), str(out))
+    assert removed == 1 and written == 2
+    urls = {r["url"] for r in read_jsonl(out)}
+    assert "u3" in urls and len(urls & {"u1", "u2"}) == 1
+
+
+def test_minhash_similarity_tracks_jaccard():
+    h = owt_utils.MinHasher(num_perm=256)
+    a, b = ENGLISH, ENGLISH.replace("dog", "cat")
+    fa, fb = h.fingerprint(a), h.fingerprint(b)
+    est = float(np.mean(fa == fb))
+    true = owt_utils.jaccard(owt_utils.shingles(a), owt_utils.shingles(b))
+    assert abs(est - true) < 0.15
+
+
+def test_filter_ngrams(tmp_path):
+    """A training doc containing a task 13-gram is split with the match
+    and 200 chars each side removed; clean docs pass through."""
+    secret = ("the secret answer to this very particular question is "
+              "exactly forty two units")  # 13 words
+    assert len(secret.split()) == 13
+    task = tmp_path / "task.jsonl"
+    write_jsonl(task, [{"text": secret}])
+    contaminated = ENGLISH + " " + secret + " " + ENGLISH
+    train = tmp_path / "train.jsonl"
+    write_jsonl(train, [
+        {"text": contaminated, "url": "bad"},
+        {"text": ENGLISH, "url": "good"},
+    ])
+    out = tmp_path / "out.jsonl"
+    grams = filter_ngrams.task_ngrams("lambada", str(task), 13)
+    stats = filter_ngrams.filter_corpus(str(train), "text", str(out), grams)
+    assert stats["split"] == 1
+    recs = read_jsonl(out)
+    for r in recs:
+        assert "secret answer" not in r["text"]
+    # the clean doc is untouched
+    assert any(r["url"] == "good" and r["text"] == ENGLISH for r in recs)
+    # fragments keep provenance
+    assert sum(r["url"] == "bad" for r in recs) == 2
+
+
+def test_add_id_and_merge(tmp_path):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    write_jsonl(a, [{"text": "one"}])
+    write_jsonl(b, [{"text": "two"}, {"text": "three"}])
+    merged = tmp_path / "merged.jsonl"
+    assert merge_jsons.merge(str(tmp_path), str(merged)) == 3
+    withid = tmp_path / "withid.jsonl"
+    assert add_id.add_ids(str(merged), str(withid), "owt") == 3
+    recs = read_jsonl(withid)
+    assert [r["id"] for r in recs] == ["owt-0", "owt-1", "owt-2"]
