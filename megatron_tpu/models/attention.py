@@ -139,6 +139,7 @@ def attention_apply(
     segment_ids=None,
     causal: bool = True,
     kv_input=None,
+    cp_pre_zigzag: bool = False,
 ):
     """Forward pass. x: [b, s, h]. Returns (out [b, s, h], new_kv_cache).
 
@@ -192,8 +193,17 @@ def attention_apply(
     # (attention_softmax_in_fp32), so the trick is unnecessary and the flag
     # intentionally has no numerical effect.
 
-    if (cfg.attention_impl in ("ring", "ulysses") and kv_cache is None
-            and segment_ids is None and causal):
+    ring_branch = (cfg.attention_impl in ("ring", "ulysses")
+                   and kv_cache is None and segment_ids is None and causal)
+    # a pre-permuted batch MUST reach the ring path: any gating drift
+    # between data_zigzag_cp (which told the loss to permute) and this
+    # dispatch would apply causal masks to the wrong rows and silently
+    # diverge — fail at trace time instead
+    assert not cp_pre_zigzag or (ring_branch
+                                 and cfg.attention_impl == "ring"), (
+        "cp_pre_zigzag=True but the ring-attention path is not taken "
+        "(data_zigzag_cp and attention_apply gating drifted)")
+    if ring_branch:
         # context-parallel attention over the 'cp' mesh axis (absent in
         # the reference — SURVEY.md §2.8): K/V-rotation ring
         # (parallel/ring_attention.py) or all-to-all head-parallel Ulysses
@@ -207,9 +217,16 @@ def attention_apply(
             else:
                 from megatron_tpu.parallel.ring_attention import \
                     ring_attention
-                out = ring_attention(q, k, v, mesh, causal=True,
-                                     scale=scale)
+                # cp_pre_zigzag: the loss pre-permuted the batch into
+                # zigzag order (data_zigzag_cp), so the ring skips its
+                # runtime permute-gathers
+                out = ring_attention(
+                    q, k, v, mesh, causal=True, scale=scale,
+                    layout="pre_zigzag" if cp_pre_zigzag else "auto")
         else:
+            assert not cp_pre_zigzag, (
+                "cp_pre_zigzag=True but no 'cp' mesh is ambient — the "
+                "batch was permuted for a ring that will not run")
             from megatron_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True, scale=scale)
     elif cfg.attention_impl == "flash" and kv_cache is None and segment_ids is None:

@@ -96,8 +96,13 @@ def model_forward(
     deterministic: bool = True,
     logits_dtype=jnp.float32,
     segment_ids=None,
+    cp_pre_zigzag: bool = False,
 ):
-    """Forward to logits [b, s, padded_vocab]. Returns (logits, kv_caches)."""
+    """Forward to logits [b, s, padded_vocab]. Returns (logits, kv_caches).
+
+    `cp_pre_zigzag`: the caller pre-permuted tokens/positions into the
+    ring-cp zigzag order (see loss_fn / parallel/ring_attention.py
+    data_zigzag_cp) — logits come back in the SAME permuted order."""
     from megatron_tpu.config import as_dtype
     compute_dtype = as_dtype(cfg.compute_dtype)
     emb = params["embedding"]["word_embeddings"]
@@ -127,7 +132,8 @@ def model_forward(
         rope_cos=rope.cos if rope else None,
         rope_sin=rope.sin if rope else None,
         position_ids=position_ids, kv_caches=kv_caches,
-        rng=rng, deterministic=deterministic, segment_ids=segment_ids)
+        rng=rng, deterministic=deterministic, segment_ids=segment_ids,
+        cp_pre_zigzag=cp_pre_zigzag)
 
     # final norm + SP gather + vocab-parallel head: ONE implementation
     # shared with both pp schedules (head_logits below)
@@ -179,10 +185,33 @@ def loss_fn(
         inputs, labels = tokens[:, :-1], tokens[:, 1:]
         if loss_mask is not None and loss_mask.shape[1] == tokens.shape[1]:
             loss_mask = loss_mask[:, 1:]
+
+    # ring-cp zigzag: permute the batch ONCE here (ints + mask — cheap)
+    # so ring attention skips its per-call q/k/v/out permute-gathers. The
+    # masked-mean loss is permutation-invariant because labels and mask
+    # ride the same permutation; RoPE/positions stay correct because the
+    # permuted position_ids carry the original positions.
+    from megatron_tpu.parallel.ring_attention import (data_zigzag_cp,
+                                                      zigzag_permutation)
+    cp = data_zigzag_cp(cfg, inputs.shape[1], segment_ids=segment_ids)
+    pre_zigzag = cp > 0
+    if pre_zigzag:
+        perm, _ = zigzag_permutation(inputs.shape[1], cp)
+        if position_ids is None:
+            position_ids = jnp.broadcast_to(
+                jnp.arange(inputs.shape[1], dtype=jnp.int32),
+                inputs.shape)
+        inputs = inputs[:, perm]
+        labels = labels[:, perm]
+        position_ids = position_ids[:, perm]
+        if loss_mask is not None:
+            loss_mask = loss_mask[:, perm]
+
     logits, _ = model_forward(params, inputs, cfg, rope=rope, rng=rng,
                               deterministic=deterministic,
                               position_ids=position_ids,
-                              segment_ids=segment_ids)
+                              segment_ids=segment_ids,
+                              cp_pre_zigzag=pre_zigzag)
     losses = cross_entropy_loss(logits, labels, vocab_size=cfg.vocab_size)
     if loss_mask is None:
         return jnp.mean(losses)

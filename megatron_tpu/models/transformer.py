@@ -111,6 +111,7 @@ def layer_apply(
     segment_ids=None,
     causal: bool = True,
     encoder_output=None,
+    cp_pre_zigzag: bool = False,
 ):
     """One transformer layer. x: [b, s, h]. Returns (x, kv_cache).
 
@@ -155,7 +156,8 @@ def layer_apply(
         rope_cos=rope_cos, rope_sin=rope_sin, position_ids=position_ids,
         kv_cache=kv_cache, layer_number=layer_number,
         dropout_rng=r_score, deterministic=deterministic,
-        segment_ids=segment_ids, causal=causal)
+        segment_ids=segment_ids, causal=causal,
+        cp_pre_zigzag=cp_pre_zigzag)
 
     if cfg.parallel_attn:
         # Falcon block: no dropout-add after attention
@@ -241,6 +243,7 @@ def stack_apply(
     segment_ids=None,
     causal: bool = True,
     encoder_output=None,
+    cp_pre_zigzag: bool = False,
 ):
     """Apply all (or a pipeline stage's worth of) layers via lax.scan.
 
@@ -267,7 +270,8 @@ def stack_apply(
             drop_path_rate=dp_rate if use_drop_path else None,
             rng=layer_rng,
             deterministic=deterministic, segment_ids=segment_ids,
-            causal=causal, encoder_output=encoder_output)
+            causal=causal, encoder_output=encoder_output,
+            cp_pre_zigzag=cp_pre_zigzag)
         return h, new_cache
 
     if cfg.recompute_granularity == "full":

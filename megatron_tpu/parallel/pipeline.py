@@ -44,12 +44,25 @@ vpp layer offsets; megatron/training.py:204-219). Mapping:
 - *Bubble*: 1F1B runs T = n_micro + 2(pp-1) ticks of (1 fwd + 1 bwd) work
   — bubble fraction 2(pp-1)/T, the reference 1F1B's (schedules.py diagram).
   The lockstep path's fill-drain fraction is (pp*vpp - 1)/(n_micro+pp*vpp-1)
-  per pass. NOTE an honest divergence from the reference: in the lockstep
-  formulation virtual stages do NOT shrink the bubble the way async
-  interleaved 1F1B does (every stage already runs all its chunks every
-  tick); vpp>1 here provides the reference's interleaved layer->stage
-  assignment (checkpoint-layout parity, memory balance) while the bubble
-  lever is n_micro, which the 1F1B memory bound makes cheap to raise.
+  per pass. NOTE an honest divergence from the reference: interleaved
+  virtual stages CANNOT shrink the bubble in any jit-lockstep formulation,
+  and the reason is structural, not an implementation gap. The reference's
+  interleave win (bubble/vpp, schedules.py:253-502) comes from ASYNC unit
+  ordering — during warmup a rank runs forward chunk-units back-to-back,
+  unconstrained by backward slots. A single jitted SPMD program must give
+  every stage the identical per-tick op sequence (stages taking different
+  fwd-vs-bwd branches would execute divergent collective sequences — the
+  deadlock class the 1F1B tick body is explicitly branch-free to avoid),
+  so every tick carries a uniform fwd-slot + bwd-slot pair; idle masked
+  slots take the same wall time, and the warmup's dead bwd slots exactly
+  cancel the interleave gain (worked example: pp=2 vpp=2 n_micro=4 gives
+  8 idle chunk-slots either way). vpp>1 therefore provides the
+  reference's interleaved layer->stage ASSIGNMENT (checkpoint-layout
+  parity, memory balance) via the lockstep schedule, while the bubble
+  lever on TPU is n_micro — which the 1F1B memory bound makes cheap to
+  raise (live bytes are flat in n_micro, so gbs-1000-style runs at
+  n_micro >> pp are the intended operating point, shrinking the bubble
+  fraction 2(pp-1)/(n_micro+2(pp-1)) arbitrarily).
 - *Embedding/LM-head*: the tied embedding is one parameter used inside the
   shard_map (stage-0 intake) and outside (head); its gradient contributions
   meet automatically under GSPMD — the reference needs an explicit

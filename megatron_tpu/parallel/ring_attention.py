@@ -28,10 +28,14 @@ rank's useful pair count becomes exactly 2cp+1 chunk-pairs (r+1 for the
 head chunk + 2cp-r for the tail chunk), equal by construction —
 `zigzag_pair_counts` asserts this and the flash path's per-pair
 lax.switch SKIPS fully-masked pairs so balanced schedule = balanced
-compute. The permutation in/out of zigzag order happens OUTSIDE the
-shard_map (GSPMD lowers it to a pairwise exchange); integrating the
-permutation into the data loader (tokens pre-permuted, loss
-permutation-invariant) would make it free and is the planned follow-up.
+compute. Two zigzag modes: layout="zigzag" permutes q/k/v in and the
+output back out OUTSIDE the shard_map (GSPMD lowers it to a pairwise
+exchange per call); layout="pre_zigzag" declares the batch ALREADY
+permuted — lm.loss_fn does that once per batch via `data_zigzag_cp` +
+`zigzag_permutation` (tokens/labels/mask/positions ride the same
+permutation; the masked-mean loss is permutation-invariant), making the
+ring's data movement zero. The pipelined (pp>1) chunk path does not
+pre-permute yet and uses the runtime-permute mode.
 """
 from __future__ import annotations
 
@@ -86,6 +90,30 @@ def zigzag_permutation(S: int, cp: int):
     return perm, inv
 
 
+def data_zigzag_cp(cfg, seq_len: int, *, causal: bool = True,
+                   segment_ids=None) -> int:
+    """cp when DATA-LEVEL zigzag applies (loss permutes tokens/labels/mask
+    once; ring attention then skips its 4 runtime permute-gathers per
+    call), else 0. Conditions: ring attention will actually run (ambient
+    mesh has cp>1), causal, no segment path, and 2*cp divides the
+    sequence. The loss is permutation-invariant as long as labels and
+    mask ride the same permutation, and RoPE stays correct because the
+    permuted position_ids carry the ORIGINAL positions."""
+    if getattr(cfg, "attention_impl", None) != "ring" or not causal \
+            or segment_ids is not None:
+        return 0
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+    except Exception:
+        return 0
+    if mesh.empty or "cp" not in mesh.axis_names:
+        return 0
+    cp = mesh.shape["cp"]
+    if cp <= 1 or seq_len % (2 * cp) != 0:
+        return 0
+    return cp
+
+
 def zigzag_pair_counts(cp: int):
     """Useful (non-fully-masked) chunk-pairs per rank under the zigzag
     schedule — equal across ranks by construction (the balance assert)."""
@@ -116,7 +144,9 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True,
     impl: "flash" forces the Pallas inner block (interpret mode off-TPU),
     "xla" forces the einsum fallback, "auto" picks flash on TPU when the
     local shard length tiles. layout: "zigzag" balances causal work across
-    ranks (module docstring), "contiguous" is the plain split, "auto"
+    ranks (module docstring), "contiguous" is the plain split,
+    "pre_zigzag" declares the data ALREADY in zigzag order (loss-level
+    pre-permutation via data_zigzag_cp — no runtime permutes), "auto"
     picks zigzag for causal when S divides 2·cp. Must run under jit with
     the ambient mesh set (same contract as the pipeline shard_map)."""
     cp = mesh.shape[axis]
@@ -143,7 +173,7 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True,
         # so the in/out permutation gathers would be pure overhead there
         layout = ("zigzag" if causal and S % (2 * cp) == 0
                   and flash_zigzag_ok else "contiguous")
-    zigzag = layout == "zigzag" and causal
+    zigzag = layout in ("zigzag", "pre_zigzag") and causal
     if zigzag:
         assert S % (2 * cp) == 0, (
             f"zigzag layout needs seq {S} divisible by 2*cp={2 * cp} "
@@ -155,7 +185,8 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True,
     else:
         use_flash = impl == "flash"
 
-    if zigzag:
+    runtime_permute = zigzag and layout != "pre_zigzag"
+    if runtime_permute:
         perm, inv = zigzag_permutation(S, cp)
         q, k, v = q[:, perm], k[:, perm], v[:, perm]
 
@@ -269,6 +300,6 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True,
         axis_names={axis},
     )
     out = shmap(q, k, v)
-    if zigzag:
+    if runtime_permute:
         out = out[:, inv]
     return out
