@@ -85,6 +85,11 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                    help="pp execution schedule: 1f1b bounds per-stage "
                         "memory by pp; gpipe is the lockstep fallback "
                         "(required for vpp>1 interleaving)")
+    g.add_argument("--pipeline_store_activations", action="store_true",
+                   help="1F1B: carry forward vjp residuals instead of "
+                        "recomputing chunk forwards in the backward slot "
+                        "(the reference's no-recompute default; ~1/3 less "
+                        "pipeline compute, more memory)")
     g.add_argument("--sequence_parallel", action="store_true")
     g.add_argument("--use_distributed_optimizer", action="store_true")
     g.add_argument("--context_parallel_algo", type=str, default="ring",
@@ -423,6 +428,7 @@ def config_from_args(args: argparse.Namespace,
             sequence_parallel=args.sequence_parallel,
             virtual_pipeline_chunks=vpp,
             pipeline_schedule=args.pipeline_schedule,
+            pipeline_store_activations=args.pipeline_store_activations,
             use_distributed_optimizer=args.use_distributed_optimizer,
         ),
         optimizer=OptimizerConfig(**_pick(args, OptimizerConfig)),

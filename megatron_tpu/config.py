@@ -175,6 +175,14 @@ class ParallelConfig:
     # "gpipe" = lockstep fill-drain with autodiff-derived backward (memory
     # grows with n_micro; required for vpp>1 interleaving)
     pipeline_schedule: str = "1f1b"
+    # 1F1B backward sourcing: False (default) stashes chunk INPUTS and
+    # recomputes each chunk forward in the backward slot (the reference's
+    # --recompute-granularity=full under 1F1B — lowest memory); True
+    # carries the forward vjp RESIDUALS instead (the reference's
+    # no-recompute default — ~1/3 less pipeline compute, memory grows to
+    # the in-flight residual footprint; pair with
+    # recompute_granularity="none"/"selective")
+    pipeline_store_activations: bool = False
     # ZeRO-1-style optimizer state sharding over dp (ref: optimizer/distrib_optimizer.py)
     use_distributed_optimizer: bool = False
 
@@ -352,6 +360,17 @@ class MegatronConfig:
                 "using the lockstep 'gpipe' schedule (per-stage activation "
                 "memory grows with num_microbatches)")
             par = dataclasses.replace(par, pipeline_schedule="gpipe")
+        if par.pipeline_store_activations and \
+                par.pipeline_schedule != "1f1b":
+            # AFTER the vpp demotion above so a demoted run drops the
+            # flag loudly too
+            from megatron_tpu.utils.logging import print_rank_0
+            print_rank_0(
+                "warning: --pipeline_store_activations only applies to "
+                "the 1f1b schedule; ignoring it for "
+                f"pipeline_schedule={par.pipeline_schedule!r}")
+            par = dataclasses.replace(par,
+                                      pipeline_store_activations=False)
         gbs = tr.global_batch_size
         if gbs is None:
             dp = par.data_parallel or (par.derive_dp(n_devices) if n_devices else 1)

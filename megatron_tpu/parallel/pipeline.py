@@ -318,10 +318,28 @@ def pipeline_train_1f1b(
     batch_shape,       # (b, s) of one microbatch's activations
     rng=None,
     cotangent_seed: float = 1.0,
+    store_activations: bool = False,
 ):
     """One-forward-one-backward pipeline schedule with hand-written backward
     (ref: megatron/schedules.py:606-722 forward_backward_pipelining_without_
     interleaving). Returns (mean_microbatch_loss, grads).
+
+    `store_activations=False` (default): the stash holds chunk INPUTS and
+    the backward slot recomputes its chunk forward inside a same-tick vjp
+    — the reference's --recompute-granularity=full under 1F1B.
+    `store_activations=True`: the forward slot's vjp RESIDUALS are carried
+    instead (the reference's no-recompute default): each tick's vjp
+    closure is flattened to leaves, leaves that are identity-passthrough
+    params are dropped (they are loop-invariant — stashing them would
+    materialize 2pp-1 copies of the stage weights), the rest ride a
+    per-leaf circular stash, and the backward slot rebuilds the closure
+    with the live params. Removes the per-tick chunk recompute (~1/3 of
+    pipeline compute) at the cost of holding each in-flight microbatch's
+    chunk residuals; pair it with recompute_granularity="selective"/"none"
+    (with "full", the per-layer rematerialization happens inside the vjp
+    anyway and storing residuals buys nothing). The head is
+    jax.checkpoint-ed in this mode so logits-sized CE residuals never
+    enter the stash.
 
     Why not jax.grad of the lockstep schedule: reverse-mode differentiates
     the whole T-tick scan, so every microbatch's stage-boundary activation
@@ -334,12 +352,16 @@ def pipeline_train_1f1b(
       (the cotangent for mb j reaches stage s exactly then: fwd arrives at
       the last stage at tick pp-1+j, turns around same-tick, and rides the
       reverse ring one stage per tick)
-    - the ONLY cross-tick activation state is a circular stash of chunk
-      INPUTS, depth D = 2pp-1 (the widest in-flight window, at stage 0) —
-      live bytes are flat in n_micro at fixed pp, the 1F1B memory bound.
-    - the backward micro-step recomputes its chunk forward from the stashed
-      input inside a same-tick jax.vjp (the reference's
-      --recompute-granularity=full under 1F1B); residuals never cross ticks.
+    - the ONLY cross-tick activation state is a circular stash of depth
+      D = 2pp-1 (the widest in-flight window, at stage 0) — live bytes
+      are flat in n_micro at fixed pp, the 1F1B memory bound. What the
+      stash HOLDS depends on `store_activations` (below): chunk inputs
+      (default) or the forward vjp residuals.
+    - default mode: the backward micro-step recomputes its chunk forward
+      from the stashed input inside a same-tick jax.vjp (the reference's
+      --recompute-granularity=full under 1F1B); residuals never cross
+      ticks. Store mode: no recompute — residuals cross ticks in the
+      stash instead.
     - total ticks T = n_micro + 2(pp-1) with one fwd + one bwd slot each,
       vs the derived lockstep's (n_micro + pp - 1) fwd ticks + as many
       derived bwd ticks — same steady-state compute, pp-bounded memory.
@@ -380,6 +402,59 @@ def pipeline_train_1f1b(
         def mb_rng(i):
             return jax.random.fold_in(rng, i) if rng is not None else None
 
+        def combined_f(sl, rng_m):
+            """(chunk -> checkpointed head) as one vjp target returning
+            (boundary h_out, per-mb loss)."""
+            def f(cp, sp, h):
+                h_out = chunk_fn(cp, h.astype(compute_dtype), sl,
+                                 offset, rng_m)
+                loss = jax.checkpoint(
+                    lambda sp_, ho: head_loss_fn(sp_, ho, sl, rng_m),
+                    prevent_cse=False)(sp, h_out)
+                return h_out.astype(boundary_dtype), loss
+            return f
+
+        param_like = [chunk_p, shared_p]  # +chunk_p_v in store mode below
+
+        def split_vjp_leaves(vjp_fn):
+            """Flatten a vjp closure, separating identity-passthrough
+            param leaves (loop-invariant — never stashed) from true
+            residuals."""
+            leaves, treedef = jax.tree.flatten(vjp_fn)
+            param_ids = {id(l) for l in jax.tree.leaves(param_like)}
+            is_param = [id(l) in param_ids for l in leaves]
+            resid = [l for l, p in zip(leaves, is_param) if not p]
+            return leaves, treedef, is_param, resid
+
+        if store_activations:
+            # Pre-cast the chunk params to compute dtype ONCE, outside the
+            # scan: every in-model `w.astype(compute_dtype)` then hits the
+            # dtype-equal fast path (convert_element_type returns its
+            # operand unchanged), so the casted weights stay
+            # identity-passthrough leaves and the id() dedup excludes them
+            # from the stash. Without this, bf16 compute would stash
+            # 2pp-1 bf16 COPIES of every stage weight (the cast creates a
+            # new value the dedup cannot recognize). Numerics are
+            # unchanged — chunk weights are always consumed at compute
+            # dtype — and the grad-through-cast is the same f32
+            # conversion the accumulator applies. The head keeps the
+            # ORIGINAL shared params (it is checkpointed, so its weight
+            # casts are recomputed at bwd, and precision-sensitive
+            # f32-param uses stay exact).
+            chunk_p_v = jax.tree.map(
+                lambda p: p.astype(compute_dtype)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, chunk_p)
+            param_like.append(chunk_p_v)
+            # trace-time prototype: residual leaf shapes for the stash
+            # buffers (outputs unused -> the duplicate forward is DCE'd)
+            h0 = jnp.zeros((n_b, n_s, cfg.hidden_size), boundary_dtype)
+            _, vjp_proto = jax.vjp(
+                combined_f(_dyn(streams_all, jnp.int32(0)),
+                           mb_rng(jnp.int32(0))),
+                chunk_p_v, shared_p, h0)
+            _, _, proto_is_param, proto_resid = split_vjp_leaves(vjp_proto)
+            resid_shapes = [(l.shape, l.dtype) for l in proto_resid]
+
         def tick(carry, t):
             fwd_msg, bwd_msg, stash, g_chunk, g_shared, loss_acc = carry
             fwd_mb = t - stage
@@ -394,46 +469,77 @@ def pipeline_train_1f1b(
             # --- forward slot: intake (stage 0) or ring message
             x0 = intake_fn(shared_p, fsl, mb_rng(fmb)).astype(boundary_dtype)
             h_in = jnp.where(is_first, x0, fwd_msg)
-            # stash the chunk input; slot reuse is safe because the
-            # in-flight window 2(pp-1-s) is < D. The write happens before
-            # the same-tick read below (on the last stage fmb == bmb).
             slot_f = jnp.mod(fmb, D)
-            stash = stash.at[slot_f].set(
-                jnp.where(fwd_valid, h_in, stash[slot_f]))
-            h_saved = jax.lax.dynamic_index_in_dim(
-                stash, jnp.mod(bmb, D), 0, False)
+            slot_b = jnp.mod(bmb, D)
+            ct_l_seed = jnp.asarray(cotangent_seed / n_micro, jnp.float32)
 
-            # --- combined fwd + bwd work, UNIFORM across stages. Every
-            # stage runs the identical op sequence (fwd-slot chunk, then
-            # one vjp through chunk+head) — branch-free because GSPMD
-            # inserts tp/sp collectives inside this region and devices in
-            # different lax.cond branches would execute divergent
-            # collective sequences, deadlocking the runtime. Stage roles
-            # are expressed through the vjp COTANGENT instead: mid stages
-            # seed the chunk output with the ring cotangent and the loss
-            # with 0; the last stage seeds the loss with
-            # loss_scale/n_micro and the chunk output with 0. The head
+            # Both modes keep every stage on the IDENTICAL op sequence —
+            # branch-free because GSPMD inserts tp/sp collectives inside
+            # this region and devices in different lax.cond branches would
+            # execute divergent collective sequences, deadlocking the
+            # runtime. Stage roles are expressed through the vjp COTANGENT
+            # instead: mid stages seed the chunk output with the ring
+            # cotangent and the loss with 0; the last stage seeds the loss
+            # with loss_scale/n_micro and the chunk output with 0. The head
             # forward+backward thus runs (masked) on every stage — a
             # ~2·h·V/(layers/pp · 12·h²) FLOP overhead (≈5% at 7B/pp8)
-            # traded for a deadlock-free single program.
-            h_out_f = chunk_fn(chunk_p, h_in.astype(compute_dtype), fsl,
-                               offset, mb_rng(fmb)).astype(boundary_dtype)
+            # traded for a deadlock-free single program. Slot reuse is safe
+            # because the in-flight window 2(pp-1-s) is < D; writes happen
+            # before the same-tick read (on the last stage fmb == bmb).
+            if store_activations:
+                # ONE fwd (this tick's microbatch) whose vjp residuals ride
+                # the stash; the bwd slot rebuilds the closure — no
+                # recompute anywhere outside the checkpointed head.
+                (h_pair, loss_f), vjp_f = jax.vjp(
+                    combined_f(fsl, mb_rng(fmb)), chunk_p_v, shared_p,
+                    h_in)
+                leaves, treedef, is_param, resid = split_vjp_leaves(vjp_f)
+                assert is_param == proto_is_param, "vjp structure drifted"
+                assert [(r.shape, r.dtype) for r in resid] == resid_shapes
+                stash = [s.at[slot_f].set(jnp.where(fwd_valid, r,
+                                                    s[slot_f]))
+                         for s, r in zip(stash, resid)]
+                resid_b = [jax.lax.dynamic_index_in_dim(s, slot_b, 0,
+                                                        False)
+                           for s in stash]
+                rb = iter(resid_b)
+                rebuilt = [l if p else next(rb)
+                           for l, p in zip(leaves, is_param)]
+                vjp_b = jax.tree.unflatten(treedef, rebuilt)
+                ct_h = jnp.where(is_last, jnp.zeros_like(bwd_msg), bwd_msg)
+                ct_l = jnp.where(is_last, ct_l_seed,
+                                 jnp.zeros((), jnp.float32))
+                dcp, dsp, dh = vjp_b((ct_h, ct_l))
+                h_out = jnp.where(is_last, jnp.zeros_like(h_pair), h_pair)
+                # loss is known at the FWD slot in this mode
+                loss_contrib = jnp.where(
+                    fwd_valid & is_last, loss_f, 0.0)
+            else:
+                # recompute mode: stash chunk INPUTS; the bwd slot reruns
+                # the chunk forward inside a same-tick vjp
+                stash = stash.at[slot_f].set(
+                    jnp.where(fwd_valid, h_in, stash[slot_f]))
+                h_saved = jax.lax.dynamic_index_in_dim(stash, slot_b, 0,
+                                                       False)
+                h_out_f = chunk_fn(chunk_p, h_in.astype(compute_dtype),
+                                   fsl, offset,
+                                   mb_rng(fmb)).astype(boundary_dtype)
 
-            def f(cp, sp, h):
-                h_out = chunk_fn(cp, h.astype(compute_dtype), bsl,
-                                 offset, mb_rng(bmb))
-                loss = head_loss_fn(sp, h_out, bsl, mb_rng(bmb))
-                return h_out.astype(boundary_dtype), loss
+                def f(cp, sp, h):
+                    h_out = chunk_fn(cp, h.astype(compute_dtype), bsl,
+                                     offset, mb_rng(bmb))
+                    loss = head_loss_fn(sp, h_out, bsl, mb_rng(bmb))
+                    return h_out.astype(boundary_dtype), loss
 
-            (_, loss_mb), vjp = jax.vjp(f, chunk_p, shared_p, h_saved)
-            ct_h = jnp.where(is_last, jnp.zeros_like(bwd_msg), bwd_msg)
-            ct_l = jnp.where(is_last,
-                             jnp.asarray(cotangent_seed / n_micro,
-                                         jnp.float32),
-                             jnp.zeros((), jnp.float32))
-            dcp, dsp, dh = vjp((ct_h, ct_l))
-            h_out = jnp.where(is_last, jnp.zeros_like(h_out_f), h_out_f)
-            loss_mb = jnp.where(is_last, loss_mb, 0.0)
+                (_, loss_mb), vjp = jax.vjp(f, chunk_p, shared_p, h_saved)
+                ct_h = jnp.where(is_last, jnp.zeros_like(bwd_msg), bwd_msg)
+                ct_l = jnp.where(is_last, ct_l_seed,
+                                 jnp.zeros((), jnp.float32))
+                dcp, dsp, dh = vjp((ct_h, ct_l))
+                h_out = jnp.where(is_last, jnp.zeros_like(h_out_f),
+                                  h_out_f)
+                loss_contrib = jnp.where(
+                    bwd_valid & is_last, loss_mb, 0.0)
 
             # --- embedding intake backward (uniform; only stage 0's
             # cotangent is nonzero, so other stages accumulate zeros)
@@ -450,7 +556,7 @@ def pipeline_train_1f1b(
 
             g_chunk = jax.tree.map(acc, g_chunk, dcp)
             g_shared = jax.tree.map(acc, g_shared, dsp, d_intake)
-            loss_acc = loss_acc + jnp.where(bwd_valid, loss_mb, 0.0)
+            loss_acc = loss_acc + loss_contrib
 
             # --- ring rotation: activations down, cotangents up
             if pp > 1:
@@ -462,7 +568,12 @@ def pipeline_train_1f1b(
                     loss_acc), None
 
         msg0 = jnp.zeros((n_b, n_s, cfg.hidden_size), boundary_dtype)
-        stash0 = jnp.zeros((D, n_b, n_s, cfg.hidden_size), boundary_dtype)
+        if store_activations:
+            stash0 = [jnp.zeros((D,) + tuple(shape), dtype)
+                      for shape, dtype in resid_shapes]
+        else:
+            stash0 = jnp.zeros((D, n_b, n_s, cfg.hidden_size),
+                               boundary_dtype)
         gc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), chunk_p)
         gs0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
                            shared_p)

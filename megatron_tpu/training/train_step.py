@@ -169,7 +169,8 @@ def custom_pipelined_train_step(
         intake_fn=intake, chunk_fn=chunk, head_loss_fn=head,
         batch_shape=(tokens.shape[1], tokens.shape[2]),
         rng=None if deterministic else rng,
-        cotangent_seed=state.opt_state.scaler.scale)
+        cotangent_seed=state.opt_state.scaler.scale,
+        store_activations=cfg.parallel.pipeline_store_activations)
     return _finish_step(state, grads, loss, cfg, wd_mask)
 
 
@@ -238,7 +239,8 @@ def pipelined_train_step(
             intake_fn=intake, chunk_fn=chunk, head_loss_fn=head,
             batch_shape=(n_b, n_s),
             rng=None if deterministic else rng,
-            cotangent_seed=loss_scale)
+            cotangent_seed=loss_scale,
+            store_activations=cfg.parallel.pipeline_store_activations)
     else:
         def total_loss(params):
             loss = pl.pipeline_loss_fn(

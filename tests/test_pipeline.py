@@ -451,3 +451,160 @@ def test_1f1b_falcon_parallel_attn(devices):
     for a, b in zip(ref_leaves, pp_leaves):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=5e-4, atol=1e-5)
+
+
+def test_1f1b_store_activations_matches_sequential(devices):
+    """store_activations=True (the reference's no-recompute mode): the
+    forward vjp residuals ride the stash — identity-passthrough param
+    leaves excluded — and the backward slot rebuilds the closure. Loss
+    AND grads must match sequential autodiff."""
+    cfg = make_cfg(num_layers=4, compute_dtype="float32",
+                   recompute_granularity="none")
+    mesh = make_mesh(1, 4, 1, devices)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (3, 2, 33), 0, 128)
+    g_ref = jax.grad(lambda p: ref_loss(p, tokens, cfg))(params)
+
+    intake, chunk, head = gpt_1f1b_fns(cfg, deterministic=True)
+    streams = gpt_1f1b_streams(tokens, cfg)
+
+    def run(p, s):
+        return pipeline_train_1f1b(p, s, cfg, mesh, intake_fn=intake,
+                                   chunk_fn=chunk, head_loss_fn=head,
+                                   batch_shape=(2, 32),
+                                   store_activations=True)
+    with jax.set_mesh(mesh):
+        loss, g_pp = jax.jit(run)(params, streams)
+    np.testing.assert_allclose(float(loss),
+                               float(ref_loss(params, tokens, cfg)),
+                               rtol=2e-4)
+    ref_leaves, ref_def = jax.tree.flatten(g_ref)
+    pp_leaves, pp_def = jax.tree.flatten(g_pp)
+    assert ref_def == pp_def
+    for a, b in zip(ref_leaves, pp_leaves):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_1f1b_store_activations_memory_flat(devices):
+    """The residual stash is a circular buffer of depth 2pp-1: live bytes
+    must stay flat in n_micro (the 1F1B bound) in store mode too."""
+    cfg = make_cfg(num_layers=4, recompute_granularity="none")
+    pp = 4
+    mesh = make_mesh(1, pp, 1, devices)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    intake, chunk, head = gpt_1f1b_fns(cfg, deterministic=True)
+    temps = {}
+    for n_micro in (8, 32):
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (n_micro, 2, 33), 0, 128)
+        streams = gpt_1f1b_streams(tokens, cfg)
+
+        def run(p, s):
+            return pipeline_train_1f1b(
+                p, s, cfg, mesh, intake_fn=intake, chunk_fn=chunk,
+                head_loss_fn=head, batch_shape=(2, 32),
+                store_activations=True)
+        with jax.set_mesh(mesh):
+            compiled = jax.jit(run).lower(params, streams).compile()
+        try:
+            mem = compiled.memory_analysis()
+        except Exception:
+            pytest.skip("backend has no memory_analysis")
+        if mem is None or not hasattr(mem, "temp_size_in_bytes"):
+            pytest.skip("backend reports no temp size")
+        temps[n_micro] = mem.temp_size_in_bytes
+    assert temps[32] < 1.3 * temps[8], temps
+
+
+def test_1f1b_store_activations_dropout(devices):
+    """Dropout with store mode: the masks bind into the stored residuals
+    at the forward slot (no recompute), so grads must match the
+    sequential simulation with identical rng folds."""
+    cfg = make_cfg(num_layers=4, compute_dtype="float32",
+                   hidden_dropout=0.3, recompute_granularity="none")
+    pp = 2
+    mesh = make_mesh(1, pp, 1, devices)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 2, 33), 0, 128)
+    rng = jax.random.PRNGKey(7)
+
+    intake, chunk, head = gpt_1f1b_fns(cfg, deterministic=False)
+    streams = gpt_1f1b_streams(tokens, cfg)
+    Lc = cfg.num_layers // pp
+
+    def sim_loss(p):
+        staged = stage_params_reshape(p["transformer"], pp)
+        shared = {k: v for k, v in p.items() if k != "transformer"}
+        total = 0.0
+        for mb in range(2):
+            sl = jax.tree.map(lambda a: a[mb], streams)
+            mb_rng = jax.random.fold_in(rng, mb)
+            h = intake(shared, sl, mb_rng)
+            for s in range(pp):
+                cp_s = jax.tree.map(lambda x: x[s], staged)
+                h = chunk(cp_s, h, sl, s * Lc, mb_rng)
+            total = total + head(shared, h, sl, mb_rng)
+        return total / 2
+
+    l_ref, g_ref = jax.value_and_grad(sim_loss)(params)
+
+    def run(p, s):
+        return pipeline_train_1f1b(p, s, cfg, mesh, intake_fn=intake,
+                                   chunk_fn=chunk, head_loss_fn=head,
+                                   batch_shape=(2, 32), rng=rng,
+                                   store_activations=True)
+    with jax.set_mesh(mesh):
+        l_pp, g_pp = jax.jit(run)(params, streams)
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=2e-4)
+    ref_leaves, ref_def = jax.tree.flatten(g_ref)
+    pp_leaves, pp_def = jax.tree.flatten(g_pp)
+    assert ref_def == pp_def
+    for a, b in zip(ref_leaves, pp_leaves):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_1f1b_store_activations_bf16_no_weight_copies(devices):
+    """bf16 compute: in-model `w.astype(bf16)` casts must NOT defeat the
+    param-identity dedup (the chunk params are pre-cast outside the scan
+    so the casts are no-ops). If weight copies leaked into the stash, the
+    params-dominated config below would make bf16 store-mode temp bytes
+    EXCEED the f32 variant (whose no-op casts always dedup); correct
+    dedup makes bf16 residuals ~half the f32 ones."""
+    pp = 2
+    mesh = make_mesh(1, pp, 1, devices)
+    # params-dominated shape: h=64, seq=8 -> per-stage weights dwarf
+    # activations, so D weight copies would dominate temp memory
+    def cfg_for(dtype):
+        return ModelConfig(num_layers=4, hidden_size=64,
+                           num_attention_heads=4, vocab_size=128,
+                           seq_length=8, compute_dtype=dtype,
+                           recompute_granularity="none").derived()
+    cfg_f32 = cfg_for("float32")
+    cfg_bf16 = cfg_for("bfloat16")
+    temps = {}
+    for cfg in (cfg_f32, cfg_bf16):
+        params = lm.model_init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 2, 9),
+                                    0, 128)
+        intake, chunk, head = gpt_1f1b_fns(cfg, deterministic=True)
+        streams = gpt_1f1b_streams(tokens, cfg)
+
+        def run(p, s, c=cfg):
+            return pipeline_train_1f1b(p, s, c, mesh, intake_fn=intake,
+                                       chunk_fn=chunk, head_loss_fn=head,
+                                       batch_shape=(2, 8),
+                                       store_activations=True)
+        with jax.set_mesh(mesh):
+            compiled = jax.jit(run).lower(params, streams).compile()
+        try:
+            mem = compiled.memory_analysis()
+        except Exception:
+            pytest.skip("backend has no memory_analysis")
+        if mem is None or not hasattr(mem, "temp_size_in_bytes"):
+            pytest.skip("backend reports no temp size")
+        temps[cfg.compute_dtype] = mem.temp_size_in_bytes
+    assert temps["bfloat16"] <= temps["float32"], (
+        f"bf16 store-mode temp {temps['bfloat16']} exceeds f32 "
+        f"{temps['float32']}: weight casts are leaking into the stash")
