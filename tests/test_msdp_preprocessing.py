@@ -160,3 +160,63 @@ def test_cli_dispatch(tmp_path, wow_raw):
     assert pp.main(["--func", "process_wow_dataset", "--raw_file", wow_raw,
                     "--processed_file", str(out)]) == 0
     assert len(out.read_text().splitlines()) == 2
+
+
+def test_biencoder_encode_fn_from_checkpoint(tmp_path):
+    """The default knowledge-prompt encoder: a saved biencoder checkpoint
+    becomes a batched query-tower encode_fn, and prompt selection runs on
+    its embeddings end-to-end (the reference's DPR-encoder role,
+    ref: tasks/msdp/preprocessing.py:323-460)."""
+    import jax
+
+    from megatron_tpu.config import (DataConfig, MegatronConfig,
+                                     OptimizerConfig, TrainingConfig)
+    from megatron_tpu.models.bert import bert_config
+    from megatron_tpu.models.biencoder import biencoder_init
+    from megatron_tpu.training.checkpointing import save_checkpoint
+    from megatron_tpu.training.train_step import state_from_params
+
+    vocab = tmp_path / "vocab.txt"
+    vocab.write_text("\n".join(
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "coffee", "tea",
+         "brewed", "from", "beans", "leaves", "how", "is", "made",
+         "what", "about"]) + "\n")
+    mcfg = bert_config(num_layers=2, hidden_size=32,
+                       num_attention_heads=2, vocab_size=16, seq_length=16,
+                       max_position_embeddings=16)
+    cfg = MegatronConfig(
+        model=mcfg,
+        optimizer=OptimizerConfig(lr=1e-3),
+        training=TrainingConfig(micro_batch_size=1, global_batch_size=1,
+                                train_iters=1),
+        data=DataConfig(tokenizer_type="BertWordPieceLowerCase",
+                        vocab_file=str(vocab)),
+    ).validate(n_devices=1)
+    params = biencoder_init(jax.random.PRNGKey(0), mcfg)
+    state = state_from_params(params, cfg)
+    ckpt = str(tmp_path / "biencoder_ckpt")
+    save_checkpoint(ckpt, state, cfg, iteration=1)
+
+    encode = pp.biencoder_encode_fn(ckpt, seq_length=16)
+    embs = encode(["coffee is brewed from beans", "tea leaves"])
+    assert embs.shape[0] == 2 and embs.shape[1] > 0
+    assert np.all(np.isfinite(embs))
+    # distinct inputs embed distinctly
+    assert not np.allclose(embs[0], embs[1])
+
+    # end-to-end: prompt selection driven by the checkpoint encoder
+    train = tmp_path / "train.tsv"
+    test = tmp_path / "test.tsv"
+    _toy_tsv(train, [
+        ["coffee", "how is coffee made", "coffee is brewed from coffee "
+         "beans", "resp"],
+        ["tea", "what about tea", "tea is made from tea leaves", "resp"],
+    ])
+    _toy_tsv(test, [["coffee", "what about coffee", "gold", "resp"]])
+    out = tmp_path / "prompts.jsonl"
+    n = pp.prompt_selection_for_knowledge_generation(
+        str(test), str(train), ckpt, str(out), "wow_seen", n_prompts=1)
+    assert n == 1
+    (line,) = out.read_text().splitlines()
+    (key, prompts), = json.loads(line).items()
+    assert key.startswith("coffee") and len(prompts) == 1
