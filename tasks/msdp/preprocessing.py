@@ -205,9 +205,12 @@ def get_database(test_datapath: str, train_datapath: str, data_type: str):
 
 
 def biencoder_encode_fn(model_file: str, *, batch_size: int = 64,
-                        seq_length: int = 64) -> Callable:
+                        seq_length: Optional[int] = None) -> Callable:
     """encode_fn built from OUR biencoder checkpoint: query-tower
-    embeddings, jitted, batched (the reference's CUDA DPR encoder role)."""
+    embeddings, jitted, batched (the reference's CUDA DPR encoder role).
+    `seq_length` defaults to the checkpoint model's own sequence length —
+    exceeding its max_position_embeddings would silently clamp position
+    lookups."""
     import jax
     import jax.numpy as jnp
 
@@ -219,6 +222,8 @@ def biencoder_encode_fn(model_file: str, *, batch_size: int = 64,
 
     cfg = load_config_from_checkpoint(model_file)
     assert cfg is not None, f"no config in checkpoint {model_file}"
+    if seq_length is None:
+        seq_length = cfg.model.seq_length
     tokenizer = build_tokenizer(cfg.data.tokenizer_type,
                                 vocab_file=cfg.data.vocab_file,
                                 tokenizer_model=cfg.data.tokenizer_model)
