@@ -1,0 +1,29 @@
+#!/bin/bash
+# Falcon-40B (MQA) on a v5p-32 slice: TP=8 x PP=4 x DP — BASELINE config 3.
+# The pipeline runs the hand-scheduled 1F1B (default): per-stage activation
+# memory is flat in the microbatch count, so large global batches
+# (n_micro >> pp) shrink the bubble for free. On memory headroom, add
+# --pipeline_store_activations to drop the backward-slot recompute
+# (the reference's no-recompute mode; pair with a lighter
+# --recompute_granularity).
+# Prereqs: converted weights (tools/convert_hf_checkpoint.py --model
+# falcon-40b) and a preprocessed .bin/.idx corpus.
+
+CKPT=${CKPT:-ckpts/falcon-40b}
+DATA=${DATA:-data/corpus}
+SAVE=${SAVE:-ckpts/falcon-40b-ft}
+
+python finetune.py \
+    --model falcon-40b \
+    --load "$CKPT" --finetune \
+    --tensor_model_parallel_size 8 \
+    --pipeline_model_parallel_size 4 \
+    --sequence_parallel \
+    --use_distributed_optimizer \
+    --bf16 --use_flash_attn --recompute_granularity full \
+    --data_path "$DATA" --split 989,10,1 \
+    --train_iters 500 --global_batch_size 1024 --micro_batch_size 1 \
+    --lr 1e-5 --lr_decay_style cosine --lr_warmup_iters 50 \
+    --weight_decay 0.1 --clip_grad 1.0 \
+    --log_interval 1 --save_interval 100 --eval_interval 100 \
+    --save "$SAVE" --tensorboard_dir runs/falcon-40b-ft
