@@ -217,9 +217,12 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True,
 
         def flash_block(q_blk, k_blk, v_blk, sel):
             """One (q chunk, kv chunk) pair via lax.switch on the pair
-            class: 0 = fully masked (skip — this is what makes the
-            balanced schedule balanced COMPUTE), 1 = causal diagonal,
-            2 = fully allowed. No collectives inside the branches."""
+            class: 0 = fully masked (skip — this is what balances the
+            schedule's COMPUTE, in aggregate across the ring sweep; within
+            a single hop ranks can take different branch mixes, so the
+            synchronized ppermute waits on that hop's slowest rank),
+            1 = causal diagonal, 2 = fully allowed. No collectives inside
+            the branches."""
             bq = q_blk.shape[1]
 
             def skip(a, bb, cc):

@@ -40,6 +40,13 @@ def _end_punctuate(text: str) -> str:
     return text if text.endswith(("?", ".", "!")) else text + "."
 
 
+def _sanitize(text: str) -> str:
+    # a tab or newline inside raw dialogue text would corrupt the 4-column
+    # TSV and misalign every following ref-file line (ref WoI sanitization,
+    # preprocessing.py:206-213)
+    return text.replace("\n", "").replace("\r", "").replace("\t", "")
+
+
 def _tok_join(text: str) -> str:
     return " ".join(_simple_word_tokenize(text))
 
@@ -99,8 +106,11 @@ def process_woi_dataset(raw_file: str, processed_file: str,
     """Wizard-of-Internet JSONL dump -> 4-column TSV; returns row count.
 
     The wizard's last search query becomes the topic and the first
-    selected retrieved sentence the knowledge; rows without a selection
-    carry the no-knowledge sentinel."""
+    selected retrieved sentence the knowledge. Contract parity with the
+    reference (ref: tasks/msdp/preprocessing.py:128-241): WoI text is NOT
+    end-punctuated (only WoW is), every field is stripped of \\t/\\n/\\r,
+    and turns whose topic resolves to ``no_topic`` are dropped from all
+    three output files (they still extend the dialogue history)."""
     n = 0
     fknwl = open(knwl_ref_file, "w") if knwl_ref_file else None
     fresp = open(resp_ref_file, "w") if resp_ref_file else None
@@ -117,9 +127,8 @@ def process_woi_dataset(raw_file: str, processed_file: str,
                 if action == "Wizard => SearchAgent":
                     search_text = item["text"]
                 elif action == "Wizard => Apprentice":
-                    text = _end_punctuate(item["text"])
                     if not history:
-                        history.append(text)
+                        history.append(item["text"])
                         continue
                     knowledge = ""
                     ctx = item.get("context", {})
@@ -139,12 +148,27 @@ def process_woi_dataset(raw_file: str, processed_file: str,
                         topic = search_text
                     else:
                         topic, knowledge = "no_topic", NO_KNOWLEDGE
-                    _write_row(fproc, fknwl, fresp, topic,
-                               SEP.join(history), knowledge, text)
-                    history.append(text)
-                    n += 1
+                    response = _sanitize(item["text"])
+                    if topic != "no_topic":
+                        fproc.write(f"{_sanitize(topic)}\t"
+                                    f"{_sanitize(SEP.join(history))}\t"
+                                    f"{_sanitize(knowledge)}\t{response}\n")
+                        if fknwl is not None:
+                            fknwl.write(_sanitize(knowledge) + "\n")
+                        if fresp is not None:
+                            # tokenized for the F1 evaluator; the reference
+                            # reassigns `response` here, so WHEN (and only
+                            # when) a resp ref file is requested, the
+                            # TOKENIZED form enters the history below (ref
+                            # :222-225) — later rows' contexts depend on
+                            # this optional argument in the reference too,
+                            # and byte parity means reproducing that
+                            response = _tok_join(response)
+                            fresp.write(response + "\n")
+                        n += 1
+                    history.append(response)
                 elif action == "Apprentice => Wizard":
-                    history.append(_end_punctuate(item["text"]))
+                    history.append(item["text"])
     for f in (fknwl, fresp):
         if f is not None:
             f.close()

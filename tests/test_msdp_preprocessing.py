@@ -52,12 +52,12 @@ def test_process_wow(tmp_path, wow_raw):
 
 def test_process_woi(tmp_path):
     record = {"d1": {"dialog_history": [
-        {"action": "Apprentice => Wizard", "text": "Tell me about pandas"},
+        {"action": "Apprentice => Wizard", "text": "Tell me\tabout pandas"},
         {"action": "Wizard => SearchAgent", "text": "panda habitat"},
         {"action": "Wizard => Apprentice", "text": "Sure thing",
          "context": {"contents": [], "selected_contents": [[True]]}},
         {"action": "Wizard => Apprentice",
-         "text": "Pandas live in bamboo forests",
+         "text": "Pandas live in\nbamboo forests",
          "context": {
              "contents": [{"content": ["Pandas eat bamboo.",
                                        "Pandas live in China."]}],
@@ -67,14 +67,20 @@ def test_process_woi(tmp_path):
     raw.write_text(json.dumps(record) + "\n")
     out = tmp_path / "proc.tsv"
     n = pp.process_woi_dataset(str(raw), str(out))
-    # the apprentice opens, so BOTH wizard turns emit: the first with the
-    # no-knowledge sentinel, the second with the selected sentence
-    assert n == 2
+    # the apprentice opens; the first wizard turn resolves to no_topic and
+    # is DROPPED (ref preprocessing.py:216), so only the panda turn emits
+    assert n == 1
     rows = [line.split("\t") for line in out.read_text().splitlines()]
-    assert rows[0][0] == "no_topic" and rows[0][2] == pp.NO_KNOWLEDGE
-    assert rows[1][0] == "panda habitat"
-    assert rows[1][2] == "Pandas live in China."
-    assert rows[1][3].startswith("Pandas live in bamboo forests")
+    assert len(rows) == 1
+    assert rows[0][0] == "panda habitat"
+    assert rows[0][2] == "Pandas live in China."
+    # WoI text is NOT end-punctuated (only WoW is) and embedded \t/\n are
+    # stripped so the TSV stays 4 columns
+    assert rows[0][3] == "Pandas live inbamboo forests"
+    assert "Tell me\tabout" not in rows[0][1]
+    assert "Tell meabout pandas" in rows[0][1]
+    # the dropped no_topic turn still extends the dialogue history
+    assert "Sure thing" in rows[0][1]
 
 
 def _toy_tsv(path, rows):
@@ -162,6 +168,7 @@ def test_cli_dispatch(tmp_path, wow_raw):
     assert len(out.read_text().splitlines()) == 2
 
 
+@pytest.mark.slow  # convergence/training-loop test
 def test_biencoder_encode_fn_from_checkpoint(tmp_path):
     """The default knowledge-prompt encoder: a saved biencoder checkpoint
     becomes a batched query-tower encode_fn, and prompt selection runs on

@@ -147,7 +147,7 @@ def write_jsonl(path: str, records: Iterable[dict]) -> int:
 def shingles(text: str, char_ngram: int = 5) -> set:
     """Character n-gram shingle set (ref: find_duplicates.py:13-15)."""
     return {text[i:i + char_ngram]
-            for i in range(max(len(text) - char_ngram, 1))}
+            for i in range(max(len(text) - char_ngram + 1, 1))}
 
 
 def jaccard(a: set, b: set, mode: str = "union") -> float:
