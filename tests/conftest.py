@@ -37,6 +37,14 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy (8-device shard_map / pipeline / e2e) tests; "
+        "deselect with `pytest -m 'not slow'` for the fast green/red tier "
+        "(see README 'Running the tests')")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

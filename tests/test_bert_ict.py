@@ -163,6 +163,7 @@ def tiny_bert_cfg():
 
 
 class TestClassificationHeads:
+    @pytest.mark.slow  # convergence/training-loop test
     def test_classification_learns(self):
         from megatron_tpu.models.classification import (classification_init,
                                                         classification_loss)
@@ -197,6 +198,7 @@ class TestClassificationHeads:
 
 
 class TestBiencoder:
+    @pytest.mark.slow  # convergence/training-loop test
     @pytest.mark.parametrize("shared", [False, True])
     def test_retrieval_loss_learns(self, shared):
         import optax

@@ -59,6 +59,7 @@ class TestBert:
                                    np.asarray(lb)[0, :3],
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow  # convergence/training-loop test
     def test_mlm_nsp_loss_trains(self, tiny_bert):
         params, cfg = tiny_bert
         rng = np.random.default_rng(0)
@@ -96,6 +97,7 @@ class TestT5:
         lc = t5_forward(params, enc2, dec_a, cfg)
         assert np.abs(np.asarray(la) - np.asarray(lc)).max() > 1e-4
 
+    @pytest.mark.slow  # convergence/training-loop test
     def test_t5_loss_trains(self, tiny_t5):
         params, cfg = tiny_t5
         rng = np.random.default_rng(0)

@@ -5,6 +5,10 @@ Contract ports of the reference's checkpoint behavior
 iteration/consumed_samples/optimizer state bit-exactly, finetune loads
 weights only, release checkpoints reset iteration, config embedding.
 """
+import pytest
+
+pytestmark = pytest.mark.slow  # 8-device shard_map compiles dominate
+
 import dataclasses
 
 import jax

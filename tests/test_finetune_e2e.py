@@ -10,6 +10,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # 8-device shard_map compiles dominate
+
 
 @pytest.fixture(scope="module")
 def corpus(tmp_path_factory):

@@ -103,6 +103,7 @@ class TestGeneration:
         assert lps.shape == (2, 3)
         assert np.all(lps[0] <= 0)
 
+    @pytest.mark.slow  # convergence/training-loop test
     def test_beam_search_beats_greedy(self, tiny_model):
         """Beam-1 == greedy; wider beams score >= beam-1."""
         params, cfg = tiny_model

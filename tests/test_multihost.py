@@ -10,6 +10,8 @@ row-range arithmetic are process-count-independent.
 import jax
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # 8-device shard_map compiles dominate
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from megatron_tpu.parallel.mesh import MESH_AXES

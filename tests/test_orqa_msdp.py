@@ -347,6 +347,7 @@ class TestSupervisedRetriever:
         assert 0.0 <= results["top1_accuracy"] <= 1.0
         assert 1.0 <= results["average_rank"] <= 3.0
 
+    @pytest.mark.slow  # convergence/training-loop test
     def test_finetune_learns_tiny(self, dpr_json, wp):
         """A few epochs on 2 samples must drive in-batch top-1 to 1.0
         (overfit smoke, the reference's correctness bar for the task

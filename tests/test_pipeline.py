@@ -12,6 +12,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # 8-device shard_map compiles dominate
 from jax.sharding import Mesh
 
 from megatron_tpu.config import (MegatronConfig, ModelConfig, OptimizerConfig,
@@ -26,7 +28,8 @@ from megatron_tpu.parallel.pipeline import (gpt_1f1b_fns, gpt_1f1b_streams,
                                             stage_params_reshape)
 
 
-def run_1f1b(params, tokens, cfg, mesh, loss_mask=None):
+def run_1f1b(params, tokens, cfg, mesh, loss_mask=None, vpp=1,
+             store_activations=False):
     """jit-compiled 1F1B (loss, grads) on `mesh` for test configs."""
     intake, chunk, head = gpt_1f1b_fns(cfg, deterministic=True)
     streams = gpt_1f1b_streams(tokens, cfg, loss_mask=loss_mask)
@@ -35,7 +38,8 @@ def run_1f1b(params, tokens, cfg, mesh, loss_mask=None):
     def run(p, s):
         return pipeline_train_1f1b(p, s, cfg, mesh, intake_fn=intake,
                                    chunk_fn=chunk, head_loss_fn=head,
-                                   batch_shape=shape)
+                                   batch_shape=shape, vpp=vpp,
+                                   store_activations=store_activations)
     with jax.set_mesh(mesh):
         return jax.jit(run)(params, streams)
 
@@ -236,6 +240,74 @@ def test_1f1b_loss_mask_semantics(devices):
     want = float(ref_loss(params, tokens, cfg, loss_mask=mask))
     loss, _ = run_1f1b(params, tokens, cfg, mesh, loss_mask=mask)
     np.testing.assert_allclose(float(loss), want, rtol=2e-4)
+
+
+@pytest.mark.parametrize("vpp", [2, 4])
+def test_1f1b_interleaved_matches_sequential_loss(devices, vpp):
+    """Interleaved virtual stages under 1F1B (ref: schedules.py:253-502):
+    the chunked layer->stage assignment and the vpp-buffer rings must not
+    change the math."""
+    cfg = make_cfg(num_layers=8)
+    mesh = make_mesh(1, 2, 1, devices)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (3, 2, 33), 0, 128)
+    want = float(ref_loss(params, tokens, cfg))
+    loss, _ = run_1f1b(params, tokens, cfg, mesh, vpp=vpp)
+    np.testing.assert_allclose(float(loss), want, rtol=2e-4)
+
+
+@pytest.mark.parametrize("store", [False, True])
+def test_1f1b_interleaved_matches_sequential_grads(devices, store):
+    """Interleaved 1F1B grads (both stash modes) == sequential autodiff —
+    including the head cotangent hand-off into chunk vpp-1's same-tick
+    backward and the chunk-rolling wraparound edges."""
+    cfg = make_cfg(num_layers=8, compute_dtype="float32")
+    mesh = make_mesh(1, 2, 1, devices)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (3, 2, 33), 0, 128)
+    g_ref = jax.grad(lambda p: ref_loss(p, tokens, cfg))(params)
+    _, g_pp = run_1f1b(params, tokens, cfg, mesh, vpp=2,
+                       store_activations=store)
+    ref_leaves, ref_def = jax.tree.flatten(g_ref)
+    pp_leaves, pp_def = jax.tree.flatten(g_pp)
+    assert ref_def == pp_def
+    for a, b in zip(ref_leaves, pp_leaves):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_1f1b_interleaved_memory_flat_in_n_micro(devices):
+    """The VERDICT r3 vpp gate: interleaved virtual stages must keep the
+    1F1B bound — per-stage live bytes flat in n_micro (the gpipe fallback
+    this replaces grew ~linearly)."""
+    cfg = make_cfg(num_layers=8)
+    pp, vpp = 2, 2
+    mesh = make_mesh(1, pp, 1, devices)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    intake, chunk, head = gpt_1f1b_fns(cfg, deterministic=True)
+    temps = {}
+    for n_micro in (8, 32):
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (n_micro, 2, 33), 0, 128)
+        streams = gpt_1f1b_streams(tokens, cfg)
+
+        def run(p, s):
+            return pipeline_train_1f1b(
+                p, s, cfg, mesh, intake_fn=intake, chunk_fn=chunk,
+                head_loss_fn=head, batch_shape=(2, 32), vpp=vpp)
+        with jax.set_mesh(mesh):
+            compiled = jax.jit(run).lower(params, streams).compile()
+        try:
+            mem = compiled.memory_analysis()
+        except Exception:
+            pytest.skip("backend has no memory_analysis")
+        if mem is None or not hasattr(mem, "temp_size_in_bytes"):
+            pytest.skip("backend reports no temp size")
+        temps[n_micro] = mem.temp_size_in_bytes
+    assert temps[32] < 1.3 * temps[8], (
+        f"n_micro 8->32 at pp={pp} vpp={vpp} grew temp bytes "
+        f"{temps[8]} -> {temps[32]} (>=1.3x): interleaved 1F1B memory is "
+        "not bounded by pp*vpp")
 
 
 def test_1f1b_memory_flat_in_n_micro(devices):

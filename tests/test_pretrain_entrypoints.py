@@ -8,6 +8,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # 8-device shard_map compiles dominate
+
 from megatron_tpu.data.indexed_dataset import IndexedDatasetBuilder
 
 VOCAB = (["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]

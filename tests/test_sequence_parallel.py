@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # 8-device shard_map compiles dominate
+
 from megatron_tpu.config import (MegatronConfig, ModelConfig, OptimizerConfig,
                                  ParallelConfig, TrainingConfig)
 from megatron_tpu.parallel.mesh import build_mesh

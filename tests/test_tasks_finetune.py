@@ -95,6 +95,7 @@ class TestRaceReader:
 
 
 class TestFinetune:
+    @pytest.mark.slow  # convergence/training-loop test
     def test_classification_finetune_separable(self):
         """A trivially separable task (label == which marker token appears)
         must reach near-perfect validation accuracy in a few epochs."""

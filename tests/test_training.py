@@ -13,6 +13,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # 8-device shard_map compiles dominate
+
 from megatron_tpu.config import (MegatronConfig, ModelConfig, OptimizerConfig,
                                  TrainingConfig)
 from megatron_tpu.training import (MicrobatchCalculator, apply_optimizer,

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # 8-device shard_map compiles dominate
+
 from megatron_tpu.parallel.ulysses import ulysses_attention
 from tests.test_ring_attention import make_mesh, ref_attention
 
