@@ -170,7 +170,8 @@ def custom_pipelined_train_step(
         batch_shape=(tokens.shape[1], tokens.shape[2]),
         rng=None if deterministic else rng,
         cotangent_seed=state.opt_state.scaler.scale,
-        store_activations=cfg.parallel.pipeline_store_activations)
+        store_activations=cfg.parallel.pipeline_store_activations,
+        vpp=cfg.parallel.virtual_pipeline_chunks)
     return _finish_step(state, grads, loss, cfg, wd_mask)
 
 
@@ -211,9 +212,10 @@ def pipelined_train_step(
     (ref: schedules.py:606-722 1F1B — see parallel/pipeline.py).
 
     Default schedule is hand-written 1F1B: per-stage live memory is flat in
-    n_micro (the reference's 1F1B memory bound). vpp>1 interleaving and
-    schedule="gpipe" use the lockstep scan whose backward is derived by
-    jax.grad (memory grows with n_micro)."""
+    n_micro (the reference's 1F1B memory bound), with vpp>1 dispatching to
+    the interleaved 1F1B variant (same bound). schedule="gpipe" uses the
+    lockstep scan whose backward is derived by jax.grad (memory grows with
+    n_micro)."""
     from megatron_tpu.parallel import pipeline as pl
 
     mcfg = cfg.model
@@ -223,7 +225,6 @@ def pipelined_train_step(
     if rope is None:
         rope = lm.make_rope(mcfg)
 
-    # config.validate resolves 1f1b + vpp>1 to gpipe with a warning
     use_1f1b = cfg.parallel.pipeline_schedule == "1f1b"
     if use_1f1b:
         intake, chunk, head = pl.gpt_1f1b_fns(mcfg, rope=rope,
@@ -240,7 +241,8 @@ def pipelined_train_step(
             batch_shape=(n_b, n_s),
             rng=None if deterministic else rng,
             cotangent_seed=loss_scale,
-            store_activations=cfg.parallel.pipeline_store_activations)
+            store_activations=cfg.parallel.pipeline_store_activations,
+            vpp=cfg.parallel.virtual_pipeline_chunks)
     else:
         def total_loss(params):
             loss = pl.pipeline_loss_fn(
@@ -317,13 +319,10 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True,
     pipelined = mesh is not None and cfg.parallel.pipeline_parallel > 1
     if pipelined:
         if pipelined_spec is not None:
-            # the spec path runs the 1F1B core only — it has no vpp
-            # interleaving, and silently dropping a vpp request (or the
-            # gpipe schedule config.validate resolved it to) would train a
-            # different layout than asked
-            assert cfg.parallel.virtual_pipeline_chunks == 1, (
-                "pipelined_spec models (BERT-family) support vpp=1 only; "
-                "drop --num_layers_per_virtual_pipeline_stage")
+            # the spec path runs the 1F1B core (vpp>=1: the interleaved
+            # variant handles virtual stages since round 4) but not the
+            # lockstep gpipe schedule — fail loudly rather than train a
+            # different schedule than asked
             assert cfg.parallel.pipeline_schedule == "1f1b", (
                 "pipelined_spec models run the 1F1B core only; drop "
                 "--pipeline_schedule gpipe")
