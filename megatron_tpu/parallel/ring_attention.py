@@ -34,8 +34,10 @@ exchange per call); layout="pre_zigzag" declares the batch ALREADY
 permuted — lm.loss_fn does that once per batch via `data_zigzag_cp` +
 `zigzag_permutation` (tokens/labels/mask/positions ride the same
 permutation; the masked-mean loss is permutation-invariant), making the
-ring's data movement zero. The pipelined (pp>1) chunk path does not
-pre-permute yet and uses the runtime-permute mode.
+ring's data movement zero. The pipelined (pp>1) paths pre-permute too
+(round 4): gpt_1f1b_streams permutes the microbatch streams once
+(zigzag_cp) and pipeline_loss_fn mirrors lm.loss_fn, so pp>1 + cp no
+longer pays the 4 runtime permute-gathers per attention call.
 """
 from __future__ import annotations
 

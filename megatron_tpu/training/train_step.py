@@ -227,12 +227,19 @@ def pipelined_train_step(
 
     use_1f1b = cfg.parallel.pipeline_schedule == "1f1b"
     if use_1f1b:
+        # data-level ring-cp zigzag (as in the unpipelined loss_fn): the
+        # streams are permuted once and every chunk's ring attention runs
+        # permute-free
+        from megatron_tpu.parallel.ring_attention import data_zigzag_cp
+        zz_cp = data_zigzag_cp(mcfg, batch["tokens"].shape[2] - 1,
+                               segment_ids=batch.get("segment_ids"))
         intake, chunk, head = pl.gpt_1f1b_fns(mcfg, rope=rope,
-                                              deterministic=deterministic)
+                                              deterministic=deterministic,
+                                              cp_pre_zigzag=zz_cp > 0)
         streams = pl.gpt_1f1b_streams(
             batch["tokens"], mcfg, loss_mask=batch.get("loss_mask"),
             position_ids=batch.get("position_ids"),
-            segment_ids=batch.get("segment_ids"))
+            segment_ids=batch.get("segment_ids"), zigzag_cp=zz_cp)
         n_b = batch["tokens"].shape[1]
         n_s = batch["tokens"].shape[2] - 1
         loss, grads = pl.pipeline_train_1f1b(
