@@ -307,10 +307,19 @@ def _run_extras():
         budget = float(os.environ.get("BENCH_EXTRAS_TIMEOUT_S", "900"))
     except ValueError:
         budget = 900.0
-    for tool, out in [("bench_kernels.py", "/tmp/bench_extras_kernels.log"),
-                      ("bench_32k.py", "/tmp/bench_extras_32k.log")]:
-        cmd = [sys.executable, os.path.join(here, "tools", tool), "--out", out]
-        print(f"bench: extras: {tool} -> {out}", file=sys.stderr)
+    suites = [
+        ("bench_kernels.py", [], "/tmp/bench_extras_kernels.log"),
+        # BASELINE configs 1-2 slice (seq 4096) before the 32k one: it
+        # compiles/runs faster, so a mid-extras kill still leaves it
+        ("bench_32k.py", ["--seq_length", "4096"],
+         "/tmp/bench_extras_4k.log"),
+        ("bench_32k.py", [], "/tmp/bench_extras_32k.log"),
+    ]
+    for tool, extra_args, out in suites:
+        cmd = [sys.executable, os.path.join(here, "tools", tool),
+               "--out", out] + extra_args
+        print(f"bench: extras: {tool} {' '.join(extra_args)} -> {out}",
+              file=sys.stderr)
         try:
             subprocess.run(cmd, stdout=sys.stderr, stderr=sys.stderr,
                            timeout=budget)
