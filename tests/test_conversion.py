@@ -242,3 +242,22 @@ class TestMetaLlamaConversion:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-6, atol=1e-7,
                                        err_msg=str(path))
+
+
+def test_golden_logit_fixture():
+    """The pinned-logit stand-in for the reference's real-weight CI gate
+    (ref: tests/test_llama_weights.py:106; real Llama-2 weights are
+    unreachable from this environment — blocked command in COVERAGE.md).
+    The numpy-seeded synthetic model regenerates bit-identically, so any
+    drift in the HF conversion or the forward numerics shows up against
+    the committed fixture at the reference's <=1e-3 avg-max-abs."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import verify_correctness as vc
+
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "golden_logits_llama_synthetic.npz")
+    assert os.path.exists(fixture), "golden fixture missing from the repo"
+    assert vc.main(["--golden", fixture]) == 0
