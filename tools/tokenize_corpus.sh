@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Tokenize a train/valid pair of loose-JSON corpora into the indexed
+# .bin/.idx format — the reference's tokenize-utils/entrypoint.sh flow
+# (ref: tokenize-utils/entrypoint.sh) without the docker wrapper: this
+# package needs no install step. See docs/tokenization.md.
+#
+#   tools/tokenize_corpus.sh TRAIN.jsonl VALID.jsonl OUT_PREFIX \
+#       [TOKENIZER_TYPE] [TOKENIZER_MODEL_OR_VOCAB...]
+#
+# Defaults mirror the reference's Falcon example (HF tokenizer).
+set -euo pipefail
+# no cd: the caller's relative paths (corpora, vocab files, OUT_PREFIX)
+# must resolve from the caller's directory; invoke the tool by its
+# absolute path instead
+tool="$(cd "$(dirname "$0")" && pwd)/preprocess_data.py"
+
+train=${1:?usage: tokenize_corpus.sh TRAIN.jsonl VALID.jsonl OUT_PREFIX [type] [model...]}
+valid=${2:?need VALID.jsonl}
+prefix=${3:?need OUT_PREFIX}
+ttype=${4:-HFTokenizer}
+shift $(( $# > 4 ? 4 : $# ))
+
+echo "Tokenizing ${train} -> ${prefix}-train"
+python "${tool}" --input "${train}" \
+    --output_prefix "${prefix}-train" --tokenizer_type "${ttype}" \
+    --workers "${WORKERS:-2}" --append_eod "$@"
+
+echo "Tokenizing ${valid} -> ${prefix}-valid"
+python "${tool}" --input "${valid}" \
+    --output_prefix "${prefix}-valid" --tokenizer_type "${ttype}" \
+    --workers "${WORKERS:-2}" --append_eod "$@"
