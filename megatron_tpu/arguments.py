@@ -66,6 +66,11 @@ def build_parser(extra_args_provider: Optional[Callable] = None
     g.add_argument("--fp16", action="store_true")
     g.add_argument("--fp32", action="store_true")
     g.add_argument("--use_flash_attn", action="store_true")
+    # explicit impl selection (beyond the reference's boolean): overrides
+    # preset defaults in BOTH directions — e.g. `--model llama2-7b
+    # --attention_impl dot` opts out of the preset's flash default
+    g.add_argument("--attention_impl", type=str, default=None,
+                   choices=["dot", "flash", "ring", "ulysses"])
     g.add_argument("--recompute_granularity", type=str, default="none",
                    choices=["none", "selective", "full"])
     g.add_argument("--model", type=str, default=None,
@@ -387,8 +392,9 @@ def config_from_args(args: argparse.Namespace,
         model = dataclasses.replace(
             model, seq_length=args.seq_length or model.seq_length,
             recompute_granularity=args.recompute_granularity,
-            attention_impl="flash" if args.use_flash_attn
-            else model.attention_impl, **overrides)
+            attention_impl=(args.attention_impl or
+                            ("flash" if args.use_flash_attn
+                             else model.attention_impl)), **overrides)
     else:
         activation = (args.glu_activation or args.activation or
                       ("swiglu" if args.use_rms_norm else "gelu"))
@@ -402,7 +408,8 @@ def config_from_args(args: argparse.Namespace,
             activation=activation,
             params_dtype=params_dtype,
             compute_dtype="bfloat16" if args.bf16 or args.fp16 else "float32",
-            attention_impl="flash" if args.use_flash_attn else "dot",
+            attention_impl=(args.attention_impl or
+                            ("flash" if args.use_flash_attn else "dot")),
         ))
         model = ModelConfig(**md)
 

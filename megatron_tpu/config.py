@@ -331,6 +331,18 @@ class MegatronConfig:
             assert par.tensor_parallel >= 1
             assert model.seq_length % max(par.tensor_parallel, 1) == 0, (
                 "sequence parallel requires seq_length divisible by tp")
+        if model.attention_impl in ("flash", "ring", "ulysses") and \
+                model.attention_dropout > 0.0:
+            # the fused/cp paths have no dropout plumbing; training traces
+            # with active attention dropout route to the unfused dot path
+            # (models/attention.py dropout_active) — correct, but the user
+            # should know the fused impl they asked for will not run
+            from megatron_tpu.utils.logging import print_rank_0
+            print_rank_0(
+                f"warning: attention_impl={model.attention_impl!r} with "
+                f"attention_dropout={model.attention_dropout} falls back "
+                "to the unfused dot path during training (dropout is only "
+                "implemented there); eval keeps the fused path")
         if model.attention_impl == "ulysses" and par.context_parallel > 1:
             # fail at config time, not first jit trace
             nkv = model.num_kv_heads or model.num_attention_heads
@@ -403,7 +415,8 @@ class MegatronConfig:
 def llama2_config(size: str = "7b", **overrides) -> ModelConfig:
     presets = {
         "tiny": dict(num_layers=2, hidden_size=256, num_attention_heads=4,
-                     vocab_size=32000, seq_length=512),
+                     vocab_size=32000, seq_length=512,
+                     attention_impl="dot"),
         "7b": dict(num_layers=32, hidden_size=4096, num_attention_heads=32,
                    ffn_hidden_size=11008, vocab_size=32000, seq_length=4096),
         "13b": dict(num_layers=40, hidden_size=5120, num_attention_heads=40,
@@ -416,6 +429,14 @@ def llama2_config(size: str = "7b", **overrides) -> ModelConfig:
         use_rotary_emb=True, norm_type="rmsnorm", norm_epsilon=1e-5,
         activation="swiglu", use_bias=False, use_post_ln=False,
         parallel_attn=False, tie_embed_logits=False,
+        # TPU-first default: real-model presets take the Pallas flash path
+        # (the reference gates it behind --use_flash_attn; here dot would
+        # materialize O(s^2) scores in HBM for no reason). The dispatch
+        # still auto-falls back to dot where flash cannot apply (KV-cache
+        # decode, segment/EOD-reset masks, active attention dropout —
+        # models/attention.py). The "tiny" presets keep dot: they exist
+        # for cheap CPU tests. Opt out with --attention_impl dot.
+        attention_impl="flash",
     )
     base.update(presets[size])
     base.update(overrides)
@@ -425,7 +446,8 @@ def llama2_config(size: str = "7b", **overrides) -> ModelConfig:
 def falcon_config(size: str = "7b", **overrides) -> ModelConfig:
     presets = {
         "tiny": dict(num_layers=2, hidden_size=256, num_attention_heads=4,
-                     num_kv_heads=1, vocab_size=65024, seq_length=512),
+                     num_kv_heads=1, vocab_size=65024, seq_length=512,
+                     attention_impl="dot"),
         "7b": dict(num_layers=32, hidden_size=4544, num_attention_heads=71,
                    num_kv_heads=1, vocab_size=65024, seq_length=2048),
         "40b": dict(num_layers=60, hidden_size=8192, num_attention_heads=128,
@@ -436,6 +458,7 @@ def falcon_config(size: str = "7b", **overrides) -> ModelConfig:
         use_rotary_emb=True, norm_type="layernorm", norm_epsilon=1e-5,
         activation="gelu", use_bias=False, use_post_ln=False,
         parallel_attn=True, tie_embed_logits=True,
+        attention_impl="flash",  # see llama2_config
     )
     base.update(presets[size])
     base.update(overrides)

@@ -193,8 +193,15 @@ def attention_apply(
     # (attention_softmax_in_fp32), so the trick is unnecessary and the flag
     # intentionally has no numerical effect.
 
+    # Active attention dropout is only implemented on the dot path (the
+    # flash kernel and the cp rings have no dropout plumbing); a training
+    # trace with attention_dropout > 0 must take it, or the configured
+    # regularization would be silently dropped. Eval traces
+    # (deterministic=True) keep the fused paths.
+    dropout_active = not deterministic and cfg.attention_dropout > 0.0
     ring_branch = (cfg.attention_impl in ("ring", "ulysses")
-                   and kv_cache is None and segment_ids is None and causal)
+                   and kv_cache is None and segment_ids is None and causal
+                   and not dropout_active)
     # a pre-permuted batch MUST reach the ring path: any gating drift
     # between data_zigzag_cp (which told the loss to permute) and this
     # dispatch would apply causal masks to the wrong rows and silently
@@ -229,7 +236,8 @@ def attention_apply(
                 "batch was permuted for a ring that will not run")
             from megatron_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True, scale=scale)
-    elif cfg.attention_impl == "flash" and kv_cache is None and segment_ids is None:
+    elif cfg.attention_impl == "flash" and kv_cache is None \
+            and segment_ids is None and not dropout_active:
         from megatron_tpu.ops.flash_attention import flash_attention
         out = flash_attention(q, k, v, causal=causal, scale=scale)
     else:

@@ -104,6 +104,12 @@ def data_zigzag_cp(cfg, seq_len: int, *, causal: bool = True,
     if getattr(cfg, "attention_impl", None) != "ring" or not causal \
             or segment_ids is not None:
         return 0
+    if getattr(cfg, "attention_dropout", 0.0) > 0.0:
+        # active attention dropout routes attention to the dot path
+        # (models/attention.py dropout_active), where a pre-permuted batch
+        # would get causal masks on the wrong rows; conservatively keep
+        # the runtime-permute mode for such configs (eval traces too)
+        return 0
     try:
         mesh = jax.sharding.get_abstract_mesh()
     except Exception:

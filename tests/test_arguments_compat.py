@@ -109,3 +109,16 @@ def test_mask_and_decoder_flags():
     assert cfg.data.masked_lm_prob == 0.2
     assert cfg.data.short_seq_prob == 0.3
     assert cfg.data.max_seq_length_dec == 64
+
+
+def test_attention_impl_flag_and_preset_default():
+    """Presets default to flash (TPU-first); --attention_impl dot opts
+    out; --use_flash_attn still forces flash on raw-flag lines."""
+    cfg, _ = parse(["--model", "llama2-7b"])
+    assert cfg.model.attention_impl == "flash"
+    cfg, _ = parse(["--model", "llama2-7b", "--attention_impl", "dot"])
+    assert cfg.model.attention_impl == "dot"
+    cfg, _ = parse(BASE + ["--use_flash_attn"])
+    assert cfg.model.attention_impl == "flash"
+    cfg, _ = parse(BASE)
+    assert cfg.model.attention_impl == "dot"

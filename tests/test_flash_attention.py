@@ -74,3 +74,32 @@ def test_flash_bf16_io():
     out = flash_attention(q, k, v, causal=True, use_pallas=False)
     assert out.dtype == jnp.bfloat16
     assert out.shape == q.shape
+
+
+def test_active_attention_dropout_routes_to_dot_path():
+    """A training trace (deterministic=False) with attention_dropout > 0
+    must take the dot path even under attention_impl='flash' — the fused
+    kernels have no dropout plumbing, so the configured regularization
+    would otherwise silently vanish (round-4 review). Equality with the
+    dot config under the same rng proves the routing."""
+    import dataclasses as dc
+
+    from megatron_tpu.config import ModelConfig
+    from megatron_tpu.models import language_model as lm
+
+    base = ModelConfig(num_layers=2, hidden_size=64, num_attention_heads=4,
+                       vocab_size=128, seq_length=32,
+                       attention_dropout=0.5,
+                       compute_dtype="float32").derived()
+    cfg_flash = dc.replace(base, attention_impl="flash")
+    params = lm.model_init(jax.random.PRNGKey(0), base)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 128)
+    rng = jax.random.PRNGKey(7)
+    l_dot = lm.loss_fn(params, tokens, base, rng=rng, deterministic=False)
+    l_flash = lm.loss_fn(params, tokens, cfg_flash, rng=rng,
+                         deterministic=False)
+    # identical (same path, same rng folding), and dropout actually bit
+    np.testing.assert_allclose(float(l_flash), float(l_dot), rtol=1e-6)
+    l_eval = lm.loss_fn(params, tokens, cfg_flash, deterministic=True)
+    assert abs(float(l_eval) - float(l_dot)) > 1e-4, (
+        "dropout appears inert — the dot routing did not happen?")

@@ -680,3 +680,27 @@ def test_1f1b_store_activations_bf16_no_weight_copies(devices):
     assert temps["bfloat16"] <= temps["float32"], (
         f"bf16 store-mode temp {temps['bfloat16']} exceeds f32 "
         f"{temps['float32']}: weight casts are leaking into the stash")
+
+
+def test_1f1b_reaches_flash_attention(devices, monkeypatch):
+    """Round-4 regression guard: the pipeline streams must NOT materialize
+    segment_ids zeros — that pushed every pp>1 run off the flash/ring
+    attention branches (which require segment_ids is None) onto the
+    unfused dot path, silently. Monkeypatch-counts flash_attention calls
+    during a pp=2 1F1B step with attention_impl='flash'."""
+    import megatron_tpu.ops.flash_attention as fa
+    calls = []
+    real = fa.flash_attention
+
+    def counting(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(fa, "flash_attention", counting)
+    cfg = make_cfg(num_layers=4, attention_impl="flash")
+    mesh = make_mesh(1, 2, 1, devices)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 2, 33), 0, 128)
+    run_1f1b(params, tokens, cfg, mesh)
+    assert calls, ("pp 1F1B never reached flash_attention with "
+                   "attention_impl='flash' — segment_ids zeros regressed?")
