@@ -178,6 +178,24 @@ def attention_apply(
         q = apply_rotary(q, rope_cos, rope_sin, position_ids)
         k = apply_rotary(k, rope_cos, rope_sin, position_ids)
 
+    # Active attention dropout is only implemented on the dot path — see
+    # the fuller comment at the dispatch below; every fused gate
+    # (including the prefill one here) must include this term.
+    dropout_active = not deterministic and cfg.attention_dropout > 0.0
+    # A cached forward with s > 1 is BY CONTRACT an offset-0 prefill
+    # (generation.py's prefill is the only such call in the codebase;
+    # decode steps are s == 1). At offset 0 causal attention over the
+    # cache equals plain causal attention over the fresh k/v, so the
+    # prefill can take the flash path on the raw (un-cache-rounded)
+    # tensors instead of paying O(s^2) score materialization on the dot
+    # path — the reference's prefill pays full unfused attention.
+    # Chunked/continuation prefills (s > 1 at offset > 0) would break
+    # this contract; such a caller must use attention_impl='dot'.
+    prefill_flash = (cfg.attention_impl == "flash" and kv_cache is not None
+                     and s > 1 and segment_ids is None and causal
+                     and not cross and not dropout_active)
+    k_raw, v_raw = k, v
+
     if kv_cache is not None:
         # incremental decode: write new k/v at offset, attend over full prefix
         new_k = jax.lax.dynamic_update_slice_in_dim(kv_cache.k, k.astype(kv_cache.k.dtype), kv_cache.offset, axis=1)
@@ -193,12 +211,12 @@ def attention_apply(
     # (attention_softmax_in_fp32), so the trick is unnecessary and the flag
     # intentionally has no numerical effect.
 
-    # Active attention dropout is only implemented on the dot path (the
-    # flash kernel and the cp rings have no dropout plumbing); a training
-    # trace with attention_dropout > 0 must take it, or the configured
+    # dropout_active (defined above, with the prefill gate): attention
+    # dropout is only implemented on the dot path (the flash kernel and
+    # the cp rings have no dropout plumbing); a training trace with
+    # attention_dropout > 0 must take it, or the configured
     # regularization would be silently dropped. Eval traces
     # (deterministic=True) keep the fused paths.
-    dropout_active = not deterministic and cfg.attention_dropout > 0.0
     ring_branch = (cfg.attention_impl in ("ring", "ulysses")
                    and kv_cache is None and segment_ids is None and causal
                    and not dropout_active)
@@ -240,6 +258,9 @@ def attention_apply(
             and segment_ids is None and not dropout_active:
         from megatron_tpu.ops.flash_attention import flash_attention
         out = flash_attention(q, k, v, causal=causal, scale=scale)
+    elif prefill_flash:
+        from megatron_tpu.ops.flash_attention import flash_attention
+        out = flash_attention(q, k_raw, v_raw, causal=True, scale=scale)
     else:
         rate = 0.0 if deterministic else cfg.attention_dropout
         out = _dot_attention(

@@ -83,6 +83,36 @@ class TestGeneration:
         got = np.asarray(tokens[0, :len(seq)])
         np.testing.assert_array_equal(got, want)
 
+    def test_greedy_decode_matches_full_forward_flash_prefill(
+            self, tiny_model):
+        """Same oracle check with attention_impl='flash': the prefill then
+        takes the flash path on the raw k/v (offset-0 prefill == plain
+        causal attention — models/attention.py prefill_flash) while decode
+        steps stay on the cached dot path."""
+        import dataclasses as dc
+        params, cfg = tiny_model
+        cfg = dc.replace(cfg, attention_impl="flash")
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        # >=16 tokens: Generator rounds the prefill length down to a
+        # multiple of 16, and the flash-prefill gate needs s > 1 — a
+        # short prompt would prefill at s=1 and test nothing new
+        prompt = [(7 * i + 3) % 90 + 1 for i in range(20)]
+        max_new = 8
+        tokens, lengths, _ = gen.generate(
+            [prompt], max_new, sampling=SamplingParams(temperature=0.0))
+        rope = lm.make_rope(cfg)
+        seq = list(prompt)
+        for _ in range(max_new):
+            logits, _ = lm.model_forward(
+                params, jnp.asarray([seq]), cfg, rope=rope,
+                logits_dtype=jnp.float32)
+            nxt = int(jnp.argmax(logits[0, -1, :cfg.vocab_size]))
+            seq.append(nxt)
+            if nxt == 0:
+                break
+        np.testing.assert_array_equal(
+            np.asarray(tokens[0, :len(seq)]), np.asarray(seq))
+
     def test_batch_mixed_lengths(self, tiny_model):
         """Rows with different prompt lengths keep their prompt tokens
         (ref: generation.py:210-214)."""
