@@ -1,0 +1,13 @@
+#!/bin/bash
+# GLUE MNLI classification finetune from a pretrained BERT checkpoint
+# (ref: examples/finetune_mnli_distributed.sh). QQP: swap --task QQP and
+# the TSV paths.
+VOCAB=${VOCAB:-vocab.txt}
+CKPT=${CKPT:-ckpts/bert}
+
+python -m tasks.main --task MNLI \
+    --train_data glue/MNLI/train.tsv \
+    --valid_data glue/MNLI/dev_matched.tsv glue/MNLI/dev_mismatched.tsv \
+    --pretrained_checkpoint "$CKPT" \
+    --tokenizer_type BertWordPieceLowerCase --vocab_file "$VOCAB" \
+    --seq_length 128 --micro_batch_size 32 --epochs 3 --lr 5e-5

@@ -1,0 +1,12 @@
+#!/bin/bash
+# RACE multiple-choice finetune from a pretrained BERT checkpoint
+# (ref: examples/finetune_race_distributed.sh).
+VOCAB=${VOCAB:-vocab.txt}
+CKPT=${CKPT:-ckpts/bert}
+
+python -m tasks.main --task RACE \
+    --train_data race/train/middle race/train/high \
+    --valid_data race/dev/middle race/dev/high \
+    --pretrained_checkpoint "$CKPT" \
+    --tokenizer_type BertWordPieceLowerCase --vocab_file "$VOCAB" \
+    --seq_length 384 --micro_batch_size 8 --epochs 3 --lr 1e-5

@@ -1,0 +1,31 @@
+#!/bin/bash
+# MSDP multi-stage pipeline (ref: examples/msdp/*.sh): prep the WoW/WoI
+# TSVs, select prompts, generate knowledge/responses via the serving API,
+# then F1-evaluate. Stages 3/4 need a running text-generation server
+# (examples/serve.sh).
+set -e
+D=${D:-msdp}
+
+# 1. dataset prep (writes 4-col TSVs + knowledge/response ref files)
+python -m tasks.msdp.preprocessing --func process_wow_dataset \
+    --raw_file "$D/wow_test.json" --processed_file "$D/wow_test.tsv" \
+    --knwl_ref_file "$D/knwl_ref.txt" --resp_ref_file "$D/resp_ref.txt"
+
+# 2. knowledge-generation prompt selection (dense retrieval over train)
+python -m tasks.msdp.preprocessing --func prompt_selection_for_knowledge_generation \
+    --test_file "$D/wow_test.tsv" --train_file "$D/wow_train.tsv" \
+    --model_file ckpts/biencoder --processed_file "$D/knwl_prompts.json" \
+    --data_type wow_seen
+
+# 3. generate knowledge via the serving API (response stage: rerun with
+#    --prompt_type response on the spliced TSV from step 4)
+python -m tasks.msdp.main --task MSDP-PROMPT --prompt_type knowledge \
+    --sample_input_file "$D/wow_test.tsv" --prompt_file "$D/knwl_prompts.json" \
+    --sample_output_file "$D/knwl_gen.txt" --megatron_api_url localhost:5000/api
+python -m tasks.msdp.preprocessing --func prepare_input_for_response_generation \
+    --test_file "$D/wow_test.tsv" --knwl_gen_file "$D/knwl_gen.txt" \
+    --processed_file "$D/resp_input.tsv"
+
+# 5. F1 against the reference files
+python -m tasks.msdp.main --task MSDP-EVAL-F1 \
+    --guess_file "$D/knwl_gen.txt" --answer_file "$D/knwl_ref.txt"

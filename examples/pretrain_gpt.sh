@@ -1,0 +1,15 @@
+#!/bin/bash
+# GPT-2-style pretraining from scratch on one host
+# (ref: examples/pretrain_gpt.sh / pretrain_gpt_distributed_with_mp.sh).
+# finetune.py without --load trains from init; --model gpt2 gives the
+# GPT-2 arch preset (learned positions, gelu, tied head).
+DATA=${DATA:-data/corpus}
+
+python finetune.py \
+    --model gpt2 \
+    --data_path "$DATA" --split 949,50,1 \
+    --train_iters 500000 --global_batch_size 512 --micro_batch_size 8 \
+    --bf16 --lr 1.5e-4 --lr_decay_style cosine --lr_warmup_iters 2000 \
+    --weight_decay 0.1 --clip_grad 1.0 \
+    --log_interval 10 --save_interval 1000 --eval_interval 1000 \
+    --save ckpts/gpt2 --tensorboard_dir runs/gpt2

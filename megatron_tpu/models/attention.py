@@ -182,15 +182,16 @@ def attention_apply(
     # the fuller comment at the dispatch below; every fused gate
     # (including the prefill one here) must include this term.
     dropout_active = not deterministic and cfg.attention_dropout > 0.0
-    # A cached forward with s > 1 is BY CONTRACT an offset-0 prefill
-    # (generation.py's prefill is the only such call in the codebase;
-    # decode steps are s == 1). At offset 0 causal attention over the
-    # cache equals plain causal attention over the fresh k/v, so the
-    # prefill can take the flash path on the raw (un-cache-rounded)
-    # tensors instead of paying O(s^2) score materialization on the dot
-    # path — the reference's prefill pays full unfused attention.
-    # Chunked/continuation prefills (s > 1 at offset > 0) would break
-    # this contract; such a caller must use attention_impl='dot'.
+    # A cached forward with s > 1 is an offset-0 prefill everywhere in
+    # this codebase (generation.py's prefill; decode steps are s == 1).
+    # At offset 0 causal attention over the cache equals plain causal
+    # attention over the fresh k/v, so the prefill can take the flash
+    # path on the raw (un-cache-rounded) tensors instead of paying
+    # O(s^2) score materialization on the dot path — the reference's
+    # prefill pays full unfused attention. The offset-0 condition is
+    # ENFORCED below with a lax.cond (a chunked/continuation prefill at
+    # offset > 0 gets the correct cached dot path, not silently wrong
+    # flash over the fresh chunk only).
     prefill_flash = (cfg.attention_impl == "flash" and kv_cache is not None
                      and s > 1 and segment_ids is None and causal
                      and not cross and not dropout_active)
@@ -260,7 +261,19 @@ def attention_apply(
         out = flash_attention(q, k, v, causal=causal, scale=scale)
     elif prefill_flash:
         from megatron_tpu.ops.flash_attention import flash_attention
-        out = flash_attention(q, k_raw, v_raw, causal=True, scale=scale)
+
+        # both branches trace (compile-time cost only); runtime executes
+        # one, and only offset 0 gets the flash shortcut
+        out = jax.lax.cond(
+            q_offset == 0,
+            lambda: flash_attention(q, k_raw, v_raw, causal=True,
+                                    scale=scale).astype(jnp.float32),
+            lambda: _dot_attention(
+                q, k, v, causal=causal,
+                softmax_fp32=cfg.attention_softmax_in_fp32,
+                scale=scale, q_offset=q_offset,
+                segment_ids=segment_ids).astype(jnp.float32),
+        ).astype(dtype)
     else:
         rate = 0.0 if deterministic else cfg.attention_dropout
         out = _dot_attention(

@@ -704,3 +704,54 @@ def test_1f1b_reaches_flash_attention(devices, monkeypatch):
     run_1f1b(params, tokens, cfg, mesh)
     assert calls, ("pp 1F1B never reached flash_attention with "
                    "attention_impl='flash' — segment_ids zeros regressed?")
+
+
+def test_1f1b_interleaved_dropout_grads_match_simulation(devices):
+    """Dropout ON through the interleaved schedule: the bwd slot of each
+    CHUNK recomputes from its stash, and the chunk offsets (c*pp+s)*Lc
+    must keep stack_apply's per-absolute-layer rng folds aligned with the
+    sequential execution — a chunk-offset slip would corrupt masks
+    silently. Same simulation oracle as the vpp=1 test, walking chunks in
+    interleaved order."""
+    cfg = make_cfg(num_layers=8, compute_dtype="float32",
+                   hidden_dropout=0.3, attention_dropout=0.1)
+    pp, vpp = 2, 2
+    mesh = make_mesh(1, pp, 1, devices)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 2, 33), 0, 128)
+    rng = jax.random.PRNGKey(7)
+
+    intake, chunk, head = gpt_1f1b_fns(cfg, deterministic=False)
+    streams = gpt_1f1b_streams(tokens, cfg)
+    Lc = cfg.num_layers // (pp * vpp)
+
+    def sim_loss(p):
+        chunked = stage_params_chunked(p["transformer"], pp, vpp)
+        shared = {k: v for k, v in p.items() if k != "transformer"}
+        total = 0.0
+        for mb in range(2):
+            sl = jax.tree.map(lambda a: a[mb], streams)
+            mb_rng = jax.random.fold_in(rng, mb)
+            h = intake(shared, sl, mb_rng)
+            for c in range(vpp):
+                for s in range(pp):
+                    cp_sc = jax.tree.map(lambda x: x[s, c], chunked)
+                    h = chunk(cp_sc, h, sl, (c * pp + s) * Lc, mb_rng)
+            total = total + head(shared, h, sl, mb_rng)
+        return total / 2
+
+    l_ref, g_ref = jax.value_and_grad(sim_loss)(params)
+
+    def run(p, s):
+        return pipeline_train_1f1b(p, s, cfg, mesh, intake_fn=intake,
+                                   chunk_fn=chunk, head_loss_fn=head,
+                                   batch_shape=(2, 32), rng=rng, vpp=vpp)
+    with jax.set_mesh(mesh):
+        l_pp, g_pp = jax.jit(run)(params, streams)
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=2e-4)
+    ref_leaves, ref_def = jax.tree.flatten(g_ref)
+    pp_leaves, pp_def = jax.tree.flatten(g_pp)
+    assert ref_def == pp_def
+    for a, b in zip(ref_leaves, pp_leaves):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=1e-5)
