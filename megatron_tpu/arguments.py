@@ -259,8 +259,10 @@ def build_parser(extra_args_provider: Optional[Callable] = None
 # exist under XLA: stream ordering, fused CUDA kernels, NCCL backends, fp8
 # Transformer Engine, vision/DINO models, ADLR cluster autoresume).
 _NOOP_FLAGS = [
+    "--DDP_impl",  # local-vs-torch DDP choice; dp is a mesh axis here
     "--accumulate_allreduce_grads_in_fp32",  # grads are always fp32 here
     "--adlr_autoresume", "--adlr_autoresume_interval",
+    "--barrier_with_L1_time",  # timers design differs (block_until_ready)
     "--apply_residual_connection_post_layernorm",
     "--classes_fraction", "--data_parallel_random_init",
     "--data_per_class_fraction",
@@ -283,8 +285,10 @@ _NOOP_FLAGS = [
     "--no_bias_dropout_fusion", "--no_bias_gelu_fusion",
     "--no_contiguous_buffers_in_local_ddp", "--no_data_sharding",
     "--no_gradient_accumulation_fusion", "--no_initialization",
+    "--mmap_warmup",  # np.memmap needs no page-in pass
     "--no_masked_softmax_fusion", "--no_persist_layer_norm",
     "--no_query_key_layer_scaling",
+    "--sample_rate",  # BERT-dataset subsampling knob of the CUDA loader
     "--no_scatter_gather_tensors_in_pipeline",
     "--num_channels", "--num_classes", "--onnx_safe", "--patch_dim",
     "--pipeline_model_parallel_split_rank", "--standalone_embedding_stage",

@@ -278,6 +278,44 @@ def param_shardings(cfg: MegatronConfig, mesh, rules=None, axes_fn=None):
     return shd.tree_logical_to_sharding(mesh, axes, rules)
 
 
+def state_shardings(cfg: MegatronConfig, mesh, param_shapes, rules=None,
+                    axes_fn=None, has_opt: bool = True):
+    """The full TrainState sharding tree the sharded train step uses —
+    ONE source shared by make_train_step and offline tools
+    (tools/checkpoint_util.py), so a pre-flight validation proves the
+    layout the real step will actually run. `param_shapes`: the param
+    tree (arrays or ShapeDtypeStructs) for the ZeRO-1 divisibility
+    decisions."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from megatron_tpu.parallel import sharding as shd
+    if rules is None:
+        rules = shd.make_logical_rules(cfg.parallel.sequence_parallel)
+    axes = axes_fn(cfg.model) if axes_fn else lm.model_axes(cfg.model)
+    param_sh = shd.tree_logical_to_sharding(mesh, axes, rules)
+    scalar_sh = NamedSharding(mesh, P())
+    opt_sh = None
+    if has_opt:
+        if cfg.parallel.use_distributed_optimizer:
+            # ZeRO-1: Adam moments additionally sharded over 'dp'
+            # (ref: optimizer/distrib_optimizer.py; see
+            # parallel/sharding.py:distributed_opt_sharding)
+            moment_sh = shd.tree_distributed_opt_sharding(
+                mesh, axes, rules, param_shapes,
+                pipelined=cfg.parallel.pipeline_parallel > 1)
+        else:
+            moment_sh = param_sh
+        opt_sh = opt.OptState(
+            step=scalar_sh,
+            mu=moment_sh,
+            nu=moment_sh if cfg.optimizer.optimizer == "adam" else None,
+            scaler=opt.ScalerState(scalar_sh, scalar_sh, scalar_sh),
+        )
+    return TrainState(params=param_sh, opt_state=opt_sh,
+                      iteration=scalar_sh)
+
+
 class _MeshContextStep:
     """Callable wrapping a jitted step so each call runs with the ambient
     mesh set (required by the partial-manual shard_map inside)."""
@@ -370,26 +408,9 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True,
         with shd.activation_shardings(mesh, rules):
             return base_fn(*args, **kwargs)
 
-    param_sh = shd.tree_logical_to_sharding(mesh, axes, rules)
+    state_sh = state_shardings(cfg, mesh, jax.eval_shape(init), rules=rules,
+                               axes_fn=axes_fn)
     scalar_sh = NamedSharding(mesh, P())
-    if cfg.parallel.use_distributed_optimizer:
-        # ZeRO-1: Adam moments additionally sharded over 'dp'
-        # (ref: optimizer/distrib_optimizer.py; see
-        # parallel/sharding.py:distributed_opt_sharding)
-        shapes = jax.eval_shape(init)
-        moment_sh = shd.tree_distributed_opt_sharding(mesh, axes, rules,
-                                                      shapes,
-                                                      pipelined=pipelined)
-    else:
-        moment_sh = param_sh
-    opt_sh = opt.OptState(
-        step=scalar_sh,
-        mu=moment_sh,
-        nu=moment_sh if cfg.optimizer.optimizer == "adam" else None,
-        scaler=opt.ScalerState(scalar_sh, scalar_sh, scalar_sh),
-    )
-    state_sh = TrainState(params=param_sh, opt_state=opt_sh,
-                          iteration=scalar_sh)
     # pytree-prefix sharding: every batch leaf is [n_micro, batch, ...],
     # dp-sharded on the batch dim — rank-2 spec so 2-D leaves (e.g. BERT's
     # is_random) and 3-D leaves (tokens, masks) both accept it

@@ -278,3 +278,42 @@ class TestShardedCheckpointing:
                         jax.tree.leaves(s_full.params)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-6, atol=1e-7)
+
+
+def test_checkpoint_util_cli(tmp_path):
+    """tools/checkpoint_util.py (the reference resharder's counterpart):
+    a checkpoint saved untopologized must validate under a target tp/pp
+    layout via the CLI, and --release must roll a weights-only copy."""
+    import os
+    import subprocess
+    import sys
+
+    from megatron_tpu.config import (MegatronConfig, ModelConfig,
+                                     OptimizerConfig, TrainingConfig)
+    from megatron_tpu.training import checkpointing as ckpt
+    from megatron_tpu.training.train_step import init_train_state
+
+    cfg = MegatronConfig(
+        model=ModelConfig(num_layers=4, hidden_size=64,
+                          num_attention_heads=4, vocab_size=128,
+                          seq_length=32).derived(),
+        optimizer=OptimizerConfig(lr=1e-4),
+        training=TrainingConfig(micro_batch_size=1, global_batch_size=1,
+                                train_iters=1),
+    ).validate(n_devices=1)
+    state = init_train_state(jax.random.PRNGKey(0), cfg)
+    root = str(tmp_path / "ckpt")
+    ckpt.save_checkpoint(root, state, cfg, iteration=3, consumed_samples=7)
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "checkpoint_util.py")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    r = subprocess.run(
+        [sys.executable, tool, "--load_dir", root,
+         "--target_tensor_parallel_size", "2",
+         "--target_pipeline_parallel_size", "2",
+         "--save_dir", str(tmp_path / "rel"), "--release"],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "restored iter=3 consumed=7" in r.stdout
+    assert ckpt.read_tracker(str(tmp_path / "rel")) == "release"

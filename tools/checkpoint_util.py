@@ -1,0 +1,125 @@
+"""Offline checkpoint utility: validate or re-save under a target topology.
+
+Counterpart of the reference's resharding toolchain
+(ref: tools/checkpoint_util.py + checkpoint_loader_megatron.py +
+checkpoint_saver_megatron.py, ~900 lines that rewrite per-rank
+mp_rank_{tp}_{pp} shards). Here checkpoints are TOPOLOGY-FREE — one
+logical tree, re-laid-out at load against the current mesh
+(training/checkpointing.py "Differences by design") — so *resharding*
+is a load-time no-op and this tool's jobs are the ones that remain
+meaningful offline:
+
+- validate (default): restore the checkpoint under the target
+  tp/pp/dp on a VIRTUAL CPU mesh and report per-leaf placement +
+  per-device bytes — a pre-flight proof the layout works before
+  burning pod time. The reference cannot do this below real GPUs.
+- --save_dir: write a re-saved logical copy (e.g. --release to roll a
+  weights-only release checkpoint for conversion/serving).
+
+  python tools/checkpoint_util.py --load_dir ckpts/llama7b \\
+      --target_tensor_parallel_size 4 --target_pipeline_parallel_size 2 \\
+      --target_data_parallel_size 1
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("checkpoint_util", description=__doc__)
+    p.add_argument("--load_dir", required=True)
+    p.add_argument("--save_dir", default=None)
+    p.add_argument("--target_tensor_parallel_size", type=int, default=1)
+    p.add_argument("--target_pipeline_parallel_size", type=int, default=1)
+    p.add_argument("--target_data_parallel_size", type=int, default=1)
+    p.add_argument("--release", action="store_true",
+                   help="save weights-only (release) checkpoint")
+    args = p.parse_args(argv)
+    if args.release and not args.save_dir:
+        p.error("--release requires --save_dir (nothing would be written)")
+
+    tp, pp, dp = (args.target_tensor_parallel_size,
+                  args.target_pipeline_parallel_size,
+                  args.target_data_parallel_size)
+    n = tp * pp * dp
+    # virtual CPU devices for the target layout — must be set before jax
+    # backends initialize (the tool is offline by design: cpu). An
+    # inherited device-count flag is REPLACED, not kept: the target
+    # layout dictates the count here.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import re
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", "")).strip()
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n}").strip()
+    import dataclasses
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.training import checkpointing as ckpt
+    from megatron_tpu.training.train_step import init_train_state
+
+    cfg = ckpt.load_config_from_checkpoint(args.load_dir)
+    assert cfg is not None, (
+        f"{args.load_dir}: no checkpoint (or no embedded config) found")
+    cfg = dataclasses.replace(
+        cfg, parallel=dataclasses.replace(
+            cfg.parallel, tensor_parallel=tp, pipeline_parallel=pp,
+            data_parallel=dp)).validate(n_devices=n)
+    mesh = build_mesh(cfg.parallel)
+    print(f"target mesh: dp={dp} pp={pp} tp={tp} "
+          f"({n} virtual cpu devices)")
+
+    # abstract state template (no concrete init) + the exact shardings the
+    # sharded train step would use (train_step.py make_train_step)
+    from megatron_tpu.training.train_step import state_shardings
+
+    rng = jax.random.PRNGKey(0)
+    example = jax.eval_shape(lambda r: init_train_state(r, cfg), rng)
+    # ONE source of truth: the same sharding tree the sharded train step
+    # would jit with, so this validation proves the real layout
+    shardings = state_shardings(cfg, mesh, example.params,
+                                has_opt=example.opt_state is not None)
+
+    state, iteration, consumed = ckpt.load_checkpoint(
+        args.load_dir, example, shardings=shardings)
+    assert state is not None, f"restore failed from {args.load_dir}"
+    # a release / no-optim checkpoint leaves example's ABSTRACT opt_state
+    # in place of a restored one; drop it so a re-save cannot try to
+    # serialize ShapeDtypeStructs
+    if state.opt_state is not None and any(
+            not hasattr(l, "addressable_shards")
+            for l in jax.tree.leaves(state.opt_state)):
+        state = state._replace(opt_state=None)
+        print("note: checkpoint carries no optimizer state "
+              "(release/no-optim save); validating weights only")
+    total = sum(l.size * l.dtype.itemsize
+                for l in jax.tree.leaves(state.params))
+    per_dev = {}
+    for l in jax.tree.leaves(state):
+        for sh in getattr(l, "addressable_shards", []):
+            per_dev[sh.device.id] = (per_dev.get(sh.device.id, 0)
+                                     + sh.data.size * sh.data.dtype.itemsize)
+    worst = max(per_dev.values()) if per_dev else 0
+    print(f"restored iter={iteration} consumed={consumed}: "
+          f"params {total / 1e6:.1f} MB logical, "
+          f"max per-device state {worst / 1e6:.1f} MB")
+
+    if args.save_dir:
+        ckpt.save_checkpoint(args.save_dir, state, cfg,
+                             iteration=0 if args.release else iteration,
+                             consumed_samples=consumed,
+                             release=args.release)
+        print(f"saved {'release ' if args.release else ''}checkpoint "
+              f"to {args.save_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
