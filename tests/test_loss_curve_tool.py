@@ -1,0 +1,50 @@
+"""tools/compare_loss_curves.py: the loss-curve-matched acceptance tool
+must parse BOTH dashboard formats (ours and the reference's
+training.py:589-607 log_string) and align on consumed samples."""
+from tools.compare_loss_curves import compare, main, parse_log
+
+OURS = """\
+iteration 1 | consumed samples 8 | elapsed time per iteration (ms): 10.0 | \
+tokens/s: 100.0 | learning rate: 1.000E-04 | lm loss: 6.100000E+00 | \
+loss scale: 1.0 | grad norm: 1.000 | skipped iterations: 0 | nan iterations: 0
+iteration 2 | consumed samples 16 | elapsed time per iteration (ms): 10.0 | \
+tokens/s: 100.0 | learning rate: 1.000E-04 | lm loss: 5.900000E+00 | \
+loss scale: 1.0 | grad norm: 1.000 | skipped iterations: 0 | nan iterations: 0
+"""
+
+# the reference's right-padded format (training.py:589-607)
+THEIRS = """\
+ iteration        1/     100 | consumed samples:            8 |\
+ elapsed time per iteration (ms): 12.3 | learning rate: 1.000E-04 |\
+ global batch size:     8 | lm loss: 6.100000E+00 | loss scale: 1.0 |
+ iteration        2/     100 | consumed samples:           16 |\
+ elapsed time per iteration (ms): 12.3 | learning rate: 1.000E-04 |\
+ global batch size:     8 | lm loss: 6.500000E+00 | loss scale: 1.0 |
+"""
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_parses_both_formats(tmp_path):
+    ours = parse_log(_write(tmp_path, "ours.log", OURS))
+    theirs = parse_log(_write(tmp_path, "theirs.log", THEIRS))
+    assert ours == {8: 6.1, 16: 5.9}
+    assert theirs == {8: 6.1, 16: 6.5}
+
+
+def test_alignment_and_exit_codes(tmp_path):
+    a = _write(tmp_path, "a.log", OURS)
+    b = _write(tmp_path, "b.log", THEIRS)
+    # point at 8 agrees; point at 16 differs by ~10% -> rtol 0.05 fails,
+    # rtol 0.2 passes
+    assert main([a, b, "--rtol", "0.2", "--quiet"]) == 0
+    assert main([a, b, "--rtol", "0.05", "--quiet"]) == 1
+    aligned, worst, n_bad, _ = compare(parse_log(a), parse_log(b),
+                                       rtol=0.05)
+    assert aligned == 2 and n_bad == 1
+    # rel error is normalized by the SECOND (baseline) log's value
+    assert abs(worst - (6.5 - 5.9) / 6.5) < 1e-9
