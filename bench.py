@@ -10,7 +10,6 @@ MFU on A100-80GB bf16 — so the ratio is hardware-normalized.
 """
 from __future__ import annotations
 
-import dataclasses
 import json
 import os
 import signal

@@ -14,7 +14,6 @@ from __future__ import annotations
 import sys
 
 import jax
-import numpy as np
 
 from megatron_tpu.utils.platform import ensure_env_platform
 ensure_env_platform()

@@ -77,7 +77,6 @@ GSPMD still inserts the TP/SP collectives inside each stage body.
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

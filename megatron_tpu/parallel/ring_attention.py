@@ -41,8 +41,6 @@ longer pays the 4 runtime permute-gathers per attention call.
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P

@@ -31,7 +31,6 @@ Differences by design:
 """
 from __future__ import annotations
 
-import dataclasses
 import json
 import os
 from typing import Any, Optional

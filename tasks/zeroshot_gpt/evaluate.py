@@ -18,7 +18,6 @@ evaluate.py:84-92).
 """
 from __future__ import annotations
 
-import functools
 import math
 from typing import Optional
 
