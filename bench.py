@@ -308,6 +308,9 @@ def _run_extras():
         budget = 900.0
     suites = [
         ("bench_kernels.py", [], "/tmp/bench_extras_kernels.log"),
+        # uniform-head overhead measurement (VERDICT r3 weak #6): two
+        # small jits, runs in well under a minute on-chip
+        ("bench_head.py", [], "/tmp/bench_extras_head.log"),
         # BASELINE configs 1-2 slice (seq 4096) before the 32k one: it
         # compiles/runs faster, so a mid-extras kill still leaves it
         ("bench_32k.py", ["--seq_length", "4096"],
