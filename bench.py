@@ -315,6 +315,9 @@ def _run_extras():
         # compiles/runs faster, so a mid-extras kill still leaves it
         ("bench_32k.py", ["--seq_length", "4096"],
          "/tmp/bench_extras_4k.log"),
+        # serving prefill+decode throughput with an HBM roofline — after
+        # the BASELINE slice so a wedge here can't starve that record
+        ("bench_decode.py", [], "/tmp/bench_extras_decode.log"),
         ("bench_32k.py", [], "/tmp/bench_extras_32k.log"),
     ]
     for tool, extra_args, out in suites:

@@ -1,0 +1,76 @@
+"""CPU smoke tests for the on-chip bench tools.
+
+The driver runs these tools in the bench extras chain on the real chip
+(bench.py _run_extras); a tunnel-down round means they only ever execute
+on hardware, so an API drift (e.g. a Generator signature change) would
+surface as a silent extras failure in a log nobody reads. Each test
+drives a tool's main() end-to-end at tiny shapes on the virtual-CPU
+backend and asserts the measurement lines it promises actually emit.
+"""
+import os
+import runpy
+import sys
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def run_tool(monkeypatch, tmp_path, tool, argv):
+    out = tmp_path / "out.log"
+    monkeypatch.setattr(sys, "argv", [tool, "--out", str(out)] + argv)
+    try:
+        runpy.run_path(os.path.join(TOOLS, tool), run_name="__main__")
+    except SystemExit as e:  # `raise SystemExit(main())` entry idiom
+        assert not e.code, f"{tool} exited rc={e.code}"
+    return out.read_text()
+
+
+def test_bench_head_emits_overhead_table(monkeypatch, tmp_path):
+    text = run_tool(
+        monkeypatch, tmp_path, "bench_head.py",
+        ["--seq", "128", "--hidden", "128", "--ffn", "344", "--heads", "4",
+         "--vocab", "512", "--iters", "2"])
+    assert "t_layer fwd+bwd" in text
+    assert "t_head  fwd+bwd" in text
+    # one overhead line per (pp, L) point, all parseable percentages
+    lines = [l for l in text.splitlines() if "uniform-head overhead" in l]
+    assert len(lines) == 6
+    for l in lines:
+        pct = float(l.split("=")[-1].strip().rstrip("%"))
+        assert 0.0 <= pct < 100.0
+
+
+def test_bench_decode_emits_throughput(monkeypatch, tmp_path):
+    text = run_tool(
+        monkeypatch, tmp_path, "bench_decode.py",
+        ["--batch", "2", "--prompt", "64", "--new", "16", "--layers", "2",
+         "--hidden", "128", "--heads", "4", "--ffn", "344",
+         "--vocab", "512"])
+    assert "new-tok/s" in text
+    # no roofline on cpu (no HBM bandwidth entry) — the line must be absent
+    # rather than printing a nonsense ratio
+    assert "roofline" not in text
+
+
+def test_bench_kernels_smoke_runs_all_arms(monkeypatch, tmp_path):
+    text = run_tool(monkeypatch, tmp_path, "bench_kernels.py",
+                    ["--smoke", "--iters", "2"])
+    # every arm must MEASURE in smoke mode (pallas arms run interpreted
+    # off-TPU) — a FAILED line here is exactly the bitrot this guards
+    assert "FAILED" not in text, text
+    for arm in ("rms fwd", "ln  fwd", "rms vjp", "flash fwd"):
+        assert arm in text, f"missing arm {arm!r}:\n{text}"
+
+
+@pytest.mark.slow
+def test_bench_32k_fit_emits_extrapolation(monkeypatch, tmp_path):
+    # width overrides exist exactly for this smoke path (tool docstring)
+    text = run_tool(
+        monkeypatch, tmp_path, "bench_32k.py",
+        ["--seq_length", "256", "--hidden", "128", "--ffn", "344",
+         "--heads", "4", "--iters", "1", "--warmup", "1"])
+    assert "_slice_train_tokens_per_sec_per_chip" in text
+    assert "extrapolated_7b_" in text
+    assert "EXTRAPOLATED" in text  # the honest-labeling contract

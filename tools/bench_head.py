@@ -67,12 +67,17 @@ def main(argv=None):
     dev = jax.devices()[0]
     emit(f"device: {dev.platform} {getattr(dev, 'device_kind', '?')}")
 
+    # recompute "none": BOTH arms must be plain vjps for the ratio to be
+    # schedule-faithful (the 1F1B schedule checkpoints layer chunks AND
+    # the head alike — pipeline.py:457-462 — so the recompute factor
+    # multiplies both and divides out; leaving "full" here would remat
+    # only the layer arm and understate the head share)
     cfg = llama2_config(
         "tiny", num_layers=1, hidden_size=args.hidden,
         num_attention_heads=args.heads, num_kv_heads=args.heads,
         ffn_hidden_size=args.ffn, vocab_size=args.vocab,
         seq_length=args.seq, compute_dtype="bfloat16",
-        attention_impl="flash", recompute_granularity="full")
+        attention_impl="flash", recompute_granularity="none")
 
     params = lm.model_init(jax.random.PRNGKey(0), cfg)
     rope = lm.make_rope(cfg)
@@ -111,9 +116,13 @@ def main(argv=None):
                                     vocab_size=cfg.vocab_size)
         return jnp.mean(losses)
 
-    # head_logits only reads final_norm + embedding/lm_head; dropping the
-    # stack keeps its weights out of the grad arm
-    head_params = {k: v for k, v in params.items() if k != "transformer"}
+    # head_logits reads ONLY final_norm + lm_head (untied preset); the
+    # stack AND the word embedding must stay out of the grad target or
+    # value_and_grad materializes zero-grads for them every timed
+    # iteration (~0.5 GB of spurious HBM writes at 7B width)
+    head_params = {k: v for k, v in params.items()
+                   if k in ("final_norm", "lm_head")}
+    assert "lm_head" in head_params, "preset unexpectedly tied"
 
     def head_arm(hp, xin):
         return jax.value_and_grad(
@@ -129,9 +138,12 @@ def main(argv=None):
     for pp, L in [(2, 32), (4, 32), (8, 32), (4, 80), (8, 80), (16, 80)]:
         ov = (pp - 1) * t_head / (L * t_layer + pp * t_head)
         emit(f"  pp={pp:2d} L={L:2d}: uniform-head overhead = {ov:.1%}")
-    analytic = (2 * args.vocab) / (2 * args.vocab + 12 * args.hidden)
+    # head = 2hV flops/token (one [h,V] GEMM at 2 flops/MAC); layer =
+    # ~24h^2 (12h^2 params x 2 flops/MAC, attention-score flops excluded
+    # like bench.py's MFU model) -> share = V/(V+12h)
+    analytic = args.vocab / (args.vocab + 12 * args.hidden)
     emit("(overhead = (pp-1)*t_head / (L*t_layer + pp*t_head); analytic "
-         f"FLOP share of head vs one layer 2hV/(2hV+12h^2) = {analytic:.1%},"
+         f"FLOP share of head vs one layer V/(V+12h) = {analytic:.1%},"
          f" measured share = {t_head / (t_head + t_layer):.1%})")
 
 

@@ -25,6 +25,9 @@ def main(argv=None):
     p = argparse.ArgumentParser("bench_kernels", description=__doc__)
     p.add_argument("--out", default="/tmp/kernel_bench.log")
     p.add_argument("--iters", type=int, default=50)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes: exercises every arm end-to-end in "
+                        "seconds (CPU CI smoke; timings meaningless)")
     args = p.parse_args(argv)
 
     import jax
@@ -47,6 +50,9 @@ def main(argv=None):
 
     dev = jax.devices()[0]
     emit(f"device: {dev.platform} {getattr(dev, 'device_kind', '?')}")
+    # off-TPU the raw kernels can only run interpreted; smoke mode opts in
+    interp = args.smoke and dev.platform != "tpu"
+
 
     def timeit(fn, *a):
         jax.block_until_ready(fn(*a))  # compile
@@ -56,8 +62,15 @@ def main(argv=None):
         jax.block_until_ready(out)
         return (time.perf_counter() - t0) / args.iters * 1e6  # us
 
+    norm_shapes = [(4, 2048, 2048), (2, 4096, 4096), (8, 1024, 8192)]
+    flash_shapes = [(2, 2048, 16, 128), (1, 8192, 8, 128),
+                    (1, 32768, 4, 128)]
+    if args.smoke:
+        norm_shapes = [(2, 128, 256)]
+        flash_shapes = [(1, 256, 2, 64)]
+
     # --- norms: pallas vs xla-fused jnp, fwd and vjp ---
-    for (b, s, h) in [(4, 2048, 2048), (2, 4096, 4096), (8, 1024, 8192)]:
+    for (b, s, h) in norm_shapes:
         x = jax.random.normal(jax.random.PRNGKey(0), (b, s, h),
                               jnp.bfloat16)
         scale = jnp.ones((h,), jnp.bfloat16)
@@ -70,18 +83,19 @@ def main(argv=None):
         pairs = [
             ("rms fwd", gb_fwd,
              jax.jit(lambda x, s: rmsnorm({"scale": s}, x)),
-             jax.jit(lambda x, s: pallas_rmsnorm(x, s)), (x, scale)),
+             jax.jit(lambda x, s: pallas_rmsnorm(x, s, interpret=interp)), (x, scale)),
             ("ln  fwd", gb_fwd,
              jax.jit(lambda x, s, b2: layernorm({"scale": s, "bias": b2},
                                                 x)),
-             jax.jit(lambda x, s, b2: pallas_layernorm(x, s, b2)),
+             jax.jit(lambda x, s, b2: pallas_layernorm(
+                 x, s, b2, interpret=interp)),
              (x, scale, bias)),
             ("rms vjp", gb_vjp,
              jax.jit(jax.grad(lambda x, s: jnp.sum(
                  rmsnorm({"scale": s}, x).astype(jnp.float32)
                  * dy.astype(jnp.float32)), argnums=(0, 1))),
              jax.jit(jax.grad(lambda x, s: jnp.sum(
-                 pallas_rmsnorm(x, s).astype(jnp.float32)
+                 pallas_rmsnorm(x, s, interpret=interp).astype(jnp.float32)
                  * dy.astype(jnp.float32)), argnums=(0, 1))), (x, scale)),
         ]
         for name, gb, f_xla, f_pal, fargs in pairs:
@@ -96,13 +110,12 @@ def main(argv=None):
                      f"{type(e).__name__}: {str(e)[:160]}")
 
     # --- flash attention: pallas kernel vs xla blockwise, fwd ---
-    for (b, s, n, d) in [(2, 2048, 16, 128), (1, 8192, 8, 128),
-                         (1, 32768, 4, 128)]:
+    for (b, s, n, d) in flash_shapes:
         q = jax.random.normal(jax.random.PRNGKey(2), (b, s, n, d),
                               jnp.bfloat16)
         try:
             t_p = timeit(jax.jit(lambda q: pallas_flash_attention(
-                q, q, q, True, None)), q)
+                q, q, q, True, None, interpret=interp)), q)
             t_x = timeit(jax.jit(lambda q: _blockwise_attention(
                 q, q, q, causal=True, scale=None, block_kv=512)), q)
             fl = 4 * b * n * s * s * d / 2  # causal matmul flops
