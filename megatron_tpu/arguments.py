@@ -73,6 +73,11 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                    choices=["dot", "flash", "ring", "ulysses"])
     g.add_argument("--recompute_granularity", type=str, default="none",
                    choices=["none", "selective", "full"])
+    # TPU-native counterpart of the reference's TE fp8 mode (the --fp8_*
+    # flags below stay inert: v5e/v5p have no fp8 datapath; int8 is the
+    # hardware's low-precision GEMM lever — see ops/quantized.py)
+    g.add_argument("--quantized_gemm", type=str, default="none",
+                   choices=["none", "int8"])
     g.add_argument("--model", type=str, default=None,
                    help="preset name (llama2-7b, falcon-40b, gpt2, ...)")
 
@@ -274,6 +279,8 @@ _NOOP_FLAGS = [
     "--distribute_saved_activations", "--distributed_backend",
     "--empty_unused_memory_level", "--fp16_lm_cross_entropy",
     "--fp32_residual_connection",
+    # fp8/TE: no fp8 datapath on v5e/v5p — the TPU-native low-precision
+    # GEMM mode is --quantized_gemm int8 (ops/quantized.py)
     "--fp8_amax_compute_algo", "--fp8_amax_history_len", "--fp8_e4m3",
     "--fp8_hybrid", "--fp8_interval", "--fp8_margin", "--no_fp8_wgrad",
     "--head_lr_mult", "--img_h", "--img_w",

@@ -106,6 +106,11 @@ class ModelConfig:
     attention_impl: str = "dot"
     # activation recompute: "none" | "selective" | "full" (ref: arguments.py:601-629)
     recompute_granularity: str = "none"
+    # low-precision GEMM path: "none" | "int8" (forward attention/MLP GEMMs
+    # on the int8 MXU datapath with current-scaling quantization; the
+    # TPU-native counterpart of the reference's TE fp8 mode — see
+    # ops/quantized.py; ref: transformer.py:931-950)
+    quantized_gemm: str = "none"
 
     # glu activations double the first MLP projection
     @property
@@ -118,6 +123,9 @@ class ModelConfig:
                                        "ulysses"), (
             f"attention_impl must be 'dot', 'flash', 'ring' or "
             f"'ulysses', got {self.attention_impl!r}")
+        assert self.quantized_gemm in ("none", "int8"), (
+            f"quantized_gemm must be 'none' or 'int8', "
+            f"got {self.quantized_gemm!r}")
         d: dict[str, Any] = {}
         if self.num_kv_heads is None:
             d["num_kv_heads"] = self.num_attention_heads

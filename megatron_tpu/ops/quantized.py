@@ -1,0 +1,95 @@
+"""Int8 quantized GEMM — the TPU-native counterpart of the reference's
+Transformer Engine fp8 path (ref: megatron/model/transformer.py:931-950 and
+the --fp8_* flag group, megatron/arguments.py:303-313).
+
+The reference reaches low-precision GEMM throughput through TE's fp8
+(H100-only; inert on its A100 targets too). TPU v5e/v5p MXUs have no fp8
+datapath — the hardware's low-precision lever is **int8**, at ~2x the bf16
+MACs/cycle on v5e. This module is the TE recipe rebuilt on that datapath:
+
+- forward GEMMs run int8 x int8 -> int32 on the MXU, with **per-token
+  activation scales** and **per-output-channel weight scales** (the
+  "current scaling" recipe: amax is taken from the tensor being quantized,
+  no cross-step amax history to thread through the train state);
+- the backward runs in the compute dtype on the *unquantized* operands
+  (straight-through estimate; the hybrid recipe the reference exposes as
+  --no_fp8_wgrad, extended to dgrad because e5m2 has no int analogue).
+
+Applied to the attention q/kv/out projections and both MLP GEMMs when
+`ModelConfig.quantized_gemm == "int8"`; the embedding and lm head stay in
+the compute dtype (TE keeps those out of fp8 for the same accuracy
+reasons). Opt in with --quantized_gemm int8.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _quantize_rows(x):
+    """x [..., K] -> (int8 values, fp32 scale [..., 1]) with per-row amax."""
+    ax = jnp.max(jnp.abs(x), axis=-1, keepdims=True).astype(jnp.float32)
+    scale = jnp.where(ax > 0, ax / 127.0, 1.0)
+    xi = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return xi.astype(jnp.int8), scale
+
+
+def _quantize_cols(w):
+    """w [K, N] -> (int8 values, fp32 scale [N]) with per-column amax."""
+    aw = jnp.max(jnp.abs(w), axis=0).astype(jnp.float32)
+    scale = jnp.where(aw > 0, aw / 127.0, 1.0)
+    wi = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[None, :]),
+                  -127, 127)
+    return wi.astype(jnp.int8), scale
+
+
+def _int8_matmul_impl(x, w):
+    xi, sx = _quantize_rows(x)
+    wi, sw = _quantize_cols(w)
+    yi = jax.lax.dot_general(
+        xi, wi, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return (yi.astype(jnp.float32) * sx * sw).astype(x.dtype)
+
+
+@jax.custom_vjp
+def int8_matmul(x, w):
+    """[..., K] @ [K, N] with an int8-MXU forward and a full-precision
+    backward. Numerics: per-row/per-column symmetric quantization bounds
+    the forward's relative error at ~0.4% rms for well-conditioned
+    operands; gradients are exact for the straight-through estimate."""
+    return _int8_matmul_impl(x, w)
+
+
+def _int8_matmul_fwd(x, w):
+    return _int8_matmul_impl(x, w), (x, w)
+
+
+def _int8_matmul_bwd(res, dy):
+    x, w = res
+    # contract dy's N against w's N for dx; batch dims of x against dy for dw
+    dx = jax.lax.dot_general(dy, w, (((dy.ndim - 1,), (1,)), ((), ())))
+    lead = tuple(range(x.ndim - 1))
+    dw = jnp.tensordot(x, dy, axes=(lead, lead))
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+int8_matmul.defvjp(_int8_matmul_fwd, _int8_matmul_bwd)
+
+
+def qdense(x, w, quantized_gemm: str):
+    """Dense-layer dispatch shared by the attention/MLP call sites.
+
+    `w` may carry extra trailing structure (the GLU [h, 2, ffn] layout) —
+    it is flattened to [K, prod(rest)] for the GEMM and the output is
+    reshaped back, so gate/value splits keep their leading-index layout."""
+    if quantized_gemm == "none":
+        if w.ndim == 2:
+            return x @ w
+        return jnp.einsum("...h,hcf->...cf", x, w)
+    assert quantized_gemm == "int8", quantized_gemm
+    if w.ndim == 2:
+        return int8_matmul(x, w)
+    k = w.shape[0]
+    y = int8_matmul(x, w.reshape(k, -1))
+    return y.reshape(*y.shape[:-1], *w.shape[1:])

@@ -109,6 +109,27 @@ def main(argv=None):
                 emit(f"{name} [{b},{s},{h}] FAILED: "
                      f"{type(e).__name__}: {str(e)[:160]}")
 
+    # --- quantized GEMM: int8 datapath vs bf16, fwd (ops/quantized.py —
+    # the TE-fp8-counterpart path; v5e int8 peak is ~2x bf16) ---
+    from megatron_tpu.ops.quantized import int8_matmul
+    gemm_shapes = [(8192, 4096, 11008), (4096, 4096, 4096),
+                   (2048, 8192, 8192)]
+    if args.smoke:
+        gemm_shapes = [(64, 128, 256)]
+    for (m, k, n) in gemm_shapes:
+        x = jax.random.normal(jax.random.PRNGKey(4), (m, k), jnp.bfloat16)
+        w = jax.random.normal(jax.random.PRNGKey(5), (k, n), jnp.bfloat16)
+        fl = 2 * m * k * n
+        try:
+            t_b = timeit(jax.jit(lambda x, w: x @ w), x, w)
+            t_q = timeit(jax.jit(int8_matmul), x, w)
+            emit(f"gemm [{m}x{k}x{n}]: bf16 {t_b:9.1f}us "
+                 f"({fl / (t_b * 1e-6) / 1e12:5.1f} TF/s) | int8(+quant) "
+                 f"{t_q:9.1f}us ({fl / (t_q * 1e-6) / 1e12:5.1f} TOP/s)")
+        except Exception as e:
+            emit(f"gemm [{m}x{k}x{n}] FAILED: "
+                 f"{type(e).__name__}: {str(e)[:160]}")
+
     # --- flash attention: pallas kernel vs xla blockwise, fwd ---
     for (b, s, n, d) in flash_shapes:
         q = jax.random.normal(jax.random.PRNGKey(2), (b, s, n, d),
