@@ -315,6 +315,11 @@ def _run_extras():
         # compiles/runs faster, so a mid-extras kill still leaves it
         ("bench_32k.py", ["--seq_length", "4096"],
          "/tmp/bench_extras_4k.log"),
+        # remat-policy A/B at the headline config (three full
+        # train-compiles — heavy, so AFTER the kill-safe 4k record): if
+        # "none"/"selective" fits HBM it sheds the full-remat recompute
+        # (~25% step time) — promote the winner to the attempt list above
+        ("bench_remat.py", [], "/tmp/bench_extras_remat.log"),
         # serving prefill+decode throughput with an HBM roofline — after
         # the BASELINE slice so a wedge here can't starve that record
         ("bench_decode.py", [], "/tmp/bench_extras_decode.log"),

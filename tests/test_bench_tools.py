@@ -60,7 +60,15 @@ def test_bench_kernels_smoke_runs_all_arms(monkeypatch, tmp_path):
     # every arm must MEASURE in smoke mode (pallas arms run interpreted
     # off-TPU) — a FAILED line here is exactly the bitrot this guards
     assert "FAILED" not in text, text
-    for arm in ("rms fwd", "ln  fwd", "rms vjp", "flash fwd"):
+    for arm in ("rms fwd", "ln  fwd", "rms vjp", "flash fwd", "gemm ["):
+        assert arm in text, f"missing arm {arm!r}:\n{text}"
+
+
+def test_bench_remat_smoke_runs_all_arms(monkeypatch, tmp_path):
+    text = run_tool(monkeypatch, tmp_path, "bench_remat.py",
+                    ["--smoke", "--iters", "2", "--warmup", "1"])
+    assert "FAILED" not in text, text
+    for arm in ("remat=none", "remat=selective", "remat=full", "best:"):
         assert arm in text, f"missing arm {arm!r}:\n{text}"
 
 

@@ -48,6 +48,9 @@ def main(argv=None):
         print(line, flush=True)
         log.write(line + "\n")
 
+    # header BEFORE jax.devices(): a wedged tunnel hangs there, and a
+    # 0-byte log is indistinguishable from "never started"
+    emit("bench_kernels: probing backend...")
     dev = jax.devices()[0]
     emit(f"device: {dev.platform} {getattr(dev, 'device_kind', '?')}")
     # off-TPU the raw kernels can only run interpreted; smoke mode opts in
