@@ -321,8 +321,10 @@ def _run_extras():
         # (~25% step time) — promote the winner to the attempt list above
         ("bench_remat.py", [], "/tmp/bench_extras_remat.log"),
         # serving prefill+decode throughput with an HBM roofline — after
-        # the BASELINE slice so a wedge here can't starve that record
-        ("bench_decode.py", [], "/tmp/bench_extras_decode.log"),
+        # the BASELINE slice so a wedge here can't starve that record;
+        # the int8-weights arm measures the halved weight stream
+        ("bench_decode.py", ["--int8_weights"],
+         "/tmp/bench_extras_decode.log"),
         ("bench_32k.py", [], "/tmp/bench_extras_32k.log"),
     ]
     for tool, extra_args, out in suites:

@@ -135,10 +135,15 @@ class Generator:
         self._rules = None
         self._param_sh = None
         if mesh is not None:
+            from megatron_tpu.ops.quantized import quantize_axes
             from megatron_tpu.parallel import sharding as shd
             self._rules = shd.make_logical_rules(False)
+            # int8-quantized weights (ops/quantized.quantize_weights)
+            # restructure the params tree — align the axes tree with it
+            # so in_shardings still match leaf-for-leaf
             self._param_sh = shd.tree_logical_to_sharding(
-                mesh, lm.model_axes(cfg), self._rules)
+                mesh, quantize_axes(lm.model_axes(cfg), params),
+                self._rules)
 
         def _score_fn(params, tokens):
             logits, _ = lm.model_forward(params, tokens, self.cfg,

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from megatron_tpu.config import ModelConfig
 from megatron_tpu.models.rope import apply_rotary
 from megatron_tpu.ops.dropout import dropout
-from megatron_tpu.ops.quantized import qdense
+from megatron_tpu.ops.quantized import qdense, wcast
 
 
 class KVCache(NamedTuple):
@@ -156,8 +156,8 @@ def attention_apply(
     dtype = x.dtype
     cross = kv_input is not None
 
-    q = qdense(x, params["wq"].astype(dtype), cfg.quantized_gemm)
-    kv = qdense(kv_input if cross else x, params["wkv"].astype(dtype),
+    q = qdense(x, wcast(params["wq"], dtype), cfg.quantized_gemm)
+    kv = qdense(kv_input if cross else x, wcast(params["wkv"], dtype),
                 cfg.quantized_gemm)
     if cfg.use_bias:
         q = q + params["bq"].astype(dtype)
@@ -285,7 +285,7 @@ def attention_apply(
             dropout_rng=dropout_rng, segment_ids=segment_ids)
 
     out = out.reshape(b, s, nq * hd)
-    out = qdense(out, params["wo"].astype(dtype), cfg.quantized_gemm)
+    out = qdense(out, wcast(params["wo"], dtype), cfg.quantized_gemm)
     if cfg.use_bias:
         out = out + params["bo"].astype(dtype)
     return out, kv_cache

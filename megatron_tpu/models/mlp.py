@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from megatron_tpu.config import ModelConfig
-from megatron_tpu.ops.quantized import qdense
+from megatron_tpu.ops.quantized import qdense, wcast
 
 
 def activation_fn(name: str, a, b=None):
@@ -86,14 +86,14 @@ def mlp_apply(params, x, cfg: ModelConfig):
     """x: [b, s, h] -> [b, s, h]."""
     dtype = x.dtype
     # GLU: single h -> 2*ffn GEMM, gate/value as leading index of the output
-    y = qdense(x, params["w1"].astype(dtype), cfg.quantized_gemm)
+    y = qdense(x, wcast(params["w1"], dtype), cfg.quantized_gemm)
     if cfg.use_bias:
         y = y + params["b1"].astype(dtype)
     if cfg.is_glu:
         y = activation_fn(cfg.activation, y[:, :, 0], y[:, :, 1])
     else:
         y = activation_fn(cfg.activation, y)
-    y = qdense(y, params["w2"].astype(dtype), cfg.quantized_gemm)
+    y = qdense(y, wcast(params["w2"], dtype), cfg.quantized_gemm)
     if cfg.use_bias:
         y = y + params["b2"].astype(dtype)
     return y

@@ -22,8 +22,23 @@ reasons). Opt in with --quantized_gemm int8.
 """
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
+
+
+class W8(NamedTuple):
+    """A weight stored int8 with per-output-channel fp32 scales — the
+    serving-side (weight-only storage) half of the int8 path: decode is
+    HBM-bandwidth-bound, and an int8-resident weight halves its stream.
+    Produced by `quantize_weights`; consumed transparently by `qdense`
+    (the GEMM runs on the int8 datapath against per-token-quantized
+    activations). As a NamedTuple it is a pytree: `lax.scan` slices the
+    stacked [L, ...] serving layout per layer, and shardings ride the
+    aligned axes from `quantize_axes`."""
+    q: jax.Array      # int8, same shape as the source weight
+    scale: jax.Array  # fp32, source shape minus the contraction axis
 
 
 def _quantize_rows(x):
@@ -77,12 +92,95 @@ def _int8_matmul_bwd(res, dy):
 int8_matmul.defvjp(_int8_matmul_fwd, _int8_matmul_bwd)
 
 
+def _w8_matmul(x, w8: W8):
+    """[..., K] against a pre-quantized weight: per-token-quantize x,
+    int8 dot against the resident int8 weight, dequantize by both scales.
+    No custom_vjp — this is the serving path; jnp.round's zero cotangent
+    makes accidental differentiation loud (zero grads), not silently
+    wrong."""
+    xi, sx = _quantize_rows(x)
+    k = w8.q.shape[0]
+    wi = w8.q.reshape(k, -1)
+    yi = jax.lax.dot_general(
+        xi, wi, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = (yi.astype(jnp.float32) * sx
+         * w8.scale.reshape(-1).astype(jnp.float32))
+    return y.astype(x.dtype).reshape(*x.shape[:-1], *w8.q.shape[1:])
+
+
+# the contraction axis quantize_weights removes from each STACKED
+# transformer weight [L, K, ...]; quantize_axes must drop the same one
+_STACKED_CONTRACT_AXIS = 1
+_QUANTIZABLE = ("wq", "wkv", "wo", "w1", "w2")
+
+
+def quantize_weights(params):
+    """Serving-time transform: re-store the transformer attention/MLP
+    weights (the _QUANTIZABLE names, scan-stacked [L, K, ...]) as int8
+    W8 leaves with per-layer per-output-channel scales. Embedding, norms
+    and lm head keep their dtype (the TE-style accuracy carve-out).
+    Returns a new params tree; pair with `quantize_axes` for sharded
+    serving."""
+    def walk(name, node):
+        if isinstance(node, dict):
+            return {k: walk(k, v) for k, v in node.items()}
+        if name in _QUANTIZABLE:
+            ax = _STACKED_CONTRACT_AXIS
+            amax = jnp.max(jnp.abs(node), axis=ax).astype(jnp.float32)
+            scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+            qv = jnp.clip(jnp.round(node.astype(jnp.float32)
+                                    / jnp.expand_dims(scale, ax)),
+                          -127, 127).astype(jnp.int8)
+            return W8(q=qv, scale=scale)
+        return node
+    out = dict(params)
+    if "transformer" in out:
+        out["transformer"] = walk("", params["transformer"])
+    return out
+
+
+def quantize_axes(axes, params):
+    """Align a logical-axes tree with a `quantize_weights`-transformed
+    params tree: wherever params holds a W8, the tuple axes leaf expands
+    to W8(q=<original>, scale=<original minus the contraction axis>)."""
+    def fix(ax, p):
+        if isinstance(p, W8):
+            a = _STACKED_CONTRACT_AXIS
+            return W8(q=ax, scale=ax[:a] + ax[a + 1:])
+        return ax
+    # type(x) is tuple: stop at plain axes tuples, but a W8 ALREADY in
+    # the axes tree (double application) would recurse — harmless, fix()
+    # only rewraps against params
+    return jax.tree.map(fix, axes, params,
+                        is_leaf=lambda x: type(x) is tuple)
+
+
+def has_quantized_weights(params) -> bool:
+    return any(isinstance(x, W8) for x in jax.tree.leaves(
+        params, is_leaf=lambda x: isinstance(x, W8)))
+
+
+def wcast(w, dtype):
+    """The call-site weight cast: fp weights cast to the compute dtype;
+    W8 weights pass through untouched (dequantization is fused into the
+    int8 GEMM inside qdense)."""
+    if isinstance(w, W8):
+        return w
+    return w.astype(dtype)
+
+
 def qdense(x, w, quantized_gemm: str):
     """Dense-layer dispatch shared by the attention/MLP call sites.
 
     `w` may carry extra trailing structure (the GLU [h, 2, ffn] layout) —
     it is flattened to [K, prod(rest)] for the GEMM and the output is
-    reshaped back, so gate/value splits keep their leading-index layout."""
+    reshaped back, so gate/value splits keep their leading-index layout.
+    A W8 weight (serving-time int8 storage) takes the int8 datapath
+    regardless of the training-mode flag — the resident weight demands
+    it."""
+    if isinstance(w, W8):
+        return _w8_matmul(x, w)
     if quantized_gemm == "none":
         if w.ndim == 2:
             return x @ w

@@ -96,11 +96,17 @@ def logical_sharding(mesh: Mesh, logical_axes: tuple, rules) -> NamedSharding:
 
 
 def tree_logical_to_sharding(mesh: Mesh, logical_tree, rules):
-    """Map a pytree of logical-axis tuples to NamedShardings."""
+    """Map a pytree of logical-axis tuples to NamedShardings.
+
+    `type(x) is tuple` (not isinstance): axes LEAVES are plain tuples,
+    while NamedTuple pytree nodes in the tree (e.g. the W8 int8-weight
+    containers from ops/quantized.quantize_axes) must be recursed INTO —
+    isinstance would swallow a W8 whole and emit a replicated
+    PartitionSpec() for its int8 payload."""
     return jax.tree.map(
         lambda ax: logical_sharding(mesh, ax, rules),
         logical_tree,
-        is_leaf=lambda x: isinstance(x, tuple),
+        is_leaf=lambda x: type(x) is tuple,
     )
 
 
@@ -207,5 +213,5 @@ def tree_distributed_opt_sharding(mesh: Mesh, logical_tree, rules,
                                                 tuple(sh.shape),
                                                 pipelined=pipelined),
         logical_tree, shape_tree,
-        is_leaf=lambda x: isinstance(x, tuple),
+        is_leaf=lambda x: type(x) is tuple,  # see tree_logical_to_sharding
     )

@@ -208,6 +208,120 @@ def test_int8_convergence_tracks_bf16():
         assert l_q8[i] < l_fp[i] * 1.25 + 0.05, (i, l_q8[i], l_fp[i])
 
 
+class TestWeightQuantizedServing:
+    """W8 int8-resident weights (ops/quantized.quantize_weights) — the
+    serving-side half of the int8 path. Decode is HBM-bandwidth-bound;
+    int8 storage halves the weight stream (bench_decode --int8_weights
+    measures it on-chip)."""
+
+    def _model(self):
+        from megatron_tpu.models.language_model import model_init
+        cfg = _tiny_cfg(num_kv_heads=2, vocab_size=96,
+                        make_vocab_size_divisible_by=32)
+        params = model_init(jax.random.PRNGKey(0), cfg)
+        return params, cfg
+
+    def test_quantized_weights_halve_transformer_bytes(self):
+        from megatron_tpu.ops.quantized import (W8, has_quantized_weights,
+                                                quantize_weights)
+        params, cfg = self._model()
+        pq = quantize_weights(params)
+        assert has_quantized_weights(pq)
+        assert not has_quantized_weights(params)
+
+        def nbytes(t):
+            return sum(x.nbytes for x in jax.tree.leaves(t))
+
+        # fp32 source -> int8 + small scales: ~4x smaller GEMM weights
+        gemm_names = ("wq", "wkv", "wo", "w1", "w2")
+        src = sum(v.nbytes for blk in params["transformer"].values()
+                  if isinstance(blk, dict)
+                  for k, v in blk.items() if k in gemm_names)
+        quant = sum(nbytes(v) for blk in pq["transformer"].values()
+                    if isinstance(blk, dict)
+                    for k, v in blk.items() if k in gemm_names)
+        assert quant < src / 3.5
+        # norms / embedding / head untouched
+        np.testing.assert_array_equal(
+            np.asarray(pq["embedding"]["word_embeddings"]),
+            np.asarray(params["embedding"]["word_embeddings"]))
+
+    def test_quantized_weights_forward_close(self):
+        from megatron_tpu.models.language_model import model_forward
+        from megatron_tpu.ops.quantized import quantize_weights
+        params, cfg = self._model()
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 96)
+        lg, _ = model_forward(params, toks, cfg)
+        lgq, _ = model_forward(quantize_weights(params), toks, cfg)
+        assert _rel_err(lgq, lg) < 0.05
+
+    def test_w8_greedy_decode_matches_w8_full_forward(self):
+        """Per-token activation scales make quantization commute with KV
+        caching: each token's projections are identical whether computed
+        in a full-context forward or a single-token decode step — so the
+        cached greedy decode must reproduce the no-cache argmax oracle
+        exactly, same as the unquantized contract
+        (tests/test_inference.py)."""
+        from megatron_tpu.inference import Generator, SamplingParams
+        from megatron_tpu.models import language_model as lm
+        from megatron_tpu.ops.quantized import quantize_weights
+        params, cfg = self._model()
+        pq = quantize_weights(params)
+        gen = Generator(pq, cfg, eos_id=0, pad_id=0)
+        prompt = [5, 17, 3, 42]
+        max_new = 8
+        tokens, _, _ = gen.generate(
+            [prompt], max_new, sampling=SamplingParams(temperature=0.0))
+
+        rope = lm.make_rope(cfg)
+        seq = list(prompt)
+        for _ in range(max_new):
+            logits, _ = lm.model_forward(pq, jnp.asarray([seq]), cfg,
+                                         rope=rope,
+                                         logits_dtype=jnp.float32)
+            nxt = int(jnp.argmax(logits[0, -1, :cfg.vocab_size]))
+            seq.append(nxt)
+            if nxt == 0:
+                break
+        np.testing.assert_array_equal(
+            np.asarray(tokens[0, :len(seq)]), np.asarray(seq))
+
+    @pytest.mark.slow
+    def test_w8_tp_sharded_decode_matches_single(self, devices):
+        """Sharded serving with W8 params: quantize_axes aligns the
+        in_shardings tree, and tp2 greedy output must equal the
+        single-device one (int32 dot partials psum exactly; per-channel
+        scales are shard-local)."""
+        from megatron_tpu.inference import Generator, SamplingParams
+        from megatron_tpu.ops.quantized import quantize_weights
+        from megatron_tpu.parallel.mesh import build_mesh
+        from megatron_tpu.config import ParallelConfig
+        params, cfg = self._model()
+        pq = quantize_weights(params)
+        prompt = [5, 17, 3, 42]
+        outs = {}
+        for tp in (1, 2):
+            mesh = build_mesh(ParallelConfig(tensor_parallel=tp),
+                              devices=jax.devices()[:tp])
+            gen = Generator(pq, cfg, eos_id=0, pad_id=0, mesh=mesh)
+            if tp == 2:
+                # replication is numerically correct and would make the
+                # equality below pass vacuously — assert the W8 payloads
+                # ACTUALLY tp-shard (the NamedTuple-vs-tuple is_leaf
+                # regression this test exists for)
+                from megatron_tpu.ops.quantized import W8
+                wq_sh = jax.tree.leaves(
+                    gen._param_sh["transformer"]["attention"]["wq"])
+                assert len(wq_sh) == 2, "W8 axes node not recursed into"
+                q_spec = wq_sh[0].spec
+                assert "tp" in jax.tree.leaves(tuple(q_spec)), (
+                    f"W8.q not tp-sharded: {q_spec}")
+            tokens, _, _ = gen.generate(
+                [prompt], 8, sampling=SamplingParams(temperature=0.0))
+            outs[tp] = np.asarray(tokens)
+        np.testing.assert_array_equal(outs[2], outs[1])
+
+
 def test_flag_maps_to_config():
     from megatron_tpu.arguments import parse_cli
     cfg, _ = parse_cli(

@@ -52,6 +52,11 @@ def main(argv=None):
     p.add_argument("--heads", type=int, default=16)
     p.add_argument("--ffn", type=int, default=5504)
     p.add_argument("--vocab", type=int, default=32000)
+    p.add_argument("--int8_weights", action="store_true",
+                   help="ALSO measure with int8-resident transformer "
+                        "weights (ops/quantized.quantize_weights) — the "
+                        "weight stream halves, so the bandwidth-bound "
+                        "decode should speed up toward its new roofline")
     args = p.parse_args(argv)
 
     import jax
@@ -112,10 +117,10 @@ def main(argv=None):
     # KV-cache slice for the current context
     bw = next((v for k, v in _HBM_BW.items()
                if kind.lower().startswith(k.lower())), None)
+    cache_bytes = (2 * args.layers * args.batch *
+                   (args.prompt + args.new / 2) * args.heads *
+                   (args.hidden // args.heads) * 2)
     if bw:
-        cache_bytes = (2 * args.layers * args.batch *
-                       (args.prompt + args.new / 2) * args.heads *
-                       (args.hidden // args.heads) * 2)
         step_bytes = n_params * 2 + cache_bytes
         ideal_step_s = step_bytes / bw
         emit(f"roofline: {step_bytes/1e9:.2f} GB/step @ {bw/1e9:.0f} GB/s "
@@ -123,6 +128,36 @@ def main(argv=None):
              f"(measured/ideal = {tok_s * ideal_step_s / args.batch:.2f})")
     emit("note: per-batch-step sampling + done-mask bookkeeping ride the "
          "same jit; prefill is amortized over the call, not subtracted")
+
+    if args.int8_weights:
+        from megatron_tpu.ops.quantized import quantize_weights
+        pq = quantize_weights(params)
+        # free the bf16 generator (params, compiled decode executables)
+        # before the int8 arm compiles: both resident at 7B-class shapes
+        # would OOM a v5e — and this arm measures HBM bandwidth, so
+        # leftover pressure would skew it
+        gen = out = params = None
+        q_bytes = sum(x.nbytes for x in jax.tree.leaves(pq))
+        emit(f"int8 weights: param bytes {n_params*2/1e9:.2f} GB -> "
+             f"{q_bytes/1e9:.2f} GB")
+        gen_q = Generator(pq, cfg, eos_id=-1)
+        t0 = time.perf_counter()
+        gen_q.generate(prompts, max_new_tokens=args.new, seed=1)
+        compile_q = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(iters):
+            gen_q.generate(prompts, max_new_tokens=args.new, seed=2 + i)
+        dt_q = (time.perf_counter() - t0) / iters
+        tok_s_q = new_toks / dt_q
+        emit(f"int8 generate: {dt_q*1e3:.1f} ms/call -> {tok_s_q:.0f} "
+             f"new-tok/s ({tok_s_q/tok_s:.2f}x vs bf16)")
+        if bw:
+            step_bytes_q = q_bytes + cache_bytes
+            ideal_q = step_bytes_q / bw
+            emit(f"int8 roofline: {step_bytes_q/1e9:.2f} GB/step -> ideal "
+                 f"{args.batch/ideal_q:.0f} new-tok/s (measured/ideal = "
+                 f"{tok_s_q * ideal_q / args.batch:.2f}; compile "
+                 f"{compile_q:.1f}s)")
 
 
 if __name__ == "__main__":
