@@ -37,17 +37,38 @@ from megatron_tpu.ops.quantized import qdense, wcast
 
 
 class KVCache(NamedTuple):
-    """Functional KV cache (ref: InferenceParams, forward_step.py:17-42)."""
+    """Functional KV cache (ref: InferenceParams, forward_step.py:17-42).
+
+    dtype=jnp.int8 stores k/v int8 with per-(batch, token, head) fp32
+    scales (k_scale/v_scale, amax over head_dim) — decode streams the
+    whole cache every step, so int8 halves the bandwidth-bound cache
+    read AND the residency: a 7B 32k-context cache (~17 GB bf16) does
+    not fit a 16 GB v5e at all until quantized. Entries are quantized at
+    write time and dequantized at read — including the current decode
+    token's own k/v (one round-trip, same ~0.4% error as the rest of
+    the cache); only the offset-0 flash-prefill branch bypasses the
+    cache entirely (it reads the raw projections)."""
     k: jax.Array  # [batch, max_seq, n_kv_heads, head_dim]
     v: jax.Array
     offset: jax.Array  # scalar int32: tokens already in cache
+    k_scale: Optional[jax.Array] = None  # [batch, max_seq, n_kv, 1] fp32
+    v_scale: Optional[jax.Array] = None
 
     @staticmethod
-    def create(batch: int, max_seq: int, n_kv: int, head_dim: int, dtype=jnp.bfloat16):
+    def create(batch: int, max_seq: int, n_kv: int, head_dim: int,
+               dtype=jnp.bfloat16):
+        shape = (batch, max_seq, n_kv, head_dim)
+        # normalize: accept "int8" the way cfg dtypes are spelled — the
+        # raw `dtype == jnp.int8` would be False for the string while
+        # jnp.zeros still allocated int8, leaving scales None (crash at
+        # the first cache write)
+        quant = jnp.dtype(dtype) == jnp.dtype(jnp.int8)
         return KVCache(
-            k=jnp.zeros((batch, max_seq, n_kv, head_dim), dtype=dtype),
-            v=jnp.zeros((batch, max_seq, n_kv, head_dim), dtype=dtype),
+            k=jnp.zeros(shape, dtype=dtype),
+            v=jnp.zeros(shape, dtype=dtype),
             offset=jnp.zeros((), dtype=jnp.int32),
+            k_scale=jnp.ones(shape[:3] + (1,), jnp.float32) if quant else None,
+            v_scale=jnp.ones(shape[:3] + (1,), jnp.float32) if quant else None,
         )
 
 
@@ -201,10 +222,26 @@ def attention_apply(
 
     if kv_cache is not None:
         # incremental decode: write new k/v at offset, attend over full prefix
-        new_k = jax.lax.dynamic_update_slice_in_dim(kv_cache.k, k.astype(kv_cache.k.dtype), kv_cache.offset, axis=1)
-        new_v = jax.lax.dynamic_update_slice_in_dim(kv_cache.v, v.astype(kv_cache.v.dtype), kv_cache.offset, axis=1)
-        kv_cache = KVCache(new_k, new_v, kv_cache.offset + s)
-        k, v = new_k.astype(dtype), new_v.astype(dtype)
+        if kv_cache.k.dtype == jnp.int8:
+            from megatron_tpu.ops.quantized import quantize_rows
+            ki, ks = quantize_rows(k)  # per (b, token, head) over head_dim
+            vi, vs = quantize_rows(v)
+            dus = jax.lax.dynamic_update_slice_in_dim
+            new_k = dus(kv_cache.k, ki, kv_cache.offset, axis=1)
+            new_v = dus(kv_cache.v, vi, kv_cache.offset, axis=1)
+            new_ks = dus(kv_cache.k_scale, ks, kv_cache.offset, axis=1)
+            new_vs = dus(kv_cache.v_scale, vs, kv_cache.offset, axis=1)
+            kv_cache = KVCache(new_k, new_v, kv_cache.offset + s,
+                               new_ks, new_vs)
+            # dequant at read; XLA fuses convert*scale into the attention
+            # dot's operand load, so HBM streams the int8 payload
+            k = new_k.astype(dtype) * new_ks.astype(dtype)
+            v = new_v.astype(dtype) * new_vs.astype(dtype)
+        else:
+            new_k = jax.lax.dynamic_update_slice_in_dim(kv_cache.k, k.astype(kv_cache.k.dtype), kv_cache.offset, axis=1)
+            new_v = jax.lax.dynamic_update_slice_in_dim(kv_cache.v, v.astype(kv_cache.v.dtype), kv_cache.offset, axis=1)
+            kv_cache = KVCache(new_k, new_v, kv_cache.offset + s)
+            k, v = new_k.astype(dtype), new_v.astype(dtype)
 
     scale = 1.0 / math.sqrt(hd)
     # Note on apply_query_key_layer_scaling: in the reference it divides QK^T

@@ -41,7 +41,7 @@ class W8(NamedTuple):
     scale: jax.Array  # fp32, source shape minus the contraction axis
 
 
-def _quantize_rows(x):
+def quantize_rows(x):
     """x [..., K] -> (int8 values, fp32 scale [..., 1]) with per-row amax."""
     ax = jnp.max(jnp.abs(x), axis=-1, keepdims=True).astype(jnp.float32)
     scale = jnp.where(ax > 0, ax / 127.0, 1.0)
@@ -59,7 +59,7 @@ def _quantize_cols(w):
 
 
 def _int8_matmul_impl(x, w):
-    xi, sx = _quantize_rows(x)
+    xi, sx = quantize_rows(x)
     wi, sw = _quantize_cols(w)
     yi = jax.lax.dot_general(
         xi, wi, (((x.ndim - 1,), (0,)), ((), ())),
@@ -98,7 +98,7 @@ def _w8_matmul(x, w8: W8):
     No custom_vjp — this is the serving path; jnp.round's zero cotangent
     makes accidental differentiation loud (zero grads), not silently
     wrong."""
-    xi, sx = _quantize_rows(x)
+    xi, sx = quantize_rows(x)
     k = w8.q.shape[0]
     wi = w8.q.reshape(k, -1)
     yi = jax.lax.dot_general(

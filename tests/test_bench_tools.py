@@ -47,10 +47,13 @@ def test_bench_decode_emits_throughput(monkeypatch, tmp_path):
         monkeypatch, tmp_path, "bench_decode.py",
         ["--batch", "2", "--prompt", "64", "--new", "16", "--layers", "2",
          "--hidden", "128", "--heads", "4", "--ffn", "344",
-         "--vocab", "512", "--int8_weights"])
+         "--vocab", "512", "--int8_weights", "--int8_kv"])
     assert "new-tok/s" in text
-    # the int8-resident-weights arm must measure and report its ratio
-    assert "int8 generate:" in text and "x vs bf16" in text
+    # every quantized arm must measure and report its ratio
+    for arm in ("int8 generate:", "int8kv generate:",
+                "int8w+kv generate:"):
+        assert arm in text, f"missing {arm!r}:\n{text}"
+    assert "x vs bf16" in text
     # no roofline on cpu (no HBM bandwidth entry) — the line must be absent
     # rather than printing a nonsense ratio
     assert "roofline" not in text

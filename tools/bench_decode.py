@@ -57,6 +57,12 @@ def main(argv=None):
                         "weights (ops/quantized.quantize_weights) — the "
                         "weight stream halves, so the bandwidth-bound "
                         "decode should speed up toward its new roofline")
+    p.add_argument("--int8_kv", action="store_true",
+                   help="ALSO measure with the int8 KV cache "
+                        "(Generator kv_cache_dtype=jnp.int8) — halves "
+                        "the cache stream, the dominant term at long "
+                        "context; with --int8_weights a combined arm "
+                        "runs too")
     args = p.parse_args(argv)
 
     import jax
@@ -90,74 +96,84 @@ def main(argv=None):
     n_params = sum(p.size for p in jax.tree.leaves(params))
     emit(f"model: {n_params/1e9:.3f}B params, L={args.layers} h={args.hidden}")
 
-    gen = Generator(params, cfg, eos_id=-1)  # eos -1: never terminates early
     rng_prompts = np.random.RandomState(0)
     prompts = [list(rng_prompts.randint(0, args.vocab, args.prompt))
                for _ in range(args.batch)]
-
-    # warmup = compile (prefill + decode loop)
-    t0 = time.perf_counter()
-    gen.generate(prompts, max_new_tokens=args.new, seed=1)
-    compile_s = time.perf_counter() - t0
-
-    iters = 3
-    t0 = time.perf_counter()
-    for i in range(iters):
-        out = gen.generate(prompts, max_new_tokens=args.new, seed=2 + i)
-    dt = (time.perf_counter() - t0) / iters
-
     new_toks = args.batch * args.new
-    tok_s = new_toks / dt
-    emit(f"compile+first: {compile_s:.1f}s")
-    emit(f"generate(batch={args.batch}, prompt={args.prompt}, "
-         f"new={args.new}): {dt*1e3:.1f} ms/call -> {tok_s:.0f} "
-         f"new-tok/s ({tok_s/args.batch:.1f} tok/s/seq)")
-
-    # decode roofline: every decode step reads all params (bf16) + the
-    # KV-cache slice for the current context
+    iters = 3
     bw = next((v for k, v in _HBM_BW.items()
                if kind.lower().startswith(k.lower())), None)
-    cache_bytes = (2 * args.layers * args.batch *
-                   (args.prompt + args.new / 2) * args.heads *
-                   (args.hidden // args.heads) * 2)
-    if bw:
-        step_bytes = n_params * 2 + cache_bytes
-        ideal_step_s = step_bytes / bw
-        emit(f"roofline: {step_bytes/1e9:.2f} GB/step @ {bw/1e9:.0f} GB/s "
-             f"-> ideal {args.batch/ideal_step_s:.0f} new-tok/s "
-             f"(measured/ideal = {tok_s * ideal_step_s / args.batch:.2f})")
-    emit("note: per-batch-step sampling + done-mask bookkeeping ride the "
-         "same jit; prefill is amortized over the call, not subtracted")
+    # per-decode-step HBM streams: all params + the cache slice for the
+    # mean context length (+ the int8 cache's fp32 scales, 1/hd of it)
+    bf16_cache = (2 * args.layers * args.batch *
+                  (args.prompt + args.new / 2) * args.heads *
+                  (args.hidden // args.heads) * 2)
+    int8_cache = bf16_cache / 2 * (1 + 4 / (args.hidden // args.heads))
+    bf16_params = n_params * 2
 
+    from megatron_tpu.ops.quantized import quantize_weights
+    state = {"params": params, "pq": None, "pq_bytes": 0}
+    del params
+
+    def make_params(int8_w):
+        if not int8_w:
+            return state["params"]
+        if state["pq"] is None:
+            state["pq"] = quantize_weights(state["params"])
+            state["pq_bytes"] = sum(x.nbytes
+                                    for x in jax.tree.leaves(state["pq"]))
+            # the fp originals are no longer needed by any later arm
+            # (bf16-param arms run first) — drop them so quantized arms
+            # at 7B-class shapes don't hold both trees in HBM
+            state["params"] = None
+        return state["pq"]
+
+    # bf16-param arms FIRST: once a quantized arm runs, the fp tree is
+    # freed and unquantized arms would be impossible
+    arms = [("bf16", False, False)]
+    if args.int8_kv:
+        arms.append(("int8kv", False, True))
     if args.int8_weights:
-        from megatron_tpu.ops.quantized import quantize_weights
-        pq = quantize_weights(params)
-        # free the bf16 generator (params, compiled decode executables)
-        # before the int8 arm compiles: both resident at 7B-class shapes
-        # would OOM a v5e — and this arm measures HBM bandwidth, so
-        # leftover pressure would skew it
-        gen = out = params = None
-        q_bytes = sum(x.nbytes for x in jax.tree.leaves(pq))
-        emit(f"int8 weights: param bytes {n_params*2/1e9:.2f} GB -> "
-             f"{q_bytes/1e9:.2f} GB")
-        gen_q = Generator(pq, cfg, eos_id=-1)
+        arms.append(("int8", True, False))
+    if args.int8_weights and args.int8_kv:
+        arms.append(("int8w+kv", True, True))
+
+    base_tok_s = None
+    for name, int8_w, int8_kv in arms:
+        # one generator at a time: two resident at 7B-class shapes would
+        # OOM a v5e, and leftover HBM pressure skews a bandwidth bench
+        gen = Generator(make_params(int8_w), cfg, eos_id=-1,
+                        kv_cache_dtype=jnp.int8 if int8_kv
+                        else jnp.bfloat16)
         t0 = time.perf_counter()
-        gen_q.generate(prompts, max_new_tokens=args.new, seed=1)
-        compile_q = time.perf_counter() - t0
+        gen.generate(prompts, max_new_tokens=args.new, seed=1)
+        compile_s = time.perf_counter() - t0
         t0 = time.perf_counter()
         for i in range(iters):
-            gen_q.generate(prompts, max_new_tokens=args.new, seed=2 + i)
-        dt_q = (time.perf_counter() - t0) / iters
-        tok_s_q = new_toks / dt_q
-        emit(f"int8 generate: {dt_q*1e3:.1f} ms/call -> {tok_s_q:.0f} "
-             f"new-tok/s ({tok_s_q/tok_s:.2f}x vs bf16)")
+            gen.generate(prompts, max_new_tokens=args.new, seed=2 + i)
+        dt = (time.perf_counter() - t0) / iters
+        gen = None
+        tok_s = new_toks / dt
+        vs = ""
+        if base_tok_s is None:
+            base_tok_s = tok_s
+            emit(f"compile+first: {compile_s:.1f}s")
+        else:
+            vs = f" ({tok_s/base_tok_s:.2f}x vs bf16)"
+        label = ("generate" if name == "bf16" else f"{name} generate:")
+        emit(f"{label}(batch={args.batch}, prompt={args.prompt}, "
+             f"new={args.new}): {dt*1e3:.1f} ms/call -> {tok_s:.0f} "
+             f"new-tok/s ({tok_s/args.batch:.1f} tok/s/seq){vs}")
         if bw:
-            step_bytes_q = q_bytes + cache_bytes
-            ideal_q = step_bytes_q / bw
-            emit(f"int8 roofline: {step_bytes_q/1e9:.2f} GB/step -> ideal "
-                 f"{args.batch/ideal_q:.0f} new-tok/s (measured/ideal = "
-                 f"{tok_s_q * ideal_q / args.batch:.2f}; compile "
-                 f"{compile_q:.1f}s)")
+            step_bytes = ((state["pq_bytes"] if int8_w else bf16_params)
+                          + (int8_cache if int8_kv else bf16_cache))
+            ideal = step_bytes / bw
+            emit(f"  {name} roofline: {step_bytes/1e9:.2f} GB/step @ "
+                 f"{bw/1e9:.0f} GB/s -> ideal {args.batch/ideal:.0f} "
+                 f"new-tok/s (measured/ideal = "
+                 f"{tok_s * ideal / args.batch:.2f})")
+    emit("note: per-batch-step sampling + done-mask bookkeeping ride the "
+         "same jit; prefill is amortized over the call, not subtracted")
 
 
 if __name__ == "__main__":
