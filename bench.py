@@ -323,7 +323,7 @@ def _run_extras():
         # serving prefill+decode throughput with an HBM roofline — after
         # the BASELINE slice so a wedge here can't starve that record;
         # the int8-weights arm measures the halved weight stream
-        ("bench_decode.py", ["--int8_weights"],
+        ("bench_decode.py", ["--int8_weights", "--int8_kv"],
          "/tmp/bench_extras_decode.log"),
         ("bench_32k.py", [], "/tmp/bench_extras_32k.log"),
     ]

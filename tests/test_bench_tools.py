@@ -50,10 +50,10 @@ def test_bench_decode_emits_throughput(monkeypatch, tmp_path):
          "--vocab", "512", "--int8_weights", "--int8_kv"])
     assert "new-tok/s" in text
     # every quantized arm must measure and report its ratio
-    for arm in ("int8 generate:", "int8kv generate:",
-                "int8w+kv generate:"):
+    for arm in ("int8 generate(", "int8kv generate(",
+                "int8w+kv generate("):
         assert arm in text, f"missing {arm!r}:\n{text}"
-    assert "x vs bf16" in text
+    assert "x vs bf16" in text and "param bytes" in text
     # no roofline on cpu (no HBM bandwidth entry) — the line must be absent
     # rather than printing a nonsense ratio
     assert "roofline" not in text

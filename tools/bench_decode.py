@@ -157,13 +157,16 @@ def main(argv=None):
         vs = ""
         if base_tok_s is None:
             base_tok_s = tok_s
-            emit(f"compile+first: {compile_s:.1f}s")
         else:
             vs = f" ({tok_s/base_tok_s:.2f}x vs bf16)"
-        label = ("generate" if name == "bf16" else f"{name} generate:")
+        if int8_w:
+            vs += (f" [param bytes {bf16_params/1e9:.2f} GB -> "
+                   f"{state['pq_bytes']/1e9:.2f} GB]")
+        label = "generate" if name == "bf16" else f"{name} generate"
         emit(f"{label}(batch={args.batch}, prompt={args.prompt}, "
              f"new={args.new}): {dt*1e3:.1f} ms/call -> {tok_s:.0f} "
-             f"new-tok/s ({tok_s/args.batch:.1f} tok/s/seq){vs}")
+             f"new-tok/s ({tok_s/args.batch:.1f} tok/s/seq, compile "
+             f"{compile_s:.1f}s){vs}")
         if bw:
             step_bytes = ((state["pq_bytes"] if int8_w else bf16_params)
                           + (int8_cache if int8_kv else bf16_cache))

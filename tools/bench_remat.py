@@ -72,9 +72,14 @@ def main(argv=None):
         micro_bs, n_micro = 2, 4
 
     results = {}
-    for remat in ("none", "selective", "full"):
+    # the int8 arm measures the quantized-GEMM training path at the same
+    # shape: forward GEMMs on the int8 datapath (~2x bf16 MXU peak),
+    # backward in bf16 — an upper bound of ~1.3x if matmul-bound
+    for remat, qg in (("none", "none"), ("selective", "none"),
+                      ("full", "none"), ("full", "int8")):
+        arm = remat if qg == "none" else f"{remat}+int8"
         model = llama2_config("tiny", compute_dtype="bfloat16",
-                              attention_impl="flash",
+                              attention_impl="flash", quantized_gemm=qg,
                               recompute_granularity=remat, **shape)
         cfg = MegatronConfig(
             model=model,
@@ -105,13 +110,13 @@ def main(argv=None):
             jax.block_until_ready(m["lm_loss"])
             dt = time.perf_counter() - t0
             tok_s = n_micro * micro_bs * seq * args.iters / dt
-            results[remat] = tok_s
-            emit(f"remat={remat:9s}: {tok_s:9.1f} tok/s "
+            results[arm] = tok_s
+            emit(f"remat={arm:9s}: {tok_s:9.1f} tok/s "
                  f"(warmup+compile {t0 - t_compile:.1f}s, "
                  f"loss {float(m['lm_loss']):.3f})")
         except Exception as e:
-            results[remat] = None
-            emit(f"remat={remat:9s}: FAILED {type(e).__name__}: "
+            results[arm] = None
+            emit(f"remat={arm:9s}: FAILED {type(e).__name__}: "
                  f"{str(e)[:200]}")
         finally:
             # the failed arm's state pins HBM via live references —
