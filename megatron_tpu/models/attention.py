@@ -295,9 +295,13 @@ def attention_apply(
             from megatron_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True, scale=scale)
     elif cfg.attention_impl == "flash" and kv_cache is None \
-            and segment_ids is None and not dropout_active:
+            and not dropout_active:
         from megatron_tpu.ops.flash_attention import flash_attention
-        out = flash_attention(q, k, v, causal=causal, scale=scale)
+        # segment_ids ride into the kernel (EOD-reset block-diagonal
+        # masking, ref: --reset_attention_mask) — O(s) memory where the
+        # dot path would materialize the [s, s] scores
+        out = flash_attention(q, k, v, causal=causal, scale=scale,
+                              segment_ids=segment_ids)
     elif prefill_flash:
         from megatron_tpu.ops.flash_attention import flash_attention
 

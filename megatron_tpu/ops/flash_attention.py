@@ -12,8 +12,10 @@ backend and is the numerics reference. The hand-tuned Pallas TPU kernel
 (`megatron_tpu.ops.flash_attention_pallas`) overrides it on TPU when
 available; both share this module's interface:
 
-    flash_attention(q, k, v, *, causal, scale) -> out
-      q: [b, sq, nq, d], k/v: [b, skv, nkv, d], GQA by nq % nkv == 0.
+    flash_attention(q, k, v, *, causal, scale, segment_ids) -> out
+      q: [b, sq, nq, d], k/v: [b, skv, nkv, d], GQA by nq % nkv == 0;
+      segment_ids [b, s] masks attention block-diagonally across
+      EOD-separated documents (ref: --reset_attention_mask).
 """
 from __future__ import annotations
 
@@ -30,8 +32,14 @@ _warned_shapes = set()
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_kv", "use_pallas"))
 def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
-                    block_kv: int = DEFAULT_BLOCK_KV, use_pallas: bool | None = None):
-    """Blockwise attention with online softmax. Returns [b, sq, nq, d]."""
+                    block_kv: int = DEFAULT_BLOCK_KV, use_pallas: bool | None = None,
+                    segment_ids=None):
+    """Blockwise attention with online softmax. Returns [b, sq, nq, d].
+
+    `segment_ids` [b, s] (shared q/k length) masks attention across
+    EOD-separated documents (ref: --reset_attention_mask) — the flash
+    formulation of the reference's block-diagonal mask, O(s) memory
+    instead of the dot path's O(s^2) scores."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas and (q.shape[1] % 128 != 0 or k.shape[1] % 128 != 0):
@@ -49,15 +57,22 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
     if use_pallas:
         try:
             from megatron_tpu.ops.flash_attention_pallas import pallas_flash_attention
-            # positional: custom_vjp functions reject keyword arguments
-            return pallas_flash_attention(q, k, v, causal, scale)
+            # positional: custom_vjp functions reject keyword arguments;
+            # ids go in as floats so every diff arg is float
+            from megatron_tpu.ops.flash_attention_pallas import (
+                DEFAULT_BLOCK_KV as PBKV, DEFAULT_BLOCK_Q as PBQ)
+            seg = (segment_ids.astype(jnp.float32)
+                   if segment_ids is not None else None)
+            return pallas_flash_attention(
+                q, k, v, causal, scale, PBQ, PBKV, False, seg, seg)
         except ImportError:
             pass
     return _blockwise_attention(q, k, v, causal=causal, scale=scale,
-                                block_kv=block_kv)
+                                block_kv=block_kv, segment_ids=segment_ids)
 
 
-def _blockwise_attention(q, k, v, *, causal, scale, block_kv):
+def _blockwise_attention(q, k, v, *, causal, scale, block_kv,
+                         segment_ids=None):
     b, sq, nq, d = q.shape
     skv, nkv = k.shape[1], k.shape[2]
     if scale is None:
@@ -70,6 +85,12 @@ def _blockwise_attention(q, k, v, *, causal, scale, block_kv):
     if pad:
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    k_seg_blocks = None
+    if segment_ids is not None:
+        k_seg = segment_ids
+        if pad:  # pad with -1: matches no real document id
+            k_seg = jnp.pad(k_seg, ((0, 0), (0, pad)), constant_values=-1)
+        k_seg_blocks = k_seg.reshape(b, n_blocks, block_kv)
 
     qg = (q.astype(jnp.float32) * scale).reshape(b, sq, nkv, g, d)
     kb = k.astype(jnp.float32).reshape(b, n_blocks, block_kv, nkv, d)
@@ -84,9 +105,15 @@ def _blockwise_attention(q, k, v, *, causal, scale, block_kv):
         valid = kv_pos < skv
         if causal:
             valid = valid[None, :] & (q_pos[:, None] >= kv_pos[None, :])
-            s = jnp.where(valid[None, :, None, None, :], s, -jnp.inf)
+            valid = jnp.broadcast_to(valid[None], (b, sq, block_kv))
         else:
-            s = jnp.where(valid[None, None, None, None, :], s, -jnp.inf)
+            valid = jnp.broadcast_to(valid[None, None], (b, sq, block_kv))
+        if segment_ids is not None:
+            # block-diagonal across documents (--reset_attention_mask)
+            ksj = jax.lax.dynamic_index_in_dim(k_seg_blocks, j, axis=1,
+                                               keepdims=False)
+            valid = valid & (segment_ids[:, :, None] == ksj[:, None, :])
+        s = jnp.where(valid[:, :, None, None, :], s, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) -> use 0
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)

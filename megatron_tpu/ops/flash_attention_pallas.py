@@ -1,4 +1,4 @@
-"""Pallas TPU flash attention: causal + GQA, forward and backward.
+"""Pallas TPU flash attention: causal + GQA + segment masks, fwd and bwd.
 
 TPU-native replacement for the reference's CUDA attention kernels — the
 external FlashAttention-2 package (ref: megatron/model/transformer.py:514-522
@@ -36,6 +36,12 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_KV = 512
 NEG_INF = -1e30
+# exp clamp for rows whose every score in a block is masked (possible with
+# segment masking: a document's rows see zero keys in a foreign-document
+# block). exp(s - max(m, CLAMP)) = exp(NEG_INF + 1e20) == 0 for masked
+# entries even when the running max itself is still NEG_INF; real scores
+# always exceed the clamp so normal rows are untouched.
+MASK_CLAMP = -1e20
 # Per-row stats (lse, delta) carry a trailing lanes dim: TPU lowering requires
 # the last two block dims be (8k, 128k) or equal to the array dims, so a
 # rank-3 [b, n, s] stat with block (1, 1, bq) cannot lower. Stats are stored
@@ -45,8 +51,16 @@ NEG_INF = -1e30
 STAT_LANES = 8
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, block_q, block_kv, num_kv):
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, block_q,
+                block_kv, num_kv, has_segs=False):
+    # refs: [qs_ref, ks_ref]? o_ref, lse_ref, acc_ref, m_ref, l_ref —
+    # segment-id blocks are inputs only when segment masking is on, so the
+    # plain path pays zero extra DMA
+    if has_segs:
+        qs_ref, ks_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        qs_ref = ks_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -74,11 +88,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
             s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        if has_segs:
+            # block-diagonal across documents (ref: --reset_attention_mask,
+            # megatron/utils.py:137-194); ids ride as f32 lanes, equality
+            # on small ints is exact
+            q_seg = qs_ref[0][:, :1]                     # [bq, 1]
+            k_seg = ks_ref[0][:, 0][None, :]             # [1, bkv]
+            s = jnp.where(q_seg == k_seg, s, NEG_INF)
 
         m_prev = m_ref[:, :1]                            # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
+        # MASK_CLAMP: a row can be fully masked in this block (foreign
+        # document) — without the clamp exp(NEG_INF - NEG_INF) == 1 would
+        # attend uniformly to the masked keys
+        p = jnp.exp(s - jnp.maximum(m_new, MASK_CLAMP))
         alpha = jnp.exp(m_prev - m_new)                  # [bq, 1]
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
@@ -97,14 +121,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    *refs, scale, causal, block_q, block_kv, num_kv,
-                   has_dlse=False):
-    # refs: [dlse_ref]? dq_ref, dq_acc — dlse is only an input when a real
-    # lse cotangent exists (ring attention); the plain path skips the DMA
+                   has_dlse=False, has_segs=False):
+    # refs: [qs_ref, ks_ref]? [dlse_ref]? dq_ref, dq_acc — segment blocks
+    # and dlse are inputs only when the respective feature is on (the
+    # plain path skips both DMAs)
+    refs = list(refs)
+    qs_ref = ks_ref = dlse_ref = None
+    if has_segs:
+        qs_ref, ks_ref = refs[0], refs[1]
+        refs = refs[2:]
     if has_dlse:
-        dlse_ref, dq_ref, dq_acc = refs
-    else:
-        dq_ref, dq_acc = refs
-        dlse_ref = None
+        dlse_ref = refs[0]
+        refs = refs[1:]
+    dq_ref, dq_acc = refs
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -122,7 +151,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, :1]                       # [bq, 1]
+        # clamp like the forward: a fully-masked row's lse is NEG_INF and
+        # exp(NEG_INF - NEG_INF) would resurrect its masked entries
+        lse = jnp.maximum(lse_ref[0, 0][:, :1], MASK_CLAMP)  # [bq, 1]
         delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
@@ -132,6 +163,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
             s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        if has_segs:
+            q_seg = qs_ref[0][:, :1]
+            k_seg = ks_ref[0][:, 0][None, :]
+            s = jnp.where(q_seg == k_seg, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -152,12 +187,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     *refs, scale, causal, block_q, block_kv, num_q,
-                    has_dlse=False):
+                    has_dlse=False, has_segs=False):
+    refs = list(refs)
+    qs_ref = ks_ref = dlse_ref = None
+    if has_segs:
+        qs_ref, ks_ref = refs[0], refs[1]
+        refs = refs[2:]
     if has_dlse:
-        dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
-    else:
-        dk_ref, dv_ref, dk_acc, dv_acc = refs
-        dlse_ref = None
+        dlse_ref = refs[0]
+        refs = refs[1:]
+    dk_ref, dv_ref, dk_acc, dv_acc = refs
     ki = pl.program_id(2)
     qi = pl.program_id(3)
 
@@ -177,7 +216,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, :1]                       # [bq, 1]
+        lse = jnp.maximum(lse_ref[0, 0][:, :1], MASK_CLAMP)  # [bq, 1]
         delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
@@ -187,6 +226,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
             s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        if has_segs:
+            q_seg = qs_ref[0][:, :1]
+            k_seg = ks_ref[0][:, 0][None, :]
+            s = jnp.where(q_seg == k_seg, s, NEG_INF)
         p = jnp.exp(s - lse)                             # [bq, bkv]
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -227,16 +270,30 @@ def _pick_blocks(sq, sk, block_q, block_kv):
     return _pick_block(sq, block_q), _pick_block(sk, block_kv)
 
 
+def _seg_lanes(seg, lanes=STAT_LANES):
+    """[b, s] f32 segment ids -> [b, s, lanes] broadcast (same trick as
+    the lse/delta stats: the trailing lanes dim satisfies TPU tiling)."""
+    return jnp.broadcast_to(seg.astype(jnp.float32)[..., None],
+                            seg.shape + (lanes,))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def pallas_flash_attention(q, k, v, causal=True, scale=None,
                            block_q=DEFAULT_BLOCK_Q, block_kv=DEFAULT_BLOCK_KV,
-                           interpret=False):
-    """q [b, sq, nq, d], k/v [b, sk, nkv, d] -> [b, sq, nq, d]."""
-    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret)
+                           interpret=False, q_seg=None, k_seg=None):
+    """q [b, sq, nq, d], k/v [b, sk, nkv, d] -> [b, sq, nq, d].
+
+    `q_seg`/`k_seg` [b, s] FLOAT segment ids (cast outside so the vjp's
+    cotangent plumbing stays all-float): scores are masked where ids
+    differ — block-diagonal attention across EOD-separated documents
+    (ref: --reset_attention_mask, megatron/utils.py:137-194)."""
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
+                        q_seg, k_seg)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
+               q_seg=None, k_seg=None):
     b, sq, nq, d = q.shape
     sk, nkv = k.shape[1], k.shape[2]
     g = nq // nkv
@@ -244,6 +301,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
         scale = d ** -0.5
     bq, bkv = _pick_blocks(sq, sk, block_q, block_kv)
     num_q, num_kv = sq // bq, sk // bkv
+    has_segs = q_seg is not None
+    assert has_segs == (k_seg is not None), "q_seg/k_seg must come together"
 
     qT = q.transpose(0, 2, 1, 3)  # [b, nq, sq, d]
     kT = k.transpose(0, 2, 1, 3)  # [b, nkv, sk, d]
@@ -256,12 +315,22 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
     o_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, h, qi, ki: (bi, h, qi, 0))
     lse_spec = pl.BlockSpec((1, 1, bq, STAT_LANES),
                             lambda bi, h, qi, ki: (bi, h, qi, 0))
+    seg_inputs, seg_specs = [], []
+    if has_segs:
+        seg_inputs = [_seg_lanes(q_seg), _seg_lanes(k_seg)]
+        seg_specs = [
+            pl.BlockSpec((1, bq, STAT_LANES),
+                         lambda bi, h, qi, ki: (bi, qi, 0)),
+            pl.BlockSpec((1, bkv, STAT_LANES),
+                         lambda bi, h, qi, ki: (bi, ki, 0)),
+        ]
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_kv=bkv, num_kv=num_kv),
+                          block_q=bq, block_kv=bkv, num_kv=num_kv,
+                          has_segs=has_segs),
         grid=grid,
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=[q_spec, kv_spec, kv_spec] + seg_specs,
         out_specs=[o_spec, lse_spec],
         out_shape=[jax.ShapeDtypeStruct((b, nq, sq, d), q.dtype),
                    jax.ShapeDtypeStruct((b, nq, sq, STAT_LANES), jnp.float32)],
@@ -269,16 +338,16 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
                         pltpu.VMEM((bq, STAT_LANES), jnp.float32),
                         pltpu.VMEM((bq, STAT_LANES), jnp.float32)],
         interpret=interpret,
-    )(qT, kT, vT)
+    )(qT, kT, vT, *seg_inputs)
     out = out.transpose(0, 2, 1, 3)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, out, lse, q_seg, k_seg)
 
 
 def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
                     dlse=None):
     """Shared backward. `dlse` [b, sq, nq] is the cotangent of the exposed
     logsumexp (ring attention's merge weights use it); None means zero."""
-    q, k, v, out, lse = res
+    q, k, v, out, lse, q_seg, k_seg = res
     b, sq, nq, d = q.shape
     sk, nkv = k.shape[1], k.shape[2]
     g = nq // nkv
@@ -286,6 +355,7 @@ def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
         scale = d ** -0.5
     bq, bkv = _pick_blocks(sq, sk, block_q, block_kv)
     num_q, num_kv = sq // bq, sk // bkv
+    has_segs = q_seg is not None
 
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)
@@ -297,6 +367,7 @@ def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
                     axis=-1).transpose(0, 2, 1)
     delta = jnp.broadcast_to(delta[..., None], (b, nq, sq, STAT_LANES))
     has_dlse = dlse is not None
+    seg_inputs = ([_seg_lanes(q_seg), _seg_lanes(k_seg)] if has_segs else [])
     extra = []
     if has_dlse:
         extra = [jnp.broadcast_to(
@@ -308,20 +379,24 @@ def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
                            lambda bi, h, qi, ki: (bi, h // g, ki, 0))
     row_spec = pl.BlockSpec((1, 1, bq, STAT_LANES),
                             lambda bi, h, qi, ki: (bi, h, qi, 0))
+    seg_specs = ([
+        pl.BlockSpec((1, bq, STAT_LANES), lambda bi, h, qi, ki: (bi, qi, 0)),
+        pl.BlockSpec((1, bkv, STAT_LANES), lambda bi, h, qi, ki: (bi, ki, 0)),
+    ] if has_segs else [])
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=bq, block_kv=bkv, num_kv=num_kv,
-                          has_dlse=has_dlse),
+                          has_dlse=has_dlse, has_segs=has_segs),
         grid=(b, nq, num_q, num_kv),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec]
-        + [row_spec] * has_dlse,
+        + seg_specs + [row_spec] * has_dlse,
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda bi, h, qi, ki: (bi, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, nq, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(qT, kT, vT, doT, lse, delta, *extra)
+    )(qT, kT, vT, doT, lse, delta, *seg_inputs, *extra)
 
     # dk/dv: grid swaps the roles — kv blocks outer, q blocks inner; every
     # q-head contributes to its kv-head, so run per Q-HEAD and sum groups
@@ -334,39 +409,49 @@ def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
                              lambda bi, h, ki, qi: (bi, h, qi, 0))
     dk_spec = pl.BlockSpec((1, 1, bkv, d),
                            lambda bi, h, ki, qi: (bi, h, ki, 0))
+    seg_specs2 = ([
+        pl.BlockSpec((1, bq, STAT_LANES), lambda bi, h, ki, qi: (bi, qi, 0)),
+        pl.BlockSpec((1, bkv, STAT_LANES), lambda bi, h, ki, qi: (bi, ki, 0)),
+    ] if has_segs else [])
 
     dk_per_head, dv_per_head = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=bq, block_kv=bkv, num_q=num_q,
-                          has_dlse=has_dlse),
+                          has_dlse=has_dlse, has_segs=has_segs),
         grid=(b, nq, num_kv, num_q),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2]
-        + [row_spec2] * has_dlse,
+        + seg_specs2 + [row_spec2] * has_dlse,
         out_specs=[dk_spec, dk_spec],
         out_shape=[jax.ShapeDtypeStruct((b, nq, sk, d), jnp.float32),
                    jax.ShapeDtypeStruct((b, nq, sk, d), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((bkv, d), jnp.float32),
                         pltpu.VMEM((bkv, d), jnp.float32)],
         interpret=interpret,
-    )(qT, kT, vT, doT, lse, delta, *extra)
+    )(qT, kT, vT, doT, lse, delta, *seg_inputs, *extra)
 
     # GQA: sum the per-q-head dk/dv into kv heads
     dk = dk_per_head.reshape(b, nkv, g, sk, d).sum(axis=2)
     dv = dv_per_head.reshape(b, nkv, g, sk, d).sum(axis=2)
 
-    return (dq.transpose(0, 2, 1, 3),
-            dk.transpose(0, 2, 1, 3).astype(k.dtype),
-            dv.transpose(0, 2, 1, 3).astype(v.dtype))
+    grads = (dq.transpose(0, 2, 1, 3),
+             dk.transpose(0, 2, 1, 3).astype(k.dtype),
+             dv.transpose(0, 2, 1, 3).astype(v.dtype))
+    # float segment ids are diff args purely for plumbing: zero cotangent
+    seg_grads = (jnp.zeros_like(q_seg) if has_segs else None,
+                 jnp.zeros_like(k_seg) if has_segs else None)
+    return grads, seg_grads
 
 
 def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, dout):
-    return _flash_bwd_core(causal, scale, block_q, block_kv, interpret,
-                           res, dout)
+    (dq, dk, dv), (dqs, dks) = _flash_bwd_core(
+        causal, scale, block_q, block_kv, interpret, res, dout)
+    return dq, dk, dv, dqs, dks
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_kv, interpret):
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_kv, interpret,
+                    q_seg=None, k_seg=None):
     out, res = _flash_fwd(q, k, v, causal, scale, block_q, block_kv,
-                          interpret)
+                          interpret, q_seg, k_seg)
     return out, res
 
 
@@ -395,8 +480,9 @@ def _with_lse_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
 
 def _with_lse_bwd(causal, scale, block_q, block_kv, interpret, res, cot):
     dout, dlse = cot
-    return _flash_bwd_core(causal, scale, block_q, block_kv, interpret,
-                           res, dout, dlse)
+    grads, _ = _flash_bwd_core(causal, scale, block_q, block_kv, interpret,
+                               res, dout, dlse)
+    return grads
 
 
 pallas_flash_attention_with_lse.defvjp(_with_lse_fwd, _with_lse_bwd)

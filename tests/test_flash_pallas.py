@@ -90,3 +90,112 @@ def test_dispatch_through_flash_attention():
     pallas = pallas_flash_attention(q, k, v, True, None, 64, 64, True)
     np.testing.assert_allclose(np.asarray(pallas), np.asarray(xla),
                                rtol=2e-5, atol=2e-5)
+
+
+def ref_attention_segs(q, k, v, segment_ids, causal=True):
+    b, sq, nq, d = q.shape
+    nkv = k.shape[2]
+    g = nq // nkv
+    qg = q.astype(jnp.float32).reshape(b, sq, nkv, g, d)
+    s = jnp.einsum("bsngd,btnd->bngst", qg, k.astype(jnp.float32)) * d**-0.5
+    mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+    if causal:
+        mask = mask & jnp.tril(jnp.ones((sq, sq), bool))[None]
+    s = jnp.where(mask[:, None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bngst,btnd->bsngd", p, v.astype(jnp.float32))
+    return o.reshape(b, sq, nq, d)
+
+
+def _seg_pattern(b, s):
+    """Documents of uneven length, incl. a boundary mid-block and a doc
+    spanning multiple 128-blocks (the shapes that break naive block
+    skipping)."""
+    seg = np.zeros((b, s), np.int32)
+    seg[:, 100:230] = 1   # crosses the 128 boundary
+    seg[:, 230:] = 2      # spans blocks 1-3 at s=512
+    return jnp.asarray(seg)
+
+
+class TestSegmentMasking:
+    """EOD-reset block-diagonal masking inside the kernel
+    (ref: --reset_attention_mask, megatron/utils.py:137-194) — every row
+    of a foreign-document block is fully masked, which is exactly the
+    case the MASK_CLAMP guard exists for."""
+
+    def test_forward_matches_reference(self):
+        b, s, nq, nkv, d = 2, 512, 4, 2, 64
+        ks = jax.random.split(jax.random.PRNGKey(4), 3)
+        q = jax.random.normal(ks[0], (b, s, nq, d), jnp.float32)
+        k = jax.random.normal(ks[1], (b, s, nkv, d), jnp.float32)
+        v = jax.random.normal(ks[2], (b, s, nkv, d), jnp.float32)
+        seg = _seg_pattern(b, s)
+        segf = seg.astype(jnp.float32)
+        got = pallas_flash_attention(q, k, v, True, None, 128, 128, True,
+                                     segf, segf)
+        want = ref_attention_segs(q, k, v, seg)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_backward_matches_reference(self):
+        b, s, nq, nkv, d = 1, 256, 4, 2, 64
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = jax.random.normal(ks[0], (b, s, nq, d), jnp.float32)
+        k = jax.random.normal(ks[1], (b, s, nkv, d), jnp.float32)
+        v = jax.random.normal(ks[2], (b, s, nkv, d), jnp.float32)
+        seg = _seg_pattern(b, s)
+        segf = seg.astype(jnp.float32)
+
+        def loss_pallas(q, k, v):
+            o = pallas_flash_attention(q, k, v, True, None, 128, 128,
+                                       True, segf, segf)
+            return jnp.sum(o * o)
+
+        def loss_ref(q, k, v):
+            o = ref_attention_segs(q, k, v, seg)
+            return jnp.sum(o * o)
+
+        g_p = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+        g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b2 in zip(g_p, g_r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_blockwise_fallback_matches_reference(self):
+        from megatron_tpu.ops.flash_attention import _blockwise_attention
+        b, s, nq, nkv, d = 2, 320, 4, 2, 32  # 320: pads to 2x256 blocks
+        ks = jax.random.split(jax.random.PRNGKey(6), 3)
+        q = jax.random.normal(ks[0], (b, s, nq, d), jnp.float32)
+        k = jax.random.normal(ks[1], (b, s, nkv, d), jnp.float32)
+        v = jax.random.normal(ks[2], (b, s, nkv, d), jnp.float32)
+        seg = _seg_pattern(b, s)
+        got = _blockwise_attention(q, k, v, causal=True, scale=None,
+                                   block_kv=256, segment_ids=seg)
+        want = ref_attention_segs(q, k, v, seg)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_attention_apply_flash_segments_match_dot(self):
+        """The EOD-reset model path: attention_impl=flash with
+        segment_ids must equal the dot path (which was the ONLY path
+        that supported segments before)."""
+        import dataclasses
+
+        from megatron_tpu.config import ModelConfig
+        from megatron_tpu.models.attention import (attention_apply,
+                                                   attention_init)
+        cfg = ModelConfig(num_layers=2, hidden_size=64,
+                          num_attention_heads=4, num_kv_heads=2,
+                          vocab_size=128, seq_length=256,
+                          use_rotary_emb=False,
+                          compute_dtype="float32").derived()
+        params = attention_init(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 256, 64))
+        seg = _seg_pattern(2, 256)
+        outs = {}
+        for impl in ("dot", "flash"):
+            c = dataclasses.replace(cfg, attention_impl=impl)
+            out, _ = attention_apply(params, x, c, segment_ids=seg)
+            outs[impl] = np.asarray(out)
+        np.testing.assert_allclose(outs["flash"], outs["dot"],
+                                   rtol=2e-4, atol=2e-4)
