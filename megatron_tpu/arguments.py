@@ -78,6 +78,12 @@ def build_parser(extra_args_provider: Optional[Callable] = None
     # hardware's low-precision GEMM lever — see ops/quantized.py)
     g.add_argument("--quantized_gemm", type=str, default="none",
                    choices=["none", "int8"])
+    # Mixture-of-Experts (beyond the reference — SURVEY.md §2.8 lists EP
+    # as absent there; models/moe.py)
+    g.add_argument("--num_experts", type=int, default=1)
+    g.add_argument("--moe_top_k", type=int, default=2)
+    g.add_argument("--moe_capacity_factor", type=float, default=1.25)
+    g.add_argument("--moe_aux_loss_coeff", type=float, default=1e-2)
     g.add_argument("--model", type=str, default=None,
                    help="preset name (llama2-7b, falcon-40b, gpt2, ...)")
 

@@ -112,6 +112,15 @@ class ModelConfig:
     # ops/quantized.py; ref: transformer.py:931-950)
     quantized_gemm: str = "none"
 
+    # Mixture-of-Experts (ABSENT in the reference — SURVEY.md §2.8; the
+    # TPU formulation is an 'experts'-sharded weight bank + GShard dense
+    # dispatch, models/moe.py). num_experts > 1 replaces every MLP with a
+    # top-k-routed expert bank; requires pipeline_parallel == 1.
+    num_experts: int = 1
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coeff: float = 1e-2
+
     # glu activations double the first MLP projection
     @property
     def is_glu(self) -> bool:
@@ -339,6 +348,23 @@ class MegatronConfig:
             assert par.tensor_parallel >= 1
             assert model.seq_length % max(par.tensor_parallel, 1) == 0, (
                 "sequence parallel requires seq_length divisible by tp")
+        if model.num_experts > 1:
+            assert par.pipeline_parallel == 1, (
+                "MoE (num_experts > 1) is not yet wired through the "
+                "pipeline schedules' aux-loss accumulation — use "
+                "pipeline_parallel=1 (dp/tp/sp compose freely)")
+            assert model.moe_top_k <= model.num_experts
+            assert model.num_experts % max(par.tensor_parallel, 1) == 0, (
+                f"num_experts={model.num_experts} must shard evenly over "
+                f"tensor_parallel={par.tensor_parallel} (the expert bank's "
+                "leading axis is tp-sharded — parallel/sharding.py "
+                "'experts' rule)")
+            if model.quantized_gemm != "none":
+                from megatron_tpu.utils.logging import print_rank_0
+                print_rank_0(
+                    "warning: quantized_gemm does not cover the MoE "
+                    "expert GEMMs yet — experts run in the compute dtype "
+                    "(attention/dense paths stay quantized)")
         if model.attention_impl in ("flash", "ring", "ulysses") and \
                 model.attention_dropout > 0.0:
             # the fused/cp paths have no dropout plumbing; training traces

@@ -127,7 +127,9 @@ def bert_encode(params, tokens, cfg: ModelConfig, *, tokentype_ids=None,
     seg = None
     if padding_mask is not None:
         seg = bert_pad_segments(padding_mask)
-    x, _ = tfm.stack_apply(params["transformer"], x, cfg, causal=False,
+    assert cfg.num_experts == 1, (
+        "MoE aux-loss accumulation is only wired into the GPT loss path")
+    x, _, _ = tfm.stack_apply(params["transformer"], x, cfg, causal=False,
                            segment_ids=seg, rng=rng,
                            deterministic=deterministic)
     return x, bert_pool(params, x, compute_dtype)

@@ -97,8 +97,11 @@ def model_forward(
     logits_dtype=jnp.float32,
     segment_ids=None,
     cp_pre_zigzag: bool = False,
+    return_aux: bool = False,
 ):
-    """Forward to logits [b, s, padded_vocab]. Returns (logits, kv_caches).
+    """Forward to logits [b, s, padded_vocab]. Returns (logits, kv_caches),
+    or (logits, kv_caches, moe_aux) with `return_aux=True` (loss_fn uses
+    it to add the MoE router's load-balancing loss).
 
     `cp_pre_zigzag`: the caller pre-permuted tokens/positions into the
     ring-cp zigzag order (see loss_fn / parallel/ring_attention.py
@@ -127,7 +130,7 @@ def model_forward(
     # 255-258 scatter_to_sequence_parallel_region); no-op without a mesh ctx
     x = constrain(x, tfm.RESIDUAL_AXES)
 
-    x, kv_caches = tfm.stack_apply(
+    x, kv_caches, aux = tfm.stack_apply(
         params["transformer"], x, cfg,
         rope_cos=rope.cos if rope else None,
         rope_sin=rope.sin if rope else None,
@@ -137,7 +140,10 @@ def model_forward(
 
     # final norm + SP gather + vocab-parallel head: ONE implementation
     # shared with both pp schedules (head_logits below)
-    return head_logits(params, x, cfg, logits_dtype=logits_dtype), kv_caches
+    logits = head_logits(params, x, cfg, logits_dtype=logits_dtype)
+    if return_aux:
+        return logits, kv_caches, aux
+    return logits, kv_caches
 
 
 def head_logits(params, x, cfg: ModelConfig, *, mb_axis: bool = False,
@@ -207,13 +213,17 @@ def loss_fn(
         if loss_mask is not None:
             loss_mask = loss_mask[:, perm]
 
-    logits, _ = model_forward(params, inputs, cfg, rope=rope, rng=rng,
-                              deterministic=deterministic,
-                              position_ids=position_ids,
-                              segment_ids=segment_ids,
-                              cp_pre_zigzag=pre_zigzag)
+    logits, _, aux = model_forward(params, inputs, cfg, rope=rope, rng=rng,
+                                   deterministic=deterministic,
+                                   position_ids=position_ids,
+                                   segment_ids=segment_ids,
+                                   cp_pre_zigzag=pre_zigzag,
+                                   return_aux=True)
     losses = cross_entropy_loss(logits, labels, vocab_size=cfg.vocab_size)
+    # MoE router load-balancing loss (0 for dense stacks)
+    aux_term = cfg.moe_aux_loss_coeff * aux if cfg.num_experts > 1 else 0.0
     if loss_mask is None:
-        return jnp.mean(losses)
+        return jnp.mean(losses) + aux_term
     loss_mask = loss_mask.astype(losses.dtype)
-    return jnp.sum(losses * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+    return (jnp.sum(losses * loss_mask)
+            / jnp.maximum(jnp.sum(loss_mask), 1.0)) + aux_term

@@ -1,0 +1,137 @@
+"""Mixture-of-Experts MLP with expert parallelism.
+
+ABSENT in the reference (SURVEY.md §2.8: "Expert parallelism (MoE) —
+absent") — provided here the TPU-native way, like the ring/Ulysses
+context parallelism: experts are one more sharded parameter dimension,
+not a process group. The GShard/Switch dense-dispatch formulation keeps
+every shape static for XLA:
+
+- router: logits = x @ wr, softmax in fp32, top-k gates renormalized;
+- capacity C = ceil(top_k * s * capacity_factor / E) per expert; each
+  token takes the next free slot of its chosen experts (cumsum position,
+  k=0 round gets priority, overflow tokens drop — the standard Switch
+  semantics);
+- dispatch/combine are einsums against a [b, s, E, C] one-hot tensor, so
+  expert parallelism is purely the 'experts'-axis sharding on the expert
+  weight bank [E, ...] — GSPMD inserts the all-to-alls;
+- load-balancing aux loss (Switch eq. 4): E * sum_e f_e * P_e, where f_e
+  is the top-1 dispatch fraction and P_e the mean router probability.
+  loss_fn adds cfg.moe_aux_loss_coeff * aux.
+
+Note the dispatch tensor is O(s^2 * top_k * capacity_factor) elements —
+fine at pretraining seq (2-4k); pair long-context (32k) with moderate
+capacity or dot-dispatch improvements before using MoE there.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models.mlp import activation_fn
+
+
+def moe_capacity(cfg: ModelConfig, seq: int) -> int:
+    return int(math.ceil(cfg.moe_top_k * seq * cfg.moe_capacity_factor
+                         / cfg.num_experts))
+
+
+def moe_init(rng, cfg: ModelConfig, dtype=jnp.float32):
+    E = cfg.num_experts
+    h = cfg.hidden_size
+    ffn = cfg.ffn_hidden_size
+    kr, k1, k2 = jax.random.split(rng, 3)
+    std = cfg.init_method_std
+    out_std = (std / math.sqrt(2.0 * cfg.num_layers)
+               if cfg.use_scaled_init else std)
+    if cfg.is_glu:
+        w1 = jax.random.normal(k1, (E, h, 2, ffn), dtype) * std
+    else:
+        w1 = jax.random.normal(k1, (E, h, ffn), dtype) * std
+    params = {
+        "router": jax.random.normal(kr, (h, E), dtype) * std,
+        "w1": w1,
+        "w2": jax.random.normal(k2, (E, ffn, h), dtype) * out_std,
+    }
+    if cfg.use_bias:
+        b1_shape = (E, 2, ffn) if cfg.is_glu else (E, ffn)
+        params["b1"] = jnp.zeros(b1_shape, dtype)
+        params["b2"] = jnp.zeros((E, h), dtype)
+    return params
+
+
+def moe_axes(cfg: ModelConfig):
+    # experts shard over 'tp' (expert parallelism); the ffn dim stays
+    # unsharded — one expert's GEMM runs whole on its device
+    w1_axes = (("experts", "embed", None, None) if cfg.is_glu
+               else ("experts", "embed", None))
+    axes = {
+        "router": ("embed", None),
+        "w1": w1_axes,
+        "w2": ("experts", None, "embed"),
+    }
+    if cfg.use_bias:
+        axes["b1"] = (("experts", None, None) if cfg.is_glu
+                      else ("experts", None))
+        axes["b2"] = ("experts", None)
+    return axes
+
+
+def moe_apply(params, x, cfg: ModelConfig):
+    """x: [b, s, h] -> (y [b, s, h], aux_loss scalar f32)."""
+    b, s, h = x.shape
+    E = cfg.num_experts
+    K = cfg.moe_top_k
+    C = moe_capacity(cfg, s)
+    dtype = x.dtype
+
+    logits = x @ params["router"].astype(dtype)             # [b, s, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gates, idx = jax.lax.top_k(probs, K)                    # [b, s, K]
+    gates = gates / jnp.maximum(
+        jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+
+    # Switch aux loss on the top-1 assignment (before capacity drops)
+    top1 = jax.nn.one_hot(idx[..., 0], E, dtype=jnp.float32)
+    f_e = jnp.mean(top1, axis=(0, 1))                       # [E]
+    p_e = jnp.mean(probs, axis=(0, 1))
+    aux = E * jnp.sum(f_e * p_e)
+
+    # capacity slots: k=0 choices first, then k=1, ... (Switch priority);
+    # positions cumsum along the sequence with a running per-expert count
+    dispatch = jnp.zeros((b, s, E, C), jnp.float32)
+    combine = jnp.zeros((b, s, E, C), jnp.float32)
+    count = jnp.zeros((b, E), jnp.float32)
+    for k in range(K):
+        onek = jax.nn.one_hot(idx[..., k], E, dtype=jnp.float32)
+        pos = (jnp.cumsum(onek, axis=1) - onek) + count[:, None, :]
+        keep = (pos < C) * onek                              # [b, s, E]
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                              dtype=jnp.float32) * keep[..., None]
+        dispatch = dispatch + slot
+        combine = combine + slot * gates[..., k][:, :, None, None]
+        count = count + jnp.sum(onek, axis=1)
+
+    # dispatch -> per-expert token blocks [b, E, C, h]
+    xin = jnp.einsum("bsec,bsh->bech", dispatch.astype(dtype), x)
+    w1 = params["w1"].astype(dtype)
+    w2 = params["w2"].astype(dtype)
+    if cfg.is_glu:
+        y1 = jnp.einsum("bech,ehgf->becgf", xin, w1)
+        if cfg.use_bias:
+            y1 = y1 + params["b1"].astype(dtype)[None, :, None]
+        act = activation_fn(cfg.activation, y1[..., 0, :], y1[..., 1, :])
+    else:
+        y1 = jnp.einsum("bech,ehf->becf", xin, w1)
+        if cfg.use_bias:
+            y1 = y1 + params["b1"].astype(dtype)[None, :, None]
+        act = activation_fn(cfg.activation, y1)
+    y2 = jnp.einsum("becf,efh->bech", act, w2)
+    if cfg.use_bias:
+        # per-expert output bias; dropped (not duplicated) tokens simply
+        # never see it, matching the dispatch semantics
+        y2 = y2 + params["b2"].astype(dtype)[None, :, None]
+    y = jnp.einsum("bech,bsec->bsh", y2, combine.astype(dtype))
+    return y, aux

@@ -90,14 +90,16 @@ def t5_forward(params, enc_tokens, dec_tokens, cfg: ModelConfig, *,
     if enc_padding_mask is not None:
         from megatron_tpu.models.bert import bert_pad_segments
         seg = bert_pad_segments(enc_padding_mask)
-    enc, _ = tfm.stack_apply(params["encoder"], x, cfg, causal=False,
+    assert cfg.num_experts == 1, (
+        "MoE aux-loss accumulation is only wired into the GPT loss path")
+    enc, _, _ = tfm.stack_apply(params["encoder"], x, cfg, causal=False,
                              segment_ids=seg, rng=rng,
                              deterministic=deterministic)
     enc = apply_norm(cfg.norm_type, params["encoder_norm"], enc,
                      cfg.norm_epsilon)
 
     y = _embed(params, dec_tokens, cfg, compute_dtype)
-    dec, _ = tfm.stack_apply(params["decoder"], y, cfg, causal=True,
+    dec, _, _ = tfm.stack_apply(params["decoder"], y, cfg, causal=True,
                              encoder_output=enc, rng=rng,
                              deterministic=deterministic)
     return t5_lm_logits(params, dec, cfg, compute_dtype)

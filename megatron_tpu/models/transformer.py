@@ -54,9 +54,14 @@ def layer_init(rng, cfg: ModelConfig, dtype=jnp.float32,
     parallel_attn drops post_attention_layernorm; parallel_layernorm adds a
     dedicated mlp norm."""
     k_attn, k_mlp, k_inter = jax.random.split(rng, 3)
+    if cfg.num_experts > 1:
+        from megatron_tpu.models.moe import moe_init
+        mlp_params = moe_init(k_mlp, cfg, dtype)
+    else:
+        mlp_params = mlp_init(k_mlp, cfg, dtype)
     params = {
         "attention": attention_init(k_attn, cfg, dtype),
-        "mlp": mlp_init(k_mlp, cfg, dtype),
+        "mlp": mlp_params,
     }
     if cross_attn:
         # decoder cross-attention + its input norm
@@ -76,9 +81,14 @@ def layer_init(rng, cfg: ModelConfig, dtype=jnp.float32,
 
 
 def layer_axes(cfg: ModelConfig, cross_attn: bool = False):
+    if cfg.num_experts > 1:
+        from megatron_tpu.models.moe import moe_axes
+        mlp_ax = moe_axes(cfg)
+    else:
+        mlp_ax = mlp_axes(cfg)
     axes = {
         "attention": attention_axes(cfg),
-        "mlp": mlp_axes(cfg),
+        "mlp": mlp_ax,
     }
     if cross_attn:
         axes["inter_attention"] = attention_axes(cfg)
@@ -113,7 +123,8 @@ def layer_apply(
     encoder_output=None,
     cp_pre_zigzag: bool = False,
 ):
-    """One transformer layer. x: [b, s, h]. Returns (x, kv_cache).
+    """One transformer layer. x: [b, s, h]. Returns (x, kv_cache, aux) —
+    `aux` is the MoE router's load-balancing loss (0.0 for dense MLPs).
 
     `encoder_output` enables the decoder cross-attention sublayer between
     self-attention and the MLP (ref: transformer.py:782-794).
@@ -145,6 +156,13 @@ def layer_apply(
             return branch
         return _drop_path(r_dp, branch, drop_path_rate)
 
+    def _mlp_branch(inp):
+        """Dense MLP or the MoE expert bank: (out, aux_loss)."""
+        if cfg.num_experts > 1:
+            from megatron_tpu.models.moe import moe_apply
+            return moe_apply(params["mlp"], inp, cfg)
+        return mlp_apply(params["mlp"], inp, cfg), jnp.zeros((), jnp.float32)
+
     residual = x
     if cfg.use_post_ln:
         ln_out = x  # input_layernorm = Identity (ref: transformer.py:630-631)
@@ -168,7 +186,7 @@ def layer_apply(
             mlp_in = apply_norm(cfg.norm_type, params["mlp_norm"], residual, eps)
         else:
             mlp_in = ln_out
-        mlp_out = mlp_apply(params["mlp"], mlp_in, cfg)
+        mlp_out, aux = _mlp_branch(mlp_in)
         out = residual + _branch(r_dp1,
                                  _dropout(r_mlp, mlp_out + attn_out, p_drop))
     else:
@@ -185,12 +203,12 @@ def layer_apply(
                 kv_input=encoder_output)
             ln_in = ln_in + _dropout(r_inter, inter_out, p_drop)
         ln2 = apply_norm(cfg.norm_type, params["post_attn_norm"], ln_in, eps)
-        mlp_out = mlp_apply(params["mlp"], ln2, cfg)
+        mlp_out, aux = _mlp_branch(ln2)
         out = ln_in + _branch(r_dp2, _dropout(r_mlp, mlp_out, p_drop))
 
     if cfg.use_post_ln:
         out = apply_norm(cfg.norm_type, params["output_norm"], out, eps)
-    return constrain(out, RESIDUAL_AXES), kv_cache
+    return constrain(out, RESIDUAL_AXES), kv_cache, aux
 
 
 # ---------------------------------------------------------------------------
@@ -247,6 +265,10 @@ def stack_apply(
 ):
     """Apply all (or a pipeline stage's worth of) layers via lax.scan.
 
+    Returns (x, kv_caches, aux) — `aux` sums the layers' MoE router
+    load-balancing losses (0.0 for dense stacks; loss_fn weighs it by
+    cfg.moe_aux_loss_coeff).
+
     `layer_offset` preserves layer_number-dependent behavior across pipeline
     stages (ref: transformer.py:1014-1044 layer offsets for vpp)."""
     num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -258,12 +280,12 @@ def stack_apply(
     layer_ids = layer_offset + jnp.arange(num_layers)
 
     def body(carry, scanned):
-        h = carry
+        h, aux_sum = carry
         p, rate, dp_rate, lid, cache = scanned
         layer_rng = None
         if rng is not None and not deterministic:
             layer_rng = jax.random.fold_in(rng, lid)
-        h, new_cache = layer_apply(
+        h, new_cache, aux = layer_apply(
             p, h, cfg, rope_cos=rope_cos, rope_sin=rope_sin,
             position_ids=position_ids, kv_cache=cache,
             layer_number=lid + 1, hidden_dropout=rate,
@@ -272,7 +294,7 @@ def stack_apply(
             deterministic=deterministic, segment_ids=segment_ids,
             causal=causal, encoder_output=encoder_output,
             cp_pre_zigzag=cp_pre_zigzag)
-        return h, new_cache
+        return (h, aux_sum + aux), new_cache
 
     if cfg.recompute_granularity == "full":
         body = jax.checkpoint(body, prevent_cse=False)
@@ -283,15 +305,16 @@ def stack_apply(
             body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             prevent_cse=False)
 
+    aux0 = jnp.zeros((), jnp.float32)
     xs = (stacked_params, drop_rates, dp_rates, layer_ids, kv_caches)
     if kv_caches is None:
         def body_nocache(carry, scanned):
             p, rate, dp_rate, lid = scanned
-            h, _ = body(carry, (p, rate, dp_rate, lid, None))
-            return h, None
-        x, _ = jax.lax.scan(body_nocache, x,
-                            (stacked_params, drop_rates, dp_rates,
-                             layer_ids))
-        return x, None
-    x, new_caches = jax.lax.scan(body, x, xs)
-    return x, new_caches
+            c, _ = body(carry, (p, rate, dp_rate, lid, None))
+            return c, None
+        (x, aux), _ = jax.lax.scan(body_nocache, (x, aux0),
+                                   (stacked_params, drop_rates, dp_rates,
+                                    layer_ids))
+        return x, None, aux
+    (x, aux), new_caches = jax.lax.scan(body, (x, aux0), xs)
+    return x, new_caches, aux

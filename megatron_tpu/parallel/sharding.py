@@ -60,6 +60,9 @@ def make_logical_rules(sequence_parallel: bool = False):
         ("heads", TENSOR_AXIS),
         ("kv_heads", TENSOR_AXIS),
         ("mlp", TENSOR_AXIS),
+        # MoE expert bank: experts shard over 'tp' (expert parallelism —
+        # each device holds E/tp whole experts; models/moe.py)
+        ("experts", TENSOR_AXIS),
         ("vocab", TENSOR_AXIS),
         ("seq", CONTEXT_AXIS),
         # Megatron-SP: the residual-stream sequence dim is sharded over 'tp'

@@ -51,7 +51,7 @@ def test_post_ln_output_is_normalized():
     from megatron_tpu.models.language_model import make_rope
     rope = make_rope(cfg)
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 64)) * 3
-    y, _ = layer_apply(p, x, cfg, rope_cos=rope.cos, rope_sin=rope.sin)
+    y, _, _ = layer_apply(p, x, cfg, rope_cos=rope.cos, rope_sin=rope.sin)
     y = np.asarray(y)
     np.testing.assert_allclose(y.mean(-1), 0.0, atol=1e-4)
     np.testing.assert_allclose(y.var(-1), 1.0, atol=1e-2)
@@ -65,13 +65,13 @@ def test_parallel_attn_single_residual():
     rope = make_rope(cfg)
     p = layer_init(jax.random.PRNGKey(0), cfg)
     x = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 64))
-    y_full, _ = layer_apply(p, x, cfg, rope_cos=rope.cos, rope_sin=rope.sin)
+    y_full, _, _ = layer_apply(p, x, cfg, rope_cos=rope.cos, rope_sin=rope.sin)
     p_noattn = jax.tree.map(lambda a: a, p)
     p_noattn["attention"] = dict(p["attention"], wo=jnp.zeros_like(p["attention"]["wo"]))
-    y_mlp, _ = layer_apply(p_noattn, x, cfg, rope_cos=rope.cos, rope_sin=rope.sin)
+    y_mlp, _, _ = layer_apply(p_noattn, x, cfg, rope_cos=rope.cos, rope_sin=rope.sin)
     p_nomlp = jax.tree.map(lambda a: a, p)
     p_nomlp["mlp"] = dict(p["mlp"], w2=jnp.zeros_like(p["mlp"]["w2"]))
-    y_attn, _ = layer_apply(p_nomlp, x, cfg, rope_cos=rope.cos, rope_sin=rope.sin)
+    y_attn, _, _ = layer_apply(p_nomlp, x, cfg, rope_cos=rope.cos, rope_sin=rope.sin)
     np.testing.assert_allclose(
         np.asarray(y_full), np.asarray(y_mlp + y_attn - x), atol=1e-5)
 
@@ -125,10 +125,10 @@ class TestDropPath:
         rope = make_rope(cfg)
         p = stack_init(jax.random.PRNGKey(0), cfg)
         x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 64))
-        y1, _ = stack_apply(p, x, cfg, rope_cos=rope.cos,
+        y1, _, _ = stack_apply(p, x, cfg, rope_cos=rope.cos,
                             rope_sin=rope.sin, deterministic=True)
         cfg0 = cfg_with()
-        y0, _ = stack_apply(p, x, cfg0, rope_cos=rope.cos,
+        y0, _, _ = stack_apply(p, x, cfg0, rope_cos=rope.cos,
                             rope_sin=rope.sin, deterministic=True)
         np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
                                    atol=1e-6)
@@ -142,9 +142,9 @@ class TestDropPath:
         rope = make_rope(cfg)
         p = stack_init(jax.random.PRNGKey(0), cfg)
         x = jax.random.normal(jax.random.PRNGKey(1), (8, 4, 64))
-        y_det, _ = stack_apply(p, x, cfg, rope_cos=rope.cos,
+        y_det, _, _ = stack_apply(p, x, cfg, rope_cos=rope.cos,
                                rope_sin=rope.sin, deterministic=True)
-        y_tr, _ = stack_apply(p, x, cfg, rope_cos=rope.cos,
+        y_tr, _, _ = stack_apply(p, x, cfg, rope_cos=rope.cos,
                               rope_sin=rope.sin,
                               rng=jax.random.PRNGKey(2),
                               deterministic=False)

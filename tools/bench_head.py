@@ -99,7 +99,7 @@ def main(argv=None):
     # (a) one transformer layer, fwd+bwd wrt (stack params, x) — the
     # pipeline chunk's per-layer unit of work
     def layer_loss(sp, xin):
-        out, _ = tfm.stack_apply(sp, xin.astype(jnp.bfloat16), cfg,
+        out, _, _ = tfm.stack_apply(sp, xin.astype(jnp.bfloat16), cfg,
                                  rope_cos=rope.cos if rope else None,
                                  rope_sin=rope.sin if rope else None,
                                  deterministic=True)
