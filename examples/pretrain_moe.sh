@@ -1,0 +1,24 @@
+#!/bin/bash
+# Mixture-of-Experts pretraining (beyond the reference — SURVEY.md §2.8
+# lists expert parallelism as absent there; models/moe.py).
+# 8 experts top-2 over a llama-style backbone; experts shard over the
+# tensor axis (tp=8 -> one expert per device), so dp scales the batch on
+# top. num_experts must divide evenly by tp; pipeline_parallel stays 1.
+DATA=${DATA:-data/corpus}
+TOKENIZER=${TOKENIZER:-tokenizer.model}
+
+python finetune.py \
+    --num_layers 24 --hidden_size 2048 --num_attention_heads 16 \
+    --seq_length 2048 --max_position_embeddings 2048 \
+    --use_rms_norm --glu_activation swiglu \
+    --position_embedding_type rotary \
+    --num_experts 8 --moe_top_k 2 \
+    --moe_capacity_factor 1.25 --moe_aux_loss_coeff 0.01 \
+    --tensor_model_parallel_size 8 --sequence_parallel \
+    --tokenizer_type SentencePieceTokenizer --tokenizer_model "$TOKENIZER" \
+    --data_path "$DATA" --split 949,50,1 \
+    --train_iters 100000 --global_batch_size 256 --micro_batch_size 2 \
+    --bf16 --lr 3e-4 --lr_decay_style cosine --lr_warmup_iters 1000 \
+    --weight_decay 0.1 --clip_grad 1.0 --attention_impl flash \
+    --log_interval 10 --save_interval 1000 --eval_interval 1000 \
+    --save ckpts/moe8x --tensorboard_dir runs/moe8x

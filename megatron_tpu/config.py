@@ -353,7 +353,9 @@ class MegatronConfig:
                 "MoE (num_experts > 1) is not yet wired through the "
                 "pipeline schedules' aux-loss accumulation — use "
                 "pipeline_parallel=1 (dp/tp/sp compose freely)")
-            assert model.moe_top_k <= model.num_experts
+            assert 1 <= model.moe_top_k <= model.num_experts, (
+                f"moe_top_k={model.moe_top_k} must be in "
+                f"[1, num_experts={model.num_experts}]")
             assert model.num_experts % max(par.tensor_parallel, 1) == 0, (
                 f"num_experts={model.num_experts} must shard evenly over "
                 f"tensor_parallel={par.tensor_parallel} (the expert bank's "

@@ -79,6 +79,31 @@ def moe_axes(cfg: ModelConfig):
     return axes
 
 
+def moe_dispatch(idx, gates, E: int, C: int):
+    """Build the dispatch/combine tensors [b, s, E, C] from top-k routing.
+
+    Capacity slots fill k=0 choices first, then k=1, ... (Switch
+    priority); each (token, k) choice takes the next free slot of its
+    expert via a sequence cumsum offset by the earlier rounds' running
+    per-expert counts. Tokens past capacity drop (dispatch row all-zero).
+    Invariants (tested in tests/test_moe.py): each filled slot holds
+    exactly one token; with ample capacity every token occupies exactly
+    its top-k slots and its combine weights sum to 1."""
+    dispatch = 0.0
+    combine = 0.0
+    count = 0.0
+    for k in range(idx.shape[-1]):
+        onek = jax.nn.one_hot(idx[..., k], E, dtype=jnp.float32)
+        pos = (jnp.cumsum(onek, axis=1) - onek) + count
+        keep = (pos < C) * onek                              # [b, s, E]
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                              dtype=jnp.float32) * keep[..., None]
+        dispatch = dispatch + slot
+        combine = combine + slot * gates[..., k][:, :, None, None]
+        count = count + jnp.sum(onek, axis=1)[:, None, :]
+    return dispatch, combine
+
+
 def moe_apply(params, x, cfg: ModelConfig):
     """x: [b, s, h] -> (y [b, s, h], aux_loss scalar f32)."""
     b, s, h = x.shape
@@ -99,20 +124,7 @@ def moe_apply(params, x, cfg: ModelConfig):
     p_e = jnp.mean(probs, axis=(0, 1))
     aux = E * jnp.sum(f_e * p_e)
 
-    # capacity slots: k=0 choices first, then k=1, ... (Switch priority);
-    # positions cumsum along the sequence with a running per-expert count
-    dispatch = jnp.zeros((b, s, E, C), jnp.float32)
-    combine = jnp.zeros((b, s, E, C), jnp.float32)
-    count = jnp.zeros((b, E), jnp.float32)
-    for k in range(K):
-        onek = jax.nn.one_hot(idx[..., k], E, dtype=jnp.float32)
-        pos = (jnp.cumsum(onek, axis=1) - onek) + count[:, None, :]
-        keep = (pos < C) * onek                              # [b, s, E]
-        slot = jax.nn.one_hot(pos.astype(jnp.int32), C,
-                              dtype=jnp.float32) * keep[..., None]
-        dispatch = dispatch + slot
-        combine = combine + slot * gates[..., k][:, :, None, None]
-        count = count + jnp.sum(onek, axis=1)
+    dispatch, combine = moe_dispatch(idx, gates, E, C)
 
     # dispatch -> per-expert token blocks [b, E, C, h]
     xin = jnp.einsum("bsec,bsh->bech", dispatch.astype(dtype), x)

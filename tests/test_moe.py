@@ -30,6 +30,43 @@ def _cfg(**kw):
 
 
 def test_dispatch_accounts_every_kept_token():
+    from megatron_tpu.models.moe import moe_dispatch
+    b, s, E, K = 2, 32, 4, 2
+    key = jax.random.PRNGKey(7)
+    probs = jax.nn.softmax(jax.random.normal(key, (b, s, E)), axis=-1)
+    gates, idx = jax.lax.top_k(probs, K)
+    gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+
+    # ample capacity: every (token, k) choice must land
+    C = s * K
+    D, W = moe_dispatch(idx, gates, E, C)
+    D, W = np.asarray(D), np.asarray(W)
+    # each token occupies exactly K slots, all with weight summing to 1
+    np.testing.assert_allclose(D.sum(axis=(2, 3)), K)
+    np.testing.assert_allclose(W.sum(axis=(2, 3)), 1.0, rtol=1e-6)
+    # a slot holds at most one token (no double booking)
+    assert D.sum(axis=1).max() <= 1.0 + 1e-6
+    # the slot a token got carries exactly its gate for that expert
+    for bi in range(b):
+        for si in range(s):
+            for k in range(K):
+                e = int(idx[bi, si, k])
+                w_slot = W[bi, si, e].sum()
+                np.testing.assert_allclose(w_slot, gates[bi, si, k],
+                                           rtol=1e-6)
+
+    # capacity 1: each expert accepts exactly min(assigned, 1) tokens
+    D1, _ = moe_dispatch(idx, gates, E, 1)
+    per_expert = np.asarray(D1).sum(axis=(1, 3))  # [b, E]
+    assert per_expert.max() <= 1.0 + 1e-6
+    # and drops really happen (s*K >> E slots)
+    assert np.asarray(D1).sum() < np.asarray(D).sum()
+
+    cfg = _cfg(moe_capacity_factor=8.0)
+    assert moe_capacity(cfg, 32) == int(np.ceil(2 * 32 * 8.0 / 4))
+
+
+def test_moe_forward_finite_and_aux_sane():
     cfg = _cfg(moe_capacity_factor=8.0)  # ample: nothing drops
     params = moe_init(jax.random.PRNGKey(0), cfg)
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 64))
@@ -38,9 +75,6 @@ def test_dispatch_accounts_every_kept_token():
     assert np.isfinite(np.asarray(y)).all()
     # aux near its balanced value E * sum(f*p) ~ 1 for a random router
     assert 0.5 < float(aux) < 4.0
-
-    # capacity formula
-    assert moe_capacity(cfg, 32) == int(np.ceil(2 * 32 * 8.0 / 4))
 
 
 def test_single_expert_equals_dense_mlp():
@@ -139,6 +173,57 @@ def test_moe_requires_pp1():
             training=TrainingConfig(micro_batch_size=1,
                                     global_batch_size=4),
         ).validate(n_devices=8)
+
+
+def test_moe_greedy_decode_matches_full_forward():
+    """MoE through the KV-cache decode loop: per-token routing is
+    position-independent, so cached greedy decode must equal the
+    no-cache argmax oracle exactly (same contract as the dense model,
+    tests/test_inference.py)."""
+    from megatron_tpu.inference import Generator, SamplingParams
+    from megatron_tpu.models import language_model as lm
+    cfg = _cfg(activation="swiglu", vocab_size=96,
+               make_vocab_size_divisible_by=32, seq_length=64,
+               max_position_embeddings=64)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    gen = Generator(params, cfg, eos_id=0, pad_id=0)
+    prompt = [5, 17, 3, 42]
+    tokens, _, _ = gen.generate([prompt], 8,
+                                sampling=SamplingParams(temperature=0.0))
+    rope = lm.make_rope(cfg)
+    seq = list(prompt)
+    for _ in range(8):
+        logits, _ = lm.model_forward(params, jnp.asarray([seq]), cfg,
+                                     rope=rope)
+        nxt = int(jnp.argmax(logits[0, -1, :cfg.vocab_size]))
+        seq.append(nxt)
+        if nxt == 0:
+            break
+    np.testing.assert_array_equal(np.asarray(tokens[0, :len(seq)]),
+                                  np.asarray(seq))
+
+
+def test_moe_checkpoint_roundtrip(tmp_path):
+    """The expert bank rides the generic pytree checkpoint path: save,
+    restore, bit-identical params incl. router and per-expert weights."""
+    from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
+                                     TrainingConfig)
+    from megatron_tpu.training import checkpointing as ckpt
+    from megatron_tpu.training import init_train_state
+
+    cfg = MegatronConfig(
+        model=_cfg(activation="swiglu"),
+        optimizer=OptimizerConfig(lr=1e-3),
+        training=TrainingConfig(micro_batch_size=1, global_batch_size=1,
+                                train_iters=1),
+    ).validate(n_devices=1)
+    state = init_train_state(jax.random.PRNGKey(0), cfg)
+    ckpt.save_checkpoint(str(tmp_path), state, cfg, iteration=3)
+    restored, it, _ = ckpt.load_checkpoint(str(tmp_path), state)
+    assert it == 3
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 @pytest.mark.slow
