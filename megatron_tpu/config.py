@@ -501,6 +501,43 @@ def falcon_config(size: str = "7b", **overrides) -> ModelConfig:
     return ModelConfig(**base).derived()
 
 
+def mixtral_config(size: str = "8x7b", **overrides) -> ModelConfig:
+    """Mixtral presets (beyond the reference — it has no MoE).
+    moe_capacity_factor defaults to num_experts/moe_top_k: Mixtral is
+    DROPLESS, and that capacity guarantees no token ever drops, making
+    converted-checkpoint inference bit-faithful (convert/hf.py
+    hf_mixtral_to_params). Lower it for capacity-bounded training."""
+    presets = {
+        "tiny": dict(num_layers=2, hidden_size=256, num_attention_heads=8,
+                     num_kv_heads=2, ffn_hidden_size=512, vocab_size=32000,
+                     seq_length=512, num_experts=4, attention_impl="dot"),
+        # seq_length 4096 is a working default (the dense dispatch is
+        # O(s^2) — see models/moe.py); the WEIGHTS support 32k positions,
+        # so max_position_embeddings carries the real context window
+        "8x7b": dict(num_layers=32, hidden_size=4096,
+                     num_attention_heads=32, num_kv_heads=8,
+                     ffn_hidden_size=14336, vocab_size=32000,
+                     seq_length=4096, max_position_embeddings=32768,
+                     num_experts=8),
+    }
+    if size not in presets:
+        raise ValueError(f"unknown mixtral size {size!r}; "
+                         f"valid: {sorted(presets)}")
+    base = dict(
+        use_rotary_emb=True, rope_theta=1e6, norm_type="rmsnorm",
+        norm_epsilon=1e-5, activation="swiglu", use_bias=False,
+        use_post_ln=False, tie_embed_logits=False, moe_top_k=2,
+        attention_impl="flash",  # see llama2_config
+    )
+    base.update(presets[size])
+    base.update(overrides)
+    # AFTER overrides: the dropless default must track the FINAL E and K
+    # (an explicit user capacity_factor still wins)
+    base.setdefault("moe_capacity_factor",
+                    base["num_experts"] / base["moe_top_k"])
+    return ModelConfig(**base).derived()
+
+
 def gpt_config(**overrides) -> ModelConfig:
     base = dict(
         num_layers=12, hidden_size=768, num_attention_heads=12,
@@ -520,5 +557,7 @@ MODEL_PRESETS = {
     "falcon-tiny": lambda: falcon_config("tiny"),
     "falcon-7b": lambda: falcon_config("7b"),
     "falcon-40b": lambda: falcon_config("40b"),
+    "mixtral-tiny": lambda: mixtral_config("tiny"),
+    "mixtral-8x7b": lambda: mixtral_config("8x7b"),
     "gpt2": gpt_config,
 }

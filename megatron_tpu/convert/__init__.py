@@ -1,5 +1,5 @@
 from megatron_tpu.convert.hf import (  # noqa: F401
-    hf_falcon_to_params, hf_llama_to_params, params_to_hf_falcon,
-    params_to_hf_llama)
+    hf_falcon_to_params, hf_llama_to_params, hf_mixtral_to_params,
+    params_to_hf_falcon, params_to_hf_llama, params_to_hf_mixtral)
 from megatron_tpu.convert.meta import (  # noqa: F401
     merge_meta_llama, meta_llama_to_params)

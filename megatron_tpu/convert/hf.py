@@ -69,10 +69,11 @@ def _pad_vocab(w: np.ndarray, padded: int) -> np.ndarray:
         [w, np.zeros((padded - v, w.shape[1]), w.dtype)], axis=0)
 
 
-def hf_llama_to_params(sd: Mapping[str, np.ndarray], cfg: ModelConfig,
-                       dtype=np.float32) -> dict:
-    """HF LlamaForCausalLM state dict -> megatron_tpu param tree
-    (ref: weights2megatron.py llama_to_megatron + permute_qkv)."""
+def _llama_backbone_import(sd: Mapping[str, np.ndarray], cfg: ModelConfig,
+                           dtype, mlp_import) -> dict:
+    """Shared Llama-backbone import (attention/norms/embedding/head);
+    `mlp_import(get, prefix) -> {name: array}` supplies the per-layer MLP
+    mapping — dense GLU for Llama, block_sparse_moe for Mixtral."""
     hd = cfg.kv_channels
     nq = cfg.num_attention_heads
     nkv = cfg.num_kv_heads
@@ -82,7 +83,7 @@ def hf_llama_to_params(sd: Mapping[str, np.ndarray], cfg: ModelConfig,
         return np.asarray(sd[name], dtype=dtype)
 
     layers = {"attention": {"wq": [], "wkv": [], "wo": []},
-              "mlp": {"w1": [], "w2": []},
+              "mlp": None,
               "input_norm": {"scale": []},
               "post_attn_norm": {"scale": []}}
     for i in range(L):
@@ -94,10 +95,11 @@ def hf_llama_to_params(sd: Mapping[str, np.ndarray], cfg: ModelConfig,
         layers["attention"]["wkv"].append(
             np.concatenate([_t(wk), _t(wv)], axis=1))
         layers["attention"]["wo"].append(_t(get(p + "self_attn.o_proj.weight")))
-        gate = _t(get(p + "mlp.gate_proj.weight"))  # [h, ffn]
-        up = _t(get(p + "mlp.up_proj.weight"))
-        layers["mlp"]["w1"].append(np.stack([gate, up], axis=1))  # [h, 2, ffn]
-        layers["mlp"]["w2"].append(_t(get(p + "mlp.down_proj.weight")))
+        mlp = mlp_import(get, p)
+        if layers["mlp"] is None:
+            layers["mlp"] = {k: [] for k in mlp}
+        for k, v in mlp.items():
+            layers["mlp"][k].append(v)
         layers["input_norm"]["scale"].append(get(p + "input_layernorm.weight"))
         layers["post_attn_norm"]["scale"].append(
             get(p + "post_attention_layernorm.weight"))
@@ -116,9 +118,24 @@ def hf_llama_to_params(sd: Mapping[str, np.ndarray], cfg: ModelConfig,
     return params
 
 
-def params_to_hf_llama(params, cfg: ModelConfig, dtype=np.float32) -> dict:
-    """megatron_tpu param tree -> HF LlamaForCausalLM state dict
-    (ref: megatron2hf.py:60-471, inverse QKV permute)."""
+def hf_llama_to_params(sd: Mapping[str, np.ndarray], cfg: ModelConfig,
+                       dtype=np.float32) -> dict:
+    """HF LlamaForCausalLM state dict -> megatron_tpu param tree
+    (ref: weights2megatron.py llama_to_megatron + permute_qkv)."""
+
+    def mlp_import(get, p):
+        gate = _t(get(p + "mlp.gate_proj.weight"))  # [h, ffn]
+        up = _t(get(p + "mlp.up_proj.weight"))
+        return {"w1": np.stack([gate, up], axis=1),  # [h, 2, ffn]
+                "w2": _t(get(p + "mlp.down_proj.weight"))}
+
+    return _llama_backbone_import(sd, cfg, dtype, mlp_import)
+
+
+def _llama_backbone_export(params, cfg: ModelConfig, dtype,
+                           mlp_export) -> dict:
+    """Shared Llama-backbone export; `mlp_export(t, i, prefix) ->
+    {hf_name: array}` supplies the per-layer MLP mapping."""
     hd = cfg.kv_channels
     nq = cfg.num_attention_heads
     nkv = cfg.num_kv_heads
@@ -144,16 +161,26 @@ def params_to_hf_llama(params, cfg: ModelConfig, dtype=np.float32) -> dict:
         sd[p + "self_attn.v_proj.weight"] = _t(wv)
         sd[p + "self_attn.o_proj.weight"] = _t(
             np.asarray(t["attention"]["wo"][i], dtype))
-        w1 = np.asarray(t["mlp"]["w1"][i], dtype)  # [h, 2, ffn]
-        sd[p + "mlp.gate_proj.weight"] = _t(w1[:, 0])
-        sd[p + "mlp.up_proj.weight"] = _t(w1[:, 1])
-        sd[p + "mlp.down_proj.weight"] = _t(np.asarray(t["mlp"]["w2"][i],
-                                                       dtype))
+        sd.update(mlp_export(t, i, p))
         sd[p + "input_layernorm.weight"] = np.asarray(
             t["input_norm"]["scale"][i], dtype)
         sd[p + "post_attention_layernorm.weight"] = np.asarray(
             t["post_attn_norm"]["scale"][i], dtype)
     return sd
+
+
+def params_to_hf_llama(params, cfg: ModelConfig, dtype=np.float32) -> dict:
+    """megatron_tpu param tree -> HF LlamaForCausalLM state dict
+    (ref: megatron2hf.py:60-471, inverse QKV permute)."""
+
+    def mlp_export(t, i, p):
+        w1 = np.asarray(t["mlp"]["w1"][i], dtype)  # [h, 2, ffn]
+        return {p + "mlp.gate_proj.weight": _t(w1[:, 0]),
+                p + "mlp.up_proj.weight": _t(w1[:, 1]),
+                p + "mlp.down_proj.weight": _t(
+                    np.asarray(t["mlp"]["w2"][i], dtype))}
+
+    return _llama_backbone_export(params, cfg, dtype, mlp_export)
 
 
 def hf_falcon_to_params(sd: Mapping[str, np.ndarray], cfg: ModelConfig,
@@ -295,3 +322,58 @@ def params_to_hf_falcon(params, cfg: ModelConfig, dtype=np.float32) -> dict:
             sd[p + "input_layernorm.bias"] = np.asarray(
                 t["input_norm"]["bias"][i], dtype)
     return sd
+
+
+def hf_mixtral_to_params(sd: Mapping[str, np.ndarray], cfg: ModelConfig,
+                         dtype=np.float32) -> dict:
+    """HF MixtralForCausalLM state dict -> megatron_tpu param tree.
+
+    Beyond the reference (it has no MoE at all — SURVEY.md §2.8): the
+    attention/norm/embedding mapping is exactly the Llama one (Mixtral IS
+    a Llama backbone: GQA + RMSNorm + rotate-half RoPE at theta 1e6), and
+    each block_sparse_moe maps onto models/moe.py:
+      gate.weight [E, h]            -> router [h, E]
+      experts.{e}.w1 (gate proj)    -> w1[e, :, 0, :]
+      experts.{e}.w3 (up proj)      -> w1[e, :, 1, :]
+      experts.{e}.w2 (down proj)    -> w2[e]
+    Routing semantics match by construction: Mixtral's softmax-then-top-k
+    renormalization equals our renormalized top-k of the full softmax.
+    Mixtral is DROPLESS — set moe_capacity_factor >= num_experts /
+    moe_top_k for bit-faithful inference (guarantees no capacity drops).
+    """
+    assert cfg.num_experts > 1, "mixtral conversion needs num_experts > 1"
+    E = cfg.num_experts
+
+    def mlp_import(get, p):
+        m = p + "block_sparse_moe."
+        w1 = np.stack([
+            np.stack([_t(get(m + f"experts.{e}.w1.weight")),   # gate
+                      _t(get(m + f"experts.{e}.w3.weight"))],  # up
+                     axis=1)
+            for e in range(E)])                                # [E, h, 2, ffn]
+        return {"router": _t(get(m + "gate.weight")),
+                "w1": w1,
+                "w2": np.stack([_t(get(m + f"experts.{e}.w2.weight"))
+                                for e in range(E)])}
+
+    return _llama_backbone_import(sd, cfg, dtype, mlp_import)
+
+
+def params_to_hf_mixtral(params, cfg: ModelConfig, dtype=np.float32) -> dict:
+    """megatron_tpu MoE param tree -> HF MixtralForCausalLM state dict
+    (inverse of hf_mixtral_to_params)."""
+    E = cfg.num_experts
+
+    def mlp_export(t, i, p):
+        m = p + "block_sparse_moe."
+        out = {m + "gate.weight": _t(np.asarray(t["mlp"]["router"][i],
+                                                dtype))}
+        w1 = np.asarray(t["mlp"]["w1"][i], dtype)   # [E, h, 2, ffn]
+        w2 = np.asarray(t["mlp"]["w2"][i], dtype)   # [E, ffn, h]
+        for e in range(E):
+            out[m + f"experts.{e}.w1.weight"] = _t(w1[e, :, 0])
+            out[m + f"experts.{e}.w3.weight"] = _t(w1[e, :, 1])
+            out[m + f"experts.{e}.w2.weight"] = _t(w2[e])
+        return out
+
+    return _llama_backbone_export(params, cfg, dtype, mlp_export)

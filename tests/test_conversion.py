@@ -261,3 +261,75 @@ def test_golden_logit_fixture():
                            "golden_logits_llama_synthetic.npz")
     assert os.path.exists(fixture), "golden fixture missing from the repo"
     assert vc.main(["--golden", fixture]) == 0
+
+
+class TestMixtralConversion:
+    """HF Mixtral <-> our MoE (beyond the reference — it has no MoE).
+
+    Routing parity holds by construction (Mixtral's softmax-then-top-k
+    renormalization == our renormalized top-k of the full softmax) and
+    dropless-ness is guaranteed by capacity_factor = E/K; these tests
+    pin both plus the weight mapping."""
+
+    @pytest.fixture(scope="class")
+    def mixtral(self):
+        from transformers import MixtralConfig, MixtralForCausalLM
+
+        from megatron_tpu.config import mixtral_config
+        cfg = mixtral_config(
+            "tiny", num_layers=2, hidden_size=64, num_attention_heads=4,
+            num_kv_heads=2, ffn_hidden_size=96, vocab_size=160,
+            seq_length=64, num_experts=4, moe_top_k=2,
+            make_vocab_size_divisible_by=32, attention_impl="dot",
+            compute_dtype="float32")  # fp32 vs fp32: the 1e-3 gate is
+        # a conversion gate, not a bf16-rounding gate
+        torch.manual_seed(0)
+        hf = MixtralForCausalLM(MixtralConfig(
+            vocab_size=160, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, num_local_experts=4,
+            num_experts_per_tok=2, max_position_embeddings=64,
+            rope_theta=cfg.rope_theta, rms_norm_eps=cfg.norm_epsilon,
+            tie_word_embeddings=False)).eval()
+        return hf, cfg
+
+    def test_logits_match_hf(self, mixtral):
+        """avg max-abs logit error <= 1e-3 fp32 — the same gate the
+        llama conversion holds (ref: tests/test_llama_weights.py:106)."""
+        import jax
+
+        from megatron_tpu.convert import hf_mixtral_to_params
+        from megatron_tpu.models import language_model as lm
+        hf, cfg = mixtral
+        # dropless capacity is part of the preset contract
+        assert cfg.moe_capacity_factor >= cfg.num_experts / cfg.moe_top_k
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        params = hf_mixtral_to_params(sd, cfg)
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 160, (2, 48)).astype(np.int32)
+        with torch.no_grad():
+            hf_logits = hf(torch.tensor(tokens.astype(np.int64))
+                           ).logits.numpy()
+        ours, _ = lm.model_forward(params, jax.numpy.asarray(tokens), cfg)
+        ours = np.asarray(ours, np.float32)[:, :, :160]
+        err = np.abs(ours - hf_logits).max(axis=-1).mean()
+        assert err <= 1e-3, err
+
+    def test_roundtrip_and_coverage(self, mixtral):
+        import jax
+
+        from megatron_tpu.convert import (hf_mixtral_to_params,
+                                          params_to_hf_mixtral)
+        hf, cfg = mixtral
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        params = hf_mixtral_to_params(sd, cfg)
+        sd2 = params_to_hf_mixtral(params, cfg)
+        params2 = hf_mixtral_to_params(sd2, cfg)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # no silently dropped tensors
+        missing = set(sd) - set(sd2) - {"model.rotary_emb.inv_freq"}
+        assert not missing, f"weights dropped by roundtrip: {missing}"
+        for k in sd2:
+            np.testing.assert_allclose(sd2[k], sd[k], rtol=1e-6, atol=1e-7,
+                                       err_msg=k)

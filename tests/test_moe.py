@@ -163,6 +163,22 @@ def test_moe_requires_experts_divisible_by_tp():
         ).validate(n_devices=8)
 
 
+def test_mixtral_preset_dropless_capacity_tracks_overrides():
+    """The dropless capacity default must be computed from the FINAL
+    num_experts/moe_top_k (post-overrides), and an explicit
+    capacity_factor must win."""
+    from megatron_tpu.config import mixtral_config
+    assert mixtral_config("8x7b").moe_capacity_factor == 8 / 2
+    assert mixtral_config("8x7b", moe_top_k=1).moe_capacity_factor == 8 / 1
+    assert mixtral_config("tiny", num_experts=8).moe_capacity_factor == 8 / 2
+    assert mixtral_config("8x7b",
+                          moe_capacity_factor=1.25).moe_capacity_factor == 1.25
+    # the real weights support 32k positions even at the 4096 default seq
+    assert mixtral_config("8x7b").max_position_embeddings == 32768
+    with pytest.raises(ValueError, match="unknown mixtral size"):
+        mixtral_config("7b")
+
+
 def test_moe_requires_pp1():
     from megatron_tpu.config import (MegatronConfig, ParallelConfig,
                                      TrainingConfig)

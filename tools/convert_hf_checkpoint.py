@@ -26,11 +26,14 @@ ensure_env_platform()
 
 
 def _model_cfg(family: str, size: str):
-    from megatron_tpu.config import falcon_config, llama2_config
+    from megatron_tpu.config import (falcon_config, llama2_config,
+                                     mixtral_config)
     if family == "llama":
         return llama2_config(size)
     if family == "falcon":
         return falcon_config(size)
+    if family == "mixtral":
+        return mixtral_config(size)
     raise ValueError(f"unknown family {family}")
 
 
@@ -61,8 +64,10 @@ def do_import(args):
         sd = {k: v.detach().cpu().numpy()
               for k, v in model.state_dict().items()}
         del model
-        conv = (hf_llama_to_params if args.family == "llama"
-                else hf_falcon_to_params)
+        from megatron_tpu.convert import hf_mixtral_to_params
+        conv = {"llama": hf_llama_to_params,
+                "falcon": hf_falcon_to_params,
+                "mixtral": hf_mixtral_to_params}[args.family]
         params = conv(sd, mcfg, dtype=np.float32)
     state = TrainState(params=params, opt_state=None, iteration=0)
     cfg = MegatronConfig(model=mcfg)
@@ -104,6 +109,22 @@ def do_export(args):
             rms_norm_eps=mcfg.norm_epsilon,
             tie_word_embeddings=mcfg.tie_embed_logits,
         )
+    elif args.family == "mixtral":
+        from megatron_tpu.convert import params_to_hf_mixtral
+        from transformers import MixtralConfig
+        sd = params_to_hf_mixtral(state.params, mcfg)
+        hf_cfg = MixtralConfig(
+            vocab_size=mcfg.vocab_size, hidden_size=mcfg.hidden_size,
+            num_hidden_layers=mcfg.num_layers,
+            num_attention_heads=mcfg.num_attention_heads,
+            num_key_value_heads=mcfg.num_kv_heads,
+            intermediate_size=mcfg.ffn_hidden_size,
+            max_position_embeddings=mcfg.max_position_embeddings,
+            rms_norm_eps=mcfg.norm_epsilon, rope_theta=mcfg.rope_theta,
+            num_local_experts=mcfg.num_experts,
+            num_experts_per_tok=mcfg.moe_top_k,
+            tie_word_embeddings=mcfg.tie_embed_logits,
+        )
     else:
         from megatron_tpu.convert import params_to_hf_falcon
         from transformers import FalconConfig
@@ -135,14 +156,16 @@ def main(argv=None):
                     help="HF model path, or a dir of consolidated.NN.pth "
                          "shards with --source meta")
     pi.add_argument("--out", required=True)
-    pi.add_argument("--family", default="llama", choices=["llama", "falcon"])
+    pi.add_argument("--family", default="llama",
+                    choices=["llama", "falcon", "mixtral"])
     pi.add_argument("--size", default="7b")
     pi.add_argument("--source", default="hf", choices=["hf", "meta"],
                     help="meta = raw Meta-llama consolidated shards")
     pe = sub.add_parser("export")
     pe.add_argument("--load", required=True)
     pe.add_argument("--hf_out", required=True)
-    pe.add_argument("--family", default="llama", choices=["llama", "falcon"])
+    pe.add_argument("--family", default="llama",
+                    choices=["llama", "falcon", "mixtral"])
     pe.add_argument("--size", default="7b")
     args = p.parse_args(argv)
     if args.cmd == "import":
