@@ -273,25 +273,11 @@ class TestMixtralConversion:
 
     @pytest.fixture(scope="class")
     def mixtral(self):
-        from transformers import MixtralConfig, MixtralForCausalLM
-
-        from megatron_tpu.config import mixtral_config
-        cfg = mixtral_config(
-            "tiny", num_layers=2, hidden_size=64, num_attention_heads=4,
-            num_kv_heads=2, ffn_hidden_size=96, vocab_size=160,
-            seq_length=64, num_experts=4, moe_top_k=2,
-            make_vocab_size_divisible_by=32, attention_impl="dot",
-            compute_dtype="float32")  # fp32 vs fp32: the 1e-3 gate is
-        # a conversion gate, not a bf16-rounding gate
-        torch.manual_seed(0)
-        hf = MixtralForCausalLM(MixtralConfig(
-            vocab_size=160, hidden_size=64, intermediate_size=96,
-            num_hidden_layers=2, num_attention_heads=4,
-            num_key_value_heads=2, num_local_experts=4,
-            num_experts_per_tok=2, max_position_embeddings=64,
-            rope_theta=cfg.rope_theta, rms_norm_eps=cfg.norm_epsilon,
-            tie_word_embeddings=False)).eval()
-        return hf, cfg
+        # one source of truth for the tiny synthetic Mixtral (same
+        # pattern as the Llama fixture above): fp32 both sides, so the
+        # 1e-3 gate measures conversion, not bf16 rounding
+        from verify_correctness import make_synthetic_hf_mixtral
+        return make_synthetic_hf_mixtral()
 
     def test_logits_match_hf(self, mixtral):
         """avg max-abs logit error <= 1e-3 fp32 — the same gate the

@@ -26,22 +26,27 @@ from megatron_tpu.utils.platform import ensure_env_platform
 ensure_env_platform()
 
 
-def compare_llama(hf_model, cfg, tokens: np.ndarray) -> dict:
+def compare_llama(hf_model, cfg, tokens: np.ndarray,
+                  family: str = "llama") -> dict:
     """Run HF (torch, fp32) and megatron_tpu (jax, fp32) on `tokens`.
 
     Returns {max_abs_err, avg_max_abs_err, loss_hf, loss_ours}
-    (ref: verify_correctness.py:143-194 reports the same quantities)."""
+    (ref: verify_correctness.py:143-194 reports the same quantities).
+    `family` picks the converter: "llama" or "mixtral" (MoE)."""
     import jax
     import jax.numpy as jnp
     import torch
 
-    from megatron_tpu.convert import hf_llama_to_params
+    from megatron_tpu.convert import (hf_llama_to_params,
+                                      hf_mixtral_to_params)
     from megatron_tpu.models import language_model as lm
     from megatron_tpu.ops.cross_entropy import cross_entropy_loss
 
     cfg = dataclasses.replace(cfg, compute_dtype="float32")
     sd = {k: v.detach().cpu().numpy() for k, v in hf_model.state_dict().items()}
-    params = hf_llama_to_params(sd, cfg)
+    conv = {"llama": hf_llama_to_params,
+            "mixtral": hf_mixtral_to_params}[family]
+    params = conv(sd, cfg)
 
     with torch.no_grad():
         out = hf_model(torch.tensor(tokens)).logits.float().numpy()
@@ -89,6 +94,32 @@ def make_synthetic_hf_llama(vocab=128, hidden=64, layers=4, heads=4, kv=2,
     return model, cfg
 
 
+def make_synthetic_hf_mixtral(vocab=160, hidden=64, layers=2, heads=4, kv=2,
+                              ffn=96, experts=4, top_k=2, seq=64, seed=0):
+    """Random tiny HF Mixtral + the matching MoE ModelConfig — extends the
+    hermetic gate to the MoE conversion path (capacity E/K => dropless,
+    so parity is exact, not capacity-truncated)."""
+    import torch
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    from megatron_tpu.config import mixtral_config
+    torch.manual_seed(seed)
+    model = MixtralForCausalLM(MixtralConfig(
+        vocab_size=vocab, hidden_size=hidden, num_hidden_layers=layers,
+        num_attention_heads=heads, num_key_value_heads=kv,
+        intermediate_size=ffn, num_local_experts=experts,
+        num_experts_per_tok=top_k, max_position_embeddings=seq,
+        rope_theta=1e6, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)).eval()
+    cfg = mixtral_config(
+        "tiny", num_layers=layers, hidden_size=hidden,
+        num_attention_heads=heads, num_kv_heads=kv, ffn_hidden_size=ffn,
+        vocab_size=vocab, seq_length=seq, num_experts=experts,
+        moe_top_k=top_k, make_vocab_size_divisible_by=1,
+        compute_dtype="float32")
+    return model, cfg
+
+
 def seed_hf_llama_numpy(model, seed=0):
     """Overwrite every parameter with numpy-seeded values. torch's RNG
     stream (manual_seed) is not guaranteed stable across torch versions;
@@ -112,6 +143,8 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--hf_path", type=str, default=None)
     p.add_argument("--model_size", type=str, default="7b")
+    p.add_argument("--family", type=str, default="llama",
+                   choices=["llama", "mixtral"])
     p.add_argument("--synthetic", action="store_true")
     p.add_argument("--batch", type=int, default=2)
     p.add_argument("--seq", type=int, default=64)
@@ -131,18 +164,24 @@ def main(argv=None):
         return golden_mode(args)
 
     if args.synthetic or args.hf_path is None:
-        model, cfg = make_synthetic_hf_llama(seq=args.seq)
+        if args.family == "mixtral":
+            model, cfg = make_synthetic_hf_mixtral(seq=args.seq)
+        else:
+            model, cfg = make_synthetic_hf_llama(seq=args.seq)
     else:
         from transformers import AutoModelForCausalLM
-        from megatron_tpu.config import llama2_config
+
+        from megatron_tpu.config import llama2_config, mixtral_config
         model = AutoModelForCausalLM.from_pretrained(
             args.hf_path, torch_dtype="float32").eval()
-        cfg = llama2_config(args.model_size, compute_dtype="float32")
+        cfg = (mixtral_config(args.model_size, compute_dtype="float32")
+               if args.family == "mixtral"
+               else llama2_config(args.model_size, compute_dtype="float32"))
 
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size,
                           (args.batch, args.seq)).astype(np.int32)
-    r = compare_llama(model, cfg, tokens)
+    r = compare_llama(model, cfg, tokens, family=args.family)
     print(f"max abs logit error:     {r['max_abs_err']:.2e}")
     print(f"avg max-abs logit error: {r['avg_max_abs_err']:.2e}")
     print(f"loss ours / hf:          {r['loss_ours']:.6f} / {r['loss_hf']:.6f}")
