@@ -52,6 +52,8 @@ def build_parser(extra_args_provider: Optional[Callable] = None
     g.add_argument("--position_embedding", action="store_true",
                    dest="use_position_embedding")
     g.add_argument("--rope_theta", type=float, default=10000.0)
+    # Mistral-style banded causal attention (None = full causal)
+    g.add_argument("--sliding_window", type=int, default=None)
     g.add_argument("--rope_scaling_factor", type=float, default=1.0)
     g.add_argument("--glu_activation", type=str, default=None,
                    choices=["swiglu", "geglu", "reglu", "liglu"])

@@ -104,6 +104,10 @@ class ModelConfig:
     # einsum) | "ring" (context-parallel K/V-rotation over 'cp') |
     # "ulysses" (context-parallel all-to-all head sharding over 'cp')
     attention_impl: str = "dot"
+    # Mistral-style sliding-window (banded causal) attention: each token
+    # attends at most the previous `sliding_window` positions. None =
+    # full causal. The flash kernel skips whole blocks outside the band.
+    sliding_window: Optional[int] = None
     # activation recompute: "none" | "selective" | "full" (ref: arguments.py:601-629)
     recompute_granularity: str = "none"
     # low-precision GEMM path: "none" | "int8" (forward attention/MLP GEMMs
@@ -367,6 +371,17 @@ class MegatronConfig:
                     "warning: quantized_gemm does not cover the MoE "
                     "expert GEMMs yet — experts run in the compute dtype "
                     "(attention/dense paths stay quantized)")
+        if model.sliding_window is not None:
+            assert model.sliding_window >= 1, (
+                f"sliding_window={model.sliding_window} must be >= 1 "
+                "(0/negative would mask EVERY key)")
+            if model.attention_impl in ("ring", "ulysses"):
+                from megatron_tpu.utils.logging import print_rank_0
+                print_rank_0(
+                    f"warning: attention_impl={model.attention_impl!r} "
+                    "has no sliding-window plumbing — attention falls "
+                    "back to the unfused dot path (O(s^2) scores); use "
+                    "attention_impl=flash for banded attention")
         if model.attention_impl in ("flash", "ring", "ulysses") and \
                 model.attention_dropout > 0.0:
             # the fused/cp paths have no dropout plumbing; training traces

@@ -106,7 +106,8 @@ def attention_axes(cfg: ModelConfig):
 
 def _dot_attention(q, k, v, *, causal: bool, softmax_fp32: bool,
                    scale: float, q_offset=None, dropout_rate: float = 0.0,
-                   dropout_rng=None, segment_ids=None):
+                   dropout_rng=None, segment_ids=None,
+                   sliding_window=None):
     """Unfused attention: einsum QK^T -> mask -> softmax -> einsum AV.
 
     q: [b, s, nq, hd]; k, v: [b, t, nkv, hd]. GQA handled by reshaping q into
@@ -122,13 +123,20 @@ def _dot_attention(q, k, v, *, causal: bool, softmax_fp32: bool,
     scores = jnp.einsum("bsngd,btnd->bngst", qg, k) * scale
     if softmax_fp32:
         scores = scores.astype(jnp.float32)
+    # sliding_window is a refinement OF the causal mask; non-causal
+    # callers must not set it (attention_apply asserts), so the gate
+    # stays causal-or-segments
     if causal or segment_ids is not None:
         if causal:
             q_pos = jnp.arange(s)[:, None]
             if q_offset is not None:
                 q_pos = q_pos + q_offset
             kv_pos = jnp.arange(t)[None, :]
-            mask = jnp.broadcast_to((q_pos >= kv_pos)[None], (b, s, t))
+            win = (q_pos >= kv_pos)
+            if sliding_window is not None:
+                # banded causal: attend at most the previous W positions
+                win = win & (q_pos - kv_pos < sliding_window)
+            mask = jnp.broadcast_to(win[None], (b, s, t))
         else:
             mask = jnp.ones((b, s, t), bool)
         if segment_ids is not None:
@@ -204,6 +212,11 @@ def attention_apply(
     # Active attention dropout is only implemented on the dot path — see
     # the fuller comment at the dispatch below; every fused gate
     # (including the prefill one here) must include this term.
+    # sliding_window refines the CAUSAL mask; a bidirectional caller
+    # (BERT/T5-encoder, cross-attention) setting it would be silently
+    # ignored by every implementation — fail at trace time instead
+    assert cfg.sliding_window is None or (causal and not cross), (
+        "sliding_window requires causal self-attention")
     dropout_active = not deterministic and cfg.attention_dropout > 0.0
     # A cached forward with s > 1 is an offset-0 prefill everywhere in
     # this codebase (generation.py's prefill; decode steps are s == 1).
@@ -259,7 +272,7 @@ def attention_apply(
     # (deterministic=True) keep the fused paths.
     ring_branch = (cfg.attention_impl in ("ring", "ulysses")
                    and kv_cache is None and segment_ids is None and causal
-                   and not dropout_active)
+                   and cfg.sliding_window is None and not dropout_active)
     # a pre-permuted batch MUST reach the ring path: any gating drift
     # between data_zigzag_cp (which told the loss to permute) and this
     # dispatch would apply causal masks to the wrong rows and silently
@@ -299,9 +312,11 @@ def attention_apply(
         from megatron_tpu.ops.flash_attention import flash_attention
         # segment_ids ride into the kernel (EOD-reset block-diagonal
         # masking, ref: --reset_attention_mask) — O(s) memory where the
-        # dot path would materialize the [s, s] scores
+        # dot path would materialize the [s, s] scores; sliding_window
+        # additionally skips whole blocks outside the band
         out = flash_attention(q, k, v, causal=causal, scale=scale,
-                              segment_ids=segment_ids)
+                              segment_ids=segment_ids,
+                              sliding_window=cfg.sliding_window)
     elif prefill_flash:
         from megatron_tpu.ops.flash_attention import flash_attention
 
@@ -309,13 +324,15 @@ def attention_apply(
         # one, and only offset 0 gets the flash shortcut
         out = jax.lax.cond(
             q_offset == 0,
-            lambda: flash_attention(q, k_raw, v_raw, causal=True,
-                                    scale=scale).astype(jnp.float32),
+            lambda: flash_attention(
+                q, k_raw, v_raw, causal=True, scale=scale,
+                sliding_window=cfg.sliding_window).astype(jnp.float32),
             lambda: _dot_attention(
                 q, k, v, causal=causal,
                 softmax_fp32=cfg.attention_softmax_in_fp32,
                 scale=scale, q_offset=q_offset,
-                segment_ids=segment_ids).astype(jnp.float32),
+                segment_ids=segment_ids,
+                sliding_window=cfg.sliding_window).astype(jnp.float32),
         ).astype(dtype)
     else:
         rate = 0.0 if deterministic else cfg.attention_dropout
@@ -323,7 +340,8 @@ def attention_apply(
             q, k, v, causal=causal,
             softmax_fp32=cfg.attention_softmax_in_fp32,
             scale=scale, q_offset=q_offset, dropout_rate=rate,
-            dropout_rng=dropout_rng, segment_ids=segment_ids)
+            dropout_rng=dropout_rng, segment_ids=segment_ids,
+            sliding_window=cfg.sliding_window)
 
     out = out.reshape(b, s, nq * hd)
     out = qdense(out, wcast(params["wo"], dtype), cfg.quantized_gemm)

@@ -30,10 +30,11 @@ logger = logging.getLogger(__name__)
 _warned_shapes = set()
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_kv", "use_pallas"))
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_kv",
+                                             "use_pallas", "sliding_window"))
 def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
                     block_kv: int = DEFAULT_BLOCK_KV, use_pallas: bool | None = None,
-                    segment_ids=None):
+                    segment_ids=None, sliding_window: int | None = None):
     """Blockwise attention with online softmax. Returns [b, sq, nq, d].
 
     `segment_ids` [b, s] (shared q/k length) masks attention across
@@ -64,15 +65,17 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
             seg = (segment_ids.astype(jnp.float32)
                    if segment_ids is not None else None)
             return pallas_flash_attention(
-                q, k, v, causal, scale, PBQ, PBKV, False, seg, seg)
+                q, k, v, causal, scale, PBQ, PBKV, False, seg, seg,
+                sliding_window)
         except ImportError:
             pass
     return _blockwise_attention(q, k, v, causal=causal, scale=scale,
-                                block_kv=block_kv, segment_ids=segment_ids)
+                                block_kv=block_kv, segment_ids=segment_ids,
+                                sliding_window=sliding_window)
 
 
 def _blockwise_attention(q, k, v, *, causal, scale, block_kv,
-                         segment_ids=None):
+                         segment_ids=None, sliding_window=None):
     b, sq, nq, d = q.shape
     skv, nkv = k.shape[1], k.shape[2]
     if scale is None:
@@ -104,7 +107,11 @@ def _blockwise_attention(q, k, v, *, causal, scale, block_kv,
         kv_pos = j * block_kv + jnp.arange(block_kv)
         valid = kv_pos < skv
         if causal:
-            valid = valid[None, :] & (q_pos[:, None] >= kv_pos[None, :])
+            win = q_pos[:, None] >= kv_pos[None, :]
+            if sliding_window is not None:
+                win = win & (q_pos[:, None] - kv_pos[None, :]
+                             < sliding_window)
+            valid = valid[None, :] & win
             valid = jnp.broadcast_to(valid[None], (b, sq, block_kv))
         else:
             valid = jnp.broadcast_to(valid[None, None], (b, sq, block_kv))

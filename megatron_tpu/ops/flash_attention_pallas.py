@@ -52,7 +52,7 @@ STAT_LANES = 8
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, block_q,
-                block_kv, num_kv, has_segs=False):
+                block_kv, num_kv, has_segs=False, window=None):
     # refs: [qs_ref, ks_ref]? o_ref, lse_ref, acc_ref, m_ref, l_ref —
     # segment-id blocks are inputs only when segment masking is on, so the
     # plain path pays zero extra DMA
@@ -70,10 +70,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, block_q,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # whole block beyond the diagonal -> skip (causal)
+    # whole block beyond the diagonal -> skip (causal); with a sliding
+    # window also skip blocks entirely BEHIND the band
     run = True
     if causal:
         run = ki * block_kv <= qi * block_q + block_q - 1
+        if window is not None:
+            run = run & (ki * block_kv + block_kv - 1
+                         > qi * block_q - window)
 
     @pl.when(run)
     def _body():
@@ -87,7 +91,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, block_q,
                 jnp.int32, (block_q, block_kv), 0)
             kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+            keep = q_pos >= kv_pos
+            if window is not None:
+                keep = keep & (q_pos - kv_pos < window)
+            s = jnp.where(keep, s, NEG_INF)
         if has_segs:
             # block-diagonal across documents (ref: --reset_attention_mask,
             # megatron/utils.py:137-194); ids ride as f32 lanes, equality
@@ -121,7 +128,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, block_q,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    *refs, scale, causal, block_q, block_kv, num_kv,
-                   has_dlse=False, has_segs=False):
+                   has_dlse=False, has_segs=False, window=None):
     # refs: [qs_ref, ks_ref]? [dlse_ref]? dq_ref, dq_acc — segment blocks
     # and dlse are inputs only when the respective feature is on (the
     # plain path skips both DMAs)
@@ -144,6 +151,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     run = True
     if causal:
         run = ki * block_kv <= qi * block_q + block_q - 1
+        if window is not None:
+            run = run & (ki * block_kv + block_kv - 1
+                         > qi * block_q - window)
 
     @pl.when(run)
     def _body():
@@ -162,7 +172,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_kv), 0)
             kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+            keep = q_pos >= kv_pos
+            if window is not None:
+                keep = keep & (q_pos - kv_pos < window)
+            s = jnp.where(keep, s, NEG_INF)
         if has_segs:
             q_seg = qs_ref[0][:, :1]
             k_seg = ks_ref[0][:, 0][None, :]
@@ -187,7 +200,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     *refs, scale, causal, block_q, block_kv, num_q,
-                    has_dlse=False, has_segs=False):
+                    has_dlse=False, has_segs=False, window=None):
     refs = list(refs)
     qs_ref = ks_ref = dlse_ref = None
     if has_segs:
@@ -207,8 +220,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if causal:
-        # q block entirely above the diagonal contributes nothing
+        # q block entirely above the diagonal contributes nothing; with a
+        # sliding window, neither does one entirely past the band
         run = qi * block_q + block_q - 1 >= ki * block_kv
+        if window is not None:
+            run = run & (qi * block_q
+                         < ki * block_kv + block_kv - 1 + window)
 
     @pl.when(run)
     def _body():
@@ -225,7 +242,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_kv), 0)
             kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+            keep = q_pos >= kv_pos
+            if window is not None:
+                keep = keep & (q_pos - kv_pos < window)
+            s = jnp.where(keep, s, NEG_INF)
         if has_segs:
             q_seg = qs_ref[0][:, :1]
             k_seg = ks_ref[0][:, 0][None, :]
@@ -277,10 +297,11 @@ def _seg_lanes(seg, lanes=STAT_LANES):
                             seg.shape + (lanes,))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 10))
 def pallas_flash_attention(q, k, v, causal=True, scale=None,
                            block_q=DEFAULT_BLOCK_Q, block_kv=DEFAULT_BLOCK_KV,
-                           interpret=False, q_seg=None, k_seg=None):
+                           interpret=False, q_seg=None, k_seg=None,
+                           sliding_window=None):
     """q [b, sq, nq, d], k/v [b, sk, nkv, d] -> [b, sq, nq, d].
 
     `q_seg`/`k_seg` [b, s] FLOAT segment ids (cast outside so the vjp's
@@ -288,12 +309,12 @@ def pallas_flash_attention(q, k, v, causal=True, scale=None,
     differ — block-diagonal attention across EOD-separated documents
     (ref: --reset_attention_mask, megatron/utils.py:137-194)."""
     out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
-                        q_seg, k_seg)
+                        q_seg, k_seg, sliding_window)
     return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
-               q_seg=None, k_seg=None):
+               q_seg=None, k_seg=None, sliding_window=None):
     b, sq, nq, d = q.shape
     sk, nkv = k.shape[1], k.shape[2]
     g = nq // nkv
@@ -328,7 +349,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           block_q=bq, block_kv=bkv, num_kv=num_kv,
-                          has_segs=has_segs),
+                          has_segs=has_segs, window=sliding_window),
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec] + seg_specs,
         out_specs=[o_spec, lse_spec],
@@ -344,7 +365,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
 
 
 def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
-                    dlse=None):
+                    dlse=None, sliding_window=None):
     """Shared backward. `dlse` [b, sq, nq] is the cotangent of the exposed
     logsumexp (ring attention's merge weights use it); None means zero."""
     q, k, v, out, lse, q_seg, k_seg = res
@@ -387,7 +408,8 @@ def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=bq, block_kv=bkv, num_kv=num_kv,
-                          has_dlse=has_dlse, has_segs=has_segs),
+                          has_dlse=has_dlse, has_segs=has_segs,
+                          window=sliding_window),
         grid=(b, nq, num_q, num_kv),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec]
         + seg_specs + [row_spec] * has_dlse,
@@ -417,7 +439,8 @@ def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
     dk_per_head, dv_per_head = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=bq, block_kv=bkv, num_q=num_q,
-                          has_dlse=has_dlse, has_segs=has_segs),
+                          has_dlse=has_dlse, has_segs=has_segs,
+                          window=sliding_window),
         grid=(b, nq, num_kv, num_q),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2]
         + seg_specs2 + [row_spec2] * has_dlse,
@@ -442,16 +465,20 @@ def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
     return grads, seg_grads
 
 
-def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, dout):
+def _flash_bwd(causal, scale, block_q, block_kv, interpret,
+               sliding_window, res, dout):
+    # sliding_window arrives as a NONDIFF arg (a static Python int), never
+    # via the residuals — a traced scalar could not close over the kernels
     (dq, dk, dv), (dqs, dks) = _flash_bwd_core(
-        causal, scale, block_q, block_kv, interpret, res, dout)
+        causal, scale, block_q, block_kv, interpret, res, dout,
+        sliding_window=sliding_window)
     return dq, dk, dv, dqs, dks
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_kv, interpret,
-                    q_seg=None, k_seg=None):
+                    q_seg=None, k_seg=None, sliding_window=None):
     out, res = _flash_fwd(q, k, v, causal, scale, block_q, block_kv,
-                          interpret, q_seg, k_seg)
+                          interpret, q_seg, k_seg, sliding_window)
     return out, res
 
 

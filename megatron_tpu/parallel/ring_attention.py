@@ -108,6 +108,12 @@ def data_zigzag_cp(cfg, seq_len: int, *, causal: bool = True,
         # would get causal masks on the wrong rows; conservatively keep
         # the runtime-permute mode for such configs (eval traces too)
         return 0
+    if getattr(cfg, "sliding_window", None) is not None:
+        # the ring path has no banded-mask plumbing: attention falls back
+        # to the dot path (models/attention.py ring_branch gating), so a
+        # pre-permuted batch would be masked on the wrong rows — same
+        # reasoning as the dropout exclusion above
+        return 0
     try:
         mesh = jax.sharding.get_abstract_mesh()
     except Exception:
