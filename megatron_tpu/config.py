@@ -365,12 +365,6 @@ class MegatronConfig:
                 f"tensor_parallel={par.tensor_parallel} (the expert bank's "
                 "leading axis is tp-sharded — parallel/sharding.py "
                 "'experts' rule)")
-            if model.quantized_gemm != "none":
-                from megatron_tpu.utils.logging import print_rank_0
-                print_rank_0(
-                    "warning: quantized_gemm does not cover the MoE "
-                    "expert GEMMs yet — experts run in the compute dtype "
-                    "(attention/dense paths stay quantized)")
         if model.sliding_window is not None:
             assert model.sliding_window >= 1, (
                 f"sliding_window={model.sliding_window} must be >= 1 "

@@ -130,17 +130,29 @@ def moe_apply(params, x, cfg: ModelConfig):
     xin = jnp.einsum("bsec,bsh->bech", dispatch.astype(dtype), x)
     w1 = params["w1"].astype(dtype)
     w2 = params["w2"].astype(dtype)
+    E_, h_ = w1.shape[0], w1.shape[1]
+
+    def bank_gemm(xb, wb):
+        # expert GEMMs honor --quantized_gemm like the dense MLP does
+        # (wb flattened to [E, K, N]; the GLU split stays a leading
+        # index of the flattened output)
+        if cfg.quantized_gemm == "int8":
+            from megatron_tpu.ops.quantized import int8_expert_matmul
+            return int8_expert_matmul(xb, wb)
+        return jnp.einsum("beck,ekn->becn", xb, wb)
+
     if cfg.is_glu:
-        y1 = jnp.einsum("bech,ehgf->becgf", xin, w1)
+        y1 = bank_gemm(xin, w1.reshape(E_, h_, -1))
+        y1 = y1.reshape(*y1.shape[:-1], 2, cfg.ffn_hidden_size)
         if cfg.use_bias:
             y1 = y1 + params["b1"].astype(dtype)[None, :, None]
         act = activation_fn(cfg.activation, y1[..., 0, :], y1[..., 1, :])
     else:
-        y1 = jnp.einsum("bech,ehf->becf", xin, w1)
+        y1 = bank_gemm(xin, w1)
         if cfg.use_bias:
             y1 = y1 + params["b1"].astype(dtype)[None, :, None]
         act = activation_fn(cfg.activation, y1)
-    y2 = jnp.einsum("becf,efh->bech", act, w2)
+    y2 = bank_gemm(act, w2)
     if cfg.use_bias:
         # per-expert output bias; dropped (not duplicated) tokens simply
         # never see it, matching the dispatch semantics

@@ -124,6 +124,12 @@ def quantize_weights(params):
     serving."""
     def walk(name, node):
         if isinstance(node, dict):
+            if "router" in node:
+                # MoE expert bank: [L, E, K, ...] layout — axis 1 is the
+                # EXPERT dim, not the contraction, and _w8_matmul has no
+                # banked path; experts stay in the compute dtype
+                # (int8_expert_matmul covers the training-side lever)
+                return node
             return {k: walk(k, v) for k, v in node.items()}
         if name in _QUANTIZABLE:
             ax = _STACKED_CONTRACT_AXIS
@@ -191,3 +197,38 @@ def qdense(x, w, quantized_gemm: str):
     k = w.shape[0]
     y = int8_matmul(x, w.reshape(k, -1))
     return y.reshape(*y.shape[:-1], *w.shape[1:])
+
+
+def _int8_bmm_impl(x, w):
+    """x [..., E, C, K] against a per-expert bank w [E, K, N] on the int8
+    datapath: per-row activation scales, per-(expert, column) weight
+    scales, int32 accumulation."""
+    xi, sx = quantize_rows(x)
+    # one quantization recipe: per-expert vmap of the dense per-column rule
+    wi, sw = jax.vmap(_quantize_cols)(w)                      # [E,K,N],[E,N]
+    yi = jnp.einsum("...eck,ekn->...ecn", xi, wi,
+                    preferred_element_type=jnp.int32)
+    y = yi.astype(jnp.float32) * sx * sw[:, None, :]
+    return y.astype(x.dtype)
+
+
+@jax.custom_vjp
+def int8_expert_matmul(x, w):
+    """Per-expert batched GEMM (MoE banks) with the same int8-forward /
+    full-precision-backward recipe as int8_matmul. x [..., E, C, K],
+    w [E, K, N] -> [..., E, C, N]."""
+    return _int8_bmm_impl(x, w)
+
+
+def _int8_bmm_fwd(x, w):
+    return _int8_bmm_impl(x, w), (x, w)
+
+
+def _int8_bmm_bwd(res, dy):
+    x, w = res
+    dx = jnp.einsum("...ecn,ekn->...eck", dy, w)
+    dw = jnp.einsum("...eck,...ecn->ekn", x, dy)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+int8_expert_matmul.defvjp(_int8_bmm_fwd, _int8_bmm_bwd)
