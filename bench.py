@@ -250,6 +250,12 @@ def main():
             # tried. micro_bs=2 + full remat: the axon remote-compile helper
             # reproducibly dies (HTTP 500) on [4, 2048, 2048] activation
             # shapes and on the selective-remat policy at this size.
+            # recompute=none at this shape MIGHT be faster (~1/4 of the
+            # step FLOPs is remat recompute) — but it is NOT attempted
+            # here: a fits-but-slower run (XLA spilling at ~15/16 GB)
+            # would REPLACE this proven record, and the tunnel windows
+            # are short. tools/bench_remat.py measures that A/B off the
+            # driver path; promote only with on-chip data.
             (llama2_config(
                 "tiny", num_layers=12, hidden_size=2048,
                 num_attention_heads=16, num_kv_heads=16, ffn_hidden_size=5504,
