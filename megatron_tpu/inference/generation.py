@@ -46,7 +46,7 @@ KV_CACHE_AXES = ("layers", None, None, "kv_heads", None)
 
 
 def init_kv_caches(cfg: ModelConfig, batch: int, max_len: int,
-                   dtype=jnp.bfloat16) -> KVCache:
+                   dtype=jnp.bfloat16, prefill_len=None) -> KVCache:
     """Stacked-over-layers KV cache [L, b, max_len, nkv, hd].
 
     Under a mesh context the cache is sharded over 'tp' on the kv-head dim
@@ -58,9 +58,25 @@ def init_kv_caches(cfg: ModelConfig, batch: int, max_len: int,
     dtype=jnp.int8: quantized cache with per-(token, head) scales — decode
     streams the whole cache every step, so this halves the dominant HBM
     stream at long context AND the residency (a 7B 32k bf16 cache alone
-    outgrows a v5e)."""
+    outgrows a v5e).
+
+    With cfg.sliding_window < max_len the cache is a ROLLING buffer of
+    exactly `sliding_window` slots (Mistral's rolling-buffer serving):
+    banded attention never reads past the window, so memory is O(W)
+    regardless of stream length — attention_apply writes position % W
+    and masks by the slot->position map."""
     from megatron_tpu.parallel.sharding import constrain
     L = cfg.num_layers
+    if cfg.sliding_window is not None and (
+            cfg.attention_impl == "flash"
+            or (prefill_len is not None
+                and prefill_len <= cfg.sliding_window)):
+        # roll only when the prefill is exact in the W-slot buffer: the
+        # flash impl computes prefill outputs from the raw k/v, and a
+        # dot-impl prefill that FITS the window overwrites nothing. A
+        # dot-impl prompt longer than the window keeps the full-length
+        # cache (correct, just not memory-bounded).
+        max_len = min(max_len, cfg.sliding_window)
     shape = (L, batch, max_len, cfg.num_kv_heads, cfg.kv_channels)
     # jnp.dtype normalization: "int8" (cfg-style spelling) must behave
     # exactly like jnp.int8 — see KVCache.create
@@ -85,7 +101,8 @@ def _decode_fn(params, tokens, lengths, rng, *, cfg: ModelConfig,
     Returns (tokens [b, max_len], logprobs [b, max_len])."""
     b = tokens.shape[0]
 
-    caches = init_kv_caches(cfg, b, max_len, dtype=kv_dtype)
+    caches = init_kv_caches(cfg, b, max_len, dtype=kv_dtype,
+                            prefill_len=min_prompt)
 
     # PREFILL on the common prefix [0, min_prompt) — mirrors the reference
     # starting generation at the min prompt length and re-using prompt tokens
@@ -277,7 +294,8 @@ def beam_search(generator: Generator, prompt: list[int], beam_width: int,
 
     def prefill(params, tokens):
         caches = init_kv_caches(cfg, bw, max_len,
-                                dtype=generator.kv_cache_dtype)
+                                dtype=generator.kv_cache_dtype,
+                                prefill_len=prompt_len)
         logits, caches = lm.model_forward(
             params, tokens[:, :prompt_len], cfg, kv_caches=caches, rope=rope,
             logits_dtype=jnp.float32)

@@ -107,7 +107,7 @@ def attention_axes(cfg: ModelConfig):
 def _dot_attention(q, k, v, *, causal: bool, softmax_fp32: bool,
                    scale: float, q_offset=None, dropout_rate: float = 0.0,
                    dropout_rng=None, segment_ids=None,
-                   sliding_window=None):
+                   sliding_window=None, kv_positions=None):
     """Unfused attention: einsum QK^T -> mask -> softmax -> einsum AV.
 
     q: [b, s, nq, hd]; k, v: [b, t, nkv, hd]. GQA handled by reshaping q into
@@ -131,7 +131,10 @@ def _dot_attention(q, k, v, *, causal: bool, softmax_fp32: bool,
             q_pos = jnp.arange(s)[:, None]
             if q_offset is not None:
                 q_pos = q_pos + q_offset
-            kv_pos = jnp.arange(t)[None, :]
+            # kv_positions: the ROLLING cache's slot->position map (slot
+            # order is not time order); default is the contiguous layout
+            kv_pos = (kv_positions[None, :] if kv_positions is not None
+                      else jnp.arange(t)[None, :])
             win = (q_pos >= kv_pos)
             if sliding_window is not None:
                 # banded causal: attend at most the previous W positions
@@ -233,28 +236,80 @@ def attention_apply(
                      and not cross and not dropout_active)
     k_raw, v_raw = k, v
 
+    kv_positions = None
     if kv_cache is not None:
-        # incremental decode: write new k/v at offset, attend over full prefix
-        if kv_cache.k.dtype == jnp.int8:
+        cap = kv_cache.k.shape[1]
+        # ROLLING mode: the cache holds only the last `sliding_window`
+        # positions (capacity == window). Writes land at position % W and
+        # reads mask by the slot->position map below — O(W) serving
+        # memory for unbounded streams. Created by init_kv_caches when
+        # cfg.sliding_window < max_len.
+        rolling = (cfg.sliding_window is not None
+                   and cap == cfg.sliding_window)
+        quant = kv_cache.k.dtype == jnp.int8
+        if quant:
             from megatron_tpu.ops.quantized import quantize_rows
             ki, ks = quantize_rows(k)  # per (b, token, head) over head_dim
             vi, vs = quantize_rows(v)
+        if rolling:
+            # tokens beyond the window never survive a chunked write:
+            # keep only the last min(s, W) and scatter to their slots
+            # (unique by construction). Multi-token chunks are CORRECT
+            # when (a) routed through the offset-0 flash prefill (outputs
+            # come from the raw k/v; the cache just ends in the right
+            # state) or (b) s <= W at offset 0 on the dot path (nothing
+            # is overwritten). Mid-stream s > 1 chunks would need history
+            # this buffer already dropped — generation.py only prefills
+            # at offset 0, which is the caller contract here.
+            assert s == 1 or prefill_flash or s <= cap, (
+                "rolling KV cache: multi-token steps need the flash "
+                "prefill or s <= sliding_window (decode steps are s == 1)")
+            n_keep = min(s, cap)  # static: plain slices, no gather
+            slots = (kv_cache.offset + (s - n_keep)
+                     + jnp.arange(n_keep)) % cap
+
+            def wr(buf, val):
+                return buf.at[:, slots].set(
+                    val[:, s - n_keep:].astype(buf.dtype))
+
+            if quant:
+                kv_cache = KVCache(wr(kv_cache.k, ki), wr(kv_cache.v, vi),
+                                   kv_cache.offset + s,
+                                   wr(kv_cache.k_scale, ks),
+                                   wr(kv_cache.v_scale, vs))
+            else:
+                kv_cache = KVCache(wr(kv_cache.k, k), wr(kv_cache.v, v),
+                                   kv_cache.offset + s)
+            # slot j holds the largest position p <= t_last with
+            # p % W == j; never-written slots (p < 0) map to a sentinel
+            # the causal mask rejects
+            t_last = kv_cache.offset - 1
+            j = jnp.arange(cap)
+            p = t_last - ((t_last - j) % cap)
+            kv_positions = jnp.where(p >= 0, p, jnp.int32(2 ** 30))
+        else:
             dus = jax.lax.dynamic_update_slice_in_dim
-            new_k = dus(kv_cache.k, ki, kv_cache.offset, axis=1)
-            new_v = dus(kv_cache.v, vi, kv_cache.offset, axis=1)
-            new_ks = dus(kv_cache.k_scale, ks, kv_cache.offset, axis=1)
-            new_vs = dus(kv_cache.v_scale, vs, kv_cache.offset, axis=1)
-            kv_cache = KVCache(new_k, new_v, kv_cache.offset + s,
-                               new_ks, new_vs)
+            if quant:
+                kv_cache = KVCache(
+                    dus(kv_cache.k, ki, kv_cache.offset, axis=1),
+                    dus(kv_cache.v, vi, kv_cache.offset, axis=1),
+                    kv_cache.offset + s,
+                    dus(kv_cache.k_scale, ks, kv_cache.offset, axis=1),
+                    dus(kv_cache.v_scale, vs, kv_cache.offset, axis=1))
+            else:
+                kv_cache = KVCache(
+                    dus(kv_cache.k, k.astype(kv_cache.k.dtype),
+                        kv_cache.offset, axis=1),
+                    dus(kv_cache.v, v.astype(kv_cache.v.dtype),
+                        kv_cache.offset, axis=1),
+                    kv_cache.offset + s)
+        if quant:
             # dequant at read; XLA fuses convert*scale into the attention
             # dot's operand load, so HBM streams the int8 payload
-            k = new_k.astype(dtype) * new_ks.astype(dtype)
-            v = new_v.astype(dtype) * new_vs.astype(dtype)
+            k = kv_cache.k.astype(dtype) * kv_cache.k_scale.astype(dtype)
+            v = kv_cache.v.astype(dtype) * kv_cache.v_scale.astype(dtype)
         else:
-            new_k = jax.lax.dynamic_update_slice_in_dim(kv_cache.k, k.astype(kv_cache.k.dtype), kv_cache.offset, axis=1)
-            new_v = jax.lax.dynamic_update_slice_in_dim(kv_cache.v, v.astype(kv_cache.v.dtype), kv_cache.offset, axis=1)
-            kv_cache = KVCache(new_k, new_v, kv_cache.offset + s)
-            k, v = new_k.astype(dtype), new_v.astype(dtype)
+            k, v = kv_cache.k.astype(dtype), kv_cache.v.astype(dtype)
 
     scale = 1.0 / math.sqrt(hd)
     # Note on apply_query_key_layer_scaling: in the reference it divides QK^T
@@ -320,20 +375,31 @@ def attention_apply(
     elif prefill_flash:
         from megatron_tpu.ops.flash_attention import flash_attention
 
-        # both branches trace (compile-time cost only); runtime executes
-        # one, and only offset 0 gets the flash shortcut
-        out = jax.lax.cond(
-            q_offset == 0,
-            lambda: flash_attention(
+        if kv_positions is not None:
+            # ROLLING cache: the dot fallback below would be silently
+            # wrong for an offset>0 chunk (the chunk's own writes already
+            # evicted history its early queries need), so a multi-token
+            # step is defined ONLY at offset 0 — take flash directly on
+            # the raw k/v instead of hiding corruption behind a cond
+            out = flash_attention(
                 q, k_raw, v_raw, causal=True, scale=scale,
-                sliding_window=cfg.sliding_window).astype(jnp.float32),
-            lambda: _dot_attention(
-                q, k, v, causal=causal,
-                softmax_fp32=cfg.attention_softmax_in_fp32,
-                scale=scale, q_offset=q_offset,
-                segment_ids=segment_ids,
-                sliding_window=cfg.sliding_window).astype(jnp.float32),
-        ).astype(dtype)
+                sliding_window=cfg.sliding_window)
+        else:
+            # both branches trace (compile-time cost only); runtime
+            # executes one, and only offset 0 gets the flash shortcut
+            out = jax.lax.cond(
+                q_offset == 0,
+                lambda: flash_attention(
+                    q, k_raw, v_raw, causal=True, scale=scale,
+                    sliding_window=cfg.sliding_window).astype(jnp.float32),
+                lambda: _dot_attention(
+                    q, k, v, causal=causal,
+                    softmax_fp32=cfg.attention_softmax_in_fp32,
+                    scale=scale, q_offset=q_offset,
+                    segment_ids=segment_ids,
+                    sliding_window=cfg.sliding_window,
+                    kv_positions=kv_positions).astype(jnp.float32),
+            ).astype(dtype)
     else:
         rate = 0.0 if deterministic else cfg.attention_dropout
         out = _dot_attention(
@@ -341,7 +407,8 @@ def attention_apply(
             softmax_fp32=cfg.attention_softmax_in_fp32,
             scale=scale, q_offset=q_offset, dropout_rate=rate,
             dropout_rng=dropout_rng, segment_ids=segment_ids,
-            sliding_window=cfg.sliding_window)
+            sliding_window=cfg.sliding_window,
+            kv_positions=kv_positions)
 
     out = out.reshape(b, s, nq * hd)
     out = qdense(out, wcast(params["wo"], dtype), cfg.quantized_gemm)
