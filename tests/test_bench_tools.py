@@ -54,6 +54,16 @@ def test_bench_decode_emits_throughput(monkeypatch, tmp_path):
                 "int8w+kv generate("):
         assert arm in text, f"missing {arm!r}:\n{text}"
     assert "x vs bf16" in text and "param bytes" in text
+
+
+def test_bench_decode_sliding_window_arm(monkeypatch, tmp_path):
+    text = run_tool(
+        monkeypatch, tmp_path, "bench_decode.py",
+        ["--batch", "1", "--prompt", "64", "--new", "16", "--layers", "2",
+         "--hidden", "64", "--heads", "4", "--ffn", "128",
+         "--vocab", "128", "--sliding_window", "32"])
+    assert "sliding_window=32 (rolling cache)" in text
+    assert "new-tok/s" in text
     # no roofline on cpu (no HBM bandwidth entry) — the line must be absent
     # rather than printing a nonsense ratio
     assert "roofline" not in text

@@ -63,6 +63,9 @@ def main(argv=None):
                         "the cache stream, the dominant term at long "
                         "context; with --int8_weights a combined arm "
                         "runs too")
+    p.add_argument("--sliding_window", type=int, default=None,
+                   help="banded attention + ROLLING W-slot cache: decode "
+                        "streams O(W) cache bytes instead of O(context)")
     args = p.parse_args(argv)
 
     import jax
@@ -88,13 +91,16 @@ def main(argv=None):
         num_attention_heads=args.heads, num_kv_heads=args.heads,
         ffn_hidden_size=args.ffn, vocab_size=args.vocab,
         seq_length=args.prompt + args.new, compute_dtype="bfloat16",
-        attention_impl="flash")
+        attention_impl="flash", sliding_window=args.sliding_window)
 
     params = lm.model_init(jax.random.PRNGKey(0), cfg)
     # serving layout: bf16 params (the reference serves fp16 — Float16Module)
     params = jax.tree.map(lambda a: a.astype(jnp.bfloat16), params)
     n_params = sum(p.size for p in jax.tree.leaves(params))
-    emit(f"model: {n_params/1e9:.3f}B params, L={args.layers} h={args.hidden}")
+    sw = (f" sliding_window={args.sliding_window} (rolling cache)"
+          if args.sliding_window is not None else "")
+    emit(f"model: {n_params/1e9:.3f}B params, L={args.layers} "
+         f"h={args.hidden}{sw}")
 
     rng_prompts = np.random.RandomState(0)
     prompts = [list(rng_prompts.randint(0, args.vocab, args.prompt))
@@ -104,9 +110,12 @@ def main(argv=None):
     bw = next((v for k, v in _HBM_BW.items()
                if kind.lower().startswith(k.lower())), None)
     # per-decode-step HBM streams: all params + the cache slice for the
-    # mean context length (+ the int8 cache's fp32 scales, 1/hd of it)
-    bf16_cache = (2 * args.layers * args.batch *
-                  (args.prompt + args.new / 2) * args.heads *
+    # mean context length (+ the int8 cache's fp32 scales, 1/hd of it);
+    # a rolling window caps the streamed context at W slots
+    ctx = args.prompt + args.new / 2
+    if args.sliding_window is not None:
+        ctx = min(ctx, args.sliding_window)
+    bf16_cache = (2 * args.layers * args.batch * ctx * args.heads *
                   (args.hidden // args.heads) * 2)
     int8_cache = bf16_cache / 2 * (1 + 4 / (args.hidden // args.heads))
     bf16_params = n_params * 2
