@@ -54,6 +54,9 @@ def test_bench_decode_emits_throughput(monkeypatch, tmp_path):
                 "int8w+kv generate("):
         assert arm in text, f"missing {arm!r}:\n{text}"
     assert "x vs bf16" in text and "param bytes" in text
+    # no roofline on cpu (no HBM bandwidth entry) — the line must be
+    # absent for EVERY arm rather than printing a nonsense ratio
+    assert "roofline" not in text
 
 
 def test_bench_decode_sliding_window_arm(monkeypatch, tmp_path):

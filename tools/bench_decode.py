@@ -97,8 +97,15 @@ def main(argv=None):
     # serving layout: bf16 params (the reference serves fp16 — Float16Module)
     params = jax.tree.map(lambda a: a.astype(jnp.bfloat16), params)
     n_params = sum(p.size for p in jax.tree.leaves(params))
-    sw = (f" sliding_window={args.sliding_window} (rolling cache)"
-          if args.sliding_window is not None else "")
+    # mirror Generator.generate's 64-bucketing: the cache rolls only when
+    # the window is smaller than the bucketed max_len (init_kv_caches)
+    bucketed = -(-(args.prompt + args.new) // 64) * 64
+    rolls = (args.sliding_window is not None
+             and args.sliding_window < bucketed)
+    sw = ("" if args.sliding_window is None else
+          f" sliding_window={args.sliding_window}"
+          + (" (rolling cache)" if rolls else " (band only: window >= "
+             "context, cache stays full-length)"))
     emit(f"model: {n_params/1e9:.3f}B params, L={args.layers} "
          f"h={args.hidden}{sw}")
 
