@@ -3,3 +3,6 @@ from megatron_tpu.convert.hf import (  # noqa: F401
     params_to_hf_falcon, params_to_hf_llama, params_to_hf_mixtral)
 from megatron_tpu.convert.meta import (  # noqa: F401
     merge_meta_llama, meta_llama_to_params)
+from megatron_tpu.convert.megatron import (  # noqa: F401
+    config_from_megatron_args, load_megatron_checkpoint, megatron_to_params,
+    params_to_megatron, save_megatron_checkpoint)

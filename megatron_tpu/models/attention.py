@@ -380,10 +380,13 @@ def attention_apply(
             # wrong for an offset>0 chunk (the chunk's own writes already
             # evicted history its early queries need), so a multi-token
             # step is defined ONLY at offset 0 — take flash directly on
-            # the raw k/v instead of hiding corruption behind a cond
+            # the raw k/v, and poison the output with NaN for any
+            # offset>0 chunked prefill so a contract violation fails
+            # loudly at the first logit instead of decoding garbage
             out = flash_attention(
                 q, k_raw, v_raw, causal=True, scale=scale,
                 sliding_window=cfg.sliding_window)
+            out = jnp.where(q_offset == 0, out, jnp.nan)
         else:
             # both branches trace (compile-time cost only); runtime
             # executes one, and only offset 0 gets the flash shortcut

@@ -356,6 +356,28 @@ class TestRollingKVCache:
         np.testing.assert_array_equal(np.asarray(toks1)[0, :n],
                                       np.asarray(greedy)[0, :n])
 
+    def test_rolling_flash_prefill_poisons_offset_gt_0(self):
+        """The rolling flash prefill is defined ONLY at offset 0 (a
+        mid-stream multi-token chunk would need history the W-slot
+        buffer already evicted). The guard poisons such a call with NaN
+        so a contract violation fails at the first logit instead of
+        silently decoding garbage — and stays finite at offset 0."""
+        from megatron_tpu.models.attention import (KVCache, attention_apply,
+                                                   attention_init)
+        _, cfg = self._model(32, impl="flash")
+        acfg = cfg
+        p = attention_init(jax.random.PRNGKey(0), acfg)
+        rope = lm.make_rope(acfg)
+        x = jnp.asarray(np.random.RandomState(4).randn(1, 8, 64), jnp.float32)
+        for offset, finite in ((0, True), (16, False)):
+            cache = KVCache(
+                k=jnp.zeros((1, 32, 2, 16), jnp.bfloat16),
+                v=jnp.zeros((1, 32, 2, 16), jnp.bfloat16),
+                offset=jnp.asarray(offset, jnp.int32))
+            y, _ = attention_apply(p, x, acfg, rope_cos=rope.cos,
+                                   rope_sin=rope.sin, kv_cache=cache)
+            assert bool(np.isfinite(np.asarray(y)).all()) is finite, offset
+
     def test_rolling_with_int8_cache(self):
         """Rolling + int8 quantized cache compose: finite outputs and
         window-sized int8 buffers with scales."""

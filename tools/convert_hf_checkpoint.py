@@ -39,13 +39,30 @@ def _model_cfg(family: str, size: str):
 
 def do_import(args):
     import numpy as np
-    import torch
-    from transformers import AutoModelForCausalLM
 
     from megatron_tpu.config import MegatronConfig
     from megatron_tpu.convert import hf_falcon_to_params, hf_llama_to_params
     from megatron_tpu.training.checkpointing import save_checkpoint
     from megatron_tpu.training.train_step import TrainState
+
+    if args.source == "megatron":
+        # reference mp_rank layout (iter_N/mp_rank_XX[_YYY]/
+        # model_optim_rng.pt) — tp/pp/vpp shards merged, arch read from
+        # the embedded args namespace (ref: megatron/checkpointing.py)
+        from megatron_tpu.convert.megatron import (config_from_megatron_args,
+                                                   load_megatron_checkpoint,
+                                                   megatron_to_params)
+        print(f"loading reference-megatron checkpoint from {args.hf_path}")
+        sd, ref_args, meta = load_megatron_checkpoint(args.hf_path)
+        print(f"  iteration={meta['iteration']} version="
+              f"{meta['checkpoint_version']} tp={meta['tp']} pp={meta['pp']}")
+        mcfg = config_from_megatron_args(ref_args)
+        params = megatron_to_params(sd, mcfg, dtype=np.float32)
+        state = TrainState(params=params, opt_state=None, iteration=0)
+        cfg = MegatronConfig(model=mcfg)
+        d = save_checkpoint(args.out, state, cfg, iteration=0, release=True)
+        print(f"wrote release checkpoint {d}")
+        return
 
     mcfg = _model_cfg(args.family, args.size)
     if args.source == "meta":
@@ -58,6 +75,8 @@ def do_import(args):
         sd = merge_meta_llama(args.hf_path)
         params = meta_llama_to_params(sd, mcfg, dtype=np.float32)
     else:
+        import torch
+        from transformers import AutoModelForCausalLM
         print(f"loading HF model from {args.hf_path}")
         model = AutoModelForCausalLM.from_pretrained(
             args.hf_path, torch_dtype=torch.float32)
@@ -159,8 +178,11 @@ def main(argv=None):
     pi.add_argument("--family", default="llama",
                     choices=["llama", "falcon", "mixtral"])
     pi.add_argument("--size", default="7b")
-    pi.add_argument("--source", default="hf", choices=["hf", "meta"],
-                    help="meta = raw Meta-llama consolidated shards")
+    pi.add_argument("--source", default="hf",
+                    choices=["hf", "meta", "megatron"],
+                    help="meta = raw Meta-llama consolidated shards; "
+                         "megatron = reference iter_N/mp_rank_XX layout "
+                         "(tp/pp shards merged, arch from embedded args)")
     pe = sub.add_parser("export")
     pe.add_argument("--load", required=True)
     pe.add_argument("--hf_out", required=True)

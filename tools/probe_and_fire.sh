@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-5 probe-and-fire loop: probe the axon TPU tunnel; the moment a
+# window opens, run bench.py (main record + extras chain = the
+# PERF_NOTES pending queue). Logs to /tmp/onchip_r5/. Detach with:
+#   nohup bash tools/probe_and_fire.sh >/tmp/tpu_probe_loop_r5.log 2>&1 &
+# Exits after a successful fire (re-arm manually for a second window).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p /tmp/onchip_r5
+N=0
+while true; do
+  N=$((N+1))
+  T=$(date -u +%H:%M:%S)
+  if timeout 90 python -c "import jax; assert jax.devices()" 2>/dev/null; then
+    echo "[$T] probe $N: TUNNEL UP — firing bench suite"
+    BENCH_PROBE_BUDGET_S=60 BENCH_EXTRAS_TIMEOUT_S=900 \
+      timeout 7200 python bench.py \
+      > /tmp/onchip_r5/bench_stdout.$N.json 2> /tmp/onchip_r5/bench_stderr.$N.log
+    rc=$?
+    echo "[$(date -u +%H:%M:%S)] bench rc=$rc — record:"
+    cat /tmp/onchip_r5/bench_stdout.$N.json
+    # only a REAL on-chip record ends the hunt; a crash or a CPU-fallback
+    # record (tunnel wedged mid-run) re-arms the loop for the next window
+    if [ $rc -eq 0 ] && ! grep -q cpu_fallback /tmp/onchip_r5/bench_stdout.$N.json; then
+      cp /tmp/onchip_r5/bench_stdout.$N.json /tmp/onchip_r5/bench_stdout.json
+      exit 0
+    fi
+    echo "re-arming (rc=$rc or cpu_fallback)"
+  else
+    echo "[$T] probe $N: down"
+  fi
+  sleep 300
+done

@@ -39,7 +39,11 @@ def main(argv=None):
     p.add_argument("--int8_weights", action="store_true",
                    help="serve with int8-resident transformer weights "
                         "(ops/quantized.quantize_weights): halves the "
-                        "decode weight stream at ~0.5%% logit error")
+                        "decode weight stream at ~0.5%% logit error. "
+                        "MoE expert banks are NOT quantized (the router "
+                        "dict is skipped), so for Mixtral-class models "
+                        "(~95%% of params in experts) the reduction is "
+                        "small — use --int8_kv there instead")
     p.add_argument("--int8_kv", action="store_true",
                    help="serve with an int8 KV cache: halves the cache "
                         "stream and residency — at 7B/32k the bf16 "

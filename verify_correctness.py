@@ -142,7 +142,9 @@ def seed_hf_llama_numpy(model, seed=0):
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--hf_path", type=str, default=None)
-    p.add_argument("--model_size", type=str, default="7b")
+    p.add_argument("--model_size", type=str, default=None,
+                   help="preset name; defaults to '7b' (llama) or "
+                        "'8x7b' (mixtral) per --family")
     p.add_argument("--family", type=str, default="llama",
                    choices=["llama", "mixtral"])
     p.add_argument("--synthetic", action="store_true")
@@ -159,6 +161,8 @@ def main(argv=None):
     p.add_argument("--save_golden", type=str, default=None)
     p.add_argument("--golden", type=str, default=None)
     args = p.parse_args(argv)
+    if args.model_size is None:
+        args.model_size = "8x7b" if args.family == "mixtral" else "7b"
 
     if args.save_golden or args.golden:
         return golden_mode(args)
