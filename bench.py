@@ -332,6 +332,9 @@ def _run_extras():
         ("bench_decode.py", ["--int8_weights", "--int8_kv"],
          "/tmp/bench_extras_decode.log"),
         ("bench_32k.py", [], "/tmp/bench_extras_32k.log"),
+        # 1F1B bubble curve vs n_micro (VERDICT r4 #7): tick-count
+        # analysis on one chip, full fit on a multi-device mesh
+        ("bench_bubble.py", [], "/tmp/bench_extras_bubble.log"),
     ]
     for tool, extra_args, out in suites:
         cmd = [sys.executable, os.path.join(here, "tools", tool),

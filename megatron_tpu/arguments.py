@@ -86,6 +86,8 @@ def build_parser(extra_args_provider: Optional[Callable] = None
     g.add_argument("--moe_top_k", type=int, default=2)
     g.add_argument("--moe_capacity_factor", type=float, default=1.25)
     g.add_argument("--moe_aux_loss_coeff", type=float, default=1e-2)
+    g.add_argument("--moe_dispatch", type=str, default="sort",
+                   choices=["sort", "dense"])
     g.add_argument("--model", type=str, default=None,
                    help="preset name (llama2-7b, falcon-40b, gpt2, ...)")
 
@@ -109,6 +111,11 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                         "(the reference's no-recompute default; ~1/3 less "
                         "pipeline compute, more memory)")
     g.add_argument("--sequence_parallel", action="store_true")
+    g.add_argument("--expert_axis", type=str, default="tp",
+                   choices=["tp", "dp"],
+                   help="mesh axis the MoE expert bank shards over: tp "
+                        "(default) or dp (GShard-style expert "
+                        "parallelism over the data axis)")
     g.add_argument("--use_distributed_optimizer", action="store_true")
     g.add_argument("--context_parallel_algo", type=str, default="ring",
                    choices=["ring", "ulysses"],
@@ -452,6 +459,7 @@ def config_from_args(args: argparse.Namespace,
             pipeline_parallel=args.pipeline_parallel,
             context_parallel=args.context_parallel,
             sequence_parallel=args.sequence_parallel,
+            expert_axis=args.expert_axis,
             virtual_pipeline_chunks=vpp,
             pipeline_schedule=args.pipeline_schedule,
             pipeline_store_activations=args.pipeline_store_activations,

@@ -117,13 +117,18 @@ class ModelConfig:
     quantized_gemm: str = "none"
 
     # Mixture-of-Experts (ABSENT in the reference — SURVEY.md §2.8; the
-    # TPU formulation is an 'experts'-sharded weight bank + GShard dense
+    # TPU formulation is an 'experts'-sharded weight bank + sort-based
     # dispatch, models/moe.py). num_experts > 1 replaces every MLP with a
-    # top-k-routed expert bank; requires pipeline_parallel == 1.
+    # top-k-routed expert bank; composes with dp/tp/sp/pp (router aux
+    # threads through every pipeline schedule).
     num_experts: int = 1
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coeff: float = 1e-2
+    # dispatch implementation: "sort" (stable-sort routing, one
+    # scatter/gather — O(s) memory, the long-context-safe default) |
+    # "dense" (GShard [b,s,E,C] one-hot einsums — the semantic oracle)
+    moe_dispatch: str = "sort"
 
     # glu activations double the first MLP projection
     @property
@@ -187,7 +192,14 @@ class ParallelConfig:
     pipeline_parallel: int = 1
     data_parallel: Optional[int] = None  # derived from world size
     context_parallel: int = 1
-    expert_parallel: int = 1
+    expert_parallel: int = 1  # unused; kept for config compatibility
+    # which mesh axis the MoE expert bank's 'experts' dim shards over:
+    # "tp" (default — each tp rank holds E/tp whole experts, router
+    # all-to-alls ride the tp ICI) or "dp" (GShard-style expert
+    # parallelism over the data axis — the classic layout when E is
+    # large and tp is small; moments/grads stay aligned since the bank
+    # is dp-sharded end-to-end)
+    expert_axis: str = "tp"
     sequence_parallel: bool = False
     # virtual pipeline (interleaved 1F1B) chunks per stage (ref: arguments.py:117-128)
     virtual_pipeline_chunks: int = 1
@@ -353,18 +365,52 @@ class MegatronConfig:
             assert model.seq_length % max(par.tensor_parallel, 1) == 0, (
                 "sequence parallel requires seq_length divisible by tp")
         if model.num_experts > 1:
-            assert par.pipeline_parallel == 1, (
-                "MoE (num_experts > 1) is not yet wired through the "
-                "pipeline schedules' aux-loss accumulation — use "
-                "pipeline_parallel=1 (dp/tp/sp compose freely)")
             assert 1 <= model.moe_top_k <= model.num_experts, (
                 f"moe_top_k={model.moe_top_k} must be in "
                 f"[1, num_experts={model.num_experts}]")
-            assert model.num_experts % max(par.tensor_parallel, 1) == 0, (
-                f"num_experts={model.num_experts} must shard evenly over "
-                f"tensor_parallel={par.tensor_parallel} (the expert bank's "
-                "leading axis is tp-sharded — parallel/sharding.py "
-                "'experts' rule)")
+            assert model.moe_dispatch in ("sort", "dense"), (
+                f"moe_dispatch={model.moe_dispatch!r} "
+                "(expected 'sort' or 'dense')")
+            assert par.expert_axis in ("tp", "dp"), par.expert_axis
+            if par.expert_axis == "tp":
+                ep_size = max(par.tensor_parallel, 1)
+            else:
+                ep_size = (par.data_parallel
+                           or (par.derive_dp(n_devices)
+                               if n_devices else None))
+                # an unknown dp cannot be assumed 1: the pp>1 guard
+                # below would pass vacuously and the run would die in
+                # the partitioner SIGABRT instead of here
+                assert ep_size is not None or par.pipeline_parallel == 1, (
+                    "expert_axis='dp' with pipeline_parallel>1 needs dp "
+                    "known at validate time — pass n_devices to "
+                    "validate() or set ParallelConfig.data_parallel")
+            if ep_size is not None:
+                assert model.num_experts % max(ep_size, 1) == 0, (
+                    f"num_experts={model.num_experts} must shard evenly "
+                    f"over the '{par.expert_axis}' mesh axis "
+                    f"(size {ep_size}) — parallel/sharding.py "
+                    "'experts' rule")
+            # XLA's SPMD partitioner CHECK-fails (spmd_partitioner_util.
+            # cc:495 — a hard SIGABRT, not a python error) when the
+            # expert bank's sharded 'experts' dim meets the pipeline's
+            # partial-manual shard_map region; verified on current jax
+            # for BOTH expert_axis choices and both dispatch impls
+            # (PERF_NOTES "MoE under pp"). Same CHECK family as the
+            # ZeRO-1 pp exclusion. MoE+pp therefore requires the expert
+            # axis be UNSPLIT (size-1); expert sharding composes freely
+            # at pp=1, and pp MoE composes with dp/sp.
+            # (ep_size is None only when pipeline_parallel == 1 — the
+            # unknown-dp case was rejected above — so the short-circuit
+            # below never compares against None)
+            assert par.pipeline_parallel == 1 or ep_size == 1, (
+                f"MoE with pipeline_parallel={par.pipeline_parallel} "
+                f"requires the expert mesh axis be unsplit (got "
+                f"'{par.expert_axis}' size {ep_size}): sharded experts "
+                "inside the pp shard_map trip an XLA partitioner CHECK "
+                "(hard abort; see PERF_NOTES 'MoE under pp'). Use "
+                "pp=1 for expert parallelism, or pp>1 with "
+                "tensor_parallel=1 / expert_axis='tp'-on-tp1")
         if model.sliding_window is not None:
             assert model.sliding_window >= 1, (
                 f"sliding_window={model.sliding_window} must be >= 1 "

@@ -1,0 +1,134 @@
+"""Bucketed sequence-length batching for variable-length finetuning.
+
+The reference supports variable sequence lengths across microbatches by
+shape-handshaking every pipeline p2p transfer
+(ref: megatron/p2p_communication.py:134-146; the `variable_seq_lengths`
+switch is set by dataloaders that produce them, arguments.py:171-178).
+Under XLA every distinct shape is a fresh compilation, so the TPU-native
+formulation is BUCKETING: pad each batch to the smallest member of a
+fixed bucket ladder. Compilation count is bounded by the ladder length
+(each bucket's program — including the full pp/tp/dp-sharded train step —
+compiles once and is cached), padding waste is bounded by the ladder's
+spacing, and the loss mask keeps padded positions out of the objective,
+so a bucketed run optimizes the identical objective as a ragged one.
+
+Usage (finetune-style):
+
+    buckets = make_buckets(cfg.model.seq_length)       # e.g. 256..4096
+    batch = collate_bucketed(samples, micro_bs, n_micro, buckets, pad_id)
+    # -> {"tokens": [n_micro, b, B+1], "loss_mask": [n_micro, b, B]}
+
+The train step reads shapes from the batch, so feeding different buckets
+through ONE jitted step just populates its compile cache — see
+tests/test_buckets.py for the cache-bound and loss-equality gates.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def make_buckets(max_seq: int, min_seq: int = 256,
+                 multiple: int = 64) -> list[int]:
+    """Power-of-two ladder [min_seq, ..., max_seq], max always included.
+
+    `multiple` guards TPU-friendliness: every bucket stays a multiple of
+    the MXU/lane tiling (and of tp*cp sharding factors in practice)."""
+    assert max_seq % multiple == 0, (
+        f"max_seq {max_seq} not a multiple of {multiple}")
+    out = []
+    b = min_seq
+    while b < max_seq:
+        if b % multiple == 0:
+            out.append(b)
+        b *= 2
+    out.append(max_seq)
+    return out
+
+
+def bucket_for(length: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= length; raises if none fits (caller truncates
+    or filters overlong samples explicitly — silent truncation here
+    would corrupt labels)."""
+    for b in sorted(buckets):
+        if length <= b:
+            return b
+    raise ValueError(f"sequence length {length} exceeds the largest "
+                     f"bucket {max(buckets)}")
+
+
+def collate_bucketed(samples: Sequence[np.ndarray], micro_bs: int,
+                     n_micro: int, buckets: Sequence[int], pad_id: int,
+                     loss_masks: Optional[Sequence[np.ndarray]] = None
+                     ) -> dict:
+    """Pack `n_micro * micro_bs` variable-length token sequences into one
+    global batch padded to the bucket of the LONGEST sample.
+
+    One bucket per global batch (not per microbatch): all microbatches of
+    a step must share a shape — under pp they interleave through the same
+    ring buffers (the reference pays a handshake per transfer instead).
+    Each sample is `tokens` of length L_i >= 2 (input+shifted-label form:
+    the model consumes [:, :-1] and predicts [:, 1:]); optional
+    `loss_masks[i]` of length L_i - 1 (defaults to all-ones). Padded
+    positions get pad_id and mask 0, so the masked-mean loss equals the
+    unpadded computation exactly."""
+    n = micro_bs * n_micro
+    assert len(samples) == n, f"need {n} samples, got {len(samples)}"
+    if loss_masks is not None:
+        assert len(loss_masks) == n
+    longest = max(len(s) for s in samples)
+    B = bucket_for(longest - 1, buckets)  # model seq dim is L-1
+    tokens = np.full((n_micro, micro_bs, B + 1), pad_id, dtype=np.int32)
+    mask = np.zeros((n_micro, micro_bs, B), dtype=np.float32)
+    for i, s in enumerate(samples):
+        m, b = divmod(i, micro_bs)
+        ln = len(s)
+        tokens[m, b, :ln] = np.asarray(s, dtype=np.int32)
+        if loss_masks is not None:
+            mask[m, b, :ln - 1] = np.asarray(loss_masks[i],
+                                             dtype=np.float32)
+        else:
+            mask[m, b, :ln - 1] = 1.0
+    return {"tokens": tokens, "loss_mask": mask}
+
+
+def bucket_batches(dataset, micro_bs: int, n_micro: int,
+                   buckets: Sequence[int], pad_id: int,
+                   drop_last: bool = False):
+    """Generator: length-sort-free streaming collation — consume the
+    dataset in order, emit one bucketed global batch per n_micro*micro_bs
+    samples. (Length-grouped sampling reduces padding further; that is a
+    sampler concern — this keeps consumption order == sampler order so
+    consumed-samples checkpoint resume stays exact.)
+
+    A trailing partial group is padded to a full batch with dummy
+    fully-masked rows (zero loss weight — the objective is untouched and
+    every real sample trains), so sample accounting stays exact for
+    small finetuning sets. `drop_last=True` discards it instead (the
+    fixed-shape pretraining convention)."""
+    group, masks = [], []
+
+    def flush():
+        lm = None if all(m is None for m in masks) else [
+            m if m is not None else np.ones(len(t) - 1, np.float32)
+            for m, t in zip(masks, group)]
+        return collate_bucketed(group, micro_bs, n_micro, buckets,
+                                pad_id, loss_masks=lm)
+
+    for item in dataset:
+        if isinstance(item, dict):
+            group.append(item["tokens"])
+            masks.append(item.get("loss_mask"))
+        else:
+            group.append(item)
+            masks.append(None)
+        if len(group) == micro_bs * n_micro:
+            yield flush()
+            group, masks = [], []
+    if group and not drop_last:
+        n_fill = micro_bs * n_micro - len(group)
+        filler = np.full(2, pad_id, dtype=np.int32)
+        group.extend([filler] * n_fill)
+        masks.extend([np.zeros(1, np.float32)] * n_fill)
+        yield flush()

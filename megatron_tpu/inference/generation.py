@@ -155,7 +155,7 @@ class Generator:
 
     def __init__(self, params, cfg: ModelConfig, eos_id: int,
                  pad_id: Optional[int] = None, mesh=None,
-                 kv_cache_dtype=jnp.bfloat16):
+                 kv_cache_dtype=jnp.bfloat16, expert_axis: str = "tp"):
         self.params = params
         self.cfg = cfg
         self.eos_id = eos_id
@@ -171,7 +171,11 @@ class Generator:
         if mesh is not None:
             from megatron_tpu.ops.quantized import quantize_axes
             from megatron_tpu.parallel import sharding as shd
-            self._rules = shd.make_logical_rules(False)
+            # expert_axis mirrors ParallelConfig.expert_axis: a model
+            # trained with dp-sharded expert banks must serve with the
+            # same 'experts' mapping or the bank gets resharded
+            self._rules = shd.make_logical_rules(False,
+                                                 expert_axis=expert_axis)
             # int8-quantized weights (ops/quantized.quantize_weights)
             # restructure the params tree — align the axes tree with it
             # so in_shardings still match leaf-for-leaf

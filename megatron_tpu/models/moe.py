@@ -18,9 +18,26 @@ every shape static for XLA:
   is the top-1 dispatch fraction and P_e the mean router probability.
   loss_fn adds cfg.moe_aux_loss_coeff * aux.
 
-Note the dispatch tensor is O(s^2 * top_k * capacity_factor) elements —
-fine at pretraining seq (2-4k); pair long-context (32k) with moderate
-capacity or dot-dispatch improvements before using MoE there.
+Two dispatch implementations share the same routing semantics (capacity
+fills k=0 choices first, then k=1, ...; within a round, earlier sequence
+positions win; overflow drops):
+
+- "sort" (default): the (token, k) choices are sorted by expert id
+  (stable sort keeps the priority order), the slot index inside each
+  expert is rank-minus-segment-start, and tokens move through ONE
+  scatter-add into the [E, C, h] expert blocks and one gather back.
+  Memory is O(s * top_k * h) — linear in sequence length — so MoE
+  composes with long context. The sort itself is O(sK log sK) int32 work
+  per layer, noise beside the expert GEMMs.
+- "dense": the original GShard einsum against a [b, s, E, C] one-hot
+  dispatch tensor — O(s^2 * top_k * capacity_factor) elements. Kept as
+  the semantic oracle (sort-vs-dense equality is tested) and for
+  explicit A/B on chip.
+
+Expert parallelism is the 'experts'-axis sharding on the weight bank and
+the [b, E, C, h] blocks in both paths; GSPMD partitions the dense
+einsums directly and the sort path's scatter/gather by resharding the
+(small, [b, sK]) index vectors.
 """
 from __future__ import annotations
 
@@ -104,6 +121,31 @@ def moe_dispatch(idx, gates, E: int, C: int):
     return dispatch, combine
 
 
+def _sort_route(idx, gates, E: int, C: int):
+    """Per-batch-row routing by stable sort (vmapped over b).
+
+    idx/gates: [s, K] -> entry arrays [K*s] in k-major order (all k=0
+    choices first — the Switch priority; within a k, sequence order):
+    (expert, token, gate, slot, keep). Slot = the entry's rank among
+    same-expert entries; computed as sorted-rank minus the expert's
+    segment start, then scattered back to entry order. Exactly the
+    bookkeeping moe_dispatch materializes as [s, E, C] one-hots, in
+    O(sK) memory."""
+    s, K = idx.shape
+    e = idx.T.reshape(-1)                        # [K*s], k-major
+    g = gates.T.reshape(-1)
+    tok = jnp.tile(jnp.arange(s), K)
+    order = jnp.argsort(e)                       # stable in jax
+    e_sorted = e[order]
+    counts = jnp.zeros((E,), jnp.int32).at[e_sorted].add(1)
+    seg_start = jnp.cumsum(counts) - counts      # exclusive cumsum [E]
+    pos_sorted = jnp.arange(K * s) - seg_start[e_sorted]
+    n = K * s
+    pos = jnp.zeros((n,), jnp.int32).at[order].set(pos_sorted)
+    keep = pos < C
+    return e, tok, g, pos, keep
+
+
 def moe_apply(params, x, cfg: ModelConfig):
     """x: [b, s, h] -> (y [b, s, h], aux_loss scalar f32)."""
     b, s, h = x.shape
@@ -124,10 +166,17 @@ def moe_apply(params, x, cfg: ModelConfig):
     p_e = jnp.mean(probs, axis=(0, 1))
     aux = E * jnp.sum(f_e * p_e)
 
-    dispatch, combine = moe_dispatch(idx, gates, E, C)
-
-    # dispatch -> per-expert token blocks [b, E, C, h]
-    xin = jnp.einsum("bsec,bsh->bech", dispatch.astype(dtype), x)
+    if cfg.moe_dispatch == "dense":
+        dispatch, combine = moe_dispatch(idx, gates, E, C)
+        # dispatch -> per-expert token blocks [b, E, C, h]
+        xin = jnp.einsum("bsec,bsh->bech", dispatch.astype(dtype), x)
+    else:
+        e, tok, g, pos, keep = jax.vmap(
+            lambda i, ga: _sort_route(i, ga, E, C))(idx, gates)
+        pos_c = jnp.minimum(pos, C - 1)      # dropped entries write 0s
+        brow = jnp.arange(b)[:, None]
+        contrib = x[brow, tok] * keep[..., None].astype(dtype)  # [b,KS,h]
+        xin = jnp.zeros((b, E, C, h), dtype).at[brow, e, pos_c].add(contrib)
     w1 = params["w1"].astype(dtype)
     w2 = params["w2"].astype(dtype)
     E_, h_ = w1.shape[0], w1.shape[1]
@@ -141,9 +190,18 @@ def moe_apply(params, x, cfg: ModelConfig):
             return int8_expert_matmul(xb, wb)
         return jnp.einsum("beck,ekn->becn", xb, wb)
 
+    # the float path einsums the weight banks UNRESHAPED: under the 1F1B
+    # store-activations stash, reshaped banks would stop being identity-
+    # passthrough vjp leaves and a full bank copy would ride every stash
+    # slot (the _assert_dedup_passthrough guard fires). The int8 path
+    # reshapes (its quantization re-materializes weights anyway) — pair
+    # it with the recompute stash mode.
     if cfg.is_glu:
-        y1 = bank_gemm(xin, w1.reshape(E_, h_, -1))
-        y1 = y1.reshape(*y1.shape[:-1], 2, cfg.ffn_hidden_size)
+        if cfg.quantized_gemm == "int8":
+            y1 = bank_gemm(xin, w1.reshape(E_, h_, -1))
+            y1 = y1.reshape(*y1.shape[:-1], 2, cfg.ffn_hidden_size)
+        else:
+            y1 = jnp.einsum("bech,ehgf->becgf", xin, w1)
         if cfg.use_bias:
             y1 = y1 + params["b1"].astype(dtype)[None, :, None]
         act = activation_fn(cfg.activation, y1[..., 0, :], y1[..., 1, :])
@@ -157,5 +215,10 @@ def moe_apply(params, x, cfg: ModelConfig):
         # per-expert output bias; dropped (not duplicated) tokens simply
         # never see it, matching the dispatch semantics
         y2 = y2 + params["b2"].astype(dtype)[None, :, None]
-    y = jnp.einsum("bech,bsec->bsh", y2, combine.astype(dtype))
+    if cfg.moe_dispatch == "dense":
+        y = jnp.einsum("bech,bsec->bsh", y2, combine.astype(dtype))
+    else:
+        out = y2[brow, e, pos_c]                         # [b, KS, h]
+        w = (g * keep).astype(dtype)
+        y = (out * w[..., None]).reshape(b, K, s, h).sum(axis=1)
     return y, aux

@@ -170,7 +170,7 @@ def t5_pipeline_loss_fn(params, batch, cfg: ModelConfig, mesh, *,
         from megatron_tpu.models.bert import bert_pad_segments
         enc_streams["seg"] = bert_pad_segments(batch["enc_mask"])
 
-    enc = pipeline_apply(
+    enc, _ = pipeline_apply(
         params["encoder"], params["embedding"], enc_streams, cfg, mesh,
         intake_fn=embed_intake, chunk_fn=enc_chunk,
         batch_shape=(n_b, s_enc), vpp=vpp, rng=rng)
@@ -196,7 +196,7 @@ def t5_pipeline_loss_fn(params, batch, cfg: ModelConfig, mesh, *,
     boundary_dtype = (jnp.float32 if jax.default_backend() == "cpu"
                       else enc.dtype)
     dec_streams = {"tokens": dec_tokens, "enc": enc.astype(boundary_dtype)}
-    dec = pipeline_apply(
+    dec, _ = pipeline_apply(
         params["decoder"], params["embedding"], dec_streams, cfg, mesh,
         intake_fn=embed_intake, chunk_fn=dec_chunk,
         batch_shape=(n_b, s_dec), vpp=vpp, rng=rng)

@@ -138,7 +138,8 @@ def pipeline_apply(
     mesh,
     *,
     intake_fn,        # (shared, mb_slice, mb_rng) -> [b, s, h]
-    chunk_fn,         # (chunk_params, h, mb_slice, layer_offset, rng) -> h
+    chunk_fn,         # (chunk_params, h, mb_slice, layer_offset, rng)
+                      #   -> h or (h, moe_aux)
     batch_shape,      # (b, s) of one microbatch's activations
     vpp: int = 1,
     rng=None,
@@ -147,8 +148,10 @@ def pipeline_apply(
     autodiff-derived backward.
 
     Runs `intake_fn` inside stage 0's tick and `chunk_fn` on each stage's
-    vpp interleaved layer chunks; returns the last stage's outputs
-    [n_micro, b, s, h] (final norm / head / loss are the caller's job).
+    vpp interleaved layer chunks; returns (outputs [n_micro, b, s, h],
+    moe_aux) — outputs are the last stage's, aux sums every stage's
+    router load-balancing losses over all real microbatches (0.0 for
+    dense chunk fns; final norm / head / loss are the caller's job).
     Equivalent of the forward half of the reference's pipelined schedules
     (ref: schedules.py:253-502,606-722); the backward half is jax.grad of
     this. The GPT wrapper is `pipeline_transformer`; encoder-decoder models
@@ -187,7 +190,8 @@ def pipeline_apply(
             return jax.random.fold_in(rng, i) if rng is not None else None
 
         def tick(carry, t):
-            bufs, outputs = carry  # bufs [vpp, b, s, h]; outputs [n, b,s,h]
+            # bufs [vpp, b, s, h]; outputs [n, b,s,h]; aux_sum scalar f32
+            bufs, outputs, aux_sum = carry
             # stage-0 chunk-0 intake for microbatch t (clamped; garbage
             # ticks are masked at collect)
             mb_in = jnp.clip(t, 0, n_micro - 1)
@@ -195,18 +199,23 @@ def pipeline_apply(
             ins = bufs.at[0].set(
                 jnp.where(is_first, x0.astype(boundary_dtype), bufs[0]))
 
-            def chunk_body(_, xs):
+            def chunk_body(acc, xs):
                 cp, h_in, c = xs
                 # chunk c of stage s processes microbatch t - s - c*pp
-                my_mb = jnp.clip(t - stage - c * pp, 0, n_micro - 1)
+                raw_mb = t - stage - c * pp
+                my_mb = jnp.clip(raw_mb, 0, n_micro - 1)
                 offset = (c * pp + stage) * Lc
-                out = chunk_fn(cp, h_in.astype(compute_dtype),
-                               _dyn(streams_all, my_mb), offset,
-                               mb_rng(my_mb))
-                return None, out.astype(boundary_dtype)
+                out, aux = _chunk_ret(chunk_fn(
+                    cp, h_in.astype(compute_dtype),
+                    _dyn(streams_all, my_mb), offset, mb_rng(my_mb)))
+                # fill/drain ticks run chunks on clamped garbage
+                # microbatches — their router aux must not count
+                mb_valid = (raw_mb >= 0) & (raw_mb < n_micro)
+                acc = acc + jnp.where(mb_valid, aux, 0.0)
+                return acc, out.astype(boundary_dtype)
 
-            _, outs = jax.lax.scan(chunk_body, None,
-                                   (chunks, ins, jnp.arange(vpp)))
+            aux_sum, outs = jax.lax.scan(chunk_body, aux_sum,
+                                         (chunks, ins, jnp.arange(vpp)))
 
             # collect the microbatch finishing its last hop (stage pp-1,
             # chunk vpp-1) at this tick
@@ -224,16 +233,18 @@ def pipeline_apply(
             rotated = jax.lax.ppermute(outs, "pp", ring) if pp > 1 else outs
             shifted = jnp.where(is_first, jnp.roll(rotated, 1, axis=0),
                                 rotated) if vpp > 1 else rotated
-            return (shifted, outputs), None
+            return (shifted, outputs, aux_sum), None
 
         bufs0 = jnp.zeros((vpp, n_b, n_s, cfg.hidden_size), boundary_dtype)
         outputs0 = jnp.zeros((n_micro, n_b, n_s, cfg.hidden_size),
                              boundary_dtype)
-        (_, outputs), _ = jax.lax.scan(tick, (bufs0, outputs0),
-                                       jnp.arange(T))
+        (_, outputs, aux_sum), _ = jax.lax.scan(
+            tick, (bufs0, outputs0, jnp.zeros((), jnp.float32)),
+            jnp.arange(T))
         # leave via concatenation over 'pp' (NOT a psum of activations):
-        # the caller slices out the last stage's block
-        return outputs[None]
+        # the caller slices out the last stage's block; aux sums across
+        # stages (each stage owns its own layers' routers)
+        return outputs[None], jax.lax.psum(aux_sum, "pp")
 
     # Partial-manual shard_map: manual over 'pp' only; dp/cp/tp stay
     # automatic (GSPMD). Constraints of this mode (jax 0.9): must run under
@@ -242,13 +253,13 @@ def pipeline_apply(
     shmap = jax.shard_map(
         per_stage,
         in_specs=(P(), P("pp"), P()),
-        out_specs=P("pp"),
+        out_specs=(P("pp"), P()),
         check_vma=False,
         axis_names={"pp"},
     )
-    stacked_out = shmap(shared_params, chunked,
-                        streams)  # [pp, n_micro, b, s, h]
-    return stacked_out[-1].astype(compute_dtype)
+    stacked_out, aux = shmap(shared_params, chunked,
+                             streams)  # [pp, n_micro, b, s, h], scalar
+    return stacked_out[-1].astype(compute_dtype), aux
 
 
 def pipeline_transformer(
@@ -296,11 +307,12 @@ def pipeline_transformer(
     def chunk(cp, h, sl, offset, rng_mb):
         layer_rng = (jax.random.fold_in(rng_mb, 1)
                      if rng_mb is not None and not deterministic else None)
-        return tfm.stack_apply(
+        x, _, aux = tfm.stack_apply(
             cp, h, cfg, rope_cos=rope_cos, rope_sin=rope_sin,
             position_ids=sl["position_ids"], segment_ids=sl["segment_ids"],
             rng=layer_rng, deterministic=deterministic,
-            layer_offset=offset, cp_pre_zigzag=cp_pre_zigzag)[0]
+            layer_offset=offset, cp_pre_zigzag=cp_pre_zigzag)
+        return x, aux
 
     return pipeline_apply(
         params["transformer"], params["embedding"], streams, cfg, mesh,
@@ -316,6 +328,18 @@ def _dyn(tree, i):
     """Index every [n_micro, ...] stream leaf at microbatch i (traced)."""
     return jax.tree.map(
         lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, False), tree)
+
+
+def _chunk_ret(ret):
+    """Normalize a chunk_fn return: `h` or `(h, aux)` -> (h, aux).
+
+    `aux` is the chunk's MoE router load-balancing loss (scalar f32);
+    dense chunk fns (BERT/T5 specs, pre-MoE callers) keep returning the
+    bare hidden state and read as aux == 0."""
+    if isinstance(ret, tuple):
+        h, aux = ret
+        return h, aux.astype(jnp.float32)
+    return ret, jnp.zeros((), jnp.float32)
 
 
 def _assert_dedup_passthrough(closure_leaves, chunk_params_v, label=""):
@@ -351,7 +375,8 @@ def pipeline_train_1f1b(
     mesh,
     *,
     intake_fn,         # (shared, mb_slice, rng_mb) -> [b, s, h]
-    chunk_fn,          # (chunk_params, h, mb_slice, layer_offset, rng_mb) -> h
+    chunk_fn,          # (chunk_params, h, mb_slice, layer_offset, rng_mb)
+                       #   -> h or (h, moe_aux)
     head_loss_fn,      # (shared, h, mb_slice, rng_mb) -> scalar per-mb loss
     batch_shape,       # (b, s) of one microbatch's activations
     rng=None,
@@ -455,14 +480,17 @@ def pipeline_train_1f1b(
 
         def combined_f(sl, rng_m):
             """(chunk -> checkpointed head) as one vjp target returning
-            (boundary h_out, per-mb loss)."""
+            (boundary h_out, per-mb loss, chunk moe aux). Seeding aux's
+            cotangent on EVERY stage (unlike the last-stage-only loss
+            seed) is what lets each stage's router aux reach its own
+            params AND send d(aux)/d(h_in) up the reverse ring."""
             def f(cp, sp, h):
-                h_out = chunk_fn(cp, h.astype(compute_dtype), sl,
-                                 offset, rng_m)
+                h_out, aux = _chunk_ret(chunk_fn(
+                    cp, h.astype(compute_dtype), sl, offset, rng_m))
                 loss = jax.checkpoint(
                     lambda sp_, ho: head_loss_fn(sp_, ho, sl, rng_m),
                     prevent_cse=False)(sp, h_out)
-                return h_out.astype(boundary_dtype), loss
+                return h_out.astype(boundary_dtype), loss, aux
             return f
 
         param_like = [chunk_p, shared_p]  # +chunk_p_v in store mode below
@@ -509,7 +537,8 @@ def pipeline_train_1f1b(
             _assert_dedup_passthrough(proto_leaves, chunk_p_v)
 
         def tick(carry, t):
-            fwd_msg, bwd_msg, stash, g_chunk, g_shared, loss_acc = carry
+            (fwd_msg, bwd_msg, stash, g_chunk, g_shared, loss_acc,
+             aux_acc) = carry
             fwd_mb = t - stage
             bwd_mb = t - 2 * (pp - 1) + stage
             fwd_valid = (fwd_mb >= 0) & (fwd_mb < n_micro)
@@ -525,6 +554,9 @@ def pipeline_train_1f1b(
             slot_f = jnp.mod(fmb, D)
             slot_b = jnp.mod(bmb, D)
             ct_l_seed = jnp.asarray(cotangent_seed / n_micro, jnp.float32)
+            # every stage's chunk aux contributes to the loss with the
+            # same 1/n_micro weight (see combined_f)
+            ct_aux = ct_l_seed * cfg.moe_aux_loss_coeff
 
             # Both modes keep every stage on the IDENTICAL op sequence —
             # branch-free because GSPMD inserts tp/sp collectives inside
@@ -543,7 +575,7 @@ def pipeline_train_1f1b(
                 # ONE fwd (this tick's microbatch) whose vjp residuals ride
                 # the stash; the bwd slot rebuilds the closure — no
                 # recompute anywhere outside the checkpointed head.
-                (h_pair, loss_f), vjp_f = jax.vjp(
+                (h_pair, loss_f, aux_f), vjp_f = jax.vjp(
                     combined_f(fsl, mb_rng(fmb)), chunk_p_v, shared_p,
                     h_in)
                 leaves, treedef, is_param, resid = split_vjp_leaves(vjp_f)
@@ -562,11 +594,12 @@ def pipeline_train_1f1b(
                 ct_h = jnp.where(is_last, jnp.zeros_like(bwd_msg), bwd_msg)
                 ct_l = jnp.where(is_last, ct_l_seed,
                                  jnp.zeros((), jnp.float32))
-                dcp, dsp, dh = vjp_b((ct_h, ct_l))
+                dcp, dsp, dh = vjp_b((ct_h, ct_l, ct_aux))
                 h_out = jnp.where(is_last, jnp.zeros_like(h_pair), h_pair)
-                # loss is known at the FWD slot in this mode
+                # loss/aux are known at the FWD slot in this mode
                 loss_contrib = jnp.where(
                     fwd_valid & is_last, loss_f, 0.0)
+                aux_contrib = jnp.where(fwd_valid, aux_f, 0.0)
             else:
                 # recompute mode: stash chunk INPUTS; the bwd slot reruns
                 # the chunk forward inside a same-tick vjp
@@ -574,25 +607,29 @@ def pipeline_train_1f1b(
                     jnp.where(fwd_valid, h_in, stash[slot_f]))
                 h_saved = jax.lax.dynamic_index_in_dim(stash, slot_b, 0,
                                                        False)
-                h_out_f = chunk_fn(chunk_p, h_in.astype(compute_dtype),
-                                   fsl, offset,
-                                   mb_rng(fmb)).astype(boundary_dtype)
+                h_out_f, _ = _chunk_ret(chunk_fn(
+                    chunk_p, h_in.astype(compute_dtype), fsl, offset,
+                    mb_rng(fmb)))
+                h_out_f = h_out_f.astype(boundary_dtype)
 
                 def f(cp, sp, h):
-                    h_out = chunk_fn(cp, h.astype(compute_dtype), bsl,
-                                     offset, mb_rng(bmb))
+                    h_out, aux = _chunk_ret(chunk_fn(
+                        cp, h.astype(compute_dtype), bsl, offset,
+                        mb_rng(bmb)))
                     loss = head_loss_fn(sp, h_out, bsl, mb_rng(bmb))
-                    return h_out.astype(boundary_dtype), loss
+                    return h_out.astype(boundary_dtype), loss, aux
 
-                (_, loss_mb), vjp = jax.vjp(f, chunk_p, shared_p, h_saved)
+                ((_, loss_mb, aux_mb), vjp) = jax.vjp(f, chunk_p, shared_p,
+                                                      h_saved)
                 ct_h = jnp.where(is_last, jnp.zeros_like(bwd_msg), bwd_msg)
                 ct_l = jnp.where(is_last, ct_l_seed,
                                  jnp.zeros((), jnp.float32))
-                dcp, dsp, dh = vjp((ct_h, ct_l))
+                dcp, dsp, dh = vjp((ct_h, ct_l, ct_aux))
                 h_out = jnp.where(is_last, jnp.zeros_like(h_out_f),
                                   h_out_f)
                 loss_contrib = jnp.where(
                     bwd_valid & is_last, loss_mb, 0.0)
+                aux_contrib = jnp.where(bwd_valid, aux_mb, 0.0)
 
             # --- embedding intake backward (uniform; only stage 0's
             # cotangent is nonzero, so other stages accumulate zeros)
@@ -610,6 +647,7 @@ def pipeline_train_1f1b(
             g_chunk = jax.tree.map(acc, g_chunk, dcp)
             g_shared = jax.tree.map(acc, g_shared, dsp, d_intake)
             loss_acc = loss_acc + loss_contrib
+            aux_acc = aux_acc + aux_contrib
 
             # --- ring rotation: activations down, cotangents up
             if pp > 1:
@@ -618,7 +656,7 @@ def pipeline_train_1f1b(
             else:
                 fwd_nxt, bwd_nxt = h_out, dh
             return (fwd_nxt, bwd_nxt, stash, g_chunk, g_shared,
-                    loss_acc), None
+                    loss_acc, aux_acc), None
 
         msg0 = jnp.zeros((n_b, n_s, cfg.hidden_size), boundary_dtype)
         if store_activations:
@@ -630,15 +668,19 @@ def pipeline_train_1f1b(
         gc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), chunk_p)
         gs0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
                            shared_p)
-        (_, _, _, g_chunk, g_shared, loss_acc), _ = jax.lax.scan(
-            tick, (msg0, msg0, stash0, gc0, gs0, jnp.zeros((), jnp.float32)),
+        (_, _, _, g_chunk, g_shared, loss_acc, aux_acc), _ = jax.lax.scan(
+            tick, (msg0, msg0, stash0, gc0, gs0,
+                   jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
             jnp.arange(T))
 
         # shared-param grads meet across stages (tied embedding: intake on
         # stage 0 + head on the last stage — ref: optimizer.py:203-229
-        # embedding-group all-reduce); loss lives on the last stage only
+        # embedding-group all-reduce); loss lives on the last stage only,
+        # router aux on every stage (each owns its own layers' routers)
         g_shared = jax.lax.psum(g_shared, "pp")
-        loss = jax.lax.psum(loss_acc, "pp") / n_micro
+        loss = (jax.lax.psum(loss_acc, "pp")
+                + cfg.moe_aux_loss_coeff * jax.lax.psum(aux_acc, "pp")
+                ) / n_micro
         return loss, jax.tree.map(lambda g: g[None], g_chunk), g_shared
 
     shmap = jax.shard_map(
@@ -738,12 +780,16 @@ def _pipeline_train_1f1b_interleaved(
             return jax.random.fold_in(rng, i) if rng is not None else None
 
         def chunk_f(c, sl, rng_m):
-            """Chunk c's forward (no head) as a vjp target."""
+            """Chunk c's forward (no head) as a vjp target returning
+            (h, moe_aux) — aux's cotangent is seeded on every stage/chunk
+            (each owns its own routers; d(aux)/d(h_in) rides the reverse
+            ring like any other cotangent)."""
             offset = (c * pp + stage) * Lc
 
             def f(cp, h):
-                return chunk_fn(cp, h.astype(compute_dtype), sl, offset,
-                                rng_m).astype(boundary_dtype)
+                h_out, aux = _chunk_ret(chunk_fn(
+                    cp, h.astype(compute_dtype), sl, offset, rng_m))
+                return h_out.astype(boundary_dtype), aux
             return f
 
         if store_activations:
@@ -781,8 +827,10 @@ def _pipeline_train_1f1b_interleaved(
                     resid_shapes, "residual structure differs across chunks"
 
         def tick(carry, t):
-            fwd_msgs, bwd_msgs, stash, g_chunks, g_shared, loss_acc = carry
+            (fwd_msgs, bwd_msgs, stash, g_chunks, g_shared, loss_acc,
+             aux_acc) = carry
             ct_l_seed = jnp.asarray(cotangent_seed / n_micro, jnp.float32)
+            ct_aux = ct_l_seed * cfg.moe_aux_loss_coeff
 
             # ---- forward slots: all vpp chunks, one hop each
             h_outs, fwd_closures = [], []
@@ -798,8 +846,8 @@ def _pipeline_train_1f1b_interleaved(
                     h_in = jnp.where(is_first, x0, h_in)
                 slot_f = jnp.mod(fmb, D)
                 if store_activations:
-                    h_out, vjp_f = jax.vjp(chunk_f(c, fsl, mb_rng(fmb)),
-                                           chunk_ps_v[c], h_in)
+                    (h_out, aux_f), vjp_f = jax.vjp(
+                        chunk_f(c, fsl, mb_rng(fmb)), chunk_ps_v[c], h_in)
                     leaves, treedef, is_param, resid = \
                         split_leaves(vjp_f, c)
                     assert is_param == protos[c][2], "vjp structure drifted"
@@ -812,7 +860,11 @@ def _pipeline_train_1f1b_interleaved(
                 else:
                     stash = stash.at[c, slot_f].set(
                         jnp.where(fwd_valid, h_in, stash[c, slot_f]))
-                    h_out = chunk_f(c, fsl, mb_rng(fmb))(chunk_ps[c], h_in)
+                    h_out, aux_f = chunk_f(c, fsl, mb_rng(fmb))(
+                        chunk_ps[c], h_in)
+                # aux VALUE from the fwd slot (each real microbatch passes
+                # each chunk's fwd slot exactly once)
+                aux_acc = aux_acc + jnp.where(fwd_valid, aux_f, 0.0)
                 h_outs.append(h_out)
 
             # ---- head: once per tick, on chunk vpp-1's fresh output (its
@@ -854,13 +906,13 @@ def _pipeline_train_1f1b_interleaved(
                     rebuilt = [l if p else next(rb)
                                for l, p in zip(leaves, is_param)]
                     vjp_b = jax.tree.unflatten(treedef, rebuilt)
-                    dcp, dh = vjp_b(ct_in)
+                    dcp, dh = vjp_b((ct_in, ct_aux))
                 else:
                     h_saved = jax.lax.dynamic_index_in_dim(
                         stash[c], slot_b, 0, False)
                     _, vjp_b = jax.vjp(chunk_f(c, bsl, mb_rng(bmb)),
                                        chunk_ps[c], h_saved)
-                    dcp, dh = vjp_b(ct_in)
+                    dcp, dh = vjp_b((ct_in, ct_aux))
                 g_chunks[c] = jax.tree.map(
                     lambda g, d: g + jnp.where(bwd_valid,
                                                d.astype(jnp.float32), 0.0),
@@ -894,7 +946,7 @@ def _pipeline_train_1f1b_interleaved(
             fwd_nxt = jnp.where(is_first, jnp.roll(rot_f, 1, axis=0), rot_f)
             bwd_nxt = jnp.where(is_last, jnp.roll(rot_b, -1, axis=0), rot_b)
             return (fwd_nxt, bwd_nxt, stash, g_chunks, g_shared,
-                    loss_acc), None
+                    loss_acc, aux_acc), None
 
         msg0 = jnp.zeros((vpp, n_b, n_s, cfg.hidden_size), boundary_dtype)
         if store_activations:
@@ -907,12 +959,15 @@ def _pipeline_train_1f1b_interleaved(
                for cp in chunk_ps]
         gs0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
                            shared_p)
-        (_, _, _, g_chunks, g_shared, loss_acc), _ = jax.lax.scan(
-            tick, (msg0, msg0, stash0, gc0, gs0, jnp.zeros((), jnp.float32)),
+        (_, _, _, g_chunks, g_shared, loss_acc, aux_acc), _ = jax.lax.scan(
+            tick, (msg0, msg0, stash0, gc0, gs0,
+                   jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
             jnp.arange(T))
 
         g_shared = jax.lax.psum(g_shared, "pp")
-        loss = jax.lax.psum(loss_acc, "pp") / n_micro
+        loss = (jax.lax.psum(loss_acc, "pp")
+                + cfg.moe_aux_loss_coeff * jax.lax.psum(aux_acc, "pp")
+                ) / n_micro
         g_stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *g_chunks)
         return loss, jax.tree.map(lambda g: g[None], g_stacked), g_shared
 
@@ -962,13 +1017,14 @@ def gpt_1f1b_fns(cfg: ModelConfig, rope=None, deterministic: bool = True,
     def chunk(cp, h, sl, offset, rng_mb):
         layer_rng = (jax.random.fold_in(rng_mb, 1)
                      if rng_mb is not None and not deterministic else None)
-        return tfm.stack_apply(
+        x, _, aux = tfm.stack_apply(
             cp, h, cfg,
             rope_cos=rope.cos if rope else None,
             rope_sin=rope.sin if rope else None,
             position_ids=sl["position_ids"], segment_ids=sl["segment_ids"],
             rng=layer_rng, deterministic=deterministic,
-            layer_offset=offset, cp_pre_zigzag=cp_pre_zigzag)[0]
+            layer_offset=offset, cp_pre_zigzag=cp_pre_zigzag)
+        return x, aux
 
     def head_loss(shared_p, h, sl, rng_mb):
         logits = lm.head_logits(shared_p, h, cfg)
@@ -1070,7 +1126,7 @@ def pipeline_loss_fn(
         loss_mask = loss_mask[..., perm]
         position_ids = position_ids[..., perm]
 
-    x = pipeline_transformer(
+    x, moe_aux = pipeline_transformer(
         params, inputs, cfg, mesh, vpp=vpp,
         rope_cos=rope.cos if rope else None,
         rope_sin=rope.sin if rope else None,
@@ -1087,4 +1143,8 @@ def pipeline_loss_fn(
     # per-microbatch masked mean, then mean over microbatches (== train_step)
     per_mb = (jnp.sum(losses * loss_mask, axis=(1, 2))
               / jnp.maximum(jnp.sum(loss_mask, axis=(1, 2)), 1.0))
-    return jnp.mean(per_mb)
+    n_micro = inputs.shape[0]
+    # aux matches lm.loss_fn's mean-over-microbatches normalization
+    aux_term = (cfg.moe_aux_loss_coeff * moe_aux / n_micro
+                if cfg.num_experts > 1 else 0.0)
+    return jnp.mean(per_mb) + aux_term

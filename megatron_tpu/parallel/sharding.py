@@ -49,7 +49,9 @@ from megatron_tpu.parallel.mesh import (
 # ---------------------------------------------------------------------------
 
 # rules as (logical_name, mesh_axis-or-None) pairs; first match wins.
-def make_logical_rules(sequence_parallel: bool = False):
+def make_logical_rules(sequence_parallel: bool = False,
+                       expert_axis: str = "tp"):
+    assert expert_axis in ("tp", "dp"), expert_axis
     return (
         ("batch", DATA_AXIS),
         ("layers", PIPELINE_AXIS),
@@ -60,9 +62,10 @@ def make_logical_rules(sequence_parallel: bool = False):
         ("heads", TENSOR_AXIS),
         ("kv_heads", TENSOR_AXIS),
         ("mlp", TENSOR_AXIS),
-        # MoE expert bank: experts shard over 'tp' (expert parallelism —
-        # each device holds E/tp whole experts; models/moe.py)
-        ("experts", TENSOR_AXIS),
+        # MoE expert bank: each device holds whole experts; the mesh axis
+        # is selectable (ParallelConfig.expert_axis) — 'tp' (default) or
+        # 'dp' (GShard-style EP over the data axis; models/moe.py)
+        ("experts", DATA_AXIS if expert_axis == "dp" else TENSOR_AXIS),
         ("vocab", TENSOR_AXIS),
         ("seq", CONTEXT_AXIS),
         # Megatron-SP: the residual-stream sequence dim is sharded over 'tp'

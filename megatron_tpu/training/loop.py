@@ -344,7 +344,8 @@ def _make_eval_step(cfg: MegatronConfig, mesh=None, loss_fn=None,
     from megatron_tpu.parallel import sharding as shd
     from megatron_tpu.training.train_step import (_MeshContextStep,
                                                   param_shardings)
-    rules = shd.make_logical_rules(cfg.parallel.sequence_parallel)
+    rules = shd.make_logical_rules(cfg.parallel.sequence_parallel,
+                                      expert_axis=cfg.parallel.expert_axis)
 
     def eval_with_ctx(params, batch):
         with shd.activation_shardings(mesh, rules):

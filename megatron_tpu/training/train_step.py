@@ -273,7 +273,8 @@ def param_shardings(cfg: MegatronConfig, mesh, rules=None, axes_fn=None):
     make_train_step uses (shared by the eval step and inference)."""
     from megatron_tpu.parallel import sharding as shd
     if rules is None:
-        rules = shd.make_logical_rules(cfg.parallel.sequence_parallel)
+        rules = shd.make_logical_rules(cfg.parallel.sequence_parallel,
+                                      expert_axis=cfg.parallel.expert_axis)
     axes = axes_fn(cfg.model) if axes_fn else lm.model_axes(cfg.model)
     return shd.tree_logical_to_sharding(mesh, axes, rules)
 
@@ -291,7 +292,8 @@ def state_shardings(cfg: MegatronConfig, mesh, param_shapes, rules=None,
 
     from megatron_tpu.parallel import sharding as shd
     if rules is None:
-        rules = shd.make_logical_rules(cfg.parallel.sequence_parallel)
+        rules = shd.make_logical_rules(cfg.parallel.sequence_parallel,
+                                      expert_axis=cfg.parallel.expert_axis)
     axes = axes_fn(cfg.model) if axes_fn else lm.model_axes(cfg.model)
     param_sh = shd.tree_logical_to_sharding(mesh, axes, rules)
     scalar_sh = NamedSharding(mesh, P())
@@ -397,7 +399,8 @@ def make_train_step(cfg: MegatronConfig, mesh=None, rules=None, donate=True,
     from megatron_tpu.parallel import sharding as shd
 
     if rules is None:
-        rules = shd.make_logical_rules(cfg.parallel.sequence_parallel)
+        rules = shd.make_logical_rules(cfg.parallel.sequence_parallel,
+                                      expert_axis=cfg.parallel.expert_axis)
 
     # run tracing under the activation-sharding context so model-level
     # `constrain` calls (sequence parallelism, logits vocab sharding) become

@@ -53,7 +53,8 @@ def _make_forward(cfg: MegatronConfig, mesh=None):
     from jax.sharding import NamedSharding, PartitionSpec as P
     from megatron_tpu.parallel import sharding as shd
     from megatron_tpu.training.train_step import param_shardings
-    rules = shd.make_logical_rules(cfg.parallel.sequence_parallel)
+    rules = shd.make_logical_rules(cfg.parallel.sequence_parallel,
+                                   expert_axis=cfg.parallel.expert_axis)
 
     def fwd_ctx(params, text, pad_mask, valid):
         with shd.activation_shardings(mesh, rules):

@@ -42,6 +42,24 @@ def test_bench_head_emits_overhead_table(monkeypatch, tmp_path):
         assert 0.0 <= pct < 100.0
 
 
+@pytest.mark.slow
+def test_bench_bubble_fit_and_fractions(monkeypatch, tmp_path):
+    """The bubble tool must time the real 1F1B program on the virtual
+    pp2 mesh, fit a linear tick model, and report measured-vs-predicted
+    bubble fractions for each n_micro and vpp arm."""
+    text = run_tool(
+        monkeypatch, tmp_path, "bench_bubble.py",
+        ["--pp", "2", "--vpp", "1", "2", "--n_micro", "2", "4", "8",
+         "--iters", "1", "--hidden", "64", "--seq", "32",
+         "--layers_per_pos", "1"])
+    assert "fit: t_tick=" in text
+    frac_lines = [l for l in text.splitlines() if "measured_bubble=" in l]
+    assert len(frac_lines) == 6  # 3 n_micro x 2 vpp
+    for l in frac_lines:
+        pred = float(l.rsplit("predicted", 1)[1])
+        assert 0.0 <= pred < 1.0
+
+
 def test_bench_decode_emits_throughput(monkeypatch, tmp_path):
     text = run_tool(
         monkeypatch, tmp_path, "bench_decode.py",

@@ -1,0 +1,161 @@
+"""Variable-seq-length bucketing (data/buckets.py) — the TPU formulation
+of the reference's --variable_seq_lengths pipeline shape handshakes
+(ref: megatron/p2p_communication.py:134-146): compile-per-bucket instead
+of handshake-per-transfer.
+
+Gates: ladder construction; loss equality padded-vs-exact (the masked
+mean must not see pad positions); the jit compile-cache bound (two
+buckets -> exactly two traces of ONE train step); and the pp2 pipelined
+step accepting two bucket shapes through one step function.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.data.buckets import (bucket_batches, bucket_for,
+                                       collate_bucketed, make_buckets)
+
+
+def test_make_buckets_ladder():
+    assert make_buckets(4096) == [256, 512, 1024, 2048, 4096]
+    assert make_buckets(512, min_seq=128) == [128, 256, 512]
+    assert make_buckets(192, min_seq=64) == [64, 128, 192]  # max included
+    with pytest.raises(AssertionError):
+        make_buckets(1000)  # not a multiple of 64
+
+
+def test_bucket_for_picks_smallest_and_rejects_overlong():
+    bks = [128, 256, 512]
+    assert bucket_for(1, bks) == 128
+    assert bucket_for(128, bks) == 128
+    assert bucket_for(129, bks) == 256
+    with pytest.raises(ValueError, match="exceeds"):
+        bucket_for(513, bks)
+
+
+def _cfg():
+    return ModelConfig(num_layers=2, hidden_size=64,
+                       num_attention_heads=4, vocab_size=128,
+                       seq_length=128, make_vocab_size_divisible_by=128,
+                       compute_dtype="float32").derived()
+
+
+def test_collate_pads_to_longest_sample_bucket():
+    rng = np.random.RandomState(0)
+    samples = [rng.randint(1, 100, ln) for ln in (9, 33, 17, 65)]
+    batch = collate_bucketed(samples, micro_bs=2, n_micro=2,
+                             buckets=[32, 64, 128], pad_id=0)
+    assert batch["tokens"].shape == (2, 2, 65)  # bucket 64 (+1)
+    assert batch["loss_mask"].shape == (2, 2, 64)
+    # sample 0 (len 9): 8 loss positions live, rest masked+padded
+    assert batch["loss_mask"][0, 0].sum() == 8
+    assert (batch["tokens"][0, 0, 9:] == 0).all()
+    # the longest sample fills its row exactly
+    assert batch["loss_mask"][1, 1].sum() == 64
+
+
+def test_padded_loss_equals_exact():
+    """Masked-mean CE on a bucket-padded batch == the unpadded loss."""
+    from megatron_tpu.models.language_model import loss_fn, model_init
+    cfg = _cfg()
+    params = model_init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(1)
+    seq = rng.randint(1, 100, 33).astype(np.int32)  # 32 model positions
+    exact = float(loss_fn(params, jnp.asarray(seq[None]), cfg))
+    batch = collate_bucketed([seq], 1, 1, [64, 128], pad_id=0)
+    padded = float(loss_fn(
+        params, jnp.asarray(batch["tokens"][0]), cfg,
+        loss_mask=jnp.asarray(batch["loss_mask"][0])))
+    np.testing.assert_allclose(padded, exact, rtol=1e-5)
+
+
+def test_one_step_two_buckets_bounded_compiles():
+    """Feeding two bucket shapes through ONE jitted step retraces once
+    per bucket and never again — the compile-count bound that replaces
+    the reference's per-transfer handshake."""
+    from megatron_tpu.models.language_model import loss_fn, model_init
+    cfg = _cfg()
+    params = model_init(jax.random.PRNGKey(0), cfg)
+    traces = []
+
+    @jax.jit
+    def step(p, tokens, mask):
+        traces.append(tokens.shape)
+        return loss_fn(p, tokens, cfg, loss_mask=mask)
+
+    rng = np.random.RandomState(2)
+    buckets = [32, 64, 128]
+    for ln in (20, 50, 21, 51, 19):  # alternating buckets 32 / 64
+        b = collate_bucketed([rng.randint(1, 100, ln)], 1, 1, buckets, 0)
+        step(params, jnp.asarray(b["tokens"][0]),
+             jnp.asarray(b["loss_mask"][0]))
+    assert len(traces) == 2, traces  # one trace per bucket, cached after
+
+
+def test_bucket_batches_stream_and_order():
+    rng = np.random.RandomState(3)
+    data = [rng.randint(1, 100, rng.randint(5, 60)) for _ in range(8)]
+    out = list(bucket_batches(iter(data), micro_bs=2, n_micro=2,
+                              buckets=[64, 128], pad_id=0))
+    assert len(out) == 2
+    # consumption order preserved (checkpoint-resume exactness)
+    np.testing.assert_array_equal(
+        out[0]["tokens"][0, 0, :len(data[0])], data[0])
+    np.testing.assert_array_equal(
+        out[1]["tokens"][0, 0, :len(data[4])], data[4])
+
+
+def test_bucket_batches_trailing_partial_group():
+    """A trailing partial group is padded with fully-masked dummy rows
+    (every real sample still trains, objective untouched); drop_last
+    discards it instead."""
+    rng = np.random.RandomState(5)
+    data = [rng.randint(1, 100, 20) for _ in range(5)]  # 5 % 4 = 1 left
+    out = list(bucket_batches(iter(data), micro_bs=2, n_micro=2,
+                              buckets=[32], pad_id=0))
+    assert len(out) == 2
+    tail = out[1]
+    np.testing.assert_array_equal(tail["tokens"][0, 0, :20], data[4])
+    assert tail["loss_mask"][0, 0].sum() == 19      # real sample live
+    assert tail["loss_mask"][0, 1].sum() == 0       # filler fully masked
+    assert tail["loss_mask"][1].sum() == 0
+    dropped = list(bucket_batches(iter(data), micro_bs=2, n_micro=2,
+                                  buckets=[32], pad_id=0,
+                                  drop_last=True))
+    assert len(dropped) == 1
+
+
+@pytest.mark.slow
+def test_pp2_step_accepts_two_buckets(devices):
+    """The pipelined (pp2, 1F1B) train step runs two bucket shapes
+    through one make_train_step function — per-bucket compile replaces
+    the reference's variable-seq p2p handshakes."""
+    from conftest import make_test_mesh
+    from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
+                                     ParallelConfig, TrainingConfig)
+    from megatron_tpu.training import init_train_state, make_train_step
+
+    cfg = MegatronConfig(
+        model=_cfg(),
+        parallel=ParallelConfig(pipeline_parallel=2),
+        optimizer=OptimizerConfig(lr=1e-3, clip_grad=1.0),
+        training=TrainingConfig(micro_batch_size=2, global_batch_size=4,
+                                train_iters=4),
+    ).validate(n_devices=2)
+    mesh = make_test_mesh(devices, pp=2)
+    state = init_train_state(jax.random.PRNGKey(0), cfg)
+    step = make_train_step(cfg, mesh=mesh, donate=False)
+    rng = np.random.RandomState(4)
+    losses = []
+    for ln in (30, 60):  # buckets 32 and 64
+        samples = [rng.randint(1, 100, ln) for _ in range(4)]
+        b = collate_bucketed(samples, 2, 2, [32, 64, 128], pad_id=0)
+        state, m = step(state, {"tokens": jnp.asarray(b["tokens"]),
+                                "loss_mask": jnp.asarray(b["loss_mask"])},
+                        jax.random.PRNGKey(1))
+        losses.append(float(m["lm_loss"]))
+    assert all(np.isfinite(losses)), losses

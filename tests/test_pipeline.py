@@ -485,7 +485,7 @@ def test_1f1b_dropout_grads_match_simulation(devices):
             h = intake(shared, sl, mb_rng)
             for s in range(pp):
                 cp_s = jax.tree.map(lambda x: x[s], staged)
-                h = chunk(cp_s, h, sl, s * Lc, mb_rng)
+                h, _ = chunk(cp_s, h, sl, s * Lc, mb_rng)
             total = total + head(shared, h, sl, mb_rng)
         return total / 2
 
@@ -615,7 +615,7 @@ def test_1f1b_store_activations_dropout(devices):
             h = intake(shared, sl, mb_rng)
             for s in range(pp):
                 cp_s = jax.tree.map(lambda x: x[s], staged)
-                h = chunk(cp_s, h, sl, s * Lc, mb_rng)
+                h, _ = chunk(cp_s, h, sl, s * Lc, mb_rng)
             total = total + head(shared, h, sl, mb_rng)
         return total / 2
 
@@ -736,7 +736,7 @@ def test_1f1b_interleaved_dropout_grads_match_simulation(devices):
             for c in range(vpp):
                 for s in range(pp):
                     cp_sc = jax.tree.map(lambda x: x[s, c], chunked)
-                    h = chunk(cp_sc, h, sl, (c * pp + s) * Lc, mb_rng)
+                    h, _ = chunk(cp_sc, h, sl, (c * pp + s) * Lc, mb_rng)
             total = total + head(shared, h, sl, mb_rng)
         return total / 2
 

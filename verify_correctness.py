@@ -160,7 +160,20 @@ def main(argv=None):
     # the reference CI uses on real weights.
     p.add_argument("--save_golden", type=str, default=None)
     p.add_argument("--golden", type=str, default=None)
+    # Loss-trajectory fixture mode (VERDICT r4 next #3): pins an N-step
+    # training trajectory — losses, lr schedule, grad norms, and the
+    # fp16 scaler's exact scale/skip sequence — on the numpy-seeded
+    # synthetic model, turning optimizer/scheduler/scaler semantics into
+    # a hermetic regression gate (the strongest loss-curve-match posture
+    # available without egress; ref: megatron/optimizer/optimizer.py:
+    # 407-466 step semantics, megatron/training.py:452-626 train loop).
+    p.add_argument("--save_loss_trajectory", type=str, default=None)
+    p.add_argument("--loss_trajectory", type=str, default=None)
+    p.add_argument("--trajectory_steps", type=int, default=100)
     args = p.parse_args(argv)
+
+    if args.save_loss_trajectory or args.loss_trajectory:
+        return trajectory_mode(args)
     if args.model_size is None:
         args.model_size = "8x7b" if args.family == "mixtral" else "7b"
 
@@ -230,6 +243,136 @@ def golden_mode(args) -> int:
     print(f"avg max-abs vs golden: {avg_max_abs:.2e} "
           f"({'PASS' if ok else 'FAIL'}, tolerance {args.tolerance:.0e})")
     return 0 if ok else 1
+
+
+def run_loss_trajectory(steps: int = 100, mode: str = "fp32") -> dict:
+    """Run `steps` full train steps (adam + clip + warmup-cosine lr + wd
+    + dynamic fp16 scaler) on the numpy-seeded synthetic Llama.
+
+    mode "fp32": float32 compute — pins optimizer/scheduler math tightly.
+    mode "fp16": float16 compute with a deliberately-overflowing initial
+    loss scale — the first steps MUST overflow and back off (hysteresis
+    then halving), later windows MUST grow the scale back; the exact
+    scale/skip sequence is the pinned artifact (discrete powers of two —
+    immune to float jitter). Ref: megatron/optimizer/grad_scaler.py:
+    75-120, optimizer.py:407-466.
+
+    Returns {losses, lr, grad_norm, loss_scale, found_inf} as np arrays
+    of length `steps`. CPU-only for hermeticity (the fixture is created
+    and checked on the same backend the test tier runs on)."""
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
+                                     ParallelConfig, TrainingConfig)
+    from megatron_tpu.convert import hf_llama_to_params
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.training import make_train_step
+    from megatron_tpu.training.train_step import state_from_params
+
+    assert jax.default_backend() == "cpu", (
+        "loss-trajectory fixtures are CPU-pinned; run under "
+        "JAX_PLATFORMS=cpu (jax.config.update('jax_platforms','cpu') "
+        "before any device touch)")
+    model, mcfg = make_synthetic_hf_llama(seq=64)
+    seed_hf_llama_numpy(model, seed=0)
+    mcfg = dataclasses.replace(
+        mcfg, compute_dtype="float32" if mode == "fp32" else "float16")
+    cfg = MegatronConfig(
+        model=mcfg,
+        parallel=ParallelConfig(),
+        optimizer=OptimizerConfig(
+            lr=3e-3, min_lr=3e-4, lr_decay_style="cosine",
+            lr_decay_iters=steps, lr_warmup_iters=10,
+            weight_decay=0.1, clip_grad=1.0,
+            # fp16: start ABOVE the fp16 max so the automaton must
+            # back off (hysteresis first), then re-grow within the run
+            initial_loss_scale=2.0 ** 24, loss_scale_window=25,
+            hysteresis=2),
+        training=TrainingConfig(micro_batch_size=2, global_batch_size=2,
+                                train_iters=steps),
+    ).validate(n_devices=1)
+    sd = {k: v.detach().cpu().numpy()
+          for k, v in model.state_dict().items()}
+    params = hf_llama_to_params(sd, cfg.model)
+    params = jax.tree.map(jnp.asarray, params)
+    state = state_from_params(params, cfg)
+    mesh = build_mesh(cfg.parallel, devices=jax.devices()[:1])
+    step = make_train_step(cfg, mesh=mesh, donate=False)
+
+    # a fixed 4-batch cycle: unlearnable fresh-random tokens would keep
+    # the loss pinned at ln(V) and the trajectory would gate nothing —
+    # cycling lets adam genuinely descend (memorization), so optimizer
+    # regressions show up as a DIFFERENT curve, not a flat one
+    data_rng = np.random.default_rng(1)
+    cycle = [data_rng.integers(0, cfg.model.vocab_size,
+                               (1, 2, 65)).astype(np.int32)
+             for _ in range(4)]
+    out = {k: [] for k in ("losses", "lr", "grad_norm", "loss_scale",
+                           "found_inf")}
+    for i in range(steps):
+        batch = {"tokens": jnp.asarray(cycle[i % 4]),
+                 "loss_mask": jnp.ones((1, 2, 64), jnp.float32)}
+        state, m = step(state, batch, jax.random.PRNGKey(i))
+        out["losses"].append(float(m["lm_loss"]))
+        out["lr"].append(float(m["lr"]))
+        out["grad_norm"].append(float(m["grad_norm"]))
+        out["loss_scale"].append(float(m["loss_scale"]))
+        out["found_inf"].append(float(m["found_inf"]))
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def trajectory_mode(args) -> int:
+    """Create or check the pinned N-step loss-trajectory fixture."""
+    steps = args.trajectory_steps
+    got = {mode: run_loss_trajectory(steps, mode)
+           for mode in ("fp32", "fp16")}
+    if args.save_loss_trajectory:
+        flat = {f"{mode}_{k}": v for mode, d in got.items()
+                for k, v in d.items()}
+        np.savez_compressed(args.save_loss_trajectory, steps=steps, **flat)
+        print(f"trajectory fixture written: {args.save_loss_trajectory} "
+              f"({steps} steps x {len(flat)} series)")
+        fp16 = got["fp16"]
+        print(f"  fp32 loss {got['fp32']['losses'][0]:.4f} -> "
+              f"{got['fp32']['losses'][-1]:.4f}; fp16 skips="
+              f"{int(fp16['found_inf'].sum())} final scale="
+              f"{fp16['loss_scale'][-1]:.0f}")
+        return 0
+    pinned = np.load(args.loss_trajectory)
+    assert int(pinned["steps"]) == steps, (
+        f"fixture has {int(pinned['steps'])} steps, ran {steps}")
+    failures = []
+
+    def check(name, a, b, rtol, atol=0.0, exact=False):
+        ok = (np.array_equal(a, b) if exact
+              else np.allclose(a, b, rtol=rtol, atol=atol))
+        worst = float(np.max(np.abs(a - b))) if len(a) else 0.0
+        print(f"  {name:<18} {'PASS' if ok else 'FAIL'} "
+              f"(max abs dev {worst:.3e}{', exact' if exact else ''})")
+        if not ok:
+            failures.append(name)
+
+    print("fp32 trajectory (optimizer/scheduler math):")
+    f32 = got["fp32"]
+    check("losses", f32["losses"], pinned["fp32_losses"], rtol=2e-4,
+          atol=1e-5)
+    check("lr", f32["lr"], pinned["fp32_lr"], rtol=1e-6)
+    check("grad_norm", f32["grad_norm"], pinned["fp32_grad_norm"],
+          rtol=1e-3, atol=1e-5)
+    print("fp16 trajectory (scaler automaton):")
+    f16 = got["fp16"]
+    check("loss_scale", f16["loss_scale"], pinned["fp16_loss_scale"],
+          rtol=0, exact=True)
+    check("found_inf", f16["found_inf"], pinned["fp16_found_inf"],
+          rtol=0, exact=True)
+    # fp16 losses jitter more; gate finiteness + coarse agreement on the
+    # applied (non-skipped) steps
+    applied = pinned["fp16_found_inf"] == 0
+    check("losses(applied)", f16["losses"][applied],
+          pinned["fp16_losses"][applied], rtol=1e-2, atol=1e-3)
+    print("PASS" if not failures else f"FAIL: {failures}")
+    return 0 if not failures else 1
 
 
 if __name__ == "__main__":
