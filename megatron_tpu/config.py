@@ -422,18 +422,20 @@ class MegatronConfig:
                     "has no sliding-window plumbing — attention falls "
                     "back to the unfused dot path (O(s^2) scores); use "
                     "attention_impl=flash for banded attention")
-        if model.attention_impl in ("flash", "ring", "ulysses") and \
+        if model.attention_impl in ("ring", "ulysses") and \
                 model.attention_dropout > 0.0:
-            # the fused/cp paths have no dropout plumbing; training traces
+            # the cp ring paths have no dropout plumbing; training traces
             # with active attention dropout route to the unfused dot path
             # (models/attention.py dropout_active) — correct, but the user
-            # should know the fused impl they asked for will not run
+            # should know the cp impl they asked for will not run. flash
+            # carries dropout natively (blockwise per-block masks).
             from megatron_tpu.utils.logging import print_rank_0
             print_rank_0(
                 f"warning: attention_impl={model.attention_impl!r} with "
                 f"attention_dropout={model.attention_dropout} falls back "
-                "to the unfused dot path during training (dropout is only "
-                "implemented there); eval keeps the fused path")
+                "to the unfused dot path during training (the cp rings "
+                "have no dropout plumbing); eval keeps the fused path, "
+                "and attention_impl=flash carries dropout natively")
         if model.attention_impl == "ulysses" and par.context_parallel > 1:
             # fail at config time, not first jit trace
             nkv = model.num_kv_heads or model.num_attention_heads

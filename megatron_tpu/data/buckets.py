@@ -37,11 +37,13 @@ def make_buckets(max_seq: int, min_seq: int = 256,
     the MXU/lane tiling (and of tp*cp sharding factors in practice)."""
     assert max_seq % multiple == 0, (
         f"max_seq {max_seq} not a multiple of {multiple}")
+    assert min_seq % multiple == 0, (
+        f"min_seq {min_seq} not a multiple of {multiple} — every rung "
+        "would be silently skipped, degenerating to one max-size bucket")
     out = []
     b = min_seq
     while b < max_seq:
-        if b % multiple == 0:
-            out.append(b)
+        out.append(b)
         b *= 2
     out.append(max_seq)
     return out

@@ -212,9 +212,9 @@ def attention_apply(
         q = apply_rotary(q, rope_cos, rope_sin, position_ids)
         k = apply_rotary(k, rope_cos, rope_sin, position_ids)
 
-    # Active attention dropout is only implemented on the dot path — see
-    # the fuller comment at the dispatch below; every fused gate
-    # (including the prefill one here) must include this term.
+    # Active attention dropout runs on the dot path AND the flash
+    # blockwise path (per-block inverted-dropout masks); the cp rings
+    # and the cached prefill exclude it (see the dispatch below).
     # sliding_window refines the CAUSAL mask; a bidirectional caller
     # (BERT/T5-encoder, cross-attention) setting it would be silently
     # ignored by every implementation — fail at trace time instead
@@ -319,12 +319,11 @@ def attention_apply(
     # (attention_softmax_in_fp32), so the trick is unnecessary and the flag
     # intentionally has no numerical effect.
 
-    # dropout_active (defined above, with the prefill gate): attention
-    # dropout is only implemented on the dot path (the flash kernel and
-    # the cp rings have no dropout plumbing); a training trace with
-    # attention_dropout > 0 must take it, or the configured
-    # regularization would be silently dropped. Eval traces
-    # (deterministic=True) keep the fused paths.
+    # dropout_active (defined above, with the prefill gate): the cp
+    # rings have no dropout plumbing, so a training trace with
+    # attention_dropout > 0 routes them to the dot path (validate warns);
+    # the flash branch below carries dropout natively. Eval traces
+    # (deterministic=True) keep every fused path.
     ring_branch = (cfg.attention_impl in ("ring", "ulysses")
                    and kv_cache is None and segment_ids is None and causal
                    and cfg.sliding_window is None and not dropout_active)
@@ -362,16 +361,23 @@ def attention_apply(
                 "batch was permuted for a ring that will not run")
             from megatron_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True, scale=scale)
-    elif cfg.attention_impl == "flash" and kv_cache is None \
-            and not dropout_active:
+    elif cfg.attention_impl == "flash" and kv_cache is None:
         from megatron_tpu.ops.flash_attention import flash_attention
         # segment_ids ride into the kernel (EOD-reset block-diagonal
         # masking, ref: --reset_attention_mask) — O(s) memory where the
         # dot path would materialize the [s, s] scores; sliding_window
-        # additionally skips whole blocks outside the band
-        out = flash_attention(q, k, v, causal=causal, scale=scale,
-                              segment_ids=segment_ids,
-                              sliding_window=cfg.sliding_window)
+        # additionally skips whole blocks outside the band. Active
+        # attention dropout stays on this path too (the reference's
+        # FA2 dropout_p, ref: transformer.py:514-522): the blockwise
+        # impl draws per-block inverted-dropout masks — no O(s^2)
+        # demotion when training GPT/Falcon presets with dropout
+        out = flash_attention(
+            q, k, v, causal=causal, scale=scale, segment_ids=segment_ids,
+            sliding_window=cfg.sliding_window,
+            dropout_rate=(cfg.attention_dropout
+                          if dropout_active and dropout_rng is not None
+                          else 0.0),
+            dropout_rng=dropout_rng if dropout_active else None)
     elif prefill_flash:
         from megatron_tpu.ops.flash_attention import flash_attention
 

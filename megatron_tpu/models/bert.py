@@ -192,6 +192,10 @@ def bert_1f1b_fns(cfg: ModelConfig, deterministic: bool = True):
     forward_step). Streams come from bert_1f1b_streams."""
     from megatron_tpu.config import as_dtype
     from megatron_tpu.ops.dropout import dropout as _drop
+    # the BERT chunk fn returns bare h (no MoE router-aux threading);
+    # _chunk_ret would read aux==0 and silently drop the balance loss
+    assert cfg.num_experts == 1, (
+        "BERT pipeline spec has no MoE router-aux threading")
     compute_dtype = as_dtype(cfg.compute_dtype)
 
     def intake(shared_p, sl, rng_mb):

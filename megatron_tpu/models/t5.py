@@ -146,6 +146,11 @@ def t5_pipeline_loss_fn(params, batch, cfg: ModelConfig, mesh, *,
     from megatron_tpu.config import as_dtype
     from megatron_tpu.parallel.pipeline import pipeline_apply
     from megatron_tpu.parallel.sharding import constrain
+    # this path discards pipeline_apply's aux return (the enc/dec chunk
+    # fns drop stack_apply's aux too) — with MoE it would silently train
+    # routers unregularized, like the sequential t5_forward guard above
+    assert cfg.num_experts == 1, (
+        "T5 pipeline path has no MoE router-aux threading")
     compute_dtype = as_dtype(cfg.compute_dtype)
 
     enc_tokens = batch["text_enc"]   # [n_micro, b, s_enc]

@@ -31,16 +31,28 @@ _warned_shapes = set()
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_kv",
-                                             "use_pallas", "sliding_window"))
+                                             "use_pallas", "sliding_window",
+                                             "dropout_rate"))
 def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
                     block_kv: int = DEFAULT_BLOCK_KV, use_pallas: bool | None = None,
-                    segment_ids=None, sliding_window: int | None = None):
+                    segment_ids=None, sliding_window: int | None = None,
+                    dropout_rate: float = 0.0, dropout_rng=None):
     """Blockwise attention with online softmax. Returns [b, sq, nq, d].
 
     `segment_ids` [b, s] (shared q/k length) masks attention across
     EOD-separated documents (ref: --reset_attention_mask) — the flash
     formulation of the reference's block-diagonal mask, O(s) memory
-    instead of the dot path's O(s^2) scores."""
+    instead of the dot path's O(s^2) scores.
+
+    `dropout_rate > 0` applies attention dropout INSIDE the tiled loop
+    (the reference's FlashAttention-2 `dropout_p`,
+    ref: megatron/model/transformer.py:514-522): the inverted-dropout
+    mask multiplies each block's post-softmax weights in the value
+    accumulation while the softmax normalizer keeps the undropped sum —
+    exactly softmax-then-dropout like the dot path, O(block) mask
+    memory, unbiased (E[out] == no-dropout out). Mask bits are drawn
+    per kv-block from `dropout_rng` folded with the block index, so
+    the backward (jax AD through the scan) sees identical masks."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas and (q.shape[1] % 128 != 0 or k.shape[1] % 128 != 0):
@@ -55,27 +67,42 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float | None = None,
                 "falling back to the (slower) XLA blockwise path",
                 q.shape[1], k.shape[1])
         use_pallas = False
+    if dropout_rate > 0.0:
+        assert dropout_rng is not None, (
+            "flash_attention: dropout_rate > 0 needs dropout_rng")
     if use_pallas:
         try:
             from megatron_tpu.ops.flash_attention_pallas import pallas_flash_attention
             # positional: custom_vjp functions reject keyword arguments;
             # ids go in as floats so every diff arg is float
             from megatron_tpu.ops.flash_attention_pallas import (
-                DEFAULT_BLOCK_KV as PBKV, DEFAULT_BLOCK_Q as PBQ)
+                DEFAULT_BLOCK_KV as PBKV, DEFAULT_BLOCK_Q as PBQ,
+                STAT_LANES)
             seg = (segment_ids.astype(jnp.float32)
                    if segment_ids is not None else None)
+            seed = None
+            if dropout_rate > 0.0:
+                # the kernel's counter-based hash takes one integer seed
+                # (<= 2^24 so the f32 plumbing is exact); per-block
+                # streams come from hashing it with the block coords
+                seed = jax.random.randint(
+                    dropout_rng, (1, STAT_LANES), 0,
+                    1 << 23).astype(jnp.float32)
             return pallas_flash_attention(
                 q, k, v, causal, scale, PBQ, PBKV, False, seg, seg,
-                sliding_window)
+                sliding_window, dropout_rate, seed)
         except ImportError:
             pass
     return _blockwise_attention(q, k, v, causal=causal, scale=scale,
                                 block_kv=block_kv, segment_ids=segment_ids,
-                                sliding_window=sliding_window)
+                                sliding_window=sliding_window,
+                                dropout_rate=dropout_rate,
+                                dropout_rng=dropout_rng)
 
 
 def _blockwise_attention(q, k, v, *, causal, scale, block_kv,
-                         segment_ids=None, sliding_window=None):
+                         segment_ids=None, sliding_window=None,
+                         dropout_rate=0.0, dropout_rng=None):
     b, sq, nq, d = q.shape
     skv, nkv = k.shape[1], k.shape[2]
     if scale is None:
@@ -127,8 +154,19 @@ def _blockwise_attention(q, k, v, *, causal, scale, block_kv,
         p = jnp.exp(s - m_safe[..., None])
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        # l accumulates the UNdropped sum (dropout scales softmax output,
+        # it does not renormalize it — same as the dot path's
+        # softmax-then-dropout); only the value accumulation sees the
+        # inverted-dropout mask
         l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[..., None] + jnp.einsum("bsngt,btnd->bsngd", p, vj)
+        pz = p
+        if dropout_rate > 0.0:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(dropout_rng, j), 1.0 - dropout_rate,
+                p.shape)
+            pz = p * keep.astype(p.dtype) / (1.0 - dropout_rate)
+        acc_new = acc * alpha[..., None] + jnp.einsum("bsngt,btnd->bsngd",
+                                                      pz, vj)
         return (acc_new, m_new, l_new), None
 
     acc0 = jnp.zeros((b, sq, nkv, g, d), jnp.float32)

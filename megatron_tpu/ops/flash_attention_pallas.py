@@ -50,19 +50,77 @@ MASK_CLAMP = -1e20
 # sublane keeps the HBM footprint 16x smaller, which matters at 32k seq).
 STAT_LANES = 8
 
+# murmur3 fmix32 constants as wrapping int32 (0x85ebca6b, 0xc2b2ae35) —
+# the in-kernel counter-based dropout RNG below uses plain int32 ops
+# (wrapping multiply + LOGICAL shifts), so it runs identically under
+# interpret mode on CPU and compiled on TPU; pltpu.prng_random_bits has
+# no CPU lowering, which would leave the dropout path untestable here
+_FMIX_M1 = -2048144789
+_FMIX_M2 = -1028477387
+
+
+def _fmix32(x):
+    """murmur3 finalizer: full avalanche on int32 (wrapping arithmetic).
+
+    Constants stay PYTHON ints (signed-int32 values): a jnp constant
+    would be captured as a pallas_call closure array, which the
+    interpret path refuses ('Cannot lower a pallas_call with
+    constants'); python scalars promote weakly onto the traced int32."""
+    srl = jax.lax.shift_right_logical
+    x = x ^ srl(x, 16)
+    x = x * _FMIX_M1
+    x = x ^ srl(x, 13)
+    x = x * _FMIX_M2
+    x = x ^ srl(x, 16)
+    return x
+
+
+def _dropout_keep(seed_i32, bh, qi, ki, block_q, block_kv, rate):
+    """Deterministic [block_q, block_kv] keep mask for (batch*head, q
+    block, kv block): two fmix rounds over (seed ^ head-row, kv column).
+    The SAME function runs in the forward and BOTH backward kernels, so
+    the mask regenerates bit-exactly without ever being stored."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 0)
+    kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 1)
+    # golden-ratio constants as wrapping int32 (0x9E3779B1 == -1640531535
+    # signed); python ints, not jnp constants — see _fmix32
+    row = _fmix32(seed_i32 ^ (bh * (-1640531535))
+                  ^ (q_pos * 0x61C88647))
+    u = _fmix32(row ^ kv_pos)
+    # 31 uniform bits vs a compile-time threshold
+    u31 = jax.lax.shift_right_logical(u, 1)
+    thresh = int(rate * float(2 ** 31))
+    return u31 >= thresh
+
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, block_q,
-                block_kv, num_kv, has_segs=False, window=None):
-    # refs: [qs_ref, ks_ref]? o_ref, lse_ref, acc_ref, m_ref, l_ref —
-    # segment-id blocks are inputs only when segment masking is on, so the
-    # plain path pays zero extra DMA
+                block_kv, num_kv, has_segs=False, window=None,
+                dropout_rate=0.0):
+    # refs: [qs_ref, ks_ref]? [seed_ref]? o_ref, lse_ref, acc_ref, m_ref,
+    # l_ref — segment-id blocks / the dropout seed are inputs only when
+    # the feature is on, so the plain path pays zero extra DMA
+    refs = list(refs)
+    qs_ref = ks_ref = seed_ref = None
     if has_segs:
-        qs_ref, ks_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
-    else:
-        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
-        qs_ref = ks_ref = None
+        qs_ref, ks_ref = refs[0], refs[1]
+        refs = refs[2:]
+    if dropout_rate > 0.0:
+        seed_ref = refs[0]
+        refs = refs[1:]
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     qi = pl.program_id(2)
     ki = pl.program_id(3)
+    drop_z = None
+    if dropout_rate > 0.0:
+        # computed at kernel top level: program_id inside a pl.when body
+        # would be captured as a cond-closure constant, which the
+        # interpret path refuses
+        bh = pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
+        dkeep = _dropout_keep(seed_ref[0, 0].astype(jnp.int32), bh, qi,
+                              ki, block_q, block_kv, dropout_rate)
+        drop_z = dkeep.astype(jnp.float32) / (1.0 - dropout_rate)
 
     @pl.when(ki == 0)
     def _init():
@@ -111,9 +169,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, block_q,
         # attend uniformly to the masked keys
         p = jnp.exp(s - jnp.maximum(m_new, MASK_CLAMP))
         alpha = jnp.exp(m_prev - m_new)                  # [bq, 1]
+        # softmax-then-dropout: l keeps the UNdropped sum (dropout scales
+        # the normalized probs, it does not renormalize them); only the
+        # value accumulation sees the inverted-dropout mask
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pz = p if drop_z is None else p * drop_z
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            pz, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
@@ -128,21 +190,31 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, block_q,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    *refs, scale, causal, block_q, block_kv, num_kv,
-                   has_dlse=False, has_segs=False, window=None):
-    # refs: [qs_ref, ks_ref]? [dlse_ref]? dq_ref, dq_acc — segment blocks
-    # and dlse are inputs only when the respective feature is on (the
-    # plain path skips both DMAs)
+                   has_dlse=False, has_segs=False, window=None,
+                   dropout_rate=0.0):
+    # refs: [qs_ref, ks_ref]? [dlse_ref]? [seed_ref]? dq_ref, dq_acc —
+    # segment blocks / dlse / the dropout seed are inputs only when the
+    # respective feature is on (the plain path skips the DMAs)
     refs = list(refs)
-    qs_ref = ks_ref = dlse_ref = None
+    qs_ref = ks_ref = dlse_ref = seed_ref = None
     if has_segs:
         qs_ref, ks_ref = refs[0], refs[1]
         refs = refs[2:]
     if has_dlse:
         dlse_ref = refs[0]
         refs = refs[1:]
+    if dropout_rate > 0.0:
+        seed_ref = refs[0]
+        refs = refs[1:]
     dq_ref, dq_acc = refs
     qi = pl.program_id(2)
     ki = pl.program_id(3)
+    drop_z = None
+    if dropout_rate > 0.0:
+        bh = pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
+        dkeep = _dropout_keep(seed_ref[0, 0].astype(jnp.int32), bh, qi,
+                              ki, block_q, block_kv, dropout_rate)
+        drop_z = dkeep.astype(jnp.float32) / (1.0 - dropout_rate)
 
     @pl.when(ki == 0)
     def _init():
@@ -183,6 +255,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if drop_z is not None:
+            # the forward's regenerated mask; with O = (P∘Z)V/l the
+            # chain rule gives dS = P ∘ (Z∘dP_raw - delta): delta =
+            # rowsum(dO∘O) already absorbs the dropped entries
+            dp = dp * drop_z
         # dlse term: d(lse)/d(s) = p, so an lse cotangent adds p*dlse
         # (used by ring attention's online merge weights)
         rest = dp - delta
@@ -200,18 +277,31 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     *refs, scale, causal, block_q, block_kv, num_q,
-                    has_dlse=False, has_segs=False, window=None):
+                    has_dlse=False, has_segs=False, window=None,
+                    dropout_rate=0.0):
     refs = list(refs)
-    qs_ref = ks_ref = dlse_ref = None
+    qs_ref = ks_ref = dlse_ref = seed_ref = None
     if has_segs:
         qs_ref, ks_ref = refs[0], refs[1]
         refs = refs[2:]
     if has_dlse:
         dlse_ref = refs[0]
         refs = refs[1:]
+    if dropout_rate > 0.0:
+        seed_ref = refs[0]
+        refs = refs[1:]
     dk_ref, dv_ref, dk_acc, dv_acc = refs
     ki = pl.program_id(2)
     qi = pl.program_id(3)
+    drop_z = None
+    if dropout_rate > 0.0:
+        # same (bh, qi, ki) stream as the forward — this kernel's grid
+        # swaps the block axes, but the mask is indexed by the block
+        # COORDINATES, not the grid order
+        bh = pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
+        dkeep = _dropout_keep(seed_ref[0, 0].astype(jnp.int32), bh, qi,
+                              ki, block_q, block_kv, dropout_rate)
+        drop_z = dkeep.astype(jnp.float32) / (1.0 - dropout_rate)
 
     @pl.when(qi == 0)
     def _init():
@@ -251,11 +341,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_seg = ks_ref[0][:, 0][None, :]
             s = jnp.where(q_seg == k_seg, s, NEG_INF)
         p = jnp.exp(s - lse)                             # [bq, bkv]
-        dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        pz = p
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if drop_z is not None:
+            pz = p * drop_z  # dV sees the dropped weights: dV = (P∘Z)ᵀdO
+            dp = dp * drop_z  # dS = P ∘ (Z∘dP_raw - delta)
+        dv_acc[:] += jax.lax.dot_general(
+            pz, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         rest = dp - delta
         if has_dlse:
             rest = rest + dlse_ref[0, 0][:, :1]
@@ -297,24 +391,34 @@ def _seg_lanes(seg, lanes=STAT_LANES):
                             seg.shape + (lanes,))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 10, 11))
 def pallas_flash_attention(q, k, v, causal=True, scale=None,
                            block_q=DEFAULT_BLOCK_Q, block_kv=DEFAULT_BLOCK_KV,
                            interpret=False, q_seg=None, k_seg=None,
-                           sliding_window=None):
+                           sliding_window=None, dropout_rate=0.0,
+                           dropout_seed=None):
     """q [b, sq, nq, d], k/v [b, sk, nkv, d] -> [b, sq, nq, d].
 
     `q_seg`/`k_seg` [b, s] FLOAT segment ids (cast outside so the vjp's
     cotangent plumbing stays all-float): scores are masked where ids
     differ — block-diagonal attention across EOD-separated documents
-    (ref: --reset_attention_mask, megatron/utils.py:137-194)."""
+    (ref: --reset_attention_mask, megatron/utils.py:137-194).
+
+    `dropout_rate` (static) + `dropout_seed` ([1, STAT_LANES] f32 array
+    holding one integer <= 2^24, a zero-cotangent diff arg like the seg
+    ids): attention dropout INSIDE the kernel — the reference's FA2
+    `dropout_p` (ref: transformer.py:514-522). Masks are regenerated
+    from (seed, head, block coords) by a counter-based hash in forward
+    AND both backward kernels; nothing is stored."""
     out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
-                        q_seg, k_seg, sliding_window)
+                        q_seg, k_seg, sliding_window, dropout_rate,
+                        dropout_seed)
     return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
-               q_seg=None, k_seg=None, sliding_window=None):
+               q_seg=None, k_seg=None, sliding_window=None,
+               dropout_rate=0.0, dropout_seed=None):
     b, sq, nq, d = q.shape
     sk, nkv = k.shape[1], k.shape[2]
     g = nq // nkv
@@ -324,6 +428,9 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
     num_q, num_kv = sq // bq, sk // bkv
     has_segs = q_seg is not None
     assert has_segs == (k_seg is not None), "q_seg/k_seg must come together"
+    has_drop = dropout_rate > 0.0
+    assert not has_drop or dropout_seed is not None, (
+        "dropout_rate > 0 needs dropout_seed")
 
     qT = q.transpose(0, 2, 1, 3)  # [b, nq, sq, d]
     kT = k.transpose(0, 2, 1, 3)  # [b, nkv, sk, d]
@@ -345,13 +452,21 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
             pl.BlockSpec((1, bkv, STAT_LANES),
                          lambda bi, h, qi, ki: (bi, ki, 0)),
         ]
+    drop_inputs, drop_specs = [], []
+    if has_drop:
+        drop_inputs = [jnp.broadcast_to(
+            jnp.asarray(dropout_seed, jnp.float32).reshape(1, -1)[:, :1],
+            (1, STAT_LANES))]
+        drop_specs = [pl.BlockSpec((1, STAT_LANES),
+                                   lambda bi, h, qi, ki: (0, 0))]
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           block_q=bq, block_kv=bkv, num_kv=num_kv,
-                          has_segs=has_segs, window=sliding_window),
+                          has_segs=has_segs, window=sliding_window,
+                          dropout_rate=dropout_rate),
         grid=grid,
-        in_specs=[q_spec, kv_spec, kv_spec] + seg_specs,
+        in_specs=[q_spec, kv_spec, kv_spec] + seg_specs + drop_specs,
         out_specs=[o_spec, lse_spec],
         out_shape=[jax.ShapeDtypeStruct((b, nq, sq, d), q.dtype),
                    jax.ShapeDtypeStruct((b, nq, sq, STAT_LANES), jnp.float32)],
@@ -359,16 +474,16 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
                         pltpu.VMEM((bq, STAT_LANES), jnp.float32),
                         pltpu.VMEM((bq, STAT_LANES), jnp.float32)],
         interpret=interpret,
-    )(qT, kT, vT, *seg_inputs)
+    )(qT, kT, vT, *seg_inputs, *drop_inputs)
     out = out.transpose(0, 2, 1, 3)
-    return out, (q, k, v, out, lse, q_seg, k_seg)
+    return out, (q, k, v, out, lse, q_seg, k_seg, dropout_seed)
 
 
 def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
-                    dlse=None, sliding_window=None):
+                    dlse=None, sliding_window=None, dropout_rate=0.0):
     """Shared backward. `dlse` [b, sq, nq] is the cotangent of the exposed
     logsumexp (ring attention's merge weights use it); None means zero."""
-    q, k, v, out, lse, q_seg, k_seg = res
+    q, k, v, out, lse, q_seg, k_seg, dropout_seed = res
     b, sq, nq, d = q.shape
     sk, nkv = k.shape[1], k.shape[2]
     g = nq // nkv
@@ -377,6 +492,7 @@ def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
     bq, bkv = _pick_blocks(sq, sk, block_q, block_kv)
     num_q, num_kv = sq // bq, sk // bkv
     has_segs = q_seg is not None
+    has_drop = dropout_rate > 0.0
 
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)
@@ -394,6 +510,11 @@ def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
         extra = [jnp.broadcast_to(
             dlse.astype(jnp.float32).transpose(0, 2, 1)[..., None],
             (b, nq, sq, STAT_LANES))]
+    drop_inputs = []
+    if has_drop:
+        drop_inputs = [jnp.broadcast_to(
+            jnp.asarray(dropout_seed, jnp.float32).reshape(1, -1)[:, :1],
+            (1, STAT_LANES))]
 
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, h, qi, ki: (bi, h, qi, 0))
     kv_spec = pl.BlockSpec((1, 1, bkv, d),
@@ -405,20 +526,24 @@ def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
         pl.BlockSpec((1, bkv, STAT_LANES), lambda bi, h, qi, ki: (bi, ki, 0)),
     ] if has_segs else [])
 
+    seed_spec = [pl.BlockSpec((1, STAT_LANES),
+                              lambda bi, h, qi, ki: (0, 0))] * has_drop
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=bq, block_kv=bkv, num_kv=num_kv,
                           has_dlse=has_dlse, has_segs=has_segs,
-                          window=sliding_window),
+                          window=sliding_window,
+                          dropout_rate=dropout_rate),
         grid=(b, nq, num_q, num_kv),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec]
-        + seg_specs + [row_spec] * has_dlse,
+        + seg_specs + [row_spec] * has_dlse + seed_spec,
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda bi, h, qi, ki: (bi, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, nq, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(qT, kT, vT, doT, lse, delta, *seg_inputs, *extra)
+    )(qT, kT, vT, doT, lse, delta, *seg_inputs, *extra, *drop_inputs)
 
     # dk/dv: grid swaps the roles — kv blocks outer, q blocks inner; every
     # q-head contributes to its kv-head, so run per Q-HEAD and sum groups
@@ -436,21 +561,25 @@ def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
         pl.BlockSpec((1, bkv, STAT_LANES), lambda bi, h, ki, qi: (bi, ki, 0)),
     ] if has_segs else [])
 
+    seed_spec2 = [pl.BlockSpec((1, STAT_LANES),
+                               lambda bi, h, ki, qi: (0, 0))] * has_drop
+
     dk_per_head, dv_per_head = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=bq, block_kv=bkv, num_q=num_q,
                           has_dlse=has_dlse, has_segs=has_segs,
-                          window=sliding_window),
+                          window=sliding_window,
+                          dropout_rate=dropout_rate),
         grid=(b, nq, num_kv, num_q),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2]
-        + seg_specs2 + [row_spec2] * has_dlse,
+        + seg_specs2 + [row_spec2] * has_dlse + seed_spec2,
         out_specs=[dk_spec, dk_spec],
         out_shape=[jax.ShapeDtypeStruct((b, nq, sk, d), jnp.float32),
                    jax.ShapeDtypeStruct((b, nq, sk, d), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((bkv, d), jnp.float32),
                         pltpu.VMEM((bkv, d), jnp.float32)],
         interpret=interpret,
-    )(qT, kT, vT, doT, lse, delta, *seg_inputs, *extra)
+    )(qT, kT, vT, doT, lse, delta, *seg_inputs, *extra, *drop_inputs)
 
     # GQA: sum the per-q-head dk/dv into kv heads
     dk = dk_per_head.reshape(b, nkv, g, sk, d).sum(axis=2)
@@ -459,26 +588,31 @@ def _flash_bwd_core(causal, scale, block_q, block_kv, interpret, res, dout,
     grads = (dq.transpose(0, 2, 1, 3),
              dk.transpose(0, 2, 1, 3).astype(k.dtype),
              dv.transpose(0, 2, 1, 3).astype(v.dtype))
-    # float segment ids are diff args purely for plumbing: zero cotangent
+    # float segment ids / the dropout seed are diff args purely for
+    # plumbing: zero cotangent
     seg_grads = (jnp.zeros_like(q_seg) if has_segs else None,
-                 jnp.zeros_like(k_seg) if has_segs else None)
+                 jnp.zeros_like(k_seg) if has_segs else None,
+                 jnp.zeros_like(dropout_seed) if has_drop else None)
     return grads, seg_grads
 
 
 def _flash_bwd(causal, scale, block_q, block_kv, interpret,
-               sliding_window, res, dout):
-    # sliding_window arrives as a NONDIFF arg (a static Python int), never
-    # via the residuals — a traced scalar could not close over the kernels
-    (dq, dk, dv), (dqs, dks) = _flash_bwd_core(
+               sliding_window, dropout_rate, res, dout):
+    # sliding_window/dropout_rate arrive as NONDIFF args (static Python
+    # values), never via the residuals — a traced scalar could not close
+    # over the kernels
+    (dq, dk, dv), (dqs, dks, dseed) = _flash_bwd_core(
         causal, scale, block_q, block_kv, interpret, res, dout,
-        sliding_window=sliding_window)
-    return dq, dk, dv, dqs, dks
+        sliding_window=sliding_window, dropout_rate=dropout_rate)
+    return dq, dk, dv, dqs, dks, dseed
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_kv, interpret,
-                    q_seg=None, k_seg=None, sliding_window=None):
+                    q_seg=None, k_seg=None, sliding_window=None,
+                    dropout_rate=0.0, dropout_seed=None):
     out, res = _flash_fwd(q, k, v, causal, scale, block_q, block_kv,
-                          interpret, q_seg, k_seg, sliding_window)
+                          interpret, q_seg, k_seg, sliding_window,
+                          dropout_rate, dropout_seed)
     return out, res
 
 

@@ -202,7 +202,10 @@ def distributed_opt_sharding(mesh: Mesh, logical_axes: tuple, rules,
     spec = list(logical_to_spec(logical_axes, rules))
     spec += [None] * (len(shape) - len(spec))
     dp = mesh.shape[DATA_AXIS]
-    if dp > 1:
+    # expert_axis='dp' already places 'dp' on the bank's experts dim —
+    # adding it to a second dim would be a DuplicateSpecError; those
+    # moments are dp-sharded (by the expert dim) either way
+    if dp > 1 and DATA_AXIS not in spec:
         for i, (ax, dim) in enumerate(zip(spec, shape)):
             if ax is None and dim % dp == 0:
                 spec[i] = DATA_AXIS

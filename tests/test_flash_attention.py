@@ -76,30 +76,112 @@ def test_flash_bf16_io():
     assert out.shape == q.shape
 
 
-def test_active_attention_dropout_routes_to_dot_path():
-    """A training trace (deterministic=False) with attention_dropout > 0
-    must take the dot path even under attention_impl='flash' — the fused
-    kernels have no dropout plumbing, so the configured regularization
-    would otherwise silently vanish (round-4 review). Equality with the
-    dot config under the same rng proves the routing."""
-    import dataclasses as dc
+class TestFlashDropout:
+    """Attention dropout ON the flash path (VERDICT r4 #5): the demotion
+    to the O(s^2) dot path is gone. The blockwise impl applies
+    softmax-then-inverted-dropout per kv block; the normalizer keeps the
+    undropped sum — identical semantics to the dot path's
+    dropout(softmax(s)), different mask draws, so parity is statistical
+    (both unbiased around the no-dropout output)."""
 
-    from megatron_tpu.config import ModelConfig
-    from megatron_tpu.models import language_model as lm
+    def _qkv(self, seed=0, b=2, s=64, n=4, d=16):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        mk = lambda k: jax.random.normal(k, (b, s, n, d), jnp.float32)
+        return mk(ks[0]), mk(ks[1]), mk(ks[2])
 
-    base = ModelConfig(num_layers=2, hidden_size=64, num_attention_heads=4,
-                       vocab_size=128, seq_length=32,
-                       attention_dropout=0.5,
-                       compute_dtype="float32").derived()
-    cfg_flash = dc.replace(base, attention_impl="flash")
-    params = lm.model_init(jax.random.PRNGKey(0), base)
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 128)
-    rng = jax.random.PRNGKey(7)
-    l_dot = lm.loss_fn(params, tokens, base, rng=rng, deterministic=False)
-    l_flash = lm.loss_fn(params, tokens, cfg_flash, rng=rng,
-                         deterministic=False)
-    # identical (same path, same rng folding), and dropout actually bit
-    np.testing.assert_allclose(float(l_flash), float(l_dot), rtol=1e-6)
-    l_eval = lm.loss_fn(params, tokens, cfg_flash, deterministic=True)
-    assert abs(float(l_eval) - float(l_dot)) > 1e-4, (
-        "dropout appears inert — the dot routing did not happen?")
+    def test_rate0_is_exact_and_same_rng_is_deterministic(self):
+        q, k, v = self._qkv()
+        base = _blockwise_attention(q, k, v, causal=True, scale=0.25,
+                                    block_kv=16)
+        z = _blockwise_attention(q, k, v, causal=True, scale=0.25,
+                                 block_kv=16, dropout_rate=0.0,
+                                 dropout_rng=jax.random.PRNGKey(3))
+        np.testing.assert_array_equal(np.asarray(z), np.asarray(base))
+        rng = jax.random.PRNGKey(4)
+        a = _blockwise_attention(q, k, v, causal=True, scale=0.25,
+                                 block_kv=16, dropout_rate=0.3,
+                                 dropout_rng=rng)
+        b2 = _blockwise_attention(q, k, v, causal=True, scale=0.25,
+                                  block_kv=16, dropout_rate=0.3,
+                                  dropout_rng=rng)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b2))
+        assert np.abs(np.asarray(a) - np.asarray(base)).max() > 1e-3
+
+    def test_unbiased_vs_no_dropout_and_vs_dot(self):
+        """Mean over seeds converges to the undropped output for BOTH
+        impls — the statistical parity gate (same target, same scaling
+        convention)."""
+        q, k, v = self._qkv(seed=1)
+        base = _blockwise_attention(q, k, v, causal=True, scale=0.25,
+                                    block_kv=16)
+        n_seeds, rate = 256, 0.3
+
+        def mean_over_seeds(fn):
+            outs = jax.vmap(fn)(
+                jax.random.split(jax.random.PRNGKey(9), n_seeds))
+            return jnp.mean(outs, axis=0), jnp.std(outs, axis=0)
+
+        m_flash, s_flash = mean_over_seeds(
+            lambda r: _blockwise_attention(
+                q, k, v, causal=True, scale=0.25, block_kv=16,
+                dropout_rate=rate, dropout_rng=r))
+        m_dot, _ = mean_over_seeds(
+            lambda r: _dot_attention(q, k, v, causal=True,
+                                     softmax_fp32=True, scale=0.25,
+                                     dropout_rate=rate, dropout_rng=r))
+        # CLT band: mean deviates from target by ~std/sqrt(N); allow 6x
+        tol = 6.0 * np.asarray(s_flash).max() / np.sqrt(n_seeds) + 1e-4
+        assert np.abs(np.asarray(m_flash) - np.asarray(base)).max() < tol
+        assert np.abs(np.asarray(m_dot) - np.asarray(base)).max() < tol
+
+    def test_grads_flow_and_regenerate(self):
+        """jax AD through the scan sees the same per-block masks; grads
+        are deterministic per rng and reach q, k AND v."""
+        q, k, v = self._qkv(seed=2, s=48)
+        rng = jax.random.PRNGKey(5)
+
+        def f(q, k, v):
+            return jnp.sum(_blockwise_attention(
+                q, k, v, causal=True, scale=0.25, block_kv=16,
+                dropout_rate=0.4, dropout_rng=rng) ** 2)
+
+        g1 = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for g in g1:
+            assert np.isfinite(np.asarray(g)).all()
+            assert np.abs(np.asarray(g)).max() > 0
+
+    def test_training_trace_keeps_flash_with_dropout(self):
+        """attention_impl='flash' + attention_dropout > 0 in a training
+        trace: dropout engages (train loss differs from eval) and the
+        loss does NOT equal the dot config's (different mask draws —
+        proof the dot demotion is gone), while eval losses match
+        exactly across impls."""
+        import dataclasses as dc
+
+        from megatron_tpu.config import ModelConfig
+        from megatron_tpu.models import language_model as lm
+
+        base = ModelConfig(num_layers=2, hidden_size=64,
+                           num_attention_heads=4, vocab_size=128,
+                           seq_length=32, attention_dropout=0.5,
+                           compute_dtype="float32").derived()
+        cfg_flash = dc.replace(base, attention_impl="flash")
+        params = lm.model_init(jax.random.PRNGKey(0), base)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 128)
+        rng = jax.random.PRNGKey(7)
+        l_dot = lm.loss_fn(params, tokens, base, rng=rng,
+                           deterministic=False)
+        l_flash = lm.loss_fn(params, tokens, cfg_flash, rng=rng,
+                             deterministic=False)
+        l_eval_f = lm.loss_fn(params, tokens, cfg_flash,
+                              deterministic=True)
+        l_eval_d = lm.loss_fn(params, tokens, base, deterministic=True)
+        np.testing.assert_allclose(float(l_eval_f), float(l_eval_d),
+                                   rtol=2e-5)
+        assert abs(float(l_flash) - float(l_eval_f)) > 1e-4, (
+            "flash-path attention dropout appears inert")
+        assert abs(float(l_flash) - float(l_dot)) > 1e-7, (
+            "flash loss bit-matches dot — did the dot demotion return?")

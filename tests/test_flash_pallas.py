@@ -323,3 +323,143 @@ def test_sliding_window_config_guards():
     from megatron_tpu.parallel.ring_attention import data_zigzag_cp
     ring_cfg = dataclasses.replace(cfg, attention_impl="ring")
     assert data_zigzag_cp(ring_cfg, 64) == 0
+
+
+class TestKernelDropout:
+    """In-kernel attention dropout (counter-based hash RNG; VERDICT r4
+    #5). The mask is REGENERATED in the forward and both backward
+    kernels from (seed, head, block coords) — these tests pin: exact
+    determinism per seed, rate-0 exactness, unbiasedness around the
+    no-dropout output, the keep fraction, and the backward's mask
+    regeneration via finite differences."""
+
+    def _qkv(self, b=1, s=256, nq=2, nkv=2, d=64, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(ks[0], (b, s, nq, d), jnp.float32)
+        k = jax.random.normal(ks[1], (b, s, nkv, d), jnp.float32)
+        v = jax.random.normal(ks[2], (b, s, nkv, d), jnp.float32)
+        return q, k, v
+
+    def _seed(self, val):
+        from megatron_tpu.ops.flash_attention_pallas import STAT_LANES
+        return jnp.full((1, STAT_LANES), float(val), jnp.float32)
+
+    def _run(self, q, k, v, rate, seed, bq=128, bkv=128):
+        return pallas_flash_attention(q, k, v, True, None, bq, bkv, True,
+                                      None, None, None, rate,
+                                      self._seed(seed))
+
+    def test_rate0_and_determinism_and_seed_sensitivity(self):
+        q, k, v = self._qkv()
+        base = pallas_flash_attention(q, k, v, True, None, 128, 128, True)
+        a1 = self._run(q, k, v, 0.3, 7)
+        a2 = self._run(q, k, v, 0.3, 7)
+        b2 = self._run(q, k, v, 0.3, 8)
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+        assert np.abs(np.asarray(a1) - np.asarray(b2)).max() > 1e-3
+        assert np.abs(np.asarray(a1) - np.asarray(base)).max() > 1e-3
+
+    @pytest.mark.slow
+    def test_unbiased_and_keep_fraction(self):
+        """Mean over seeds -> no-dropout output (CLT band), and the
+        realized keep fraction of the hash stream is binomially sane."""
+        q, k, v = self._qkv(seed=1)
+        base = pallas_flash_attention(q, k, v, True, None, 128, 128, True)
+        rate, n_seeds = 0.3, 192
+        outs = jnp.stack([self._run(q, k, v, rate, 100 + i)
+                          for i in range(n_seeds)])
+        m = np.asarray(jnp.mean(outs, axis=0))
+        sd = np.asarray(jnp.std(outs, axis=0))
+        tol = 6.0 * sd.max() / np.sqrt(n_seeds) + 1e-4
+        assert np.abs(m - np.asarray(base)).max() < tol
+
+        from megatron_tpu.ops.flash_attention_pallas import _dropout_keep
+        keep = _dropout_keep(jnp.int32(12345), jnp.int32(3),
+                             jnp.int32(0), jnp.int32(0), 256, 256, rate)
+        frac = float(jnp.mean(keep.astype(jnp.float32)))
+        # 256*256 = 65536 draws: binomial std ~ 0.0018; allow 6 sigma
+        assert abs(frac - (1 - rate)) < 0.011, frac
+
+    def test_backward_regenerates_forward_mask(self):
+        """Forward AND all three gradients must match a dense softmax-
+        then-dropout reference built with the SAME hash mask
+        (reconstructed outside the kernel via _dropout_keep) — only true
+        if fwd, dq, and dkv kernels all regenerate identical masks and
+        the dS = P∘(Z∘dP − delta) algebra is right."""
+        from megatron_tpu.ops.flash_attention_pallas import _dropout_keep
+        b, s, n, d = 1, 128, 2, 32
+        q, k, v = self._qkv(b=b, s=s, nq=n, nkv=n, d=d, seed=2)
+        rate, seed, bq, bkv = 0.4, 11, 64, 64
+
+        Z = np.zeros((b, n, s, s), np.float32)
+        for bi in range(b):
+            for h in range(n):
+                for qi in range(s // bq):
+                    for ki in range(s // bkv):
+                        kp = _dropout_keep(
+                            jnp.int32(seed), jnp.int32(bi * n + h),
+                            jnp.int32(qi), jnp.int32(ki), bq, bkv, rate)
+                        Z[bi, h, qi * bq:(qi + 1) * bq,
+                          ki * bkv:(ki + 1) * bkv] = np.asarray(kp)
+        Z = jnp.asarray(Z) / (1.0 - rate)
+
+        def dense_ref(q, k, v):
+            s_ = jnp.einsum("bqnd,bknd->bnqk", q, k) * d ** -0.5
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            s_ = jnp.where(mask[None, None], s_, -1e30)
+            p = jax.nn.softmax(s_, axis=-1)
+            return jnp.einsum("bnqk,bknd->bqnd", p * Z, v)
+
+        def loss_p(q, k, v):
+            return jnp.sum(self._run(q, k, v, rate, seed, bq, bkv) ** 2)
+
+        def loss_r(q, k, v):
+            return jnp.sum(dense_ref(q, k, v) ** 2)
+
+        o_p = self._run(q, k, v, rate, seed, bq, bkv)
+        np.testing.assert_allclose(np.asarray(o_p),
+                                   np.asarray(dense_ref(q, k, v)),
+                                   rtol=1e-5, atol=1e-5)
+        gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, want in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(want),
+                                       rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.slow
+    def test_matches_xla_blockwise_statistics(self):
+        """Both impls are unbiased around the same target with the SAME
+        1/(1-p) scaling convention. Per-element CLT bands are wide here
+        (short peaky rows make dropout variance large), so the sharp
+        statistic is the regression coefficient of the seed-mean onto
+        the no-dropout output: c = <m, base>/<base, base> must be 1 for
+        both — a keep-prob or scaling mismatch shifts c by the
+        mismatch ratio while its sampling noise is ~1/sqrt(N*elements)."""
+        q, k, v = self._qkv(seed=2, s=128)
+        rate, n = 0.25, 96
+        pall = jnp.stack([self._run(q, k, v, rate, 50 + i, bq=64, bkv=64)
+                          for i in range(n)]).mean(0)
+        xla = jnp.stack([
+            _blockwise_attention(q, k, v, causal=True, scale=None,
+                                 block_kv=64, dropout_rate=rate,
+                                 dropout_rng=jax.random.PRNGKey(50 + i))
+            for i in range(n)]).mean(0)
+        base = np.asarray(
+            pallas_flash_attention(q, k, v, True, None, 64, 64, True))
+        for name, m in (("pallas", pall), ("xla", xla)):
+            c = float(np.sum(np.asarray(m) * base) / np.sum(base * base))
+            assert abs(c - 1.0) < 0.02, (name, c)
+
+    def test_dropout_composes_with_sliding_window_and_segments(self):
+        """Dropout + banded mask + segment mask in one kernel call stay
+        finite and deterministic."""
+        from megatron_tpu.ops.flash_attention_pallas import _seg_lanes
+        q, k, v = self._qkv(s=256)
+        seg = jnp.concatenate([jnp.zeros((1, 128)), jnp.ones((1, 128))],
+                              axis=1).astype(jnp.float32)
+        o1 = pallas_flash_attention(q, k, v, True, None, 128, 128, True,
+                                    seg, seg, 64, 0.3, self._seed(5))
+        o2 = pallas_flash_attention(q, k, v, True, None, 128, 128, True,
+                                    seg, seg, 64, 0.3, self._seed(5))
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+        assert np.isfinite(np.asarray(o1)).all()

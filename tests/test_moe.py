@@ -436,6 +436,30 @@ def test_moe_tp_expert_parallel_matches_single(devices):
     np.testing.assert_allclose(losses[2], losses[1], rtol=5e-3)
 
 
+def test_moe_dp_expert_axis_with_zero1_shardings(devices):
+    """expert_axis='dp' + ZeRO-1: the bank's experts dim already carries
+    'dp', so distributed_opt_sharding must NOT add 'dp' to a second dim
+    (DuplicateSpecError regression, round-5 review)."""
+    from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
+                                     ParallelConfig, TrainingConfig)
+    from megatron_tpu.models.language_model import model_init
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.training.train_step import state_shardings
+
+    cfg = MegatronConfig(
+        model=_cfg(activation="swiglu"),
+        parallel=ParallelConfig(data_parallel=2, expert_axis="dp",
+                                use_distributed_optimizer=True),
+        training=TrainingConfig(micro_batch_size=4, global_batch_size=8),
+    ).validate(n_devices=2)
+    mesh = build_mesh(cfg.parallel, devices=jax.devices()[:2])
+    shapes = jax.eval_shape(
+        lambda: model_init(jax.random.PRNGKey(0), cfg.model))
+    sh = state_shardings(cfg, mesh, shapes)  # raised before the fix
+    mu_w1 = sh.opt_state.mu["transformer"]["mlp"]["w1"]
+    assert [a for a in mu_w1.spec if a == "dp"] == ["dp"]
+
+
 @pytest.mark.slow
 def test_moe_dp_expert_parallel_matches_single(devices):
     """expert_axis='dp' (GShard-style EP over the data axis): dp2 with
