@@ -1,0 +1,136 @@
+"""Cross-implementation gate: the REFERENCE's own code vs megatron_tpu.
+
+tools/reference_forward_cpu.py runs the reference implementation at
+/root/reference on CPU (apex/amp_C/flash_attn shimmed by
+tools/reference_cpu_shim.py) — its own initialize/arguments machinery,
+its own checkpoint loader consuming OUR exported Megatron checkpoint,
+its own LlamaModel, and for the training arm its own FP32Optimizer
+(l2-clip -> AdamW) — and these tests compare against megatron_tpu on
+the same weights and data:
+
+- forward: logits agree at fp32 round-off (<=1e-5 avg max-abs; measured
+  1.8e-7) — the executable real-weight-class gate (ref CI:
+  tests/test_llama_weights.py:106 used <=1e-3 on real weights), with
+  the weights flowing through our megatron EXPORTER and their LOADER.
+- training: per-step masked-mean losses over 12 full optimizer steps
+  from identical init on identical batches agree to <=1e-5 relative
+  (measured 2.0e-7 over 30 steps once the reference arm applies its
+  wd_mult groups) — the "loss-curve-matched to the reference" north
+  star, executed sample-for-sample on CPU.
+
+Requires /root/reference; skipped where the reference tree is absent.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+REF = "/root/reference"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(not os.path.isdir(os.path.join(REF, "megatron")),
+                       reason="reference tree not present"),
+]
+
+ARCH = dict(num_layers=4, hidden_size=64, num_attention_heads=4,
+            num_kv=2, ffn=176, vocab=128, seq=64)
+
+
+def _our_cfg():
+    from megatron_tpu.config import ModelConfig
+    return ModelConfig(
+        num_layers=ARCH["num_layers"], hidden_size=ARCH["hidden_size"],
+        num_attention_heads=ARCH["num_attention_heads"],
+        num_kv_heads=ARCH["num_kv"], ffn_hidden_size=ARCH["ffn"],
+        vocab_size=ARCH["vocab"], make_vocab_size_divisible_by=1,
+        seq_length=ARCH["seq"], compute_dtype="float32",
+        params_dtype="float32").derived()
+
+
+def _export(tmp_path, cfg):
+    from megatron_tpu.convert.megatron import save_megatron_checkpoint
+    from megatron_tpu.models import language_model as lm
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    ckpt = str(tmp_path / "ckpt")
+    save_megatron_checkpoint(ckpt, params, cfg)
+    return params, ckpt
+
+
+def _run_reference(ckpt, tokens_path, out, extra=()):
+    cmd = [sys.executable,
+           os.path.join(REPO, "tools", "reference_forward_cpu.py"),
+           "--ref_path", REF, "--load", ckpt, "--tokens", tokens_path,
+           "--out", out] + [
+        f"--{k}={v}" for k, v in ARCH.items()] + list(extra)
+    # an OS-assigned free port: pid-derived constants collide across
+    # parallel pytest processes
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ, MASTER_PORT=str(port))
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=560,
+                       env=env)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+def test_reference_forward_matches(tmp_path):
+    from megatron_tpu.models import language_model as lm
+    cfg = _our_cfg()
+    params, ckpt = _export(tmp_path, cfg)
+    tokens = np.random.default_rng(3).integers(
+        0, cfg.vocab_size, (2, ARCH["seq"])).astype(np.int32)
+    tpath = str(tmp_path / "tokens.npy")
+    np.save(tpath, tokens)
+    out = str(tmp_path / "ref.npz")
+    _run_reference(ckpt, tpath, out)
+    ref = np.load(out)["logits"]
+    logits, _ = lm.model_forward(params, jnp.asarray(tokens), cfg,
+                                 logits_dtype=jnp.float32)
+    ours = np.asarray(logits)[..., :cfg.vocab_size]
+    gap = np.abs(ours - ref).max(-1).mean()
+    assert gap <= 1e-5, gap
+
+
+def test_reference_training_curve_matches(tmp_path):
+    from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
+                                     ParallelConfig, TrainingConfig)
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.training import make_train_step
+    from megatron_tpu.training.train_step import state_from_params
+
+    N, b = 12, 2
+    cfg_m = _our_cfg()
+    params, ckpt = _export(tmp_path, cfg_m)
+    blocks = np.random.default_rng(9).integers(
+        0, cfg_m.vocab_size, (N, b, ARCH["seq"] + 1)).astype(np.int32)
+    tpath = str(tmp_path / "blocks.npy")
+    np.save(tpath, blocks)
+    out = str(tmp_path / "ref_train.npz")
+    _run_reference(ckpt, tpath, out, extra=[f"--train={N}"])
+    ref = np.load(out)["losses"]
+
+    cfg = MegatronConfig(
+        model=cfg_m, parallel=ParallelConfig(),
+        optimizer=OptimizerConfig(lr=1e-3, lr_decay_style="constant",
+                                  weight_decay=0.01, clip_grad=1.0),
+        training=TrainingConfig(micro_batch_size=b, global_batch_size=b,
+                                train_iters=N),
+    ).validate(n_devices=1)
+    state = state_from_params(jax.tree.map(jnp.asarray, params), cfg)
+    mesh = build_mesh(cfg.parallel, devices=jax.devices()[:1])
+    step = make_train_step(cfg, mesh=mesh, donate=False)
+    ours = []
+    for i in range(N):
+        batch = {"tokens": jnp.asarray(blocks[i][None]),
+                 "loss_mask": jnp.ones((1, b, ARCH["seq"]), jnp.float32)}
+        state, m = step(state, batch, jax.random.PRNGKey(0))
+        ours.append(float(m["lm_loss"]))
+    rel = np.abs(np.asarray(ours) - ref) / ref
+    assert rel.max() <= 1e-5, (rel.max(), list(zip(ours, ref)))

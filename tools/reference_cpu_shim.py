@@ -1,0 +1,170 @@
+"""CPU-execution shims for the reference Megatron codebase.
+
+The reference hard-imports CUDA-only packages (apex, amp_C, flash_attn)
+and calls .cuda()/torch.cuda.* throughout. These shims install
+numerically-equivalent torch-CPU stand-ins BEFORE `import megatron`, so
+the reference's own model/loader/training code runs on this machine —
+the missing half of the cross-implementation gate (VERDICT r4 #3
+stretch: run the reference itself on CPU against the same data).
+
+Equivalences used (each checked against the apex source semantics):
+- apex.optimizers.FusedAdam(adam_w_mode=True default) == torch.optim
+  .AdamW with the same (lr, betas, eps, weight_decay); FusedSGD == SGD.
+- amp_C.multi_tensor_l2norm == global l2 over the tensor list;
+  multi_tensor_scale == elementwise copy-with-scale.
+- flash_attn is stubbed to raise (runs must use --no flash attn paths).
+- torch.cuda RNG entry points map to the CPU generator so
+  tensor_parallel/random.py's fork/restore machinery still functions.
+
+Import and call install() before any `import megatron`.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+import torch
+
+
+def _mk(name):
+    m = types.ModuleType(name)
+    sys.modules[name] = m
+    return m
+
+
+_INSTALLED = False
+
+
+def install():
+    # sentinel, NOT "apex in sys.modules": a real apex on the machine
+    # must not silently skip the torch.cuda patches below
+    global _INSTALLED
+    if _INSTALLED:
+        return
+    _INSTALLED = True
+
+    # torch>=2.6 defaults torch.load(weights_only=True), which rejects
+    # the argparse.Namespace embedded in megatron checkpoints; these are
+    # locally-produced trusted files
+    import argparse
+    torch.serialization.add_safe_globals([argparse.Namespace])
+
+    # --- apex ---------------------------------------------------------
+    apex = _mk("apex")
+    mta = _mk("apex.multi_tensor_apply")
+
+    class _Applier:
+        available = True
+
+        def __call__(self, op, noop_flag, tensor_lists, *args):
+            return op(noop_flag, tensor_lists, *args)
+
+    mta.multi_tensor_applier = _Applier()
+    apex.multi_tensor_apply = mta
+
+    opt = _mk("apex.optimizers")
+
+    class FusedAdam(torch.optim.AdamW):
+        def __init__(self, params, lr=1e-3, bias_correction=True,
+                     betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
+                     weight_decay=0.0, amsgrad=False, **kw):
+            assert adam_w_mode, "shim maps FusedAdam -> AdamW"
+            super().__init__(params, lr=lr, betas=betas, eps=eps,
+                             weight_decay=weight_decay, amsgrad=amsgrad)
+
+    class FusedSGD(torch.optim.SGD):
+        def __init__(self, params, lr=1e-3, momentum=0.0, dampening=0,
+                     weight_decay=0.0, nesterov=False, **kw):
+            super().__init__(params, lr=lr, momentum=momentum,
+                             dampening=dampening,
+                             weight_decay=weight_decay, nesterov=nesterov)
+
+    opt.FusedAdam = FusedAdam
+    opt.FusedSGD = FusedSGD
+    apex.optimizers = opt
+
+    # fused_layer_norm tries apex.contrib + fused cuda modules; give it
+    # empty shells so its `except ImportError` fallbacks engage
+    _mk("apex.contrib")
+
+    # --- amp_C --------------------------------------------------------
+    amp_C = _mk("amp_C")
+
+    def multi_tensor_l2norm(noop_flag, tensor_lists, per_tensor=False):
+        (tensors,) = tensor_lists
+        if not tensors:
+            z = torch.zeros(1)
+            return z, z
+        norm = torch.norm(
+            torch.stack([t.detach().float().norm(2) for t in tensors]), 2)
+        return norm.reshape(1), None
+
+    def multi_tensor_scale(noop_flag, tensor_lists, scale):
+        src, dst = tensor_lists
+        for s, d in zip(src, dst):
+            d.copy_(s, non_blocking=False)
+            d.mul_(scale)
+
+    amp_C.multi_tensor_l2norm = multi_tensor_l2norm
+    amp_C.multi_tensor_scale = multi_tensor_scale
+
+    # --- flash_attn (import-time only; CPU runs keep it disabled) -----
+    fa = _mk("flash_attn")
+
+    def _no_flash(*a, **k):
+        raise RuntimeError("flash_attn shim: run with use_flash_attn off")
+
+    fa.flash_attn_func = _no_flash
+    _mk("flash_attn.flash_attn_interface").flash_attn_func = _no_flash
+
+    # --- torch.cuda on CPU --------------------------------------------
+    # moves become no-ops; RNG maps to the CPU generator so the
+    # tensor-parallel rng tracker forks/restores real state
+    torch.Tensor.cuda = lambda self, *a, **k: self
+    torch.nn.Module.cuda = lambda self, *a, **k: self
+    # megatron asserts tensor.type() == 'torch.cuda.FloatTensor'
+    # (clip_grads.py:50); report the cuda spelling for no-arg calls
+    _orig_type = torch.Tensor.type
+
+    def _type(self, dtype=None, **kw):
+        if dtype is None:
+            return _orig_type(self).replace("torch.", "torch.cuda.", 1)
+        return _orig_type(self, dtype, **kw)
+
+    torch.Tensor.type = _type
+    tc = torch.cuda
+    # True: initialize_megatron asserts CUDA; every actual device
+    # operation is a no-op'd move or a CPU-RNG mapping below
+    tc.is_available = lambda: True
+    # "cpu" (not 0): megatron passes current_device() straight into
+    # device= kwargs, and device 0 would resolve to the absent cuda:0
+    tc.current_device = lambda: "cpu"
+    tc.set_device = lambda *a, **k: None
+    tc.device_count = lambda: 1
+    tc.synchronize = lambda *a, **k: None
+    tc.empty_cache = lambda: None
+    tc.get_rng_state = lambda *a, **k: torch.get_rng_state()
+    tc.set_rng_state = lambda s, *a, **k: torch.set_rng_state(s)
+    tc.manual_seed = lambda s: None
+    tc.memory_allocated = lambda *a, **k: 0
+    tc.max_memory_allocated = lambda *a, **k: 0
+    tc.reset_peak_memory_stats = lambda *a, **k: None
+    tc.memory_reserved = lambda *a, **k: 0
+    tc.max_memory_reserved = lambda *a, **k: 0
+    # real torch.Tensor SUBCLASSES (not lambdas): megatron builds
+    # isinstance tuples from these (model/module.py _FLOAT_TYPES), and
+    # isinstance() needs types — a lambda would raise TypeError there.
+    # Calling them constructs CPU tensors of the right dtype.
+    def _cpu_tensor_type(name, dtype):
+        def _new(cls, *a, **k):
+            if a and all(isinstance(x, int) for x in a):
+                return torch.zeros(a, dtype=dtype)
+            return torch.tensor(a[0] if a else [], dtype=dtype)
+        return type(name, (torch.Tensor,), {"__new__": _new})
+
+    tc.DoubleTensor = _cpu_tensor_type("DoubleTensor", torch.float64)
+    tc.FloatTensor = _cpu_tensor_type("FloatTensor", torch.float32)
+    tc.HalfTensor = _cpu_tensor_type("HalfTensor", torch.float16)
+    tc.BFloat16Tensor = _cpu_tensor_type("BFloat16Tensor", torch.bfloat16)
+    tc.LongTensor = _cpu_tensor_type("LongTensor", torch.int64)
+    tc.IntTensor = _cpu_tensor_type("IntTensor", torch.int32)

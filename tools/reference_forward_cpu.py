@@ -1,0 +1,184 @@
+"""Run the REFERENCE's GPT/Llama model on CPU over one batch and dump
+logits — the executable half of the cross-implementation gate.
+
+Loads a megatron-layout checkpoint (e.g. one written by OUR
+convert/megatron.save_megatron_checkpoint), builds the reference's own
+LlamaModel via its own initialize/arguments/checkpointing machinery
+(under tools/reference_cpu_shim), and writes fp32 logits for the given
+tokens. The companion test (tests/test_reference_cpu.py) compares them
+against megatron_tpu's forward on the same weights — OUR exporter +
+THEIR loader + THEIR model vs OUR model, end to end, no network.
+
+  python tools/reference_forward_cpu.py --ref_path /root/reference \
+      --load <ckpt dir> --tokens tokens.npy --out logits.npz \
+      --num_layers 4 --hidden_size 64 --num_attention_heads 4 \
+      --num_kv 2 --ffn 176 --vocab 128 --seq 64
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("reference_forward_cpu")
+    p.add_argument("--ref_path", default="/root/reference")
+    p.add_argument("--load", required=True)
+    p.add_argument("--tokens", required=True)  # .npy int32 [b, s]
+    p.add_argument("--out", required=True)
+    p.add_argument("--num_layers", type=int, required=True)
+    p.add_argument("--hidden_size", type=int, required=True)
+    p.add_argument("--num_attention_heads", type=int, required=True)
+    p.add_argument("--num_kv", type=int, required=True)
+    p.add_argument("--ffn", type=int, required=True)
+    p.add_argument("--vocab", type=int, required=True)
+    p.add_argument("--seq", type=int, required=True)
+    # --train N: instead of one forward, run N full training steps
+    # (their model fwd/bwd + their FP32Optimizer: clip -> adamw) on
+    # batches from --tokens shaped [N, b, s+1]; dump per-step losses.
+    p.add_argument("--train", type=int, default=0)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--weight_decay", type=float, default=0.01)
+    p.add_argument("--clip_grad", type=float, default=1.0)
+    args = p.parse_args(argv)
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import reference_cpu_shim
+    reference_cpu_shim.install()
+    sys.path.insert(0, args.ref_path)
+
+    import numpy as np
+    import torch
+
+    # single-process gloo "distributed" run
+    os.environ.setdefault("MASTER_ADDR", "127.0.0.1")
+    os.environ.setdefault("MASTER_PORT", "29511")
+    os.environ["WORLD_SIZE"] = "1"
+    os.environ["RANK"] = "0"
+    os.environ["LOCAL_RANK"] = "0"
+
+    sys.argv = [
+        "reference_forward_cpu",
+        "--num_layers", str(args.num_layers),
+        "--hidden_size", str(args.hidden_size),
+        "--num_attention_heads", str(args.num_attention_heads),
+        "--num_attention_heads_kv", str(args.num_kv),
+        "--ffn_hidden_size", str(args.ffn),
+        "--seq_length", str(args.seq),
+        "--max_position_embeddings", str(args.seq),
+        "--micro_batch_size", "2",
+        "--global_batch_size", "2",
+        "--load", args.load,
+        "--no_load_optim", "--no_load_rng", "--finetune",
+        "--distributed_backend", "gloo",
+        # NOT --use_cpu_initialization: the reference's cpu-init path has
+        # a latent bug (language_model.py:452 calls
+        # _initialize_affine_weight_cpu without init_method); the normal
+        # path works because the shim maps its cuda RNG onto the CPU
+        # generator
+        "--no_masked_softmax_fusion",
+        "--no_bias_gelu_fusion", "--no_bias_dropout_fusion",
+        "--position_embedding_type", "rotary",
+        "--use_rms_norm", "--glu_activation", "swiglu",
+        "--no_tie_embed_logits",
+        "--layernorm_epsilon", "1e-5",
+        "--hidden_dropout", "0.0", "--attention_dropout", "0.0",
+        "--make_vocab_size_divisible_by", "1",
+        "--no_gradient_accumulation_fusion",
+        # torch DDP impl: params_have_main_grad=False, so the manual
+        # training loop below works on a bare (unwrapped) module
+        "--DDP_impl", "torch",
+        "--optimizer", "adam",
+        "--lr", str(args.lr),
+        "--lr_decay_style", "constant",
+        "--weight_decay", str(args.weight_decay),
+        "--clip_grad", str(args.clip_grad),
+        "--adam_beta1", "0.9", "--adam_beta2", "0.999",
+        "--adam_eps", "1e-8",
+    ]
+
+    from megatron import get_args, initialize
+    from megatron.model.llama_model import LlamaModel
+    from megatron.model.enums import ModelType
+    from megatron import checkpointing
+    from megatron.utils import get_ltor_masks_and_position_ids
+
+    # no vocab_file + a non-listed tokenizer type -> set_global_variables
+    # skips tokenizer construction entirely; padded_vocab_size (normally
+    # tokenizer-derived) is injected below before the model builds
+    initialize.initialize_megatron(extra_args_provider=None,
+                                   args_defaults={})
+    margs = get_args()
+    margs.padded_vocab_size = args.vocab
+    margs.model_type = ModelType.encoder_or_decoder
+
+    torch.manual_seed(margs.seed)
+    model = LlamaModel(num_tokentypes=0, parallel_output=False,
+                       pre_process=True, post_process=True,
+                       model_type=ModelType.encoder_or_decoder)
+    model = model.float().eval()
+
+    it = checkpointing.load_checkpoint([model], None, None)
+    print(f"loaded checkpoint at iteration {it}")
+
+    if args.train:
+        return _train(args, margs, model)
+
+    tokens = torch.tensor(np.load(args.tokens).astype(np.int64))
+    attn_mask, _, pos = get_ltor_masks_and_position_ids(
+        tokens, margs.padded_vocab_size - 1, False, False, False)
+    with torch.no_grad():
+        logits = model(tokens, pos, attn_mask).float().numpy()
+    np.savez_compressed(args.out, logits=logits)
+    print(f"wrote {args.out} logits {logits.shape}")
+    return 0
+
+
+def _train(args, margs, model):
+    """N steps of the reference's own training semantics: model fwd/bwd,
+    FP32Optimizer (l2 clip -> FusedAdam==AdamW via the shim), constant
+    lr — per-step masked-mean losses to --out."""
+    import numpy as np
+    import torch
+
+    from megatron import get_timers
+    from megatron.optimizer import get_megatron_optimizer
+    from megatron.utils import get_ltor_masks_and_position_ids
+
+    blocks = np.load(args.tokens).astype(np.int64)  # [N, b, s+1]
+    assert blocks.ndim == 3 and blocks.shape[0] >= args.train
+    optimizer = get_megatron_optimizer([model])
+    # get_param_groups tags no-wd groups (biases, 1-D params) with
+    # wd_mult=0.0 but the per-group weight_decay is normally applied by
+    # OptimizerParamScheduler (optimizer_param_scheduler.py:127); this
+    # loop has no scheduler, so apply the multiplier here or AdamW would
+    # decay norm scales the real reference exempts
+    for g in optimizer.optimizer.param_groups:
+        g["weight_decay"] = margs.weight_decay * g.get("wd_mult", 1.0)
+    timers = get_timers()
+    model.train()
+    losses, grad_norms = [], []
+    for i in range(args.train):
+        blk = torch.tensor(blocks[i])
+        tokens, labels = blk[:, :-1].contiguous(), blk[:, 1:].contiguous()
+        attn_mask, _, pos = get_ltor_masks_and_position_ids(
+            tokens, margs.padded_vocab_size - 1, False, False, False)
+        optimizer.zero_grad()
+        per_tok = model(tokens, pos, attn_mask, labels=labels)
+        loss = per_tok.float().mean()
+        loss.backward()
+        ok, gnorm, _ = optimizer.step(margs, timers)
+        assert ok
+        losses.append(float(loss))
+        grad_norms.append(float(gnorm) if gnorm is not None else 0.0)
+        print(f"step {i}: loss {losses[-1]:.6f} grad_norm "
+              f"{grad_norms[-1]:.4f}", flush=True)
+    np.savez_compressed(args.out, losses=np.asarray(losses),
+                        grad_norms=np.asarray(grad_norms))
+    print(f"wrote {args.out} ({args.train} steps)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
