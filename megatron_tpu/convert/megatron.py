@@ -180,6 +180,64 @@ def _merge_tp(key: str, parts: list[np.ndarray], glu: bool) -> np.ndarray:
     return parts[0]
 
 
+def _install_enum_stubs():
+    """Make reference-pickled enums loadable WITHOUT the reference tree.
+
+    Checkpoints written by the reference's own save_checkpoint pickle
+    enum members from megatron.model.enums inside the args namespace
+    (validate_args converts position_embedding_type to the enum,
+    ref: megatron/arguments.py:245-246; values ref: model/enums.py).
+    When `megatron` is not importable, install stub modules holding
+    value-identical enums so unpickling reconstructs members whose
+    str() the config mapping below understands. Never shadows a real
+    megatron package."""
+    import enum
+    import importlib.util
+    import sys
+    import types
+
+    if importlib.util.find_spec("megatron") is not None:
+        return []
+    root = types.ModuleType("megatron")
+    model = types.ModuleType("megatron.model")
+    enums = types.ModuleType("megatron.model.enums")
+    for name, members in (
+            ("ModelType", ("encoder_or_decoder", "encoder_and_decoder")),
+            ("LayerType", ("encoder", "decoder")),
+            ("AttnType", ("self_attn", "cross_attn")),
+            ("AttnMaskType", ("padding", "causal")),
+            ("PositionEmbeddingType", ("rotary", "absolute")),
+    ):
+        setattr(enums, name,
+                enum.Enum(name, {m: i + 1 for i, m in enumerate(members)}))
+    root.model = model
+    model.enums = enums
+    names = ["megatron", "megatron.model", "megatron.model.enums"]
+    sys.modules.update(zip(names, (root, model, enums)))
+    return names
+
+
+def _tolerant_torch_load(path: str):
+    import sys
+
+    import torch
+    try:
+        return torch.load(path, map_location="cpu", weights_only=False)
+    except ModuleNotFoundError as e:
+        if "megatron" not in str(e):
+            raise
+        installed = _install_enum_stubs()
+        try:
+            return torch.load(path, map_location="cpu",
+                              weights_only=False)
+        finally:
+            # the stubs exist only for this unpickle — left installed
+            # they would shadow a real megatron tree put on sys.path
+            # later in the process
+            for m in installed:
+                sys.modules.pop(m, None)
+
+
 # ---------------------------------------------------------------------------
 # load + merge
 # ---------------------------------------------------------------------------
@@ -204,8 +262,7 @@ def load_megatron_checkpoint(load_dir: str, iteration=None
 
     # torch.load(weights_only=False): the payload embeds an
     # argparse.Namespace; these files are the user's own checkpoints
-    loaded = {rank: torch.load(path, map_location="cpu",
-                               weights_only=False)
+    loaded = {rank: _tolerant_torch_load(path)
               for rank, path in shards.items()}
     first = loaded[(0, 0)]
     version = float(first.get("checkpoint_version", 0))

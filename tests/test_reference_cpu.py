@@ -98,6 +98,49 @@ def test_reference_forward_matches(tmp_path):
     assert gap <= 1e-5, gap
 
 
+def test_import_of_reference_written_checkpoint(tmp_path):
+    """The GENUINE writer: the reference trains 3 steps and saves via
+    its own save_checkpoint; our importer ingests iter_0000003 (incl.
+    the enum-laden args namespace via the tolerant loader) and our
+    forward matches the reference's forward from the same file."""
+    import dataclasses
+
+    from megatron_tpu.convert.megatron import (config_from_megatron_args,
+                                               load_megatron_checkpoint,
+                                               megatron_to_params)
+    from megatron_tpu.models import language_model as lm
+
+    cfg = _our_cfg()
+    _, ckpt = _export(tmp_path, cfg)
+    blocks = np.random.default_rng(11).integers(
+        0, cfg.vocab_size, (3, 2, ARCH["seq"] + 1)).astype(np.int32)
+    bpath = str(tmp_path / "blocks.npy")
+    np.save(bpath, blocks)
+    refsave = str(tmp_path / "refsaved")
+    _run_reference(ckpt, bpath, str(tmp_path / "losses.npz"),
+                   extra=["--train=3", f"--save_after={refsave}"])
+    sd, ref_args, meta = load_megatron_checkpoint(refsave)
+    assert meta["iteration"] == "3"
+    got_cfg = config_from_megatron_args(ref_args)
+    assert got_cfg.num_layers == cfg.num_layers
+    assert got_cfg.use_rotary_emb and got_cfg.is_glu
+    params = megatron_to_params(sd, got_cfg)
+
+    tokens = np.random.default_rng(3).integers(
+        0, cfg.vocab_size, (2, ARCH["seq"])).astype(np.int32)
+    tpath = str(tmp_path / "tokens.npy")
+    np.save(tpath, tokens)
+    out = str(tmp_path / "ref_fwd.npz")
+    _run_reference(refsave, tpath, out)
+    ref = np.load(out)["logits"]
+    logits, _ = lm.model_forward(
+        jax.tree.map(jnp.asarray, params), jnp.asarray(tokens),
+        dataclasses.replace(got_cfg, compute_dtype="float32"),
+        logits_dtype=jnp.float32)
+    ours = np.asarray(logits)[..., :cfg.vocab_size]
+    assert np.abs(ours - ref).max(-1).mean() <= 1e-5
+
+
 def test_reference_training_curve_matches(tmp_path):
     from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
                                      ParallelConfig, TrainingConfig)

@@ -44,10 +44,16 @@ def install():
     _INSTALLED = True
 
     # torch>=2.6 defaults torch.load(weights_only=True), which rejects
-    # the argparse.Namespace embedded in megatron checkpoints; these are
-    # locally-produced trusted files
-    import argparse
-    torch.serialization.add_safe_globals([argparse.Namespace])
+    # the argparse.Namespace / enums / numpy rng-state the reference
+    # embeds in its checkpoints. Everything loaded under this shim is a
+    # locally-produced trusted file, so default the flag off.
+    _orig_load = torch.load
+
+    def _load(*a, **k):
+        k.setdefault("weights_only", False)
+        return _orig_load(*a, **k)
+
+    torch.load = _load
 
     # --- apex ---------------------------------------------------------
     apex = _mk("apex")

@@ -41,6 +41,9 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--weight_decay", type=float, default=0.01)
     p.add_argument("--clip_grad", type=float, default=1.0)
+    # after --train: have the REFERENCE'S OWN save_checkpoint write its
+    # mp_rank layout here (the real writer — importer tests use it)
+    p.add_argument("--save_after", type=str, default=None)
     args = p.parse_args(argv)
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -103,6 +106,8 @@ def main(argv=None):
     from megatron.model.enums import ModelType
     from megatron import checkpointing
     from megatron.utils import get_ltor_masks_and_position_ids
+    # (enum-laden checkpoint loading works because the shim defaults
+    # torch.load to weights_only=False — no allowlist needed here)
 
     # no vocab_file + a non-listed tokenizer type -> set_global_variables
     # skips tokenizer construction entirely; padded_vocab_size (normally
@@ -174,6 +179,11 @@ def _train(args, margs, model):
         grad_norms.append(float(gnorm) if gnorm is not None else 0.0)
         print(f"step {i}: loss {losses[-1]:.6f} grad_norm "
               f"{grad_norms[-1]:.4f}", flush=True)
+    if args.save_after:
+        from megatron import checkpointing
+        margs.save = args.save_after
+        checkpointing.save_checkpoint(args.train, [model], None, None)
+        print(f"reference save_checkpoint wrote {args.save_after}")
     np.savez_compressed(args.out, losses=np.asarray(losses),
                         grad_norms=np.asarray(grad_norms))
     print(f"wrote {args.out} ({args.train} steps)")
