@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import os
 import re
+import sys
 from typing import Mapping, Optional
 
 import numpy as np
@@ -217,25 +218,20 @@ def _install_enum_stubs():
     return names
 
 
-def _tolerant_torch_load(path: str):
-    import sys
-
+def _tolerant_torch_load(path: str, installed: list):
+    """`installed` accumulates stub module names across calls; the
+    CALLER removes them when the whole checkpoint is loaded (the stubs
+    must not outlive the load — they would shadow a real megatron tree
+    put on sys.path later in the process)."""
     import torch
     try:
         return torch.load(path, map_location="cpu", weights_only=False)
     except ModuleNotFoundError as e:
         if "megatron" not in str(e):
             raise
-        installed = _install_enum_stubs()
-        try:
-            return torch.load(path, map_location="cpu",
-                              weights_only=False)
-        finally:
-            # the stubs exist only for this unpickle — left installed
-            # they would shadow a real megatron tree put on sys.path
-            # later in the process
-            for m in installed:
-                sys.modules.pop(m, None)
+        if not installed:
+            installed.extend(_install_enum_stubs())
+        return torch.load(path, map_location="cpu", weights_only=False)
 
 
 # ---------------------------------------------------------------------------
@@ -261,9 +257,18 @@ def load_megatron_checkpoint(load_dir: str, iteration=None
     pp = 1 + max(p for _, p in shards)
 
     # torch.load(weights_only=False): the payload embeds an
-    # argparse.Namespace; these files are the user's own checkpoints
-    loaded = {rank: _tolerant_torch_load(path)
-              for rank, path in shards.items()}
+    # argparse.Namespace; these files are the user's own checkpoints.
+    # Stub installation state is carried across shards so a 32-shard
+    # enum-bearing checkpoint pays at most ONE failed load, not one per
+    # shard.
+    loaded = {}
+    installed: list = []
+    try:
+        for rank, path in shards.items():
+            loaded[rank] = _tolerant_torch_load(path, installed)
+    finally:
+        for m in installed:
+            sys.modules.pop(m, None)
     first = loaded[(0, 0)]
     version = float(first.get("checkpoint_version", 0))
     args_ns = first.get("args")
@@ -535,11 +540,41 @@ def params_to_megatron(params, cfg: ModelConfig, dtype=np.float32) -> dict:
             t["input_norm"]["scale"][i], dtype)
         enc[p + "post_attention_layernorm.weight"] = np.asarray(
             t["post_attn_norm"]["scale"][i], dtype)
+        if cfg.norm_type == "layernorm":
+            enc[p + "input_layernorm.bias"] = np.asarray(
+                t["input_norm"]["bias"][i], dtype)
+            enc[p + "post_attention_layernorm.bias"] = np.asarray(
+                t["post_attn_norm"]["bias"][i], dtype)
+        if cfg.use_bias:
+            bq = np.asarray(t["attention"]["bq"][i], dtype)
+            bkv = np.asarray(t["attention"]["bkv"][i], dtype)
+            bk, bv = bkv[:nkv * hd], bkv[nkv * hd:]
+            bgroups = []
+            for g in range(nkv):
+                bgroups.append(bq[g * per * hd:(g + 1) * per * hd])
+                bgroups.append(bk[g * hd:(g + 1) * hd])
+                bgroups.append(bv[g * hd:(g + 1) * hd])
+            enc[p + "attention.query_key_value.bias"] = \
+                np.concatenate(bgroups)
+            enc[p + "attention.dense.bias"] = np.asarray(
+                t["attention"]["bo"][i], dtype)
+            b1 = np.asarray(t["mlp"]["b1"][i], dtype)
+            enc[p + "mlp.dense_h_to_4h.bias"] = (
+                np.concatenate([b1[1], b1[0]])  # (gate, up) -> [up; gate]
+                if cfg.is_glu else b1)
+            enc[p + "mlp.dense_4h_to_h.bias"] = np.asarray(
+                t["mlp"]["b2"][i], dtype)
     enc["final_layernorm.weight"] = np.asarray(
         params["final_norm"]["scale"], dtype)
-    lm = {"embedding": {"word_embeddings.weight": np.asarray(
-              params["embedding"]["word_embeddings"], dtype)},
-          "transformer": enc}
+    if cfg.norm_type == "layernorm":
+        enc["final_layernorm.bias"] = np.asarray(
+            params["final_norm"]["bias"], dtype)
+    emb = {"word_embeddings.weight": np.asarray(
+        params["embedding"]["word_embeddings"], dtype)}
+    if cfg.use_position_embedding:
+        emb["position_embeddings.weight"] = np.asarray(
+            params["embedding"]["position_embeddings"], dtype)
+    lm = {"embedding": emb, "transformer": enc}
     if not cfg.tie_embed_logits:
         lm["lm_head"] = _t(np.asarray(params["lm_head"], dtype))
     return lm

@@ -98,6 +98,38 @@ def test_reference_forward_matches(tmp_path):
     assert gap <= 1e-5, gap
 
 
+def test_reference_forward_matches_gpt_family(tmp_path):
+    """GPT-class coverage of the same gate: learned absolute positions +
+    LayerNorm (with biases) + erf-gelu + linear biases + TIED
+    embeddings, exported by us, loaded and run by the reference's
+    GPTModel."""
+    from megatron_tpu.config import ModelConfig
+    from megatron_tpu.models import language_model as lm
+
+    cfg = ModelConfig(
+        num_layers=ARCH["num_layers"], hidden_size=ARCH["hidden_size"],
+        num_attention_heads=ARCH["num_attention_heads"],
+        num_kv_heads=ARCH["num_kv"], ffn_hidden_size=ARCH["ffn"],
+        vocab_size=ARCH["vocab"], make_vocab_size_divisible_by=1,
+        seq_length=ARCH["seq"], use_rotary_emb=False,
+        use_position_embedding=True, norm_type="layernorm",
+        activation="gelu", use_bias=True, tie_embed_logits=True,
+        compute_dtype="float32", params_dtype="float32").derived()
+    params, ckpt = _export(tmp_path, cfg)
+    tokens = np.random.default_rng(5).integers(
+        0, cfg.vocab_size, (2, ARCH["seq"])).astype(np.int32)
+    tpath = str(tmp_path / "tokens.npy")
+    np.save(tpath, tokens)
+    out = str(tmp_path / "ref.npz")
+    _run_reference(ckpt, tpath, out, extra=["--family=gpt"])
+    ref = np.load(out)["logits"]
+    logits, _ = lm.model_forward(params, jnp.asarray(tokens), cfg,
+                                 logits_dtype=jnp.float32)
+    ours = np.asarray(logits)[..., :cfg.vocab_size]
+    gap = np.abs(ours - ref).max(-1).mean()
+    assert gap <= 1e-5, gap
+
+
 def test_import_of_reference_written_checkpoint(tmp_path):
     """The GENUINE writer: the reference trains 3 steps and saves via
     its own save_checkpoint; our importer ingests iter_0000003 (incl.

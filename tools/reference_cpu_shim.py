@@ -114,6 +114,47 @@ def install():
     amp_C.multi_tensor_l2norm = multi_tensor_l2norm
     amp_C.multi_tensor_scale = multi_tensor_scale
 
+    # --- fused_layer_norm_cuda (apex LN extension) --------------------
+    # MixedFusedLayerNorm unconditionally calls these two
+    # (ref: megatron/model/fused_layer_norm.py:36,56); plain-torch LN
+    # math with the same (output, mean, invvar) contract
+    fln = _mk("fused_layer_norm_cuda")
+
+    def _ln_stats(input_, shape, eps):
+        dims = tuple(range(input_.dim() - len(shape), input_.dim()))
+        x = input_.float()
+        mean = x.mean(dims, keepdim=True)
+        var = x.var(dims, unbiased=False, keepdim=True)
+        invvar = torch.rsqrt(var + eps)
+        return x, mean, invvar, dims
+
+    def forward_affine(input_, normalized_shape, weight, bias, eps):
+        x, mean, invvar, _ = _ln_stats(input_, normalized_shape, eps)
+        out = (x - mean) * invvar * weight.float() + bias.float()
+        return out.to(input_.dtype), mean, invvar
+
+    def backward_affine(grad_out, mean, invvar, input_, normalized_shape,
+                        weight, bias, eps):
+        x = input_.float()
+        g = grad_out.float()
+        dims = tuple(range(input_.dim() - len(normalized_shape),
+                           input_.dim()))
+        n = 1
+        for d in dims:
+            n *= input_.shape[d]
+        xhat = (x - mean) * invvar
+        gw = g * weight.float()
+        dx = (invvar / n) * (n * gw - gw.sum(dims, keepdim=True)
+                             - xhat * (gw * xhat).sum(dims, keepdim=True))
+        outer = tuple(range(input_.dim() - len(normalized_shape)))
+        dweight = (g * xhat).sum(outer)
+        dbias = g.sum(outer)
+        return (dx.to(input_.dtype), dweight.to(weight.dtype),
+                dbias.to(bias.dtype))
+
+    fln.forward_affine = forward_affine
+    fln.backward_affine = backward_affine
+
     # --- flash_attn (import-time only; CPU runs keep it disabled) -----
     fa = _mk("flash_attn")
 

@@ -34,6 +34,10 @@ def main(argv=None):
     p.add_argument("--ffn", type=int, required=True)
     p.add_argument("--vocab", type=int, required=True)
     p.add_argument("--seq", type=int, required=True)
+    # llama: rotary + rmsnorm + swiglu + untied head, no biases
+    # gpt: learned absolute positions + layernorm + erf-gelu + biases +
+    #      tied embeddings (the reference's GPTModel defaults)
+    p.add_argument("--family", default="llama", choices=["llama", "gpt"])
     # --train N: instead of one forward, run N full training steps
     # (their model fwd/bwd + their FP32Optimizer: clip -> adamw) on
     # batches from --tokens shaped [N, b, s+1]; dump per-step losses.
@@ -45,6 +49,9 @@ def main(argv=None):
     # mp_rank layout here (the real writer — importer tests use it)
     p.add_argument("--save_after", type=str, default=None)
     args = p.parse_args(argv)
+    if args.save_after and not args.train:
+        p.error("--save_after requires --train N (only the training "
+                "path saves)")
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import reference_cpu_shim
@@ -82,9 +89,6 @@ def main(argv=None):
         # generator
         "--no_masked_softmax_fusion",
         "--no_bias_gelu_fusion", "--no_bias_dropout_fusion",
-        "--position_embedding_type", "rotary",
-        "--use_rms_norm", "--glu_activation", "swiglu",
-        "--no_tie_embed_logits",
         "--layernorm_epsilon", "1e-5",
         "--hidden_dropout", "0.0", "--attention_dropout", "0.0",
         "--make_vocab_size_divisible_by", "1",
@@ -99,9 +103,14 @@ def main(argv=None):
         "--clip_grad", str(args.clip_grad),
         "--adam_beta1", "0.9", "--adam_beta2", "0.999",
         "--adam_eps", "1e-8",
-    ]
+    ] + {
+        "llama": ["--position_embedding_type", "rotary", "--use_rms_norm",
+                  "--glu_activation", "swiglu", "--no_tie_embed_logits"],
+        "gpt": ["--position_embedding_type", "absolute", "--use_bias"],
+    }[args.family]
 
     from megatron import get_args, initialize
+    from megatron.model import GPTModel
     from megatron.model.llama_model import LlamaModel
     from megatron.model.enums import ModelType
     from megatron import checkpointing
@@ -119,9 +128,10 @@ def main(argv=None):
     margs.model_type = ModelType.encoder_or_decoder
 
     torch.manual_seed(margs.seed)
-    model = LlamaModel(num_tokentypes=0, parallel_output=False,
-                       pre_process=True, post_process=True,
-                       model_type=ModelType.encoder_or_decoder)
+    cls = LlamaModel if args.family == "llama" else GPTModel
+    model = cls(num_tokentypes=0, parallel_output=False,
+                pre_process=True, post_process=True,
+                model_type=ModelType.encoder_or_decoder)
     model = model.float().eval()
 
     it = checkpointing.load_checkpoint([model], None, None)
