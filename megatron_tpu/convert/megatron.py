@@ -387,6 +387,18 @@ def _ungroup_qkv(qkv: np.ndarray, nq: int, nkv: int, hd: int
             v.reshape((nkv * hd,) + qkv.shape[1:]))
 
 
+def _group_qkv(q: np.ndarray, k: np.ndarray, v: np.ndarray, nkv: int,
+               per: int, hd: int) -> np.ndarray:
+    """Sequential-head (q, k, v) rows -> the grouped [(q..q,k,v) x nkv]
+    layout — the inverse of _ungroup_qkv; serves weights AND biases."""
+    groups = []
+    for g in range(nkv):
+        groups.append(q[g * per * hd:(g + 1) * per * hd])
+        groups.append(k[g * hd:(g + 1) * hd])
+        groups.append(v[g * hd:(g + 1) * hd])
+    return np.concatenate(groups)
+
+
 def megatron_to_params(sd: Mapping[str, np.ndarray], cfg: ModelConfig,
                        dtype=np.float32) -> dict:
     """Merged reference sd (from load_megatron_checkpoint) -> our stacked
@@ -520,12 +532,8 @@ def params_to_megatron(params, cfg: ModelConfig, dtype=np.float32) -> dict:
         wkv = np.asarray(t["attention"]["wkv"][i], dtype)
         wk = _t(wkv[:, :nkv * hd])
         wv = _t(wkv[:, nkv * hd:])
-        groups = []
-        for g in range(nkv):
-            groups.append(wq[g * per * hd:(g + 1) * per * hd])
-            groups.append(wk[g * hd:(g + 1) * hd])
-            groups.append(wv[g * hd:(g + 1) * hd])
-        enc[p + "attention.query_key_value.weight"] = np.concatenate(groups)
+        enc[p + "attention.query_key_value.weight"] = _group_qkv(
+            wq, wk, wv, nkv, per, hd)
         enc[p + "attention.dense.weight"] = _t(
             np.asarray(t["attention"]["wo"][i], dtype))
         w1 = np.asarray(t["mlp"]["w1"][i], dtype)
@@ -549,13 +557,8 @@ def params_to_megatron(params, cfg: ModelConfig, dtype=np.float32) -> dict:
             bq = np.asarray(t["attention"]["bq"][i], dtype)
             bkv = np.asarray(t["attention"]["bkv"][i], dtype)
             bk, bv = bkv[:nkv * hd], bkv[nkv * hd:]
-            bgroups = []
-            for g in range(nkv):
-                bgroups.append(bq[g * per * hd:(g + 1) * per * hd])
-                bgroups.append(bk[g * hd:(g + 1) * hd])
-                bgroups.append(bv[g * hd:(g + 1) * hd])
-            enc[p + "attention.query_key_value.bias"] = \
-                np.concatenate(bgroups)
+            enc[p + "attention.query_key_value.bias"] = _group_qkv(
+                bq, bk, bv, nkv, per, hd)
             enc[p + "attention.dense.bias"] = np.asarray(
                 t["attention"]["bo"][i], dtype)
             b1 = np.asarray(t["mlp"]["b1"][i], dtype)

@@ -386,16 +386,35 @@ class TestCLI:
 
 
 class TestRoundtrip:
-    def test_save_then_load_bitexact(self, tmp_path):
+    @pytest.mark.parametrize("variant", ["llama", "biased-glu", "gpt"])
+    def test_save_then_load_bitexact(self, tmp_path, variant):
         """Our exporter's release checkpoint reimports to the identical
-        param tree (and its args namespace rebuilds the config)."""
+        param tree (and its args namespace rebuilds the config). The
+        biased-glu variant pins the [up; gate] bias split/merge pair
+        (neither the llama nor gpt arms exercise GLU *with* biases);
+        gpt pins layernorm biases + position embeddings + tied head."""
         from megatron_tpu.config import ModelConfig
+        extra = {
+            "llama": {},
+            "biased-glu": dict(use_bias=True),
+            "gpt": dict(use_bias=True, use_rotary_emb=False,
+                        use_position_embedding=True,
+                        norm_type="layernorm", activation="gelu",
+                        tie_embed_logits=True),
+        }[variant]
         cfg = ModelConfig(num_layers=3, hidden_size=64,
                           num_attention_heads=4, num_kv_heads=2,
                           ffn_hidden_size=176, vocab_size=128,
                           make_vocab_size_divisible_by=1, seq_length=64,
-                          compute_dtype="float32").derived()
+                          compute_dtype="float32", **extra).derived()
         params = lm.model_init(jax.random.PRNGKey(0), cfg)
+        # biases init to zeros — a gate/up bias swap would roundtrip
+        # zeros unnoticed; randomize every leaf so layout bugs can't hide
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        keys = jax.random.split(jax.random.PRNGKey(1), len(leaves))
+        params = jax.tree_util.tree_unflatten(
+            treedef, [jax.random.normal(k, l.shape, l.dtype)
+                      for k, l in zip(keys, leaves)])
         save_megatron_checkpoint(str(tmp_path), params, cfg)
         sd, args, _ = load_megatron_checkpoint(str(tmp_path))
         got = megatron_to_params(sd, cfg)

@@ -98,15 +98,11 @@ def test_reference_forward_matches(tmp_path):
     assert gap <= 1e-5, gap
 
 
-def test_reference_forward_matches_gpt_family(tmp_path):
-    """GPT-class coverage of the same gate: learned absolute positions +
-    LayerNorm (with biases) + erf-gelu + linear biases + TIED
-    embeddings, exported by us, loaded and run by the reference's
-    GPTModel."""
+def _gpt_cfg():
+    """GPT-class arch: learned absolute positions + LayerNorm (with
+    biases) + erf-gelu + linear biases + TIED embeddings."""
     from megatron_tpu.config import ModelConfig
-    from megatron_tpu.models import language_model as lm
-
-    cfg = ModelConfig(
+    return ModelConfig(
         num_layers=ARCH["num_layers"], hidden_size=ARCH["hidden_size"],
         num_attention_heads=ARCH["num_attention_heads"],
         num_kv_heads=ARCH["num_kv"], ffn_hidden_size=ARCH["ffn"],
@@ -115,6 +111,14 @@ def test_reference_forward_matches_gpt_family(tmp_path):
         use_position_embedding=True, norm_type="layernorm",
         activation="gelu", use_bias=True, tie_embed_logits=True,
         compute_dtype="float32", params_dtype="float32").derived()
+
+
+def test_reference_forward_matches_gpt_family(tmp_path):
+    """GPT-class coverage of the same gate, exported by us, loaded and
+    run by the reference's GPTModel."""
+    from megatron_tpu.models import language_model as lm
+
+    cfg = _gpt_cfg()
     params, ckpt = _export(tmp_path, cfg)
     tokens = np.random.default_rng(5).integers(
         0, cfg.vocab_size, (2, ARCH["seq"])).astype(np.int32)
@@ -173,7 +177,12 @@ def test_import_of_reference_written_checkpoint(tmp_path):
     assert np.abs(ours - ref).max(-1).mean() <= 1e-5
 
 
-def test_reference_training_curve_matches(tmp_path):
+@pytest.mark.parametrize("family", ["llama", "gpt"])
+def test_reference_training_curve_matches(tmp_path, family):
+    """llama arm: rotary/rmsnorm/swiglu/untied. gpt arm: the biased
+    LayerNorm model with TIED embeddings — its curve match additionally
+    pins bias grads, the (shimmed-apex) LN backward, and the
+    tied-embedding gradient meeting at both ends."""
     from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
                                      ParallelConfig, TrainingConfig)
     from megatron_tpu.parallel.mesh import build_mesh
@@ -181,14 +190,15 @@ def test_reference_training_curve_matches(tmp_path):
     from megatron_tpu.training.train_step import state_from_params
 
     N, b = 12, 2
-    cfg_m = _our_cfg()
+    cfg_m = _our_cfg() if family == "llama" else _gpt_cfg()
     params, ckpt = _export(tmp_path, cfg_m)
     blocks = np.random.default_rng(9).integers(
         0, cfg_m.vocab_size, (N, b, ARCH["seq"] + 1)).astype(np.int32)
     tpath = str(tmp_path / "blocks.npy")
     np.save(tpath, blocks)
     out = str(tmp_path / "ref_train.npz")
-    _run_reference(ckpt, tpath, out, extra=[f"--train={N}"])
+    _run_reference(ckpt, tpath, out,
+                   extra=[f"--train={N}", f"--family={family}"])
     ref = np.load(out)["losses"]
 
     cfg = MegatronConfig(
