@@ -331,6 +331,11 @@ def _run_extras():
         # the int8-weights arm measures the halved weight stream
         ("bench_decode.py", ["--int8_weights", "--int8_kv"],
          "/tmp/bench_extras_decode.log"),
+        # continuous-batching engine under concurrent load (TTFT
+        # percentiles + aggregate tok/s over the slot grid) — the
+        # serving-side complement to bench_decode's single stream
+        ("serving_bench.py", ["--requests", "32", "--slots", "8"],
+         "/tmp/bench_extras_serving.log"),
         ("bench_32k.py", [], "/tmp/bench_extras_32k.log"),
         # 1F1B bubble curve vs n_micro (VERDICT r4 #7): tick-count
         # analysis on one chip, full fit on a multi-device mesh

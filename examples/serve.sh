@@ -1,14 +1,27 @@
 #!/bin/bash
 # Text-generation REST server + CLI client
 # (ref: examples/run_text_generation_server_345M.sh).
+#
+# The server runs the continuous-batching engine by default
+# (megatron_tpu/serving): NUM_SLOTS concurrent decode slots over a
+# pooled KV cache, bounded admission queue, 429 backpressure.
+# SERIAL=1 restores the reference's one-lock serial path.
+# LOAD=1 runs the concurrent-load micro-bench against the live server
+# instead of the interactive CLI (tools/serving_bench.py --url).
 set -e
 CKPT=${CKPT:-ckpts/llama2-7b-ft}
 TOK=${TOK:-meta-llama/Llama-2-7b-hf}
 PORT=${PORT:-5000}
+NUM_SLOTS=${NUM_SLOTS:-8}
+MAX_QUEUE=${MAX_QUEUE:-64}
+
+EXTRA=()
+[ -n "$SERIAL" ] && EXTRA+=(--serial)
 
 python tools/run_text_generation_server.py \
     --load "$CKPT" --tokenizer_type HFTokenizer --tokenizer_model "$TOK" \
-    --port "$PORT" &
+    --port "$PORT" --num_slots "$NUM_SLOTS" --max_queue "$MAX_QUEUE" \
+    "${EXTRA[@]}" &
 SERVER_PID=$!
 trap 'kill $SERVER_PID 2>/dev/null' EXIT
 
@@ -22,4 +35,12 @@ for _ in $(seq 1 120); do
     sleep 5
 done
 
-python tools/text_generation_cli.py "localhost:$PORT"
+if [ -n "$LOAD" ]; then
+    # concurrent-load mode: offered load vs latency/throughput record
+    python tools/serving_bench.py --url "localhost:$PORT" \
+        --requests "${REQUESTS:-32}" --rps "${RPS:-0}" \
+        --new "${NEW_TOKENS:-32}" --out /tmp/serving_bench.log
+    curl -s "http://localhost:$PORT/metrics"; echo
+else
+    python tools/text_generation_cli.py "localhost:$PORT"
+fi

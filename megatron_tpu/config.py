@@ -340,6 +340,53 @@ class DataConfig:
     mmap_warmup: bool = False
 
 
+# serving KV-pool dtypes: the model dtype spellings plus int8 (the
+# quantized pool) — one map feeds BOTH ServingConfig.validate and the
+# engine's resolution (serving/engine.py) so the two can never drift
+SERVING_KV_DTYPES = {**_DTYPES, "int8": jnp.int8}
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    """Continuous-batching engine config (serving/engine.py — ABSENT in
+    the reference, whose server is strictly serial;
+    ref: megatron/text_generation_server.py:37 one-lock serving).
+
+    num_slots: batch slots in the persistent decode grid = max requests
+    decoding concurrently. max_queue: bounded admission queue; overflow
+    is rejected with 429-style backpressure. max_len: per-slot KV region
+    length (prompt + generated; defaults to max_position_embeddings).
+    kv_dtype: pool dtype — "bfloat16" | "float32" | "int8" (quantized
+    pool with per-(token, head) scales), or None to inherit the
+    Generator's kv_cache_dtype. prefill_bucket: prompts pad up to this
+    multiple so the prefill jit cache hits across lengths (rolling
+    sliding-window pools prefill exact-length instead). serial_fallback:
+    route /api through the old one-lock serial path."""
+
+    num_slots: int = 8
+    max_queue: int = 64
+    max_len: Optional[int] = None
+    kv_dtype: Optional[str] = None
+    prefill_bucket: int = 16
+    serial_fallback: bool = False
+
+    def validate(self, model: Optional["ModelConfig"] = None
+                 ) -> "ServingConfig":
+        assert self.num_slots >= 1, self.num_slots
+        assert self.max_queue >= 1, self.max_queue
+        assert self.prefill_bucket >= 1, self.prefill_bucket
+        assert self.kv_dtype is None or \
+            self.kv_dtype in SERVING_KV_DTYPES, self.kv_dtype
+        if self.max_len is not None:
+            assert self.max_len >= 1
+            if model is not None and model.max_position_embeddings:
+                assert self.max_len <= model.max_position_embeddings, (
+                    f"serving max_len={self.max_len} exceeds "
+                    f"max_position_embeddings="
+                    f"{model.max_position_embeddings}")
+        return self
+
+
 @dataclass(frozen=True)
 class MegatronConfig:
     model: ModelConfig = field(default_factory=ModelConfig)
@@ -347,6 +394,7 @@ class MegatronConfig:
     optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
     training: TrainingConfig = field(default_factory=TrainingConfig)
     data: DataConfig = field(default_factory=DataConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
 
     def validate(self, n_devices: Optional[int] = None) -> "MegatronConfig":
         """Derive + consistency-check, mirroring validate_args
@@ -476,6 +524,7 @@ class MegatronConfig:
             assert tr.global_batch_size % (tr.micro_batch_size * par.data_parallel) == 0, (
                 f"global batch {tr.global_batch_size} must be divisible by "
                 f"micro_batch*dp={tr.micro_batch_size * par.data_parallel}")
+        self.serving.validate(model)
         return dataclasses.replace(self, model=model, parallel=par, training=tr)
 
     @property
@@ -497,6 +546,7 @@ class MegatronConfig:
             optimizer=build(OptimizerConfig, d.get("optimizer", {})),
             training=build(TrainingConfig, d.get("training", {})),
             data=build(DataConfig, d.get("data", {})),
+            serving=build(ServingConfig, d.get("serving", {})),
         )
 
 

@@ -25,14 +25,20 @@ def generate_and_post_process(
     add_BOS: bool = False,
     return_output_log_probs: bool = False,
     seed: int = 0,
+    prompt_ids: Optional[Sequence[Sequence[int]]] = None,
 ):
-    """(ref: api.py:19-102). Returns (texts, tokens, logprobs|None)."""
-    prompt_ids = []
-    for p in prompts:
-        ids = tokenizer.tokenize(p)
-        if add_BOS and tokenizer.bos is not None:
-            ids = [tokenizer.bos] + ids
-        prompt_ids.append(ids)
+    """(ref: api.py:19-102). Returns (texts, tokens, logprobs|None).
+
+    `prompt_ids`: pre-tokenized prompts (with BOS already applied) — the
+    server's preflight validation tokenizes anyway, so passing them here
+    avoids tokenizing every prompt twice."""
+    if prompt_ids is None:
+        prompt_ids = []
+        for p in prompts:
+            ids = tokenizer.tokenize(p)
+            if add_BOS and tokenizer.bos is not None:
+                ids = [tokenizer.bos] + ids
+            prompt_ids.append(ids)
     sp = SamplingParams(temperature=temperature, top_k=top_k, top_p=top_p)
     tokens, lengths, logprobs = generator.generate(
         prompt_ids, tokens_to_generate, sampling=sp, seed=seed)
@@ -53,11 +59,16 @@ def beam_search_and_post_process(
     beam_size: int = 4,
     length_penalty: float = 1.0,
     add_BOS: bool = False,
+    prompt_ids: Optional[Sequence[int]] = None,
 ):
-    """(ref: api.py:106-186)."""
-    ids = tokenizer.tokenize(prompt)
-    if add_BOS and tokenizer.bos is not None:
-        ids = [tokenizer.bos] + ids
+    """(ref: api.py:106-186). `prompt_ids`: pre-tokenized prompt (BOS
+    applied) so preflight-validating callers don't tokenize twice."""
+    if prompt_ids is not None:
+        ids = list(prompt_ids)
+    else:
+        ids = tokenizer.tokenize(prompt)
+        if add_BOS and tokenizer.bos is not None:
+            ids = [tokenizer.bos] + ids
     tokens, lengths, scores = beam_search(
         generator, ids, beam_size, tokens_to_generate,
         length_penalty=length_penalty)

@@ -44,9 +44,16 @@ class SamplingParams(NamedTuple):
 
 KV_CACHE_AXES = ("layers", None, None, "kv_heads", None)
 
+# Generator.generate rounds the prefill length DOWN to this multiple
+# (jit-cache bucketing); the serving engine's seeded-determinism burn
+# (serving/engine.py _initial_rng) counts the serial path's in-prompt
+# RNG splits from the SAME constant — change it in one place only.
+PREFILL_BUCKET = 16
+
 
 def init_kv_caches(cfg: ModelConfig, batch: int, max_len: int,
-                   dtype=jnp.bfloat16, prefill_len=None) -> KVCache:
+                   dtype=jnp.bfloat16, prefill_len=None,
+                   per_slot_offsets: bool = False) -> KVCache:
     """Stacked-over-layers KV cache [L, b, max_len, nkv, hd].
 
     Under a mesh context the cache is sharded over 'tp' on the kv-head dim
@@ -64,7 +71,12 @@ def init_kv_caches(cfg: ModelConfig, batch: int, max_len: int,
     exactly `sliding_window` slots (Mistral's rolling-buffer serving):
     banded attention never reads past the window, so memory is O(W)
     regardless of stream length — attention_apply writes position % W
-    and masks by the slot->position map."""
+    and masks by the slot->position map.
+
+    per_slot_offsets=True allocates PER-ROW offsets [L, batch] instead of
+    the shared per-layer scalar [L]: the continuous-batching engine's
+    slot-grid layout (serving/kv_pool.py), where every batch row is an
+    independent request at its own sequence position."""
     from megatron_tpu.parallel.sharding import constrain
     L = cfg.num_layers
     if cfg.sliding_window is not None and (
@@ -85,7 +97,8 @@ def init_kv_caches(cfg: ModelConfig, batch: int, max_len: int,
     return KVCache(
         k=constrain(jnp.zeros(shape, dtype), KV_CACHE_AXES),
         v=constrain(jnp.zeros(shape, dtype), KV_CACHE_AXES),
-        offset=jnp.zeros((L,), jnp.int32),
+        offset=jnp.zeros((L, batch) if per_slot_offsets else (L,),
+                         jnp.int32),
         k_scale=(constrain(jnp.ones(sshape, jnp.float32), KV_CACHE_AXES)
                  if quant else None),
         v_scale=(constrain(jnp.ones(sshape, jnp.float32), KV_CACHE_AXES)
@@ -194,14 +207,19 @@ class Generator:
         # one cached jit; retraces only on new (batch, len) shapes
         self._score_fn = self._jit(_score_fn, n_array_args=1)
 
-    def _jit(self, fn, n_array_args: int):
+    def _jit(self, fn, n_array_args: int, donate_argnums=()):
         """jit with the mesh treatment: params consumed in their sharded
         layout, activation ctx active during trace. The `None` in_shardings
         entries mean 'inherit the argument's own sharding' (host numpy
         inputs land replicated, which is the broadcast-tokens serving
-        layout; a pre-sharded array would be consumed as-is)."""
+        layout; a pre-sharded array would be consumed as-is).
+
+        `donate_argnums`: buffer donation for persistently-resident state
+        (the serving engine's KV pool — without donation every decode
+        step would copy the whole pool; ignored on backends without
+        aliasing support, e.g. CPU)."""
         if self.mesh is None:
-            return jax.jit(fn)
+            return jax.jit(fn, donate_argnums=donate_argnums)
         from megatron_tpu.parallel import sharding as shd
         mesh, rules = self.mesh, self._rules
 
@@ -210,7 +228,8 @@ class Generator:
                 return fn(*args, **kwargs)
 
         return jax.jit(fn_ctx,
-                       in_shardings=(self._param_sh,) + (None,) * n_array_args)
+                       in_shardings=(self._param_sh,) + (None,) * n_array_args,
+                       donate_argnums=donate_argnums)
 
     def _get_decode(self, max_len: int, min_prompt: int,
                     sp: SamplingParams):
@@ -241,9 +260,10 @@ class Generator:
                 f"max_position_embeddings={max_pos}; positions past the RoPE "
                 "table would silently clamp")
         # bucket shapes so the jit cache actually hits across request sizes:
-        # max_len rounds UP to 64, prefill length DOWN to 16
+        # max_len rounds UP to 64, prefill length DOWN to PREFILL_BUCKET
         max_len = min(-(-max_len // 64) * 64, max_pos)
-        min_prompt = max((int(lengths.min()) // 16) * 16, 1)
+        min_prompt = max(
+            (int(lengths.min()) // PREFILL_BUCKET) * PREFILL_BUCKET, 1)
         toks = np.full((b, max_len), self.pad_id, np.int32)
         for i, p in enumerate(prompts):
             toks[i, :len(p)] = p

@@ -50,3 +50,54 @@ def sample(rng, logits, *, top_k: int = 0, top_p: float = 0.0,
     logits = top_k_filter(logits, top_k)
     logits = top_p_filter(logits, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def _top_k_filter_rows(logits, k):
+    """top_k_filter with a PER-ROW traced k [b] (0 disables the row)."""
+    V = logits.shape[-1]
+    srt = jnp.sort(logits, axis=-1)  # ascending
+    # sorted[V - k] == sorted[-k], the serial filter's threshold
+    idx = jnp.clip(V - jnp.maximum(k, 1), 0, V - 1).astype(jnp.int32)
+    kth = jnp.take_along_axis(srt, idx[:, None], axis=-1)
+    filtered = jnp.where(logits < kth, -jnp.inf, logits)
+    return jnp.where((k > 0)[:, None], filtered, logits)
+
+
+def _top_p_filter_rows(logits, p):
+    """top_p_filter with a PER-ROW traced p [b] (<=0 or >=1 disables)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < p[:, None]
+    min_kept = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                       axis=-1, keepdims=True)
+    filtered = jnp.where(logits < min_kept, -jnp.inf, logits)
+    return jnp.where(((p > 0.0) & (p < 1.0))[:, None], filtered, logits)
+
+
+def sample_batched(rngs, logits, *, temperature, top_k, top_p,
+                   vocab_size: int | None = None):
+    """One sampling step with PER-ROW keys and sampling params — the
+    continuous-batching engine's path (serving/engine.py), where one
+    compiled decode step serves slots carrying different requests.
+
+    rngs: [b, 2] uint32 (one PRNG key per row); logits: [b, vocab];
+    temperature/top_p: float32 [b]; top_k: int32 [b]. Returns int32 [b].
+
+    Row-for-row it reproduces `sample(rngs[i], logits[i:i+1], ...)`
+    bit-exactly: the filters are the same row-wise math with traced
+    instead of static knobs, and a vmapped `categorical` over a [V] row
+    draws the same threefry bits as the serial [1, V] call (the counter
+    stream depends only on the key and the element count)."""
+    logits = logits.astype(jnp.float32)
+    if vocab_size is not None and vocab_size < logits.shape[-1]:
+        iota = jnp.arange(logits.shape[-1])
+        logits = jnp.where(iota < vocab_size, logits, -jnp.inf)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    greedy_rows = (temperature == 0.0) | (top_k == 1)
+    x = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    x = _top_k_filter_rows(x, top_k)
+    x = _top_p_filter_rows(x, top_p)
+    sampled = jax.vmap(
+        lambda r, row: jax.random.categorical(r, row, axis=-1))(rngs, x)
+    return jnp.where(greedy_rows, greedy, sampled).astype(jnp.int32)

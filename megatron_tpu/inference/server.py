@@ -7,6 +7,17 @@ run_text_generation_server.py:60-84): same `/api` PUT contract —
  "top_k": .., "top_p": .., "logprobs": bool, "beam_width": int|absent} ->
 {"text": [...], "segments"/"logprobs": ...}.
 
+Beyond the reference, `/api` routes through the continuous-batching
+engine (megatron_tpu/serving): each prompt becomes an independent
+request that joins the persistent decode batch at token granularity, so
+a long request no longer blocks every other caller. The reference's
+serial one-lock path is kept behind `ServingConfig(serial_fallback=
+True)` (and always serves beam search, which stays whole-batch). Proper
+HTTP statuses on BOTH transport backends: 400 for invalid payloads
+(shared validator), 429 when the bounded admission queue overflows,
+500 for internal errors. `GET /metrics` exposes the ServingMetrics
+snapshot.
+
 The reference needs a rank-0 Flask thread that broadcasts a GENERATE/BEAM
 signal to all other ranks sitting in a receive loop
 (ref: text_generation_server.py:22-31); single-controller JAX needs none of
@@ -17,65 +28,266 @@ from __future__ import annotations
 
 import itertools
 import json
+import secrets
 import threading
+from typing import Optional, Tuple
 
 from megatron_tpu.inference.api import (beam_search_and_post_process,
                                         generate_and_post_process)
 from megatron_tpu.inference.generation import Generator
 from megatron_tpu.utils.logging import print_rank_0
 
+MAX_PROMPTS = 128
+
+
+def validate_generate_payload(payload) -> Optional[str]:
+    """Shared request validator for both transport backends: returns an
+    error message (→ HTTP 400) or None. Mirrors the reference's checks
+    (ref: text_generation_server.py:31-228), which it answered with
+    200 + {"message": ...} under flask."""
+    if not isinstance(payload, dict):
+        return "request body must be a JSON object"
+    if "prompts" not in payload:
+        return "prompts argument required"
+    prompts = payload["prompts"]
+    if not isinstance(prompts, list) or not prompts:
+        return "prompts must be a non-empty list"
+    if len(prompts) > MAX_PROMPTS:
+        return f"Maximum number of prompts is {MAX_PROMPTS}"
+    if not all(isinstance(p, str) and p for p in prompts):
+        return "prompts must be non-empty strings"
+    try:
+        n = int(payload.get("tokens_to_generate", 64))
+    except (TypeError, ValueError):
+        return "tokens_to_generate must be an integer"
+    if n < 0:
+        return "tokens_to_generate must be >= 0"
+    # sampling knobs must coerce cleanly — a list/dict/None here would
+    # otherwise surface as a 500 from deep inside the handler
+    for field, conv in (("temperature", float), ("top_k", int),
+                        ("top_p", float), ("length_penalty", float),
+                        ("beam_width", int), ("random_seed", int)):
+        v = payload.get(field)
+        if v is None:
+            continue
+        try:
+            conv(v)
+        except (TypeError, ValueError):
+            return f"{field} must be a number"
+    if payload.get("beam_width") and len(prompts) > 1:
+        # (ref: beam-search rejects multi-prompt requests)
+        return "With beam_search only one prompt is allowed"
+    return None
+
 
 class MegatronServer:
     """(ref: text_generation_server.py:229-241 MegatronServer)"""
 
-    def __init__(self, generator: Generator, tokenizer):
+    def __init__(self, generator: Generator, tokenizer, serving=None,
+                 request_timeout: float = 600.0):
+        from megatron_tpu.config import ServingConfig
         self.generator = generator
         self.tokenizer = tokenizer
-        self._lock = threading.Lock()  # one generation at a time (ref: :37)
+        self.serving = (serving if serving is not None
+                        else ServingConfig()).validate(generator.cfg)
+        self._lock = threading.Lock()  # serial paths: one at a time (ref: :37)
         self._request_counter = itertools.count()
+        self._timeout = request_timeout
+        self.engine = None
+        if not self.serving.serial_fallback:
+            from megatron_tpu.serving import ServingEngine
+            self.engine = ServingEngine(generator, self.serving)
 
-    def handle(self, payload: dict) -> dict:
-        """(ref: text_generation_server.py:31-228 MegatronGenerate.put)"""
-        if "prompts" not in payload:
-            return {"message": "prompts argument required"}
-        prompts = payload["prompts"]
-        if not isinstance(prompts, list) or not prompts:
-            return {"message": "prompts must be a non-empty list"}
-        if len(prompts) > 128:
-            return {"message": "Maximum number of prompts is 128"}
-        n = int(payload.get("tokens_to_generate", 64))
-        if n < 0:
-            return {"message": "tokens_to_generate must be >= 0"}
-        with self._lock:
+    def close(self):
+        if self.engine is not None:
+            self.engine.close()
+
+    def _seed_for(self, payload) -> int:
+        """Explicit random_seed stays deterministic; unseeded requests
+        mix real entropy with a per-process counter so traffic differs
+        run-to-run AND request-to-request (the old counter-only fallback
+        restarted at 0 every process start, making 'unseeded' traffic
+        identical across restarts)."""
+        if payload.get("random_seed") is not None:
+            return int(payload["random_seed"])
+        return (secrets.randbits(31)
+                ^ (next(self._request_counter) & 0x7FFFFFFF))
+
+    def handle(self, payload: dict) -> Tuple[int, dict]:
+        """(ref: text_generation_server.py:31-228 MegatronGenerate.put).
+        Returns (http_status, body)."""
+        err = validate_generate_payload(payload)
+        if err is not None:
+            return 400, {"message": err}
+        from megatron_tpu.serving import AdmissionError, QueueFullError
+        try:
             if payload.get("beam_width"):
-                if len(prompts) > 1:
-                    # (ref: text_generation_server.py beam-search rejects
-                    # multi-prompt requests)
-                    return {"message":
-                            "With beam_search only one prompt is allowed"}
-                texts, scores = beam_search_and_post_process(
-                    self.generator, self.tokenizer, prompts[0],
-                    tokens_to_generate=n,
-                    beam_size=int(payload["beam_width"]),
-                    length_penalty=float(payload.get("length_penalty", 1.0)),
-                    add_BOS=bool(payload.get("add_BOS", False)))
-                return {"text": texts, "score": scores}
+                return 200, self._handle_beam(payload)
+            if self.engine is not None and not payload.get("serial"):
+                return 200, self._handle_engine(payload)
+            return 200, self._handle_serial(payload)
+        except QueueFullError as e:
+            return 429, {"message": str(e)}
+        except AdmissionError as e:
+            # only explicit admission failures are client errors; a bare
+            # ValueError from inside the model stack stays a 500 (it is
+            # a server fault, not a fixable request)
+            return 400, {"message": str(e)}
+        except Exception as e:  # noqa: BLE001 — 500 with message, both paths
+            return 500, {"message": str(e)}
+
+    def _handle_beam(self, payload: dict) -> dict:
+        prompts = payload["prompts"]
+        # same length admission as the other routes: positions past the
+        # RoPE table would silently clamp, not error
+        prompt_ids = self._preflight_lengths(
+            payload, self.generator.cfg.max_position_embeddings,
+            "max_position_embeddings")
+        with self._lock:
+            texts, scores = beam_search_and_post_process(
+                self.generator, self.tokenizer, prompts[0],
+                tokens_to_generate=int(payload.get("tokens_to_generate",
+                                                   64)),
+                beam_size=int(payload["beam_width"]),
+                length_penalty=float(payload.get("length_penalty", 1.0)),
+                add_BOS=bool(payload.get("add_BOS", False)),
+                prompt_ids=prompt_ids[0])
+            return {"text": texts, "score": scores}
+
+    def _preflight_lengths(self, payload: dict, max_total: int,
+                           what: str):
+        """Tokenize-and-check before generating, so oversize/empty
+        prompts 400 as AdmissionError on EVERY route (a bare ValueError
+        escaping the model stack stays a 500 — it is a server fault).
+        Returns the token ids (BOS applied) so no route tokenizes
+        twice."""
+        from megatron_tpu.serving import AdmissionError
+        n = int(payload.get("tokens_to_generate", 64))
+        add_bos = bool(payload.get("add_BOS", False))
+        prompt_ids = []
+        for i, p in enumerate(payload["prompts"]):
+            ids = self.tokenizer.tokenize(p)
+            if add_bos and self.tokenizer.bos is not None:
+                ids = [self.tokenizer.bos] + ids
+            if not ids:
+                raise AdmissionError(
+                    f"prompt {i} tokenized to zero tokens")
+            if len(ids) + n > max_total:
+                raise AdmissionError(
+                    f"prompt {i} ({len(ids)} tokens) + tokens_to_"
+                    f"generate ({n}) exceeds {what}={max_total}")
+            prompt_ids.append(ids)
+        return prompt_ids
+
+    def _handle_serial(self, payload: dict) -> dict:
+        """The reference's whole-batch path: one generation at a time."""
+        prompt_ids = self._preflight_lengths(
+            payload, self.generator.cfg.max_position_embeddings,
+            "max_position_embeddings")
+        with self._lock:
             texts, tokens, logprobs = generate_and_post_process(
-                self.generator, self.tokenizer, prompts,
-                tokens_to_generate=n,
+                self.generator, self.tokenizer, payload["prompts"],
+                tokens_to_generate=int(payload.get("tokens_to_generate",
+                                                   64)),
                 temperature=float(payload.get("temperature", 1.0)),
                 top_k=int(payload.get("top_k", 0)),
                 top_p=float(payload.get("top_p", 0.0)),
                 add_BOS=bool(payload.get("add_BOS", False)),
-                return_output_log_probs=bool(payload.get("logprobs", False)),
-                # unseeded requests must differ run-to-run (the reference
-                # leaves sampling unseeded unless random_seed is given)
-                seed=int(payload.get("random_seed",
-                                     next(self._request_counter))))
-            out = {"text": texts, "segments": tokens}
-            if logprobs is not None:
-                out["logprobs"] = logprobs
-            return out
+                return_output_log_probs=bool(payload.get("logprobs",
+                                                         False)),
+                seed=self._seed_for(payload),
+                prompt_ids=prompt_ids)
+        out = {"text": texts, "segments": tokens}
+        if logprobs is not None:
+            out["logprobs"] = logprobs
+        return out
+
+    def _handle_engine(self, payload: dict) -> dict:
+        """Continuous-batching path: each prompt is an independent
+        request interleaved with all other traffic. Prompt i of a
+        multi-prompt payload uses seed+i (a single seeded prompt
+        reproduces the serial path token-for-token; multi-prompt
+        payloads sample independently per row instead of sharing the
+        serial path's one batch-wide key)."""
+        from megatron_tpu.serving import QueueFullError, SamplingOptions
+        n = int(payload.get("tokens_to_generate", 64))
+        sampling = SamplingOptions(
+            temperature=float(payload.get("temperature", 1.0)),
+            top_k=int(payload.get("top_k", 0)),
+            top_p=float(payload.get("top_p", 0.0)))
+        want_lp = bool(payload.get("logprobs", False))
+        seed = self._seed_for(payload)
+        # tokenize + validate EVERY prompt before submitting ANY, so a
+        # bad prompt 400s without leaving earlier rows decoding for a
+        # response that will never be read
+        prompt_ids = self._preflight_lengths(payload, self.engine.max_len,
+                                             "max_len")
+        # Submit in waves: a payload with more prompts than the queue
+        # bound (the reference's contract allows up to MAX_PROMPTS=128)
+        # drains its OWN completed rows to make room instead of failing.
+        # 429 fires only when the queue is full of OTHER traffic before
+        # this payload served a single row.
+        import time as _time
+        deadline = _time.monotonic() + self._timeout
+        reqs: dict = {}
+        results: dict = {}
+        pending: list = []
+        try:
+            for i, ids in enumerate(prompt_ids):
+                while True:
+                    try:
+                        reqs[i] = self.engine.submit(ids, n, sampling,
+                                                     seed=seed + i)
+                        pending.append(i)
+                        break
+                    except QueueFullError:
+                        if pending:
+                            # make room by draining our oldest row
+                            j = pending.pop(0)
+                            results[j] = reqs[j].result(
+                                timeout=self._timeout)
+                        elif results:
+                            # our rows are all done; OTHER traffic holds
+                            # the queue — wait for room, bounded. On
+                            # deadline this is a timeout (500), NOT a
+                            # 429: retrying would redo work already
+                            # spent on the served rows
+                            if _time.monotonic() > deadline:
+                                raise RuntimeError(
+                                    "timed out waiting for queue space "
+                                    f"after serving {len(results)} of "
+                                    f"{len(prompt_ids)} prompts")
+                            _time.sleep(0.05)
+                        else:
+                            raise  # genuine backpressure: nothing served
+            for j in pending:
+                results[j] = reqs[j].result(timeout=self._timeout)
+        except Exception:
+            # rejection/timeout dooms the whole payload: cancel every
+            # sibling still in flight so the slot grid is not kept busy
+            # decoding output nobody will read
+            for r in reqs.values():
+                self.engine.cancel(r)
+            raise
+        texts, tokens, logprobs = [], [], []
+        for i in range(len(prompt_ids)):
+            toks, gen_lps = results[i]
+            texts.append(self.tokenizer.detokenize(toks))
+            tokens.append(toks)
+            # serial-contract shape: one value per OUTPUT token; prompt
+            # positions are zero (the serial path fills some in-prompt
+            # positions with scoring values — an artifact of its
+            # bucketed prefill, not part of the contract)
+            logprobs.append([0.0] * len(reqs[i].prompt) + gen_lps)
+        out = {"text": texts, "segments": tokens}
+        if want_lp:
+            out["logprobs"] = logprobs
+        return out
+
+    def metrics_snapshot(self) -> dict:
+        if self.engine is None:
+            return {"serving": "serial"}
+        return self.engine.metrics.snapshot()
 
     def run(self, host: str = "0.0.0.0", port: int = 5000):
         try:
@@ -90,7 +302,12 @@ class MegatronServer:
 
         @app.route("/api", methods=["PUT"])
         def api():
-            return jsonify(server.handle(request.get_json()))
+            status, body = server.handle(request.get_json(silent=True))
+            return jsonify(body), status
+
+        @app.route("/metrics", methods=["GET"])
+        def metrics():
+            return jsonify(server.metrics_snapshot()), 200
 
         print_rank_0(f"serving (flask) on {host}:{port}/api")
         app.run(host=host, port=port, threaded=True)
@@ -100,6 +317,14 @@ class MegatronServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _send(self, status: int, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_PUT(self):
                 if self.path.rstrip("/") != "/api":
                     self.send_error(404)
@@ -107,16 +332,20 @@ class MegatronServer:
                 length = int(self.headers.get("Content-Length", 0))
                 try:
                     payload = json.loads(self.rfile.read(length) or b"{}")
-                    result = server.handle(payload)
-                    body = json.dumps(result).encode()
-                    self.send_response(200)
-                except Exception as e:  # mirror flask's 500-with-message
-                    body = json.dumps({"message": str(e)}).encode()
-                    self.send_response(500)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                except json.JSONDecodeError as e:
+                    self._send(400, {"message": f"invalid JSON: {e}"})
+                    return
+                try:
+                    status, body = server.handle(payload)
+                except Exception as e:  # pragma: no cover — handle()
+                    status, body = 500, {"message": str(e)}
+                self._send(status, body)
+
+            def do_GET(self):
+                if self.path.rstrip("/") != "/metrics":
+                    self.send_error(404)
+                    return
+                self._send(200, server.metrics_snapshot())
 
             def log_message(self, fmt, *a):
                 pass

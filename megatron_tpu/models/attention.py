@@ -50,7 +50,10 @@ class KVCache(NamedTuple):
     cache entirely (it reads the raw projections)."""
     k: jax.Array  # [batch, max_seq, n_kv_heads, head_dim]
     v: jax.Array
-    offset: jax.Array  # scalar int32: tokens already in cache
+    # tokens already in cache: scalar int32, or PER-ROW [batch] int32 for
+    # the serving engine's slot grid (each row decodes at its own length;
+    # vector offsets support s == 1 steps only — see attention_apply)
+    offset: jax.Array
     k_scale: Optional[jax.Array] = None  # [batch, max_seq, n_kv, 1] fp32
     v_scale: Optional[jax.Array] = None
 
@@ -128,18 +131,28 @@ def _dot_attention(q, k, v, *, causal: bool, softmax_fp32: bool,
     # stays causal-or-segments
     if causal or segment_ids is not None:
         if causal:
-            q_pos = jnp.arange(s)[:, None]
+            q_pos = jnp.arange(s)[None, :]  # [1, s]
             if q_offset is not None:
-                q_pos = q_pos + q_offset
+                # scalar offset (one sequence position for the whole
+                # batch) or PER-ROW [b] offsets (the serving engine's
+                # slot grid, where every row decodes at its own length)
+                off = (q_offset[:, None] if jnp.ndim(q_offset) == 1
+                       else q_offset)
+                q_pos = q_pos + off  # [b|1, s]
             # kv_positions: the ROLLING cache's slot->position map (slot
-            # order is not time order); default is the contiguous layout
-            kv_pos = (kv_positions[None, :] if kv_positions is not None
-                      else jnp.arange(t)[None, :])
-            win = (q_pos >= kv_pos)
+            # order is not time order), [t] shared or [b, t] per-row;
+            # default is the contiguous layout
+            if kv_positions is not None:
+                kv_pos = (kv_positions if kv_positions.ndim == 2
+                          else kv_positions[None, :])
+            else:
+                kv_pos = jnp.arange(t)[None, :]  # [1, t]
+            win = (q_pos[:, :, None] >= kv_pos[:, None, :])  # [b|1, s, t]
             if sliding_window is not None:
                 # banded causal: attend at most the previous W positions
-                win = win & (q_pos - kv_pos < sliding_window)
-            mask = jnp.broadcast_to(win[None], (b, s, t))
+                win = win & (q_pos[:, :, None] - kv_pos[:, None, :]
+                             < sliding_window)
+            mask = jnp.broadcast_to(win, (b, s, t))
         else:
             mask = jnp.ones((b, s, t), bool)
         if segment_ids is not None:
@@ -199,11 +212,27 @@ def attention_apply(
     k, v = kv[:, :, 0], kv[:, :, 1]
 
     q_offset = None
+    per_slot = False
     if kv_cache is not None:
         q_offset = kv_cache.offset
+        # PER-SLOT offsets (vector [b]): every batch row sits at its own
+        # sequence position — the continuous-batching engine's slot grid
+        # (serving/engine.py), where one compiled s==1 decode step serves
+        # requests of different lengths. Multi-token chunks with per-row
+        # offsets would need per-row dynamic slices; the engine prefills
+        # each request at batch=1 with a scalar offset instead.
+        per_slot = jnp.ndim(q_offset) == 1
+        if per_slot:
+            assert s == 1 and not cross, (
+                "per-slot (vector) KV-cache offsets support only s == 1 "
+                "self-attention decode steps; prefill requests at "
+                "batch=1 with a scalar offset and insert into the pool")
         if position_ids is None:
-            position_ids = kv_cache.offset + jnp.arange(s)[None, :]
-            position_ids = jnp.broadcast_to(position_ids, (b, s))
+            if per_slot:
+                position_ids = q_offset[:, None] + jnp.arange(s)[None, :]
+            else:
+                position_ids = kv_cache.offset + jnp.arange(s)[None, :]
+                position_ids = jnp.broadcast_to(position_ids, (b, s))
 
     if cfg.use_rotary_emb and not cross:
         assert rope_cos is not None and rope_sin is not None, (
@@ -251,7 +280,32 @@ def attention_apply(
             from megatron_tpu.ops.quantized import quantize_rows
             ki, ks = quantize_rows(k)  # per (b, token, head) over head_dim
             vi, vs = quantize_rows(v)
-        if rolling:
+        if per_slot:
+            # serving slot grid: row i writes its s==1 k/v at its own
+            # offset[i] (one scatter, [b] index vectors) — through the
+            # ring (position % W) when the buffer is rolling
+            rows = jnp.arange(b)
+            slots = kv_cache.offset % cap if rolling else kv_cache.offset
+
+            def wr(buf, val):
+                return buf.at[rows, slots].set(val[:, 0].astype(buf.dtype))
+
+            if quant:
+                kv_cache = KVCache(wr(kv_cache.k, ki), wr(kv_cache.v, vi),
+                                   kv_cache.offset + 1,
+                                   wr(kv_cache.k_scale, ks),
+                                   wr(kv_cache.v_scale, vs))
+            else:
+                kv_cache = KVCache(wr(kv_cache.k, k), wr(kv_cache.v, v),
+                                   kv_cache.offset + 1)
+            if rolling:
+                # per-row map: slot j holds the largest p <= t_last[row]
+                # with p % W == j (sentinel for never-written slots)
+                t_last = kv_cache.offset[:, None] - 1  # [b, 1]
+                j = jnp.arange(cap)[None, :]
+                p = t_last - ((t_last - j) % cap)
+                kv_positions = jnp.where(p >= 0, p, jnp.int32(2 ** 30))
+        elif rolling:
             # tokens beyond the window never survive a chunked write:
             # keep only the last min(s, W) and scatter to their slots
             # (unique by construction). Multi-token chunks are CORRECT

@@ -117,7 +117,9 @@ def model_forward(
                 # incremental decode: positions continue from the cache offset
                 # (all layers share one offset; ref: InferenceParams keeps a
                 # single sequence_len_offset, forward_step.py:17-42)
-                pos = pos + kv_caches.offset[0]
+                off = kv_caches.offset[0]
+                # per-slot serving pools carry [batch] offsets per layer
+                pos = pos + (off[:, None] if jnp.ndim(off) == 1 else off)
         else:
             pos = position_ids
         x = x + params["embedding"]["position_embeddings"][pos].astype(compute_dtype)

@@ -1,0 +1,10 @@
+"""Continuous-batching serving subsystem (new layer between the
+generator and the HTTP front end — see docs/serving.md)."""
+from megatron_tpu.serving.engine import ServingEngine  # noqa: F401
+from megatron_tpu.serving.kv_pool import (  # noqa: F401
+    SlotKVPool, insert_prefill)
+from megatron_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from megatron_tpu.serving.request import (  # noqa: F401
+    GenRequest, RequestState, SamplingOptions)
+from megatron_tpu.serving.scheduler import (  # noqa: F401
+    AdmissionError, FIFOScheduler, QueueFullError)

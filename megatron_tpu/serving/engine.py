@@ -1,0 +1,370 @@
+"""Continuous-batching serving engine.
+
+The reference (and the serial port in inference/server.py) generates one
+whole batch at a time behind a lock: a 128-prompt request's entire
+prefill + decode blocks every other caller. This engine implements
+Orca-style iteration-level scheduling over a vLLM-style pooled KV cache,
+TPU-native:
+
+- ONE persistent jitted decode step over a fixed grid of `num_slots`
+  batch slots — static shapes, compiled exactly once, no per-request
+  retrace. Per-slot sequence positions ride the vector KV-cache offsets
+  (models/attention.py), per-slot sampling knobs ride
+  `sample_batched` (inference/sampling.py), per-request seeds ride a
+  [slots, 2] PRNG-key grid.
+- Each slot owns a region of a pre-allocated KV pool
+  (serving/kv_pool.py, built by init_kv_caches — int8 and
+  sliding-window ROLLING layouts included). Admission prefills a
+  request at batch=1 and inserts its KV into the slot region via
+  `lax.dynamic_update_slice`; eviction on EOS/max-tokens frees the slot
+  with no copying.
+- A bounded FIFO (serving/scheduler.py) provides backpressure; the
+  engine loop drains it into free slots between decode steps, so
+  new requests join the running batch at token granularity.
+
+Seeded determinism: a request with seed s reproduces the serial
+`Generator.generate([prompt], ..., seed=s)` output token-for-token —
+the engine burns the same number of PRNG splits the serial path spends
+on its bucketed in-prompt steps, and `sample_batched` is row-for-row
+bit-identical to `sample`.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.inference.generation import Generator
+from megatron_tpu.inference.sampling import sample_batched
+from megatron_tpu.models import language_model as lm
+from megatron_tpu.serving.kv_pool import SlotKVPool, insert_prefill
+from megatron_tpu.serving.metrics import ServingMetrics
+from megatron_tpu.serving.request import (GenRequest, RequestState,
+                                          SamplingOptions)
+from megatron_tpu.serving.scheduler import FIFOScheduler
+from megatron_tpu.utils.logging import print_rank_0
+
+from megatron_tpu.config import SERVING_KV_DTYPES as _KV_DTYPES
+
+
+class ServingEngine:
+    """Drives generation for many concurrent requests through one
+    compiled decode step. Construct from a `Generator` (whose params /
+    config / mesh treatment / rope tables are reused as-is)."""
+
+    def __init__(self, generator: Generator, serving=None,
+                 metrics: Optional[ServingMetrics] = None,
+                 writer=None, report_interval: int = 100,
+                 start: bool = True):
+        from megatron_tpu.config import ServingConfig
+        self.gen = generator
+        cfg = generator.cfg
+        self.cfg = cfg
+        self.serving = serving if serving is not None else ServingConfig()
+        self.max_len = self.serving.max_len or cfg.max_position_embeddings
+        assert self.max_len <= cfg.max_position_embeddings, (
+            f"ServingConfig.max_len={self.max_len} exceeds "
+            f"max_position_embeddings={cfg.max_position_embeddings}")
+        self.num_slots = self.serving.num_slots
+        kv_dtype = (generator.kv_cache_dtype
+                    if self.serving.kv_dtype is None
+                    else _KV_DTYPES[self.serving.kv_dtype])
+        self.pool = SlotKVPool(cfg, self.num_slots, self.max_len,
+                               dtype=kv_dtype)
+        self.scheduler = FIFOScheduler(self.serving.max_queue,
+                                       max_total_len=self.max_len)
+        self.scheduler.notify = self._wake
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._writer = writer
+        self._report_interval = max(report_interval, 1)
+
+        S, Vp = self.num_slots, cfg.padded_vocab_size
+        # per-slot device state (functionally replaced every step)
+        self._last_logits = jnp.zeros((S, Vp), jnp.float32)
+        self._rngs = jnp.zeros((S, 2), jnp.uint32)
+        # per-slot host state (engine thread only)
+        self._lengths = np.zeros(S, np.int32)
+        self._active = np.zeros(S, bool)
+        self._temps = np.ones(S, np.float32)
+        self._top_ks = np.zeros(S, np.int32)
+        self._top_ps = np.zeros(S, np.float32)
+        self._slot_req: List[Optional[GenRequest]] = [None] * S
+
+        self._decode_traces = 0  # trace count — MUST stay 1 in steady state
+        self._decode = self.gen._jit(self._decode_fn, n_array_args=7,
+                                     donate_argnums=(1, 2, 3))
+        # one jit; jax retraces per padded prompt length (bucketed by
+        # _prefill_bucket so the cache hits across request sizes)
+        self._prefill = self.gen._jit(self._prefill_fn, n_array_args=7,
+                                      donate_argnums=(1, 2, 3))
+        self._steps = 0
+        self._cond = threading.Condition()
+        self._stop = False
+        self._broken: Optional[str] = None
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serving-engine")
+        if start:
+            self._thread.start()
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 64,
+               sampling: SamplingOptions = SamplingOptions(),
+               seed: int = 0) -> GenRequest:
+        """Non-blocking: enqueue and return the request handle. Raises
+        QueueFullError (→ 429) when the bounded queue is full and
+        AdmissionError (→ 400) when the request can never fit."""
+        if self._broken:
+            raise RuntimeError(f"engine failed: {self._broken}")
+        req = GenRequest(list(prompt), max_new_tokens, sampling, seed)
+        self.metrics.count("requests_received")
+        try:
+            if max_new_tokens == 0:
+                # nothing to decode: the serial path returns the prompt
+                # row unchanged — short-circuit without occupying a
+                # slot, but through the SAME admission check (an
+                # oversize prompt must 400 on both routes)
+                self.scheduler.check_admissible(req)
+                req.mark_admitted()
+                req.finish()
+                self.metrics.record_admitted(0.0)
+                self.metrics.record_completed(0.0, 0)
+                return req
+            self.scheduler.submit(req)
+        except Exception:
+            self.metrics.count("requests_rejected")
+            raise
+        return req
+
+    def cancel(self, req: GenRequest):
+        """Best-effort cancellation: a QUEUED request is dropped and
+        failed immediately; a RUNNING one is flagged and evicted at the
+        next decode step (frees its slot without decoding to
+        completion). Used by the HTTP layer to avoid orphaned work when
+        a multi-prompt payload fails partway through submission."""
+        req.cancel()
+        if not req.done():
+            self.scheduler.cancel(req)
+        self._wake()
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 64,
+                 sampling: SamplingOptions = SamplingOptions(),
+                 seed: int = 0, timeout: Optional[float] = None):
+        """Blocking convenience: submit + wait. Returns (tokens,
+        logprobs) with tokens = prompt + generated."""
+        return self.submit(prompt, max_new_tokens, sampling,
+                           seed).result(timeout)
+
+    def close(self):
+        """Stop the loop; fail queued and in-flight requests. Safe on a
+        never-started (start=False) engine."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread.ident is not None:  # was started
+            self._thread.join(timeout=30)
+        for req in self.scheduler.close():
+            req.fail("engine shut down")
+        for req in self._slot_req:
+            if req is not None and req.state is RequestState.RUNNING:
+                req.fail("engine shut down")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------
+    # device programs
+    # ------------------------------------------------------------------
+    def _decode_fn(self, params, pool, last_logits, rngs, lengths,
+                   temps, top_ks, top_ps):
+        """ONE interleaved decode step for the whole slot grid: sample
+        each slot's next token from its carried logits, then forward all
+        slots' tokens (s=1) through the model with per-slot positions.
+        Inactive slots ride along at length 0 (static shapes); their
+        writes land at position 0 and are fully overwritten by the next
+        prefill insert."""
+        self._decode_traces += 1
+        cfg = self.cfg
+        split = jax.vmap(jax.random.split)(rngs)  # [S, 2, 2]
+        new_rngs, step_keys = split[:, 0], split[:, 1]
+        toks = sample_batched(step_keys, last_logits,
+                              temperature=temps, top_k=top_ks,
+                              top_p=top_ps, vocab_size=cfg.vocab_size)
+        # logprob of the chosen token under the RAW carried logits —
+        # the serial path's convention (generation.py _decode_fn)
+        lp = jax.nn.log_softmax(last_logits, axis=-1)
+        tok_lp = jnp.take_along_axis(lp, toks[:, None], axis=-1)[:, 0]
+        # the engine's host `lengths` are the source of truth for every
+        # row's position; broadcast them over layers into the pool
+        L = pool.offset.shape[0]
+        pool = pool._replace(offset=jnp.broadcast_to(
+            lengths[None, :], (L, lengths.shape[0])).astype(jnp.int32))
+        logits, pool = lm.model_forward(
+            params, toks[:, None], cfg, kv_caches=pool,
+            position_ids=lengths[:, None], rope=self.gen.rope,
+            logits_dtype=jnp.float32)
+        return pool, logits[:, 0], new_rngs, toks, tok_lp
+
+    def _prefill_fn(self, params, pool, last_logits, rngs, tokens,
+                    plen, slot, rng0):
+        caches = self.pool.make_prefill_caches(1)
+        logits, caches = lm.model_forward(
+            params, tokens, self.cfg, kv_caches=caches,
+            rope=self.gen.rope, logits_dtype=jnp.float32)
+        pool = insert_prefill(pool, caches, slot, plen)
+        # logits at the LAST REAL prompt position (bucket pads sit
+        # after it and are causally invisible to it)
+        last = jax.lax.dynamic_slice_in_dim(
+            logits, plen - 1, 1, axis=1)[0, 0]
+        last_logits = last_logits.at[slot].set(last)
+        rngs = rngs.at[slot].set(rng0)
+        return pool, last_logits, rngs
+
+    def _prefill_bucket(self, plen: int) -> int:
+        """Pad prompts up to a bucket so the prefill jit cache hits
+        across request sizes. ROLLING pools prefill at the exact length:
+        pad positions fed through the ring would evict real tokens from
+        the W-slot buffer."""
+        if self.pool.rolling:
+            return plen
+        b = max(self.serving.prefill_bucket, 1)
+        return min(-(-plen // b) * b, self.max_len)
+
+    @staticmethod
+    def _initial_rng(seed: int, plen: int):
+        """Per-request key, advanced past the splits the SERIAL path
+        spends on its bucketed in-prompt steps (Generator.generate
+        rounds the prefill down to a PREFILL_BUCKET multiple and
+        consumes the remaining prompt tokens through decode steps,
+        splitting once per step) — so a seeded engine request reproduces
+        the serial output bit-for-bit from the first generated token."""
+        from megatron_tpu.inference.generation import PREFILL_BUCKET
+        key = jax.random.PRNGKey(seed)
+        burn = plen - max((plen // PREFILL_BUCKET) * PREFILL_BUCKET, 1)
+        for _ in range(burn):
+            key = jax.random.split(key)[0]
+        return key
+
+    # ------------------------------------------------------------------
+    # engine loop (single thread)
+    # ------------------------------------------------------------------
+    def _wake(self):
+        with self._cond:
+            self._cond.notify_all()
+
+    def _loop(self):
+        print_rank_0(
+            f"serving engine: {self.num_slots} slots x cap "
+            f"{self.pool.cap} ({self.pool.dtype}"
+            f"{', rolling' if self.pool.rolling else ''}), "
+            f"pool {self.pool.nbytes() / 2**20:.1f} MiB, "
+            f"queue bound {self.serving.max_queue}")
+        while True:
+            with self._cond:
+                while (not self._stop and self.scheduler.depth() == 0
+                       and not self._active.any()):
+                    self._cond.wait(timeout=0.5)
+                if self._stop:
+                    return
+            try:
+                self._reap_cancelled()
+                self._admit()
+                if self._active.any():
+                    self._step()
+            except Exception as e:  # noqa: BLE001 — fail loudly, not hang
+                self._broken = repr(e)
+                print_rank_0(f"serving engine loop failed: {e!r}")
+                for req in self._slot_req:
+                    if req is not None:
+                        req.fail(self._broken)
+                for req in self.scheduler.close():
+                    req.fail(self._broken)
+                return
+
+    def _admit(self):
+        popped = self.scheduler.pop_ready(self.pool.free_count())
+        for i, req in enumerate(popped):
+            try:
+                self._prefill_into_slot(req)
+            except Exception as e:
+                # the failing request AND the rest of this pop are in
+                # neither _slot_req nor the scheduler — fail them here
+                # or their callers would hang to the request timeout
+                for r in popped[i:]:
+                    r.fail(repr(e))
+                raise
+
+    def _prefill_into_slot(self, req: GenRequest):
+        slot = self.pool.alloc()
+        plen = len(req.prompt)
+        padded = self._prefill_bucket(plen)
+        toks = np.full((1, padded), self.gen.pad_id, np.int32)
+        toks[0, :plen] = req.prompt
+        self.pool.caches, self._last_logits, self._rngs = self._prefill(
+            self.gen.params, self.pool.caches, self._last_logits,
+            self._rngs, jnp.asarray(toks), np.int32(plen), np.int32(slot),
+            self._initial_rng(req.seed, plen))
+        self._lengths[slot] = plen
+        self._active[slot] = True
+        self._temps[slot] = req.sampling.temperature
+        self._top_ks[slot] = req.sampling.top_k
+        self._top_ps[slot] = req.sampling.top_p
+        self._slot_req[slot] = req
+        req.mark_admitted()
+        self.metrics.record_admitted(req.admit_time - req.submit_time)
+
+    def _reap_cancelled(self):
+        for slot in np.nonzero(self._active)[0]:
+            req = self._slot_req[slot]
+            if req is not None and req.cancelled:
+                self._evict(slot, failed="cancelled")
+
+    def _evict(self, slot: int, failed: Optional[str] = None):
+        req = self._slot_req[slot]
+        self._slot_req[slot] = None
+        self._active[slot] = False
+        self._lengths[slot] = 0  # inactive rows park at position 0
+        self.pool.release(slot)
+        if failed is not None:
+            req.fail(failed)
+            self.metrics.count("requests_cancelled")
+            return
+        req.finish()
+        self.metrics.record_completed(
+            req.finish_time - req.submit_time, len(req.generated))
+
+    def _step(self):
+        """One interleaved decode step + host bookkeeping."""
+        out = self._decode(
+            self.gen.params, self.pool.caches, self._last_logits,
+            self._rngs, jnp.asarray(self._lengths),
+            jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+            jnp.asarray(self._top_ps))
+        self.pool.caches, self._last_logits, self._rngs = out[:3]
+        toks = np.asarray(out[3])
+        tok_lp = np.asarray(out[4])
+        n_active = 0
+        for slot in np.nonzero(self._active)[0]:
+            req = self._slot_req[slot]
+            first = not req.generated
+            req.append_token(int(toks[slot]), float(tok_lp[slot]))
+            if first:
+                self.metrics.record_first_token(req.ttft)
+            self._lengths[slot] += 1
+            n_active += 1
+            if (int(toks[slot]) == self.gen.eos_id
+                    or len(req.generated) >= req.max_new_tokens):
+                self._evict(slot)
+        self._steps += 1
+        self.metrics.record_step(n_active, self.num_slots, n_active,
+                                 self.scheduler.depth())
+        if self._writer is not None and \
+                self._steps % self._report_interval == 0:
+            self.metrics.report(self._writer, self._steps)

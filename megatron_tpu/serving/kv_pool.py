@@ -1,0 +1,137 @@
+"""Slot-based KV-cache pool for continuous batching.
+
+vLLM pools KV memory as fixed-size blocks chained per request
+(PagedAttention); the TPU-native formulation here is a fixed GRID of
+batch slots over one pre-allocated cache — [layers, num_slots, cap,
+kv_heads, head_dim] from `init_kv_caches` (inference/generation.py), so
+the int8-quantized and sliding-window ROLLING layouts come for free.
+A slot owns a contiguous `cap`-token region; admission binds a request
+to a free slot, prefill writes the prompt's KV into the region via
+`lax.dynamic_update_slice`, and eviction returns the slot to the free
+list with no copying — the next request simply overwrites it (stale
+entries past a row's offset are invisible to the causal mask and are
+overwritten write-before-read during decode).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.inference.generation import init_kv_caches
+from megatron_tpu.models.attention import KVCache
+
+
+def insert_prefill(pool: KVCache, prefill: KVCache, slot, plen) -> KVCache:
+    """Write a batch-1 prefill cache into `slot`'s pool region.
+
+    Pure/jittable (slot and plen are traced scalars, so one compile
+    serves every slot). The prefill cache must share the pool's layout —
+    both come from `init_kv_caches(cfg, ..., max_len, dtype)`, so caps
+    (full-length or rolling W), dtypes, and scale tensors line up.
+    Only the row's offset is set to `plen`, the TRUE prompt length: a
+    bucket-padded prefill leaves pad garbage at [plen, padded), which
+    decode overwrites write-before-read (attention_apply writes position
+    `offset` before attending it)."""
+    dus = jax.lax.dynamic_update_slice
+    zero = jnp.int32(0)
+    slot = jnp.asarray(slot, jnp.int32)
+    start5 = (zero, slot, zero, zero, zero)
+    new = KVCache(
+        k=dus(pool.k, prefill.k.astype(pool.k.dtype), start5),
+        v=dus(pool.v, prefill.v.astype(pool.v.dtype), start5),
+        offset=dus(pool.offset,
+                   jnp.full((pool.offset.shape[0], 1), plen, jnp.int32),
+                   (zero, slot)),
+        k_scale=(None if pool.k_scale is None
+                 else dus(pool.k_scale, prefill.k_scale, start5)),
+        v_scale=(None if pool.v_scale is None
+                 else dus(pool.v_scale, prefill.v_scale, start5)),
+    )
+    return new
+
+
+class SlotKVPool:
+    """Pre-allocated slot-grid cache + host-side free-slot bookkeeping.
+
+    `caches` is the live device pytree ([L, S, cap, nkv, hd] with
+    per-slot offsets [L, S]); the engine replaces it functionally every
+    step. Slot alloc/release runs only on the engine thread."""
+
+    def __init__(self, cfg: ModelConfig, num_slots: int, max_len: int,
+                 dtype=jnp.bfloat16):
+        assert num_slots >= 1, num_slots
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.dtype = jnp.dtype(dtype)
+        self.caches = init_kv_caches(cfg, num_slots, max_len, dtype=dtype,
+                                     per_slot_offsets=True)
+        self.cap = self.caches.k.shape[2]  # rolling pools clamp to W
+        self.rolling = (cfg.sliding_window is not None
+                        and self.cap == cfg.sliding_window
+                        and self.cap < max_len)
+        self._free: List[int] = list(range(num_slots))
+
+    def make_prefill_caches(self, batch: int = 1) -> KVCache:
+        """A fresh request-local cache in the POOL's layout (same cap /
+        dtype / rolling decision), for the prefill pass that precedes
+        `insert_prefill`."""
+        return init_kv_caches(self.cfg, batch, self.max_len,
+                              dtype=self.dtype)
+
+    # ---- slot bookkeeping (engine thread only) -----------------------
+    def alloc(self) -> int:
+        return self._free.pop(0)
+
+    def release(self, slot: int):
+        assert slot not in self._free, f"double free of slot {slot}"
+        self._free.append(slot)
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def used_count(self) -> int:
+        return self.num_slots - len(self._free)
+
+    def nbytes(self) -> int:
+        n = self.caches.k.nbytes + self.caches.v.nbytes
+        if self.caches.k_scale is not None:
+            n += self.caches.k_scale.nbytes + self.caches.v_scale.nbytes
+        return n
+
+
+def slot_nbytes(cfg: ModelConfig, max_len: int,
+                dtype=jnp.bfloat16) -> int:
+    """Bytes ONE slot's cache region will occupy (k+v, plus int8
+    scales), without allocating — for sizing num_slots against free
+    device memory before building the pool."""
+    cap = max_len
+    if cfg.sliding_window is not None and cfg.attention_impl == "flash":
+        cap = min(cap, cfg.sliding_window)
+    elems = cfg.num_layers * cap * cfg.num_kv_heads * cfg.kv_channels
+    n = 2 * elems * jnp.dtype(dtype).itemsize
+    if jnp.dtype(dtype) == jnp.dtype(jnp.int8):
+        n += 2 * (elems // cfg.kv_channels) * 4  # fp32 scales
+    return n
+
+
+def fit_num_slots(cfg: ModelConfig, max_len: int, dtype=jnp.bfloat16,
+                  requested: int = 8, headroom: float = 0.8) -> int:
+    """Clamp `requested` slots to what the backend's free memory can
+    hold (weights are assumed already resident, so bytes_limit -
+    bytes_in_use is the pool's budget). Backends with no memory stats
+    (CPU, tunneled chips) return `requested` unchanged."""
+    import jax
+    stats = None
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        pass
+    if not stats or not stats.get("bytes_limit"):
+        return requested
+    free = stats["bytes_limit"] - stats.get("bytes_in_use", 0)
+    fit = int(free * headroom) // max(slot_nbytes(cfg, max_len, dtype), 1)
+    return max(1, min(requested, fit))

@@ -1,0 +1,126 @@
+"""Serving metrics registry: queue depth, TTFT, tokens/s, occupancy.
+
+The reference's server has no observability at all; the training side
+here already has writer plumbing (utils/logging.py make_writer — TB /
+wandb / null). `ServingMetrics` is the serving-side registry those
+writers consume: counters and latency reservoirs updated from the
+engine loop and HTTP threads, snapshotted as plain floats.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Deque, Dict, Optional, Tuple
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[idx]
+
+
+class ServingMetrics:
+    """Thread-safe registry. All record_* methods are cheap (no device
+    sync); `snapshot()` computes derived stats on demand."""
+
+    def __init__(self, max_samples: int = 4096,
+                 throughput_window_s: float = 30.0):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = collections.defaultdict(int)
+        self._ttft: Deque[float] = collections.deque(maxlen=max_samples)
+        self._queue_wait: Deque[float] = collections.deque(
+            maxlen=max_samples)
+        self._req_latency: Deque[float] = collections.deque(
+            maxlen=max_samples)
+        # (timestamp, tokens emitted that step) for the tokens/s window
+        self._token_events: Deque[Tuple[float, int]] = collections.deque(
+            maxlen=max_samples)
+        self._window_s = throughput_window_s
+        # occupancy accumulators (slot-steps busy / slot-steps total)
+        self._busy_slot_steps = 0
+        self._total_slot_steps = 0
+        # gauges pushed by the engine
+        self.queue_depth = 0
+        self.active_slots = 0
+        self.num_slots = 0
+
+    # ---- recording ---------------------------------------------------
+    def count(self, name: str, n: int = 1):
+        with self._lock:
+            self._counters[name] += n
+
+    def record_admitted(self, queue_wait_s: float):
+        with self._lock:
+            self._counters["requests_admitted"] += 1
+            self._queue_wait.append(queue_wait_s)
+
+    def record_first_token(self, ttft_s: float):
+        with self._lock:
+            self._ttft.append(ttft_s)
+
+    def record_completed(self, latency_s: float, gen_tokens: int):
+        with self._lock:
+            self._counters["requests_completed"] += 1
+            self._counters["tokens_generated"] += gen_tokens
+            self._req_latency.append(latency_s)
+
+    def record_step(self, active_slots: int, num_slots: int,
+                    tokens_emitted: int, queue_depth: int):
+        now = time.monotonic()
+        with self._lock:
+            self._counters["decode_steps"] += 1
+            self._busy_slot_steps += active_slots
+            self._total_slot_steps += num_slots
+            self._token_events.append((now, tokens_emitted))
+            self.queue_depth = queue_depth
+            self.active_slots = active_slots
+            self.num_slots = num_slots
+
+    # ---- derived -----------------------------------------------------
+    def tokens_per_s(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            events = [(t, n) for t, n in self._token_events
+                      if now - t <= self._window_s]
+        if len(events) < 2:
+            return 0.0
+        span = max(now - events[0][0], 1e-9)
+        return sum(n for _, n in events) / span
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            counters = dict(self._counters)
+            ttft = sorted(self._ttft)
+            qwait = sorted(self._queue_wait)
+            lat = sorted(self._req_latency)
+            occ = (self._busy_slot_steps / self._total_slot_steps
+                   if self._total_slot_steps else 0.0)
+            gauges = {"queue_depth": float(self.queue_depth),
+                      "active_slots": float(self.active_slots),
+                      "num_slots": float(self.num_slots)}
+        out = {k: float(v) for k, v in counters.items()}
+        out.update(gauges)
+        out.update({
+            "ttft_p50_ms": _percentile(ttft, 0.50) * 1e3,
+            "ttft_p95_ms": _percentile(ttft, 0.95) * 1e3,
+            "queue_wait_p50_ms": _percentile(qwait, 0.50) * 1e3,
+            "latency_p50_ms": _percentile(lat, 0.50) * 1e3,
+            "latency_p95_ms": _percentile(lat, 0.95) * 1e3,
+            "tokens_per_s": self.tokens_per_s(),
+            "slot_occupancy": occ,
+        })
+        return out
+
+    def report(self, writer, step: Optional[int] = None):
+        """Push the snapshot through a utils/logging writer (TB / wandb /
+        NullWriter)."""
+        snap = self.snapshot()
+        step = int(step if step is not None
+                   else snap.get("decode_steps", 0))
+        for k, v in snap.items():
+            writer.add_scalar(f"serving/{k}", v, step)
+        writer.flush()
+        return snap

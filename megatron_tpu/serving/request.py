@@ -1,0 +1,114 @@
+"""Request objects for the continuous-batching engine.
+
+The reference's server has no request abstraction at all — one Flask
+thread holds a lock and the whole prompt batch IS the request
+(ref: megatron/text_generation_server.py:31-228). Continuous batching
+(Orca's iteration-level scheduling) needs one: requests enter and leave
+the persistent decode batch at token granularity, so each carries its
+own sampling state, seed, and lifecycle timestamps.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import threading
+import time
+from typing import List, Optional
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"        # accepted, waiting for a free slot
+    RUNNING = "running"      # prefilled into a slot, decoding
+    FINISHED = "finished"    # EOS or max_new_tokens reached
+    FAILED = "failed"        # engine error or shutdown
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingOptions:
+    """Per-REQUEST sampling knobs. The engine batches these into [slots]
+    arrays so one compiled decode step serves mixed requests
+    (inference/sampling.py sample_batched)."""
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 0.0
+
+
+_req_ids = itertools.count()
+
+
+class GenRequest:
+    """One generation request flowing through the engine.
+
+    Completion is signalled through a threading.Event so HTTP handler
+    threads can block on `result()` while the engine thread decodes."""
+
+    def __init__(self, prompt: List[int], max_new_tokens: int,
+                 sampling: SamplingOptions = SamplingOptions(),
+                 seed: int = 0):
+        assert prompt, "empty prompt"
+        assert max_new_tokens >= 0, max_new_tokens
+        self.id = next(_req_ids)
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.sampling = sampling
+        self.seed = int(seed)
+        self.state = RequestState.QUEUED
+        self.generated: List[int] = []
+        self.gen_logprobs: List[float] = []
+        self.error: Optional[str] = None
+        # lifecycle timestamps (metrics: queue wait, TTFT, decode rate)
+        self.submit_time = time.monotonic()
+        self.admit_time: Optional[float] = None
+        self.first_token_time: Optional[float] = None
+        self.finish_time: Optional[float] = None
+        self._done = threading.Event()
+        self.cancelled = False
+
+    def cancel(self):
+        """Best-effort: a QUEUED request is dropped before admission; a
+        RUNNING one is evicted at the next decode step (its slot frees
+        without waiting for EOS/max-tokens)."""
+        self.cancelled = True
+
+    # ---- engine side -------------------------------------------------
+    def mark_admitted(self):
+        self.state = RequestState.RUNNING
+        self.admit_time = time.monotonic()
+
+    def append_token(self, token: int, logprob: float):
+        if self.first_token_time is None:
+            self.first_token_time = time.monotonic()
+        self.generated.append(int(token))
+        self.gen_logprobs.append(float(logprob))
+
+    def finish(self):
+        self.state = RequestState.FINISHED
+        self.finish_time = time.monotonic()
+        self._done.set()
+
+    def fail(self, msg: str):
+        self.state = RequestState.FAILED
+        self.error = msg
+        self.finish_time = time.monotonic()
+        self._done.set()
+
+    # ---- caller side -------------------------------------------------
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until finished; returns (tokens, logprobs) where tokens
+        is prompt + generated (the serial path's row layout,
+        inference/generation.py generate)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} still {self.state}")
+        if self.state is RequestState.FAILED:
+            raise RuntimeError(f"request {self.id} failed: {self.error}")
+        return self.prompt + self.generated, list(self.gen_logprobs)
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time

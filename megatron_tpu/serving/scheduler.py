@@ -1,0 +1,102 @@
+"""FIFO admission-control scheduler for the serving engine.
+
+The reference serializes whole prompt batches behind one lock
+(ref: megatron/text_generation_server.py:37). Here the unit of
+scheduling is the REQUEST: a bounded thread-safe FIFO feeds the engine
+loop, which drains it into free KV-pool slots at token granularity
+(Orca-style iteration-level scheduling). Admission control happens at
+submit time — oversize prompts and a full queue are rejected
+immediately so callers get backpressure instead of unbounded latency.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import List, Optional
+
+from megatron_tpu.serving.request import GenRequest
+
+
+class QueueFullError(RuntimeError):
+    """Bounded queue overflow — the HTTP layer maps this to 429."""
+
+
+class AdmissionError(ValueError):
+    """Request can never be served (e.g. prompt + new tokens exceed the
+    pool's max_len) — the HTTP layer maps this to 400."""
+
+
+class FIFOScheduler:
+    """Bounded FIFO with admission checks.
+
+    Thread contract: `submit`/`depth`/`close` are called from any
+    thread; `pop_ready` only from the engine loop. `notify` (set by the
+    engine) wakes the loop when work arrives."""
+
+    def __init__(self, max_queue: int, max_total_len: int):
+        assert max_queue >= 1, max_queue
+        self.max_queue = max_queue
+        self.max_total_len = max_total_len
+        self._q: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._closed = False
+        self.notify = lambda: None
+
+    def check_admissible(self, req: GenRequest):
+        """Length admission check, shared with the engine's
+        zero-decode short-circuit (which never enqueues)."""
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.max_total_len:
+            raise AdmissionError(
+                f"prompt ({len(req.prompt)}) + max_new_tokens "
+                f"({req.max_new_tokens}) = {total} exceeds the engine's "
+                f"max_len={self.max_total_len}")
+
+    def submit(self, req: GenRequest) -> GenRequest:
+        self.check_admissible(req)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("scheduler closed")
+            if len(self._q) >= self.max_queue:
+                raise QueueFullError(
+                    f"request queue full ({self.max_queue}); retry later")
+            self._q.append(req)
+        self.notify()
+        return req
+
+    def pop_ready(self, n: int) -> List[GenRequest]:
+        """Up to n non-cancelled requests in FIFO order (engine loop
+        only); cancelled entries are dropped and failed in passing."""
+        out: List[GenRequest] = []
+        with self._lock:
+            while self._q and len(out) < n:
+                req = self._q.popleft()
+                if req.cancelled:
+                    req.fail("cancelled")
+                    continue
+                out.append(req)
+        return out
+
+    def cancel(self, req: GenRequest) -> bool:
+        """Drop a still-QUEUED request; returns False if it already left
+        the queue (the engine evicts running ones at the next step)."""
+        with self._lock:
+            try:
+                self._q.remove(req)
+            except ValueError:
+                return False
+        req.fail("cancelled")
+        return True
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def close(self) -> List[GenRequest]:
+        """Reject further submits; return the drained backlog so the
+        engine can fail them."""
+        with self._lock:
+            self._closed = True
+            backlog = list(self._q)
+            self._q.clear()
+        return backlog

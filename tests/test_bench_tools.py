@@ -90,6 +90,23 @@ def test_bench_decode_sliding_window_arm(monkeypatch, tmp_path):
     assert "roofline" not in text
 
 
+def test_serving_bench_emits_record(monkeypatch, tmp_path):
+    """The concurrent-load micro-bench must drive the engine end-to-end
+    and emit one parseable BENCH-style JSON record."""
+    import json
+    text = run_tool(
+        monkeypatch, tmp_path, "serving_bench.py",
+        ["--requests", "6", "--slots", "2", "--prompt", "12", "--new", "6",
+         "--layers", "2", "--hidden", "64", "--heads", "4",
+         "--vocab", "128", "--seq", "128"])
+    rec = json.loads(text)
+    assert rec["bench"] == "serving" and rec["mode"] == "engine"
+    assert rec["tokens_per_s"] > 0
+    assert rec["ttft_p95_ms"] >= rec["ttft_p50_ms"] >= 0
+    assert 0 < rec["slot_occupancy"] <= 1
+    assert rec["decode_steps"] >= 6  # 6 requests interleaved on 2 slots
+
+
 def test_bench_kernels_smoke_runs_all_arms(monkeypatch, tmp_path):
     text = run_tool(monkeypatch, tmp_path, "bench_kernels.py",
                     ["--smoke", "--iters", "2"])

@@ -166,11 +166,15 @@ class TestServer:
         gen = Generator(params, cfg, eos_id=0, pad_id=0)
         server = MegatronServer(gen, FakeTokenizer())
 
-        # direct handler contract
-        out = server.handle({"prompts": ["hello"], "tokens_to_generate": 4,
-                             "temperature": 0.0, "logprobs": True})
+        # direct handler contract: (status, body)
+        status, out = server.handle({"prompts": ["hello"],
+                                     "tokens_to_generate": 4,
+                                     "temperature": 0.0, "logprobs": True})
+        assert status == 200
         assert "text" in out and "segments" in out and "logprobs" in out
-        assert server.handle({})["message"] == "prompts argument required"
+        status, out = server.handle({})
+        assert status == 400
+        assert out["message"] == "prompts argument required"
 
         # over HTTP (stdlib backend)
         import socket
@@ -377,6 +381,64 @@ class TestRollingKVCache:
             y, _ = attention_apply(p, x, acfg, rope_cos=rope.cos,
                                    rope_sin=rope.sin, kv_cache=cache)
             assert bool(np.isfinite(np.asarray(y)).all()) is finite, offset
+
+    @pytest.mark.parametrize("delta,dot_cap", [(-1, 32), (0, 32),
+                                               (1, 256)])
+    def test_window_boundary_cap_selection(self, delta, dot_cap):
+        """prefill_len one below / exactly at / one above the window:
+        a dot-impl prefill that FITS the W-slot buffer rolls (cap W);
+        one token over keeps the full-length cache (its own writes
+        would evict history mid-chunk). The flash impl always rolls
+        (prefill outputs come from the raw k/v)."""
+        from megatron_tpu.inference.generation import init_kv_caches
+        _, cfg = self._model(32, impl="dot")
+        c = init_kv_caches(cfg, 1, 256, prefill_len=32 + delta)
+        assert c.k.shape[2] == dot_cap, delta
+        _, cfgf = self._model(32, impl="flash")
+        cf = init_kv_caches(cfgf, 1, 256, prefill_len=32 + delta)
+        assert cf.k.shape[2] == 32, delta
+
+    @pytest.mark.parametrize("delta", [-1, 0, 1])
+    def test_window_boundary_outputs_match_banded_oracle(self, delta):
+        """Greedy decode with prefill_len W-1 / W / W+1 must match the
+        banded NO-CACHE oracle token-for-token whichever cache layout
+        (rolling W-slot vs full buffer) the boundary selects."""
+        params, cfg = self._model(32, impl="dot")
+        plen = 32 + delta
+        prompt = list(np.random.RandomState(10 + delta).randint(
+            1, 96, plen))
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        toks, _, lp = gen.generate(
+            [prompt], 8, sampling=SamplingParams(temperature=0.0))
+        assert np.isfinite(np.asarray(lp)).all()
+        rope = lm.make_rope(cfg)
+        seq = list(prompt)
+        for _ in range(8):
+            logits, _ = lm.model_forward(params, jnp.asarray([seq]), cfg,
+                                         rope=rope)
+            nxt = int(jnp.argmax(logits[0, -1, :cfg.vocab_size]))
+            seq.append(nxt)
+            if nxt == 0:
+                break
+        np.testing.assert_array_equal(np.asarray(toks[0, :len(seq)]),
+                                      np.asarray(seq), err_msg=str(delta))
+
+    def test_below_window_equals_non_windowed_cache(self):
+        """Total length <= W: the band covers all history, so the
+        windowed model on its ROLLING cache must equal the NON-windowed
+        model on its full cache bit-for-bit (same params — init depends
+        only on shapes)."""
+        import dataclasses as dc
+        params, cfg = self._model(32, impl="dot")
+        cfg_full = dc.replace(cfg, sliding_window=None)
+        prompt = list(np.random.RandomState(20).randint(1, 96, 31))
+        out = {}
+        for name, c in (("rolling", cfg), ("full", cfg_full)):
+            gen = Generator(params, c, eos_id=0, pad_id=0)
+            toks, lens, _ = gen.generate(
+                [prompt], 1, sampling=SamplingParams(temperature=0.0))
+            out[name] = np.asarray(toks[0, :lens[0]])
+        np.testing.assert_array_equal(out["rolling"], out["full"])
 
     def test_rolling_with_int8_cache(self):
         """Rolling + int8 quantized cache compose: finite outputs and

@@ -48,6 +48,22 @@ def main(argv=None):
                    help="serve with an int8 KV cache: halves the cache "
                         "stream and residency — at 7B/32k the bf16 "
                         "cache alone outgrows a v5e")
+    # continuous-batching engine knobs (megatron_tpu/serving)
+    p.add_argument("--num_slots", type=int, default=None,
+                   help="batch slots in the persistent decode grid = "
+                        "max concurrently-decoding requests. Default: "
+                        "up to 8, clamped to what free device memory "
+                        "fits AFTER the weights (the slot-grid pool is "
+                        "allocated eagerly — 8 full-context Llama-7B "
+                        "bf16 slots alone are ~17 GB)")
+    p.add_argument("--max_queue", type=int, default=64,
+                   help="bounded admission queue; overflow returns 429")
+    p.add_argument("--serving_max_len", type=int, default=None,
+                   help="per-slot KV region length (prompt+generated); "
+                        "defaults to max_position_embeddings")
+    p.add_argument("--serial", action="store_true",
+                   help="serve with the reference's serial one-lock "
+                        "path instead of the continuous-batching engine")
     args = p.parse_args(argv)
 
     cfg = ckpt.load_config_from_checkpoint(args.load)
@@ -75,7 +91,26 @@ def main(argv=None):
     gen = Generator(params, mcfg, eos_id=tokenizer.eod,
                     kv_cache_dtype=jnp.int8 if args.int8_kv
                     else jnp.bfloat16)
-    MegatronServer(gen, tokenizer).run(args.host, args.port)
+    from megatron_tpu.config import ServingConfig
+    num_slots = args.num_slots
+    if num_slots is None and not args.serial:
+        # size the eager slot-grid pool to the memory the weights left
+        # free (a fixed 8-slot default OOMs 7B-class serving on a v5e)
+        from megatron_tpu.serving.kv_pool import fit_num_slots
+        from megatron_tpu.utils.logging import print_rank_0
+        num_slots = fit_num_slots(
+            mcfg, args.serving_max_len or mcfg.max_position_embeddings,
+            dtype=jnp.int8 if args.int8_kv else jnp.bfloat16)
+        print_rank_0(f"serving: auto-sized num_slots={num_slots} "
+                     "(override with --num_slots)")
+    if num_slots is None:  # serial fallback: engine never built
+        num_slots = 8
+    serving = ServingConfig(num_slots=num_slots,
+                            max_queue=args.max_queue,
+                            max_len=args.serving_max_len,
+                            serial_fallback=args.serial)
+    MegatronServer(gen, tokenizer, serving=serving).run(args.host,
+                                                        args.port)
 
 
 if __name__ == "__main__":

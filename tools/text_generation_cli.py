@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import json
 import sys
+import urllib.error
 import urllib.request
 
 
@@ -26,8 +27,18 @@ def main():
         req = urllib.request.Request(
             url, data=payload, method="PUT",
             headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req) as resp:
-            data = json.loads(resp.read())
+        try:
+            with urllib.request.urlopen(req) as resp:
+                data = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            # the server answers real statuses (400 bad request, 429
+            # queue full, 500) with a JSON message — print, don't crash
+            try:
+                msg = json.loads(e.read()).get("message", str(e))
+            except Exception:
+                msg = str(e)
+            print(f"Server error ({e.code}): {msg}")
+            continue
         print("Megatron Response:")
         print(data["text"][0])
 
