@@ -336,6 +336,11 @@ def _run_extras():
         # serving-side complement to bench_decode's single stream
         ("serving_bench.py", ["--requests", "32", "--slots", "8"],
          "/tmp/bench_extras_serving.log"),
+        # resilience smoke: scripted chaos run (transient write fault +
+        # NaN-streak rollback + corrupt-checkpoint fallback) — the
+        # recovery-latency record makes regressions in the resilience
+        # subsystem show up next to the perf numbers
+        ("chaos_train.py", ["--smoke"], "/tmp/bench_extras_chaos.log"),
         ("bench_32k.py", [], "/tmp/bench_extras_32k.log"),
         # 1F1B bubble curve vs n_micro (VERDICT r4 #7): tick-count
         # analysis on one chip, full fit on a multi-device mesh

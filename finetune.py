@@ -125,7 +125,8 @@ def main(argv=None):
     if load_dir:
         loaded, start_iteration, consumed = ckpt.load_checkpoint(
             load_dir, state, finetune=cfg.training.finetune,
-            no_load_optim=cfg.training.no_load_optim)
+            no_load_optim=cfg.training.no_load_optim,
+            resilience=cfg.resilience)
         if loaded is not None:
             state = loaded
 
@@ -138,10 +139,32 @@ def main(argv=None):
             ckpt.save_checkpoint(cfg.training.checkpoint_dir, st, cfg,
                                  iteration, consumed_samples)
 
+    # divergence-rollback hooks (docs/resilience.md): restore the newest
+    # valid checkpoint and rebuild the data stream with a shifted seed so
+    # the replayed segment sees a different sample order. Rollback only
+    # targets checkpoints THIS run writes (--save): restoring the --load
+    # base would resurrect its iteration counter / optimizer state (a
+    # finetune base "resumes" at its pretraining iteration and the loop
+    # would just exit)
+    load_fn = None
+    if cfg.training.checkpoint_dir:
+        def load_fn():
+            return ckpt.load_checkpoint(cfg.training.checkpoint_dir,
+                                        state,
+                                        resilience=cfg.resilience)
+
+    def reset_data_fn(consumed_samples, reseed):
+        import dataclasses
+        cfg2 = dataclasses.replace(cfg, training=dataclasses.replace(
+            cfg.training, seed=cfg.training.seed + reseed))
+        it, _, _ = build_data(cfg2, tokenizer, consumed_samples,
+                              mesh=mesh)
+        return it
+
     state, consumed = train(
         cfg, train_it, valid_it, mesh=mesh, state=state, rng=rng,
         start_iteration=start_iteration, consumed_samples=consumed,
-        save_fn=save_fn)
+        save_fn=save_fn, load_fn=load_fn, reset_data_fn=reset_data_fn)
     print_rank_0(f"training done at consumed_samples={consumed}")
     return 0
 

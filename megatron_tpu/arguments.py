@@ -16,6 +16,7 @@ from typing import Callable, Optional
 
 from megatron_tpu.config import (DataConfig, MegatronConfig, ModelConfig,
                                  OptimizerConfig, ParallelConfig,
+                                 ResilienceConfig, ServingConfig,
                                  TrainingConfig)
 
 
@@ -202,6 +203,40 @@ def build_parser(extra_args_provider: Optional[Callable] = None
     g.add_argument("--train_data_path", nargs="*", default=None)
     g.add_argument("--valid_data_path", nargs="*", default=None)
     g.add_argument("--test_data_path", nargs="*", default=None)
+
+    g = p.add_argument_group(
+        "resilience",
+        "fault tolerance for long preemptible runs (docs/resilience.md)")
+    g.add_argument("--no_checkpoint_integrity", action="store_true",
+                   help="skip writing/verifying per-checkpoint SHA-256 "
+                        "manifests")
+    g.add_argument("--keep_last_k", type=int, default=None,
+                   help="retain only the newest K iter_* checkpoints "
+                        "(the last VALID one always survives)")
+    g.add_argument("--io_retries", type=int, default=4,
+                   help="max attempts for checkpoint/tracker I/O "
+                        "(1 = no retry)")
+    g.add_argument("--io_backoff_s", type=float, default=0.5)
+    g.add_argument("--io_backoff_max_s", type=float, default=30.0)
+    g.add_argument("--max_consecutive_nonfinite", type=int, default=3,
+                   help="NaN/inf steps in a row before rolling back to "
+                        "the last checkpoint (0 disables)")
+    g.add_argument("--loss_spike_factor", type=float, default=None,
+                   help="roll back when a finite loss exceeds this "
+                        "multiple of the rolling mean (None disables)")
+    g.add_argument("--loss_spike_window", type=int, default=32)
+    g.add_argument("--max_rollbacks", type=int, default=2,
+                   help="divergence rollbacks before aborting with "
+                        "TrainingDivergedError")
+    g.add_argument("--step_timeout_s", type=float, default=None,
+                   help="hung-step watchdog deadline; on expiry dump "
+                        "stacks, attempt a final checkpoint, exit with "
+                        "--watchdog_exit_code (None disables)")
+    g.add_argument("--watchdog_exit_code", type=int, default=43)
+    g.add_argument("--request_deadline_s", type=float, default=None,
+                   help="serving: per-request wall-clock deadline "
+                        "(expired requests are evicted with a "
+                        "504-style error)")
 
     g = p.add_argument_group(
         "reference compat",
@@ -471,6 +506,11 @@ def config_from_args(args: argparse.Namespace,
             "rampup_batch_size": tuple(args.rampup_batch_size)
             if args.rampup_batch_size else None}),
         data=DataConfig(**_pick(args, DataConfig)),
+        serving=ServingConfig(
+            request_deadline_s=args.request_deadline_s),
+        resilience=ResilienceConfig(**{
+            **_pick(args, ResilienceConfig),
+            "checkpoint_integrity": not args.no_checkpoint_integrity}),
     )
     return cfg.validate(n_devices=n_devices)
 

@@ -369,12 +369,18 @@ class ServingConfig:
     kv_dtype: Optional[str] = None
     prefill_bucket: int = 16
     serial_fallback: bool = False
+    # per-request wall-clock deadline measured from submit: queued or
+    # running requests past it are evicted and fail with
+    # DeadlineExceededError (→ HTTP 504). None = no deadline.
+    request_deadline_s: Optional[float] = None
 
     def validate(self, model: Optional["ModelConfig"] = None
                  ) -> "ServingConfig":
         assert self.num_slots >= 1, self.num_slots
         assert self.max_queue >= 1, self.max_queue
         assert self.prefill_bucket >= 1, self.prefill_bucket
+        assert self.request_deadline_s is None or \
+            self.request_deadline_s > 0.0, self.request_deadline_s
         assert self.kv_dtype is None or \
             self.kv_dtype in SERVING_KV_DTYPES, self.kv_dtype
         if self.max_len is not None:
@@ -388,6 +394,59 @@ class ServingConfig:
 
 
 @dataclass(frozen=True)
+class ResilienceConfig:
+    """Fault-tolerance knobs (megatron_tpu/resilience/ — ABSENT in the
+    reference beyond SIGTERM + NaN counting; see docs/resilience.md).
+
+    Checkpoint integrity: `checkpoint_integrity` writes a per-checkpoint
+    SHA-256 manifest on save and verifies it on load, falling back to
+    the newest valid checkpoint when the tracker names a torn/corrupt
+    one. `keep_last_k` prunes old iter_* dirs after each save but never
+    deletes the last valid checkpoint. Retrying I/O: checkpoint/tracker
+    reads+writes retry `io_retries` times with exponential backoff
+    (`io_backoff_s` doubling up to `io_backoff_max_s`, ±`io_jitter`).
+    Divergence guard: after `max_consecutive_nonfinite` NaN/inf steps
+    (0 disables) or a finite loss above `loss_spike_factor` × the
+    rolling `loss_spike_window`-step mean, the loop rolls back to the
+    last checkpoint with a re-seeded data order; more than
+    `max_rollbacks` rollbacks aborts with TrainingDivergedError.
+    Watchdog: a train step exceeding `step_timeout_s` (None disables)
+    dumps stacks, attempts a final checkpoint, and exits with
+    `watchdog_exit_code` so a supervisor can distinguish hangs."""
+
+    checkpoint_integrity: bool = True
+    keep_last_k: Optional[int] = None
+    io_retries: int = 4
+    io_backoff_s: float = 0.5
+    io_backoff_max_s: float = 30.0
+    io_jitter: float = 0.25
+    max_consecutive_nonfinite: int = 3
+    loss_spike_factor: Optional[float] = None
+    loss_spike_window: int = 32
+    max_rollbacks: int = 2
+    step_timeout_s: Optional[float] = None
+    watchdog_exit_code: int = 43
+
+    def validate(self) -> "ResilienceConfig":
+        assert self.io_retries >= 1, self.io_retries
+        assert self.io_backoff_s >= 0.0
+        assert self.io_backoff_max_s >= self.io_backoff_s
+        assert 0.0 <= self.io_jitter <= 1.0, self.io_jitter
+        assert self.keep_last_k is None or self.keep_last_k >= 1, (
+            f"keep_last_k={self.keep_last_k} must be >= 1 (None keeps "
+            "all)")
+        assert self.max_consecutive_nonfinite >= 0
+        assert self.loss_spike_factor is None or \
+            self.loss_spike_factor > 1.0, (
+            f"loss_spike_factor={self.loss_spike_factor} must exceed "
+            "1.0 (it multiplies the rolling mean)")
+        assert self.loss_spike_window >= 1
+        assert self.max_rollbacks >= 0
+        assert self.step_timeout_s is None or self.step_timeout_s > 0.0
+        return self
+
+
+@dataclass(frozen=True)
 class MegatronConfig:
     model: ModelConfig = field(default_factory=ModelConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
@@ -395,6 +454,7 @@ class MegatronConfig:
     training: TrainingConfig = field(default_factory=TrainingConfig)
     data: DataConfig = field(default_factory=DataConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     def validate(self, n_devices: Optional[int] = None) -> "MegatronConfig":
         """Derive + consistency-check, mirroring validate_args
@@ -525,6 +585,7 @@ class MegatronConfig:
                 f"global batch {tr.global_batch_size} must be divisible by "
                 f"micro_batch*dp={tr.micro_batch_size * par.data_parallel}")
         self.serving.validate(model)
+        self.resilience.validate()
         return dataclasses.replace(self, model=model, parallel=par, training=tr)
 
     @property
@@ -547,6 +608,7 @@ class MegatronConfig:
             training=build(TrainingConfig, d.get("training", {})),
             data=build(DataConfig, d.get("data", {})),
             serving=build(ServingConfig, d.get("serving", {})),
+            resilience=build(ResilienceConfig, d.get("resilience", {})),
         )
 
 

@@ -14,9 +14,12 @@ a long request no longer blocks every other caller. The reference's
 serial one-lock path is kept behind `ServingConfig(serial_fallback=
 True)` (and always serves beam search, which stays whole-batch). Proper
 HTTP statuses on BOTH transport backends: 400 for invalid payloads
-(shared validator), 429 when the bounded admission queue overflows,
-500 for internal errors. `GET /metrics` exposes the ServingMetrics
-snapshot.
+(shared validator), 429 when the bounded admission queue overflows (or
+the engine is draining for shutdown), 503 for queued work dropped by a
+drain, 504 when a request outlives
+`ServingConfig.request_deadline_s`, 500 for internal errors.
+`GET /metrics` exposes the ServingMetrics snapshot. SIGTERM drains
+gracefully: stop admitting, finish in-flight slots, then exit.
 
 The reference needs a rank-0 Flask thread that broadcasts a GENERATE/BEAM
 signal to all other ranks sitting in a receive loop
@@ -102,6 +105,46 @@ class MegatronServer:
         if self.engine is not None:
             self.engine.close()
 
+    def drain(self, timeout: Optional[float] = 120.0) -> bool:
+        """Graceful shutdown: stop admitting, finish in-flight slots,
+        then stop the engine. Serial mode has no queue to drain — the
+        one-lock path finishes its current batch when the process
+        exits."""
+        if self.engine is None:
+            return True
+        drained = self.engine.drain(timeout)
+        if not drained:
+            self.engine.close()  # stragglers fail hard rather than hang
+        return drained
+
+    def install_sigterm_drain(self, shutdown_cb=None) -> bool:
+        """SIGTERM -> drain + stop serving (the k8s/rolling-restart
+        contract: the pod gets its grace period to finish in-flight
+        work). `shutdown_cb` stops the HTTP front end once the drain
+        completes. Returns False outside the main thread (signal
+        handlers can only install there — tests drive `drain()`
+        directly)."""
+        import signal as _signal
+
+        def _on_sigterm(signum, frame):
+            print_rank_0("SIGTERM: draining serving engine "
+                         "(no new admissions; finishing in-flight)")
+            t = threading.Thread(target=self._drain_and_shutdown,
+                                 args=(shutdown_cb,), daemon=True,
+                                 name="sigterm-drain")
+            t.start()
+
+        try:
+            _signal.signal(_signal.SIGTERM, _on_sigterm)
+            return True
+        except ValueError:  # not the main thread
+            return False
+
+    def _drain_and_shutdown(self, shutdown_cb):
+        self.drain()
+        if shutdown_cb is not None:
+            shutdown_cb()
+
     def _seed_for(self, payload) -> int:
         """Explicit random_seed stays deterministic; unseeded requests
         mix real entropy with a per-process counter so traffic differs
@@ -119,7 +162,10 @@ class MegatronServer:
         err = validate_generate_payload(payload)
         if err is not None:
             return 400, {"message": err}
-        from megatron_tpu.serving import AdmissionError, QueueFullError
+        from megatron_tpu.serving import (AdmissionError,
+                                          DeadlineExceededError,
+                                          QueueFullError,
+                                          ServiceUnavailableError)
         try:
             if payload.get("beam_width"):
                 return 200, self._handle_beam(payload)
@@ -128,6 +174,14 @@ class MegatronServer:
             return 200, self._handle_serial(payload)
         except QueueFullError as e:
             return 429, {"message": str(e)}
+        except DeadlineExceededError as e:
+            # per-request deadline expiry (ServingConfig.
+            # request_deadline_s): the engine evicted the request —
+            # gateway-timeout semantics, retryable by the client
+            return 504, {"message": str(e)}
+        except ServiceUnavailableError as e:
+            # queued work dropped by a graceful drain: retry elsewhere
+            return 503, {"message": str(e)}
         except AdmissionError as e:
             # only explicit admission failures are client errors; a bare
             # ValueError from inside the model stack stays a 500 (it is
@@ -310,6 +364,12 @@ class MegatronServer:
             return jsonify(server.metrics_snapshot()), 200
 
         print_rank_0(f"serving (flask) on {host}:{port}/api")
+        # flask's dev server has no programmatic shutdown, and the
+        # drain callback runs on a worker thread where signal.signal()
+        # would raise — once the engine is drained there is nothing
+        # left to clean up, so exit the process directly
+        import os as _os
+        self.install_sigterm_drain(shutdown_cb=lambda: _os._exit(0))
         app.run(host=host, port=port, threaded=True)
 
     def _run_stdlib(self, host, port):
@@ -352,4 +412,8 @@ class MegatronServer:
 
         print_rank_0(f"serving (http.server) on {host}:{port}/api")
         httpd = ThreadingHTTPServer((host, port), Handler)
+        # SIGTERM drains in-flight work, then shutdown() unblocks
+        # serve_forever for a clean exit (rolling-restart contract)
+        self.install_sigterm_drain(shutdown_cb=httpd.shutdown)
         httpd.serve_forever()
+        httpd.server_close()

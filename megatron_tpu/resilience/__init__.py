@@ -1,0 +1,22 @@
+"""Fault-tolerance subsystem: survive the failure modes that dominate
+week-long preemptible training runs (see docs/resilience.md).
+
+- `integrity`: checkpoint manifests + verification, newest-valid
+  fallback, retention that never strands the run;
+- `retry`: exponential-backoff + jitter wrapper for flaky storage I/O;
+- `guard`: divergence policy (skip / rollback / abort);
+- `watchdog`: hung-step monitor with a distinct exit code;
+- `faults`: the injection harness that proves all of the above
+  end-to-end (tests/test_resilience.py, tools/chaos_train.py).
+"""
+from megatron_tpu.resilience.faults import (  # noqa: F401
+    FaultInjector, InjectedFault, activate, deactivate, fault_point,
+    get_fault_injector, use_fault_injector)
+from megatron_tpu.resilience.guard import (  # noqa: F401
+    DivergenceGuard, GuardAction, TrainingDivergedError)
+from megatron_tpu.resilience.integrity import (  # noqa: F401
+    MANIFEST, apply_retention, find_latest_valid, list_iter_checkpoints,
+    verify_checkpoint, write_manifest)
+from megatron_tpu.resilience.retry import (  # noqa: F401
+    RetryPolicy, policy_from, retry)
+from megatron_tpu.resilience.watchdog import StepWatchdog  # noqa: F401

@@ -1,0 +1,229 @@
+"""Fault-injection harness: make every failure path testable on demand.
+
+The resilience subsystem's claims (retry survives transient write
+errors, the loop rolls back a NaN streak, the watchdog catches a hung
+step, integrity catches a corrupt checkpoint) are only claims until a
+fault actually fires. `FaultInjector` is the single switchboard that
+fires them deterministically:
+
+- **transient write errors**: named *fault points* inside the
+  checkpoint I/O path (`fault_point("checkpoint_write")`, ...) consult
+  the active injector and raise `OSError` on configured call counts —
+  the retry layer then has a real exception to absorb;
+- **NaN injection**: `corrupt_batch` poisons a batch's loss_mask with
+  +inf so the loss AND gradients genuinely go non-finite through the
+  real compiled train step (no metric faking);
+- **step delays**: `maybe_delay` stalls the host between steps, the
+  observable shape of a hung infeed/host callback, to trip the
+  watchdog;
+- **checkpoint corruption**: `corrupt_file`/`corrupt_checkpoint` flip
+  bytes on disk so integrity verification has something to catch.
+
+Activation is process-global (`activate`/`deactivate` or the
+`with use_fault_injector(...)` context) and OFF by default — production
+code paths pay one `is None` check. `FaultInjector.from_env` parses the
+`MEGATRON_TPU_FAULTS` spec used by tools/chaos_train.py, e.g.
+``write_error@2,write_error@3,nan@5,nan@6,delay@4:1.5`` meaning: fail
+the 2nd and 3rd checkpoint writes, poison the 5th and 6th train-step
+calls, sleep 1.5s before the 4th.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, Optional, Set
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# the active injector (process-global switchboard)
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional["FaultInjector"] = None
+_LOCK = threading.Lock()
+
+
+def get_fault_injector() -> Optional["FaultInjector"]:
+    return _ACTIVE
+
+
+def activate(injector: "FaultInjector") -> "FaultInjector":
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = injector
+    return injector
+
+
+def deactivate() -> None:
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = None
+
+
+@contextlib.contextmanager
+def use_fault_injector(injector: "FaultInjector"):
+    activate(injector)
+    try:
+        yield injector
+    finally:
+        deactivate()
+
+
+def fault_point(name: str) -> None:
+    """Named hook inside production I/O paths. No-op (one attribute
+    read) unless an injector is active and armed for `name`."""
+    inj = _ACTIVE
+    if inj is not None:
+        inj.check(name)
+
+
+class InjectedFault(OSError):
+    """Transient-looking failure raised at a fault point. Subclasses
+    OSError so the retry layer treats it exactly like a real
+    filesystem flake."""
+
+
+class FaultInjector:
+    """Deterministic fault schedule, keyed by per-name call counts.
+
+    `transient_errors`: fault-point name -> set of 1-based call counts
+    that raise `InjectedFault` (each fires once).
+    `nan_step_calls`: 1-based train-step CALL counts (monotonic across
+    rollbacks — a replayed iteration is a new call) whose batch gets
+    poisoned.
+    `delay_step_calls`: step call count -> seconds to sleep before it.
+    """
+
+    def __init__(self,
+                 transient_errors: Optional[Dict[str, Set[int]]] = None,
+                 nan_step_calls: Optional[Set[int]] = None,
+                 delay_step_calls: Optional[Dict[int, float]] = None):
+        self.transient_errors = {
+            k: set(v) for k, v in (transient_errors or {}).items()}
+        self.nan_step_calls = set(nan_step_calls or ())
+        self.delay_step_calls = dict(delay_step_calls or {})
+        self._counts: Dict[str, int] = {}
+        self._step_calls = 0
+        self._lock = threading.Lock()
+        # audit trail: (kind, detail) of every fault actually fired
+        self.fired: list = []
+
+    # ---- fault points (I/O) ------------------------------------------
+    def check(self, name: str) -> None:
+        with self._lock:
+            n = self._counts.get(name, 0) + 1
+            self._counts[name] = n
+            armed = n in self.transient_errors.get(name, ())
+            if armed:
+                self.fired.append(("transient_error", f"{name}@{n}"))
+        if armed:
+            raise InjectedFault(
+                f"injected transient failure at {name} (call {n})")
+
+    # ---- train-step hooks --------------------------------------------
+    def next_step_call(self) -> int:
+        """Advance the step-call counter; the loop calls this once per
+        executed train step (replays after rollback keep counting)."""
+        with self._lock:
+            self._step_calls += 1
+            return self._step_calls
+
+    def maybe_delay(self, step_call: int,
+                    sleep=time.sleep) -> float:
+        d = self.delay_step_calls.get(step_call, 0.0)
+        if d > 0.0:
+            with self._lock:
+                self.fired.append(("delay", f"step@{step_call}:{d}"))
+            sleep(d)
+        return d
+
+    def corrupt_batch(self, batch: dict, step_call: int) -> dict:
+        """Poison the loss_mask with +inf so the REAL compiled step
+        produces a non-finite loss and non-finite gradients — the
+        honest end-to-end shape of a divergence, not a faked metric."""
+        if step_call not in self.nan_step_calls:
+            return batch
+        with self._lock:
+            self.fired.append(("nan", f"step@{step_call}"))
+        batch = dict(batch)
+        mask = np.asarray(batch.get("loss_mask"), dtype=np.float32).copy()
+        mask[...] = np.inf
+        batch["loss_mask"] = mask
+        return batch
+
+    # ---- on-disk corruption (static helpers) -------------------------
+    @staticmethod
+    def corrupt_file(path: str, offset: int = 0, nbytes: int = 8) -> None:
+        """Flip `nbytes` bytes in place — simulated bit rot / torn
+        write."""
+        size = os.path.getsize(path)
+        if size == 0:
+            with open(path, "wb") as f:
+                f.write(b"\xff" * nbytes)
+            return
+        offset = min(offset, size - 1)
+        with open(path, "r+b") as f:
+            f.seek(offset)
+            chunk = f.read(min(nbytes, size - offset))
+            f.seek(offset)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+
+    @staticmethod
+    def corrupt_checkpoint(ckpt_dir: str, nbytes: int = 8) -> str:
+        """Corrupt the largest payload file under an iteration dir
+        (skipping the manifest itself) and return its path."""
+        from megatron_tpu.resilience.integrity import MANIFEST
+        victim, vsize = None, -1
+        for root, _, files in os.walk(ckpt_dir):
+            for fn in files:
+                if fn == MANIFEST:
+                    continue
+                p = os.path.join(root, fn)
+                s = os.path.getsize(p)
+                if s > vsize:
+                    victim, vsize = p, s
+        if victim is None:
+            raise FileNotFoundError(f"no files to corrupt in {ckpt_dir}")
+        FaultInjector.corrupt_file(victim, offset=max(vsize // 2, 0),
+                                   nbytes=nbytes)
+        return victim
+
+    # ---- env-driven construction -------------------------------------
+    ENV_VAR = "MEGATRON_TPU_FAULTS"
+
+    @classmethod
+    def from_env(cls, spec: Optional[str] = None
+                 ) -> Optional["FaultInjector"]:
+        """Parse a comma-separated spec (see module docstring). Returns
+        None when the spec is empty/absent. Unknown kinds raise — a
+        typo'd chaos schedule must not silently test nothing."""
+        spec = spec if spec is not None else os.environ.get(cls.ENV_VAR, "")
+        spec = spec.strip()
+        if not spec:
+            return None
+        transient: Dict[str, Set[int]] = {}
+        nans: Set[int] = set()
+        delays: Dict[int, float] = {}
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            kind, _, arg = item.partition("@")
+            if kind == "write_error":
+                transient.setdefault("checkpoint_write", set()).add(
+                    int(arg))
+            elif kind == "tracker_error":
+                transient.setdefault("tracker_read", set()).add(int(arg))
+            elif kind == "nan":
+                nans.add(int(arg))
+            elif kind == "delay":
+                n, _, secs = arg.partition(":")
+                delays[int(n)] = float(secs or 1.0)
+            else:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} in {cls.ENV_VAR} "
+                    f"(valid: write_error, tracker_error, nan, delay)")
+        return cls(transient_errors=transient, nan_step_calls=nans,
+                   delay_step_calls=delays)

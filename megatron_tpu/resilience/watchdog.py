@@ -1,0 +1,145 @@
+"""Hung-step watchdog: a stuck train step must kill the process, loudly.
+
+On TPU pods the classic wedge modes — a hung collective, a stalled
+infeed, a host callback that never returns — leave the process ALIVE
+but making no progress, which no exit-code supervisor can see; the
+reference has nothing for this (its dist_signal_handler only covers
+SIGTERM, ref: megatron/dist_signal_handler.py:50-81). `StepWatchdog`
+is a monitor thread armed by a per-step `heartbeat()`: when no
+heartbeat lands within `timeout_s` it
+
+1. dumps every thread's stack via `faulthandler` (the post-mortem for
+   "where was it stuck"),
+2. runs the `on_timeout` callback (the loop passes a best-effort
+   final-checkpoint attempt),
+3. exits the process with a DISTINCT code (default 43) so a
+   supervisor/restart policy can tell "hung" from "crashed" from
+   "clean exit".
+
+The loop arms it only after the first step completes — the first step
+includes the jit compile, whose duration is unrelated to the steady
+state the deadline protects.
+"""
+from __future__ import annotations
+
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+# module-level exit hook: tests monkeypatch this to observe a firing
+# without losing the process
+_exit = os._exit
+
+DEFAULT_EXIT_CODE = 43
+
+
+class StepWatchdog:
+    """Deadline monitor. `start()` arms it; `heartbeat()` resets the
+    deadline; `stop()` disarms (idempotent, called from the loop's
+    finally)."""
+
+    def __init__(self, timeout_s: float,
+                 on_timeout: Optional[Callable[[], None]] = None,
+                 exit_code: int = DEFAULT_EXIT_CODE,
+                 poll_s: Optional[float] = None,
+                 dump_stacks: bool = True,
+                 on_timeout_budget_s: float = 60.0):
+        assert timeout_s > 0.0, timeout_s
+        self.timeout_s = float(timeout_s)
+        self.on_timeout = on_timeout
+        self.exit_code = int(exit_code)
+        self.poll_s = poll_s if poll_s is not None else min(
+            self.timeout_s / 4.0, 1.0)
+        self.dump_stacks = dump_stacks
+        # hard bound on the final-checkpoint callback: when the hang IS
+        # the storage, an unbounded save attempt would wedge the
+        # watchdog itself and the exit would never happen
+        self.on_timeout_budget_s = float(on_timeout_budget_s)
+        self.fired = False
+        self._last = time.monotonic()
+        self._suspended = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def started(self) -> bool:
+        return self._thread is not None
+
+    def start(self) -> "StepWatchdog":
+        if self._thread is not None:
+            return self
+        self._last = time.monotonic()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="step-watchdog")
+        self._thread.start()
+        return self
+
+    def heartbeat(self) -> None:
+        self._last = time.monotonic()
+
+    def suspend(self) -> "StepWatchdog":
+        """Pause deadline checking across a phase whose duration is
+        unrelated to step health (eval sweep, checkpoint save):
+
+            with watchdog.suspend(): evaluate(...)
+
+        The deadline clock restarts at resume."""
+        self._suspended = True
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._suspended = False
+        self._last = time.monotonic()
+        return False
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+
+    def _run(self) -> None:
+        from megatron_tpu.utils.logging import print_rank_0
+        while not self._stop.wait(self.poll_s):
+            if self._suspended:
+                self._last = time.monotonic()
+                continue
+            stalled = time.monotonic() - self._last
+            if stalled <= self.timeout_s:
+                continue
+            self.fired = True
+            print_rank_0(
+                f"watchdog: no step progress for {stalled:.1f}s "
+                f"(deadline {self.timeout_s:.1f}s); dumping stacks and "
+                f"exiting with code {self.exit_code}")
+            if self.dump_stacks:
+                try:
+                    faulthandler.dump_traceback(file=sys.stderr,
+                                                all_threads=True)
+                except Exception:  # noqa: BLE001 — never block the exit
+                    pass
+            if self.on_timeout is not None:
+                # bounded: run the final-checkpoint attempt in a daemon
+                # thread so a wedged storage stack cannot block the exit
+                def _cb():
+                    try:
+                        self.on_timeout()
+                    except Exception as e:  # noqa: BLE001
+                        print_rank_0(f"watchdog: on_timeout callback "
+                                     f"failed: {e!r}")
+                t = threading.Thread(target=_cb, daemon=True,
+                                     name="watchdog-final-checkpoint")
+                t.start()
+                t.join(self.on_timeout_budget_s)
+                if t.is_alive():
+                    print_rank_0("watchdog: final checkpoint attempt "
+                                 f"exceeded {self.on_timeout_budget_s}s; "
+                                 "exiting without it")
+            _exit(self.exit_code)
+            return  # only reached when _exit is monkeypatched in tests

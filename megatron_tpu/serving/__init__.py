@@ -5,6 +5,7 @@ from megatron_tpu.serving.kv_pool import (  # noqa: F401
     SlotKVPool, insert_prefill)
 from megatron_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from megatron_tpu.serving.request import (  # noqa: F401
-    GenRequest, RequestState, SamplingOptions)
+    DeadlineExceededError, GenRequest, RequestState, SamplingOptions,
+    ServiceUnavailableError)
 from megatron_tpu.serving.scheduler import (  # noqa: F401
     AdmissionError, FIFOScheduler, QueueFullError)

@@ -103,6 +103,8 @@ class ServingEngine:
         self._steps = 0
         self._cond = threading.Condition()
         self._stop = False
+        self._draining = False
+        self._deadline_s = self.serving.request_deadline_s
         self._broken: Optional[str] = None
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serving-engine")
@@ -120,6 +122,11 @@ class ServingEngine:
         AdmissionError (→ 400) when the request can never fit."""
         if self._broken:
             raise RuntimeError(f"engine failed: {self._broken}")
+        if self._draining:
+            from megatron_tpu.serving.scheduler import QueueFullError
+            raise QueueFullError(
+                "engine draining (shutdown in progress); retry against "
+                "another replica")
         req = GenRequest(list(prompt), max_new_tokens, sampling, seed)
         self.metrics.count("requests_received")
         try:
@@ -172,6 +179,31 @@ class ServingEngine:
         for req in self._slot_req:
             if req is not None and req.state is RequestState.RUNNING:
                 req.fail("engine shut down")
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop admitting (queued-but-unstarted
+        requests fail immediately with a retry-later error; new submits
+        are rejected the same way), let every IN-FLIGHT slot decode to
+        completion, then stop the loop. Returns True when all in-flight
+        work finished within `timeout` (None = wait indefinitely);
+        False leaves the stragglers to `close()`'s hard failure. The
+        SIGTERM handler in inference/server.py calls this so a rolling
+        restart never truncates a response mid-stream."""
+        self._draining = True
+        backlog = self.scheduler.close()
+        for req in backlog:
+            req.fail("engine draining (shutdown in progress); retry "
+                     "against another replica", kind="unavailable")
+        if backlog:
+            self.metrics.count("requests_rejected", len(backlog))
+        self._wake()
+        if self._thread.ident is not None:
+            self._thread.join(timeout)
+        drained = not self._thread.is_alive()
+        if drained:
+            print_rank_0("serving engine drained: all in-flight "
+                         "requests completed")
+        return drained
 
     def __enter__(self):
         return self
@@ -268,13 +300,17 @@ class ServingEngine:
             f"queue bound {self.serving.max_queue}")
         while True:
             with self._cond:
-                while (not self._stop and self.scheduler.depth() == 0
+                while (not self._stop and not self._draining
+                       and self.scheduler.depth() == 0
                        and not self._active.any()):
                     self._cond.wait(timeout=0.5)
                 if self._stop:
                     return
+                if self._draining and not self._active.any():
+                    return  # drained: queue closed, slots empty
             try:
                 self._reap_cancelled()
+                self._reap_expired()
                 self._admit()
                 if self._active.any():
                     self._step()
@@ -326,15 +362,41 @@ class ServingEngine:
             if req is not None and req.cancelled:
                 self._evict(slot, failed="cancelled")
 
-    def _evict(self, slot: int, failed: Optional[str] = None):
+    def _reap_expired(self):
+        """Per-request deadline (ServingConfig.request_deadline_s):
+        evict running slots and drop queued requests whose wall clock
+        ran out — their callers have already timed out; decoding for
+        them starves live traffic."""
+        if self._deadline_s is None:
+            return
+        import time
+        now = time.monotonic()
+        for slot in np.nonzero(self._active)[0]:
+            req = self._slot_req[slot]
+            if req is not None and \
+                    now - req.submit_time > self._deadline_s:
+                self._evict(
+                    slot,
+                    failed=(f"deadline exceeded after "
+                            f"{now - req.submit_time:.1f}s "
+                            f"(deadline {self._deadline_s:.1f}s, "
+                            f"{len(req.generated)} tokens generated)"),
+                    kind="deadline")
+        expired = self.scheduler.drop_expired(self._deadline_s, now)
+        if expired:
+            self.metrics.count("requests_expired", len(expired))
+
+    def _evict(self, slot: int, failed: Optional[str] = None,
+               kind: str = "error"):
         req = self._slot_req[slot]
         self._slot_req[slot] = None
         self._active[slot] = False
         self._lengths[slot] = 0  # inactive rows park at position 0
         self.pool.release(slot)
         if failed is not None:
-            req.fail(failed)
-            self.metrics.count("requests_cancelled")
+            req.fail(failed, kind=kind)
+            self.metrics.count("requests_expired" if kind == "deadline"
+                               else "requests_cancelled")
             return
         req.finish()
         self.metrics.record_completed(

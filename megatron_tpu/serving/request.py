@@ -21,7 +21,18 @@ class RequestState(enum.Enum):
     QUEUED = "queued"        # accepted, waiting for a free slot
     RUNNING = "running"      # prefilled into a slot, decoding
     FINISHED = "finished"    # EOS or max_new_tokens reached
-    FAILED = "failed"        # engine error or shutdown
+    FAILED = "failed"        # engine error, deadline, or shutdown
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request outlived its per-request deadline (queued or
+    running) and was evicted — the HTTP layer maps this to 504."""
+
+
+class ServiceUnavailableError(RuntimeError):
+    """The request was dropped because the engine is draining for
+    shutdown (queued work is not carried across restarts) — the HTTP
+    layer maps this to 503 so clients retry against another replica."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,6 +68,7 @@ class GenRequest:
         self.generated: List[int] = []
         self.gen_logprobs: List[float] = []
         self.error: Optional[str] = None
+        self.error_kind: str = "error"
         # lifecycle timestamps (metrics: queue wait, TTFT, decode rate)
         self.submit_time = time.monotonic()
         self.admit_time: Optional[float] = None
@@ -87,9 +99,12 @@ class GenRequest:
         self.finish_time = time.monotonic()
         self._done.set()
 
-    def fail(self, msg: str):
+    def fail(self, msg: str, kind: str = "error"):
+        """`kind` picks the exception `result()` raises: "deadline" →
+        DeadlineExceededError (504), anything else → RuntimeError."""
         self.state = RequestState.FAILED
         self.error = msg
+        self.error_kind = kind
         self.finish_time = time.monotonic()
         self._done.set()
 
@@ -104,6 +119,13 @@ class GenRequest:
         if not self._done.wait(timeout):
             raise TimeoutError(f"request {self.id} still {self.state}")
         if self.state is RequestState.FAILED:
+            kind = getattr(self, "error_kind", "error")
+            if kind == "deadline":
+                raise DeadlineExceededError(
+                    f"request {self.id}: {self.error}")
+            if kind == "unavailable":
+                raise ServiceUnavailableError(
+                    f"request {self.id}: {self.error}")
             raise RuntimeError(f"request {self.id} failed: {self.error}")
         return self.prompt + self.generated, list(self.gen_logprobs)
 

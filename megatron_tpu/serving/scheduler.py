@@ -88,6 +88,26 @@ class FIFOScheduler:
         req.fail("cancelled")
         return True
 
+    def drop_expired(self, deadline_s: float, now: float) -> List[GenRequest]:
+        """Remove queued requests older than `deadline_s` and fail them
+        with a deadline error (engine loop only) — a request that waited
+        out its whole deadline in the queue must 504, not start decoding
+        output its caller already gave up on."""
+        expired: List[GenRequest] = []
+        with self._lock:
+            keep = collections.deque()
+            for req in self._q:
+                if now - req.submit_time > deadline_s:
+                    expired.append(req)
+                else:
+                    keep.append(req)
+            self._q = keep
+        for req in expired:
+            req.fail(f"deadline exceeded after "
+                     f"{now - req.submit_time:.1f}s in queue "
+                     f"(deadline {deadline_s:.1f}s)", kind="deadline")
+        return expired
+
     def depth(self) -> int:
         with self._lock:
             return len(self._q)

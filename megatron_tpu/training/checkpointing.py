@@ -39,7 +39,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from megatron_tpu.config import MegatronConfig
+from megatron_tpu.config import MegatronConfig, ResilienceConfig
+from megatron_tpu.resilience import integrity
+from megatron_tpu.resilience.faults import fault_point
+from megatron_tpu.resilience.retry import RetryPolicy, policy_from, retry
 from megatron_tpu.training.train_step import TrainState
 from megatron_tpu.utils.logging import print_rank_0
 
@@ -48,7 +51,11 @@ STATE_DIR = "state"  # orbax pytree directory inside an iteration dir
 
 # one async checkpointer per process; saves are serialized through it
 _ASYNC_CKPTR = None
-_PENDING_TRACKERS: list[tuple[str, str]] = []
+# (root, tag, ckpt_dir, resilience) awaiting durability; the manifest
+# and tracker publish in finalize_async_saves, in this order, so the
+# tracker can never name a checkpoint whose manifest (and therefore
+# whose payload) is not fully on disk
+_PENDING_TRACKERS: list[tuple[str, str, str, ResilienceConfig]] = []
 
 
 def _orbax():
@@ -64,16 +71,47 @@ def _get_async_checkpointer():
     return _ASYNC_CKPTR
 
 
+def _write_text_atomic(path: str, text: str,
+                       policy: RetryPolicy = RetryPolicy()) -> None:
+    """Tracker/metadata writes: fault-injectable, retried, and atomic
+    (tmp + rename — a crash mid-write can tear a direct tracker write,
+    and a torn tracker strands EVERY restart until a human edits it)."""
+
+    def _write():
+        fault_point("checkpoint_write")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    retry(_write, policy, label=f"write:{os.path.basename(path)}")
+
+
+def _publish(root: str, tag: str, d: str,
+             resil: ResilienceConfig) -> None:
+    """Seal + announce one durable checkpoint: manifest (integrity
+    gate), then tracker (visibility), then retention (pruning — only
+    after the new checkpoint is fully published)."""
+    policy = policy_from(resil)
+    if resil.checkpoint_integrity:
+        retry(lambda: integrity.write_manifest(d), policy,
+              label="write_manifest")
+    _write_text_atomic(os.path.join(root, TRACKER), tag, policy)
+    if resil.keep_last_k:
+        integrity.apply_retention(root, resil.keep_last_k)
+
+
 def finalize_async_saves() -> None:
     """Block until in-flight async saves are durable, then publish their
-    tracker entries. Called automatically before the next save and must be
-    called before process exit (the train loop does)."""
+    manifest + tracker entries. Called automatically before the next
+    save and must be called before process exit (the train loop does)."""
     global _PENDING_TRACKERS
     if _ASYNC_CKPTR is not None:
         _ASYNC_CKPTR.wait_until_finished()
-    for root, tag in _PENDING_TRACKERS:
-        with open(os.path.join(root, TRACKER), "w") as f:
-            f.write(tag)
+    for root, tag, d, resil in _PENDING_TRACKERS:
+        _publish(root, tag, d, resil)
     _PENDING_TRACKERS = []
 
 
@@ -137,12 +175,20 @@ def save_checkpoint(
     backend="orbax" (default) writes per-device shards via TensorStore —
     a sharded state never gathers onto one host. backend="npz" keeps the
     round-1 single-file format. async_save=True returns once the save is
-    scheduled; the tracker is published by `finalize_async_saves()` (run
-    automatically before the next save), so a crash mid-write can never
-    leave the tracker naming a torn checkpoint."""
+    scheduled; the manifest + tracker are published by
+    `finalize_async_saves()` (run automatically before the next save),
+    so a crash mid-write can never leave the tracker naming a torn
+    checkpoint.
+
+    Resilience (cfg.resilience, docs/resilience.md): every file write is
+    retried with exponential backoff, a SHA-256 `manifest.json` seals
+    the checkpoint before the tracker names it, and `keep_last_k` prunes
+    old iter_* dirs after a successful publish."""
     finalize_async_saves()  # serialize with any in-flight save (all
     # backends: an npz tracker written now must not be regressed by a
     # pending async tracker publishing later)
+    resil = getattr(cfg, "resilience", None) or ResilienceConfig()
+    policy = policy_from(resil)
     d = _iter_dir(root, iteration, release)
     os.makedirs(d, exist_ok=True)
     tag = "release" if release else str(iteration)
@@ -157,15 +203,19 @@ def save_checkpoint(
         ocp = _orbax()
         state_path = os.path.join(os.path.abspath(d), STATE_DIR)
         ckptr.save(state_path, args=ocp.args.StandardSave(tree), force=True)
-        if async_save:
-            _PENDING_TRACKERS.append((root, tag))
-        else:
+        if not async_save:
             ckptr.wait_until_finished()
     elif backend == "npz":
-        np.savez(os.path.join(d, "params.npz"), **_flatten(state.params))
+
+        def _savez(path, tree_part):
+            def _write():
+                fault_point("checkpoint_write")
+                np.savez(path, **_flatten(tree_part))
+            retry(_write, policy, label=f"write:{os.path.basename(path)}")
+
+        _savez(os.path.join(d, "params.npz"), state.params)
         if state.opt_state is not None and not release:
-            np.savez(os.path.join(d, "opt_state.npz"),
-                     **_flatten(state.opt_state))
+            _savez(os.path.join(d, "opt_state.npz"), state.opt_state)
     else:
         raise ValueError(f"unknown checkpoint backend {backend!r}")
 
@@ -176,24 +226,50 @@ def save_checkpoint(
         "has_opt_state": "opt_state" in tree,
         "format_version": 2 if backend == "orbax" else 1,
     }
-    with open(os.path.join(d, "metadata.json"), "w") as f:
-        json.dump(meta, f, indent=2)
-    with open(os.path.join(d, "config.json"), "w") as f:
-        f.write(cfg.to_json())
-    if not (backend == "orbax" and async_save):
-        with open(os.path.join(root, TRACKER), "w") as f:
-            f.write(tag)
+    _write_text_atomic(os.path.join(d, "metadata.json"),
+                       json.dumps(meta, indent=2), policy)
+    _write_text_atomic(os.path.join(d, "config.json"), cfg.to_json(),
+                       policy)
+    if backend == "orbax" and async_save:
+        # payload not yet durable: manifest + tracker (+ retention)
+        # publish in finalize_async_saves
+        _PENDING_TRACKERS.append((root, tag, d, resil))
+    else:
+        _publish(root, tag, d, resil)
     print_rank_0(f"saved checkpoint to {d} (iteration {iteration}"
                  f"{', async' if async_save else ''})")
     return d
 
 
-def read_tracker(root: str) -> Optional[str]:
+def read_tracker(root: str,
+                 policy: RetryPolicy = RetryPolicy()) -> Optional[str]:
     p = os.path.join(root, TRACKER)
     if not os.path.exists(p):
         return None
-    with open(p) as f:
-        return f.read().strip()
+
+    def _read():
+        fault_point("tracker_read")
+        with open(p) as f:
+            return f.read().strip()
+
+    return retry(_read, policy, label="tracker_read")
+
+
+def _dir_for_tag(root: str, tag: Optional[str]) -> Optional[str]:
+    """Tracker tag -> checkpoint dir; None for a missing/empty/garbage
+    tag (an empty or corrupted tracker file must read as "no
+    checkpoint", not crash on int())."""
+    if not tag:
+        return None
+    if tag == "release":
+        return os.path.join(root, "release")
+    try:
+        return os.path.join(root, f"iter_{int(tag):07d}")
+    except ValueError:
+        print_rank_0(f"warning: tracker in {root} holds garbage "
+                     f"({tag!r}); treating as no tracker and scanning "
+                     "for the newest valid iter_* checkpoint")
+        return None
 
 
 def load_checkpoint(
@@ -203,21 +279,95 @@ def load_checkpoint(
     shardings: Optional[TrainState] = None,
     finetune: bool = False,
     no_load_optim: bool = False,
+    resilience: Optional[ResilienceConfig] = None,
 ) -> tuple[Optional[TrainState], int, int]:
     """Load newest checkpoint under `root`.
 
     Returns (state, iteration, consumed_samples); (None, 0, 0) if absent
     (ref: checkpointing.py:561-643 load_checkpoint). `finetune` loads model
-    weights only and resets iteration/optimizer (ref: --finetune)."""
-    tag = read_tracker(root)
-    if tag is None:
+    weights only and resets iteration/optimizer (ref: --finetune).
+
+    Robust to a bad tip: an empty/garbage tracker is treated as "no
+    tracker", and (with `resilience.checkpoint_integrity`, the default)
+    each candidate is verified against its SHA-256 manifest before any
+    tensor is read — a torn/corrupt checkpoint is skipped with a warning
+    and the newest VALID `iter_*` checkpoint loads instead. Only when no
+    candidate survives does this return (None, 0, 0)."""
+    resil = resilience or ResilienceConfig()
+    policy = policy_from(resil)
+    tag = read_tracker(root, policy)
+    tracked = _dir_for_tag(root, tag)
+    if tag is None and not integrity.list_iter_checkpoints(root):
         print_rank_0(f"no checkpoint tracker in {root}; starting from scratch")
         return None, 0, 0
-    release = tag == "release"
-    d = os.path.join(root, "release" if release else f"iter_{int(tag):07d}")
-    with open(os.path.join(d, "metadata.json")) as f:
-        meta = json.load(f)
 
+    # candidate order: the tracker-named dir, then every other iter_*
+    # dir newest-first (the fallback chain for a torn/corrupt tip)
+    candidates = []
+    if tracked is not None:
+        candidates.append(tracked)
+    for _, d2 in integrity.list_iter_checkpoints(root):
+        if d2 not in candidates:
+            candidates.append(d2)
+
+    for d in candidates:
+        if not os.path.isdir(d):
+            print_rank_0(f"warning: tracker names missing checkpoint "
+                         f"{d}; falling back")
+            continue
+        # integrity disabled = the caller opted out of fallback
+        # machinery: restore errors propagate as before
+        verified = not resil.checkpoint_integrity
+        if resil.checkpoint_integrity:
+            ok, why = integrity.verify_checkpoint(d)
+            if not ok:
+                print_rank_0(f"warning: checkpoint {d} failed integrity "
+                             f"verification ({why}); falling back to "
+                             "the previous valid checkpoint")
+                continue
+            verified = why == "ok"
+            if not verified:
+                print_rank_0(f"checkpoint {d}: {why}")
+        try:
+            with open(os.path.join(d, "metadata.json")) as f:
+                meta = json.load(f)
+        except (OSError, ValueError) as e:
+            print_rank_0(f"warning: checkpoint {d} metadata unreadable "
+                         f"({e}); falling back")
+            continue
+        try:
+            return _restore_from_dir(d, meta, example_state,
+                                     shardings=shardings,
+                                     finetune=finetune,
+                                     no_load_optim=no_load_optim)
+        except Exception as e:  # noqa: BLE001 — see below
+            if verified:
+                # the payload checksummed clean, so this is a REAL
+                # error (tree/shape mismatch, wrong model config) —
+                # silently falling back would mask a misconfiguration
+                raise
+            # no manifest to vouch for this dir (e.g. an async save
+            # whose process died before finalize published one): a
+            # restore failure means it is torn — keep falling back
+            print_rank_0(f"warning: restore from unverified checkpoint "
+                         f"{d} failed ({type(e).__name__}: {e}); "
+                         "falling back")
+            continue
+
+    print_rank_0(f"no valid checkpoint under {root}; starting from scratch")
+    return None, 0, 0
+
+
+def _restore_from_dir(
+    d: str,
+    meta: dict,
+    example_state: TrainState,
+    *,
+    shardings: Optional[TrainState] = None,
+    finetune: bool = False,
+    no_load_optim: bool = False,
+) -> tuple[Optional[TrainState], int, int]:
+    release = bool(meta.get("release", os.path.basename(d) == "release"))
     load_optim = (not finetune and not no_load_optim and not release
                   and example_state.opt_state is not None)
     state_path = os.path.join(os.path.abspath(d), STATE_DIR)
@@ -256,13 +406,22 @@ def load_checkpoint(
         def do_restore(target):
             # partial_restore: unwanted subtrees (optimizer moments for
             # finetune / inference loads) are never read off disk — a 70B
-            # Adam state must not materialize just to be discarded
+            # Adam state must not materialize just to be discarded.
+            # Older orbax (< 0.9) has no partial_restore kwarg: its
+            # transforms-mode restore with an empty transforms dict is
+            # the same contract (item is the target structure; on-disk
+            # leaves absent from it are never read)
+            restore_kwargs = dict(
+                item=target,
+                restore_args=jax.tree.map(_restore_args, target))
             with ocp.PyTreeCheckpointer() as ckptr:
-                return ckptr.restore(
-                    state_path, args=ocp.args.PyTreeRestore(
-                        item=target,
-                        restore_args=jax.tree.map(_restore_args, target),
-                        partial_restore=True))
+                try:
+                    args = ocp.args.PyTreeRestore(partial_restore=True,
+                                                  **restore_kwargs)
+                except TypeError:
+                    args = ocp.args.PyTreeRestore(transforms={},
+                                                  **restore_kwargs)
+                return ckptr.restore(state_path, args=args)
 
         try:
             # no explicit shardings: let orbax re-apply the layout from
@@ -312,13 +471,20 @@ def load_checkpoint(
 
 
 def load_config_from_checkpoint(root: str) -> Optional[MegatronConfig]:
-    """`use_checkpoint_args` (ref: checkpointing.py:476-558)."""
-    tag = read_tracker(root)
-    if tag is None:
-        return None
-    d = os.path.join(root, "release" if tag == "release" else f"iter_{int(tag):07d}")
-    with open(os.path.join(d, "config.json")) as f:
-        return MegatronConfig.from_dict(json.load(f))
+    """`use_checkpoint_args` (ref: checkpointing.py:476-558). Shares
+    load_checkpoint's tolerance for a garbage tracker: falls back to
+    the newest iter_* dir whose config is readable."""
+    d = _dir_for_tag(root, read_tracker(root))
+    candidates = ([d] if d is not None else []) + \
+        [d2 for _, d2 in integrity.list_iter_checkpoints(root)
+         if d2 != d]
+    for c in candidates:
+        try:
+            with open(os.path.join(c, "config.json")) as f:
+                return MegatronConfig.from_dict(json.load(f))
+        except (OSError, ValueError):
+            continue
+    return None
 
 
 def merge_restored_params(fresh, restored, *, label: str = "checkpoint"):

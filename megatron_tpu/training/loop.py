@@ -23,7 +23,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from megatron_tpu.config import MegatronConfig
+from megatron_tpu.config import MegatronConfig, ResilienceConfig
+from megatron_tpu.resilience import (DivergenceGuard, GuardAction,
+                                     StepWatchdog, TrainingDivergedError,
+                                     get_fault_injector)
 # NOTE: the package __init__ re-exports the train_step FUNCTION under the
 # same name as its module, so `import ...train_step as ts` would resolve to
 # the function attribute — import the symbols directly instead
@@ -87,16 +90,31 @@ def evaluate(state: TrainState, eval_iterator, eval_step_fn,
              eval_iters: int, mesh=None, batch_sh=None) -> dict:
     """(ref: training.py:754-807) mean lm loss + ppl over eval_iters batches.
     `batch_sh` lifts host batches to global arrays on multi-host runs (same
-    invariant as the train path)."""
+    invariant as the train path). A finite `eval_iterator` that runs dry
+    mid-eval stops early and averages over the batches actually seen —
+    an exhausted validation split must not kill the training run. With
+    ZERO batches seen (the iterator was already dead) returns None so
+    the caller skips reporting instead of logging a fake 0.0 loss."""
     total = 0.0
+    seen = 0
     for _ in range(eval_iters):
-        batch = next(eval_iterator)
+        try:
+            batch = next(eval_iterator)
+        except StopIteration:
+            print_rank_0(f"evaluate: valid iterator exhausted after "
+                         f"{seen}/{eval_iters} batches; "
+                         + ("averaging over the batches seen" if seen
+                           else "skipping this eval interval"))
+            break
         if batch_sh is not None:
             from megatron_tpu.parallel.multihost import make_global_batch
             batch = make_global_batch(batch, mesh, batch_sh)
         loss = eval_step_fn(state.params, batch)
         total += float(loss)
-    mean = total / max(eval_iters, 1)
+        seen += 1
+    if seen == 0:
+        return None
+    mean = total / seen
     return {"lm loss": mean, "lm loss ppl": float(np.exp(min(mean, 20.0)))}
 
 
@@ -111,13 +129,29 @@ def train(
     consumed_samples: int = 0,
     save_fn: Optional[Callable] = None,
     step_kwargs: Optional[dict] = None,
+    load_fn: Optional[Callable] = None,
+    reset_data_fn: Optional[Callable] = None,
 ):
     """The `_train` loop (ref: training.py:639-751). `train_iterator` yields
     {"tokens": [n_micro, mbs, seq+1], "loss_mask": [n_micro, mbs, seq]}.
     `step_kwargs` forwards to make_train_step (loss_fn / init_params_fn /
     axes_fn — the pretrain_bert/t5/ict entry points' extension hook,
     mirroring the reference's forward_step_func argument to `pretrain`).
-    Returns (state, consumed_samples)."""
+    Returns (state, consumed_samples).
+
+    Resilience hooks (cfg.resilience, docs/resilience.md): `load_fn()
+    -> (state, iteration, consumed_samples) | None` restores the newest
+    valid checkpoint when the divergence guard orders a rollback;
+    `reset_data_fn(consumed_samples, reseed) -> iterator` rebuilds the
+    training stream with a re-seeded order for the replayed segment (a
+    rollback that replays the exact batches that diverged would diverge
+    again). Without `load_fn`, a guard breach aborts with
+    TrainingDivergedError instead of burning compute on a dead run. A
+    `step_timeout_s` watchdog (armed after the first, compile-heavy
+    step) dumps stacks, attempts a final checkpoint, and exits with a
+    distinct code when a step wedges. An active FaultInjector
+    (resilience/faults.py) can poison batches / stall steps here — the
+    chaos-test entry points."""
     timers = Timers()
     wandb_kwargs = {}
     if cfg.training.wandb_logger:
@@ -161,6 +195,25 @@ def train(
     seq_len = cfg.model.seq_length
     trace_active = False
 
+    res = getattr(cfg, "resilience", None) or ResilienceConfig()
+    guard = DivergenceGuard(
+        max_consecutive_nonfinite=res.max_consecutive_nonfinite,
+        loss_spike_factor=res.loss_spike_factor,
+        loss_spike_window=res.loss_spike_window,
+        max_rollbacks=res.max_rollbacks)
+    injector = get_fault_injector()
+    base_rng = rng
+    watchdog = None
+    if res.step_timeout_s:
+        def _watchdog_checkpoint():
+            # best-effort final checkpoint from the monitor thread; the
+            # closure reads the loop's CURRENT state/iteration
+            if save_fn is not None:
+                save_fn(state, iteration, consumed_samples)
+        watchdog = StepWatchdog(res.step_timeout_s,
+                                on_timeout=_watchdog_checkpoint,
+                                exit_code=res.watchdog_exit_code)
+
     # pod-scale feeding: host batches must become globally sharded arrays
     # when >1 process drives the mesh (single-process: identity)
     batch_sh = None
@@ -179,6 +232,8 @@ def train(
 
     try:
         while iteration < cfg.training.train_iters:
+            if watchdog is not None:
+                watchdog.heartbeat()
             calc.update(consumed_samples)
             # batch-size rampup: propagate the current microbatch count into the
             # iterator so the yielded batch matches what we account for below.
@@ -187,6 +242,10 @@ def train(
             if hasattr(train_iterator, "num_microbatches"):
                 train_iterator.num_microbatches = calc.num_microbatches
             batch = next(train_iterator)
+            if injector is not None:
+                step_call = injector.next_step_call()
+                injector.maybe_delay(step_call)
+                batch = injector.corrupt_batch(batch, step_call)
             if batch_sh is not None:
                 from megatron_tpu.parallel.multihost import make_global_batch
                 batch = make_global_batch(batch, mesh, batch_sh)
@@ -201,6 +260,12 @@ def train(
             state, metrics = step_fn(state, batch, step_rng)
             jax.block_until_ready(metrics["lm_loss"])
             timers("train-step").stop()
+            if watchdog is not None:
+                watchdog.heartbeat()
+                if not watchdog.started:
+                    # arm only now: the first step's jit compile is
+                    # unrelated to the steady-state deadline
+                    watchdog.start()
             if iteration == start_iteration:
                 # HBM report after the first step (ref: training.py:522-524
                 # report_memory_flag)
@@ -216,10 +281,70 @@ def train(
             iteration += 1
             interval_iters += 1
             consumed_samples += calc.global_batch_size
-            if bool(metrics["found_inf"]):
+            loss_val = float(metrics["lm_loss"])
+            found_inf = bool(metrics["found_inf"])
+            if found_inf:
                 skipped_total += 1
-            if not np.isfinite(float(metrics["lm_loss"])):
+            if not np.isfinite(loss_val):
                 nan_total += 1
+
+            if guard.enabled:
+                action = guard.observe(loss_val, found_inf)
+                if action is GuardAction.ROLLBACK:
+                    exhausted = guard.note_rollback()
+                    if exhausted:
+                        raise TrainingDivergedError(
+                            f"divergence persisted through "
+                            f"{guard.rollbacks - 1} rollback(s) at "
+                            f"iteration {iteration}; aborting cleanly")
+                    if load_fn is None:
+                        raise TrainingDivergedError(
+                            f"divergence at iteration {iteration} "
+                            f"({guard.max_consecutive_nonfinite} "
+                            "consecutive non-finite steps or loss "
+                            "spike) with no checkpoint to roll back "
+                            "to — configure --save to enable rollback")
+                    print_rank_0(
+                        f"divergence guard: rolling back at iteration "
+                        f"{iteration} (rollback {guard.rollbacks}/"
+                        f"{res.max_rollbacks})")
+                    loaded = load_fn()
+                    if loaded is None or loaded[0] is None:
+                        raise TrainingDivergedError(
+                            "rollback requested but no restorable "
+                            "checkpoint was found")
+                    # rematerialize as fresh uncommitted buffers (a
+                    # REAL copy — np.asarray/jnp.asarray are zero-copy
+                    # on CPU): the step executable was compiled against
+                    # the ORIGINAL state's placement and DONATES its
+                    # inputs, so feeding it the restorer's committed /
+                    # aliased arrays lets the donation clobber the very
+                    # buffers the restore returned (NaN garbage or a
+                    # segfault on CPU jax 0.4.x)
+                    state = jax.tree.map(
+                        lambda x: jnp.array(np.asarray(x), copy=True),
+                        loaded[0])
+                    iteration, consumed_samples = (int(loaded[1]),
+                                                   int(loaded[2]))
+                    # re-seeded step randomness for the replayed
+                    # segment; identical batches + identical rng would
+                    # replay the same divergence
+                    rng = jax.random.fold_in(base_rng,
+                                             0x5EED + guard.rollbacks)
+                    if reset_data_fn is not None:
+                        if isinstance(train_iterator, PrefetchIterator):
+                            train_iterator.close()
+                        train_iterator = reset_data_fn(
+                            consumed_samples, guard.rollbacks)
+                        if (cfg.data.num_workers > 0
+                                and cfg.training.rampup_batch_size is None
+                                and not isinstance(train_iterator,
+                                                   PrefetchIterator)):
+                            train_iterator = PrefetchIterator(
+                                train_iterator)
+                    interval_t0 = time.perf_counter()
+                    interval_iters = 0
+                    continue
 
             if iteration % cfg.training.log_interval == 0:
                 dt = (time.perf_counter() - interval_t0) / max(interval_iters, 1)
@@ -241,12 +366,20 @@ def train(
                     eval_step_fn = _make_eval_step(
                         cfg, mesh, loss_fn=sk.get("loss_fn"),
                         axes_fn=sk.get("axes_fn"))
-                results = evaluate(state, valid_iterator, eval_step_fn,
-                                   cfg.training.eval_iters, mesh=mesh,
-                                   batch_sh=batch_sh)
-                print_rank_0(f"validation at iteration {iteration}: {results}")
-                for k, v in results.items():
-                    writer.add_scalar(f"lm-loss-validation/{k}", v, iteration)
+                # eval time is unrelated to step health: suspend the
+                # step deadline for its duration
+                with (watchdog.suspend() if watchdog is not None
+                      else _nullcontext()):
+                    results = evaluate(state, valid_iterator,
+                                       eval_step_fn,
+                                       cfg.training.eval_iters,
+                                       mesh=mesh, batch_sh=batch_sh)
+                if results is not None:
+                    print_rank_0(f"validation at iteration {iteration}: "
+                                 f"{results}")
+                    for k, v in results.items():
+                        writer.add_scalar(f"lm-loss-validation/{k}", v,
+                                          iteration)
 
             should_save = (save_fn is not None and cfg.training.save_interval and
                            iteration % cfg.training.save_interval == 0)
@@ -265,10 +398,16 @@ def train(
                     print_rank_0(f"exiting after {mins:.1f} min (exit_duration)")
                     exiting = True
             if should_save or (exiting and save_fn is not None):
-                save_fn(state, iteration, consumed_samples)
+                # a slow sync save is not a hung STEP — suspend the
+                # deadline while it runs
+                with (watchdog.suspend() if watchdog is not None
+                      else _nullcontext()):
+                    save_fn(state, iteration, consumed_samples)
             if exiting:
                 break
     finally:
+        if watchdog is not None:
+            watchdog.stop()
         # flush an in-flight profiler trace so early exits still produce it
         if trace_active:
             jax.profiler.stop_trace()

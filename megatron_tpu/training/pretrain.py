@@ -52,7 +52,8 @@ def run_pretrain(
     if load_dir:
         loaded, start_iteration, consumed = ckpt.load_checkpoint(
             load_dir, state, finetune=cfg.training.finetune,
-            no_load_optim=cfg.training.no_load_optim)
+            no_load_optim=cfg.training.no_load_optim,
+            resilience=cfg.resilience)
         if loaded is not None:
             state = loaded
 
@@ -74,11 +75,28 @@ def run_pretrain(
             ckpt.save_checkpoint(cfg.training.checkpoint_dir, st, cfg,
                                  iteration, consumed_samples)
 
+    # divergence-rollback hooks (docs/resilience.md): only checkpoints
+    # THIS run writes are rollback targets — see finetune.py
+    load_fn = None
+    if cfg.training.checkpoint_dir:
+        def load_fn():
+            return ckpt.load_checkpoint(cfg.training.checkpoint_dir,
+                                        state,
+                                        resilience=cfg.resilience)
+
+    def reset_data_fn(consumed_samples, reseed):
+        return DictBatchIterator(
+            dataset, cfg.training.micro_batch_size,
+            cfg.parallel.data_parallel or 1, cfg.num_microbatches,
+            consumed_samples=consumed_samples,
+            dataloader_type=cfg.data.dataloader_type,
+            seed=cfg.training.seed + reseed)
+
     state, consumed = train(
         cfg, train_it, valid_iterator=valid_it, mesh=mesh, state=state,
         rng=rng,
         start_iteration=start_iteration, consumed_samples=consumed,
-        save_fn=save_fn,
+        save_fn=save_fn, load_fn=load_fn, reset_data_fn=reset_data_fn,
         step_kwargs={"loss_fn": loss_fn, "init_params_fn": init_params_fn,
                      "axes_fn": axes_fn, "pipelined_spec": pipelined_spec,
                      "pipelined_loss_fn": pipelined_loss_fn})

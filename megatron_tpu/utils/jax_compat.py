@@ -1,0 +1,79 @@
+"""Compatibility shims for older jax releases.
+
+The parallelism code targets the current jax surface — `jax.set_mesh`
+(ambient-mesh context) and top-level `jax.shard_map` with `axis_names`
+partial-manual selection / `check_vma`. Older jax (< 0.6, e.g. 0.4.x)
+spells these `with mesh:` (thread-local resource env) and
+`jax.experimental.shard_map.shard_map(f, mesh, ..., auto=...,
+check_rep=...)`. Rather than fork every call site on a version check,
+`ensure_jax_compat()` (run once from the package __init__) fills the
+MISSING attributes in the jax namespace with equivalents:
+
+- `jax.set_mesh(mesh)` -> context manager entering the Mesh (sets the
+  same thread-local mesh the experimental shard_map resolves against);
+- `jax.shard_map(f, in_specs=..., out_specs=..., axis_names=...,
+  check_vma=...)` -> a wrapper that, at call time, resolves the ambient
+  physical mesh and lowers to the experimental shard_map with
+  `auto = mesh.axes - axis_names` and `check_rep=False` (partial-manual
+  regions predate per-value replication checking);
+- `jax.sharding.get_abstract_mesh()` -> the thread-local physical mesh
+  (an empty Mesh when none is active — same `.empty`/`.axis_names`
+  probing contract the call sites rely on).
+
+On a jax that already has these attributes this module does nothing —
+the shims exist only where the real API is absent, so behavior on
+current jax is untouched.
+"""
+from __future__ import annotations
+
+import contextlib
+
+
+def ensure_jax_compat() -> None:
+    import jax
+
+    if not hasattr(jax, "set_mesh"):
+
+        @contextlib.contextmanager
+        def set_mesh(mesh):
+            with mesh:
+                yield mesh
+
+        jax.set_mesh = set_mesh
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, *, in_specs, out_specs, mesh=None,
+                      axis_names=None, check_vma=True):
+            axis_names = (frozenset(axis_names)
+                          if axis_names is not None else None)
+
+            def wrapped(*args):
+                m = mesh
+                if m is None:
+                    from jax._src import mesh as mesh_lib
+                    m = mesh_lib.thread_resources.env.physical_mesh
+                    if m.empty:
+                        raise RuntimeError(
+                            "jax.shard_map compat shim: no ambient mesh "
+                            "— wrap the call in jax.set_mesh(mesh)")
+                manual = (axis_names if axis_names is not None
+                          else frozenset(m.axis_names))
+                auto = frozenset(m.axis_names) - manual
+                return _shard_map(
+                    f, mesh=m, in_specs=in_specs, out_specs=out_specs,
+                    check_rep=bool(check_vma) and not auto,
+                    auto=auto)(*args)
+
+            return wrapped
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+
+        def get_abstract_mesh():
+            from jax._src import mesh as mesh_lib
+            return mesh_lib.thread_resources.env.physical_mesh
+
+        jax.sharding.get_abstract_mesh = get_abstract_mesh

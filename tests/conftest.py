@@ -43,6 +43,12 @@ def pytest_configure(config):
         "slow: compile-heavy (8-device shard_map / pipeline / e2e) tests; "
         "deselect with `pytest -m 'not slow'` for the fast green/red tier "
         "(see README 'Running the tests')")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection / resilience tests (tests/"
+        "test_resilience.py) — deliberately corrupt checkpoints, fail "
+        "writes, poison batches, stall steps; sized to stay inside the "
+        "tier-1 budget, select with `pytest -m chaos`")
 
 
 @pytest.fixture(autouse=True, scope="module")

@@ -1,0 +1,783 @@
+"""Resilience subsystem tests (megatron_tpu/resilience + the paths it
+threads through training/checkpointing/serving).
+
+The acceptance gates from ISSUE 2, each proven END-TO-END under fault
+injection, on CPU, inside the tier-1 budget:
+
+- corrupt/empty tracker -> fallback to the newest valid iter_* dir;
+- torn/corrupt checkpoint named by the tracker -> detected by the
+  SHA-256 manifest, fallback to the previous valid checkpoint;
+- transient write errors -> absorbed by the retry layer, save succeeds;
+- NaN-streak -> the loop rolls back BIT-EXACT to the last checkpoint
+  (re-seeded data order) and the run completes; repeated divergence
+  aborts cleanly;
+- a stalled step -> the watchdog fires, attempts a final checkpoint,
+  and exits with the distinct code;
+- SIGTERM -> checkpoint-and-exit; async-save crash -> the tracker
+  never names a torn checkpoint, the next save publishes pending
+  trackers first;
+- serving: per-request deadline eviction (504 semantics) and graceful
+  drain.
+"""
+import json
+import os
+import signal
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+from megatron_tpu.config import (MegatronConfig, DataConfig, ModelConfig,
+                                 OptimizerConfig, ResilienceConfig,
+                                 TrainingConfig)
+from megatron_tpu.resilience import (DivergenceGuard, FaultInjector,
+                                     GuardAction, InjectedFault,
+                                     RetryPolicy, StepWatchdog,
+                                     TrainingDivergedError, fault_point,
+                                     integrity, retry, use_fault_injector)
+from megatron_tpu.resilience import watchdog as watchdog_mod
+from megatron_tpu.training import checkpointing as ckpt
+from megatron_tpu.training import init_train_state, make_train_step
+
+
+FAST_IO = dict(io_backoff_s=0.01, io_backoff_max_s=0.02)
+
+
+def tiny_cfg(**res_overrides):
+    model = ModelConfig(num_layers=2, hidden_size=32, num_attention_heads=2,
+                        vocab_size=64, seq_length=16).derived()
+    return MegatronConfig(
+        model=model,
+        optimizer=OptimizerConfig(lr=1e-3),
+        training=TrainingConfig(micro_batch_size=1, global_batch_size=2,
+                                train_iters=6, log_interval=100),
+        data=DataConfig(num_workers=0),
+        resilience=ResilienceConfig(**{**FAST_IO, **res_overrides}),
+    ).validate(n_devices=1)
+
+
+def _batch(key=1):
+    tokens = jax.random.randint(jax.random.PRNGKey(key), (2, 1, 17), 0, 64)
+    return {"tokens": np.asarray(tokens),
+            "loss_mask": np.ones((2, 1, 16), np.float32)}
+
+
+def _batches(seed=0):
+    i = 0
+    while True:
+        yield _batch(seed * 1000 + i)
+        i += 1
+
+
+# ---------------------------------------------------------------------------
+# retry
+# ---------------------------------------------------------------------------
+
+class TestRetry:
+    def test_succeeds_after_transient_failures(self):
+        calls = {"n": 0}
+        sleeps = []
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=4, base_delay_s=1.0,
+                            max_delay_s=10.0, jitter=0.0)
+        out = retry(flaky, policy, sleep=sleeps.append)
+        assert out == "ok" and calls["n"] == 3
+        assert sleeps == [1.0, 2.0]  # exponential, no jitter
+
+    def test_gives_up_and_reraises_last(self):
+        def always():
+            raise OSError("permanent-ish")
+
+        with pytest.raises(OSError, match="permanent-ish"):
+            retry(always, RetryPolicy(max_attempts=3, base_delay_s=0.0),
+                  sleep=lambda s: None)
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = {"n": 0}
+
+        def typo():
+            calls["n"] += 1
+            raise ValueError("bug, not flake")
+
+        with pytest.raises(ValueError):
+            retry(typo, RetryPolicy(max_attempts=5, base_delay_s=0.0),
+                  sleep=lambda s: None)
+        assert calls["n"] == 1
+
+    def test_delay_caps_at_max(self):
+        import random
+        p = RetryPolicy(base_delay_s=1.0, max_delay_s=4.0, jitter=0.0)
+        assert p.delay_for(10, random.Random(0)) == 4.0
+
+
+# ---------------------------------------------------------------------------
+# fault points
+# ---------------------------------------------------------------------------
+
+class TestFaultInjection:
+    def test_fault_point_fires_on_scheduled_calls_only(self):
+        inj = FaultInjector(transient_errors={"checkpoint_write": {2}})
+        with use_fault_injector(inj):
+            fault_point("checkpoint_write")  # call 1: clean
+            with pytest.raises(InjectedFault):
+                fault_point("checkpoint_write")  # call 2: fires
+            fault_point("checkpoint_write")  # call 3: clean again
+        fault_point("checkpoint_write")  # deactivated: no-op
+        assert inj.fired == [("transient_error", "checkpoint_write@2")]
+
+    def test_from_env_spec(self):
+        inj = FaultInjector.from_env(
+            "write_error@2, nan@5, nan@6, delay@3:1.5")
+        assert inj.transient_errors == {"checkpoint_write": {2}}
+        assert inj.nan_step_calls == {5, 6}
+        assert inj.delay_step_calls == {3: 1.5}
+        assert FaultInjector.from_env("") is None
+        with pytest.raises(ValueError):
+            FaultInjector.from_env("tyop@1")
+
+    def test_corrupt_batch_produces_nonfinite_loss(self):
+        cfg = tiny_cfg()
+        state = init_train_state(jax.random.PRNGKey(0), cfg)
+        step = make_train_step(cfg, donate=False)
+        inj = FaultInjector(nan_step_calls={1})
+        bad = inj.corrupt_batch(_batch(), 1)
+        _, m = step(state, bad, jax.random.PRNGKey(0))
+        assert not np.isfinite(float(m["lm_loss"]))
+        assert bool(m["found_inf"])
+
+
+# ---------------------------------------------------------------------------
+# integrity: manifests, verification, retention
+# ---------------------------------------------------------------------------
+
+class TestIntegrity:
+    def _fake_ckpt(self, root, it, payload=b"x" * 1024):
+        d = os.path.join(root, f"iter_{it:07d}")
+        os.makedirs(os.path.join(d, "state"), exist_ok=True)
+        with open(os.path.join(d, "metadata.json"), "w") as f:
+            json.dump({"iteration": it}, f)
+        with open(os.path.join(d, "state", "data.bin"), "wb") as f:
+            f.write(payload)
+        integrity.write_manifest(d)
+        return d
+
+    def test_verify_roundtrip_and_corruption(self, tmp_path):
+        d = self._fake_ckpt(str(tmp_path), 1)
+        ok, why = integrity.verify_checkpoint(d)
+        assert ok and why == "ok"
+        FaultInjector.corrupt_file(os.path.join(d, "state", "data.bin"),
+                                   offset=100)
+        ok, why = integrity.verify_checkpoint(d)
+        assert not ok and "checksum mismatch" in why
+
+    def test_verify_missing_file_and_no_manifest(self, tmp_path):
+        d = self._fake_ckpt(str(tmp_path), 1)
+        os.remove(os.path.join(d, "state", "data.bin"))
+        ok, why = integrity.verify_checkpoint(d)
+        assert not ok and "missing file" in why
+        # legacy dir: metadata but no manifest -> valid with warning
+        d2 = self._fake_ckpt(str(tmp_path), 2)
+        os.remove(os.path.join(d2, integrity.MANIFEST))
+        ok, why = integrity.verify_checkpoint(d2)
+        assert ok and "unverified" in why
+        # torn dir: no metadata at all -> invalid
+        os.remove(os.path.join(d2, "metadata.json"))
+        ok, _ = integrity.verify_checkpoint(d2)
+        assert not ok
+
+    def test_retention_prunes_oldest(self, tmp_path):
+        for it in (1, 2, 3, 4):
+            self._fake_ckpt(str(tmp_path), it)
+        deleted = integrity.apply_retention(str(tmp_path), keep_last_k=2)
+        assert sorted(os.path.basename(d) for d in deleted) == [
+            "iter_0000001", "iter_0000002"]
+        left = [d for _, d in integrity.list_iter_checkpoints(str(tmp_path))]
+        assert len(left) == 2
+
+    def test_retention_never_deletes_last_valid(self, tmp_path):
+        good = self._fake_ckpt(str(tmp_path), 1)
+        for it in (2, 3):
+            d = self._fake_ckpt(str(tmp_path), it)
+            # corrupt by truncating the payload (size mismatch — caught
+            # even by the shallow retention check)
+            with open(os.path.join(d, "state", "data.bin"), "wb") as f:
+                f.write(b"short")
+        deleted = integrity.apply_retention(str(tmp_path), keep_last_k=1)
+        assert good not in deleted  # newest VALID survives
+        assert os.path.isdir(good)
+        names = {os.path.basename(d) for _, d in
+                 integrity.list_iter_checkpoints(str(tmp_path))}
+        assert {"iter_0000001", "iter_0000003"} <= names
+
+
+# ---------------------------------------------------------------------------
+# checkpoint load: tracker garbage + torn-checkpoint fallback
+# ---------------------------------------------------------------------------
+
+class TestCheckpointFallback:
+    def _save_two(self, root, cfg):
+        state1 = init_train_state(jax.random.PRNGKey(1), cfg)
+        ckpt.save_checkpoint(root, state1, cfg, iteration=1,
+                             consumed_samples=2)
+        state2 = init_train_state(jax.random.PRNGKey(2), cfg)
+        ckpt.save_checkpoint(root, state2, cfg, iteration=2,
+                             consumed_samples=4)
+        return state1, state2
+
+    def test_garbage_tracker_falls_back_to_newest_valid(self, tmp_path):
+        cfg = tiny_cfg()
+        root = str(tmp_path)
+        _, state2 = self._save_two(root, cfg)
+        with open(os.path.join(root, ckpt.TRACKER), "w") as f:
+            f.write("not-a-number!!")
+        example = init_train_state(jax.random.PRNGKey(9), cfg)
+        loaded, it, consumed = ckpt.load_checkpoint(
+            root, example, resilience=cfg.resilience)
+        assert it == 2 and consumed == 4
+        np.testing.assert_array_equal(
+            np.asarray(jax.tree.leaves(loaded.params)[0]),
+            np.asarray(jax.tree.leaves(state2.params)[0]))
+
+    def test_empty_tracker_falls_back(self, tmp_path):
+        cfg = tiny_cfg()
+        root = str(tmp_path)
+        self._save_two(root, cfg)
+        open(os.path.join(root, ckpt.TRACKER), "w").close()
+        example = init_train_state(jax.random.PRNGKey(9), cfg)
+        _, it, _ = ckpt.load_checkpoint(root, example,
+                                        resilience=cfg.resilience)
+        assert it == 2
+
+    def test_garbage_tracker_no_dirs_is_no_checkpoint(self, tmp_path):
+        cfg = tiny_cfg()
+        root = str(tmp_path)
+        os.makedirs(root, exist_ok=True)
+        with open(os.path.join(root, ckpt.TRACKER), "w") as f:
+            f.write("garbage")
+        example = init_train_state(jax.random.PRNGKey(9), cfg)
+        loaded, it, consumed = ckpt.load_checkpoint(
+            root, example, resilience=cfg.resilience)
+        assert loaded is None and it == 0 and consumed == 0
+
+    def test_torn_tip_falls_back_to_previous_valid(self, tmp_path):
+        """The tracker names iter 2; iter 2's payload is bit-rotted.
+        Load must detect the corruption via the manifest and restore
+        iter 1 instead."""
+        cfg = tiny_cfg()
+        root = str(tmp_path)
+        state1, _ = self._save_two(root, cfg)
+        assert ckpt.read_tracker(root) == "2"
+        FaultInjector.corrupt_checkpoint(os.path.join(root, "iter_0000002"))
+        example = init_train_state(jax.random.PRNGKey(9), cfg)
+        loaded, it, consumed = ckpt.load_checkpoint(
+            root, example, resilience=cfg.resilience)
+        assert it == 1 and consumed == 2
+        for a, b in zip(jax.tree.leaves(loaded.params),
+                        jax.tree.leaves(state1.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_torn_unverified_tip_falls_back_on_restore_error(self,
+                                                             tmp_path):
+        """An async save whose process died before the manifest/tracker
+        published leaves a manifest-less dir with metadata but a torn
+        payload. It verifies only as 'unverified', so a restore failure
+        must continue the fallback chain instead of killing the run."""
+        cfg = tiny_cfg()
+        root = str(tmp_path)
+        state1, _ = self._save_two(root, cfg)
+        torn = os.path.join(root, "iter_0000003")
+        os.makedirs(os.path.join(torn, "state"), exist_ok=True)
+        with open(os.path.join(torn, "metadata.json"), "w") as f:
+            json.dump({"iteration": 3, "consumed_samples": 6,
+                       "release": False, "has_opt_state": True}, f)
+        # state dir exists but holds garbage instead of an orbax tree
+        with open(os.path.join(torn, "state", "junk"), "wb") as f:
+            f.write(b"\x00" * 64)
+        example = init_train_state(jax.random.PRNGKey(9), cfg)
+        loaded, it, consumed = ckpt.load_checkpoint(
+            root, example, resilience=cfg.resilience)
+        assert it == 2 and consumed == 4 and loaded is not None
+
+    def test_transient_write_errors_survive_via_retry(self, tmp_path):
+        """The 2nd and 4th checkpoint-write fault-point calls raise; the
+        retry layer absorbs both and the save lands valid."""
+        cfg = tiny_cfg()
+        root = str(tmp_path)
+        state = init_train_state(jax.random.PRNGKey(0), cfg)
+        inj = FaultInjector(
+            transient_errors={"checkpoint_write": {2, 4}})
+        with use_fault_injector(inj):
+            ckpt.save_checkpoint(root, state, cfg, iteration=3,
+                                 consumed_samples=6)
+        assert [k for k, _ in inj.fired] == ["transient_error"] * 2
+        ok, why = integrity.verify_checkpoint(
+            os.path.join(root, "iter_0000003"))
+        assert ok and why == "ok"
+        example = init_train_state(jax.random.PRNGKey(9), cfg)
+        loaded, it, consumed = ckpt.load_checkpoint(
+            root, example, resilience=cfg.resilience)
+        assert it == 3 and consumed == 6
+
+    def test_retention_on_save(self, tmp_path):
+        cfg = tiny_cfg(keep_last_k=2)
+        root = str(tmp_path)
+        state = init_train_state(jax.random.PRNGKey(0), cfg)
+        for it in (1, 2, 3):
+            ckpt.save_checkpoint(root, state, cfg, iteration=it)
+        names = sorted(os.path.basename(d) for _, d in
+                       integrity.list_iter_checkpoints(root))
+        assert names == ["iter_0000002", "iter_0000003"]
+
+
+# ---------------------------------------------------------------------------
+# async-save publish ordering (satellite: crash-safety of the tracker)
+# ---------------------------------------------------------------------------
+
+class TestAsyncSaveOrdering:
+    def test_crash_before_finalize_leaves_no_tracker(self, tmp_path):
+        """An async save whose process dies before finalize must leave
+        the tracker UNTOUCHED (naming the previous checkpoint or
+        nothing) — never the in-flight one."""
+        cfg = tiny_cfg()
+        root = str(tmp_path)
+        state = init_train_state(jax.random.PRNGKey(0), cfg)
+        ckpt.save_checkpoint(root, state, cfg, iteration=5,
+                             async_save=True)
+        # simulated crash: finalize never runs. The tracker must not
+        # name iteration 5 (the write may not be durable).
+        assert ckpt.read_tracker(root) is None
+        # drop the pending entry as a dead process would
+        ckpt._ASYNC_CKPTR.wait_until_finished()
+        ckpt._PENDING_TRACKERS.clear()
+
+    def test_next_save_publishes_pending_trackers_first(self, tmp_path):
+        cfg = tiny_cfg()
+        root = str(tmp_path)
+        state = init_train_state(jax.random.PRNGKey(0), cfg)
+        ckpt.save_checkpoint(root, state, cfg, iteration=5,
+                             consumed_samples=10, async_save=True)
+        assert ckpt.read_tracker(root) is None  # not yet durable
+        # the NEXT save finalizes the pending one before its own write,
+        # so iteration 5 gets manifest+tracker, then 6 supersedes it
+        ckpt.save_checkpoint(root, state, cfg, iteration=6,
+                             consumed_samples=12)
+        assert ckpt.read_tracker(root) == "6"
+        for it in (5, 6):
+            ok, why = integrity.verify_checkpoint(
+                os.path.join(root, f"iter_{it:07d}"))
+            assert ok and why == "ok", (it, why)
+
+    def test_finalize_publishes_manifest_and_tracker(self, tmp_path):
+        cfg = tiny_cfg()
+        root = str(tmp_path)
+        state = init_train_state(jax.random.PRNGKey(0), cfg)
+        ckpt.save_checkpoint(root, state, cfg, iteration=7,
+                             consumed_samples=14, async_save=True)
+        ckpt.finalize_async_saves()
+        assert ckpt.read_tracker(root) == "7"
+        example = init_train_state(jax.random.PRNGKey(9), cfg)
+        loaded, it, consumed = ckpt.load_checkpoint(
+            root, example, resilience=cfg.resilience)
+        assert it == 7 and consumed == 14
+
+
+# ---------------------------------------------------------------------------
+# divergence guard (unit) + NaN-streak rollback through the real loop
+# ---------------------------------------------------------------------------
+
+class TestDivergenceGuard:
+    def test_streak_triggers_rollback(self):
+        g = DivergenceGuard(max_consecutive_nonfinite=3)
+        assert g.observe(1.0, False) is GuardAction.OK
+        assert g.observe(float("nan"), False) is GuardAction.SKIP
+        assert g.observe(2.0, True) is GuardAction.SKIP
+        assert g.observe(float("inf"), False) is GuardAction.ROLLBACK
+
+    def test_finite_step_resets_streak(self):
+        g = DivergenceGuard(max_consecutive_nonfinite=2)
+        assert g.observe(float("nan"), False) is GuardAction.SKIP
+        assert g.observe(1.0, False) is GuardAction.OK
+        assert g.observe(float("nan"), False) is GuardAction.SKIP
+
+    def test_loss_spike(self):
+        g = DivergenceGuard(max_consecutive_nonfinite=0,
+                            loss_spike_factor=3.0, loss_spike_window=8,
+                            min_spike_history=4)
+        for _ in range(4):
+            assert g.observe(1.0, False) is GuardAction.OK
+        assert g.observe(2.0, False) is GuardAction.OK
+        assert g.observe(10.0, False) is GuardAction.ROLLBACK
+
+    def test_rollback_budget(self):
+        g = DivergenceGuard(max_rollbacks=1)
+        assert g.note_rollback() is False
+        assert g.note_rollback() is True
+
+
+class TestNaNStreakRollback:
+    def _run(self, tmp_path, nan_calls, res_overrides, train_iters=6,
+             save_interval=2):
+        import dataclasses
+        cfg = tiny_cfg(max_consecutive_nonfinite=2, **res_overrides)
+        cfg = dataclasses.replace(cfg, training=dataclasses.replace(
+            cfg.training, train_iters=train_iters,
+            save_interval=save_interval,
+            checkpoint_dir=str(tmp_path)))
+        from megatron_tpu.training.loop import train
+        root = str(tmp_path)
+        saved_params = {}
+        rollback_loads = []
+
+        def save_fn(st, iteration, consumed):
+            ckpt.save_checkpoint(root, st, cfg, iteration, consumed)
+            saved_params[iteration] = [np.asarray(x).copy() for x in
+                                       jax.tree.leaves(st.params)]
+
+        example = init_train_state(jax.random.PRNGKey(99), cfg)
+
+        def load_fn():
+            out = ckpt.load_checkpoint(root, example,
+                                       resilience=cfg.resilience)
+            rollback_loads.append(out)
+            return out
+
+        inj = FaultInjector(nan_step_calls=set(nan_calls))
+        with use_fault_injector(inj):
+            state, consumed = train(
+                cfg, _batches(0), mesh=None,
+                rng=jax.random.PRNGKey(cfg.training.seed),
+                save_fn=save_fn, load_fn=load_fn,
+                reset_data_fn=lambda consumed, reseed: _batches(reseed))
+        return state, consumed, saved_params, rollback_loads, inj
+
+    def test_rollback_resumes_bit_exact_and_completes(self, tmp_path):
+        """Checkpoint at iter 2; NaN-poison step calls 3+4 (iterations
+        3-4) -> streak of 2 -> rollback. The restored params must be
+        BIT-EXACT the iter-2 checkpoint, and the run must then complete
+        all 6 iterations on the re-seeded stream."""
+        state, consumed, saved, loads, inj = self._run(
+            tmp_path, nan_calls=(3, 4), res_overrides={})
+        assert len(loads) == 1  # exactly one rollback
+        rolled_state, rolled_it, _ = loads[0]
+        assert rolled_it == 2
+        for a, b in zip(jax.tree.leaves(rolled_state.params),
+                        saved[2]):
+            np.testing.assert_array_equal(np.asarray(a), b)
+        assert int(state.iteration) == 6  # run completed after rollback
+        assert ("nan", "step@3") in inj.fired
+        assert ckpt.read_tracker(str(tmp_path)) == "6"
+
+    def test_repeated_divergence_aborts_cleanly(self, tmp_path):
+        """max_rollbacks=0: the first rollback decision must abort with
+        TrainingDivergedError (clean, distinct — not an infinite
+        crash-loop)."""
+        with pytest.raises(TrainingDivergedError):
+            self._run(tmp_path, nan_calls=(3, 4),
+                      res_overrides={"max_rollbacks": 0})
+
+    def test_divergence_without_checkpoint_aborts(self):
+        """No load_fn (no --save configured): a guard breach aborts
+        instead of silently skipping forever."""
+        import dataclasses
+        cfg = tiny_cfg(max_consecutive_nonfinite=2)
+        from megatron_tpu.training.loop import train
+        inj = FaultInjector(nan_step_calls={1, 2})
+        with use_fault_injector(inj):
+            with pytest.raises(TrainingDivergedError):
+                train(cfg, _batches(0), mesh=None,
+                      rng=jax.random.PRNGKey(1234))
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+class TestWatchdog:
+    def test_fires_after_deadline(self, monkeypatch):
+        exits = []
+        monkeypatch.setattr(watchdog_mod, "_exit", exits.append)
+        timeouts = []
+        wd = StepWatchdog(0.15, on_timeout=lambda: timeouts.append(1),
+                          exit_code=43, dump_stacks=False)
+        wd.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while not wd.fired and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert wd.fired
+            assert timeouts == [1]
+            assert exits == [43]
+        finally:
+            wd.stop()
+
+    def test_heartbeat_defers_firing(self, monkeypatch):
+        exits = []
+        monkeypatch.setattr(watchdog_mod, "_exit", exits.append)
+        wd = StepWatchdog(0.3, dump_stacks=False)
+        wd.start()
+        try:
+            for _ in range(5):
+                time.sleep(0.1)
+                wd.heartbeat()
+            assert not wd.fired and exits == []
+        finally:
+            wd.stop()
+
+    def test_suspend_pauses_deadline(self, monkeypatch):
+        """Eval/save phases suspend the deadline: a pause far beyond
+        timeout_s inside `with wd.suspend()` must not fire."""
+        exits = []
+        monkeypatch.setattr(watchdog_mod, "_exit", exits.append)
+        wd = StepWatchdog(0.2, poll_s=0.05, dump_stacks=False)
+        wd.start()
+        try:
+            with wd.suspend():
+                time.sleep(0.8)
+            assert not wd.fired and exits == []
+            time.sleep(0.1)  # resumed: still inside the fresh deadline
+            assert not wd.fired
+        finally:
+            wd.stop()
+
+    def test_fires_on_artificially_delayed_step(self, tmp_path,
+                                                monkeypatch):
+        """Through the REAL train loop: a FaultInjector stall on step
+        call 3 exceeds step_timeout_s; the watchdog must fire, attempt
+        the final checkpoint (save_fn), and 'exit' with the distinct
+        code (monkeypatched so the test process survives)."""
+        import dataclasses
+        exits = []
+        monkeypatch.setattr(watchdog_mod, "_exit", exits.append)
+        cfg = tiny_cfg(step_timeout_s=0.4, max_consecutive_nonfinite=0)
+        cfg = dataclasses.replace(cfg, training=dataclasses.replace(
+            cfg.training, train_iters=5, checkpoint_dir=str(tmp_path)))
+        from megatron_tpu.training.loop import train
+        root = str(tmp_path)
+
+        def save_fn(st, iteration, consumed):
+            ckpt.save_checkpoint(root, st, cfg, iteration, consumed)
+
+        inj = FaultInjector(delay_step_calls={3: 1.5})
+        with use_fault_injector(inj):
+            train(cfg, _batches(0), mesh=None,
+                  rng=jax.random.PRNGKey(1), save_fn=save_fn)
+        assert exits == [43], "watchdog must exit with the distinct code"
+        # the final-checkpoint attempt landed and is valid
+        tag = ckpt.read_tracker(root)
+        assert tag is not None
+        ok, why = integrity.verify_checkpoint(
+            os.path.join(root, f"iter_{int(tag):07d}"))
+        assert ok, why
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM checkpoint-and-exit (satellite: the path existed untested)
+# ---------------------------------------------------------------------------
+
+class TestSigterm:
+    def test_sigterm_checkpoints_and_exits_early(self, tmp_path):
+        import dataclasses
+        cfg = tiny_cfg(max_consecutive_nonfinite=0)
+        cfg = dataclasses.replace(cfg, training=dataclasses.replace(
+            cfg.training, train_iters=100000,
+            checkpoint_dir=str(tmp_path)))
+        from megatron_tpu.training.loop import train
+        root = str(tmp_path)
+        saves = []
+
+        def save_fn(st, iteration, consumed):
+            ckpt.save_checkpoint(root, st, cfg, iteration, consumed)
+            saves.append(iteration)
+
+        killer = threading.Timer(
+            1.5, lambda: os.kill(os.getpid(), signal.SIGTERM))
+        killer.start()
+        old = signal.getsignal(signal.SIGTERM)
+        try:
+            t0 = time.monotonic()
+            state, consumed = train(cfg, _batches(0), mesh=None,
+                                    rng=jax.random.PRNGKey(1),
+                                    save_fn=save_fn)
+            assert time.monotonic() - t0 < 60.0
+        finally:
+            killer.cancel()
+            signal.signal(signal.SIGTERM, old)
+        assert saves, "SIGTERM must checkpoint before exiting"
+        assert int(state.iteration) < 100000
+        assert ckpt.read_tracker(root) == str(saves[-1])
+
+
+# ---------------------------------------------------------------------------
+# evaluate(): exhausted valid iterator must not kill the run
+# ---------------------------------------------------------------------------
+
+def test_evaluate_survives_exhausted_iterator():
+    from types import SimpleNamespace
+    from megatron_tpu.training.loop import evaluate
+
+    batches = iter([{"v": 1.0}, {"v": 3.0}])
+    state = SimpleNamespace(params=None)
+    step = lambda params, batch: jnp.float32(batch["v"])  # noqa: E731
+    out = evaluate(state, batches, step, eval_iters=5)
+    assert out["lm loss"] == pytest.approx(2.0)  # mean over the 2 seen
+    # iterator already dead: no fake 0.0 loss — the caller skips the
+    # report entirely
+    assert evaluate(state, batches, step, eval_iters=5) is None
+
+
+# ---------------------------------------------------------------------------
+# serving: per-request deadline + graceful drain
+# ---------------------------------------------------------------------------
+
+class TestServingRobustness:
+    @pytest.fixture(scope="class")
+    def tiny_generator(self):
+        from megatron_tpu.inference import Generator
+        from megatron_tpu.models import language_model as lm
+        mcfg = ModelConfig(num_layers=2, hidden_size=64,
+                           num_attention_heads=4, num_kv_heads=2,
+                           vocab_size=96, seq_length=64,
+                           make_vocab_size_divisible_by=32,
+                           compute_dtype="float32").derived()
+        params = lm.model_init(jax.random.PRNGKey(0), mcfg)
+        return Generator(params, mcfg, eos_id=0, pad_id=0)
+
+    def test_queued_requests_expire(self):
+        from megatron_tpu.serving import (DeadlineExceededError,
+                                          FIFOScheduler, GenRequest)
+        sched = FIFOScheduler(max_queue=4, max_total_len=64)
+        req = sched.submit(GenRequest([1, 2, 3], 8))
+        expired = sched.drop_expired(deadline_s=10.0,
+                                     now=req.submit_time + 11.0)
+        assert expired == [req] and sched.depth() == 0
+        with pytest.raises(DeadlineExceededError):
+            req.result(timeout=0)
+
+    def test_running_request_expires_with_504_semantics(self,
+                                                        tiny_generator):
+        from megatron_tpu.config import ServingConfig
+        from megatron_tpu.serving import (DeadlineExceededError,
+                                          ServingEngine)
+        eng = ServingEngine(tiny_generator, ServingConfig(
+            num_slots=2, max_queue=8, max_len=64,
+            request_deadline_s=30.0))
+        try:
+            req = eng.submit([5, 6, 7], max_new_tokens=40, seed=1)
+            # wait until it is decoding, then age it past the deadline
+            deadline = time.monotonic() + 30.0
+            while not req.generated and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert req.generated, "request never started decoding"
+            req.submit_time -= 1000.0
+            with pytest.raises(DeadlineExceededError,
+                               match="deadline exceeded"):
+                req.result(timeout=30)
+            assert eng.metrics.snapshot().get("requests_expired", 0) >= 1
+        finally:
+            eng.close()
+
+    def test_drain_finishes_inflight_and_rejects_new(self,
+                                                     tiny_generator):
+        from megatron_tpu.config import ServingConfig
+        from megatron_tpu.serving import QueueFullError, ServingEngine
+        eng = ServingEngine(tiny_generator, ServingConfig(
+            num_slots=2, max_queue=8, max_len=64))
+        req = eng.submit([9, 10, 11], max_new_tokens=24, seed=2)
+        deadline = time.monotonic() + 30.0
+        while not req.generated and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert req.generated
+        assert eng.drain(timeout=60.0) is True
+        # the in-flight request finished completely
+        toks, _ = req.result(timeout=0)
+        assert len(toks) > 3
+        # post-drain admissions are rejected with backpressure semantics
+        with pytest.raises(QueueFullError, match="draining"):
+            eng.submit([1, 2], max_new_tokens=4)
+        eng.close()  # idempotent after drain
+
+    def test_drain_fails_queued_backlog(self, tiny_generator):
+        from megatron_tpu.config import ServingConfig
+        from megatron_tpu.serving import ServingEngine
+        # start=False: nothing is admitted, so the backlog is
+        # deterministic when drain() closes the queue
+        eng = ServingEngine(tiny_generator, ServingConfig(
+            num_slots=1, max_queue=8, max_len=64), start=False)
+        reqs = [eng.submit([7, 8], max_new_tokens=8, seed=i)
+                for i in range(3)]
+        assert eng.drain(timeout=5.0) is True
+        for r in reqs:
+            with pytest.raises(RuntimeError, match="draining"):
+                r.result(timeout=0)
+
+    def test_server_maps_deadline_to_504(self, tiny_generator):
+        """The HTTP layer's status mapping, without sockets: a handler
+        whose engine raises DeadlineExceededError answers 504."""
+        from megatron_tpu.inference.server import MegatronServer
+        from megatron_tpu.serving import DeadlineExceededError
+
+        class _Tok:
+            bos = None
+            vocab_size = 96
+
+            def tokenize(self, s):
+                return [5, 6, 7]
+
+            def detokenize(self, ids):
+                return "x"
+
+        from megatron_tpu.config import ServingConfig
+        srv = MegatronServer(tiny_generator, _Tok(),
+                             serving=ServingConfig(serial_fallback=True))
+        try:
+
+            def _boom(payload):
+                raise DeadlineExceededError("deadline exceeded: test")
+
+            srv._handle_serial = _boom
+            status, body = srv.handle(
+                {"prompts": ["hi"], "tokens_to_generate": 4})
+            assert status == 504
+            assert "deadline" in body["message"]
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos tool (e2e, subprocess — slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_train_smoke(tmp_path):
+    """tools/chaos_train.py --smoke: the scripted chaos run (transient
+    write error + NaN-streak rollback + corruption fallback) completes
+    and emits an honest recovery record."""
+    import subprocess
+    import sys as _sys
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "chaos_train.py")
+    out = str(tmp_path / "chaos.json")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([_sys.executable, tool, "--smoke", "--out", out],
+                       capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    with open(out) as f:
+        record = json.load(f)
+    assert record["completed"] is True
+    assert record["faults_fired"] == {"transient_error": 1, "nan": 2}
+    assert record["value"] is not None  # a rollback actually happened
+    assert record["corrupt_fallback_iteration"] < record["final_iteration"]

@@ -1,0 +1,180 @@
+"""Scripted chaos run: train a tiny model while faults fire, measure
+recovery.
+
+The unit suite (tests/test_resilience.py) proves each resilience path
+in isolation; this tool composes them into ONE run the way a bad day
+on a preemptible cluster would — transient checkpoint-write failures,
+a NaN streak mid-run, a corrupted checkpoint on disk — and reports
+whether training still completed, how many rollbacks it took, and the
+recovery latency (wall-clock cost of a rollback: detect → restore →
+resume). Emits ONE BENCH-style JSON record on stdout (and to --out),
+like bench.py, so recovery-latency regressions surface in the
+`BENCH_*.json` extras.
+
+Modes:
+- `--smoke` (bench extras / CI): tiny model, short schedule, fixed
+  fault script — finishes in well under a minute on CPU;
+- default: the same scenario at a configurable size
+  (`--train_iters`, `--hidden_size`), plus `--faults SPEC` to override
+  the fault schedule with a `MEGATRON_TPU_FAULTS`-syntax spec (e.g.
+  "write_error@2,nan@5,nan@6,delay@8:2.0").
+
+  JAX_PLATFORMS=cpu python tools/chaos_train.py --smoke [--out FILE]
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_tpu.utils.platform import ensure_env_platform
+
+
+def run_chaos(train_iters: int, hidden_size: int, fault_spec: str,
+              workdir: str) -> dict:
+    import jax
+    import numpy as np
+
+    from megatron_tpu.config import (DataConfig, MegatronConfig,
+                                     ModelConfig, OptimizerConfig,
+                                     ResilienceConfig, TrainingConfig)
+    from megatron_tpu.resilience import (FaultInjector, integrity,
+                                         use_fault_injector)
+    from megatron_tpu.training import checkpointing as ckpt
+    from megatron_tpu.training import init_train_state
+    from megatron_tpu.training.loop import train
+
+    model = ModelConfig(num_layers=2, hidden_size=hidden_size,
+                        num_attention_heads=2, vocab_size=64,
+                        seq_length=16).derived()
+    cfg = MegatronConfig(
+        model=model,
+        optimizer=OptimizerConfig(lr=1e-3),
+        training=TrainingConfig(micro_batch_size=1, global_batch_size=2,
+                                train_iters=train_iters, log_interval=100,
+                                save_interval=2, checkpoint_dir=workdir),
+        data=DataConfig(num_workers=0),
+        resilience=ResilienceConfig(max_consecutive_nonfinite=2,
+                                    keep_last_k=3, io_backoff_s=0.05,
+                                    io_backoff_max_s=0.2),
+    ).validate(n_devices=1)
+
+    def batches(seed=0):
+        i = 0
+        while True:
+            tokens = jax.random.randint(jax.random.PRNGKey(seed * 1000 + i),
+                                        (2, 1, 17), 0, 64)
+            yield {"tokens": np.asarray(tokens),
+                   "loss_mask": np.ones((2, 1, 16), np.float32)}
+            i += 1
+
+    root = workdir
+    timeline = {"saves": 0, "rollback_at": None, "resumed_at": None}
+
+    def save_fn(st, iteration, consumed):
+        ckpt.save_checkpoint(root, st, cfg, iteration, consumed)
+        timeline["saves"] += 1
+
+    example = init_train_state(jax.random.PRNGKey(99), cfg)
+
+    def load_fn():
+        timeline["rollback_at"] = time.monotonic()
+        out = ckpt.load_checkpoint(root, example,
+                                   resilience=cfg.resilience)
+        timeline["resumed_at"] = time.monotonic()
+        return out
+
+    injector = FaultInjector.from_env(fault_spec)
+    assert injector is not None, f"empty fault spec {fault_spec!r}"
+
+    t0 = time.monotonic()
+    with use_fault_injector(injector):
+        state, consumed = train(
+            cfg, batches(0), mesh=None,
+            rng=jax.random.PRNGKey(cfg.training.seed),
+            save_fn=save_fn, load_fn=load_fn,
+            reset_data_fn=lambda c, r: batches(r))
+    wall_s = time.monotonic() - t0
+
+    # post-run corruption drill: bit-rot the tracker-named checkpoint
+    # and prove the fallback restores the previous valid one
+    tag = ckpt.read_tracker(root)
+    FaultInjector.corrupt_checkpoint(
+        os.path.join(root, f"iter_{int(tag):07d}"))
+    t1 = time.monotonic()
+    recovered, rec_it, _ = ckpt.load_checkpoint(
+        root, example, resilience=cfg.resilience)
+    fallback_s = time.monotonic() - t1
+
+    recovery_s = (timeline["resumed_at"] - timeline["rollback_at"]
+                  if timeline["rollback_at"] is not None else None)
+    fired = {}
+    for kind, _ in injector.fired:
+        fired[kind] = fired.get(kind, 0) + 1
+    valid = [it for it, d in integrity.list_iter_checkpoints(root)
+             if integrity.verify_checkpoint(d)[0]]
+    ok = (int(state.iteration) == train_iters and recovered is not None
+          and rec_it < int(tag))
+    return {
+        "metric": "chaos_recovery_latency_s",
+        "value": round(recovery_s, 3) if recovery_s is not None else None,
+        "unit": (f"s detect->restore->resume ({train_iters} iters, "
+                 f"faults {fault_spec})"),
+        "vs_baseline": None,
+        "completed": ok,
+        "final_iteration": int(state.iteration),
+        "consumed_samples": int(consumed),
+        "faults_fired": fired,
+        "saves": timeline["saves"],
+        "corrupt_fallback_iteration": int(rec_it),
+        "corrupt_fallback_s": round(fallback_s, 3),
+        "valid_checkpoints": valid,
+        "wall_s": round(wall_s, 1),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny fixed scenario for bench extras / CI")
+    ap.add_argument("--train_iters", type=int, default=12)
+    ap.add_argument("--hidden_size", type=int, default=64)
+    ap.add_argument("--faults", type=str,
+                    default="write_error@2,nan@5,nan@6",
+                    help="MEGATRON_TPU_FAULTS-syntax fault schedule")
+    ap.add_argument("--workdir", type=str, default=None,
+                    help="checkpoint dir (default: fresh tempdir)")
+    ap.add_argument("--out", type=str, default=None,
+                    help="also write the JSON record here")
+    args = ap.parse_args(argv)
+
+    ensure_env_platform()
+    if args.smoke:
+        args.train_iters, args.hidden_size = 8, 32
+        args.faults = "write_error@2,nan@3,nan@4"
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_train_")
+    cleanup = args.workdir is None
+    try:
+        record = run_chaos(args.train_iters, args.hidden_size,
+                           args.faults, workdir)
+    finally:
+        if cleanup:
+            shutil.rmtree(workdir, ignore_errors=True)
+    line = json.dumps(record)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0 if record["completed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,6 +61,10 @@ def main(argv=None):
     p.add_argument("--serving_max_len", type=int, default=None,
                    help="per-slot KV region length (prompt+generated); "
                         "defaults to max_position_embeddings")
+    p.add_argument("--request_deadline_s", type=float, default=None,
+                   help="per-request wall-clock deadline: queued or "
+                        "running requests past it are evicted and "
+                        "answer 504 (None = no deadline)")
     p.add_argument("--serial", action="store_true",
                    help="serve with the reference's serial one-lock "
                         "path instead of the continuous-batching engine")
@@ -108,7 +112,8 @@ def main(argv=None):
     serving = ServingConfig(num_slots=num_slots,
                             max_queue=args.max_queue,
                             max_len=args.serving_max_len,
-                            serial_fallback=args.serial)
+                            serial_fallback=args.serial,
+                            request_deadline_s=args.request_deadline_s)
     MegatronServer(gen, tokenizer, serving=serving).run(args.host,
                                                         args.port)
 
