@@ -336,6 +336,11 @@ def _run_extras():
         # serving-side complement to bench_decode's single stream
         ("serving_bench.py", ["--requests", "32", "--slots", "8"],
          "/tmp/bench_extras_serving.log"),
+        # host-sync cadence A/B (PERF_NOTES "batch K steps per sync"):
+        # per-step vs per-window metrics fetch in the train loop, and
+        # decode_sync_interval 1-vs-K in the engine — ON CHIP the
+        # ms/step delta is the dispatch gap the per-step sync cost
+        ("bench_sync.py", [], "/tmp/bench_extras_sync.log"),
         # resilience smoke: scripted chaos run (transient write fault +
         # NaN-streak rollback + corrupt-checkpoint fallback) — the
         # recovery-latency record makes regressions in the resilience

@@ -149,6 +149,11 @@ def build_parser(extra_args_provider: Optional[Callable] = None
     g.add_argument("--use_checkpoint_args", action="store_true")
     g.add_argument("--wandb_logger", action="store_true")
     g.add_argument("--tensorboard_dir", type=str, default=None)
+    g.add_argument("--sync_metrics", action="store_true",
+                   help="fetch loss/found_inf every step (step-exact "
+                        "debugging); default is ONE metrics transfer "
+                        "per log window with the loop dispatching "
+                        "ahead of the device (training/loop.py)")
 
     g = p.add_argument_group("optimizer")
     g.add_argument("--optimizer", type=str, default="adam",
@@ -237,6 +242,13 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                    help="serving: per-request wall-clock deadline "
                         "(expired requests are evicted with a "
                         "504-style error)")
+    g.add_argument("--decode_sync_interval", type=int, default=1,
+                   help="serving: decode steps dispatched per host "
+                        "sync — 1/K syncs per token, up to K-1 wasted "
+                        "steps per finished request (docs/serving.md)")
+    g.add_argument("--prefill_max_batch", type=int, default=8,
+                   help="serving: max same-bucket admissions coalesced "
+                        "into one batched prefill call (1 disables)")
 
     g = p.add_argument_group(
         "reference compat",
@@ -507,7 +519,9 @@ def config_from_args(args: argparse.Namespace,
             if args.rampup_batch_size else None}),
         data=DataConfig(**_pick(args, DataConfig)),
         serving=ServingConfig(
-            request_deadline_s=args.request_deadline_s),
+            request_deadline_s=args.request_deadline_s,
+            decode_sync_interval=args.decode_sync_interval,
+            prefill_max_batch=args.prefill_max_batch),
         resilience=ResilienceConfig(**{
             **_pick(args, ResilienceConfig),
             "checkpoint_integrity": not args.no_checkpoint_integrity}),

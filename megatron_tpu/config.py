@@ -287,6 +287,16 @@ class TrainingConfig:
     no_load_rng: bool = False
     wandb_logger: bool = False
     tensorboard_dir: Optional[str] = None
+    # Host/device sync cadence (training/loop.py). False (default): the
+    # loop never blocks on a step's metrics — per-step scalars stay
+    # device-resident and are fetched in ONE transfer per log window
+    # (guard/skip accounting replays the window at the flush, at most
+    # log_interval-1 steps late; rollback restores a checkpoint either
+    # way, so decisions are identical — see docs/resilience.md). True
+    # restores the step-exact fetch-every-iteration behavior for
+    # debugging; profile=True implies it so trace windows stay
+    # step-aligned.
+    sync_metrics: bool = False
     # jax.profiler trace capture over a step window (SURVEY.md §5: the TPU
     # equivalent of the reference's named-span-only profiling). Traces are
     # viewable in TensorBoard / Perfetto.
@@ -373,12 +383,28 @@ class ServingConfig:
     # running requests past it are evicted and fail with
     # DeadlineExceededError (→ HTTP 504). None = no deadline.
     request_deadline_s: Optional[float] = None
+    # decode steps dispatched per host sync: the engine chains K async
+    # decode calls on device state and fetches all K tokens in ONE
+    # transfer, so syncs/token = 1/K. EOS/eviction/admission happen at
+    # sync boundaries, so a finished request's slot burns up to K-1
+    # wasted steps and queued requests wait up to K-1 extra steps for a
+    # slot. Seeded outputs are token-exact vs K=1 (per-slot rng/logits
+    # chains are independent of the sync cadence). 1 = the pre-window
+    # behavior (sync every token).
+    decode_sync_interval: int = 1
+    # admission coalescing: up to this many same-bucket queued prompts
+    # prefill in ONE batched call (amortizes the per-call weight stream;
+    # batch sizes round up to powers of two so the jit cache stays
+    # bounded at O(log slots) entries per length bucket). 1 disables.
+    prefill_max_batch: int = 8
 
     def validate(self, model: Optional["ModelConfig"] = None
                  ) -> "ServingConfig":
         assert self.num_slots >= 1, self.num_slots
         assert self.max_queue >= 1, self.max_queue
         assert self.prefill_bucket >= 1, self.prefill_bucket
+        assert self.decode_sync_interval >= 1, self.decode_sync_interval
+        assert self.prefill_max_batch >= 1, self.prefill_max_batch
         assert self.request_deadline_s is None or \
             self.request_deadline_s > 0.0, self.request_deadline_s
         assert self.kv_dtype is None or \

@@ -332,6 +332,13 @@ class PrefetchIterator:
     the train loop does in its finally block — or the producer thread
     stays parked holding `depth` buffered batches.
 
+    Batches stay HOST arrays here: running jax.device_put from the
+    producer thread races the main thread's dispatch and aborts inside
+    XLA on CPU jax 0.4.x, so the train loop does its device-side input
+    double-buffering on the MAIN thread instead (loop.py
+    "prefetch_ahead" — batch N+1 is lifted right after step N's async
+    dispatch, overlapping step N's device time).
+
     NOT safe under batch-size rampup: buffered batches lag a
     num_microbatches change by up to `depth` steps, skewing the
     consumed-samples accounting, so loop.py only wraps when rampup is

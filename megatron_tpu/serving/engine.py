@@ -21,6 +21,15 @@ TPU-native:
 - A bounded FIFO (serving/scheduler.py) provides backpressure; the
   engine loop drains it into free slots between decode steps, so
   new requests join the running batch at token granularity.
+- Host/device overlap: `decode_sync_interval=K` chains K decode
+  dispatches on device-resident state (lengths ride the device and
+  self-increment) and fetches all K sampled tokens in ONE transfer —
+  syncs/token = 1/K, at the cost of up to K-1 wasted slot-steps per
+  finished request and K-1 extra steps of admission latency (EOS /
+  eviction / admission decide at sync boundaries). Sampling knobs and
+  lengths keep cached device copies re-uploaded only on slot churn,
+  and queued same-length-bucket admissions coalesce into one batched
+  prefill call (`prefill_max_batch`).
 
 Seeded determinism: a request with seed s reproduces the serial
 `Generator.generate([prompt], ..., seed=s)` output token-for-token —
@@ -92,12 +101,33 @@ class ServingEngine:
         self._top_ks = np.zeros(S, np.int32)
         self._top_ps = np.zeros(S, np.float32)
         self._slot_req: List[Optional[GenRequest]] = [None] * S
+        # cached DEVICE copies of the per-slot state: sampling knobs and
+        # lengths only change on slot churn (admit/evict), so they are
+        # re-uploaded only when the dirty flags say so instead of
+        # jnp.asarray'ing 4 host arrays every decode step. Between
+        # churns the lengths chain device-side through the decode calls.
+        self._d_lengths = jnp.asarray(self._lengths)
+        self._d_temps = jnp.asarray(self._temps)
+        self._d_top_ks = jnp.asarray(self._top_ks)
+        self._d_top_ps = jnp.asarray(self._top_ps)
+        self._sampling_dirty = True
+        self._lengths_dirty = True
+        self._sync_interval = max(self.serving.decode_sync_interval, 1)
+        self._prefill_max_batch = max(
+            min(self.serving.prefill_max_batch, self.num_slots), 1)
 
         self._decode_traces = 0  # trace count — MUST stay 1 in steady state
+        # lengths (arg 4) chains device-side but is NOT donated: it is
+        # [S] int32 (nothing to save), and donating a buffer that the
+        # next chained call consumes while the previous one is still in
+        # flight hits the CPU jax 0.4.x donation-aliasing bug the
+        # rollback path in training/loop.py documents (observed here as
+        # rare wrong tokens on the 8-virtual-device CPU mesh)
         self._decode = self.gen._jit(self._decode_fn, n_array_args=7,
                                      donate_argnums=(1, 2, 3))
-        # one jit; jax retraces per padded prompt length (bucketed by
-        # _prefill_bucket so the cache hits across request sizes)
+        # one jit; jax retraces per (batch-bucket, padded prompt length)
+        # combo (both bucketed — _prefill_bucket / _batch_bucket — so
+        # the cache hits across request sizes and arrival bursts)
         self._prefill = self.gen._jit(self._prefill_fn, n_array_args=7,
                                       donate_argnums=(1, 2, 3))
         self._steps = 0
@@ -221,7 +251,15 @@ class ServingEngine:
         slots' tokens (s=1) through the model with per-slot positions.
         Inactive slots ride along at length 0 (static shapes); their
         writes land at position 0 and are fully overwritten by the next
-        prefill insert."""
+        prefill insert.
+
+        `lengths` is the DEVICE copy of the per-slot positions and is
+        returned incremented, so K chained calls advance positions
+        without a host round-trip (decode_sync_interval). The clamp at
+        max_len-1 only ever binds for rows idling past their eviction
+        inside a window — admission guarantees a live row never needs a
+        position past max_len-1 — and keeps their rope/cache indices in
+        bounds until the boundary re-upload re-parks them."""
         self._decode_traces += 1
         cfg = self.cfg
         split = jax.vmap(jax.random.split)(rngs)  # [S, 2, 2]
@@ -233,8 +271,8 @@ class ServingEngine:
         # the serial path's convention (generation.py _decode_fn)
         lp = jax.nn.log_softmax(last_logits, axis=-1)
         tok_lp = jnp.take_along_axis(lp, toks[:, None], axis=-1)[:, 0]
-        # the engine's host `lengths` are the source of truth for every
-        # row's position; broadcast them over layers into the pool
+        # `lengths` is the source of truth for every row's position;
+        # broadcast them over layers into the pool
         L = pool.offset.shape[0]
         pool = pool._replace(offset=jnp.broadcast_to(
             lengths[None, :], (L, lengths.shape[0])).astype(jnp.int32))
@@ -242,21 +280,40 @@ class ServingEngine:
             params, toks[:, None], cfg, kv_caches=pool,
             position_ids=lengths[:, None], rope=self.gen.rope,
             logits_dtype=jnp.float32)
-        return pool, logits[:, 0], new_rngs, toks, tok_lp
+        new_lengths = jnp.minimum(lengths + 1,
+                                  jnp.int32(self.max_len - 1))
+        return pool, logits[:, 0], new_rngs, toks, tok_lp, new_lengths
 
     def _prefill_fn(self, params, pool, last_logits, rngs, tokens,
-                    plen, slot, rng0):
-        caches = self.pool.make_prefill_caches(1)
+                    plens, slots, rng0s):
+        """Batched prefill: B prompts (same padded bucket) forward in
+        ONE call — the weight stream is paid once per batch instead of
+        once per request — then each row's KV inserts into its slot.
+        Row results are independent (per-row causal attention), so a
+        B>1 prefill is the B=1 prefill done B times. Duplicate rows
+        (the batch-bucket pads replicate row 0) rewrite the same slot
+        with identical values — idempotent by construction."""
+        B = tokens.shape[0]
+        caches = self.pool.make_prefill_caches(B)
         logits, caches = lm.model_forward(
             params, tokens, self.cfg, kv_caches=caches,
             rope=self.gen.rope, logits_dtype=jnp.float32)
-        pool = insert_prefill(pool, caches, slot, plen)
-        # logits at the LAST REAL prompt position (bucket pads sit
-        # after it and are causally invisible to it)
-        last = jax.lax.dynamic_slice_in_dim(
-            logits, plen - 1, 1, axis=1)[0, 0]
-        last_logits = last_logits.at[slot].set(last)
-        rngs = rngs.at[slot].set(rng0)
+        for i in range(B):  # static unroll: B is a trace-time shape
+            def row(x):
+                return jax.lax.dynamic_slice_in_dim(x, i, 1, axis=1)
+            sub = caches._replace(
+                k=row(caches.k), v=row(caches.v),
+                k_scale=(None if caches.k_scale is None
+                         else row(caches.k_scale)),
+                v_scale=(None if caches.v_scale is None
+                         else row(caches.v_scale)))
+            pool = insert_prefill(pool, sub, slots[i], plens[i])
+            # logits at the LAST REAL prompt position (bucket pads sit
+            # after it and are causally invisible to it)
+            last = jax.lax.dynamic_slice_in_dim(
+                logits[i], plens[i] - 1, 1, axis=0)[0]
+            last_logits = last_logits.at[slots[i]].set(last)
+            rngs = rngs.at[slots[i]].set(rng0s[i])
         return pool, last_logits, rngs
 
     def _prefill_bucket(self, plen: int) -> int:
@@ -268,6 +325,16 @@ class ServingEngine:
             return plen
         b = max(self.serving.prefill_bucket, 1)
         return min(-(-plen // b) * b, self.max_len)
+
+    @staticmethod
+    def _batch_bucket(n: int) -> int:
+        """Round a prefill batch up to a power of two so the jit cache
+        holds O(log slots) entries per length bucket, not one per
+        arrival-burst size."""
+        b = 1
+        while b < n:
+            b *= 2
+        return b
 
     @staticmethod
     def _initial_rng(seed: int, plen: int):
@@ -325,36 +392,59 @@ class ServingEngine:
                 return
 
     def _admit(self):
-        popped = self.scheduler.pop_ready(self.pool.free_count())
-        for i, req in enumerate(popped):
+        groups = self.scheduler.pop_ready_grouped(
+            self.pool.free_count(),
+            lambda r: self._prefill_bucket(len(r.prompt)),
+            self._prefill_max_batch)
+        pending = [r for _, reqs in groups for r in reqs]
+        for padded, reqs in groups:
             try:
-                self._prefill_into_slot(req)
+                self._prefill_group(reqs, padded)
+                for r in reqs:
+                    pending.remove(r)
             except Exception as e:
-                # the failing request AND the rest of this pop are in
+                # the failing group AND the rest of this pop are in
                 # neither _slot_req nor the scheduler — fail them here
                 # or their callers would hang to the request timeout
-                for r in popped[i:]:
+                for r in pending:
                     r.fail(repr(e))
                 raise
 
-    def _prefill_into_slot(self, req: GenRequest):
-        slot = self.pool.alloc()
-        plen = len(req.prompt)
-        padded = self._prefill_bucket(plen)
-        toks = np.full((1, padded), self.gen.pad_id, np.int32)
-        toks[0, :plen] = req.prompt
+    def _prefill_group(self, reqs: List[GenRequest], padded: int):
+        """One batched prefill for same-bucket admissions. The batch
+        dim rounds up to a power of two; pad rows replicate row 0
+        (identical re-write of the same slot — harmless)."""
+        B_real = len(reqs)
+        B = self._batch_bucket(B_real)
+        slots = [self.pool.alloc() for _ in reqs]
+        plens = [len(r.prompt) for r in reqs]
+        toks = np.full((B, padded), self.gen.pad_id, np.int32)
+        for i, r in enumerate(reqs):
+            toks[i, :plens[i]] = r.prompt
+        toks[B_real:] = toks[0]
+        plens_a = np.asarray(plens + [plens[0]] * (B - B_real), np.int32)
+        slots_a = np.asarray(slots + [slots[0]] * (B - B_real), np.int32)
+        rng0s = jnp.stack(
+            [self._initial_rng(r.seed, p)
+             for r, p in zip(reqs, plens)]
+            + [self._initial_rng(reqs[0].seed, plens[0])] * (B - B_real))
         self.pool.caches, self._last_logits, self._rngs = self._prefill(
             self.gen.params, self.pool.caches, self._last_logits,
-            self._rngs, jnp.asarray(toks), np.int32(plen), np.int32(slot),
-            self._initial_rng(req.seed, plen))
-        self._lengths[slot] = plen
-        self._active[slot] = True
-        self._temps[slot] = req.sampling.temperature
-        self._top_ks[slot] = req.sampling.top_k
-        self._top_ps[slot] = req.sampling.top_p
-        self._slot_req[slot] = req
-        req.mark_admitted()
-        self.metrics.record_admitted(req.admit_time - req.submit_time)
+            self._rngs, jnp.asarray(toks), jnp.asarray(plens_a),
+            jnp.asarray(slots_a), rng0s)
+        for slot, plen, req in zip(slots, plens, reqs):
+            self._lengths[slot] = plen
+            self._active[slot] = True
+            self._temps[slot] = req.sampling.temperature
+            self._top_ks[slot] = req.sampling.top_k
+            self._top_ps[slot] = req.sampling.top_p
+            self._slot_req[slot] = req
+            req.mark_admitted()
+            self.metrics.record_admitted(req.admit_time - req.submit_time)
+        self._sampling_dirty = True
+        self._lengths_dirty = True
+        self.metrics.count("prefill_calls")
+        self.metrics.count("prefill_prompts", B_real)
 
     def _reap_cancelled(self):
         for slot in np.nonzero(self._active)[0]:
@@ -392,6 +482,8 @@ class ServingEngine:
         self._slot_req[slot] = None
         self._active[slot] = False
         self._lengths[slot] = 0  # inactive rows park at position 0
+        self._lengths_dirty = True  # device copy re-parks at next step
+        self._sampling_dirty = True
         self.pool.release(slot)
         if failed is not None:
             req.fail(failed, kind=kind)
@@ -402,31 +494,78 @@ class ServingEngine:
         self.metrics.record_completed(
             req.finish_time - req.submit_time, len(req.generated))
 
+    @staticmethod
+    def _fetch(tree):
+        """ONE device→host transfer for the window's sampled tokens —
+        the engine's sync seam (counted as `host_syncs`; wrapped by the
+        cadence tests and tools/bench_sync.py)."""
+        return jax.device_get(tree)
+
     def _step(self):
-        """One interleaved decode step + host bookkeeping."""
-        out = self._decode(
-            self.gen.params, self.pool.caches, self._last_logits,
-            self._rngs, jnp.asarray(self._lengths),
-            jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-            jnp.asarray(self._top_ps))
-        self.pool.caches, self._last_logits, self._rngs = out[:3]
-        toks = np.asarray(out[3])
-        tok_lp = np.asarray(out[4])
-        n_active = 0
-        for slot in np.nonzero(self._active)[0]:
+        """K chained decode dispatches + ONE host sync + bookkeeping.
+
+        With decode_sync_interval=1 this is the classic per-token sync.
+        With K>1 the host enqueues K decode calls back-to-back — each
+        consumes the previous call's device outputs, so XLA runs them
+        gap-free — and fetches all K token grids in one transfer. The
+        host then consumes each slot's K tokens in order; a request
+        hitting EOS/max at inner step k discards the trailing K-1-k
+        tokens (its slot burned them as `wasted_decode_steps` — the
+        documented cost of the batched sync) and evicts at the
+        boundary. Per-request streams are token-exact vs K=1: slot
+        rng/logits/KV chains never cross slots or sync boundaries."""
+        K = self._sync_interval
+        if self._sampling_dirty:
+            self._d_temps = jnp.asarray(self._temps)
+            self._d_top_ks = jnp.asarray(self._top_ks)
+            self._d_top_ps = jnp.asarray(self._top_ps)
+            self._sampling_dirty = False
+            self.metrics.count("sampling_uploads")
+        if self._lengths_dirty or not self._active.all():
+            # churn re-syncs positions from the host truth; partially
+            # active grids also re-park idle rows at 0 each window so
+            # their device-side drift stays bounded by K
+            self._d_lengths = jnp.asarray(self._lengths)
+            self._lengths_dirty = False
+        tok_steps, lp_steps = [], []
+        for _ in range(K):
+            out = self._decode(
+                self.gen.params, self.pool.caches, self._last_logits,
+                self._rngs, self._d_lengths, self._d_temps,
+                self._d_top_ks, self._d_top_ps)
+            (self.pool.caches, self._last_logits, self._rngs) = out[:3]
+            self._d_lengths = out[5]
+            tok_steps.append(out[3])
+            lp_steps.append(out[4])
+        fetched = self._fetch((tok_steps, lp_steps))
+        self.metrics.count("host_syncs")
+        toks = [np.asarray(t) for t in fetched[0]]   # K x [S]
+        tok_lp = [np.asarray(l) for l in fetched[1]]
+        active_slots = np.nonzero(self._active)[0]
+        n_active = len(active_slots)
+        consumed = np.zeros(K, np.int64)  # tokens delivered per step
+        for slot in active_slots:
             req = self._slot_req[slot]
-            first = not req.generated
-            req.append_token(int(toks[slot]), float(tok_lp[slot]))
-            if first:
-                self.metrics.record_first_token(req.ttft)
-            self._lengths[slot] += 1
-            n_active += 1
-            if (int(toks[slot]) == self.gen.eos_id
-                    or len(req.generated) >= req.max_new_tokens):
-                self._evict(slot)
-        self._steps += 1
-        self.metrics.record_step(n_active, self.num_slots, n_active,
-                                 self.scheduler.depth())
+            for k in range(K):
+                first = not req.generated
+                tok = int(toks[k][slot])
+                req.append_token(tok, float(tok_lp[k][slot]))
+                if first:
+                    self.metrics.record_first_token(req.ttft)
+                self._lengths[slot] += 1
+                consumed[k] += 1
+                if (tok == self.gen.eos_id
+                        or len(req.generated) >= req.max_new_tokens):
+                    if K - 1 - k:
+                        self.metrics.count("wasted_decode_steps",
+                                           K - 1 - k)
+                    self._evict(slot)
+                    break
+        self._steps += K
+        depth = self.scheduler.depth()
+        for k in range(K):
+            self.metrics.record_step(n_active, self.num_slots,
+                                     int(consumed[k]), depth)
         if self._writer is not None and \
-                self._steps % self._report_interval == 0:
+                self._steps % self._report_interval < K:
             self.metrics.report(self._writer, self._steps)

@@ -112,6 +112,17 @@ class ServingMetrics:
             "tokens_per_s": self.tokens_per_s(),
             "slot_occupancy": occ,
         })
+        # dispatch-overlap cadence (engine host_syncs / prefill_calls
+        # counters): syncs per decode step — 1/decode_sync_interval —
+        # and prompts amortized per batched prefill call
+        steps = counters.get("decode_steps", 0)
+        if counters.get("host_syncs"):
+            out["host_syncs_per_step"] = (
+                counters["host_syncs"] / max(steps, 1))
+        if counters.get("prefill_calls"):
+            out["prompts_per_prefill"] = (
+                counters.get("prefill_prompts", 0)
+                / counters["prefill_calls"])
         return out
 
     def report(self, writer, step: Optional[int] = None):

@@ -77,6 +77,23 @@ class FIFOScheduler:
                 out.append(req)
         return out
 
+    def pop_ready_grouped(self, n: int, bucket_fn,
+                          max_group: int) -> list:
+        """`pop_ready(n)` coalesced into same-bucket groups of at most
+        `max_group` for batched prefill (engine loop only). Returns
+        [(bucket, [requests])] — groups ordered by each bucket's first
+        arrival, FIFO within a group. Everything popped is admitted
+        this cycle (all callers get slots), so coalescing across the
+        FIFO never starves a request."""
+        groups: dict = {}
+        for req in self.pop_ready(n):
+            groups.setdefault(bucket_fn(req), []).append(req)
+        out = []
+        for bucket, reqs in groups.items():
+            for i in range(0, len(reqs), max(max_group, 1)):
+                out.append((bucket, reqs[i:i + max(max_group, 1)]))
+        return out
+
     def cancel(self, req: GenRequest) -> bool:
         """Drop a still-QUEUED request; returns False if it already left
         the queue (the engine evicts running ones at the next step)."""

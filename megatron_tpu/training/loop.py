@@ -12,6 +12,17 @@ Differences by design:
   batch via jax.device_put against the dp-sharded spec.
 - train_step is one compiled program (training/train_step.py); timers wrap it
   with block_until_ready instead of CUDA syncs.
+- Host/device overlap (async dispatch, the default): the loop never
+  blocks on a step. Per-step metrics stay device-resident in a
+  `_MetricsWindow` (handles only; D2H copies started early via
+  copy_to_host_async) and are materialized in ONE `_device_fetch` per
+  log window; skip/NaN accounting and the divergence guard replay the
+  window's per-step floats at the flush — identical decisions to the
+  step-exact path, at most log_interval-1 steps late (rollback restores
+  a checkpoint either way). Input batches are lifted to the dp-sharded
+  device layout in the prefetch producer thread (batch N+1's transfer
+  overlaps step N). `--sync_metrics` (or profile=True) restores the
+  fetch-every-step behavior.
 """
 from __future__ import annotations
 
@@ -36,6 +47,72 @@ from megatron_tpu.data.samplers import PrefetchIterator
 from megatron_tpu.training.microbatches import MicrobatchCalculator
 from megatron_tpu.utils.logging import make_writer, print_rank_0
 from megatron_tpu.utils.timers import Timers
+
+
+def _device_fetch(tree):
+    """ONE device→host transfer for a pytree of device values — THE
+    sync seam of the training path. Every metrics/eval fetch funnels
+    through here so sync-cadence tests (tests/test_async_dispatch.py)
+    and tools/bench_sync.py can count host syncs by wrapping this one
+    function."""
+    return jax.device_get(tree)
+
+
+class _MetricsWindow:
+    """Device-resident per-step metrics between host syncs.
+
+    `push` keeps a step's scalar jax.Arrays as handles (no sync, no
+    float()) and — with `eager_d2h` (accelerator backends) — starts
+    their D2H copies as soon as the step is dispatched, so `flush`
+    materializes the whole window in ONE already-overlapped
+    `_device_fetch` — the loop's only block point in async mode."""
+
+    def __init__(self, eager_d2h: bool = False):
+        self._eager_d2h = eager_d2h
+        self._its = []
+        self._metrics = []
+
+    def __len__(self):
+        return len(self._its)
+
+    def push(self, iteration: int, metrics: dict):
+        if self._eager_d2h:
+            for v in metrics.values():
+                start = getattr(v, "copy_to_host_async", None)
+                if start is not None:
+                    try:
+                        start()
+                    except Exception:
+                        pass  # backend without async D2H: flush works
+        self._its.append(iteration)
+        self._metrics.append(metrics)
+
+    def flush(self):
+        """-> [(iteration, {name: float})] in step order; empties the
+        window. One `_device_fetch` regardless of window length."""
+        if not self._its:
+            return []
+        vals = _device_fetch(self._metrics)
+        out = [(it, {k: float(v) for k, v in m.items()})
+               for it, m in zip(self._its, vals)]
+        self._its, self._metrics = [], []
+        return out
+
+
+def _make_batch_lift(mesh, batch_sh):
+    """The input lift: host batch pytree -> committed device arrays in
+    the layout the jitted step consumes (dp-sharded batch dim under a
+    mesh, globally-sharded under multi-process, plain placement
+    otherwise). Applied one batch AHEAD of the step that consumes it
+    so the H2D transfer overlaps the previous step's device time."""
+    if batch_sh is not None:
+        from megatron_tpu.parallel.multihost import make_global_batch
+        return lambda b: make_global_batch(b, mesh, batch_sh)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        sh = NamedSharding(mesh, PartitionSpec(None, "dp"))
+        return lambda b: jax.device_put(b, sh)
+    return jax.device_put
 
 
 class SignalState:
@@ -94,8 +171,14 @@ def evaluate(state: TrainState, eval_iterator, eval_step_fn,
     mid-eval stops early and averages over the batches actually seen —
     an exhausted validation split must not kill the training run. With
     ZERO batches seen (the iterator was already dead) returns None so
-    the caller skips reporting instead of logging a fake 0.0 loss."""
-    total = 0.0
+    the caller skips reporting instead of logging a fake 0.0 loss.
+
+    The per-batch losses stay device-resident (handles only) and are
+    fetched in ONE transfer after the loop — the old code float()'d,
+    i.e. host-synced, once per eval batch, serializing the eval stream.
+    The host-order float accumulation is kept so the reported mean is
+    bit-identical to the per-step-fetch version."""
+    losses = []
     seen = 0
     for _ in range(eval_iters):
         try:
@@ -109,11 +192,13 @@ def evaluate(state: TrainState, eval_iterator, eval_step_fn,
         if batch_sh is not None:
             from megatron_tpu.parallel.multihost import make_global_batch
             batch = make_global_batch(batch, mesh, batch_sh)
-        loss = eval_step_fn(state.params, batch)
-        total += float(loss)
+        losses.append(eval_step_fn(state.params, batch))
         seen += 1
     if seen == 0:
         return None
+    total = 0.0
+    for v in _device_fetch(losses):
+        total += float(v)
     mean = total / seen
     return {"lm loss": mean, "lm loss ppl": float(np.exp(min(mean, 20.0)))}
 
@@ -152,7 +237,23 @@ def train(
     distinct code when a step wedges. An active FaultInjector
     (resilience/faults.py) can poison batches / stall steps here — the
     chaos-test entry points."""
-    timers = Timers()
+    # async by default: the loop blocks once per log window (the
+    # metrics flush), not per step; sync_metrics / profile restore the
+    # step-exact barriers (docstring "Host/device overlap")
+    sync_metrics = cfg.training.sync_metrics or cfg.training.profile
+    # Dispatch overlap (run-ahead + committed device_put input lift) is
+    # gated to non-cpu backends: CPU jax 0.4.x recycles donated buffers
+    # of an in-flight step while they are still referenced — observed
+    # as heap corruption on the checkpoint-resume path and wrong decode
+    # tokens in the serving engine (same backend bug family as the
+    # rollback fresh-copy note below). The cpu harness keeps the old
+    # blocking dispatch; the windowed metrics-FETCH cadence — what the
+    # sync tests pin — is pure host logic and stays identical on every
+    # backend.
+    overlap_dispatch = (not sync_metrics
+                        and jax.default_backend() != "cpu")
+    step_barrier = not sync_metrics and not overlap_dispatch
+    timers = Timers(barrier_free=not sync_metrics)
     wandb_kwargs = {}
     if cfg.training.wandb_logger:
         tr = cfg.training
@@ -210,7 +311,26 @@ def train(
             # closure reads the loop's CURRENT state/iteration
             if save_fn is not None:
                 save_fn(state, iteration, consumed_samples)
-        watchdog = StepWatchdog(res.step_timeout_s,
+        wd_timeout = res.step_timeout_s
+        if overlap_dispatch:
+            # run-ahead dispatch: the host only observes device
+            # progress at window FLUSHES (between them dispatch always
+            # "progresses"), and a healthy flush legitimately blocks
+            # for up to a whole window of device time — so the deadline
+            # covers a window, not a step. The cost is detection
+            # latency scaled by log_interval (docs/resilience.md);
+            # --sync_metrics restores the per-step deadline. Per-step-
+            # barrier backends (sync mode, the cpu harness) heartbeat
+            # every iteration and keep the original deadline.
+            wd_timeout = res.step_timeout_s * max(
+                cfg.training.log_interval, 1)
+            print_rank_0(
+                f"watchdog: async metrics scales the step deadline to "
+                f"one log window: {wd_timeout:.1f}s "
+                f"(step_timeout_s={res.step_timeout_s:.1f} x "
+                f"log_interval={cfg.training.log_interval}); use "
+                f"--sync_metrics for per-step hang detection")
+        watchdog = StepWatchdog(wd_timeout,
                                 on_timeout=_watchdog_checkpoint,
                                 exit_code=res.watchdog_exit_code)
 
@@ -221,6 +341,22 @@ def train(
         from jax.sharding import NamedSharding, PartitionSpec
         batch_sh = NamedSharding(mesh, PartitionSpec(None, "dp"))
 
+    # device-side input double buffering ("prefetch_ahead"): batch N+1
+    # is pulled from the iterator and jax.device_put against the
+    # dp-sharded spec RIGHT AFTER step N's async dispatch, so its H2D
+    # transfer rides under step N's device time instead of sitting on
+    # step N+1's dispatch path. Main-thread only: device ops from the
+    # prefetch producer thread race the dispatch and abort inside XLA
+    # on CPU jax 0.4.x. Disabled under rampup (the look-ahead would use
+    # a stale microbatch count) and under an active FaultInjector
+    # (which corrupts HOST arrays per step call, in order).
+    lift_fn = (_make_batch_lift(mesh, batch_sh)
+               if overlap_dispatch and injector is None else None)
+    prefetch_ahead = (lift_fn is not None
+                      and cfg.training.rampup_batch_size is None)
+    pending_batch = None
+    pending_stop: Optional[StopIteration] = None
+
     # host-side batch assembly overlaps device compute (the reference's
     # DataLoader-worker overlap, ref: data_samplers.py num_workers).
     # Not under batch-size rampup: prefetched batches would lag the
@@ -229,6 +365,10 @@ def train(
             and cfg.training.rampup_batch_size is None
             and not isinstance(train_iterator, PrefetchIterator)):
         train_iterator = PrefetchIterator(train_iterator)
+
+    window = _MetricsWindow(eager_d2h=overlap_dispatch)
+    last_metrics: dict = {}
+    memory_reported = False
 
     try:
         while iteration < cfg.training.train_iters:
@@ -241,115 +381,230 @@ def train(
             # phase (bounded by the ramp step count).
             if hasattr(train_iterator, "num_microbatches"):
                 train_iterator.num_microbatches = calc.num_microbatches
-            batch = next(train_iterator)
-            if injector is not None:
-                step_call = injector.next_step_call()
-                injector.maybe_delay(step_call)
-                batch = injector.corrupt_batch(batch, step_call)
-            if batch_sh is not None:
-                from megatron_tpu.parallel.multihost import make_global_batch
-                batch = make_global_batch(batch, mesh, batch_sh)
-            step_rng = jax.random.fold_in(rng, iteration)
-            if (cfg.training.profile and not trace_active
-                    and iteration == cfg.training.profile_step_start):
-                jax.profiler.start_trace(cfg.training.profile_dir
-                                         or cfg.training.tensorboard_dir
-                                         or "/tmp/megatron_tpu_trace")
-                trace_active = True
-            timers("train-step", log_level=0).start()
-            state, metrics = step_fn(state, batch, step_rng)
-            jax.block_until_ready(metrics["lm_loss"])
-            timers("train-step").stop()
-            if watchdog is not None:
-                watchdog.heartbeat()
-                if not watchdog.started:
-                    # arm only now: the first step's jit compile is
-                    # unrelated to the steady-state deadline
-                    watchdog.start()
-            if iteration == start_iteration:
-                # HBM report after the first step (ref: training.py:522-524
-                # report_memory_flag)
-                from megatron_tpu.utils.logging import report_memory
-                report_memory("after first step")
-            if trace_active and iteration >= cfg.training.profile_step_end:
-                jax.profiler.stop_trace()
-                trace_active = False
-                print_rank_0(f"profiler trace written "
-                             f"({cfg.training.profile_step_start}.."
-                             f"{cfg.training.profile_step_end})")
+            stop_exc: Optional[StopIteration] = None
+            if pending_batch is not None:
+                # lifted one step ago; its H2D transfer overlapped the
+                # previous step's device time
+                batch, pending_batch = pending_batch, None
+            elif pending_stop is not None:
+                # deferred iterator exhaustion
+                stop_exc, pending_stop = pending_stop, None
+            else:
+                try:
+                    batch = next(train_iterator)
+                except StopIteration as stop:
+                    # exhausted mid-window: the steps already dispatched
+                    # must still reach the guard and the skip/NaN
+                    # counters (the step-exact path observed every one
+                    # of them before this raise) — skip the step, fall
+                    # through to the flush, then re-raise below
+                    stop_exc = stop
+                else:
+                    if injector is not None:
+                        step_call = injector.next_step_call()
+                        injector.maybe_delay(step_call)
+                        batch = injector.corrupt_batch(batch, step_call)
+                    if lift_fn is not None:
+                        batch = lift_fn(batch)
+                    elif batch_sh is not None:
+                        from megatron_tpu.parallel.multihost import \
+                            make_global_batch
+                        batch = make_global_batch(batch, mesh, batch_sh)
+            if stop_exc is None:
+                step_rng = jax.random.fold_in(rng, iteration)
+                if (cfg.training.profile and not trace_active
+                        and iteration == cfg.training.profile_step_start):
+                    jax.profiler.start_trace(
+                        cfg.training.profile_dir
+                        or cfg.training.tensorboard_dir
+                        or "/tmp/megatron_tpu_trace")
+                    trace_active = True
+                t_step = timers("train-step", log_level=0)
+                t_step.ensure_started()  # async: ONE span per window
+                state, metrics = step_fn(state, batch, step_rng)
+                if sync_metrics:
+                    # exact-sync path: block on this step's result
+                    # before closing the span (the old per-step
+                    # block_until_ready)
+                    t_step.stop(sync_on=metrics["lm_loss"])
+                elif step_barrier:
+                    # cpu-backend donation guard (see step_barrier
+                    # above): completion barrier only, no host transfer
+                    jax.block_until_ready(metrics["lm_loss"])
+                if (watchdog is not None and watchdog.started
+                        and not overlap_dispatch):
+                    # per-step barriers make each iteration real device
+                    # progress — keep the per-step heartbeat (and
+                    # deadline) on these paths; the run-ahead path
+                    # heartbeats at flushes against its window-scaled
+                    # deadline
+                    watchdog.heartbeat()
+                if (trace_active
+                        and iteration >= cfg.training.profile_step_end):
+                    jax.profiler.stop_trace()
+                    trace_active = False
+                    print_rank_0(f"profiler trace written "
+                                 f"({cfg.training.profile_step_start}.."
+                                 f"{cfg.training.profile_step_end})")
 
-            iteration += 1
-            interval_iters += 1
-            consumed_samples += calc.global_batch_size
-            loss_val = float(metrics["lm_loss"])
-            found_inf = bool(metrics["found_inf"])
-            if found_inf:
-                skipped_total += 1
-            if not np.isfinite(loss_val):
-                nan_total += 1
+                iteration += 1
+                interval_iters += 1
+                consumed_samples += calc.global_batch_size
+                window.push(iteration, metrics)
 
-            if guard.enabled:
-                action = guard.observe(loss_val, found_inf)
-                if action is GuardAction.ROLLBACK:
-                    exhausted = guard.note_rollback()
-                    if exhausted:
-                        raise TrainingDivergedError(
-                            f"divergence persisted through "
-                            f"{guard.rollbacks - 1} rollback(s) at "
-                            f"iteration {iteration}; aborting cleanly")
-                    if load_fn is None:
-                        raise TrainingDivergedError(
-                            f"divergence at iteration {iteration} "
-                            f"({guard.max_consecutive_nonfinite} "
-                            "consecutive non-finite steps or loss "
-                            "spike) with no checkpoint to roll back "
-                            "to — configure --save to enable rollback")
-                    print_rank_0(
-                        f"divergence guard: rolling back at iteration "
-                        f"{iteration} (rollback {guard.rollbacks}/"
-                        f"{res.max_rollbacks})")
-                    loaded = load_fn()
-                    if loaded is None or loaded[0] is None:
-                        raise TrainingDivergedError(
-                            "rollback requested but no restorable "
-                            "checkpoint was found")
-                    # rematerialize as fresh uncommitted buffers (a
-                    # REAL copy — np.asarray/jnp.asarray are zero-copy
-                    # on CPU): the step executable was compiled against
-                    # the ORIGINAL state's placement and DONATES its
-                    # inputs, so feeding it the restorer's committed /
-                    # aliased arrays lets the donation clobber the very
-                    # buffers the restore returned (NaN garbage or a
-                    # segfault on CPU jax 0.4.x)
-                    state = jax.tree.map(
-                        lambda x: jnp.array(np.asarray(x), copy=True),
-                        loaded[0])
-                    iteration, consumed_samples = (int(loaded[1]),
-                                                   int(loaded[2]))
-                    # re-seeded step randomness for the replayed
-                    # segment; identical batches + identical rng would
-                    # replay the same divergence
-                    rng = jax.random.fold_in(base_rng,
-                                             0x5EED + guard.rollbacks)
-                    if reset_data_fn is not None:
-                        if isinstance(train_iterator, PrefetchIterator):
-                            train_iterator.close()
-                        train_iterator = reset_data_fn(
-                            consumed_samples, guard.rollbacks)
-                        if (cfg.data.num_workers > 0
-                                and cfg.training.rampup_batch_size is None
-                                and not isinstance(train_iterator,
-                                                   PrefetchIterator)):
-                            train_iterator = PrefetchIterator(
-                                train_iterator)
-                    interval_t0 = time.perf_counter()
-                    interval_iters = 0
-                    continue
+                if (prefetch_ahead and pending_batch is None
+                        and pending_stop is None
+                        and iteration < cfg.training.train_iters):
+                    # the double-buffer fill: pull + lift batch N+1
+                    # while step N runs (the dispatch above did not
+                    # block). Exhaustion is deferred to the next loop
+                    # turn so a finite iterator still serves its last
+                    # batch.
+                    try:
+                        pending_batch = lift_fn(next(train_iterator))
+                    except StopIteration as stop:
+                        pending_stop = stop
 
-            if iteration % cfg.training.log_interval == 0:
+            # window flush points: every step when sync; else log/eval/
+            # save/exit boundaries, the run end, and the first step
+            # (whose flush doubles as the post-compile barrier that
+            # arms the watchdog and grounds the memory report)
+            trcfg = cfg.training
+            log_due = iteration % trcfg.log_interval == 0
+            eval_due = bool(valid_iterator is not None
+                            and trcfg.eval_interval
+                            and iteration % trcfg.eval_interval == 0)
+            save_due = bool(save_fn is not None and trcfg.save_interval
+                            and iteration % trcfg.save_interval == 0)
+            # exit conditions (ref: training.py:712-748), decided ONCE
+            # per iteration and reused by the exit block below — a
+            # SIGTERM (or the duration clock) crossing between two
+            # independent reads would exit with an unflushed window
+            exit_msgs = []
+            if signals.received:
+                exit_msgs.append(
+                    "SIGTERM received: checkpointing and exiting")
+            if (trcfg.exit_interval
+                    and iteration % trcfg.exit_interval == 0):
+                exit_msgs.append(f"exiting at iteration {iteration} "
+                                 "(exit_interval)")
+            if trcfg.exit_duration_in_mins is not None:
+                mins = (time.perf_counter() - t_start) / 60.0
+                if mins > trcfg.exit_duration_in_mins:
+                    exit_msgs.append(f"exiting after {mins:.1f} min "
+                                     "(exit_duration)")
+            exit_due = bool(exit_msgs)
+            flush_due = (sync_metrics or log_due or eval_due or save_due
+                         or exit_due or stop_exc is not None
+                         or iteration >= trcfg.train_iters
+                         or iteration == start_iteration + 1)
+
+            rollback_at = None
+            if flush_due and len(window):
+                flushed = window.flush()  # the window's ONE host sync
+                if not sync_metrics:
+                    t_step.stop_if_started()
+                for it, m in flushed:
+                    last_metrics = m
+                    found_inf = bool(m["found_inf"])
+                    if found_inf:
+                        skipped_total += 1
+                    if not np.isfinite(m["lm_loss"]):
+                        nan_total += 1
+                    if guard.enabled:
+                        action = guard.observe(m["lm_loss"], found_inf)
+                        if action is GuardAction.ROLLBACK:
+                            # steps past the trigger (≤ window-1, already
+                            # executed by the async run-ahead) are
+                            # discarded: the step-exact path never ran
+                            # them and the restore erases their effect,
+                            # so guard state and skip/nan counters stay
+                            # identical across both modes
+                            rollback_at = it
+                            break
+                if watchdog is not None:
+                    watchdog.heartbeat()
+                    if not watchdog.started:
+                        # arm only now: the first step's jit compile
+                        # (barrier'd by the first-step flush above) is
+                        # unrelated to the steady-state deadline
+                        watchdog.start()
+                if not memory_reported:
+                    # HBM report after the first step has actually run
+                    # (ref: training.py:522-524 report_memory_flag)
+                    memory_reported = True
+                    from megatron_tpu.utils.logging import report_memory
+                    report_memory("after first step")
+
+            if rollback_at is not None:
+                exhausted = guard.note_rollback()
+                if exhausted:
+                    raise TrainingDivergedError(
+                        f"divergence persisted through "
+                        f"{guard.rollbacks - 1} rollback(s) at "
+                        f"iteration {rollback_at}; aborting cleanly")
+                if load_fn is None:
+                    raise TrainingDivergedError(
+                        f"divergence at iteration {rollback_at} "
+                        f"({guard.max_consecutive_nonfinite} "
+                        "consecutive non-finite steps or loss "
+                        "spike) with no checkpoint to roll back "
+                        "to — configure --save to enable rollback")
+                print_rank_0(
+                    f"divergence guard: rolling back at iteration "
+                    f"{rollback_at} (rollback {guard.rollbacks}/"
+                    f"{res.max_rollbacks})")
+                loaded = load_fn()
+                if loaded is None or loaded[0] is None:
+                    raise TrainingDivergedError(
+                        "rollback requested but no restorable "
+                        "checkpoint was found")
+                # rematerialize as fresh uncommitted buffers (a
+                # REAL copy — np.asarray/jnp.asarray are zero-copy
+                # on CPU): the step executable was compiled against
+                # the ORIGINAL state's placement and DONATES its
+                # inputs, so feeding it the restorer's committed /
+                # aliased arrays lets the donation clobber the very
+                # buffers the restore returned (NaN garbage or a
+                # segfault on CPU jax 0.4.x)
+                state = jax.tree.map(
+                    lambda x: jnp.array(np.asarray(x), copy=True),
+                    loaded[0])
+                iteration, consumed_samples = (int(loaded[1]),
+                                               int(loaded[2]))
+                # re-seeded step randomness for the replayed
+                # segment; identical batches + identical rng would
+                # replay the same divergence
+                rng = jax.random.fold_in(base_rng,
+                                         0x5EED + guard.rollbacks)
+                if reset_data_fn is not None:
+                    if isinstance(train_iterator, PrefetchIterator):
+                        train_iterator.close()
+                    train_iterator = reset_data_fn(
+                        consumed_samples, guard.rollbacks)
+                    # the look-ahead batch belongs to the OLD stream
+                    pending_batch, pending_stop = None, None
+                    if (cfg.data.num_workers > 0
+                            and cfg.training.rampup_batch_size is None
+                            and not isinstance(train_iterator,
+                                               PrefetchIterator)):
+                        train_iterator = PrefetchIterator(
+                            train_iterator)
+                interval_t0 = time.perf_counter()
+                interval_iters = 0
+                continue
+
+            if stop_exc is not None:
+                # exhaustion, now with the window drained and no
+                # rollback ordered by the replay — surface it as the
+                # step-exact path did
+                raise stop_exc
+
+            if log_due:
                 dt = (time.perf_counter() - interval_t0) / max(interval_iters, 1)
                 toks = calc.global_batch_size * seq_len / dt
-                line = training_log(metrics, iteration, consumed_samples, dt, toks,
+                line = training_log(last_metrics, iteration,
+                                    consumed_samples, dt, toks,
                                     writer, skipped_total, nan_total)
                 print_rank_0(line)
                 if cfg.training.log_timers_to_tensorboard:
@@ -359,8 +614,7 @@ def train(
                 interval_t0 = time.perf_counter()
                 interval_iters = 0
 
-            if (valid_iterator is not None and cfg.training.eval_interval and
-                    iteration % cfg.training.eval_interval == 0):
+            if eval_due:
                 if eval_step_fn is None:
                     sk = step_kwargs or {}
                     eval_step_fn = _make_eval_step(
@@ -381,22 +635,26 @@ def train(
                         writer.add_scalar(f"lm-loss-validation/{k}", v,
                                           iteration)
 
-            should_save = (save_fn is not None and cfg.training.save_interval and
-                           iteration % cfg.training.save_interval == 0)
-            # exit conditions (ref: training.py:712-748)
-            exiting = False
-            if signals.received:
-                print_rank_0("SIGTERM received: checkpointing and exiting")
-                exiting = True
-            if (cfg.training.exit_interval and
-                    iteration % cfg.training.exit_interval == 0):
-                print_rank_0(f"exiting at iteration {iteration} (exit_interval)")
-                exiting = True
-            if cfg.training.exit_duration_in_mins is not None:
-                mins = (time.perf_counter() - t_start) / 60.0
-                if mins > cfg.training.exit_duration_in_mins:
-                    print_rank_0(f"exiting after {mins:.1f} min (exit_duration)")
-                    exiting = True
+            should_save = save_due
+            # the SAME exit decision the flush saw (exit_due above);
+            # re-read the duration clock and SIGTERM once the window is
+            # drained — an eval/save sweep above can burn minutes past
+            # the budget the pre-sweep reading missed, and exiting on
+            # the fresh reading is safe exactly when no unobserved
+            # steps would be dropped
+            exiting = exit_due
+            if not exiting and len(window) == 0:
+                if signals.received:
+                    exit_msgs.append(
+                        "SIGTERM received: checkpointing and exiting")
+                if trcfg.exit_duration_in_mins is not None:
+                    mins = (time.perf_counter() - t_start) / 60.0
+                    if mins > trcfg.exit_duration_in_mins:
+                        exit_msgs.append(f"exiting after {mins:.1f} min "
+                                         "(exit_duration)")
+                exiting = bool(exit_msgs)
+            for msg in exit_msgs:
+                print_rank_0(msg)
             if should_save or (exiting and save_fn is not None):
                 # a slow sync save is not a hung STEP — suspend the
                 # deadline while it runs

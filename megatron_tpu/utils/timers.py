@@ -16,8 +16,9 @@ import jax
 
 
 class _Timer:
-    def __init__(self, name: str):
+    def __init__(self, name: str, barrier_free: bool = False):
         self.name = name
+        self.barrier_free = barrier_free
         self._elapsed = 0.0
         self._count = 0
         self._started = False
@@ -25,18 +26,29 @@ class _Timer:
 
     def start(self, barrier: bool = False, sync_on=None):
         assert not self._started, f"timer {self.name} already started"
-        if sync_on is not None:
+        if sync_on is not None and not self.barrier_free:
             jax.block_until_ready(sync_on)
         self._start_time = time.perf_counter()
         self._started = True
 
     def stop(self, barrier: bool = False, sync_on=None):
         assert self._started, f"timer {self.name} not started"
-        if sync_on is not None:
+        if sync_on is not None and not self.barrier_free:
             jax.block_until_ready(sync_on)
         self._elapsed += time.perf_counter() - self._start_time
         self._count += 1
         self._started = False
+
+    def ensure_started(self):
+        """Idempotent start — the async train loop opens ONE span per
+        log window (first dispatch after a flush) instead of a
+        barrier'd span per step."""
+        if not self._started:
+            self.start()
+
+    def stop_if_started(self):
+        if self._started:
+            self.stop()
 
     def elapsed(self, reset: bool = True) -> float:
         was_started = self._started
@@ -56,16 +68,24 @@ class _Timer:
 
 
 class Timers:
-    """(ref: timers.py:136-307) registry with log levels and a write() dump."""
+    """(ref: timers.py:136-307) registry with log levels and a write() dump.
 
-    def __init__(self, log_level: int = 2):
+    `barrier_free=True` drops every device barrier (`sync_on` args are
+    ignored): spans measure host wall time only. The async train loop
+    uses this — it times whole log windows, whose flush already syncs —
+    while `profile=True` / `--sync_metrics` runs keep the exact
+    per-step barriers."""
+
+    def __init__(self, log_level: int = 2, barrier_free: bool = False):
         self._timers: dict[str, _Timer] = {}
         self._levels: dict[str, int] = {}
         self.log_level = log_level
+        self.barrier_free = barrier_free
 
     def __call__(self, name: str, log_level: int = 0) -> _Timer:
         if name not in self._timers:
-            self._timers[name] = _Timer(name)
+            self._timers[name] = _Timer(name,
+                                        barrier_free=self.barrier_free)
             self._levels[name] = log_level
         return self._timers[name]
 

@@ -1,0 +1,250 @@
+"""Host-sync cadence tests for the async-dispatch layer.
+
+The load-bearing contracts of the latency-hiding overlap work:
+- the train loop performs ONE metrics fetch per log window (vs one per
+  step with --sync_metrics) — counted through the `_device_fetch` seam;
+- async-metrics training logs bit-identical per-window losses to the
+  step-exact path, and the divergence guard makes the SAME rollback
+  decisions (the window replay discards post-trigger steps, so guard
+  state and skip/nan counters match);
+- `evaluate()` fetches once per eval sweep, not once per batch;
+- the serving engine's sync cadence lives in tests/test_serving.py
+  (TestDecodeSyncCadence).
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.config import (DataConfig, MegatronConfig, ModelConfig,
+                                 OptimizerConfig, ResilienceConfig,
+                                 TrainingConfig)
+from megatron_tpu.resilience import FaultInjector, use_fault_injector
+from megatron_tpu.training import loop as loop_mod
+from megatron_tpu.training.loop import evaluate, train
+
+
+def tiny_cfg(sync_metrics: bool, train_iters: int = 8,
+             log_interval: int = 4, save_interval=None,
+             num_workers: int = 0, **res):
+    model = ModelConfig(num_layers=2, hidden_size=32,
+                        num_attention_heads=2, vocab_size=64,
+                        seq_length=16).derived()
+    return MegatronConfig(
+        model=model,
+        optimizer=OptimizerConfig(lr=1e-3),
+        training=TrainingConfig(micro_batch_size=1, global_batch_size=2,
+                                train_iters=train_iters,
+                                log_interval=log_interval,
+                                save_interval=save_interval,
+                                sync_metrics=sync_metrics),
+        data=DataConfig(num_workers=num_workers),
+        resilience=ResilienceConfig(**res),
+    ).validate(n_devices=1)
+
+
+def _batch(key: int):
+    tokens = jax.random.randint(jax.random.PRNGKey(key), (2, 1, 17), 0, 64)
+    return {"tokens": np.asarray(tokens),
+            "loss_mask": np.ones((2, 1, 16), np.float32)}
+
+
+def _batches(seed: int = 0):
+    i = 0
+    while True:
+        yield _batch(seed * 1000 + i)
+        i += 1
+
+
+@pytest.fixture
+def fetch_calls(monkeypatch):
+    """Transfer-counting shim: every host sync in the train/eval path
+    funnels through loop._device_fetch, so wrapping it counts syncs."""
+    calls = []
+    real = loop_mod._device_fetch
+
+    def counting(tree):
+        calls.append(len(jax.tree.leaves(tree)))
+        return real(tree)
+
+    monkeypatch.setattr(loop_mod, "_device_fetch", counting)
+    return calls
+
+
+class RecordingWriter:
+    def __init__(self):
+        self.scalars = []
+
+    def add_scalar(self, tag, value, step):
+        self.scalars.append((tag, float(value), int(step)))
+
+    def flush(self):
+        pass
+
+    def series(self, tag):
+        return [(s, v) for t, v, s in self.scalars if t == tag]
+
+
+@pytest.fixture
+def writer(monkeypatch):
+    w = RecordingWriter()
+    monkeypatch.setattr(loop_mod, "make_writer", lambda *a, **k: w)
+    return w
+
+
+class TestTrainSyncCadence:
+    """Acceptance: host syncs per train step drop from >=1 (sync mode)
+    to <=1 per log window (async mode)."""
+
+    def test_async_fetches_once_per_window(self, fetch_calls, writer):
+        cfg = tiny_cfg(sync_metrics=False, train_iters=8, log_interval=4)
+        train(cfg, _batches(), rng=jax.random.PRNGKey(0))
+        # flushes: first step (post-compile barrier + memory report),
+        # iteration 4 (log), iteration 8 (log + run end) — one transfer
+        # each, regardless of window length
+        assert len(fetch_calls) == 3, fetch_calls
+
+    def test_sync_mode_fetches_every_step(self, fetch_calls, writer):
+        cfg = tiny_cfg(sync_metrics=True, train_iters=8, log_interval=4)
+        train(cfg, _batches(), rng=jax.random.PRNGKey(0))
+        assert len(fetch_calls) == 8, fetch_calls
+
+
+class TestAsyncParity:
+    """Acceptance: same data/seed => async logs the same per-window
+    losses and the guard makes the same rollback decisions as
+    --sync_metrics."""
+
+    def _run(self, sync: bool, monkeypatch):
+        w = RecordingWriter()
+        monkeypatch.setattr(loop_mod, "make_writer", lambda *a, **k: w)
+        cfg = tiny_cfg(sync, train_iters=9, log_interval=3)
+        state, consumed = train(cfg, _batches(7),
+                                rng=jax.random.PRNGKey(3))
+        return w, state, consumed
+
+    def test_logged_losses_identical(self, monkeypatch):
+        w_sync, st_s, c_s = self._run(True, monkeypatch)
+        w_async, st_a, c_a = self._run(False, monkeypatch)
+        tag = "lm-loss-training/lm loss"
+        assert w_sync.series(tag) == w_async.series(tag)  # bit-exact
+        assert w_sync.series(tag), "premise: something was logged"
+        assert c_s == c_a
+        for a, b in zip(jax.tree.leaves(st_s.params),
+                        jax.tree.leaves(st_a.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def _run_guarded(self, sync: bool, monkeypatch, num_workers: int = 0):
+        """NaN-poison step calls 3+4 (streak of 2 with
+        max_consecutive_nonfinite=2) -> the guard must roll back to the
+        iteration-2 snapshot in BOTH modes, even though async only
+        notices at the next flush boundary."""
+        w = RecordingWriter()
+        monkeypatch.setattr(loop_mod, "make_writer", lambda *a, **k: w)
+        cfg = tiny_cfg(sync, train_iters=6, log_interval=2,
+                       save_interval=2, num_workers=num_workers,
+                       max_consecutive_nonfinite=2)
+        saved = {}
+        loads = []
+
+        def save_fn(st, iteration, consumed):
+            saved["snap"] = (
+                jax.tree.map(lambda x: np.asarray(x).copy(), st),
+                iteration, consumed)
+
+        def load_fn():
+            st, it, cons = saved["snap"]
+            loads.append(it)
+            return jax.tree.map(jnp.asarray, st), it, cons
+
+        inj = FaultInjector(nan_step_calls={3, 4})
+        with use_fault_injector(inj):
+            state, consumed = train(
+                cfg, _batches(0), rng=jax.random.PRNGKey(cfg.training.seed),
+                save_fn=save_fn, load_fn=load_fn,
+                reset_data_fn=lambda c, r: _batches(r))
+        return w, state, consumed, loads
+
+    def test_guard_rollback_decisions_identical(self, monkeypatch):
+        w_s, st_s, c_s, loads_s = self._run_guarded(True, monkeypatch)
+        w_a, st_a, c_a, loads_a = self._run_guarded(False, monkeypatch)
+        # one rollback in both modes, from the same checkpoint iteration
+        assert loads_s == loads_a == [2]
+        assert int(st_s.iteration) == int(st_a.iteration) == 6
+        assert c_s == c_a
+        tag = "lm-loss-training/lm loss"
+        assert w_s.series(tag) == w_a.series(tag)
+
+    def test_rollback_rewraps_prefetch_iterator(self, monkeypatch):
+        """Rollback on a worker-fed run (num_workers>0) re-wraps the
+        reset iterator in PrefetchIterator — the recovery path the
+        resilience subsystem exists for must survive the async loop."""
+        w, state, consumed, loads = self._run_guarded(
+            False, monkeypatch, num_workers=1)
+        assert loads == [2]
+        assert int(state.iteration) == 6
+
+
+class TestExhaustionFlush:
+    def test_guard_observes_tail_steps_on_iterator_exhaustion(
+            self, monkeypatch, writer):
+        """A finite iterator that dies mid-window must not take the
+        window's guard observations with it: a NaN streak in the tail
+        steps raises TrainingDivergedError (no checkpoint to roll back
+        to) in BOTH modes — never a bare StopIteration that silently
+        drops the unobserved steps."""
+        from megatron_tpu.resilience import TrainingDivergedError
+
+        def finite(n):
+            for i in range(n):
+                yield _batch(i)
+
+        for sync in (True, False):
+            cfg = tiny_cfg(sync, train_iters=100, log_interval=100,
+                           max_consecutive_nonfinite=2)
+            inj = FaultInjector(nan_step_calls={4, 5})
+            with use_fault_injector(inj):
+                with pytest.raises(TrainingDivergedError):
+                    train(cfg, finite(5), rng=jax.random.PRNGKey(0))
+
+
+class TestEvalSingleFetch:
+    def test_evaluate_fetches_once(self, fetch_calls):
+        from types import SimpleNamespace
+        batches = iter([{"v": float(v)} for v in (1.0, 3.0, 5.0, 7.0)])
+        state = SimpleNamespace(params=None)
+        step = lambda params, b: jnp.float32(b["v"])  # noqa: E731
+        out = evaluate(state, batches, step, eval_iters=4)
+        assert out["lm loss"] == pytest.approx(4.0)
+        assert len(fetch_calls) == 1, (
+            "evaluate must fetch ONCE after the sweep, not per batch")
+        assert fetch_calls[0] == 4  # all 4 losses ride the one transfer
+
+
+class TestPrefetchAheadLift:
+    """The input lift is gated off the cpu backend inside train()
+    (donation + run-ahead trips CPU jax 0.4.x buffer recycling), but
+    the lift itself must produce exactly the layout the step consumes —
+    pin it directly."""
+
+    def test_lift_plain_and_sharded(self):
+        from megatron_tpu.training.loop import _make_batch_lift
+        batch = _batch(0)
+        lifted = _make_batch_lift(None, None)(batch)
+        assert all(isinstance(x, jax.Array)
+                   for x in jax.tree.leaves(lifted))
+        np.testing.assert_array_equal(np.asarray(lifted["tokens"]),
+                                      batch["tokens"])
+
+    def test_lift_against_mesh_spec(self, devices):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        from megatron_tpu.parallel.mesh import MESH_AXES
+        from megatron_tpu.training.loop import _make_batch_lift
+        mesh = Mesh(np.asarray(devices[:2]).reshape(2, 1, 1, 1),
+                    MESH_AXES)
+        batch = {"tokens": np.zeros((2, 4, 17), np.int32)}
+        lifted = _make_batch_lift(mesh, None)(batch)
+        want = NamedSharding(mesh, PartitionSpec(None, "dp"))
+        assert lifted["tokens"].sharding.is_equivalent_to(want, 3)

@@ -107,6 +107,28 @@ def test_serving_bench_emits_record(monkeypatch, tmp_path):
     assert rec["decode_steps"] >= 6  # 6 requests interleaved on 2 slots
 
 
+def test_bench_sync_emits_cadence_record(monkeypatch, tmp_path):
+    """The host-sync cadence A/B must show the async window fetching
+    fewer times than per-step and the K-window serving arm syncing at
+    exactly 1/K per decode step."""
+    import json
+    text = run_tool(
+        monkeypatch, tmp_path, "bench_sync.py",
+        ["--iters", "9", "--log_interval", "3", "--requests", "3",
+         "--slots", "2", "--new", "6", "--sync_k", "3",
+         "--layers", "2", "--hidden", "64", "--heads", "4",
+         "--vocab", "128", "--seq", "64"])
+    rec = json.loads(text)
+    tr = rec["training"]
+    assert tr["sync"]["host_syncs"] == 9          # one fetch per step
+    assert tr["async"]["host_syncs"] <= 4         # one per window (+1st)
+    assert tr["sync_reduction_x"] >= 2
+    sv = rec["serving"]
+    assert sv["k1"]["syncs_per_step"] == 1.0
+    assert sv["k"]["syncs_per_step"] == pytest.approx(1 / 3, abs=1e-3)
+    assert sv["k"]["tokens"] == sv["k1"]["tokens"]  # cadence != semantics
+
+
 def test_bench_kernels_smoke_runs_all_arms(monkeypatch, tmp_path):
     text = run_tool(monkeypatch, tmp_path, "bench_kernels.py",
                     ["--smoke", "--iters", "2"])

@@ -585,6 +585,82 @@ class TestServerStatusCodes:
         assert snap["requests_completed"] >= 1
 
 
+class TestDecodeSyncCadence:
+    """Acceptance for the K-step dispatch window: decode_sync_interval=K
+    is token-exact vs K=1 for seeded requests, performs 1/K host syncs
+    per decode step, still compiles the decode exactly once, and only
+    re-uploads the per-slot sampling state on slot churn."""
+
+    def _collect(self, tiny_model, K):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        with ServingEngine(gen, ServingConfig(
+                num_slots=3, max_queue=32, max_len=64,
+                decode_sync_interval=K)) as eng:
+            reqs = [eng.submit(p, 8,
+                               SamplingOptions(temperature=0.9, top_k=5),
+                               seed=100 + i)
+                    for i, p in enumerate(PROMPTS)]
+            outs = [r.result(timeout=300)[0] for r in reqs]
+            assert eng._decode_traces == 1
+            return outs, eng.metrics.snapshot()
+
+    def test_k_step_window_token_exact_at_one_over_k_syncs(self,
+                                                           tiny_model):
+        outs1, snap1 = self._collect(tiny_model, 1)
+        outs3, snap3 = self._collect(tiny_model, 3)
+        # token-exact: per-slot rng/logits/KV chains are independent of
+        # the sync cadence
+        assert outs1 == outs3
+        # 1/K syncs per decode step, windows always complete
+        assert snap1["host_syncs"] == snap1["decode_steps"]
+        assert snap3["decode_steps"] % 3 == 0
+        assert snap3["host_syncs"] == snap3["decode_steps"] / 3
+        assert snap3["host_syncs_per_step"] == pytest.approx(1 / 3)
+
+    def test_sampling_uploads_only_on_slot_churn(self, tiny_model):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        with ServingEngine(gen, ServingConfig(num_slots=2, max_queue=8,
+                                              max_len=64)) as eng:
+            toks, _ = eng.generate([5, 17, 3], 24,
+                                   SamplingOptions(temperature=0.8),
+                                   seed=9)
+            snap = eng.metrics.snapshot()
+        # one long-running request: ~24 decode steps but the sampling
+        # knobs upload only on admission (+ the engine's initial dirty
+        # state), NOT once per step as before
+        assert snap["decode_steps"] >= 20
+        assert snap["sampling_uploads"] <= 3
+
+    def test_batched_prefill_coalesces_same_bucket_admissions(
+            self, tiny_model):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        eng = ServingEngine(gen, ServingConfig(num_slots=3, max_queue=32,
+                                               max_len=64),
+                            start=False)
+        try:
+            # queue a burst BEFORE the loop starts so the first pop
+            # sees all of them: 3 free slots, same 16-token bucket ->
+            # ONE batched prefill call for the first three
+            reqs = [eng.submit(p, 4, SamplingOptions(temperature=0.0),
+                               seed=0) for p in PROMPTS[:4]]
+            eng._thread.start()
+            outs = [r.result(timeout=300)[0] for r in reqs]
+            snap = eng.metrics.snapshot()
+        finally:
+            eng.close()
+        assert snap["prefill_calls"] <= 2  # 3 coalesced + 1 straggler
+        assert snap["prefill_prompts"] == 4
+        assert snap["prompts_per_prefill"] >= 2
+        # batching is a scheduling change, not a semantics change
+        for p, toks in zip(PROMPTS[:4], outs):
+            want_toks, want_lens, _ = gen.generate(
+                [p], 4, sampling=SamplingParams(temperature=0.0))
+            assert toks == want_toks[0, :want_lens[0]].tolist()
+
+
 class TestSeeding:
     def test_explicit_seed_deterministic_unseeded_entropic(self,
                                                            tiny_model):
