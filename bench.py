@@ -346,6 +346,12 @@ def _run_extras():
         # recovery-latency record makes regressions in the resilience
         # subsystem show up next to the perf numbers
         ("chaos_train.py", ["--smoke"], "/tmp/bench_extras_chaos.log"),
+        # corrupt-dataset detection smoke: inject truncated-.bin /
+        # garbage-.idx / out-of-range-pointer faults, prove each raises
+        # a typed DatasetCorruptionError at open (docs/resilience.md
+        # "corrupt-data detection")
+        ("validate_dataset.py", ["--smoke"],
+         "/tmp/bench_extras_validate_dataset.log"),
         ("bench_32k.py", [], "/tmp/bench_extras_32k.log"),
         # 1F1B bubble curve vs n_micro (VERDICT r4 #7): tick-count
         # analysis on one chip, full fit on a multi-device mesh

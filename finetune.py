@@ -41,7 +41,7 @@ def build_data(cfg, tokenizer, consumed_samples: int, mesh=None):
                 return None
             ds, _, _ = build_train_valid_test_datasets(
                 list(paths), "1,0,0", cfg.model.seq_length, tr.seed,
-                n, 0, 0)
+                n, 0, 0, strict_data=cfg.data.strict_data)
             return ds
         train_ds = one(cfg.data.train_data_path or cfg.data.data_path,
                        samples[0])
@@ -50,7 +50,7 @@ def build_data(cfg, tokenizer, consumed_samples: int, mesh=None):
     else:
         train_ds, valid_ds, test_ds = build_train_valid_test_datasets(
             cfg.data.data_path, cfg.data.split, cfg.model.seq_length,
-            tr.seed, *samples)
+            tr.seed, *samples, strict_data=cfg.data.strict_data)
 
     host_rows = None
     if mesh is not None and jax.process_count() > 1:
@@ -80,7 +80,7 @@ def build_data(cfg, tokenizer, consumed_samples: int, mesh=None):
 def main(argv=None):
     from megatron_tpu.arguments import parse_cli
     from megatron_tpu.config import MegatronConfig
-    from megatron_tpu.data import build_tokenizer
+    from megatron_tpu.data import build_tokenizer, restore_data_state
     from megatron_tpu.parallel.mesh import build_mesh
     from megatron_tpu.training import init_train_state
     from megatron_tpu.training import checkpointing as ckpt
@@ -121,27 +121,38 @@ def main(argv=None):
     rng = jax.random.PRNGKey(cfg.training.seed)
     state = init_train_state(rng, cfg)
     start_iteration, consumed = 0, 0
+    data_state, quarantine = None, []
     load_dir = cfg.training.load_dir or cfg.training.checkpoint_dir
     if load_dir:
-        loaded, start_iteration, consumed = ckpt.load_checkpoint(
+        loaded = ckpt.load_checkpoint(
             load_dir, state, finetune=cfg.training.finetune,
             no_load_optim=cfg.training.no_load_optim,
             resilience=cfg.resilience)
-        if loaded is not None:
-            state = loaded
+        _, start_iteration, consumed = loaded
+        data_state, quarantine = loaded.data_state, loaded.quarantine
+        if loaded.state is not None:
+            state = loaded.state
 
     train_it, valid_it, _ = build_data(cfg, tokenizer, consumed, mesh=mesh)
     assert train_it is not None, "--data_path produced no training data"
+    restore_data_state(train_it, data_state)
 
     save_fn = None
     if cfg.training.checkpoint_dir:
-        def save_fn(st, iteration, consumed_samples):
+        def save_fn(st, iteration, consumed_samples, data_state=None,
+                    quarantine=None):
+            # data_state/quarantine: the loop's exact-resume snapshot of
+            # the training iterator, persisted in checkpoint metadata so
+            # a restart replays the identical batch sequence
             ckpt.save_checkpoint(cfg.training.checkpoint_dir, st, cfg,
-                                 iteration, consumed_samples)
+                                 iteration, consumed_samples,
+                                 data_state=data_state,
+                                 quarantine=quarantine)
 
     # divergence-rollback hooks (docs/resilience.md): restore the newest
-    # valid checkpoint and rebuild the data stream with a shifted seed so
-    # the replayed segment sees a different sample order. Rollback only
+    # valid checkpoint and rebuild the data stream at its EXACT saved
+    # position — the loop replays the identical order and quarantines
+    # the poisoned step window (never a re-seeded order). Rollback only
     # targets checkpoints THIS run writes (--save): restoring the --load
     # base would resurrect its iteration counter / optimizer state (a
     # finetune base "resumes" at its pretraining iteration and the loop
@@ -153,18 +164,17 @@ def main(argv=None):
                                         state,
                                         resilience=cfg.resilience)
 
-    def reset_data_fn(consumed_samples, reseed):
-        import dataclasses
-        cfg2 = dataclasses.replace(cfg, training=dataclasses.replace(
-            cfg.training, seed=cfg.training.seed + reseed))
-        it, _, _ = build_data(cfg2, tokenizer, consumed_samples,
+    def reset_data_fn(consumed_samples, rollbacks, data_state=None):
+        it, _, _ = build_data(cfg, tokenizer, consumed_samples,
                               mesh=mesh)
+        restore_data_state(it, data_state)
         return it
 
     state, consumed = train(
         cfg, train_it, valid_it, mesh=mesh, state=state, rng=rng,
         start_iteration=start_iteration, consumed_samples=consumed,
-        save_fn=save_fn, load_fn=load_fn, reset_data_fn=reset_data_fn)
+        save_fn=save_fn, load_fn=load_fn, reset_data_fn=reset_data_fn,
+        quarantine_log=quarantine)
     print_rank_0(f"training done at consumed_samples={consumed}")
     return 0
 

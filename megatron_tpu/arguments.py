@@ -201,6 +201,11 @@ def build_parser(extra_args_provider: Optional[Callable] = None
     g.add_argument("--no_new_tokens", dest="new_tokens",
                    action="store_false", default=True)
     g.add_argument("--data_impl", type=str, default="mmap")
+    g.add_argument("--strict_data", action="store_true",
+                   help="fail fast (DatasetCorruptionError) on "
+                        "out-of-bounds documents or corrupt blend "
+                        "prefixes instead of the default "
+                        "skip-and-count (docs/resilience.md)")
     g.add_argument("--mask_prob", type=float, default=0.15,
                    dest="masked_lm_prob",
                    help="masked-LM probability (ref: --mask_prob)")

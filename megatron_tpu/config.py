@@ -348,6 +348,10 @@ class DataConfig:
     new_tokens: bool = True
     data_impl: str = "mmap"
     mmap_warmup: bool = False
+    # corrupt-data policy (docs/resilience.md): False (default) skips
+    # and counts out-of-bounds documents / corrupt blend prefixes with
+    # loud warnings; True fails fast with DatasetCorruptionError
+    strict_data: bool = False
 
 
 # serving KV-pool dtypes: the model dtype spellings plus int8 (the
@@ -434,7 +438,8 @@ class ResilienceConfig:
     Divergence guard: after `max_consecutive_nonfinite` NaN/inf steps
     (0 disables) or a finite loss above `loss_spike_factor` × the
     rolling `loss_spike_window`-step mean, the loop rolls back to the
-    last checkpoint with a re-seeded data order; more than
+    last checkpoint, replays the exact data order from its saved
+    iterator state, and quarantines the poisoned step window; more than
     `max_rollbacks` rollbacks aborts with TrainingDivergedError.
     Watchdog: a train step exceeding `step_timeout_s` (None disables)
     dumps stacks, attempts a final checkpoint, and exits with

@@ -1,10 +1,11 @@
 from megatron_tpu.data.indexed_dataset import (  # noqa: F401
-    IndexedDatasetBuilder, MMapIndexedDataset, best_fitting_dtype,
-    infer_dataset_exists, make_dataset)
+    DatasetCorruptionError, IndexedDatasetBuilder, MMapIndexedDataset,
+    best_fitting_dtype, infer_dataset_exists, make_dataset)
 from megatron_tpu.data.gpt_dataset import (  # noqa: F401
     GPTDataset, build_train_valid_test_datasets, get_train_valid_test_split_)
 from megatron_tpu.data.blendable import BlendableDataset  # noqa: F401
 from megatron_tpu.data.samplers import (  # noqa: F401
-    BatchIterator, MegatronPretrainingRandomSampler,
-    MegatronPretrainingSampler, get_ltor_masks_and_position_ids)
+    BatchIterator, DictBatchIterator, MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler, PrefetchIterator,
+    get_ltor_masks_and_position_ids, restore_data_state)
 from megatron_tpu.data.tokenizers import build_tokenizer  # noqa: F401

@@ -17,12 +17,14 @@ import numpy as np
 def normalize_blend_weights(data_prefix: Sequence):
     """[w0, p0, w1, p1, ...] -> (prefixes, normalized weights)
     (ref: megatron/data/dataset_utils.py get_datasets_weights_and_num_samples)."""
-    assert len(data_prefix) % 2 == 0, (
-        "blended data_path must alternate weight, prefix")
+    if len(data_prefix) % 2 != 0:
+        raise ValueError("blended data_path must alternate weight, prefix "
+                         f"(got {len(data_prefix)} items)")
     weights = [float(w) for w in data_prefix[0::2]]
     prefixes = [str(p) for p in data_prefix[1::2]]
     s = sum(weights)
-    assert s > 0
+    if s <= 0:
+        raise ValueError(f"blend weights must sum > 0 (got {weights})")
     return prefixes, [w / s for w in weights]
 
 

@@ -27,7 +27,9 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from megatron_tpu.data.indexed_dataset import MMapIndexedDataset, make_dataset
+from megatron_tpu.data.indexed_dataset import (DatasetCorruptionError,
+                                               MMapIndexedDataset,
+                                               make_dataset)
 from megatron_tpu.utils.logging import print_rank_0
 
 
@@ -121,42 +123,56 @@ def build_index_mappings(name: str, data_prefix: str, documents: np.ndarray,
                                   base + "_sample_idx.npy",
                                   base + "_shuffle_idx.npy")
 
-    if not cache or not all(os.path.isfile(f) for f in (doc_f, sample_f,
-                                                        shuffle_f)):
-        t0 = time.time()
-        if num_epochs == 1:
-            separate_last_epoch = False
-        else:
-            # (ref: gpt_dataset.py:313-339) separate the last epoch from the
-            # global shuffle when it contributes <80% of an epoch's samples
-            samples_sans_last = ((num_epochs - 1) * tokens_per_epoch - 1
-                                 ) // seq_length
-            last_epoch_samples = num_samples - samples_sans_last
-            samples_per_epoch = (tokens_per_epoch - 1) // seq_length
-            assert 0 <= last_epoch_samples <= samples_per_epoch + 1
-            separate_last_epoch = (last_epoch_samples <
-                                   int(0.80 * samples_per_epoch))
-
-        doc_idx = build_doc_idx(documents, num_epochs, np_rng,
-                                separate_last_epoch)
-        sample_idx = build_sample_idx(sizes, doc_idx, seq_length, num_epochs,
-                                      tokens_per_epoch)
-        if separate_last_epoch:
-            n_shuffle = ((num_epochs - 1) * tokens_per_epoch - 1) // seq_length
-        else:
-            n_shuffle = sample_idx.shape[0] - 1
-        shuffle_idx = build_shuffle_idx(n_shuffle, sample_idx.shape[0] - 1,
-                                        np_rng)
-        if cache:
-            np.save(doc_f, doc_idx, allow_pickle=True)
-            np.save(sample_f, sample_idx, allow_pickle=True)
-            np.save(shuffle_f, shuffle_idx, allow_pickle=True)
-            print_rank_0(f"built index mappings for {name} in "
-                         f"{time.time()-t0:.2f}s ({num_epochs} epochs, "
-                         f"{sample_idx.shape[0]-1} samples)")
-        else:
+    if cache and all(os.path.isfile(f) for f in (doc_f, sample_f, shuffle_f)):
+        doc_idx = np.load(doc_f, allow_pickle=True, mmap_mode="r")
+        sample_idx = np.load(sample_f, allow_pickle=True, mmap_mode="r")
+        shuffle_idx = np.load(shuffle_f, allow_pickle=True, mmap_mode="r")
+        # a mapping cached against a previous version of the corpus can
+        # name documents the current index no longer has (corpus
+        # re-preprocessed smaller under the same prefix, or ids the
+        # caller's out-of-bounds filtering just removed) — serving it
+        # would bypass the skip-and-count policy and die downstream in
+        # numpy instead of here
+        if (doc_idx.size > 0 and int(doc_idx.min()) >= 0
+                and int(doc_idx.max()) < len(sizes)):
             return doc_idx, sample_idx, shuffle_idx
+        print_rank_0(f"warning: cached index mapping {base}_* names "
+                     f"documents outside the current index of "
+                     f"{len(sizes)} sequences (stale cache from a "
+                     "rewritten corpus); rebuilding")
 
+    t0 = time.time()
+    if num_epochs == 1:
+        separate_last_epoch = False
+    else:
+        # (ref: gpt_dataset.py:313-339) separate the last epoch from the
+        # global shuffle when it contributes <80% of an epoch's samples
+        samples_sans_last = ((num_epochs - 1) * tokens_per_epoch - 1
+                             ) // seq_length
+        last_epoch_samples = num_samples - samples_sans_last
+        samples_per_epoch = (tokens_per_epoch - 1) // seq_length
+        assert 0 <= last_epoch_samples <= samples_per_epoch + 1
+        separate_last_epoch = (last_epoch_samples <
+                               int(0.80 * samples_per_epoch))
+
+    doc_idx = build_doc_idx(documents, num_epochs, np_rng,
+                            separate_last_epoch)
+    sample_idx = build_sample_idx(sizes, doc_idx, seq_length, num_epochs,
+                                  tokens_per_epoch)
+    if separate_last_epoch:
+        n_shuffle = ((num_epochs - 1) * tokens_per_epoch - 1) // seq_length
+    else:
+        n_shuffle = sample_idx.shape[0] - 1
+    shuffle_idx = build_shuffle_idx(n_shuffle, sample_idx.shape[0] - 1,
+                                    np_rng)
+    if not cache:
+        return doc_idx, sample_idx, shuffle_idx
+    np.save(doc_f, doc_idx, allow_pickle=True)
+    np.save(sample_f, sample_idx, allow_pickle=True)
+    np.save(shuffle_f, shuffle_idx, allow_pickle=True)
+    print_rank_0(f"built index mappings for {name} in "
+                 f"{time.time()-t0:.2f}s ({num_epochs} epochs, "
+                 f"{sample_idx.shape[0]-1} samples)")
     doc_idx = np.load(doc_f, allow_pickle=True, mmap_mode="r")
     sample_idx = np.load(sample_f, allow_pickle=True, mmap_mode="r")
     shuffle_idx = np.load(shuffle_f, allow_pickle=True, mmap_mode="r")
@@ -165,16 +181,39 @@ def build_index_mappings(name: str, data_prefix: str, documents: np.ndarray,
 
 class GPTDataset:
     """Map-style dataset of [seq_length+1]-token samples
-    (ref: gpt_dataset.py:221-269)."""
+    (ref: gpt_dataset.py:221-269).
+
+    Document ids outside the index are SKIPPED and counted
+    (`skipped_documents`, logged) by default — one bad split boundary
+    or stale doc list must not kill a multi-week run; `strict_data=True`
+    (`--strict_data`) fails fast with `DatasetCorruptionError` instead."""
 
     def __init__(self, name: str, data_prefix: str,
                  documents: np.ndarray, indexed: MMapIndexedDataset,
                  num_samples: int, seq_length: int, seed: int,
-                 cache: bool = True):
+                 cache: bool = True, strict_data: bool = False):
         self.name = name
+        self.data_prefix = data_prefix
         self.indexed = indexed
-        assert np.min(documents) >= 0
-        assert np.max(documents) < len(indexed.sizes)
+        documents = np.asarray(documents)
+        oob = (documents < 0) | (documents >= len(indexed.sizes))
+        self.skipped_documents = int(oob.sum())
+        if self.skipped_documents:
+            msg = (f"dataset {name}: {self.skipped_documents}/"
+                   f"{documents.size} document ids out of bounds for an "
+                   f"index of {len(indexed.sizes)} sequences (stale doc "
+                   "split or corrupt index)")
+            if strict_data:
+                raise DatasetCorruptionError(
+                    data_prefix, msg + " — re-run preprocessing, or drop "
+                    "--strict_data to skip them")
+            print_rank_0(f"warning: {msg}; skipping them "
+                         "(--strict_data fails fast instead)")
+            documents = documents[~oob]
+        if documents.size == 0:
+            raise DatasetCorruptionError(
+                data_prefix, f"dataset {name}: no in-bounds documents "
+                "left to sample from")
         self.doc_idx, self.sample_idx, self.shuffle_idx = build_index_mappings(
             name, data_prefix, documents, np.asarray(indexed.sizes),
             num_samples, seq_length, seed, cache=cache)
@@ -202,9 +241,16 @@ class GPTDataset:
             parts.append(self.indexed.get(self.doc_idx[doc_index_l],
                                           length=int(offset_l + 1)))
             sample = np.concatenate(parts)
-        assert len(sample) == self.seq_length + 1, (
-            f"sample {idx}: got {len(sample)} tokens, "
-            f"want {self.seq_length + 1}")
+        if len(sample) != self.seq_length + 1:
+            # typed (not an assert: gone under python -O) — a
+            # wrong-length sample means the on-disk index and data
+            # disagree, and silently feeding it would corrupt training
+            raise DatasetCorruptionError(
+                self.data_prefix,
+                f"dataset {self.name}: sample {idx} gathered "
+                f"{len(sample)} tokens, want {self.seq_length + 1} — "
+                "index/data mismatch (was the corpus rewritten under a "
+                "cached index mapping?)")
         return {"text": sample.astype(np.int64)}
 
 
@@ -231,17 +277,24 @@ def get_train_valid_test_split_(splits_string: str, size: int):
 def build_train_valid_test_datasets(
     data_prefix: Sequence, splits_string: str, seq_length: int, seed: int,
     train_samples: int, valid_samples: int, test_samples: int,
-    cache: bool = True,
+    cache: bool = True, strict_data: bool = False,
 ):
     """(ref: gpt_dataset.py:20-127). Single prefix or weighted blend
-    [w0, p0, w1, p1, ...]."""
+    [w0, p0, w1, p1, ...].
+
+    Corrupt-data policy (`strict_data` / `--strict_data`): a blend
+    prefix that fails validation (`DatasetCorruptionError`) is skipped
+    with a loud count and the surviving prefixes re-weighted — unless
+    strict, which fails fast. A single (sole-source) corrupt prefix
+    always raises: there is nothing left to train on."""
     from megatron_tpu.data.blendable import BlendableDataset, \
         normalize_blend_weights
 
     if len(data_prefix) == 1:
         return _single_train_valid_test(
             data_prefix[0], splits_string, seq_length, seed,
-            (train_samples, valid_samples, test_samples), cache)
+            (train_samples, valid_samples, test_samples), cache,
+            strict_data)
 
     prefixes, weights = normalize_blend_weights(data_prefix)
     counts = (train_samples, valid_samples, test_samples)
@@ -249,14 +302,34 @@ def build_train_valid_test_datasets(
     # one split cannot shift the weights of the survivors
     per_ds: list[list] = [[], [], []]
     per_w: list[list] = [[], [], []]
+    skipped_prefixes: list[str] = []
     for prefix, w in zip(prefixes, weights):
         n = tuple(int(np.ceil(w * c * 1.005)) for c in counts)
-        tr, va, te = _single_train_valid_test(
-            prefix, splits_string, seq_length, seed, n, cache)
+        try:
+            tr, va, te = _single_train_valid_test(
+                prefix, splits_string, seq_length, seed, n, cache,
+                strict_data)
+        except DatasetCorruptionError as e:
+            if strict_data:
+                raise
+            skipped_prefixes.append(prefix)
+            print_rank_0(f"warning: skipping corrupt blend prefix "
+                         f"({e}); surviving prefixes re-weighted "
+                         "(--strict_data fails fast instead)")
+            continue
         for i, d in enumerate((tr, va, te)):
             if d is not None:
                 per_ds[i].append(d)
                 per_w[i].append(w)
+    if skipped_prefixes and not any(per_ds):
+        raise DatasetCorruptionError(
+            ", ".join(skipped_prefixes),
+            f"all {len(skipped_prefixes)} blend prefixes failed "
+            "validation — no data left to train on")
+    if skipped_prefixes:
+        print_rank_0(f"blend: skipped {len(skipped_prefixes)}/"
+                     f"{len(prefixes)} corrupt prefixes: "
+                     f"{', '.join(skipped_prefixes)}")
     out = []
     for lst, ws, c in zip(per_ds, per_w, counts):
         out.append(BlendableDataset(lst, ws, c) if lst and c > 0 else None)
@@ -264,7 +337,7 @@ def build_train_valid_test_datasets(
 
 
 def _single_train_valid_test(prefix, splits_string, seq_length, seed, counts,
-                             cache):
+                             cache, strict_data=False):
     indexed = make_dataset(prefix)
     total_docs = indexed.doc_idx.shape[0] - 1
     splits = get_train_valid_test_split_(splits_string, total_docs)
@@ -274,7 +347,8 @@ def _single_train_valid_test(prefix, splits_string, seq_length, seed, counts,
         if splits[i + 1] > splits[i] and counts[i] > 0:
             documents = np.arange(splits[i], splits[i + 1], dtype=np.int32)
             out.append(GPTDataset(name, prefix, documents, indexed, counts[i],
-                                  seq_length, seed, cache=cache))
+                                  seq_length, seed, cache=cache,
+                                  strict_data=strict_data))
         else:
             out.append(None)
     return tuple(out)

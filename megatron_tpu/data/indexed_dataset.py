@@ -21,12 +21,25 @@ from __future__ import annotations
 import os
 import shutil
 import struct
-from functools import lru_cache
 from typing import Optional, Sequence
 
 import numpy as np
 
 _MAGIC = b"MMIDIDX\x00\x00"
+_HEADER_BYTES = 34  # magic(9) + version(8) + dtype(1) + len(8) + docs(8)
+
+
+class DatasetCorruptionError(RuntimeError):
+    """A `.idx`/`.bin` pair failed validation at open. Typed (never an
+    assert — asserts vanish under `python -O` — and never a downstream
+    numpy error) so callers can distinguish corrupt input data from
+    code bugs; carries the offending path and an actionable message.
+    `tools/validate_dataset.py` runs the same checks offline."""
+
+    def __init__(self, path: str, reason: str):
+        self.path = path
+        self.reason = reason
+        super().__init__(f"{path}: {reason}")
 
 # dtype codes shared with the reference (ref: indexed_dataset.py:90-100)
 DTYPES = {
@@ -63,24 +76,67 @@ def best_fitting_dtype(vocab_size: Optional[int]) -> np.dtype:
 
 
 class MMapIndexedDataset:
-    """Read-side mmap dataset (ref: indexed_dataset.py:341-461)."""
+    """Read-side mmap dataset (ref: indexed_dataset.py:341-461).
+
+    Validates the pair ON OPEN — header fields, index size arithmetic
+    vs the actual `.idx` bytes, every pointer/size against the actual
+    `.bin` bytes, doc_idx bounds + monotonicity — raising a typed
+    `DatasetCorruptionError` up front instead of letting a truncated
+    `.bin` or bit-rotted `.idx` surface 30 hours later as an
+    inscrutable numpy error (or, worse, as silently garbage tokens)."""
 
     def __init__(self, prefix: str):
         self.prefix = prefix
-        with open(index_file_path(prefix), "rb") as f:
-            magic = f.read(9)
-            assert magic == _MAGIC, (
-                f"{index_file_path(prefix)}: bad magic {magic!r} — not an "
-                "indexed dataset index file")
-            (version,) = struct.unpack("<Q", f.read(8))
-            assert version == 1, f"unsupported index version {version}"
-            (code,) = struct.unpack("<B", f.read(1))
-            self.dtype = np.dtype(DTYPES[code])
-            (self._len,) = struct.unpack("<Q", f.read(8))
-            (self._doc_count,) = struct.unpack("<Q", f.read(8))
-            offset = f.tell()
-        self._index_mmap = np.memmap(index_file_path(prefix), mode="r",
-                                     order="C")
+        idx_path = index_file_path(prefix)
+        bin_path = data_file_path(prefix)
+        for path in (idx_path, bin_path):
+            # typed, so the blend-level skip-and-count policy catches a
+            # half-deleted corpus the same way it catches a corrupt one
+            if not os.path.isfile(path):
+                raise DatasetCorruptionError(
+                    path, "file missing — deleted corpus half or wrong "
+                    "prefix; re-run preprocessing or fix --data_path")
+        with open(idx_path, "rb") as f:
+            header = f.read(_HEADER_BYTES)
+        if len(header) < _HEADER_BYTES:
+            raise DatasetCorruptionError(
+                idx_path, f"index header truncated ({len(header)} of "
+                f"{_HEADER_BYTES} bytes) — re-run preprocessing")
+        magic = header[:9]
+        if magic != _MAGIC:
+            raise DatasetCorruptionError(
+                idx_path, f"bad magic {magic!r} — not an indexed-dataset "
+                "index file (overwritten header?); rebuild with "
+                "tools/preprocess_data.py")
+        (version,) = struct.unpack("<Q", header[9:17])
+        if version != 1:
+            raise DatasetCorruptionError(
+                idx_path, f"unsupported index version {version} "
+                "(expected 1) — corrupt header or a newer format")
+        code = header[17]
+        if code not in DTYPES:
+            raise DatasetCorruptionError(
+                idx_path, f"unknown dtype code {code} (valid: "
+                f"{sorted(DTYPES)}) — corrupt header byte")
+        self.dtype = np.dtype(DTYPES[code])
+        (self._len,) = struct.unpack("<Q", header[18:26])
+        (self._doc_count,) = struct.unpack("<Q", header[26:34])
+        offset = _HEADER_BYTES
+
+        # size arithmetic: the header fully determines the index length
+        expected = (offset + 4 * self._len + 8 * self._len
+                    + 8 * self._doc_count)
+        actual = os.path.getsize(idx_path)
+        if actual != expected:
+            kind = ("truncated" if actual < expected
+                    else "has trailing garbage")
+            raise DatasetCorruptionError(
+                idx_path, f"index size mismatch: header promises "
+                f"{self._len} sequences + {self._doc_count} doc entries "
+                f"= {expected} bytes, file has {actual} ({kind}) — "
+                "re-run preprocessing")
+
+        self._index_mmap = np.memmap(idx_path, mode="r", order="C")
         self.sizes = np.frombuffer(self._index_mmap, dtype=np.int32,
                                    count=self._len, offset=offset)
         offset += self.sizes.nbytes
@@ -89,8 +145,50 @@ class MMapIndexedDataset:
         offset += self._pointers.nbytes
         self.doc_idx = np.frombuffer(self._index_mmap, dtype=np.int64,
                                      count=self._doc_count, offset=offset)
-        self._data_mmap = np.memmap(data_file_path(prefix), mode="r",
-                                    order="C")
+
+        bin_size = os.path.getsize(bin_path)
+        if self._len:
+            if int(self.sizes.min()) < 0:
+                i = int(np.argmin(self.sizes))
+                raise DatasetCorruptionError(
+                    idx_path, f"negative size {int(self.sizes[i])} at "
+                    f"sequence {i} — corrupt sizes table")
+            if int(self._pointers.min()) < 0:
+                i = int(np.argmin(self._pointers))
+                raise DatasetCorruptionError(
+                    idx_path, f"negative pointer {int(self._pointers[i])} "
+                    f"at sequence {i} — corrupt pointers table")
+            # chunked scan: a single vectorized `pointers + sizes*item`
+            # materializes O(len) int64 temporaries — multi-GB spikes on
+            # billion-sequence corpora — for what is just a running max
+            chunk = 1 << 22
+            for lo in range(0, self._len, chunk):
+                ends = (self._pointers[lo:lo + chunk]
+                        + self.sizes[lo:lo + chunk].astype(np.int64)
+                        * self.dtype.itemsize)
+                if int(ends.max()) > bin_size:
+                    i = lo + int(np.argmax(ends))
+                    raise DatasetCorruptionError(
+                        bin_path, f"sequence {i} spans bytes "
+                        f"[{int(self._pointers[i])}, "
+                        f"{int(self._pointers[i]) + int(self.sizes[i]) * self.dtype.itemsize}) "
+                        f"but the data file is only {bin_size} bytes — "
+                        "truncated .bin or stale index; re-run "
+                        "preprocessing or restore the corpus")
+        if self._doc_count:
+            if (int(self.doc_idx.min()) < 0
+                    or int(self.doc_idx.max()) > self._len):
+                raise DatasetCorruptionError(
+                    idx_path, "doc_idx entries outside "
+                    f"[0, {self._len}] — corrupt document table")
+            if self._doc_count > 1 and bool(
+                    (np.diff(self.doc_idx) < 0).any()):
+                raise DatasetCorruptionError(
+                    idx_path, "doc_idx is not monotonically "
+                    "non-decreasing — corrupt document table")
+        self._data_mmap = np.memmap(bin_path, mode="r",
+                                    order="C") if bin_size else \
+            np.empty(0, dtype=np.uint8)
 
     def __len__(self) -> int:
         return self._len
@@ -135,7 +233,10 @@ class IndexedDatasetBuilder:
         """Append another dataset with the same dtype
         (ref: indexed_dataset.py:524-538 merge_file_)."""
         other = MMapIndexedDataset(other_prefix)
-        assert other.dtype == self.dtype
+        if other.dtype != self.dtype:
+            raise ValueError(
+                f"cannot merge {other_prefix} (dtype {other.dtype}) "
+                f"into a {self.dtype} builder")
         base = len(self._sizes)
         self._sizes.extend(int(s) for s in other.sizes)
         # skip the leading 0 of the other doc_idx
@@ -164,8 +265,46 @@ class IndexedDatasetBuilder:
             f.write(doc_idx.tobytes(order="C"))
 
 
-@lru_cache(maxsize=None)
+# handle cache keyed on (mtime_ns, size) of BOTH files — a plain
+# lru_cache(prefix) kept serving stale (or corrupt) mmaps after the
+# files were rewritten by re-preprocessing, and a failed open must
+# never pin a broken entry
+_DATASET_CACHE: dict = {}
+
+
+def _file_signature(prefix: str) -> tuple:
+    si = os.stat(index_file_path(prefix))
+    sb = os.stat(data_file_path(prefix))
+    return (si.st_mtime_ns, si.st_size, sb.st_mtime_ns, sb.st_size)
+
+
+def _dataset_cache_clear() -> None:
+    _DATASET_CACHE.clear()
+
+
 def make_dataset(prefix: str, impl: str = "mmap") -> MMapIndexedDataset:
-    """(ref: indexed_dataset.py:58-73 make_dataset) — mmap only."""
-    assert impl in ("mmap", "infer"), f"only mmap supported, got {impl!r}"
-    return MMapIndexedDataset(prefix)
+    """(ref: indexed_dataset.py:58-73 make_dataset) — mmap only.
+
+    Re-validates freshness per call: the cached handle is reused only
+    while both files' (mtime, size) are unchanged; a rewritten pair
+    re-opens (and re-validates), a failed open evicts."""
+    if impl not in ("mmap", "infer"):
+        raise ValueError(f"only mmap supported, got {impl!r}")
+    try:
+        sig = _file_signature(prefix)
+    except FileNotFoundError as e:
+        _DATASET_CACHE.pop(prefix, None)
+        raise DatasetCorruptionError(
+            e.filename or prefix, "file missing — deleted corpus half "
+            "or wrong prefix; re-run preprocessing or fix --data_path"
+        ) from e
+    hit = _DATASET_CACHE.get(prefix)
+    if hit is not None and hit[0] == sig:
+        return hit[1]
+    _DATASET_CACHE.pop(prefix, None)  # stale or first open: drop first
+    ds = MMapIndexedDataset(prefix)   # may raise DatasetCorruptionError
+    _DATASET_CACHE[prefix] = (sig, ds)
+    return ds
+
+
+make_dataset.cache_clear = _dataset_cache_clear  # lru_cache-compat API

@@ -10,6 +10,15 @@ build_pretraining_data_loader). Semantics kept:
   (ref: data_samplers.py:119-166) and equally dp-shards the pool;
 - drop_last batching.
 
+Beyond the reference: every sampler/iterator here speaks the
+`state_dict()` / `load_state_dict()` exact-resume protocol
+(consumed_samples, epoch, shuffle seed, within-epoch cursor, prefetch
+depth). The state rides in checkpoint metadata
+(training/checkpointing.py) so an interrupted run — or a divergence
+rollback (training/loop.py poison-batch quarantine) — replays the
+IDENTICAL batch sequence instead of fast-forwarding by luck
+(docs/resilience.md "Exact resume & poison-batch quarantine").
+
 Difference by design: the reference yields per-dp-rank microbatches from a
 per-rank torch DataLoader and broadcasts over TP (ref: training.py:855-939).
 Single-controller JAX wants the GLOBAL batch on the host: `BatchIterator`
@@ -25,17 +34,31 @@ import numpy as np
 
 class MegatronPretrainingSampler:
     """Sequential dp-sharded sampler (ref: data_samplers.py:48-95).
-    Yields lists of global dataset indices, one per (micro_bs * dp) chunk."""
+    Yields lists of global dataset indices, one per (micro_bs * dp) chunk.
+
+    `consumed_samples` is the live within-epoch cursor: it advances as
+    batches are yielded, so `state_dict()` taken at any batch boundary
+    and restored via `load_state_dict()` resumes the identical stream
+    (the exact-resume protocol, docs/resilience.md). `consumed_samples
+    == total_samples` is a valid (empty) stream — a run checkpointed
+    exactly at epoch end resumes by wrapping to the next epoch, not by
+    crashing."""
 
     def __init__(self, total_samples: int, consumed_samples: int,
                  micro_batch_size: int, data_parallel_size: int,
                  drop_last: bool = True):
+        if total_samples <= 0:
+            raise ValueError(f"total_samples={total_samples} must be > 0")
+        if not 0 <= consumed_samples <= total_samples:
+            raise ValueError(
+                f"consumed_samples={consumed_samples} outside "
+                f"[0, {total_samples}] — the resume offset must be a "
+                "within-epoch cursor (callers wrap epochs via "
+                "BatchIterator)")
         self.total_samples = total_samples
         self.consumed_samples = consumed_samples
         self.micro_batch_times_dp = micro_batch_size * data_parallel_size
         self.drop_last = drop_last
-        assert self.total_samples > 0
-        assert self.consumed_samples < self.total_samples
 
     def __len__(self):
         return self.total_samples
@@ -45,14 +68,32 @@ class MegatronPretrainingSampler:
         for idx in range(self.consumed_samples, self.total_samples):
             batch.append(idx)
             if len(batch) == self.micro_batch_times_dp:
+                self.consumed_samples += self.micro_batch_times_dp
                 yield batch
                 batch = []
         if batch and not self.drop_last:
+            self.consumed_samples += len(batch)
             yield batch
+
+    def state_dict(self) -> dict:
+        return {"consumed_samples": int(self.consumed_samples)}
+
+    def load_state_dict(self, sd: dict) -> None:
+        c = int(sd["consumed_samples"])
+        if not 0 <= c <= self.total_samples:
+            raise ValueError(
+                f"sampler state consumed_samples={c} outside "
+                f"[0, {self.total_samples}] — checkpoint from a "
+                "different dataset?")
+        self.consumed_samples = c
 
 
 class MegatronPretrainingRandomSampler:
-    """Per-epoch reshuffling sampler (ref: data_samplers.py:119-186)."""
+    """Per-epoch reshuffling sampler (ref: data_samplers.py:119-186).
+
+    `consumed_samples` is GLOBAL (monotonic across epochs); the epoch
+    and within-epoch cursor derive from it, so `state_dict()` /
+    `load_state_dict()` resume the identical shuffled stream."""
 
     def __init__(self, total_samples: int, consumed_samples: int,
                  micro_batch_size: int, data_parallel_size: int,
@@ -63,6 +104,10 @@ class MegatronPretrainingRandomSampler:
         self.seed = seed
         self.last_batch_size = (self.total_samples
                                 % self.micro_batch_times_dp)
+        if self.total_samples - self.last_batch_size <= 0:
+            raise ValueError(
+                f"total_samples={total_samples} holds no full "
+                f"micro_batch_size*dp={self.micro_batch_times_dp} batch")
 
     def __len__(self):
         return self.total_samples
@@ -71,7 +116,11 @@ class MegatronPretrainingRandomSampler:
         active_total = self.total_samples - self.last_batch_size
         self.epoch = self.consumed_samples // active_total
         current_epoch_samples = self.consumed_samples % active_total
-        assert current_epoch_samples % self.micro_batch_times_dp == 0
+        if current_epoch_samples % self.micro_batch_times_dp != 0:
+            raise ValueError(
+                f"consumed_samples={self.consumed_samples} is not "
+                f"batch-aligned (micro_batch_size*dp="
+                f"{self.micro_batch_times_dp})")
 
         g = np.random.RandomState(self.seed + self.epoch)
         idx_range = g.permutation(active_total)[current_epoch_samples:]
@@ -83,6 +132,19 @@ class MegatronPretrainingRandomSampler:
                 self.consumed_samples += self.micro_batch_times_dp
                 yield batch
                 batch = []
+
+    def state_dict(self) -> dict:
+        return {"consumed_samples": int(self.consumed_samples),
+                "seed": int(self.seed)}
+
+    def load_state_dict(self, sd: dict) -> None:
+        if "seed" in sd and int(sd["seed"]) != self.seed:
+            raise ValueError(
+                f"sampler state was written with seed={sd['seed']}, "
+                f"this run uses seed={self.seed} — the shuffled order "
+                "differs; resume with the original --seed for a "
+                "bit-exact replay")
+        self.consumed_samples = int(sd["consumed_samples"])
 
 
 class BatchIterator:
@@ -126,8 +188,7 @@ class BatchIterator:
         self._sampler_args = (micro_batch_size, data_parallel, seed,
                               drop_last)
         self._dataloader_type = dataloader_type
-        self.sampler = self._make_sampler(consumed_samples)
-        self._it = iter(self.sampler)
+        self._position(consumed_samples)
 
     def _make_sampler(self, consumed_samples: int):
         mbs, dp, seed, drop_last = self._sampler_args
@@ -139,13 +200,88 @@ class BatchIterator:
                 len(self.dataset), consumed_samples, mbs, dp, seed)
         raise ValueError(f"unknown dataloader_type {self._dataloader_type!r}")
 
+    def _epoch_len(self) -> int:
+        """Samples one sequential epoch actually yields: drop_last drops
+        the non-batch-aligned tail, so the resume modulus must be the
+        aligned prefix — len(dataset) would leak dropped tail samples
+        into the resumed stream's arithmetic."""
+        chunk = self._sampler_args[0] * self._sampler_args[1]
+        total = len(self.dataset)
+        drop_last = self._sampler_args[3]
+        return max(total - total % chunk if drop_last else total, 1)
+
+    def _position(self, consumed_samples: int) -> None:
+        """Rebuild the sampler at a monotonic consumed-samples count,
+        deriving (epoch, within-epoch cursor). A resumed run past one
+        epoch no longer crashes the sequential sampler's range check —
+        the cursor wraps exactly as the live stream did."""
+        self.samples_yielded = int(consumed_samples)
+        if self._dataloader_type == "cyclic":
+            # the random sampler's epoch arithmetic is internal (global
+            # consumed_samples)
+            self._epoch = 0
+            self.sampler = self._make_sampler(consumed_samples)
+        else:
+            el = self._epoch_len()
+            self._epoch = consumed_samples // el
+            self.sampler = self._make_sampler(consumed_samples % el)
+        self._it = iter(self.sampler)
+
+    def state_dict(self) -> dict:
+        """Exact-resume state at the current batch boundary: restored
+        via `load_state_dict`, the stream replays the identical batch
+        sequence (docs/resilience.md "exact resume & quarantine")."""
+        mbs, dp, seed, drop_last = self._sampler_args
+        return {
+            "version": 1,
+            "dataloader_type": self._dataloader_type,
+            "seed": int(seed),
+            "drop_last": bool(drop_last),
+            "micro_batch_times_dp": int(mbs * dp),
+            "dataset_len": int(len(self.dataset)),
+            "epoch": int(self._epoch),
+            "samples_yielded": int(self.samples_yielded),
+            "sampler": self.sampler.state_dict(),
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        """Restore an exact stream position. Mismatched stream identity
+        (dataloader type / seed / batch geometry) raises ValueError —
+        silently resuming a DIFFERENT order would corrupt the replay
+        guarantees the checkpoint promises."""
+        mbs, dp, seed, drop_last = self._sampler_args
+        for key, ours in (("dataloader_type", self._dataloader_type),
+                          ("seed", int(seed)),
+                          ("drop_last", bool(drop_last)),
+                          ("micro_batch_times_dp", int(mbs * dp))):
+            if key in sd and sd[key] != ours:
+                raise ValueError(
+                    f"data-iterator state mismatch: checkpoint has "
+                    f"{key}={sd[key]!r}, this run uses {ours!r} — "
+                    "resume with the original data configuration for a "
+                    "bit-exact replay (or skip data-state restore to "
+                    "accept a different order)")
+        if (sd.get("dataset_len") is not None
+                and int(sd["dataset_len"]) != len(self.dataset)):
+            from megatron_tpu.utils.logging import print_rank_0
+            print_rank_0(
+                f"warning: data-iterator state was written over "
+                f"{sd['dataset_len']} samples, this dataset has "
+                f"{len(self.dataset)} — epoch boundaries moved, the "
+                "resumed order may not be bit-exact")
+        self._epoch = int(sd.get("epoch", 0))
+        self.samples_yielded = int(sd["samples_yielded"])
+        self.sampler = self._make_sampler(0)
+        self.sampler.load_state_dict(sd["sampler"])
+        self._it = iter(self.sampler)
+
     def __iter__(self):
         return self
 
     def _next_indices(self):
         """One micro-batch of sample indices, wrapping epochs."""
         try:
-            return next(self._it)
+            idxs = next(self._it)
         except StopIteration:
             if self._dataloader_type == "cyclic":
                 # the random sampler's consumed_samples advanced during
@@ -157,9 +293,12 @@ class BatchIterator:
                 # sequential wrap: restart from sample 0, NOT from the
                 # resume offset — otherwise samples [0, consumed) would
                 # be excluded from every later epoch
+                self._epoch += 1
                 self.sampler = self._make_sampler(0)
                 self._it = iter(self.sampler)
-            return next(self._it)
+            idxs = next(self._it)
+        self.samples_yielded += len(idxs)
+        return idxs
 
     def __next__(self) -> dict:
         micro = []
@@ -260,24 +399,19 @@ class DictBatchIterator:
         self._sampler_args = (micro_batch_size, data_parallel, seed,
                               drop_last)
         self._dataloader_type = dataloader_type
-        # sequential resume offset is the within-epoch position (the
-        # sampler asserts consumed < total). One drop_last epoch emits
-        # only the batch-aligned prefix, so the modulus is that epoch
-        # length — len(dataset) would leak dropped tail samples into the
-        # resumed stream. The random sampler takes the GLOBAL count: its
-        # epoch arithmetic is internal.
-        if dataloader_type == "cyclic":
-            resume = consumed_samples
-        else:
-            chunk = micro_batch_size * data_parallel
-            epoch_len = (len(dataset) - len(dataset) % chunk
-                         if drop_last else len(dataset))
-            resume = consumed_samples % max(epoch_len, 1)
-        self.sampler = self._make_sampler(resume)
-        self._it = iter(self.sampler)
+        # shared with BatchIterator: sequential resume derives
+        # (epoch, within-epoch cursor) from the monotonic count — one
+        # drop_last epoch emits only the batch-aligned prefix, so the
+        # modulus is that epoch length; the random sampler takes the
+        # GLOBAL count (its epoch arithmetic is internal)
+        self._position(consumed_samples)
 
     _make_sampler = BatchIterator._make_sampler
+    _epoch_len = BatchIterator._epoch_len
+    _position = BatchIterator._position
     _next_indices = BatchIterator._next_indices
+    state_dict = BatchIterator.state_dict
+    load_state_dict = BatchIterator.load_state_dict
 
     def __iter__(self):
         return self
@@ -290,6 +424,26 @@ class DictBatchIterator:
             micro.append({k: np.stack([it[k] for it in items])
                           for k in items[0]})
         return {k: np.stack([m[k] for m in micro]) for k in micro[0]}
+
+
+def restore_data_state(it, data_state) -> bool:
+    """Position an iterator at a checkpoint's exact data state
+    (`load_state_dict`). A mismatched state — different seed/geometry
+    because the user changed the data config on purpose — degrades,
+    loudly, to the consumed-samples fast-forward the iterator was
+    already built with. Returns True only on an exact restore."""
+    from megatron_tpu.utils.logging import print_rank_0
+    if it is None or not data_state:
+        return False
+    try:
+        it.load_state_dict(data_state)
+        return True
+    except (ValueError, KeyError) as e:
+        print_rank_0(f"warning: checkpoint data state not restored "
+                     f"({e}); falling back to consumed-samples "
+                     "fast-forward — the resumed batch order may "
+                     "differ from the interrupted run")
+        return False
 
 
 def get_ltor_masks_and_position_ids(
@@ -343,7 +497,17 @@ class PrefetchIterator:
     num_microbatches change by up to `depth` steps, skewing the
     consumed-samples accounting, so loop.py only wraps when rampup is
     off (num_microbatches is then constant and the forwarding setter is
-    a benign same-value write)."""
+    a benign same-value write).
+
+    Exact-resume state: the producer runs AHEAD of the consumer by up
+    to `depth` batches, so the source iterator's live `state_dict()`
+    over-counts what training has actually seen. The producer therefore
+    snapshots the source state after pulling each batch and ships the
+    pair through the queue; `state_dict()` returns the snapshot of the
+    last batch DELIVERED to the consumer — checkpointing it resumes
+    exactly at the next undelivered batch, never `depth` batches late.
+    The producer thread starts lazily on the first `__next__`, so
+    `load_state_dict()` before consumption is race-free."""
 
     _STOP = object()
 
@@ -351,12 +515,14 @@ class PrefetchIterator:
         import queue
         import threading
         self._queue_mod = queue
+        self._threading_mod = threading
         self._it = it
-        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self.depth = max(depth, 1)
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         self._err = None
         self._closed = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        self._thread = None  # started on first __next__
+        self._last_state = None  # source state at the last delivered batch
 
     @property
     def num_microbatches(self):
@@ -366,12 +532,46 @@ class PrefetchIterator:
     def num_microbatches(self, v):
         self._it.num_microbatches = v
 
+    def state_dict(self):
+        """Source iterator state at the CONSUMER's position (None when
+        the source has no state protocol), tagged with the prefetch
+        depth."""
+        sd = self._last_state
+        if sd is None:
+            get_state = getattr(self._it, "state_dict", None)
+            if get_state is None:
+                return None
+            sd = get_state()
+        return {**sd, "prefetch_depth": int(self.depth)}
+
+    def load_state_dict(self, sd) -> None:
+        """Delegate to the source. Only legal before the producer has
+        started (i.e. before the first `__next__`) — once batches are
+        buffered, repositioning the source would splice two streams."""
+        if self._thread is not None:
+            raise RuntimeError(
+                "load_state_dict on a running PrefetchIterator — "
+                "restore the source iterator before wrapping it "
+                "(or before consuming the first batch)")
+        self._it.load_state_dict(sd)
+
+    def _ensure_started(self):
+        if self._thread is None and not self._closed.is_set():
+            self._thread = self._threading_mod.Thread(
+                target=self._run, daemon=True)
+            self._thread.start()
+
     def _run(self):
         try:
+            get_state = getattr(self._it, "state_dict", None)
             for batch in self._it:
+                # snapshot AFTER the pull: the state a consumer resuming
+                # past this batch needs (single-threaded producer — no
+                # later pull can race the snapshot)
+                state = get_state() if get_state is not None else None
                 while not self._closed.is_set():
                     try:
-                        self._q.put(batch, timeout=0.2)
+                        self._q.put((batch, state), timeout=0.2)
                         break
                     except self._queue_mod.Full:
                         continue
@@ -397,16 +597,21 @@ class PrefetchIterator:
                 self._q.get_nowait()
             except self._queue_mod.Empty:
                 break
-        self._thread.join(timeout=2.0)
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        self._ensure_started()
         item = self._q.get()
         if item is self._STOP:
             self._q.put(self._STOP)  # re-arm: every later call raises too
             if self._err is not None:
                 raise self._err
             raise StopIteration
-        return item
+        batch, state = item
+        if state is not None:
+            self._last_state = state
+        return batch

@@ -17,7 +17,11 @@ fires them deterministically:
   observable shape of a hung infeed/host callback, to trip the
   watchdog;
 - **checkpoint corruption**: `corrupt_file`/`corrupt_checkpoint` flip
-  bytes on disk so integrity verification has something to catch.
+  bytes on disk so integrity verification has something to catch;
+- **dataset corruption**: `corrupt_dataset(prefix, mode)` injects the
+  three dominant on-disk corpus failures (truncated `.bin`, garbage
+  `.idx` header, out-of-range pointer) so the open-time validation in
+  `data/indexed_dataset.py` is provable end-to-end.
 
 Activation is process-global (`activate`/`deactivate` or the
 `with use_fault_injector(...)` context) and OFF by default — production
@@ -169,6 +173,92 @@ class FaultInjector:
             chunk = f.read(min(nbytes, size - offset))
             f.seek(offset)
             f.write(bytes(b ^ 0xFF for b in chunk))
+
+    @staticmethod
+    def truncate_file(path: str, drop_bytes: int = 8,
+                      keep_bytes: Optional[int] = None) -> int:
+        """Chop the tail off a file (simulated torn copy / partial
+        upload); returns the new size."""
+        size = os.path.getsize(path)
+        new = (keep_bytes if keep_bytes is not None
+               else max(size - drop_bytes, 0))
+        with open(path, "r+b") as f:
+            f.truncate(new)
+        return new
+
+    DATASET_FAULTS = ("truncate_bin", "garbage_idx", "oob_pointer")
+
+    @staticmethod
+    def corrupt_dataset(prefix: str, mode: str = "truncate_bin") -> str:
+        """Inject on-disk dataset corruption into a `.idx`/`.bin` pair;
+        returns the path touched. The open-time validation in
+        MMapIndexedDataset must catch every mode with a typed
+        DatasetCorruptionError (tests/test_resilience.py,
+        tools/chaos_train.py, tools/validate_dataset.py --smoke):
+
+        - ``truncate_bin``: chop the tail off `.bin` so index pointers
+          run past EOF (torn copy / disk-full write);
+        - ``garbage_idx``: overwrite the `.idx` header (bad magic —
+          classic wrong-file / bit-rot shape);
+        - ``oob_pointer``: rewrite the LAST pointer in `.idx` to far
+          beyond the `.bin` size (single flipped high byte shape).
+        """
+        from megatron_tpu.data import indexed_dataset as idx_mod
+        bin_path = idx_mod.data_file_path(prefix)
+        idx_path = idx_mod.index_file_path(prefix)
+        if mode == "truncate_bin":
+            size = os.path.getsize(bin_path)
+            FaultInjector.truncate_file(
+                bin_path, drop_bytes=max(size // 2, 1))
+            return bin_path
+        if mode == "garbage_idx":
+            with open(idx_path, "r+b") as f:
+                f.write(b"\xff" * 16)
+            return idx_path
+        if mode == "oob_pointer":
+            import struct
+            with open(idx_path, "rb") as f:
+                header = f.read(34)
+            (n,) = struct.unpack("<Q", header[18:26])
+            if n == 0:
+                raise ValueError(f"{prefix}: empty index has no "
+                                 "pointers to corrupt")
+            last_ptr_off = 34 + 4 * n + 8 * (n - 1)
+            huge = os.path.getsize(bin_path) * 2 + 4096
+            with open(idx_path, "r+b") as f:
+                f.seek(last_ptr_off)
+                f.write(struct.pack("<q", huge))
+            return idx_path
+        raise ValueError(f"unknown dataset fault {mode!r} "
+                         f"(valid: {FaultInjector.DATASET_FAULTS})")
+
+    @staticmethod
+    def dataset_corruption_drill(workdir: str) -> Dict[str, bool]:
+        """Build → prime handle cache → corrupt → reopen, once per
+        DATASET_FAULTS mode; maps mode → "reopen raised the typed
+        DatasetCorruptionError". Priming the cache before corrupting
+        also proves `make_dataset` re-validates on mtime/size change
+        instead of serving the stale pre-corruption mmap. Shared by
+        tools/chaos_train.py and tools/validate_dataset.py --smoke so
+        their records cannot silently diverge."""
+        from megatron_tpu.data.indexed_dataset import (
+            DatasetCorruptionError, IndexedDatasetBuilder, make_dataset)
+        detected = {}
+        for mode in FaultInjector.DATASET_FAULTS:
+            prefix = os.path.join(workdir, f"drill_{mode}")
+            b = IndexedDatasetBuilder(prefix, dtype="int32")
+            for i in range(8):
+                b.add_item(list(range(i, i + 12)))
+                b.end_document()
+            b.finalize()
+            make_dataset(prefix)
+            FaultInjector.corrupt_dataset(prefix, mode)
+            try:
+                make_dataset(prefix)
+                detected[mode] = False
+            except DatasetCorruptionError:
+                detected[mode] = True
+        return detected
 
     @staticmethod
     def corrupt_checkpoint(ckpt_dir: str, nbytes: int = 8) -> str:

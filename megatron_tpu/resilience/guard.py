@@ -10,8 +10,11 @@ Here the loop consults a policy after every step:
   just tracks the streak);
 - `max_consecutive_nonfinite` bad steps in a row, or a finite loss
   exceeding `loss_spike_factor ×` the rolling-window mean → **ROLLBACK**
-  to the last checkpoint with a re-seeded data order (the loop owns the
-  restore; the guard owns the decision);
+  to the last checkpoint; the loop then replays the EXACT data order
+  from the checkpoint's saved iterator state and deterministically
+  skips the quarantined step window — the poison batches are dodged by
+  construction, never by a re-seeded order (the loop owns the
+  restore/quarantine; the guard owns the decision);
 - more than `max_rollbacks` rollbacks → **ABORT** with
   `TrainingDivergedError` so the supervisor sees a clean, distinct
   failure instead of an infinite crash-loop.
@@ -34,7 +37,7 @@ class TrainingDivergedError(RuntimeError):
 class GuardAction(enum.Enum):
     OK = "ok"
     SKIP = "skip"          # bad step, already dropped; keep going
-    ROLLBACK = "rollback"  # restore last checkpoint, re-seed data
+    ROLLBACK = "rollback"  # restore last checkpoint, quarantine window
 
 
 class DivergenceGuard:

@@ -49,6 +49,48 @@ from megatron_tpu.utils.logging import print_rank_0
 TRACKER = "latest_checkpointed_iteration.txt"
 STATE_DIR = "state"  # orbax pytree directory inside an iteration dir
 
+
+class LoadedCheckpoint:
+    """load_checkpoint result: unpacks/indexes like the historical
+    (state, iteration, consumed_samples) 3-tuple, plus named extras —
+    `data_state` (the data-iterator exact-resume state_dict stored in
+    checkpoint metadata; None for legacy checkpoints or fresh starts),
+    `quarantine` (list of poison-batch windows skipped by divergence
+    rollbacks, see training/loop.py), and `ckpt_dir`."""
+
+    __slots__ = ("state", "iteration", "consumed_samples", "data_state",
+                 "quarantine", "ckpt_dir")
+
+    def __init__(self, state, iteration: int, consumed_samples: int,
+                 data_state: Optional[dict] = None,
+                 quarantine: Optional[list] = None,
+                 ckpt_dir: Optional[str] = None):
+        self.state = state
+        self.iteration = iteration
+        self.consumed_samples = consumed_samples
+        self.data_state = data_state
+        self.quarantine = list(quarantine or [])
+        self.ckpt_dir = ckpt_dir
+
+    def _tuple(self):
+        return (self.state, self.iteration, self.consumed_samples)
+
+    def __iter__(self):
+        return iter(self._tuple())
+
+    def __getitem__(self, i):
+        return self._tuple()[i]
+
+    def __len__(self):
+        return 3
+
+    def __repr__(self):
+        return (f"LoadedCheckpoint(iteration={self.iteration}, "
+                f"consumed_samples={self.consumed_samples}, "
+                f"data_state={'yes' if self.data_state else 'no'}, "
+                f"quarantine={len(self.quarantine)} windows, "
+                f"ckpt_dir={self.ckpt_dir!r})")
+
 # one async checkpointer per process; saves are serialized through it
 _ASYNC_CKPTR = None
 # (root, tag, ckpt_dir, resilience) awaiting durability; the manifest
@@ -169,6 +211,8 @@ def save_checkpoint(
     release: bool = False,
     backend: str = "orbax",
     async_save: bool = False,
+    data_state: Optional[dict] = None,
+    quarantine: Optional[list] = None,
 ) -> str:
     """(ref: checkpointing.py:243-337 save_checkpoint)
 
@@ -226,6 +270,15 @@ def save_checkpoint(
         "has_opt_state": "opt_state" in tree,
         "format_version": 2 if backend == "orbax" else 1,
     }
+    if data_state is not None:
+        # data-iterator exact-resume state (samplers.state_dict):
+        # restoring it replays the identical batch sequence
+        meta["data_state"] = data_state
+    if quarantine:
+        # poison-batch windows deterministically skipped by divergence
+        # rollbacks (training/loop.py) — carried forward so a resumed
+        # run keeps the audit trail
+        meta["quarantine"] = list(quarantine)
     _write_text_atomic(os.path.join(d, "metadata.json"),
                        json.dumps(meta, indent=2), policy)
     _write_text_atomic(os.path.join(d, "config.json"), cfg.to_json(),
@@ -280,10 +333,12 @@ def load_checkpoint(
     finetune: bool = False,
     no_load_optim: bool = False,
     resilience: Optional[ResilienceConfig] = None,
-) -> tuple[Optional[TrainState], int, int]:
+) -> LoadedCheckpoint:
     """Load newest checkpoint under `root`.
 
-    Returns (state, iteration, consumed_samples); (None, 0, 0) if absent
+    Returns a `LoadedCheckpoint` — unpacks like the historical
+    (state, iteration, consumed_samples) 3-tuple, with `.data_state` /
+    `.quarantine` extras for exact data resume; (None, 0, 0) if absent
     (ref: checkpointing.py:561-643 load_checkpoint). `finetune` loads model
     weights only and resets iteration/optimizer (ref: --finetune).
 
@@ -299,7 +354,7 @@ def load_checkpoint(
     tracked = _dir_for_tag(root, tag)
     if tag is None and not integrity.list_iter_checkpoints(root):
         print_rank_0(f"no checkpoint tracker in {root}; starting from scratch")
-        return None, 0, 0
+        return LoadedCheckpoint(None, 0, 0)
 
     # candidate order: the tracker-named dir, then every other iter_*
     # dir newest-first (the fallback chain for a torn/corrupt tip)
@@ -355,7 +410,7 @@ def load_checkpoint(
             continue
 
     print_rank_0(f"no valid checkpoint under {root}; starting from scratch")
-    return None, 0, 0
+    return LoadedCheckpoint(None, 0, 0)
 
 
 def _restore_from_dir(
@@ -366,7 +421,7 @@ def _restore_from_dir(
     shardings: Optional[TrainState] = None,
     finetune: bool = False,
     no_load_optim: bool = False,
-) -> tuple[Optional[TrainState], int, int]:
+) -> LoadedCheckpoint:
     release = bool(meta.get("release", os.path.basename(d) == "release"))
     load_optim = (not finetune and not no_load_optim and not release
                   and example_state.opt_state is not None)
@@ -457,17 +512,27 @@ def _restore_from_dir(
                 shardings.opt_state if shardings is not None else None)
 
     if finetune or release:
+        # fresh run: the data stream restarts too — no exact-resume
+        # state or quarantine history carries over
         iteration, consumed = 0, 0
+        data_state, quarantine = None, []
     else:
         iteration = meta["iteration"]
         consumed = meta.get("consumed_samples", 0)
+        data_state = meta.get("data_state")
+        quarantine = meta.get("quarantine", [])
 
     state = TrainState(
         params=params, opt_state=opt_state,
         iteration=jnp.asarray(iteration, jnp.int32))
     print_rank_0(f"loaded checkpoint {d} (iteration {iteration}, "
-                 f"consumed_samples {consumed})")
-    return state, iteration, consumed
+                 f"consumed_samples {consumed}"
+                 + (", exact data-resume state" if data_state else "")
+                 + (f", {len(quarantine)} quarantined window(s)"
+                    if quarantine else "") + ")")
+    return LoadedCheckpoint(state, iteration, consumed,
+                            data_state=data_state, quarantine=quarantine,
+                            ckpt_dir=d)
 
 
 def load_config_from_checkpoint(root: str) -> Optional[MegatronConfig]:

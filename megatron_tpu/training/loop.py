@@ -26,6 +26,7 @@ Differences by design:
 """
 from __future__ import annotations
 
+import inspect
 import signal
 import time
 from typing import Callable, Iterator, Optional
@@ -99,6 +100,50 @@ class _MetricsWindow:
         return out
 
 
+def _iter_state(it) -> Optional[dict]:
+    """Exact-resume state of a data iterator (samplers.state_dict
+    protocol), or None for plain generators that have none."""
+    get_state = getattr(it, "state_dict", None)
+    return get_state() if get_state is not None else None
+
+
+def _accepts_kwargs(fn, *names) -> bool:
+    """True when `fn` takes every keyword in `names` (or **kwargs) —
+    the back-compat seam for the save_fn / reset_data_fn hook contracts
+    growing data_state/quarantine arguments."""
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+    if any(p.kind is inspect.Parameter.VAR_KEYWORD
+           for p in params.values()):
+        return True
+    return all(n in params for n in names)
+
+
+def _call_save_fn(save_fn, state, iteration, consumed_samples,
+                  data_state, quarantine):
+    """save_fn with the exact-resume extras when it accepts them
+    (finetune.py / run_pretrain do); legacy 3-arg save hooks keep
+    working unchanged."""
+    if _accepts_kwargs(save_fn, "data_state", "quarantine"):
+        return save_fn(state, iteration, consumed_samples,
+                       data_state=data_state, quarantine=quarantine)
+    return save_fn(state, iteration, consumed_samples)
+
+
+def _call_reset_data_fn(reset_data_fn, consumed_samples, rollbacks,
+                        data_state):
+    """reset_data_fn(consumed, rollbacks[, data_state=...]): hooks that
+    take data_state rebuild the stream at the EXACT checkpointed
+    position (bit-identical replay); legacy 2-arg hooks are called as
+    before."""
+    if _accepts_kwargs(reset_data_fn, "data_state"):
+        return reset_data_fn(consumed_samples, rollbacks,
+                             data_state=data_state)
+    return reset_data_fn(consumed_samples, rollbacks)
+
+
 def _make_batch_lift(mesh, batch_sh):
     """The input lift: host batch pytree -> committed device arrays in
     the layout the jitted step consumes (dp-sharded batch dim under a
@@ -135,9 +180,12 @@ class SignalState:
 
 def training_log(metrics: dict, iteration: int, consumed_samples: int,
                  elapsed_per_iter: float, tokens_per_sec: float,
-                 writer, skipped_total: int, nan_total: int) -> str:
+                 writer, skipped_total: int, nan_total: int,
+                 quarantined_total: int = 0) -> str:
     """Format + emit the per-interval dashboard line
-    (ref: training.py:452-626)."""
+    (ref: training.py:452-626). `quarantined_total` counts poison-batch
+    steps deterministically skipped by divergence rollbacks (only shown
+    once non-zero — see docs/resilience.md)."""
     loss = float(metrics["lm_loss"])
     lr = float(metrics["lr"])
     gnorm = float(metrics["grad_norm"])
@@ -148,6 +196,10 @@ def training_log(metrics: dict, iteration: int, consumed_samples: int,
             f"lm loss: {loss:.6E} | loss scale: {lscale:.1f} | "
             f"grad norm: {gnorm:.3f} | skipped iterations: {skipped_total} | "
             f"nan iterations: {nan_total}")
+    if quarantined_total:
+        line += f" | quarantined iterations: {quarantined_total}"
+        writer.add_scalar("resilience/quarantined iterations",
+                          quarantined_total, iteration)
     writer.add_scalar("lm-loss-training/lm loss", loss, iteration)
     writer.add_scalar("learning-rate/learning rate", lr, iteration)
     writer.add_scalar("grad-norm/grad norm", gnorm, iteration)
@@ -216,6 +268,7 @@ def train(
     step_kwargs: Optional[dict] = None,
     load_fn: Optional[Callable] = None,
     reset_data_fn: Optional[Callable] = None,
+    quarantine_log: Optional[list] = None,
 ):
     """The `_train` loop (ref: training.py:639-751). `train_iterator` yields
     {"tokens": [n_micro, mbs, seq+1], "loss_mask": [n_micro, mbs, seq]}.
@@ -225,13 +278,20 @@ def train(
     Returns (state, consumed_samples).
 
     Resilience hooks (cfg.resilience, docs/resilience.md): `load_fn()
-    -> (state, iteration, consumed_samples) | None` restores the newest
-    valid checkpoint when the divergence guard orders a rollback;
-    `reset_data_fn(consumed_samples, reseed) -> iterator` rebuilds the
-    training stream with a re-seeded order for the replayed segment (a
-    rollback that replays the exact batches that diverged would diverge
-    again). Without `load_fn`, a guard breach aborts with
-    TrainingDivergedError instead of burning compute on a dead run. A
+    -> LoadedCheckpoint | (state, iteration, consumed_samples) | None`
+    restores the newest valid checkpoint when the divergence guard
+    orders a rollback; `reset_data_fn(consumed_samples, rollbacks[,
+    data_state=...]) -> iterator` rebuilds the training stream at the
+    EXACT checkpointed position (samplers state_dict protocol). The
+    loop then replays the identical batch order but deterministically
+    SKIPS the quarantined step window (checkpoint iteration, trigger
+    iteration] — no update runs on the poison batches, the window is
+    recorded in `quarantine_log` + checkpoint metadata, and the data
+    order is never re-seeded. `save_fn(state, iteration, consumed[,
+    data_state=, quarantine=])` persists the iterator state alongside
+    the weights so an interrupted run resumes bit-exact. Without
+    `load_fn`, a guard breach aborts with TrainingDivergedError
+    instead of burning compute on a dead run. A
     `step_timeout_s` watchdog (armed after the first, compile-heavy
     step) dumps stacks, attempts a final checkpoint, and exits with a
     distinct code when a step wedges. An active FaultInjector
@@ -289,6 +349,13 @@ def train(
     iteration = start_iteration
     skipped_total = 0
     nan_total = 0
+    quarantined_total = 0
+    # audit trail of poison-batch windows skipped by rollbacks; seeded
+    # from the loaded checkpoint so the history survives restarts, and
+    # persisted into every later checkpoint's metadata
+    quarantine_log = list(quarantine_log or [])
+    data_state_now: Optional[dict] = None  # iterator state at the
+    # CURRENT step's batch (snapshotted before any look-ahead pull)
     eval_step_fn = None  # built lazily once, reused across eval intervals
     t_start = time.perf_counter()
     interval_t0 = time.perf_counter()
@@ -310,7 +377,8 @@ def train(
             # best-effort final checkpoint from the monitor thread; the
             # closure reads the loop's CURRENT state/iteration
             if save_fn is not None:
-                save_fn(state, iteration, consumed_samples)
+                _call_save_fn(save_fn, state, iteration, consumed_samples,
+                              data_state_now, quarantine_log)
         wd_timeout = res.step_timeout_s
         if overlap_dispatch:
             # run-ahead dispatch: the host only observes device
@@ -410,6 +478,11 @@ def train(
                         from megatron_tpu.parallel.multihost import \
                             make_global_batch
                         batch = make_global_batch(batch, mesh, batch_sh)
+            if stop_exc is None and save_fn is not None:
+                # snapshot the iterator at THIS step's batch, before the
+                # look-ahead pull below advances it — a checkpoint at
+                # iteration N must resume with batch N+1, not N+2
+                data_state_now = _iter_state(train_iterator)
             if stop_exc is None:
                 step_rng = jax.random.fold_in(rng, iteration)
                 if (cfg.training.profile and not trace_active
@@ -572,18 +645,75 @@ def train(
                     loaded[0])
                 iteration, consumed_samples = (int(loaded[1]),
                                                int(loaded[2]))
-                # re-seeded step randomness for the replayed
-                # segment; identical batches + identical rng would
-                # replay the same divergence
+                # re-seeded STEP randomness (dropout etc.) for the
+                # replayed segment — the DATA order is never re-seeded
                 rng = jax.random.fold_in(base_rng,
                                          0x5EED + guard.rollbacks)
                 if reset_data_fn is not None:
                     if isinstance(train_iterator, PrefetchIterator):
                         train_iterator.close()
-                    train_iterator = reset_data_fn(
-                        consumed_samples, guard.rollbacks)
+                    # exact replay: the stream is rebuilt at the
+                    # checkpoint's saved iterator state (same seed,
+                    # same order) — never a shifted seed
+                    train_iterator = _call_reset_data_fn(
+                        reset_data_fn, consumed_samples,
+                        guard.rollbacks,
+                        getattr(loaded, "data_state", None))
                     # the look-ahead batch belongs to the OLD stream
                     pending_batch, pending_stop = None, None
+                    # poison-batch quarantine: the replayed order would
+                    # re-serve the exact batches that diverged, so the
+                    # window (checkpoint iteration, trigger iteration]
+                    # is skipped BY CONSTRUCTION — batches are pulled
+                    # and discarded (no train step, like the optimizer's
+                    # skip-as-select but decided up front), iteration /
+                    # consumed_samples advance so the iteration↦batch
+                    # mapping downstream of the window is identical to
+                    # an undiverged run. Repeated divergence past the
+                    # window still burns the rollback budget above and
+                    # escalates to TrainingDivergedError.
+                    q_from, q_count = iteration + 1, 0
+                    q_consumed0 = consumed_samples
+                    while iteration < rollback_at:
+                        calc.update(consumed_samples)
+                        if hasattr(train_iterator, "num_microbatches"):
+                            train_iterator.num_microbatches = \
+                                calc.num_microbatches
+                        try:
+                            next(train_iterator)
+                        except StopIteration:
+                            break  # stream shorter than the window
+                        iteration += 1
+                        consumed_samples += calc.global_batch_size
+                        q_count += 1
+                        if watchdog is not None:
+                            watchdog.heartbeat()
+                    if q_count:
+                        quarantined_total += q_count
+                        # actual consumed delta, not q_count ×
+                        # global_batch_size: under rampup the batch
+                        # size changes per step inside the window
+                        q_samples = consumed_samples - q_consumed0
+                        quarantine_log.append({
+                            "from_iteration": q_from,
+                            "to_iteration": iteration,
+                            "samples": q_samples,
+                            "rollback": guard.rollbacks,
+                        })
+                        # the skipped window counts as completed (empty)
+                        # iterations — keep state.iteration (lr
+                        # schedule, logs) aligned with the loop clock
+                        state = TrainState(
+                            params=state.params,
+                            opt_state=state.opt_state,
+                            iteration=jnp.asarray(iteration, jnp.int32))
+                        print_rank_0(
+                            f"divergence guard: quarantined iterations "
+                            f"[{q_from}, {iteration}] ({q_count} steps, "
+                            f"{q_samples} samples) — exact data order "
+                            "replayed, poison window skipped "
+                            "deterministically")
+                    data_state_now = _iter_state(train_iterator)
                     if (cfg.data.num_workers > 0
                             and cfg.training.rampup_batch_size is None
                             and not isinstance(train_iterator,
@@ -605,7 +735,8 @@ def train(
                 toks = calc.global_batch_size * seq_len / dt
                 line = training_log(last_metrics, iteration,
                                     consumed_samples, dt, toks,
-                                    writer, skipped_total, nan_total)
+                                    writer, skipped_total, nan_total,
+                                    quarantined_total)
                 print_rank_0(line)
                 if cfg.training.log_timers_to_tensorboard:
                     timers.write(["train-step"], writer, iteration,
@@ -660,7 +791,9 @@ def train(
                 # deadline while it runs
                 with (watchdog.suspend() if watchdog is not None
                       else _nullcontext()):
-                    save_fn(state, iteration, consumed_samples)
+                    _call_save_fn(save_fn, state, iteration,
+                                  consumed_samples, data_state_now,
+                                  quarantine_log)
             if exiting:
                 break
     finally:

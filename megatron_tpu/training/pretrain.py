@@ -32,7 +32,8 @@ def run_pretrain(
     make_train_step contract: (params, microbatch_dict, rng) -> scalar.
     `pipelined_spec` / `pipelined_loss_fn` supply the pp>1 formulation of
     the same model (see make_train_step)."""
-    from megatron_tpu.data.samplers import DictBatchIterator
+    from megatron_tpu.data.samplers import (DictBatchIterator,
+                                            restore_data_state)
     from megatron_tpu.training import checkpointing as ckpt
     from megatron_tpu.training.loop import train
     from megatron_tpu.training.train_step import state_from_params
@@ -48,20 +49,31 @@ def run_pretrain(
     state = state_from_params(init_params_fn(), cfg)
 
     start_iteration, consumed = 0, 0
+    data_state, quarantine = None, []
     load_dir = cfg.training.load_dir or cfg.training.checkpoint_dir
     if load_dir:
-        loaded, start_iteration, consumed = ckpt.load_checkpoint(
+        loaded = ckpt.load_checkpoint(
             load_dir, state, finetune=cfg.training.finetune,
             no_load_optim=cfg.training.no_load_optim,
             resilience=cfg.resilience)
-        if loaded is not None:
-            state = loaded
+        _, start_iteration, consumed = loaded
+        data_state, quarantine = loaded.data_state, loaded.quarantine
+        if loaded.state is not None:
+            state = loaded.state
 
-    train_it = DictBatchIterator(
-        dataset, cfg.training.micro_batch_size,
-        cfg.parallel.data_parallel or 1, cfg.num_microbatches,
-        consumed_samples=consumed,
-        dataloader_type=cfg.data.dataloader_type, seed=cfg.training.seed)
+    def make_train_it(consumed_samples, data_state=None):
+        # exact resume: a checkpointed iterator state repositions the
+        # stream bit-exactly; otherwise consumed-samples fast-forward
+        it = DictBatchIterator(
+            dataset, cfg.training.micro_batch_size,
+            cfg.parallel.data_parallel or 1, cfg.num_microbatches,
+            consumed_samples=consumed_samples,
+            dataloader_type=cfg.data.dataloader_type,
+            seed=cfg.training.seed)
+        restore_data_state(it, data_state)
+        return it
+
+    train_it = make_train_it(consumed, data_state)
     valid_it = None
     if valid_dataset is not None:
         valid_it = DictBatchIterator(
@@ -71,12 +83,17 @@ def run_pretrain(
 
     save_fn = None
     if cfg.training.checkpoint_dir:
-        def save_fn(st, iteration, consumed_samples):
+        def save_fn(st, iteration, consumed_samples, data_state=None,
+                    quarantine=None):
             ckpt.save_checkpoint(cfg.training.checkpoint_dir, st, cfg,
-                                 iteration, consumed_samples)
+                                 iteration, consumed_samples,
+                                 data_state=data_state,
+                                 quarantine=quarantine)
 
     # divergence-rollback hooks (docs/resilience.md): only checkpoints
-    # THIS run writes are rollback targets — see finetune.py
+    # THIS run writes are rollback targets — see finetune.py. The data
+    # stream is rebuilt at the checkpoint's EXACT position (the loop
+    # quarantines the poison window; the order is never re-seeded)
     load_fn = None
     if cfg.training.checkpoint_dir:
         def load_fn():
@@ -84,19 +101,15 @@ def run_pretrain(
                                         state,
                                         resilience=cfg.resilience)
 
-    def reset_data_fn(consumed_samples, reseed):
-        return DictBatchIterator(
-            dataset, cfg.training.micro_batch_size,
-            cfg.parallel.data_parallel or 1, cfg.num_microbatches,
-            consumed_samples=consumed_samples,
-            dataloader_type=cfg.data.dataloader_type,
-            seed=cfg.training.seed + reseed)
+    def reset_data_fn(consumed_samples, rollbacks, data_state=None):
+        return make_train_it(consumed_samples, data_state)
 
     state, consumed = train(
         cfg, train_it, valid_iterator=valid_it, mesh=mesh, state=state,
         rng=rng,
         start_iteration=start_iteration, consumed_samples=consumed,
         save_fn=save_fn, load_fn=load_fn, reset_data_fn=reset_data_fn,
+        quarantine_log=quarantine,
         step_kwargs={"loss_fn": loss_fn, "init_params_fn": init_params_fn,
                      "axes_fn": axes_fn, "pipelined_spec": pipelined_spec,
                      "pipelined_loss_fn": pipelined_loss_fn})

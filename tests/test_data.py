@@ -360,3 +360,218 @@ class TestPrefetchIterator:
         it.close()
         time.sleep(0.1)
         assert not it._thread.is_alive()
+
+
+class _SeqDataset:
+    """n samples of {'text': [i, i, i, i]} — order-pinning fixture for
+    the exact-resume state protocol tests."""
+
+    def __init__(self, n=20):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {"text": np.full(4, i, dtype=np.int64)}
+
+
+class TestSamplerStateProtocol:
+    """state_dict/load_state_dict: a restored sampler/iterator replays
+    the IDENTICAL stream the original would have continued with
+    (docs/resilience.md "exact resume")."""
+
+    def test_sequential_sampler_round_trip(self):
+        s1 = MegatronPretrainingSampler(100, 0, 2, 2)
+        it1 = iter(s1)
+        head = [next(it1) for _ in range(5)]
+        assert head[0] == [0, 1, 2, 3]
+        s2 = MegatronPretrainingSampler(100, 0, 2, 2)
+        s2.load_state_dict(s1.state_dict())
+        assert [next(iter(s2)) for _ in range(3)] == \
+            [next(it1) for _ in range(3)]
+
+    def test_random_sampler_round_trip(self):
+        from megatron_tpu.data.samplers import \
+            MegatronPretrainingRandomSampler
+        s1 = MegatronPretrainingRandomSampler(50, 0, 2, 2, seed=7)
+        it1 = iter(s1)
+        for _ in range(4):
+            next(it1)
+        s2 = MegatronPretrainingRandomSampler(50, 0, 2, 2, seed=7)
+        s2.load_state_dict(s1.state_dict())
+        # NOTE: re-iterating s1 resumes from its own consumed cursor
+        assert [next(iter(s2)) for _ in range(3)] == \
+            [next(it1) for _ in range(3)]
+
+    def test_random_sampler_seed_mismatch_rejected(self):
+        from megatron_tpu.data.samplers import \
+            MegatronPretrainingRandomSampler
+        s1 = MegatronPretrainingRandomSampler(50, 8, 2, 2, seed=7)
+        s2 = MegatronPretrainingRandomSampler(50, 0, 2, 2, seed=8)
+        with pytest.raises(ValueError, match="seed"):
+            s2.load_state_dict(s1.state_dict())
+
+    def test_consumed_equals_total_is_empty_not_a_crash(self):
+        """A run checkpointed exactly at epoch end resumes by wrapping
+        to the next epoch (the old assert crashed it)."""
+        s = MegatronPretrainingSampler(10, 10, 2, 1)
+        assert list(s) == []
+        # through BatchIterator the wrap serves the next epoch's start
+        it = BatchIterator(_SeqDataset(8), micro_batch_size=2,
+                           data_parallel=1, num_microbatches=1,
+                           consumed_samples=8)
+        np.testing.assert_array_equal(next(it)["tokens"][0, :, 0],
+                                      [0, 1])
+
+    def test_drop_last_mismatch_rejected(self):
+        """drop_last changes _epoch_len, so a mismatch silently shifts
+        the replayed order — it must be rejected like seed/geometry."""
+        a = BatchIterator(_SeqDataset(9), micro_batch_size=2,
+                          data_parallel=1, num_microbatches=1,
+                          drop_last=False)
+        b = BatchIterator(_SeqDataset(9), micro_batch_size=2,
+                          data_parallel=1, num_microbatches=1)
+        with pytest.raises(ValueError, match="drop_last"):
+            b.load_state_dict(a.state_dict())
+
+    @pytest.mark.parametrize("dataloader_type", ["single", "cyclic"])
+    def test_batch_iterator_round_trip_across_epochs(self,
+                                                     dataloader_type):
+        """Resume state taken mid-run (past an epoch wrap) replays the
+        identical batch sequence, for both sampler types."""
+        make = lambda: BatchIterator(
+            _SeqDataset(10), micro_batch_size=2, data_parallel=1,
+            num_microbatches=2, dataloader_type=dataloader_type, seed=5)
+        a = make()
+        for _ in range(4):  # 16 samples: wraps the 10-sample epoch
+            next(a)
+        sd = a.state_dict()
+        assert sd["samples_yielded"] == 16
+        b = make()
+        b.load_state_dict(sd)
+        for _ in range(4):
+            np.testing.assert_array_equal(next(a)["tokens"],
+                                          next(b)["tokens"])
+
+    def test_prefetch_iterator_state_is_consumer_exact(self):
+        """The producer runs ahead; state_dict must reflect the last
+        DELIVERED batch, so a resume never skips the buffered ones."""
+        from megatron_tpu.data.samplers import PrefetchIterator
+        make = lambda: BatchIterator(
+            _SeqDataset(20), micro_batch_size=2, data_parallel=1,
+            num_microbatches=1, dataloader_type="single", seed=5)
+        wrapped = PrefetchIterator(make(), depth=3)
+        delivered = [next(wrapped) for _ in range(3)]
+        for _ in range(20):  # let the producer run ahead
+            if wrapped._q.qsize() >= 3:
+                break
+            import time
+            time.sleep(0.01)
+        sd = wrapped.state_dict()
+        assert sd["prefetch_depth"] == 3
+        assert sd["samples_yielded"] == 6  # 3 delivered x 2 rows, not 12
+        resumed = make()
+        resumed.load_state_dict(sd)
+        np.testing.assert_array_equal(next(resumed)["tokens"],
+                                      next(wrapped)["tokens"])
+        wrapped.close()
+        assert delivered[0]["tokens"].shape == (1, 2, 4)
+
+    def test_prefetch_load_state_dict_before_start(self):
+        from megatron_tpu.data.samplers import PrefetchIterator
+        src = BatchIterator(_SeqDataset(20), 2, 1, 1,
+                            dataloader_type="single", seed=5)
+        donor = BatchIterator(_SeqDataset(20), 2, 1, 1,
+                              dataloader_type="single", seed=5)
+        for _ in range(2):
+            next(donor)
+        wrapped = PrefetchIterator(src, depth=2)
+        wrapped.load_state_dict(donor.state_dict())  # legal: not started
+        np.testing.assert_array_equal(next(wrapped)["tokens"][0, :, 0],
+                                      [4, 5])
+        with pytest.raises(RuntimeError, match="running"):
+            wrapped.load_state_dict(donor.state_dict())
+        wrapped.close()
+
+
+class TestDatasetCacheFreshness:
+    def test_rewritten_files_invalidate_cached_handle(self, tmp_path):
+        """make_dataset keys its handle cache on (mtime, size) of both
+        files — a corpus rewritten in place must re-open, not serve the
+        stale mmap (satellite of ISSUE 4)."""
+        from megatron_tpu.data.indexed_dataset import make_dataset
+        prefix = make_corpus(tmp_path, [[1, 2, 3], [4, 5]])
+        ds1 = make_dataset(prefix)
+        assert make_dataset(prefix) is ds1
+        np.testing.assert_array_equal(ds1[0], [1, 2, 3])
+        # rewrite with different content; force a distinct mtime in
+        # case the filesystem's resolution is coarse
+        make_corpus(tmp_path, [[9, 8, 7, 6], [5, 4]], name="corpus")
+        os.utime(prefix + ".idx", ns=(1, 1))
+        ds2 = make_dataset(prefix)
+        assert ds2 is not ds1
+        np.testing.assert_array_equal(ds2[0], [9, 8, 7, 6])
+
+
+class TestMissingFiles:
+    def test_missing_half_is_typed_not_oserror(self, tmp_path):
+        """A deleted .bin/.idx must raise DatasetCorruptionError (the
+        blend skip-and-count policy catches it), not FileNotFoundError."""
+        from megatron_tpu.data import DatasetCorruptionError
+        from megatron_tpu.data.indexed_dataset import make_dataset
+        prefix = make_corpus(tmp_path, [[1, 2, 3], [4, 5]])
+        os.remove(prefix + ".bin")
+        with pytest.raises(DatasetCorruptionError, match="missing"):
+            make_dataset(prefix)
+        with pytest.raises(DatasetCorruptionError, match="missing"):
+            MMapIndexedDataset(prefix)
+
+
+class TestStrictData:
+    def _corpus(self, tmp_path):
+        rng = np.random.default_rng(0)
+        docs = [rng.integers(0, 100, 12).tolist() for _ in range(10)]
+        return make_corpus(tmp_path, docs)
+
+    def test_out_of_bounds_documents_skip_and_count(self, tmp_path):
+        prefix = self._corpus(tmp_path)
+        indexed = MMapIndexedDataset(prefix)
+        documents = np.asarray([0, 1, 2, 3, 99, 100], dtype=np.int32)
+        ds = GPTDataset("train", prefix, documents, indexed,
+                        num_samples=5, seq_length=8, seed=0, cache=False)
+        assert ds.skipped_documents == 2
+        assert len(ds[0]["text"]) == 9  # still serves valid samples
+
+    def test_stale_indexmap_cache_rebuilt(self, tmp_path):
+        """A corpus re-preprocessed smaller under the same prefix leaves
+        *_indexmap_*.npy caches naming documents the new index no longer
+        has; serving them would bypass the OOB filtering and die in
+        numpy — they must be detected and rebuilt."""
+        prefix = self._corpus(tmp_path)  # 10 docs
+        indexed = MMapIndexedDataset(prefix)
+        ds = GPTDataset("train", prefix, np.arange(10, dtype=np.int32),
+                        indexed, num_samples=5, seq_length=8, seed=0,
+                        cache=True)
+        assert len(ds[0]["text"]) == 9
+        # rewrite the corpus with only 4 docs; same cache key
+        rng = np.random.default_rng(1)
+        make_corpus(tmp_path,
+                    [rng.integers(0, 100, 12).tolist() for _ in range(4)])
+        indexed2 = MMapIndexedDataset(prefix)
+        ds2 = GPTDataset("train", prefix, np.arange(10, dtype=np.int32),
+                         indexed2, num_samples=5, seq_length=8, seed=0,
+                         cache=True)
+        assert ds2.skipped_documents == 6
+        for i in range(len(ds2)):
+            assert len(ds2[i]["text"]) == 9
+
+    def test_strict_data_fails_fast(self, tmp_path):
+        from megatron_tpu.data import DatasetCorruptionError
+        prefix = self._corpus(tmp_path)
+        indexed = MMapIndexedDataset(prefix)
+        documents = np.asarray([0, 1, 99], dtype=np.int32)
+        with pytest.raises(DatasetCorruptionError, match="out of bounds"):
+            GPTDataset("train", prefix, documents, indexed,
+                       num_samples=5, seq_length=8, seed=0, cache=False,
+                       strict_data=True)

@@ -781,3 +781,286 @@ def test_chaos_train_smoke(tmp_path):
     assert record["faults_fired"] == {"transient_error": 1, "nan": 2}
     assert record["value"] is not None  # a rollback actually happened
     assert record["corrupt_fallback_iteration"] < record["final_iteration"]
+    # exact-resume + quarantine are now part of the scripted scenario
+    assert record["exact_resume_state_saved"] is True
+    assert record["quarantine_windows"], "rollback must quarantine"
+    assert all(record["data_faults_detected"].values()), record
+
+
+# ---------------------------------------------------------------------------
+# bit-exact resume: checkpointable data-iterator state (ISSUE 4 tentpole)
+# ---------------------------------------------------------------------------
+
+class _RecordingWriter:
+    def __init__(self):
+        self.scalars = []
+
+    def add_scalar(self, tag, value, step):
+        self.scalars.append((tag, float(value), int(step)))
+
+    def flush(self):
+        pass
+
+    def series(self, tag):
+        return [(s, v) for t, v, s in self.scalars if t == tag]
+
+
+class _SyntheticTextDataset:
+    """Map-style dataset: index -> deterministic tokens (GPTDataset
+    stand-in for the exact-resume loop tests). Optionally records every
+    __getitem__ so tests can pin the exact sample order trained on."""
+
+    def __init__(self, n, seq_length=16, vocab=64, trace=None):
+        self._n, self._seq, self._vocab = n, seq_length, vocab
+        self.trace = trace
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        if self.trace is not None:
+            self.trace.append(int(i))
+        rng = np.random.RandomState((int(i) * 7919 + 3) % (2 ** 31))
+        return {"text": rng.randint(0, self._vocab,
+                                    size=self._seq + 1).astype(np.int64)}
+
+
+class TestBitExactResume:
+    """Acceptance: interrupt at iteration 3 (checkpoint with
+    data-iterator state), restart from the checkpoint — the logged loss
+    series over all 6 iterations is BIT-IDENTICAL to an uninterrupted
+    run, for both the sequential and the per-epoch-shuffling sampler."""
+
+    LOSS_TAG = "lm-loss-training/lm loss"
+
+    def _cfg(self, tmp_path, train_iters=6, exit_interval=None):
+        import dataclasses
+        cfg = tiny_cfg()
+        return dataclasses.replace(cfg, training=dataclasses.replace(
+            cfg.training, train_iters=train_iters, log_interval=1,
+            exit_interval=exit_interval, checkpoint_dir=str(tmp_path)))
+
+    def _iterator(self, dataloader_type, consumed=0, n=32, trace=None):
+        from megatron_tpu.data.samplers import BatchIterator
+        ds = _SyntheticTextDataset(n, trace=trace)
+        return BatchIterator(ds, micro_batch_size=1, data_parallel=1,
+                             num_microbatches=2,
+                             consumed_samples=consumed,
+                             dataloader_type=dataloader_type, seed=1234)
+
+    def _train(self, cfg, it, monkeypatch, state=None, start=0,
+               consumed=0, save_root=None):
+        from megatron_tpu.training.loop import train
+        w = _RecordingWriter()
+        import megatron_tpu.training.loop as loop_mod
+        monkeypatch.setattr(loop_mod, "make_writer", lambda *a, **k: w)
+        save_fn = None
+        if save_root is not None:
+            def save_fn(st, iteration, consumed_samples, data_state=None,
+                        quarantine=None):
+                ckpt.save_checkpoint(save_root, st, cfg, iteration,
+                                     consumed_samples,
+                                     data_state=data_state,
+                                     quarantine=quarantine)
+        state, consumed = train(cfg, it, mesh=None, state=state,
+                                rng=jax.random.PRNGKey(
+                                    cfg.training.seed),
+                                start_iteration=start,
+                                consumed_samples=consumed,
+                                save_fn=save_fn)
+        return w, state, consumed
+
+    @pytest.mark.parametrize("dataloader_type", ["single", "cyclic"])
+    def test_interrupted_run_is_bit_identical(self, tmp_path,
+                                              dataloader_type,
+                                              monkeypatch):
+        root = str(tmp_path / dataloader_type)
+        os.makedirs(root)
+        # reference: 6 uninterrupted iterations
+        cfg = self._cfg(root)
+        w_full, _, _ = self._train(cfg, self._iterator(dataloader_type),
+                                   monkeypatch)
+        full = w_full.series(self.LOSS_TAG)
+        assert len(full) == 6
+
+        # interrupted: exit (and checkpoint, with data state) at iter 3
+        cfg_a = self._cfg(root, exit_interval=3)
+        w_a, _, _ = self._train(cfg_a, self._iterator(dataloader_type),
+                                monkeypatch, save_root=root)
+
+        # resume: restore state + iterator position from the checkpoint
+        example = init_train_state(jax.random.PRNGKey(99), cfg)
+        loaded = ckpt.load_checkpoint(root, example)
+        assert loaded.iteration == 3
+        assert loaded.data_state is not None
+        it = self._iterator(dataloader_type,
+                            consumed=loaded.consumed_samples)
+        it.load_state_dict(loaded.data_state)
+        # fresh uncommitted buffers: the donating step must not clobber
+        # the restorer's arrays (same guard as the loop's rollback path)
+        fresh = jax.tree.map(
+            lambda x: jnp.array(np.asarray(x), copy=True), loaded.state)
+        w_b, state, _ = self._train(self._cfg(root), it, monkeypatch,
+                                    state=fresh, start=3,
+                                    consumed=loaded.consumed_samples)
+
+        resumed = w_a.series(self.LOSS_TAG) + w_b.series(self.LOSS_TAG)
+        assert resumed == full  # bit-exact, steps 1..6
+        assert int(state.iteration) == 6
+
+    def test_data_state_detects_seed_mismatch(self):
+        it = self._iterator("cyclic")
+        sd = it.state_dict()
+        from megatron_tpu.data.samplers import BatchIterator
+        other = BatchIterator(_SyntheticTextDataset(32), 1, 1, 2,
+                              dataloader_type="cyclic", seed=4321)
+        with pytest.raises(ValueError, match="seed"):
+            other.load_state_dict(sd)
+
+
+# ---------------------------------------------------------------------------
+# poison-batch quarantine: rollback replays the EXACT order and skips
+# the quarantined window deterministically (ISSUE 4 tentpole)
+# ---------------------------------------------------------------------------
+
+class TestPoisonBatchQuarantine:
+    def test_rollback_replays_exact_order_and_skips_window(
+            self, tmp_path, monkeypatch):
+        """Checkpoint at iter 2; NaN-poison step calls 3+4 -> rollback
+        at 4. The replayed stream must serve the IDENTICAL samples as
+        the original window (exact order — not re-seeded), the loop
+        must skip that window without training on it, and training must
+        continue with the sample sequence an undiverged run would have
+        seen."""
+        import dataclasses
+        from megatron_tpu.data.samplers import BatchIterator
+        from megatron_tpu.training.loop import train
+        import megatron_tpu.training.loop as loop_mod
+
+        root = str(tmp_path)
+        cfg = tiny_cfg(max_consecutive_nonfinite=2)
+        cfg = dataclasses.replace(cfg, training=dataclasses.replace(
+            cfg.training, train_iters=6, save_interval=2,
+            checkpoint_dir=root))
+        monkeypatch.setattr(loop_mod, "make_writer",
+                            lambda *a, **k: _RecordingWriter())
+
+        trace = []
+        ds = _SyntheticTextDataset(64, trace=trace)
+
+        def make_it(consumed, data_state=None):
+            it = BatchIterator(ds, 1, 1, 2, consumed_samples=consumed,
+                               dataloader_type="cyclic", seed=1234)
+            if data_state:
+                it.load_state_dict(data_state)
+            return it
+
+        def save_fn(st, iteration, consumed, data_state=None,
+                    quarantine=None):
+            ckpt.save_checkpoint(root, st, cfg, iteration, consumed,
+                                 data_state=data_state,
+                                 quarantine=quarantine)
+
+        example = init_train_state(jax.random.PRNGKey(99), cfg)
+
+        def load_fn():
+            return ckpt.load_checkpoint(root, example,
+                                        resilience=cfg.resilience)
+
+        def reset_data_fn(consumed, rollbacks, data_state=None):
+            return make_it(consumed, data_state)
+
+        inj = FaultInjector(nan_step_calls={3, 4})
+        with use_fault_injector(inj):
+            state, consumed = train(
+                cfg, make_it(0), mesh=None,
+                rng=jax.random.PRNGKey(cfg.training.seed),
+                save_fn=save_fn, load_fn=load_fn,
+                reset_data_fn=reset_data_fn)
+
+        # oracle: the sample order an uninterrupted run would draw
+        ref_trace = []
+        ref_it = BatchIterator(
+            _SyntheticTextDataset(64, trace=ref_trace), 1, 1, 2,
+            dataloader_type="cyclic", seed=1234)
+        for _ in range(6):
+            next(ref_it)
+        assert len(ref_trace) == 12  # 6 iterations x 2 samples
+
+        # observed: steps 1-4 (original), the quarantine replay of the
+        # window (iterations 3-4 — IDENTICAL samples, proving the order
+        # was not re-seeded), then steps 5-6 exactly on schedule
+        assert trace == (ref_trace[:8] + ref_trace[4:8]
+                         + ref_trace[8:12]), (
+            "rollback must replay the exact order and quarantine the "
+            "window — never re-seed the stream")
+
+        assert int(state.iteration) == 6
+        assert consumed == 12  # quarantined samples stay accounted
+        # the quarantine window is recorded in the final checkpoint
+        tag = ckpt.read_tracker(root)
+        with open(os.path.join(root, f"iter_{int(tag):07d}",
+                               "metadata.json")) as f:
+            meta = json.load(f)
+        assert meta["quarantine"] == [{"from_iteration": 3,
+                                       "to_iteration": 4, "samples": 4,
+                                       "rollback": 1}]
+
+
+# ---------------------------------------------------------------------------
+# corrupt-dataset detection: typed errors at open (ISSUE 4 tentpole)
+# ---------------------------------------------------------------------------
+
+class TestDatasetCorruptionDetection:
+    def _build(self, tmp_path, name="corpus", docs=12):
+        from megatron_tpu.data.indexed_dataset import IndexedDatasetBuilder
+        prefix = str(tmp_path / name)
+        b = IndexedDatasetBuilder(prefix, dtype=np.int32)
+        for i in range(docs):
+            b.add_item(list(range(i, i + 10)))
+            b.end_document()
+        b.finalize()
+        return prefix
+
+    @pytest.mark.parametrize("mode,path_ext", [
+        ("truncate_bin", ".bin"),
+        ("garbage_idx", ".idx"),
+        ("oob_pointer", ".idx"),
+    ])
+    def test_injected_fault_raises_typed_error_at_open(
+            self, tmp_path, mode, path_ext):
+        """Each FaultInjector dataset fault must surface as
+        DatasetCorruptionError AT OPEN (never a downstream numpy
+        error), naming the corrupt file."""
+        from megatron_tpu.data.indexed_dataset import (
+            DatasetCorruptionError, MMapIndexedDataset)
+        prefix = self._build(tmp_path, name=mode)
+        touched = FaultInjector.corrupt_dataset(prefix, mode)
+        assert touched.endswith(path_ext)
+        with pytest.raises(DatasetCorruptionError) as ei:
+            MMapIndexedDataset(prefix)
+        # names the corrupt pair (an oob pointer lives in .idx but is
+        # detected against the .bin size — either file is actionable)
+        assert os.path.basename(prefix) in str(ei.value)
+
+    def test_make_dataset_never_serves_stale_corrupt_handle(
+            self, tmp_path):
+        """A cached clean handle must be invalidated when the files
+        change on disk (mtime+size cache key) — corruption after a
+        successful open is still caught at the next make_dataset."""
+        from megatron_tpu.data.indexed_dataset import (
+            DatasetCorruptionError, make_dataset)
+        prefix = self._build(tmp_path)
+        ds1 = make_dataset(prefix)
+        assert make_dataset(prefix) is ds1  # unchanged files: cache hit
+        FaultInjector.corrupt_dataset(prefix, "truncate_bin")
+        with pytest.raises(DatasetCorruptionError):
+            make_dataset(prefix)
+
+    def test_truncated_index_header(self, tmp_path):
+        from megatron_tpu.data.indexed_dataset import (
+            DatasetCorruptionError, MMapIndexedDataset)
+        prefix = self._build(tmp_path)
+        FaultInjector.truncate_file(prefix + ".idx", keep_bytes=20)
+        with pytest.raises(DatasetCorruptionError, match="truncated"):
+            MMapIndexedDataset(prefix)
