@@ -341,6 +341,12 @@ def _run_extras():
         # decode_sync_interval 1-vs-K in the engine — ON CHIP the
         # ms/step delta is the dispatch gap the per-step sync cost
         ("bench_sync.py", [], "/tmp/bench_extras_sync.log"),
+        # prefix-cache + chunked-prefill A/B on a shared-prefix
+        # workload (PERF_NOTES serving section): hit rate, REAL prefill
+        # forward tokens removed by KV reuse, TTFT with/without
+        # chunking — ON CHIP this is the pending on-chip record for
+        # the PR-5 serving work
+        ("bench_prefix.py", [], "/tmp/bench_extras_prefix.log"),
         # resilience smoke: scripted chaos run (transient write fault +
         # NaN-streak rollback + corrupt-checkpoint fallback) — the
         # recovery-latency record makes regressions in the resilience

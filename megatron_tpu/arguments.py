@@ -254,6 +254,22 @@ def build_parser(extra_args_provider: Optional[Callable] = None
     g.add_argument("--prefill_max_batch", type=int, default=8,
                    help="serving: max same-bucket admissions coalesced "
                         "into one batched prefill call (1 disables)")
+    g.add_argument("--enable_prefix_cache", action="store_true",
+                   help="serving: retain finished slots' KV on an LRU "
+                        "and reuse bucket-aligned shared prefixes "
+                        "through one on-device region copy (token-"
+                        "exact vs off; unsupported on rolling "
+                        "sliding-window pools — docs/serving.md)")
+    g.add_argument("--prefill_chunk", type=int, default=None,
+                   help="serving: split prompts/suffixes longer than "
+                        "this into chunks interleaved with decode "
+                        "steps (bounds ITL of running requests during "
+                        "long prefills; None = monolithic prefill)")
+    g.add_argument("--retained_slots", type=int, default=None,
+                   help="serving: prefix-cache retained-slot budget — "
+                        "at most this many finished slots keep their "
+                        "KV for reuse (None retains all; they are "
+                        "reclaimed lazily when admission needs a slot)")
 
     g = p.add_argument_group(
         "reference compat",
@@ -526,7 +542,10 @@ def config_from_args(args: argparse.Namespace,
         serving=ServingConfig(
             request_deadline_s=args.request_deadline_s,
             decode_sync_interval=args.decode_sync_interval,
-            prefill_max_batch=args.prefill_max_batch),
+            prefill_max_batch=args.prefill_max_batch,
+            enable_prefix_cache=args.enable_prefix_cache,
+            prefill_chunk=args.prefill_chunk,
+            retained_slots=args.retained_slots),
         resilience=ResilienceConfig(**{
             **_pick(args, ResilienceConfig),
             "checkpoint_integrity": not args.no_checkpoint_integrity}),

@@ -401,6 +401,28 @@ class ServingConfig:
     # batch sizes round up to powers of two so the jit cache stays
     # bounded at O(log slots) entries per length bucket). 1 disables.
     prefill_max_batch: int = 8
+    # prefix-cache KV reuse (SGLang's RadixAttention, slot-grid native):
+    # finished slots RETAIN their KV on an LRU list instead of freeing;
+    # a new prompt sharing a bucket-aligned prefix with a retained (or
+    # running) slot's prompt reuses it through ONE on-device region copy
+    # and prefills only the suffix. Seeded outputs stay token-exact vs
+    # the cache-off engine (the clone copies KV — int8 blocks + scales —
+    # verbatim). Unsupported on ROLLING (sliding-window) pools, whose
+    # ring order is source-length-dependent: validate() rejects it.
+    enable_prefix_cache: bool = False
+    # chunked prefill (Sarathi-Serve): prompts/suffixes longer than this
+    # split into chunks the engine interleaves with decode steps, so a
+    # long prompt's prefill no longer stalls every in-flight decode for
+    # its whole duration. None disables (one monolithic prefill call).
+    # Also unsupported on ROLLING pools (an offset>0 chunk would need
+    # ring history the W-slot buffer already dropped).
+    prefill_chunk: Optional[int] = None
+    # retained-slot budget for the prefix cache: at most this many
+    # finished slots keep their KV for reuse (the oldest demotes to the
+    # free list beyond it). None retains every finished slot — they are
+    # reclaimed lazily when admission needs a slot anyway, so the only
+    # cost of None is colder free-list slots.
+    retained_slots: Optional[int] = None
 
     def validate(self, model: Optional["ModelConfig"] = None
                  ) -> "ServingConfig":
@@ -409,6 +431,48 @@ class ServingConfig:
         assert self.prefill_bucket >= 1, self.prefill_bucket
         assert self.decode_sync_interval >= 1, self.decode_sync_interval
         assert self.prefill_max_batch >= 1, self.prefill_max_batch
+        assert self.prefill_chunk is None or self.prefill_chunk >= 1, (
+            self.prefill_chunk)
+        assert self.retained_slots is None or self.retained_slots >= 0, (
+            self.retained_slots)
+        if model is not None and model.sliding_window is not None:
+            # ROLLING pools (flash impl caps the region to W < max_len)
+            # hold the last W positions ring-ordered by the SOURCE's
+            # length: a cloned prefix may already be evicted and an
+            # offset>0 chunk would wrap over history its own queries
+            # need. Exclude LOUDLY rather than decode garbage.
+            max_len = self.max_len or model.max_position_embeddings
+            rolling = (model.attention_impl == "flash"
+                       and model.sliding_window < max_len)
+            assert not (rolling and self.enable_prefix_cache), (
+                "enable_prefix_cache is unsupported on ROLLING "
+                "(sliding-window) KV pools: the W-slot ring is ordered "
+                "by the source's length, so a prefix clone could copy "
+                "already-evicted positions. Serve this model with the "
+                "prefix cache off.")
+            assert not (rolling and self.prefill_chunk is not None), (
+                "prefill_chunk is unsupported on ROLLING "
+                "(sliding-window) KV pools: an offset>0 chunk would "
+                "wrap the W-slot ring over history its own queries "
+                "still need. Serve this model unchunked.")
+        if (model is not None and model.attention_impl == "flash"
+                and self.kv_dtype == "int8"):
+            # the flash impl's OFFSET-0 prefill reads the RAW k/v
+            # (bypassing the quantized cache entirely) while an
+            # offset>0 continuation chunk / prefix suffix reads the
+            # DEQUANTIZED int8 region — mathematically different
+            # logits, so the token-exact cache-on/off contract cannot
+            # hold. Exclude LOUDLY. (The engine re-checks with the
+            # RESOLVED pool dtype, covering kv_dtype=None inheriting
+            # an int8 Generator.)
+            assert not (self.enable_prefix_cache
+                        or self.prefill_chunk is not None), (
+                "enable_prefix_cache/prefill_chunk are unsupported on "
+                "flash-impl int8 KV pools: the offset-0 flash prefill "
+                "reads raw k/v while offset>0 continuations read the "
+                "dequantized cache, so cache-on outputs would not be "
+                "token-exact vs cache-off. Use the dot impl or a "
+                "bf16/f32 pool.")
         assert self.request_deadline_s is None or \
             self.request_deadline_s > 0.0, self.request_deadline_s
         assert self.kv_dtype is None or \

@@ -106,6 +106,37 @@ def init_kv_caches(cfg: ModelConfig, batch: int, max_len: int,
     )
 
 
+def prefill_chunk(params, tokens, caches, cfg: ModelConfig, *, rope,
+                  last_idx, next_offset):
+    """Forward one [1, s] prompt chunk through a batch-1 cache at the
+    cache's CURRENT offset and return (caches, last_logits_row).
+
+    Offset 0 is the classic whole-prompt prefill; offset > 0 is the
+    continuation form the serving engine's prefix cache and chunked
+    prefill rely on — a multi-token append whose causal mask starts at
+    the cache offset (models/attention.py generalizes the decode
+    masking to q-len > 1; the flash impl routes offset > 0 through the
+    cached dot path via its lax.cond). `last_idx` (traced) picks the
+    logits row of the chunk's last REAL token.
+
+    `next_offset` (traced) is the REAL token count after this chunk:
+    the attention write advances the offset by the full padded chunk
+    length, so a bucket-padded chunk would leave the cache pointing
+    past its pad garbage and the NEXT chunk would append at the wrong
+    positions. Resetting to the real count makes the next chunk's
+    write start right after the real tokens, overwriting the pads
+    write-before-read — the same invariant bucketed prefill +
+    insert_prefill already rely on for the final pads."""
+    logits, caches = lm.model_forward(params, tokens, cfg,
+                                      kv_caches=caches, rope=rope,
+                                      logits_dtype=jnp.float32)
+    last = jax.lax.dynamic_slice_in_dim(logits[0], last_idx, 1,
+                                        axis=0)[0]
+    caches = caches._replace(offset=jnp.full_like(
+        caches.offset, jnp.asarray(next_offset, jnp.int32)))
+    return caches, last
+
+
 def _decode_fn(params, tokens, lengths, rng, *, cfg: ModelConfig,
                max_len: int, min_prompt: int, sp: SamplingParams,
                eos_id: int, pad_id: int, rope, kv_dtype=jnp.bfloat16):

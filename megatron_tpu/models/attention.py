@@ -250,16 +250,19 @@ def attention_apply(
     assert cfg.sliding_window is None or (causal and not cross), (
         "sliding_window requires causal self-attention")
     dropout_active = not deterministic and cfg.attention_dropout > 0.0
-    # A cached forward with s > 1 is an offset-0 prefill everywhere in
-    # this codebase (generation.py's prefill; decode steps are s == 1).
-    # At offset 0 causal attention over the cache equals plain causal
-    # attention over the fresh k/v, so the prefill can take the flash
-    # path on the raw (un-cache-rounded) tensors instead of paying
-    # O(s^2) score materialization on the dot path — the reference's
-    # prefill pays full unfused attention. The offset-0 condition is
-    # ENFORCED below with a lax.cond (a chunked/continuation prefill at
-    # offset > 0 gets the correct cached dot path, not silently wrong
-    # flash over the fresh chunk only).
+    # A cached forward with s > 1 is either an offset-0 prefill
+    # (generation.py's whole-prompt pass) or a CONTINUATION chunk at
+    # offset > 0 (generation.py prefill_chunk — the serving engine's
+    # prefix-cache suffix / chunked prefill): the decode masking
+    # generalized to q-len > 1, queries at positions offset..offset+s
+    # attending the cache's live region. At offset 0 causal attention
+    # over the cache equals plain causal attention over the fresh k/v,
+    # so that case can take the flash path on the raw (un-cache-rounded)
+    # tensors instead of paying O(s^2) score materialization on the dot
+    # path — the reference's prefill pays full unfused attention. The
+    # offset-0 condition is ENFORCED below with a lax.cond: an
+    # offset > 0 chunk gets the correct cached dot path, not silently
+    # wrong flash over the fresh chunk only.
     prefill_flash = (cfg.attention_impl == "flash" and kv_cache is not None
                      and s > 1 and segment_ids is None and causal
                      and not cross and not dropout_active)

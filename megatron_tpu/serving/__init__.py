@@ -2,8 +2,9 @@
 generator and the HTTP front end — see docs/serving.md)."""
 from megatron_tpu.serving.engine import ServingEngine  # noqa: F401
 from megatron_tpu.serving.kv_pool import (  # noqa: F401
-    SlotKVPool, insert_prefill)
+    SlotKVPool, clone_prefix, insert_prefill, slice_slot)
 from megatron_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from megatron_tpu.serving.prefix_index import PrefixIndex  # noqa: F401
 from megatron_tpu.serving.request import (  # noqa: F401
     DeadlineExceededError, GenRequest, RequestState, SamplingOptions,
     ServiceUnavailableError)

@@ -30,6 +30,22 @@ TPU-native:
   lengths keep cached device copies re-uploaded only on slot churn,
   and queued same-length-bucket admissions coalesce into one batched
   prefill call (`prefill_max_batch`).
+- Prefix-cache KV reuse (`enable_prefix_cache`, SGLang's
+  RadixAttention made slot-grid native): finished slots RETAIN their
+  KV on an LRU list (serving/kv_pool.py) and a host-side radix index
+  (serving/prefix_index.py) matches new prompts against running +
+  retained slots at prefill-bucket granularity. A hit slices the
+  shared region out of the pool (`slice_slot` — the read half of
+  `clone_prefix`) and forwards ONLY the suffix, so the shared tokens
+  cost one on-device region copy instead of L forward layers.
+- Chunked prefill (`prefill_chunk`, Sarathi-Serve): prompts/suffixes
+  longer than the chunk split into pieces the loop interleaves with
+  decode steps — one chunk per engine iteration — so a long prompt's
+  prefill no longer stalls every in-flight decode for its whole
+  duration. The in-progress KV accumulates in a batch-1 cache OUTSIDE
+  the pool (`generation.prefill_chunk` appends each chunk at the
+  cache's offset) and lands in the slot region with one
+  `insert_prefill` when the last chunk completes.
 
 Seeded determinism: a request with seed s reproduces the serial
 `Generator.generate([prompt], ..., seed=s)` output token-for-token —
@@ -46,17 +62,40 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from megatron_tpu.inference.generation import Generator
+from megatron_tpu.inference.generation import Generator, prefill_chunk
 from megatron_tpu.inference.sampling import sample_batched
 from megatron_tpu.models import language_model as lm
-from megatron_tpu.serving.kv_pool import SlotKVPool, insert_prefill
+from megatron_tpu.serving.kv_pool import (SlotKVPool, insert_prefill,
+                                          slice_slot)
 from megatron_tpu.serving.metrics import ServingMetrics
+from megatron_tpu.serving.prefix_index import PrefixIndex
 from megatron_tpu.serving.request import (GenRequest, RequestState,
                                           SamplingOptions)
 from megatron_tpu.serving.scheduler import FIFOScheduler
 from megatron_tpu.utils.logging import print_rank_0
 
 from megatron_tpu.config import SERVING_KV_DTYPES as _KV_DTYPES
+
+
+class _PendingPrefill:
+    """A request mid-prefill: it owns a pool slot (reserved at
+    admission) but its KV accumulates in `sub`, a batch-1 cache OUTSIDE
+    the pool, so the K-chained decode dispatches — which write garbage
+    for every inactive grid row — can never touch it. `pos` is the
+    number of prompt tokens whose KV `sub` holds (starts at the cloned
+    prefix length on a hit); `last` is the logits row of the most
+    recent chunk's final real token (only the LAST chunk's value is
+    consumed, as the sampling logits at prompt position plen-1)."""
+
+    __slots__ = ("req", "slot", "sub", "pos", "rng0", "last")
+
+    def __init__(self, req: GenRequest, slot: int, sub, pos: int, rng0):
+        self.req = req
+        self.slot = slot
+        self.sub = sub
+        self.pos = pos
+        self.rng0 = rng0
+        self.last = None
 
 
 class ServingEngine:
@@ -82,7 +121,38 @@ class ServingEngine:
                     if self.serving.kv_dtype is None
                     else _KV_DTYPES[self.serving.kv_dtype])
         self.pool = SlotKVPool(cfg, self.num_slots, self.max_len,
-                               dtype=kv_dtype)
+                               dtype=kv_dtype,
+                               retained_limit=self.serving.retained_slots)
+        # prefix cache + chunked prefill: both need the continuation
+        # form of prefill (append at offset > 0), which a ROLLING pool
+        # cannot express — its W-slot ring is ordered by the SOURCE's
+        # length, so a cloned prefix may already be evicted and a chunk
+        # would wrap over history its own queries need.
+        # ServingConfig.validate rejects the combination; assert again
+        # here for engines constructed without going through validate.
+        self._prefix_on = bool(self.serving.enable_prefix_cache)
+        self._chunk = self.serving.prefill_chunk
+        assert not (self.pool.rolling
+                    and (self._prefix_on or self._chunk is not None)), (
+            "enable_prefix_cache/prefill_chunk are unsupported on "
+            "ROLLING (sliding-window) KV pools — see "
+            "ServingConfig.validate")
+        # flash + int8 re-check with the RESOLVED pool dtype (validate
+        # only sees an explicit kv_dtype string; None inherits the
+        # Generator's): the offset-0 flash prefill reads raw k/v while
+        # offset>0 continuations read the dequantized int8 cache, so
+        # cache-on could not be token-exact vs cache-off
+        assert not (cfg.attention_impl == "flash"
+                    and self.pool.dtype == jnp.dtype(jnp.int8)
+                    and (self._prefix_on or self._chunk is not None)), (
+            "enable_prefix_cache/prefill_chunk are unsupported on "
+            "flash-impl int8 KV pools — see ServingConfig.validate")
+        self._index = PrefixIndex(max(self.serving.prefill_bucket, 1))
+        # a retained slot's KV is reclaimed lazily (alloc / retain
+        # overflow) — forget its prefixes the moment that happens
+        self.pool.on_reclaim = self._index.remove
+        self._prefilling: List[_PendingPrefill] = []
+        self._sub0 = None  # lazily-built zero template for miss starts
         self.scheduler = FIFOScheduler(self.serving.max_queue,
                                        max_total_len=self.max_len)
         self.scheduler.notify = self._wake
@@ -130,6 +200,24 @@ class ServingEngine:
         # the cache hits across request sizes and arrival bursts)
         self._prefill = self.gen._jit(self._prefill_fn, n_array_args=7,
                                       donate_argnums=(1, 2, 3))
+        # prefix-cache / chunked-prefill programs (slot indices and
+        # offsets are traced scalars — one compile serves every slot):
+        # _slice reads a region out of the pool (the read half of
+        # kv_pool.clone_prefix; start=0 on a miss just yields a
+        # masked-garbage batch-1 cache at offset 0), _chunk_fwd appends
+        # one chunk at the sub-cache's offset (retraces per padded
+        # chunk length, same bucketing as _prefill), _insert is the
+        # write half — the whole region lands in the dst slot and the
+        # slot activates. `sub` is deliberately NOT donated across the
+        # _chunk_fwd chain: chained donation of a consumed-in-flight
+        # buffer hits the CPU jax 0.4.x aliasing bug documented at
+        # _decode above.
+        self._chunk_traces = 0
+        self._slice = self.gen._jit(self._slice_fn, n_array_args=3)
+        self._chunk_fwd = self.gen._jit(self._chunk_fwd_fn,
+                                        n_array_args=4)
+        self._insert = self.gen._jit(self._insert_fn, n_array_args=8,
+                                     donate_argnums=(1, 2, 3))
         self._steps = 0
         self._cond = threading.Condition()
         self._stop = False
@@ -209,6 +297,9 @@ class ServingEngine:
         for req in self._slot_req:
             if req is not None and req.state is RequestState.RUNNING:
                 req.fail("engine shut down")
+        for st in self._prefilling:
+            if not st.req.done():
+                st.req.fail("engine shut down")
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful shutdown: stop admitting (queued-but-unstarted
@@ -249,9 +340,11 @@ class ServingEngine:
         """ONE interleaved decode step for the whole slot grid: sample
         each slot's next token from its carried logits, then forward all
         slots' tokens (s=1) through the model with per-slot positions.
-        Inactive slots ride along at length 0 (static shapes); their
-        writes land at position 0 and are fully overwritten by the next
-        prefill insert.
+        Inactive slots ride along too (static shapes): hard-freed rows
+        park at length 0 (their position-0 write is overwritten by the
+        next prefill insert), while prefix-retained rows park at their
+        FINAL length so the garbage writes land past every cloneable
+        prefix instead of clobbering the retained KV (see _evict).
 
         `lengths` is the DEVICE copy of the per-slot positions and is
         returned incremented, so K chained calls advance positions
@@ -316,6 +409,35 @@ class ServingEngine:
             rngs = rngs.at[slots[i]].set(rng0s[i])
         return pool, last_logits, rngs
 
+    def _slice_fn(self, params, pool, slot, start):
+        """Read `slot`'s region as a batch-1 cache positioned at
+        `start` — the prefix-clone read (start = matched prefix
+        length; misses start from the shared zero template instead).
+        `params` rides along unused so the mesh-aware jit treatment
+        applies uniformly (jit drops unused args at lowering)."""
+        return slice_slot(pool, slot, start)
+
+    def _chunk_fwd_fn(self, params, sub, tokens, last_idx, next_offset):
+        """Append one [1, s] prompt chunk at `sub`'s current offset
+        (generation.prefill_chunk: decode masking generalized to
+        q-len > 1). Retraces once per padded chunk length — the same
+        bucket set as the monolithic prefill."""
+        self._chunk_traces += 1
+        return prefill_chunk(params, tokens, sub, self.cfg,
+                             rope=self.gen.rope, last_idx=last_idx,
+                             next_offset=next_offset)
+
+    def _insert_fn(self, params, pool, last_logits, rngs, sub, slot,
+                   plen, last, rng0):
+        """Land a completed prefill: the sub-cache's whole region
+        writes into `slot` with the first `plen` tokens live (the
+        write half of kv_pool.clone_prefix, fused with the slot's
+        last-logits/rng activation)."""
+        pool = insert_prefill(pool, sub, slot, plen)
+        last_logits = last_logits.at[slot].set(last)
+        rngs = rngs.at[slot].set(rng0)
+        return pool, last_logits, rngs
+
     def _prefill_bucket(self, plen: int) -> int:
         """Pad prompts up to a bucket so the prefill jit cache hits
         across request sizes. ROLLING pools prefill at the exact length:
@@ -369,16 +491,25 @@ class ServingEngine:
             with self._cond:
                 while (not self._stop and not self._draining
                        and self.scheduler.depth() == 0
-                       and not self._active.any()):
+                       and not self._active.any()
+                       and not self._prefilling):
                     self._cond.wait(timeout=0.5)
                 if self._stop:
                     return
-                if self._draining and not self._active.any():
-                    return  # drained: queue closed, slots empty
+                if (self._draining and not self._active.any()
+                        and not self._prefilling):
+                    # drained: queue closed, slots empty, no prefill
+                    # in flight (a mid-chunk request is in-flight work
+                    # and decodes to completion like a running slot)
+                    return
             try:
                 self._reap_cancelled()
                 self._reap_expired()
                 self._admit()
+                # ONE chunk per iteration (Sarathi-Serve): prefill work
+                # is interleaved with the decode step below, so running
+                # slots keep emitting tokens while a long prompt lands
+                self._advance_prefill()
                 if self._active.any():
                     self._step()
             except Exception as e:  # noqa: BLE001 — fail loudly, not hang
@@ -387,28 +518,166 @@ class ServingEngine:
                 for req in self._slot_req:
                     if req is not None:
                         req.fail(self._broken)
+                for st in self._prefilling:
+                    st.req.fail(self._broken)
                 for req in self.scheduler.close():
                     req.fail(self._broken)
                 return
 
     def _admit(self):
-        groups = self.scheduler.pop_ready_grouped(
-            self.pool.free_count(),
-            lambda r: self._prefill_bucket(len(r.prompt)),
-            self._prefill_max_batch)
-        pending = [r for _, reqs in groups for r in reqs]
-        for padded, reqs in groups:
-            try:
+        popped = self.scheduler.pop_ready(self.pool.free_count())
+        if not popped:
+            return
+        pending = list(popped)
+        try:
+            groupable: List[GenRequest] = []
+            for r in popped:
+                # prefix lookup caps the match at len(prompt)-1: at
+                # least one suffix token must forward to produce the
+                # sampling logits at position plen-1
+                src, hit = (self._index.lookup(r.prompt,
+                                               len(r.prompt) - 1)
+                            if self._prefix_on else (None, 0))
+                if hit or (self._chunk is not None
+                           and len(r.prompt) > self._chunk):
+                    self._start_pending(r, src, hit)
+                    pending.remove(r)
+                else:
+                    groupable.append(r)
+            for padded, reqs in FIFOScheduler.group_by_bucket(
+                    groupable,
+                    lambda rr: self._prefill_bucket(len(rr.prompt)),
+                    self._prefill_max_batch):
                 self._prefill_group(reqs, padded)
                 for r in reqs:
                     pending.remove(r)
-            except Exception as e:
-                # the failing group AND the rest of this pop are in
-                # neither _slot_req nor the scheduler — fail them here
-                # or their callers would hang to the request timeout
-                for r in pending:
-                    r.fail(repr(e))
-                raise
+        except Exception as e:
+            # anything not yet admitted is in neither _slot_req /
+            # _prefilling nor the scheduler — fail it here or its
+            # caller would hang to the request timeout
+            for r in pending:
+                r.fail(repr(e))
+            raise
+
+    def _start_pending(self, req: GenRequest, src_slot: Optional[int],
+                       prefix_len: int):
+        """Reserve a slot and begin a suffix/chunked prefill. On a
+        prefix hit the shared region slices out of `src_slot` (one
+        on-device copy in place of L forward layers over those
+        tokens); otherwise the sub-cache starts empty at offset 0."""
+        plen = len(req.prompt)
+        if prefix_len:
+            # matched at lookup — counted even when the allocation
+            # below forfeits the hit, so hit_tokens - tokens_saved
+            # measures slot-pressure forfeits
+            self.metrics.count("prefix_hit_tokens", prefix_len)
+        slot = self.pool.alloc(
+            exclude=(src_slot,) if prefix_len else ())
+        if slot is None:
+            # the ONLY allocatable slot is the clone source itself:
+            # forfeit the hit and reclaim it as a plain slot
+            src_slot, prefix_len = None, 0
+            slot = self.pool.alloc()
+        assert slot is not None, "popped more requests than free slots"
+        try:
+            if prefix_len:
+                self.pool.touch(src_slot)  # refresh the retained LRU
+                req.prefix_len = prefix_len
+                self.metrics.count("prefix_hits")
+                self.metrics.count("prefill_tokens_saved", prefix_len)
+                sub = self._slice(self.gen.params, self.pool.caches,
+                                  jnp.int32(src_slot),
+                                  jnp.int32(prefix_len))
+            else:
+                # miss: start from the shared ZERO template instead of
+                # paying a full region copy out of the pool for content
+                # the offset-0 mask never reads. Sharing one template
+                # across admissions is safe because _chunk_fwd never
+                # donates its input — every chunk returns fresh buffers
+                if self._sub0 is None:
+                    self._sub0 = self.pool.make_prefill_caches(1)
+                sub = self._sub0
+            st = _PendingPrefill(req, slot, sub, prefix_len,
+                                 self._initial_rng(req.seed, plen))
+            req.mark_admitted()
+            self.metrics.record_admitted(req.admit_time
+                                         - req.submit_time)
+            self._prefilling.append(st)
+        except Exception:
+            self.pool.release(slot)
+            raise
+
+    def _advance_prefill(self):
+        """Run ONE prefill chunk for the oldest pending request; when
+        its last chunk lands, insert the accumulated KV into the slot
+        and activate it. Chunk tokens pad up to the prefill bucket
+        (capped so the write can never spill past the region — a
+        clamped dynamic_update_slice would silently shift backwards
+        over real tokens)."""
+        if not self._prefilling:
+            return
+        st = self._prefilling[0]
+        plen = len(st.req.prompt)
+        n = plen - st.pos
+        if self._chunk is not None:
+            n = min(n, self._chunk)
+        # chunk shape bucketing: a FULL chunk is already a fixed shape;
+        # only the tail pads up to the prefill bucket (capped at the
+        # chunk size so chunking never widens the shape set, and at the
+        # region remainder so the padded write can never spill past the
+        # slot — a clamped dynamic_update_slice would silently shift
+        # backwards over real tokens)
+        b = max(self.serving.prefill_bucket, 1)
+        if self._chunk is not None and n == self._chunk:
+            padded = n
+        else:
+            padded = -(-n // b) * b
+            if self._chunk is not None:
+                padded = min(padded, max(self._chunk, n))
+        padded = min(padded, self.max_len - st.pos)
+        assert n <= padded, (n, padded, st.pos)
+        toks = np.full((1, padded), self.gen.pad_id, np.int32)
+        toks[0, :n] = st.req.prompt[st.pos:st.pos + n]
+        st.sub, st.last = self._chunk_fwd(
+            self.gen.params, st.sub, jnp.asarray(toks),
+            jnp.int32(n - 1), jnp.int32(st.pos + n))
+        st.pos += n
+        st.req.prefill_chunks += 1
+        self.metrics.count("prefill_chunks")
+        # REAL tokens forwarded — the cache-on/off A/B seam: prefix
+        # hits forward strictly fewer tokens than the cache-off run
+        self.metrics.count("prefill_forward_tokens", n)
+        if st.pos >= plen:
+            self._prefilling.pop(0)
+            self._activate_pending(st, plen)
+
+    def _activate_pending(self, st: _PendingPrefill, plen: int):
+        slot, req = st.slot, st.req
+        out = self._insert(self.gen.params, self.pool.caches,
+                           self._last_logits, self._rngs, st.sub,
+                           jnp.int32(slot), jnp.int32(plen), st.last,
+                           st.rng0)
+        self.pool.caches, self._last_logits, self._rngs = out
+        self._lengths[slot] = plen
+        self._active[slot] = True
+        self._temps[slot] = req.sampling.temperature
+        self._top_ks[slot] = req.sampling.top_k
+        self._top_ps[slot] = req.sampling.top_p
+        self._slot_req[slot] = req
+        self._sampling_dirty = True
+        self._lengths_dirty = True
+        if self._prefix_on:
+            # the slot is now cloneable for its PROMPT (extended with
+            # the generated tokens at retain time)
+            self._index.insert(slot, req.prompt)
+
+    def _drop_pending(self, st: _PendingPrefill, msg: str,
+                      kind: str = "error"):
+        self._prefilling.remove(st)
+        self.pool.release(st.slot)
+        st.req.fail(msg, kind=kind)
+        self.metrics.count("requests_expired" if kind == "deadline"
+                           else "requests_cancelled")
 
     def _prefill_group(self, reqs: List[GenRequest], padded: int):
         """One batched prefill for same-bucket admissions. The batch
@@ -445,12 +714,20 @@ class ServingEngine:
         self._lengths_dirty = True
         self.metrics.count("prefill_calls")
         self.metrics.count("prefill_prompts", B_real)
+        self.metrics.count("prefill_forward_tokens", int(sum(plens)))
+        for slot, req in zip(slots, reqs):
+            req.prefill_chunks = 1
+            if self._prefix_on:
+                self._index.insert(slot, req.prompt)
 
     def _reap_cancelled(self):
         for slot in np.nonzero(self._active)[0]:
             req = self._slot_req[slot]
             if req is not None and req.cancelled:
                 self._evict(slot, failed="cancelled")
+        for st in list(self._prefilling):
+            if st.req.cancelled:
+                self._drop_pending(st, "cancelled")
 
     def _reap_expired(self):
         """Per-request deadline (ServingConfig.request_deadline_s):
@@ -472,6 +749,15 @@ class ServingEngine:
                             f"(deadline {self._deadline_s:.1f}s, "
                             f"{len(req.generated)} tokens generated)"),
                     kind="deadline")
+        for st in list(self._prefilling):
+            if now - st.req.submit_time > self._deadline_s:
+                self._drop_pending(
+                    st,
+                    f"deadline exceeded after "
+                    f"{now - st.req.submit_time:.1f}s "
+                    f"(deadline {self._deadline_s:.1f}s, "
+                    f"{st.pos} prompt tokens prefilled)",
+                    kind="deadline")
         expired = self.scheduler.drop_expired(self._deadline_s, now)
         if expired:
             self.metrics.count("requests_expired", len(expired))
@@ -481,10 +767,32 @@ class ServingEngine:
         req = self._slot_req[slot]
         self._slot_req[slot] = None
         self._active[slot] = False
-        self._lengths[slot] = 0  # inactive rows park at position 0
         self._lengths_dirty = True  # device copy re-parks at next step
         self._sampling_dirty = True
-        self.pool.release(slot)
+        if failed is None and self._prefix_on:
+            # prefix cache: RETAIN the finished slot's KV for reuse
+            # instead of freeing it, and index the full sequence the
+            # region now holds (prompt + generated — the decode loop
+            # wrote every generated token's KV, EOS included, before
+            # this eviction). CRITICAL: the slot PARKS AT ITS FINAL
+            # LENGTH, not 0 — inactive rows still ride every decode
+            # step and write a garbage token at their position, so
+            # parking at 0 would clobber the retained prefix's first
+            # entry. At >= final length the writes land past every
+            # cloneable prefix: a clone is capped at the NEW prompt's
+            # len-1 <= max_len-2, while idle writes sit at
+            # final..max_len-1 (the decode clamp).
+            # index BEFORE retain(): with retained_slots=0 (or any
+            # overflow that demotes this very slot) retain() fires
+            # on_reclaim -> _index.remove(slot) for the demoted slot —
+            # inserting after would resurrect a stale entry over a
+            # free-listed slot, and free-list alloc() never reclaims.
+            self._index.insert(slot, req.prompt + req.generated)
+            self.pool.retain(slot)
+        else:
+            self._lengths[slot] = 0  # inactive rows park at position 0
+            self.pool.release(slot)
+            self._index.remove(slot)
         if failed is not None:
             req.fail(failed, kind=kind)
             self.metrics.count("requests_expired" if kind == "deadline"
@@ -523,8 +831,9 @@ class ServingEngine:
             self.metrics.count("sampling_uploads")
         if self._lengths_dirty or not self._active.all():
             # churn re-syncs positions from the host truth; partially
-            # active grids also re-park idle rows at 0 each window so
-            # their device-side drift stays bounded by K
+            # active grids also re-park idle rows each window (at 0 for
+            # hard-freed slots, at their final length for retained
+            # ones) so their device-side drift stays bounded by K
             self._d_lengths = jnp.asarray(self._lengths)
             self._lengths_dirty = False
         tok_steps, lp_steps = [], []

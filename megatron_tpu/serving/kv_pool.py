@@ -11,10 +11,19 @@ to a free slot, prefill writes the prompt's KV into the region via
 list with no copying — the next request simply overwrites it (stale
 entries past a row's offset are invisible to the causal mask and are
 overwritten write-before-read during decode).
+
+Prefix-cache support (SGLang's RadixAttention, slot-grid native): a
+finished slot can be RETAINED instead of freed — its KV stays resident
+on an LRU list and is reclaimed lazily, only when admission needs a
+slot (`retain`/`touch`/`alloc`). A request whose prompt shares a prefix
+with a retained (or still-running) slot reuses the prefix KV through
+ONE on-device region copy — `clone_prefix` / `slice_slot` — instead of
+re-running L forward layers over the shared tokens.
 """
 from __future__ import annotations
 
-from typing import List
+import collections
+from typing import Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -53,15 +62,70 @@ def insert_prefill(pool: KVCache, prefill: KVCache, slot, plen) -> KVCache:
     return new
 
 
+def slice_slot(pool: KVCache, slot, offset) -> KVCache:
+    """Extract `slot`'s whole cap-region as a batch-1 cache positioned
+    at `offset` (both traced scalars — one compile serves every slot).
+
+    The inverse of `insert_prefill`: the copy spans the full region, so
+    tokens past `offset` (the source's own continuation, or stale
+    garbage) ride along — they sit beyond the returned cache's offset,
+    where the causal mask never reads them and appends overwrite them
+    write-before-read, the same invariant bucket-padded prefill relies
+    on. int8 pools copy quantized blocks + scales verbatim."""
+    ds = jax.lax.dynamic_slice
+    L, _, cap, nkv, hd = pool.k.shape
+    zero = jnp.int32(0)
+    slot = jnp.asarray(slot, jnp.int32)
+    start5 = (zero, slot, zero, zero, zero)
+    return KVCache(
+        k=ds(pool.k, start5, (L, 1, cap, nkv, hd)),
+        v=ds(pool.v, start5, (L, 1, cap, nkv, hd)),
+        offset=jnp.full((L,), offset, jnp.int32),
+        k_scale=(None if pool.k_scale is None
+                 else ds(pool.k_scale, start5, (L, 1, cap, nkv, 1))),
+        v_scale=(None if pool.v_scale is None
+                 else ds(pool.v_scale, start5, (L, 1, cap, nkv, 1))),
+    )
+
+
+def clone_prefix(pool: KVCache, src_slot, dst_slot, plen) -> KVCache:
+    """Copy `src_slot`'s region into `dst_slot` and mark the first
+    `plen` tokens live — the prefix-cache hit primitive: one on-device
+    region copy replaces L forward layers over the shared prefix.
+
+    Pure/jittable; all three scalars are traced, so one compile serves
+    every (src, dst, plen) triple. Copies k/v (and int8 scales)
+    VERBATIM — a cloned prefix is bit-identical to the source's, which
+    is what the token-exact cache-on-vs-off contract requires. Only
+    defined for contiguous (non-ROLLING) pools: a rolling region holds
+    the last W positions ring-ordered by the SOURCE's length, so the
+    prefix [0, plen) may already be evicted —
+    `ServingConfig.validate` / the engine exclude rolling pools.
+
+    The engine's admission path runs this decomposed around the suffix
+    forward (`slice_slot` → append suffix KV → `insert_prefill`), which
+    is the same two region copies fused with the prefill."""
+    return insert_prefill(pool, slice_slot(pool, src_slot, plen),
+                          dst_slot, plen)
+
+
 class SlotKVPool:
     """Pre-allocated slot-grid cache + host-side free-slot bookkeeping.
 
     `caches` is the live device pytree ([L, S, cap, nkv, hd] with
     per-slot offsets [L, S]); the engine replaces it functionally every
-    step. Slot alloc/release runs only on the engine thread."""
+    step. Slot alloc/release runs only on the engine thread.
+
+    Lazy eviction (prefix cache): `retain(slot)` parks a finished
+    slot's KV on an LRU "retained" list instead of the free list; it
+    stays clone-able until `alloc` actually needs the slot (free list
+    first, then oldest retained). `retained_limit` caps the list (None
+    = every finished slot retains); `on_reclaim(slot)` fires whenever a
+    retained slot's KV is about to be overwritten so the engine can
+    drop its prefix-index entries."""
 
     def __init__(self, cfg: ModelConfig, num_slots: int, max_len: int,
-                 dtype=jnp.bfloat16):
+                 dtype=jnp.bfloat16, retained_limit: Optional[int] = None):
         assert num_slots >= 1, num_slots
         self.cfg = cfg
         self.num_slots = num_slots
@@ -74,6 +138,12 @@ class SlotKVPool:
                         and self.cap == cfg.sliding_window
                         and self.cap < max_len)
         self._free: List[int] = list(range(num_slots))
+        # retained slots, oldest first (OrderedDict as an LRU: touch
+        # moves to the end, reclaim pops from the front)
+        self._retained: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        self.retained_limit = retained_limit
+        self.on_reclaim: Optional[Callable[[int], None]] = None
 
     def make_prefill_caches(self, batch: int = 1) -> KVCache:
         """A fresh request-local cache in the POOL's layout (same cap /
@@ -83,18 +153,62 @@ class SlotKVPool:
                               dtype=self.dtype)
 
     # ---- slot bookkeeping (engine thread only) -----------------------
-    def alloc(self) -> int:
-        return self._free.pop(0)
+    def alloc(self, exclude=()) -> Optional[int]:
+        """Allocate a slot: free list first, then reclaim the
+        least-recently-used retained slot (its KV is about to be
+        overwritten — `on_reclaim` fires so the index can forget it).
+        `exclude` protects slots that must survive this allocation
+        (the source of a prefix clone in the same admission cycle);
+        returns None when nothing outside `exclude` is allocatable."""
+        if self._free:
+            return self._free.pop(0)
+        for slot in list(self._retained):
+            if slot not in exclude:
+                del self._retained[slot]
+                self._reclaim(slot)
+                return slot
+        return None
+
+    def retain(self, slot: int):
+        """Finished request: keep the slot's KV for prefix reuse. The
+        slot moves to the retained LRU (most-recent end); if that
+        overflows `retained_limit`, the OLDEST retained slot is
+        demoted to the free list (and reclaimed for the index)."""
+        assert slot not in self._free and slot not in self._retained, (
+            f"retain of non-busy slot {slot}")
+        self._retained[slot] = None
+        if (self.retained_limit is not None
+                and len(self._retained) > max(self.retained_limit, 0)):
+            old, _ = self._retained.popitem(last=False)
+            self._reclaim(old)
+            self._free.append(old)
+
+    def touch(self, slot: int):
+        """A prefix hit read `slot`'s KV — refresh its LRU position
+        (no-op for running slots, which are not on the retained list)."""
+        if slot in self._retained:
+            self._retained.move_to_end(slot)
+
+    def _reclaim(self, slot: int):
+        if self.on_reclaim is not None:
+            self.on_reclaim(slot)
 
     def release(self, slot: int):
+        """Hard free (error/cancel eviction): the KV is NOT indexed for
+        reuse — the engine drops any index entries itself."""
         assert slot not in self._free, f"double free of slot {slot}"
+        self._retained.pop(slot, None)
         self._free.append(slot)
 
     def free_count(self) -> int:
-        return len(self._free)
+        """Allocatable slots: truly free + lazily-evictable retained."""
+        return len(self._free) + len(self._retained)
+
+    def retained_count(self) -> int:
+        return len(self._retained)
 
     def used_count(self) -> int:
-        return self.num_slots - len(self._free)
+        return self.num_slots - self.free_count()
 
     def nbytes(self) -> int:
         n = self.caches.k.nbytes + self.caches.v.nbytes

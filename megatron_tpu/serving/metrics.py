@@ -15,11 +15,34 @@ from typing import Deque, Dict, Optional, Tuple
 
 
 def _percentile(sorted_vals, q: float) -> float:
-    """Nearest-rank percentile over an already-sorted list."""
-    if not sorted_vals:
+    """Nearest-rank percentile over an already-sorted sequence. Total
+    on degenerate input: an empty window (a /metrics scrape before the
+    first request) returns 0.0, and q is clamped into [0, 1] so a
+    caller typo can never index out of range."""
+    vals = list(sorted_vals)
+    if not vals:
         return 0.0
-    idx = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
-    return sorted_vals[idx]
+    idx = min(len(vals) - 1, max(0, int(q * len(vals))))
+    return vals[idx]
+
+
+# counters a snapshot always carries (as 0.0 before any traffic):
+# scrapers and the bench tools key on these without .get() guards, and
+# a /metrics scrape of a fresh engine must look like an idle engine,
+# not a different schema
+_BASE_COUNTERS = (
+    "requests_received", "requests_admitted", "requests_completed",
+    "requests_rejected", "requests_cancelled", "requests_expired",
+    "tokens_generated", "decode_steps", "host_syncs",
+    "wasted_decode_steps", "sampling_uploads",
+    "prefill_calls", "prefill_prompts",
+    # prefix cache / chunked prefill (docs/serving.md):
+    # prefix_hit_tokens counts tokens MATCHED at lookup (including
+    # hits forfeited to slot pressure); prefill_tokens_saved counts
+    # tokens whose forward was actually replaced by a region clone
+    "prefix_hits", "prefix_hit_tokens", "prefill_tokens_saved",
+    "prefill_chunks", "prefill_forward_tokens",
+)
 
 
 class ServingMetrics:
@@ -101,7 +124,8 @@ class ServingMetrics:
             gauges = {"queue_depth": float(self.queue_depth),
                       "active_slots": float(self.active_slots),
                       "num_slots": float(self.num_slots)}
-        out = {k: float(v) for k, v in counters.items()}
+        out = {k: 0.0 for k in _BASE_COUNTERS}
+        out.update({k: float(v) for k, v in counters.items()})
         out.update(gauges)
         out.update({
             "ttft_p50_ms": _percentile(ttft, 0.50) * 1e3,

@@ -1,0 +1,111 @@
+"""Host-side radix index for prefix-cache KV reuse.
+
+SGLang's RadixAttention keeps a token-level radix tree over every
+cached sequence and matches new prompts against it character by
+character. The slot-grid formulation here is coarser on purpose: keys
+are BLOCKS of `granularity` tokens (the engine passes its
+`prefill_bucket`), because a prefix hit only pays off when the suffix
+forward still lands in an existing jit-cache bucket — a hit at an
+unaligned length would buy one region copy and spend a fresh XLA
+compile. Matching at bucket granularity keeps the set of suffix shapes
+identical to the no-cache engine's.
+
+The index maps block-paths to SLOTS (running or retained — see
+SlotKVPool.retain): every slot registers on each node along its
+sequence's path, so a node's slot set is exactly the set of slots whose
+cached KV covers that node's prefix, and the deepest non-empty node on
+a prompt's path gives the longest reusable prefix in one walk.
+`lookup` prefers the most recently indexed slot at the deepest node
+(ties go to the warmest KV). All methods run on the engine thread only
+— no locking.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class _Node:
+    __slots__ = ("children", "slots")
+
+    def __init__(self):
+        self.children: Dict[tuple, "_Node"] = {}
+        # slot -> None; insertion-ordered so the most recently indexed
+        # slot sits at the end (lookup's tie-break)
+        self.slots: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+
+
+class PrefixIndex:
+    """Block-granular radix/trie over the token sequences resident in
+    KV-pool slots. `granularity` is the engine's prefill bucket: only
+    whole blocks are indexed, so matches are always bucket-aligned."""
+
+    def __init__(self, granularity: int):
+        assert granularity >= 1, granularity
+        self.granularity = granularity
+        self._root = _Node()
+        self._blocks: Dict[int, List[tuple]] = {}  # slot -> block path
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def insert(self, slot: int, tokens: Sequence[int]):
+        """(Re)index `slot` as holding valid KV for `tokens[0:len)`.
+        Called at admission (the prompt) and again at retain time (the
+        prompt + generated tokens, which the decode loop has already
+        written into the region). Re-inserting replaces the old path."""
+        self.remove(slot)
+        g = self.granularity
+        n_blocks = len(tokens) // g
+        blocks = [tuple(tokens[i * g:(i + 1) * g])
+                  for i in range(n_blocks)]
+        node = self._root
+        for b in blocks:
+            node = node.children.setdefault(b, _Node())
+            node.slots[slot] = None
+        self._blocks[slot] = blocks
+
+    def remove(self, slot: int):
+        """Forget `slot` (its region is about to be overwritten — wired
+        to SlotKVPool.on_reclaim — or its request failed). Unindexed
+        slots are a no-op, so callers need not track membership."""
+        blocks = self._blocks.pop(slot, None)
+        if not blocks:
+            return
+        path = [self._root]
+        node = self._root
+        for b in blocks:
+            node = node.children.get(b)
+            if node is None:  # defensive: partial path can't happen
+                break
+            node.slots.pop(slot, None)
+            path.append(node)
+        # prune now-empty tail nodes (a node with no slots has an empty
+        # subtree: every indexed slot registers on its whole path)
+        for parent, b, child in reversed(
+                list(zip(path[:-1], blocks, path[1:]))):
+            if not child.slots and not child.children:
+                del parent.children[b]
+
+    def lookup(self, tokens: Sequence[int],
+               max_tokens: Optional[int] = None
+               ) -> Tuple[Optional[int], int]:
+        """Longest bucket-aligned prefix of `tokens` held by an indexed
+        slot, capped at `max_tokens` (the engine passes len(prompt)-1:
+        at least one suffix token must forward to produce sampling
+        logits). Returns (slot, matched_len) or (None, 0)."""
+        g = self.granularity
+        limit = len(tokens) if max_tokens is None else max_tokens
+        node = self._root
+        best: Tuple[Optional[int], int] = (None, 0)
+        depth = 0
+        while (depth + 1) * g <= limit:
+            child = node.children.get(
+                tuple(tokens[depth * g:(depth + 1) * g]))
+            if child is None or not child.slots:
+                break
+            depth += 1
+            node = child
+            best = (next(reversed(node.slots)), depth * g)
+        return best

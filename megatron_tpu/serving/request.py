@@ -76,6 +76,13 @@ class GenRequest:
         self.finish_time: Optional[float] = None
         self._done = threading.Event()
         self.cancelled = False
+        # prefix-cache bookkeeping (engine thread): tokens whose KV was
+        # reused through a region clone instead of a forward pass, and
+        # the number of prefill chunks the prompt's forward was split
+        # into (1 = monolithic). Observability only — correctness is
+        # pinned by the token-exact cache-on/off tests.
+        self.prefix_len = 0
+        self.prefill_chunks = 0
 
     def cancel(self):
         """Best-effort: a QUEUED request is dropped before admission; a

@@ -77,21 +77,22 @@ class FIFOScheduler:
                 out.append(req)
         return out
 
-    def pop_ready_grouped(self, n: int, bucket_fn,
-                          max_group: int) -> list:
-        """`pop_ready(n)` coalesced into same-bucket groups of at most
-        `max_group` for batched prefill (engine loop only). Returns
+    @staticmethod
+    def group_by_bucket(reqs: List[GenRequest], bucket_fn,
+                        max_group: int) -> list:
+        """Coalesce already-popped requests into same-bucket groups of
+        at most `max_group` for batched prefill. Returns
         [(bucket, [requests])] — groups ordered by each bucket's first
-        arrival, FIFO within a group. Everything popped is admitted
-        this cycle (all callers get slots), so coalescing across the
-        FIFO never starves a request."""
+        arrival, FIFO within a group. The engine partitions a pop into
+        prefix-hit / chunked singles and groupable misses first, so
+        grouping is exposed separately from the pop."""
         groups: dict = {}
-        for req in self.pop_ready(n):
+        for req in reqs:
             groups.setdefault(bucket_fn(req), []).append(req)
         out = []
-        for bucket, reqs in groups.items():
-            for i in range(0, len(reqs), max(max_group, 1)):
-                out.append((bucket, reqs[i:i + max(max_group, 1)]))
+        for bucket, rs in groups.items():
+            for i in range(0, len(rs), max(max_group, 1)):
+                out.append((bucket, rs[i:i + max(max_group, 1)]))
         return out
 
     def cancel(self, req: GenRequest) -> bool:

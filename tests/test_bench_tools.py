@@ -107,6 +107,36 @@ def test_serving_bench_emits_record(monkeypatch, tmp_path):
     assert rec["decode_steps"] >= 6  # 6 requests interleaved on 2 slots
 
 
+def test_bench_prefix_emits_ab_record(monkeypatch, tmp_path):
+    """The shared-prefix A/B must show the cache-on arm reusing prefix
+    tokens (hits > 0, saved > 0) and forwarding strictly fewer REAL
+    prefill tokens than the cache-off arm, with all arms token-exact
+    (the tool asserts arm agreement itself and exits nonzero on
+    divergence)."""
+    import json
+    text = run_tool(
+        monkeypatch, tmp_path, "bench_prefix.py",
+        ["--requests", "5", "--shared", "32", "--unique", "8",
+         "--slots", "3", "--new", "4", "--chunk", "16",
+         "--layers", "2", "--hidden", "64", "--heads", "4",
+         "--vocab", "128", "--seq", "128"])
+    rec = json.loads(text)
+    assert rec["bench"] == "prefix_cache"
+    base, pref, chnk = (rec["baseline"], rec["prefix"],
+                        rec["prefix_chunked"])
+    assert base["prefix_hits"] == 0
+    assert base["prefill_tokens_saved"] == 0
+    # the warmup request seeds the retained prefix, so the burst is
+    # guaranteed at least one deterministic hit
+    assert pref["prefix_hits"] >= 1
+    assert pref["prefill_tokens_saved"] >= 32
+    assert pref["prefill_forward_tokens"] < base["prefill_forward_tokens"]
+    assert rec["forward_token_reduction_x"] > 1.0
+    # the chunked arm splits prefills without losing the cache win
+    assert chnk["prefill_chunks"] > pref["prefill_chunks"]
+    assert chnk["prefill_tokens_saved"] >= 32
+
+
 def test_bench_sync_emits_cadence_record(monkeypatch, tmp_path):
     """The host-sync cadence A/B must show the async window fetching
     fewer times than per-step and the K-window serving arm syncing at

@@ -23,9 +23,11 @@ import pytest
 from megatron_tpu.config import ModelConfig, ServingConfig
 from megatron_tpu.inference import Generator, SamplingParams
 from megatron_tpu.models import language_model as lm
-from megatron_tpu.serving import (AdmissionError, GenRequest, QueueFullError,
-                                  SamplingOptions, ServingEngine,
-                                  ServingMetrics, SlotKVPool)
+from megatron_tpu.serving import (AdmissionError, GenRequest, PrefixIndex,
+                                  QueueFullError, RequestState,
+                                  SamplingOptions, ServiceUnavailableError,
+                                  ServingEngine, ServingMetrics, SlotKVPool,
+                                  clone_prefix)
 
 
 def tiny_cfg(**overrides):
@@ -659,6 +661,563 @@ class TestDecodeSyncCadence:
             want_toks, want_lens, _ = gen.generate(
                 [p], 4, sampling=SamplingParams(temperature=0.0))
             assert toks == want_toks[0, :want_lens[0]].tolist()
+
+
+class TestPrefixIndex:
+    """Host-side radix index: bucket-aligned longest match, recency
+    tie-break, tolerant removal with tail pruning."""
+
+    def test_longest_aligned_match(self):
+        idx = PrefixIndex(4)
+        idx.insert(0, list(range(12)))
+        # uncapped: the whole 3-block sequence matches
+        assert idx.lookup(list(range(12))) == (0, 12)
+        # capped at 11 (the engine's len(prompt)-1): 2 blocks
+        assert idx.lookup(list(range(12)), max_tokens=11) == (0, 8)
+        # diverging after the first block matches exactly one block
+        assert idx.lookup(list(range(4)) + [99, 98, 97, 96]) == (0, 4)
+        # diverging inside the first block matches nothing
+        assert idx.lookup([99] + list(range(1, 12))) == (None, 0)
+
+    def test_most_recent_wins_remove_prunes(self):
+        idx = PrefixIndex(2)
+        idx.insert(1, [1, 2, 3, 4])
+        idx.insert(2, [1, 2, 3, 4])
+        assert idx.lookup([1, 2, 3, 4])[0] == 2  # warmest KV wins
+        idx.remove(2)
+        assert idx.lookup([1, 2, 3, 4]) == (1, 4)
+        idx.remove(1)
+        idx.remove(1)  # removal is tolerant (on_reclaim may repeat)
+        assert idx.lookup([1, 2, 3, 4]) == (None, 0)
+        assert len(idx) == 0 and not idx._root.children  # pruned
+
+    def test_reinsert_replaces_path(self):
+        idx = PrefixIndex(2)
+        idx.insert(3, [1, 2, 3, 4])
+        idx.insert(3, [5, 6, 7, 8])  # retain-time extension/replace
+        assert idx.lookup([1, 2, 3, 4]) == (None, 0)
+        assert idx.lookup([5, 6, 7, 8]) == (3, 4)
+
+    def test_sub_block_sequences_not_indexed(self):
+        idx = PrefixIndex(8)
+        idx.insert(0, [1, 2, 3])  # shorter than one block
+        assert idx.lookup([1, 2, 3, 4, 5, 6, 7, 8]) == (None, 0)
+
+
+class TestRetainedPool:
+    """Lazy slot eviction: finished slots keep their KV on an LRU
+    retained list; admission reclaims them only when it must."""
+
+    def test_retain_lru_and_lazy_reclaim(self, tiny_model):
+        _, cfg = tiny_model
+        pool = SlotKVPool(cfg, 3, 64)
+        reclaimed = []
+        pool.on_reclaim = reclaimed.append
+        a, b, c = pool.alloc(), pool.alloc(), pool.alloc()
+        pool.retain(a)
+        pool.retain(b)
+        assert pool.free_count() == 2 and pool.retained_count() == 2
+        pool.touch(a)  # a is now most recently used
+        assert pool.alloc() == b and reclaimed == [b]  # LRU goes first
+        # `exclude` protects the clone source of the same admission
+        assert pool.alloc(exclude=(a,)) is None
+        assert pool.alloc() == a and reclaimed == [b, a]
+        pool.release(c)
+        assert pool.alloc() == c  # free list beats retained
+
+    def test_retained_limit_demotes_oldest(self, tiny_model):
+        _, cfg = tiny_model
+        pool = SlotKVPool(cfg, 3, 64, retained_limit=1)
+        reclaimed = []
+        pool.on_reclaim = reclaimed.append
+        a, b, _ = pool.alloc(), pool.alloc(), pool.alloc()
+        pool.retain(a)
+        pool.retain(b)
+        assert reclaimed == [a] and pool.retained_count() == 1
+        assert pool.alloc() == a  # demoted to the free list
+
+    def test_clone_prefix_copies_verbatim(self, tiny_model):
+        """The prefix-hit primitive copies k/v (and int8 scales)
+        bit-identically and leaves the source untouched."""
+        _, cfg = tiny_model
+        rs = np.random.RandomState(0)
+
+        def rnd(x):
+            if x is None:
+                return None
+            if x.dtype == jnp.int8:
+                return jnp.asarray(
+                    rs.randint(-127, 128, x.shape), jnp.int8)
+            return jnp.asarray(rs.randn(*x.shape), x.dtype)
+
+        for dtype in (jnp.bfloat16, jnp.int8):
+            pool = SlotKVPool(cfg, 2, 32, dtype=dtype)
+            caches = pool.caches._replace(
+                k=rnd(pool.caches.k), v=rnd(pool.caches.v),
+                k_scale=rnd(pool.caches.k_scale),
+                v_scale=rnd(pool.caches.v_scale))
+            out = clone_prefix(caches, 0, 1, 5)
+            for name in ("k", "v", "k_scale", "v_scale"):
+                src = getattr(caches, name)
+                if src is None:
+                    continue
+                got = np.asarray(getattr(out, name))
+                # dst region == src region (whole cap, verbatim) and
+                # the source region is untouched
+                np.testing.assert_array_equal(
+                    got[:, 1], np.asarray(src)[:, 0], err_msg=name)
+                np.testing.assert_array_equal(
+                    got[:, 0], np.asarray(src)[:, 0], err_msg=name)
+            off = np.asarray(out.offset)
+            assert (off[:, 1] == 5).all() and (off[:, 0] == 0).all()
+
+
+class TestPrefixCacheEngine:
+    """Tentpole acceptance: seeded generation is token-exact with the
+    prefix cache on vs off (bf16 AND int8 pools), and a shared-prefix
+    workload forwards strictly fewer prefill tokens with the cache on
+    (counted through the prefill_forward_tokens seam, not wall-clock)."""
+
+    SHARED = list(range(5, 21))  # one full 16-token bucket
+
+    def _jobs(self):
+        return [(self.SHARED + [70 + i, 80 + i], 300 + i)
+                for i in range(4)]
+
+    def _run(self, gen, serving):
+        outs = []
+        with ServingEngine(gen, serving) as eng:
+            for p, s in self._jobs():  # sequential => deterministic hits
+                outs.append(eng.generate(
+                    p, 8, SamplingOptions(temperature=0.9, top_k=5),
+                    seed=s, timeout=300)[0])
+            snap = eng.metrics.snapshot()
+        return outs, snap
+
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_token_exact_on_vs_off_and_tokens_saved(self, tiny_model,
+                                                    kv_dtype):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0,
+                        kv_cache_dtype=(jnp.int8 if kv_dtype else
+                                        jnp.bfloat16))
+        base = dict(num_slots=3, max_queue=16, max_len=64)
+        off_outs, off_snap = self._run(gen, ServingConfig(**base))
+        on_outs, on_snap = self._run(
+            gen, ServingConfig(enable_prefix_cache=True, **base))
+        assert on_outs == off_outs  # bit-exact cache on vs off
+        for (p, s), toks in zip(self._jobs(), on_outs):  # ... and serial
+            want_toks, want_lens, _ = gen.generate(
+                [p], 8, sampling=SamplingParams(temperature=0.9,
+                                                top_k=5), seed=s)
+            assert toks == want_toks[0, :want_lens[0]].tolist(), (p, s)
+        # every request after the first hits the 16-token bucket prefix
+        assert on_snap["prefix_hits"] == 3
+        assert on_snap["prefix_hit_tokens"] == 48
+        assert on_snap["prefill_tokens_saved"] == 48
+        assert off_snap["prefill_tokens_saved"] == 0
+        # the seam: strictly fewer REAL tokens through prefill forwards
+        assert (on_snap["prefill_forward_tokens"]
+                == off_snap["prefill_forward_tokens"] - 48 > 0)
+
+    def test_hit_on_running_slot(self, tiny_model):
+        """A prompt sharing a prefix with a STILL-DECODING request
+        clones from the running slot; both stay token-exact."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        with ServingEngine(gen, ServingConfig(
+                num_slots=3, max_queue=16, max_len=64,
+                enable_prefix_cache=True)) as eng:
+            long_req = eng.submit(self.SHARED + [90], 24,
+                                  SamplingOptions(temperature=0.8),
+                                  seed=7)
+            while not long_req.generated and not long_req.done():
+                time.sleep(0.005)
+            short = eng.submit(self.SHARED + [91, 92], 6,
+                               SamplingOptions(temperature=0.8), seed=8)
+            short_toks, _ = short.result(timeout=300)
+            long_toks, _ = long_req.result(timeout=300)
+            snap = eng.metrics.snapshot()
+        assert snap["prefix_hits"] >= 1 and short.prefix_len == 16
+        for p, s, got in (((self.SHARED + [90]), 7, long_toks),
+                          ((self.SHARED + [91, 92]), 8, short_toks)):
+            want_toks, want_lens, _ = gen.generate(
+                [p], 24 if s == 7 else 6,
+                sampling=SamplingParams(temperature=0.8), seed=s)
+            assert got == want_toks[0, :want_lens[0]].tolist(), (p, s)
+
+    def test_retained_slots_reclaimed_under_pressure(self, tiny_model):
+        """More distinct prompts than slots: retained slots are lazily
+        reclaimed for fresh admissions and everything stays exact."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        prompts = [[10 * i + j for j in range(1, 7)] for i in range(1, 7)]
+        with ServingEngine(gen, ServingConfig(
+                num_slots=2, max_queue=16, max_len=64,
+                enable_prefix_cache=True, retained_slots=1)) as eng:
+            reqs = [eng.submit(p, 4, SamplingOptions(temperature=0.0),
+                               seed=0) for p in prompts]
+            outs = [r.result(timeout=300)[0] for r in reqs]
+        for p, toks in zip(prompts, outs):
+            want_toks, want_lens, _ = gen.generate(
+                [p], 4, sampling=SamplingParams(temperature=0.0))
+            assert toks == want_toks[0, :want_lens[0]].tolist(), p
+
+    def test_forfeited_hit_counts_hit_tokens_not_saved(self,
+                                                       tiny_model):
+        """With 1 slot the clone source is the only allocatable slot:
+        the hit is forfeited (the slot is reclaimed as a plain slot) —
+        counted in prefix_hit_tokens but NOT prefill_tokens_saved, and
+        output stays exact."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        p2 = self.SHARED + [71, 81]
+        with ServingEngine(gen, ServingConfig(
+                num_slots=1, max_queue=8, max_len=64,
+                enable_prefix_cache=True)) as eng:
+            eng.generate(self.SHARED + [70, 80], 4,
+                         SamplingOptions(temperature=0.0), seed=0,
+                         timeout=300)
+            toks, _ = eng.generate(p2, 4,
+                                   SamplingOptions(temperature=0.0),
+                                   seed=0, timeout=300)
+            snap = eng.metrics.snapshot()
+        assert snap["prefix_hit_tokens"] == 16  # matched at lookup
+        assert snap["prefill_tokens_saved"] == 0  # ...but forfeited
+        assert snap["prefix_hits"] == 0
+        want_toks, want_lens, _ = gen.generate(
+            [p2], 4, sampling=SamplingParams(temperature=0.0))
+        assert toks == want_toks[0, :want_lens[0]].tolist()
+
+    def test_retained_slots_zero_no_stale_index(self, tiny_model):
+        """retained_slots=0: retain() demotes the finishing slot itself
+        straight to the free list, and the index entry must die WITH it
+        (retain fires on_reclaim for the demoted slot; free-list alloc
+        never does). An entry inserted after retain() would outlive the
+        demotion: an immediate repeat of the same prompt would 'hit' a
+        free-listed slot — a phantom clone source the pool no longer
+        guards (exclude= only protects the retained scan) — and inflate
+        the hit metrics. With nothing ever retained, every request must
+        be a miss."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        pa = self.SHARED + [70, 80]
+        pb = [50 - i for i in range(18)]  # different 16-token bucket
+        with ServingEngine(gen, ServingConfig(
+                num_slots=1, max_queue=8, max_len=64,
+                enable_prefix_cache=True, retained_slots=0)) as eng:
+            # pa twice back-to-back: the repeat would hit a stale entry
+            # (no intervening admission cleans it); then pb reuses the
+            # slot; then pa again after the reuse.
+            jobs = (pa, pa, pb, pa)
+            outs = [eng.generate(p, 4, SamplingOptions(temperature=0.0),
+                                 seed=0, timeout=300)[0] for p in jobs]
+            snap = eng.metrics.snapshot()
+        assert outs[0] == outs[1] == outs[3]  # repeats bit-identical
+        for p, toks in zip(jobs, outs):
+            want_toks, want_lens, _ = gen.generate(
+                [p], 4, sampling=SamplingParams(temperature=0.0))
+            assert toks == want_toks[0, :want_lens[0]].tolist(), p
+        # nothing retained and nothing running at each admission: every
+        # lookup must miss (a stale entry shows up as hits > 0 here)
+        assert snap["prefix_hits"] == 0
+        assert snap["prefix_hit_tokens"] == 0
+        assert snap["prefill_tokens_saved"] == 0
+
+    def test_flash_int8_pool_excluded_loudly(self):
+        """Flash-impl int8 pools can't honor the token-exact contract
+        (offset-0 flash prefill reads raw k/v, offset>0 continuations
+        read the dequantized cache) — rejected at validate() AND at
+        engine construction with the RESOLVED pool dtype."""
+        cfg = tiny_cfg(attention_impl="flash")
+        with pytest.raises(AssertionError, match="flash-impl int8"):
+            ServingConfig(max_len=64, kv_dtype="int8",
+                          enable_prefix_cache=True).validate(cfg)
+        with pytest.raises(AssertionError, match="flash-impl int8"):
+            ServingConfig(max_len=64, kv_dtype="int8",
+                          prefill_chunk=8).validate(cfg)
+        # dot-impl int8 stays supported (both paths read the cache)
+        ServingConfig(max_len=64, kv_dtype="int8",
+                      enable_prefix_cache=True).validate(tiny_cfg())
+        # kv_dtype=None inheriting an int8 Generator: engine catches it
+        params = lm.model_init(jax.random.PRNGKey(0), cfg)
+        gen = Generator(params, cfg, eos_id=0, pad_id=0,
+                        kv_cache_dtype=jnp.int8)
+        with pytest.raises(AssertionError, match="flash-impl int8"):
+            ServingEngine(gen, ServingConfig(max_len=64,
+                                             prefill_chunk=8),
+                          start=False)
+
+    def test_rolling_pool_excluded_loudly(self):
+        cfg = tiny_cfg(sliding_window=16, attention_impl="flash",
+                       seq_length=64, max_position_embeddings=64)
+        with pytest.raises(AssertionError, match="ROLLING"):
+            ServingConfig(max_len=64,
+                          enable_prefix_cache=True).validate(cfg)
+        with pytest.raises(AssertionError, match="ROLLING"):
+            ServingConfig(max_len=64, prefill_chunk=8).validate(cfg)
+        # non-rolling models validate fine
+        ServingConfig(max_len=64, enable_prefix_cache=True,
+                      prefill_chunk=8).validate(tiny_cfg())
+        # the engine enforces it even without validate()
+        params = lm.model_init(jax.random.PRNGKey(0), cfg)
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        with pytest.raises(AssertionError, match="ROLLING"):
+            ServingEngine(gen, ServingConfig(
+                max_len=64, enable_prefix_cache=True), start=False)
+
+
+class TestChunkedPrefill:
+    """Chunked prefill is a scheduling change, not a semantics change:
+    multi-chunk prompts are token-exact vs the monolithic prefill, and
+    decode steps for running slots interleave between chunks."""
+
+    def _long_prompts(self):
+        rs = np.random.RandomState(3)
+        return [rs.randint(1, 96, n).tolist() for n in (20, 33, 48)]
+
+    def test_chunked_token_exact_vs_unchunked_and_serial(self,
+                                                         tiny_model):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        base = dict(num_slots=3, max_queue=16, max_len=64)
+        outs = {}
+        for chunk in (None, 8):
+            with ServingEngine(gen, ServingConfig(
+                    prefill_chunk=chunk, **base)) as eng:
+                reqs = [eng.submit(p, 8,
+                                   SamplingOptions(temperature=0.9,
+                                                   top_k=5),
+                                   seed=50 + i)
+                        for i, p in enumerate(self._long_prompts())]
+                outs[chunk] = [r.result(timeout=300)[0] for r in reqs]
+                if chunk:
+                    snap = eng.metrics.snapshot()
+                    assert snap["prefill_chunks"] >= 3 + 5 + 6
+                    chunks = [r.prefill_chunks for r in reqs]
+                    assert chunks == [3, 5, 6]  # ceil(plen / 8)
+        assert outs[8] == outs[None]
+        for p, s, toks in zip(self._long_prompts(), (50, 51, 52),
+                              outs[8]):
+            want_toks, want_lens, _ = gen.generate(
+                [p], 8, sampling=SamplingParams(temperature=0.9,
+                                                top_k=5), seed=s)
+            assert toks == want_toks[0, :want_lens[0]].tolist(), (p, s)
+
+    def test_uniform_chunks_compile_once(self, tiny_model):
+        """Full chunks are a fixed shape: two multi-chunk prompts share
+        ONE chunk-forward trace (the tail pads to the same shape when
+        prefill_chunk <= prefill_bucket)."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        with ServingEngine(gen, ServingConfig(
+                num_slots=2, max_queue=8, max_len=64,
+                prefill_chunk=8)) as eng:
+            for i, p in enumerate(self._long_prompts()[:2]):
+                eng.generate(p, 4, SamplingOptions(temperature=0.0),
+                             seed=i, timeout=300)
+            assert eng._chunk_traces == 1
+            assert eng._decode_traces == 1
+
+    def test_decode_interleaves_between_chunks(self, tiny_model):
+        """The no-full-prompt-stall pin: while a long prompt prefills
+        chunk by chunk, the already-running slot keeps taking decode
+        steps between chunks."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        with ServingEngine(gen, ServingConfig(
+                num_slots=2, max_queue=8, max_len=64,
+                prefill_chunk=8)) as eng:
+            events = []
+            d, c = eng._decode, eng._chunk_fwd
+
+            def rec_decode(*a):
+                events.append("d")
+                return d(*a)
+
+            def rec_chunk(*a):
+                events.append("c")
+                return c(*a)
+
+            eng._decode, eng._chunk_fwd = rec_decode, rec_chunk
+            running = eng.submit([3, 4], 40,
+                                 SamplingOptions(temperature=0.8),
+                                 seed=1)
+            while not running.generated and not running.done():
+                time.sleep(0.005)
+            long_req = eng.submit(list(range(1, 41)), 4,
+                                  SamplingOptions(temperature=0.8),
+                                  seed=2)  # 40 tokens -> 5 chunks
+            long_req.result(timeout=300)
+            running.result(timeout=300)
+        chunk_idx = [i for i, e in enumerate(events) if e == "c"]
+        assert len(chunk_idx) >= 5
+        assert "d" in events[chunk_idx[0]:chunk_idx[-1]], (
+            "chunks ran back-to-back — the long prompt stalled the "
+            f"running request's decode: {events}")
+
+    def test_cancel_mid_chunk_releases_slot(self, tiny_model):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        eng = ServingEngine(gen, ServingConfig(
+            num_slots=1, max_queue=4, max_len=64, prefill_chunk=4),
+            start=False)
+        try:
+            r = eng.submit(list(range(1, 13)), 4)  # 12 tokens, 3 chunks
+            eng._admit()
+            assert len(eng._prefilling) == 1
+            assert eng.pool.free_count() == 0  # slot reserved
+            eng._advance_prefill()  # one chunk lands, 2 remain
+            assert eng._prefilling and eng._prefilling[0].pos == 4
+            r.cancel()
+            eng._reap_cancelled()
+            assert r.done() and not eng._prefilling
+            assert eng.pool.free_count() == 1
+            with pytest.raises(RuntimeError, match="cancelled"):
+                r.result(timeout=1)
+        finally:
+            eng.close()
+
+
+class TestPrefillBucketBoundaries:
+    """Satellite: prompt lengths straddling the prefill bucket
+    (bucket-1 / bucket / bucket+1) and a pow-2 batch-bucket pad row
+    stay token-exact vs serial generation."""
+
+    def test_bucket_edges_token_exact(self, engine):
+        gen, eng = engine
+        rs = np.random.RandomState(7)
+        bucket = eng.serving.prefill_bucket
+        for n in (bucket - 1, bucket, bucket + 1):
+            p = rs.randint(1, 96, n).tolist()
+            toks, _ = eng.generate(
+                p, 6, SamplingOptions(temperature=0.9, top_k=5),
+                seed=n, timeout=300)
+            want_toks, want_lens, _ = gen.generate(
+                [p], 6, sampling=SamplingParams(temperature=0.9,
+                                                top_k=5), seed=n)
+            assert toks == want_toks[0, :want_lens[0]].tolist(), n
+
+    def test_batch_bucket_pad_row(self, tiny_model):
+        """3 same-bucket admissions batch-bucket to a pow-2 B=4 with a
+        replicated pad row — one prefill call, request-exact rows."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        eng = ServingEngine(gen, ServingConfig(num_slots=3, max_queue=8,
+                                               max_len=64),
+                            start=False)
+        try:
+            reqs = [eng.submit(p, 4, SamplingOptions(temperature=0.0),
+                               seed=0) for p in PROMPTS[:3]]
+            eng._thread.start()
+            outs = [r.result(timeout=300)[0] for r in reqs]
+            snap = eng.metrics.snapshot()
+        finally:
+            eng.close()
+        assert snap["prefill_calls"] == 1  # one coalesced B=4 call
+        assert snap["prefill_prompts"] == 3
+        for p, toks in zip(PROMPTS[:3], outs):
+            want_toks, want_lens, _ = gen.generate(
+                [p], 4, sampling=SamplingParams(temperature=0.0))
+            assert toks == want_toks[0, :want_lens[0]].tolist(), p
+
+
+class TestDrainResolvesQueued:
+    """Satellite: drain() must RESOLVE requests that were admitted to
+    the scheduler but never given a slot — terminal 503, not a hung
+    future."""
+
+    def test_drain_fails_queued_as_503(self, tiny_model):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        eng = ServingEngine(gen, ServingConfig(num_slots=1, max_queue=8,
+                                               max_len=64), start=False)
+        r1 = eng.submit([1, 2], 4)
+        r2 = eng.submit([3, 4], 4)
+        assert eng.drain(timeout=5)  # nothing in flight -> immediate
+        for r in (r1, r2):
+            assert r.done(), "queued request left hanging by drain()"
+            with pytest.raises(ServiceUnavailableError):
+                r.result(timeout=1)
+        eng.close()
+
+    def test_drain_completes_running_fails_queued(self, tiny_model):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        eng = ServingEngine(gen, ServingConfig(num_slots=1, max_queue=8,
+                                               max_len=64))
+        running = eng.submit([5, 6, 7], 30,
+                             SamplingOptions(temperature=0.8), seed=1)
+        while running.state is not RequestState.RUNNING \
+                and not running.done():
+            time.sleep(0.005)
+        queued = eng.submit([8, 9], 4)  # 1 slot busy -> stays queued
+        assert eng.drain(timeout=120)
+        toks, _ = running.result(timeout=1)  # decoded to completion
+        assert len(running.generated) > 0
+        assert queued.done()
+        with pytest.raises(ServiceUnavailableError):
+            queued.result(timeout=1)
+        eng.close()
+
+    def test_drain_completes_mid_chunk_request(self, tiny_model):
+        """A request mid-chunked-prefill is in-flight work: drain waits
+        for it instead of hanging or dropping it."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        eng = ServingEngine(gen, ServingConfig(num_slots=2, max_queue=8,
+                                               max_len=64,
+                                               prefill_chunk=8))
+        r = eng.submit(list(range(1, 41)), 4,
+                       SamplingOptions(temperature=0.0), seed=1)
+        while r.state is not RequestState.RUNNING and not r.done():
+            time.sleep(0.002)
+        assert eng.drain(timeout=120)
+        toks, _ = r.result(timeout=1)
+        want_toks, want_lens, _ = gen.generate(
+            [list(range(1, 41))], 4,
+            sampling=SamplingParams(temperature=0.0))
+        assert toks == want_toks[0, :want_lens[0]].tolist()
+        eng.close()
+
+
+class TestMetricsHardening:
+    """Satellite: a /metrics scrape before the first request must not
+    raise — empty sample windows are total."""
+
+    def test_empty_snapshot_total_and_jsonable(self):
+        import json
+        snap = ServingMetrics().snapshot()
+        json.dumps(snap)  # scrape-able as-is
+        assert snap["requests_completed"] == 0.0
+        assert snap["tokens_generated"] == 0.0
+        assert snap["prefill_tokens_saved"] == 0.0
+        assert snap["prefix_hits"] == 0.0
+        assert snap["ttft_p50_ms"] == 0.0
+        assert snap["tokens_per_s"] == 0.0
+        assert snap["slot_occupancy"] == 0.0
+
+    def test_percentile_degenerate_inputs(self):
+        from megatron_tpu.serving.metrics import _percentile
+        assert _percentile([], 0.5) == 0.0
+        assert _percentile([], 0.0) == 0.0
+        assert _percentile([1.0], 2.0) == 1.0   # q clamped high
+        assert _percentile([1.0, 2.0], -0.5) == 1.0  # q clamped low
+
+    def test_fresh_server_metrics_scrape(self, tiny_model):
+        import json
+        from megatron_tpu.inference.server import MegatronServer
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        srv = MegatronServer(gen, FakeTokenizer(),
+                             serving=ServingConfig(num_slots=1,
+                                                   max_queue=2,
+                                                   max_len=32))
+        try:
+            snap = json.loads(json.dumps(srv.engine.metrics.snapshot()))
+            assert snap["requests_received"] == 0.0
+        finally:
+            srv.close()
 
 
 class TestSeeding:

@@ -1,0 +1,161 @@
+"""Prefix-cache + chunked-prefill A/B micro-bench.
+
+Drives the continuous-batching engine over a SHARED-PREFIX workload
+(the system-prompt / few-shot-template serving shape the prefix cache
+exists for) in three arms on the same seeded request set:
+
+- baseline: cache off, monolithic prefill;
+- prefix:   --enable_prefix_cache — hit-rate, prefix tokens reused,
+            REAL prefill forward tokens (the engine's
+            `prefill_forward_tokens` seam, not wall-clock);
+- chunked:  prefix cache + `prefill_chunk` — the Sarathi-Serve arm,
+            long-prompt prefill interleaved with decode.
+
+Reports per arm: hit rate, prefill tokens saved, prefill forward
+tokens, TTFT p50/p95, tokens/s. On CPU the times are a harness smoke;
+ON CHIP the forward-token delta is the prefill compute the cache
+removed and the TTFT delta is what chunking buys queued work.
+
+Emits ONE BENCH-style JSON record on stdout (and to --out), like the
+other bench tools; runs in the bench.py extras chain.
+
+  python tools/bench_prefix.py [--requests N] [--shared N] [--unique N]
+                               [--slots N] [--new N] [--chunk N]
+                               [--out FILE]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_tpu.utils.platform import ensure_env_platform
+
+
+def _build(args):
+    import jax
+    import numpy as np
+
+    from megatron_tpu.config import ModelConfig
+    from megatron_tpu.inference.generation import Generator
+    from megatron_tpu.models import language_model as lm
+
+    cfg = ModelConfig(
+        num_layers=args.layers, hidden_size=args.hidden,
+        num_attention_heads=args.heads,
+        num_kv_heads=max(args.heads // 2, 1), vocab_size=args.vocab,
+        seq_length=args.seq, max_position_embeddings=args.seq,
+        make_vocab_size_divisible_by=64,
+        compute_dtype="bfloat16").derived()
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    gen = Generator(params, cfg, eos_id=0, pad_id=0)
+    rs = np.random.RandomState(0)
+    shared = rs.randint(1, cfg.vocab_size, args.shared).tolist()
+    prompts = [shared + rs.randint(1, cfg.vocab_size,
+                                   args.unique).tolist()
+               for _ in range(args.requests)]
+    return gen, prompts
+
+
+def _run_arm(gen, prompts, args, *, prefix: bool, chunk) -> dict:
+    from megatron_tpu.config import ServingConfig
+    from megatron_tpu.serving import SamplingOptions, ServingEngine
+
+    serving = ServingConfig(
+        num_slots=args.slots, max_queue=max(len(prompts), 64),
+        enable_prefix_cache=prefix, prefill_chunk=chunk)
+    with ServingEngine(gen, serving) as eng:
+        # warmup: compile prefill/chunk buckets + the one decode trace
+        # (in the cache arms it also RETAINS the shared prefix, so the
+        # burst measures a warm cache — the steady-state serving shape)
+        eng.generate(prompts[0], 2, SamplingOptions(temperature=1.0),
+                     seed=0)
+        snap0 = eng.metrics.snapshot()  # counters exclude the warmup
+        t0 = time.monotonic()
+        reqs = [eng.submit(p, args.new,
+                           SamplingOptions(temperature=1.0), seed=i)
+                for i, p in enumerate(prompts)]
+        outs = [r.result(timeout=600)[0] for r in reqs]
+        wall = time.monotonic() - t0
+        snap = eng.metrics.snapshot()
+
+    def delta(k):
+        return int(snap[k] - snap0[k])
+
+    return {
+        "enable_prefix_cache": prefix,
+        "prefill_chunk": chunk,
+        "outputs": outs,  # popped before emit; arms must agree
+        "prefix_hits": delta("prefix_hits"),
+        "hit_rate": round(delta("prefix_hits") / max(len(prompts), 1),
+                          3),
+        "prefix_hit_tokens": delta("prefix_hit_tokens"),
+        "prefill_tokens_saved": delta("prefill_tokens_saved"),
+        "prefill_forward_tokens": delta("prefill_forward_tokens"),
+        "prefill_chunks": delta("prefill_chunks"),
+        # reservoir percentiles include the warmup's one sample (a
+        # deque can't be delta'd); 1-in-N noise, called out here
+        "ttft_p50_ms": round(snap["ttft_p50_ms"], 2),
+        "ttft_p95_ms": round(snap["ttft_p95_ms"], 2),
+        "tokens_per_s": round(delta("tokens_generated")
+                              / max(wall, 1e-9), 1),
+    }
+
+
+def main(argv=None):
+    ensure_env_platform()
+    p = argparse.ArgumentParser("bench_prefix", description=__doc__)
+    p.add_argument("--out", default="/tmp/bench_prefix.log")
+    p.add_argument("--requests", type=int, default=12)
+    p.add_argument("--shared", type=int, default=48,
+                   help="shared-prefix length (system prompt stand-in)")
+    p.add_argument("--unique", type=int, default=8,
+                   help="per-request unique suffix length")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--new", type=int, default=16)
+    p.add_argument("--chunk", type=int, default=16,
+                   help="prefill_chunk for the chunked arm")
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--seq", type=int, default=256)
+    args = p.parse_args(argv)
+
+    import jax
+    gen, prompts = _build(args)
+    base = _run_arm(gen, prompts, args, prefix=False, chunk=None)
+    pref = _run_arm(gen, prompts, args, prefix=True, chunk=None)
+    chnk = _run_arm(gen, prompts, args, prefix=True, chunk=args.chunk)
+    # the cache must be a scheduling change, not a semantics change —
+    # every arm replays the same seeded requests token-for-token
+    assert pref.pop("outputs") == base.pop("outputs") == \
+        chnk.pop("outputs"), "arms diverged: prefix cache is UNSOUND"
+
+    dev = jax.devices()[0]
+    record = {
+        "bench": "prefix_cache",
+        "device": getattr(dev, "device_kind", dev.platform),
+        "requests": args.requests,
+        "shared": args.shared,
+        "unique": args.unique,
+        "baseline": base,
+        "prefix": pref,
+        "prefix_chunked": chnk,
+        "forward_token_reduction_x": round(
+            base["prefill_forward_tokens"]
+            / max(pref["prefill_forward_tokens"], 1), 2),
+    }
+    line = json.dumps(record)
+    print(line, flush=True)
+    with open(args.out, "w") as f:
+        f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
