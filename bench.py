@@ -336,6 +336,13 @@ def _run_extras():
         # serving-side complement to bench_decode's single stream
         ("serving_bench.py", ["--requests", "32", "--slots", "8"],
          "/tmp/bench_extras_serving.log"),
+        # overload arm: offered load > slot capacity with deadlines +
+        # early shedding (docs/serving.md "Overload & failure
+        # behavior") — shed rate / goodput / p99 queue delay, the
+        # numbers an admission-control regression moves first
+        ("serving_bench.py", ["--overload", "--requests", "48",
+                              "--slots", "4", "--new", "16"],
+         "/tmp/bench_extras_serving_overload.log"),
         # host-sync cadence A/B (PERF_NOTES "batch K steps per sync"):
         # per-step vs per-window metrics fetch in the train loop, and
         # decode_sync_interval 1-vs-K in the engine — ON CHIP the
@@ -352,6 +359,13 @@ def _run_extras():
         # recovery-latency record makes regressions in the resilience
         # subsystem show up next to the perf numbers
         ("chaos_train.py", ["--smoke"], "/tmp/bench_extras_chaos.log"),
+        # serving chaos drill: overload + NaN slot + wedged iteration +
+        # crash loop through a REAL engine — asserts no stranded
+        # futures, watchdog-restart recovery, and the crash-loop
+        # circuit breaker (docs/serving.md "Overload & failure
+        # behavior"); the hang-recovery latency is the record
+        ("chaos_serve.py", ["--smoke"],
+         "/tmp/bench_extras_chaos_serve.log"),
         # corrupt-dataset detection smoke: inject truncated-.bin /
         # garbage-.idx / out-of-range-pointer faults, prove each raises
         # a typed DatasetCorruptionError at open (docs/resilience.md

@@ -270,6 +270,35 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                         "at most this many finished slots keep their "
                         "KV for reuse (None retains all; they are "
                         "reclaimed lazily when admission needs a slot)")
+    g.add_argument("--priority_levels", type=int, default=1,
+                   help="serving: distinct request priority classes — "
+                        "requests carry priority in [0, levels); "
+                        "higher wins admission ordering and (with "
+                        "--preemption) may evict lower-priority "
+                        "running slots (1 = all requests equal)")
+    g.add_argument("--shed_on_overload", action="store_true",
+                   help="serving: fail a new request at SUBMIT time "
+                        "(retryable 429 + Retry-After) when its "
+                        "estimated queue delay already exceeds its "
+                        "deadline, instead of queue-then-504 "
+                        "(docs/serving.md overload section)")
+    g.add_argument("--preemption", action="store_true",
+                   help="serving: a queued higher-priority request "
+                        "with no allocatable slot evicts the lowest-"
+                        "priority running slot; the victim's KV parks "
+                        "and it resumes token-exact later (unsupported "
+                        "on rolling / flash-int8 pools)")
+    g.add_argument("--max_engine_restarts", type=int, default=2,
+                   help="serving: supervisor loop restarts after a "
+                        "crashed/hung engine step before the crash-"
+                        "loop circuit breaker trips (engine goes "
+                        "unhealthy, submits 503)")
+    g.add_argument("--engine_step_timeout_s", type=float, default=None,
+                   help="serving: hung-iteration watchdog deadline — "
+                        "no engine-loop progress within this many "
+                        "seconds fails the in-flight requests and "
+                        "restarts the loop (None disables; must "
+                        "exceed the worst prefill compile time)")
 
     g = p.add_argument_group(
         "reference compat",
@@ -545,7 +574,12 @@ def config_from_args(args: argparse.Namespace,
             prefill_max_batch=args.prefill_max_batch,
             enable_prefix_cache=args.enable_prefix_cache,
             prefill_chunk=args.prefill_chunk,
-            retained_slots=args.retained_slots),
+            retained_slots=args.retained_slots,
+            priority_levels=args.priority_levels,
+            shed_on_overload=args.shed_on_overload,
+            preemption=args.preemption,
+            max_engine_restarts=args.max_engine_restarts,
+            engine_step_timeout_s=args.engine_step_timeout_s),
         resilience=ResilienceConfig(**{
             **_pick(args, ResilienceConfig),
             "checkpoint_integrity": not args.no_checkpoint_integrity}),

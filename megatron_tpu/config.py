@@ -423,6 +423,44 @@ class ServingConfig:
     # reclaimed lazily when admission needs a slot anyway, so the only
     # cost of None is colder free-list slots.
     retained_slots: Optional[int] = None
+    # --- overload & failure knobs (docs/serving.md "Overload &
+    # failure behavior") -----------------------------------------------
+    # distinct priority classes: requests carry priority in
+    # [0, priority_levels) (higher wins admission ordering and, with
+    # `preemption`, may evict lower-priority running slots). 1 = every
+    # request equal (the pre-SLO behavior).
+    priority_levels: int = 1
+    # early load shedding: when the estimated queue delay for a new
+    # request already exceeds its (per-request or engine-default)
+    # deadline, fail it at SUBMIT time with a retryable 429 +
+    # Retry-After instead of letting it burn its whole deadline in the
+    # queue and then 504. Only sheds once at least one completion has
+    # been observed (the estimate needs a service-time sample).
+    shed_on_overload: bool = False
+    # priority preemption: a queued higher-priority request with no
+    # allocatable slot evicts the lowest-priority running slot. The
+    # victim's KV is PARKED in a batch-1 sub-cache (slice_slot — the
+    # read half of clone_prefix) together with its carried logits and
+    # PRNG key, and it resumes later with one insert_prefill — no
+    # re-prefill, token-exact vs never-preempted, and the decode trace
+    # stays one compile (preemption is slot bookkeeping + two region
+    # copies, never a new program). Unsupported on ROLLING pools (the
+    # parked region's ring order is source-length-dependent) and
+    # flash-impl int8 pools (same exclusion as the prefix cache).
+    preemption: bool = False
+    # engine supervisor: a crashed engine-loop step fails only the
+    # slotted requests it must, requeues the rest, resets the device
+    # state and restarts the loop — up to this many times, after which
+    # the crash-loop circuit breaker trips (engine goes unhealthy,
+    # submits raise EngineUnhealthyError → HTTP 503, /healthz reports
+    # unhealthy). 0 = any crash trips the breaker immediately.
+    max_engine_restarts: int = 2
+    # hung-iteration watchdog (resilience/watchdog.py in detection-only
+    # mode): no engine-loop progress within this many seconds fails the
+    # in-flight requests (no stranded futures) and restarts the loop
+    # when the wedged dispatch returns. None disables. Must comfortably
+    # exceed the worst prefill-bucket compile time.
+    engine_step_timeout_s: Optional[float] = None
 
     def validate(self, model: Optional["ModelConfig"] = None
                  ) -> "ServingConfig":
@@ -435,6 +473,18 @@ class ServingConfig:
             self.prefill_chunk)
         assert self.retained_slots is None or self.retained_slots >= 0, (
             self.retained_slots)
+        assert self.priority_levels >= 1, self.priority_levels
+        # preemption triggers only when a QUEUED request outranks a
+        # RUNNING one; with a single priority class every request
+        # clamps to 0 and it can never fire — reject the silently
+        # inert combination instead of shipping a no-op knob
+        assert not (self.preemption and self.priority_levels < 2), (
+            "preemption requires priority_levels >= 2: with one "
+            "priority class every request clamps to priority 0 and "
+            "no arrival can ever outrank a running slot")
+        assert self.max_engine_restarts >= 0, self.max_engine_restarts
+        assert self.engine_step_timeout_s is None or \
+            self.engine_step_timeout_s > 0.0, self.engine_step_timeout_s
         if model is not None and model.sliding_window is not None:
             # ROLLING pools (flash impl caps the region to W < max_len)
             # hold the last W positions ring-ordered by the SOURCE's
@@ -455,6 +505,13 @@ class ServingConfig:
                 "(sliding-window) KV pools: an offset>0 chunk would "
                 "wrap the W-slot ring over history its own queries "
                 "still need. Serve this model unchunked.")
+            assert not (rolling and self.preemption), (
+                "preemption is unsupported on ROLLING (sliding-window) "
+                "KV pools: the parked region's W-slot ring is ordered "
+                "by the victim's length, so an insert-resume (or a "
+                "replay continuation at offset>0) could read "
+                "already-evicted positions. Serve this model without "
+                "preemption.")
         if (model is not None and model.attention_impl == "flash"
                 and self.kv_dtype == "int8"):
             # the flash impl's OFFSET-0 prefill reads the RAW k/v
@@ -466,13 +523,14 @@ class ServingConfig:
             # RESOLVED pool dtype, covering kv_dtype=None inheriting
             # an int8 Generator.)
             assert not (self.enable_prefix_cache
-                        or self.prefill_chunk is not None), (
-                "enable_prefix_cache/prefill_chunk are unsupported on "
-                "flash-impl int8 KV pools: the offset-0 flash prefill "
-                "reads raw k/v while offset>0 continuations read the "
-                "dequantized cache, so cache-on outputs would not be "
-                "token-exact vs cache-off. Use the dot impl or a "
-                "bf16/f32 pool.")
+                        or self.prefill_chunk is not None
+                        or self.preemption), (
+                "enable_prefix_cache/prefill_chunk/preemption are "
+                "unsupported on flash-impl int8 KV pools: the offset-0 "
+                "flash prefill reads raw k/v while offset>0 "
+                "continuations (and a preemption replay) read the "
+                "dequantized cache, so outputs would not be "
+                "token-exact. Use the dot impl or a bf16/f32 pool.")
         assert self.request_deadline_s is None or \
             self.request_deadline_s > 0.0, self.request_deadline_s
         assert self.kv_dtype is None or \

@@ -14,12 +14,22 @@ a long request no longer blocks every other caller. The reference's
 serial one-lock path is kept behind `ServingConfig(serial_fallback=
 True)` (and always serves beam search, which stays whole-batch). Proper
 HTTP statuses on BOTH transport backends: 400 for invalid payloads
-(shared validator), 429 when the bounded admission queue overflows (or
-the engine is draining for shutdown), 503 for queued work dropped by a
-drain, 504 when a request outlives
-`ServingConfig.request_deadline_s`, 500 for internal errors.
-`GET /metrics` exposes the ServingMetrics snapshot. SIGTERM drains
-gracefully: stop admitting, finish in-flight slots, then exit.
+(shared validator), 429 when the bounded admission queue overflows, the
+engine sheds on overload, or the engine is draining for shutdown, 503
+for queued work dropped by a drain and for an unhealthy engine (the
+supervisor's crash-loop circuit breaker tripped), 504 when a request
+outlives its effective deadline, 500 for internal errors. 429/503
+responses carry a `Retry-After` header and the current queue depth in
+the JSON body, so clients and load balancers can back off instead of
+hammering a saturated replica. `GET /metrics` exposes the
+ServingMetrics snapshot; `GET /healthz` is the separate liveness/
+readiness probe (engine loop alive, circuit-breaker state, slot
+occupancy, queue depth) — host-state reads only, so a wedged decode
+cannot wedge the probe. Payloads may carry `priority` (higher wins
+admission ordering and, with ServingConfig.preemption, may preempt
+running slots) and `deadline_s` (per-request SLO overriding
+request_deadline_s). SIGTERM drains gracefully: stop admitting, finish
+in-flight slots, then exit.
 
 The reference needs a rank-0 Flask thread that broadcasts a GENERATE/BEAM
 signal to all other ranks sitting in a receive loop
@@ -65,11 +75,12 @@ def validate_generate_payload(payload) -> Optional[str]:
         return "tokens_to_generate must be an integer"
     if n < 0:
         return "tokens_to_generate must be >= 0"
-    # sampling knobs must coerce cleanly — a list/dict/None here would
-    # otherwise surface as a 500 from deep inside the handler
+    # sampling + SLO knobs must coerce cleanly — a list/dict/None here
+    # would otherwise surface as a 500 from deep inside the handler
     for field, conv in (("temperature", float), ("top_k", int),
                         ("top_p", float), ("length_penalty", float),
-                        ("beam_width", int), ("random_seed", int)):
+                        ("beam_width", int), ("random_seed", int),
+                        ("priority", int), ("deadline_s", float)):
         v = payload.get(field)
         if v is None:
             continue
@@ -77,6 +88,15 @@ def validate_generate_payload(payload) -> Optional[str]:
             conv(v)
         except (TypeError, ValueError):
             return f"{field} must be a number"
+    if payload.get("deadline_s") is not None:
+        # json.loads happily parses NaN/Infinity; a NaN deadline would
+        # make every expiry comparison False (an unreapable request)
+        # AND poison the scheduler's sort key, scrambling EDF order
+        # for OTHER requests — reject at the boundary
+        import math as _math
+        d = float(payload["deadline_s"])
+        if not _math.isfinite(d) or d <= 0.0:
+            return "deadline_s must be a finite number > 0"
     if payload.get("beam_width") and len(prompts) > 1:
         # (ref: beam-search rejects multi-prompt requests)
         return "With beam_search only one prompt is allowed"
@@ -164,6 +184,7 @@ class MegatronServer:
             return 400, {"message": err}
         from megatron_tpu.serving import (AdmissionError,
                                           DeadlineExceededError,
+                                          EngineUnhealthyError,
                                           QueueFullError,
                                           ServiceUnavailableError)
         try:
@@ -172,16 +193,25 @@ class MegatronServer:
             if self.engine is not None and not payload.get("serial"):
                 return 200, self._handle_engine(payload)
             return 200, self._handle_serial(payload)
+        except EngineUnhealthyError as e:
+            # crash-loop circuit breaker open: this replica cannot
+            # serve — 503 so the client/LB retries against another one
+            return 503, self._backoff_body(str(e), retry_after=30)
         except QueueFullError as e:
-            return 429, {"message": str(e)}
+            # bounded-queue overflow, early load shedding
+            # (OverloadShedError subclasses this), or a draining
+            # engine — all retryable, all carry the backoff hint
+            return 429, self._backoff_body(
+                str(e), retry_after=getattr(e, "retry_after", None),
+                queue_depth=getattr(e, "queue_depth", None))
         except DeadlineExceededError as e:
-            # per-request deadline expiry (ServingConfig.
-            # request_deadline_s): the engine evicted the request —
-            # gateway-timeout semantics, retryable by the client
+            # per-request deadline expiry (payload deadline_s /
+            # ServingConfig.request_deadline_s): the engine evicted the
+            # request — gateway-timeout semantics, retryable
             return 504, {"message": str(e)}
         except ServiceUnavailableError as e:
             # queued work dropped by a graceful drain: retry elsewhere
-            return 503, {"message": str(e)}
+            return 503, self._backoff_body(str(e), retry_after=5)
         except AdmissionError as e:
             # only explicit admission failures are client errors; a bare
             # ValueError from inside the model stack stays a 500 (it is
@@ -189,6 +219,46 @@ class MegatronServer:
             return 400, {"message": str(e)}
         except Exception as e:  # noqa: BLE001 — 500 with message, both paths
             return 500, {"message": str(e)}
+
+    def _backoff_body(self, message: str,
+                      retry_after: Optional[int] = None,
+                      queue_depth: Optional[int] = None) -> dict:
+        """JSON body for 429/503: the message plus the machine-readable
+        backoff hint (`retry_after`, seconds — also emitted as the
+        Retry-After header by both transports) and the current queue
+        depth, so clients can back off proportionally to the backlog
+        instead of hammering a saturated replica."""
+        if queue_depth is None:
+            queue_depth = (self.engine.scheduler.depth()
+                           if self.engine is not None else 0)
+        return {"message": message,
+                "retry_after": int(retry_after) if retry_after else 1,
+                "queue_depth": int(queue_depth)}
+
+    @staticmethod
+    def response_headers(body: dict) -> dict:
+        """Extra HTTP headers for a response body (shared by both
+        transports): a `retry_after` hint in the body becomes the
+        standard Retry-After header."""
+        if isinstance(body, dict) and body.get("retry_after"):
+            return {"Retry-After": str(int(body["retry_after"]))}
+        return {}
+
+    def healthz(self) -> Tuple[int, dict]:
+        """Liveness/readiness for `/healthz` — separate from `/metrics`
+        (a scrape-schema document) so probes get a stable, tiny,
+        host-state-only answer: 200 only while the engine ACCEPTS new
+        work; 503 once the crash-loop circuit breaker is open, the
+        loop is wedged/dead, or a drain started (a draining replica
+        rejects every new request — the probe must pull it out of
+        rotation, that is the whole point of a readiness signal).
+        Serial mode has no engine loop to probe."""
+        if self.engine is None:
+            return 200, {"healthy": True, "serving": "serial"}
+        h = self.engine.health()
+        ok = (h["healthy"] and h["state"] == "running"
+              and h["loop_alive"])
+        return (200 if ok else 503), h
 
     def _handle_beam(self, payload: dict) -> dict:
         prompts = payload["prompts"]
@@ -263,7 +333,8 @@ class MegatronServer:
         reproduces the serial path token-for-token; multi-prompt
         payloads sample independently per row instead of sharing the
         serial path's one batch-wide key)."""
-        from megatron_tpu.serving import QueueFullError, SamplingOptions
+        from megatron_tpu.serving import (OverloadShedError,
+                                          QueueFullError, SamplingOptions)
         n = int(payload.get("tokens_to_generate", 64))
         sampling = SamplingOptions(
             temperature=float(payload.get("temperature", 1.0)),
@@ -271,6 +342,12 @@ class MegatronServer:
             top_p=float(payload.get("top_p", 0.0)))
         want_lp = bool(payload.get("logprobs", False))
         seed = self._seed_for(payload)
+        # SLO fields: priority orders admission (and may preempt, with
+        # ServingConfig.preemption); deadline_s overrides the engine
+        # default for THIS request (validated numeric above)
+        priority = int(payload.get("priority", 0) or 0)
+        deadline_s = payload.get("deadline_s")
+        deadline_s = None if deadline_s is None else float(deadline_s)
         # tokenize + validate EVERY prompt before submitting ANY, so a
         # bad prompt 400s without leaving earlier rows decoding for a
         # response that will never be read
@@ -290,10 +367,20 @@ class MegatronServer:
             for i, ids in enumerate(prompt_ids):
                 while True:
                     try:
-                        reqs[i] = self.engine.submit(ids, n, sampling,
-                                                     seed=seed + i)
+                        reqs[i] = self.engine.submit(
+                            ids, n, sampling, seed=seed + i,
+                            priority=priority, deadline_s=deadline_s)
                         pending.append(i)
                         break
+                    except OverloadShedError:
+                        # early shedding says this row can no longer
+                        # make its deadline — retrying in the wave
+                        # would only burn a worker thread toward a
+                        # slow 500; fail the payload FAST with the
+                        # retryable 429 the feature exists to produce
+                        # (already-submitted siblings are cancelled by
+                        # the outer handler)
+                        raise
                     except QueueFullError:
                         if pending:
                             # make room by draining our oldest row
@@ -357,11 +444,17 @@ class MegatronServer:
         @app.route("/api", methods=["PUT"])
         def api():
             status, body = server.handle(request.get_json(silent=True))
-            return jsonify(body), status
+            return (jsonify(body), status,
+                    server.response_headers(body))
 
         @app.route("/metrics", methods=["GET"])
         def metrics():
             return jsonify(server.metrics_snapshot()), 200
+
+        @app.route("/healthz", methods=["GET"])
+        def healthz():
+            status, body = server.healthz()
+            return jsonify(body), status
 
         print_rank_0(f"serving (flask) on {host}:{port}/api")
         # flask's dev server has no programmatic shutdown, and the
@@ -382,6 +475,8 @@ class MegatronServer:
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in server.response_headers(body).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -402,10 +497,14 @@ class MegatronServer:
                 self._send(status, body)
 
             def do_GET(self):
-                if self.path.rstrip("/") != "/metrics":
+                path = self.path.rstrip("/")
+                if path == "/metrics":
+                    self._send(200, server.metrics_snapshot())
+                elif path == "/healthz":
+                    status, body = server.healthz()
+                    self._send(status, body)
+                else:
                     self.send_error(404)
-                    return
-                self._send(200, server.metrics_snapshot())
 
             def log_message(self, fmt, *a):
                 pass

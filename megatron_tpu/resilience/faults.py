@@ -21,7 +21,12 @@ fires them deterministically:
 - **dataset corruption**: `corrupt_dataset(prefix, mode)` injects the
   three dominant on-disk corpus failures (truncated `.bin`, garbage
   `.idx` header, out-of-range pointer) so the open-time validation in
-  `data/indexed_dataset.py` is provable end-to-end.
+  `data/indexed_dataset.py` is provable end-to-end;
+- **serving faults** (`serve_delay`/`serve_crash`/`serve_nan`): stall,
+  crash, or NaN-poison one slot of the serving engine's step loop, so
+  the engine supervisor (watchdog restart, crash-loop circuit breaker,
+  per-slot non-finite guard — serving/engine.py) is provable through a
+  REAL engine — tools/chaos_serve.py composes them with overload.
 
 Activation is process-global (`activate`/`deactivate` or the
 `with use_fault_injector(...)` context) and OFF by default — production
@@ -98,18 +103,38 @@ class FaultInjector:
     rollbacks — a replayed iteration is a new call) whose batch gets
     poisoned.
     `delay_step_calls`: step call count -> seconds to sleep before it.
+
+    Serving faults (keyed by the ENGINE-step call counter — the serving
+    engine advances it once per `_step`, independently of the train
+    counter):
+    `serve_delay_calls`: engine-step call -> seconds to stall the loop
+    (the observable shape of a wedged decode dispatch — trips the
+    engine watchdog).
+    `serve_crash_calls`: engine-step calls that raise `InjectedFault`
+    inside the loop (the supervisor must restart, not hang).
+    `serve_nan_calls`: engine-step call -> active-slot ordinal whose
+    carried logits are poisoned with NaN before the dispatch, so the
+    non-finite guard has a REAL poisoned slot to catch (the fault rides
+    the actual sampling + forward, no metric faking).
     """
 
     def __init__(self,
                  transient_errors: Optional[Dict[str, Set[int]]] = None,
                  nan_step_calls: Optional[Set[int]] = None,
-                 delay_step_calls: Optional[Dict[int, float]] = None):
+                 delay_step_calls: Optional[Dict[int, float]] = None,
+                 serve_delay_calls: Optional[Dict[int, float]] = None,
+                 serve_crash_calls: Optional[Set[int]] = None,
+                 serve_nan_calls: Optional[Dict[int, int]] = None):
         self.transient_errors = {
             k: set(v) for k, v in (transient_errors or {}).items()}
         self.nan_step_calls = set(nan_step_calls or ())
         self.delay_step_calls = dict(delay_step_calls or {})
+        self.serve_delay_calls = dict(serve_delay_calls or {})
+        self.serve_crash_calls = set(serve_crash_calls or ())
+        self.serve_nan_calls = dict(serve_nan_calls or {})
         self._counts: Dict[str, int] = {}
         self._step_calls = 0
+        self._serve_steps = 0
         self._lock = threading.Lock()
         # audit trail: (kind, detail) of every fault actually fired
         self.fired: list = []
@@ -156,6 +181,42 @@ class FaultInjector:
         mask[...] = np.inf
         batch["loss_mask"] = mask
         return batch
+
+    # ---- serving-engine hooks ----------------------------------------
+    def next_serve_step(self) -> int:
+        """Advance the engine-step counter; the serving loop calls this
+        once per `_step` (restarted loops keep counting — a restart is
+        not a reset, so a crash-loop schedule keeps firing)."""
+        with self._lock:
+            self._serve_steps += 1
+            return self._serve_steps
+
+    def maybe_serve_delay(self, step_call: int, sleep=time.sleep) -> float:
+        d = self.serve_delay_calls.get(step_call, 0.0)
+        if d > 0.0:
+            with self._lock:
+                self.fired.append(("serve_delay",
+                                   f"step@{step_call}:{d}"))
+            sleep(d)
+        return d
+
+    def check_serve_crash(self, step_call: int) -> None:
+        if step_call in self.serve_crash_calls:
+            with self._lock:
+                self.fired.append(("serve_crash", f"step@{step_call}"))
+            raise InjectedFault(
+                f"injected engine-step crash (step {step_call})")
+
+    def serve_nan_slot(self, step_call: int) -> Optional[int]:
+        """Active-slot ordinal to poison with NaN logits at this engine
+        step, or None. The engine maps the ordinal onto its active-slot
+        list (mod), so the schedule never depends on slot layout."""
+        slot = self.serve_nan_calls.get(step_call)
+        if slot is not None:
+            with self._lock:
+                self.fired.append(("serve_nan",
+                                   f"step@{step_call}:slot{slot}"))
+        return slot
 
     # ---- on-disk corruption (static helpers) -------------------------
     @staticmethod
@@ -296,6 +357,9 @@ class FaultInjector:
         transient: Dict[str, Set[int]] = {}
         nans: Set[int] = set()
         delays: Dict[int, float] = {}
+        serve_delays: Dict[int, float] = {}
+        serve_crashes: Set[int] = set()
+        serve_nans: Dict[int, int] = {}
         for item in spec.split(","):
             item = item.strip()
             if not item:
@@ -311,9 +375,21 @@ class FaultInjector:
             elif kind == "delay":
                 n, _, secs = arg.partition(":")
                 delays[int(n)] = float(secs or 1.0)
+            elif kind == "serve_delay":
+                n, _, secs = arg.partition(":")
+                serve_delays[int(n)] = float(secs or 1.0)
+            elif kind == "serve_crash":
+                serve_crashes.add(int(arg))
+            elif kind == "serve_nan":
+                n, _, slot = arg.partition(":")
+                serve_nans[int(n)] = int(slot or 0)
             else:
                 raise ValueError(
                     f"unknown fault kind {kind!r} in {cls.ENV_VAR} "
-                    f"(valid: write_error, tracker_error, nan, delay)")
+                    f"(valid: write_error, tracker_error, nan, delay, "
+                    f"serve_delay, serve_crash, serve_nan)")
         return cls(transient_errors=transient, nan_step_calls=nans,
-                   delay_step_calls=delays)
+                   delay_step_calls=delays,
+                   serve_delay_calls=serve_delays,
+                   serve_crash_calls=serve_crashes,
+                   serve_nan_calls=serve_nans)

@@ -46,11 +46,18 @@ class StepWatchdog:
                  exit_code: int = DEFAULT_EXIT_CODE,
                  poll_s: Optional[float] = None,
                  dump_stacks: bool = True,
-                 on_timeout_budget_s: float = 60.0):
+                 on_timeout_budget_s: float = 60.0,
+                 exit_process: bool = True):
         assert timeout_s > 0.0, timeout_s
         self.timeout_s = float(timeout_s)
         self.on_timeout = on_timeout
         self.exit_code = int(exit_code)
+        # exit_process=False: DETECTION-ONLY mode (the serving engine
+        # supervisor) — on deadline run `on_timeout` and latch `fired`
+        # instead of killing the process; the supervisor restarts the
+        # wedged loop and `rearm()`s. Training keeps the default True:
+        # a hung train step has no supervisor above it in-process.
+        self.exit_process = bool(exit_process)
         self.poll_s = poll_s if poll_s is not None else min(
             self.timeout_s / 4.0, 1.0)
         self.dump_stacks = dump_stacks
@@ -78,6 +85,13 @@ class StepWatchdog:
         return self
 
     def heartbeat(self) -> None:
+        self._last = time.monotonic()
+
+    def rearm(self) -> None:
+        """Detection-only mode: clear a latched firing and restart the
+        deadline clock (called by the serving supervisor after it
+        restarted the wedged loop)."""
+        self.fired = False
         self._last = time.monotonic()
 
     def suspend(self) -> "StepWatchdog":
@@ -110,14 +124,19 @@ class StepWatchdog:
             if self._suspended:
                 self._last = time.monotonic()
                 continue
+            if self.fired and not self.exit_process:
+                continue  # latched until rearm()
             stalled = time.monotonic() - self._last
             if stalled <= self.timeout_s:
                 continue
             self.fired = True
             print_rank_0(
                 f"watchdog: no step progress for {stalled:.1f}s "
-                f"(deadline {self.timeout_s:.1f}s); dumping stacks and "
-                f"exiting with code {self.exit_code}")
+                f"(deadline {self.timeout_s:.1f}s); "
+                + (f"dumping stacks and exiting with code "
+                   f"{self.exit_code}" if self.exit_process
+                   else "running the timeout callback (detection-only "
+                        "mode; the supervisor restarts the loop)"))
             if self.dump_stacks:
                 try:
                     faulthandler.dump_traceback(file=sys.stderr,
@@ -141,5 +160,7 @@ class StepWatchdog:
                     print_rank_0("watchdog: final checkpoint attempt "
                                  f"exceeded {self.on_timeout_budget_s}s; "
                                  "exiting without it")
+            if not self.exit_process:
+                continue  # stay armed-but-latched; rearm() resets
             _exit(self.exit_code)
             return  # only reached when _exit is monkeypatched in tests

@@ -1,6 +1,7 @@
 """Continuous-batching serving subsystem (new layer between the
 generator and the HTTP front end — see docs/serving.md)."""
-from megatron_tpu.serving.engine import ServingEngine  # noqa: F401
+from megatron_tpu.serving.engine import (  # noqa: F401
+    EngineHungError, ServingEngine)
 from megatron_tpu.serving.kv_pool import (  # noqa: F401
     SlotKVPool, clone_prefix, insert_prefill, slice_slot)
 from megatron_tpu.serving.metrics import ServingMetrics  # noqa: F401
@@ -9,4 +10,5 @@ from megatron_tpu.serving.request import (  # noqa: F401
     DeadlineExceededError, GenRequest, RequestState, SamplingOptions,
     ServiceUnavailableError)
 from megatron_tpu.serving.scheduler import (  # noqa: F401
-    AdmissionError, FIFOScheduler, QueueFullError)
+    AdmissionError, AdmissionScheduler, EngineUnhealthyError,
+    FIFOScheduler, OverloadShedError, QueueFullError)

@@ -47,6 +47,30 @@ TPU-native:
   cache's offset) and lands in the slot region with one
   `insert_prefill` when the last chunk completes.
 
+- Overload robustness (docs/serving.md "Overload & failure behavior"):
+  admission is priority + earliest-deadline-first with optional early
+  load shedding (serving/scheduler.py), and a queued higher-priority
+  request with no allocatable slot PREEMPTS the lowest-priority
+  running slot — the victim's KV parks in a batch-1 sub-cache
+  (`slice_slot`, the read half of `clone_prefix`) together with its
+  carried logits row and PRNG key, and it resumes later with one
+  `insert_prefill`: no re-prefill, token-exact vs never-preempted,
+  decode trace untouched (preemption is slot bookkeeping plus two
+  region copies through already-compiled programs). If the parked
+  buffers are dropped (engine restart, park budget), the victim
+  replays its effective prompt through prefill instead — still
+  token-exact, the host-side PRNG copy survives.
+- Engine supervisor: the loop runs under a supervisor that restarts it
+  after a crashed or hung step (resilience/watchdog.py in
+  detection-only mode detects the hang and fails the in-flight futures
+  so none strand). A restart fails only the slotted requests it must
+  (their device state is suspect), requeues queued/prefilling work,
+  and resets the pool; after `max_engine_restarts` the crash-loop
+  circuit breaker trips — the engine goes unhealthy, `submit` raises
+  EngineUnhealthyError (HTTP 503) and `/healthz` reports it. A
+  per-slot non-finite-logits guard fails a poisoned REQUEST (NaN/inf
+  logits) without taking the engine down.
+
 Seeded determinism: a request with seed s reproduces the serial
 `Generator.generate([prompt], ..., seed=s)` output token-for-token —
 the engine burns the same number of PRNG splits the serial path spends
@@ -55,7 +79,9 @@ bit-identical to `sample`.
 """
 from __future__ import annotations
 
+import math
 import threading
+import time
 from typing import List, Optional, Sequence
 
 import jax
@@ -65,16 +91,25 @@ import numpy as np
 from megatron_tpu.inference.generation import Generator, prefill_chunk
 from megatron_tpu.inference.sampling import sample_batched
 from megatron_tpu.models import language_model as lm
+from megatron_tpu.resilience.faults import get_fault_injector
 from megatron_tpu.serving.kv_pool import (SlotKVPool, insert_prefill,
                                           slice_slot)
 from megatron_tpu.serving.metrics import ServingMetrics
 from megatron_tpu.serving.prefix_index import PrefixIndex
 from megatron_tpu.serving.request import (GenRequest, RequestState,
                                           SamplingOptions)
-from megatron_tpu.serving.scheduler import FIFOScheduler
+from megatron_tpu.serving.scheduler import (AdmissionScheduler,
+                                            EngineUnhealthyError,
+                                            OverloadShedError)
 from megatron_tpu.utils.logging import print_rank_0
 
 from megatron_tpu.config import SERVING_KV_DTYPES as _KV_DTYPES
+
+
+class EngineHungError(RuntimeError):
+    """Raised by the loop when the watchdog flagged a wedged iteration
+    that eventually returned — the supervisor treats it as a crash and
+    restarts the session."""
 
 
 class _PendingPrefill:
@@ -85,23 +120,35 @@ class _PendingPrefill:
     number of prompt tokens whose KV `sub` holds (starts at the cloned
     prefix length on a hit); `last` is the logits row of the most
     recent chunk's final real token (only the LAST chunk's value is
-    consumed, as the sampling logits at prompt position plen-1)."""
+    consumed, as the sampling logits at prompt position plen-1).
+    `tokens` is the sequence being prefilled — `req.prompt` for a fresh
+    request, `req.effective_prompt()` (prompt + generated so far) for a
+    preemption replay."""
 
-    __slots__ = ("req", "slot", "sub", "pos", "rng0", "last")
+    __slots__ = ("req", "slot", "sub", "pos", "rng0", "last", "tokens")
 
-    def __init__(self, req: GenRequest, slot: int, sub, pos: int, rng0):
+    def __init__(self, req: GenRequest, slot: int, sub, pos: int, rng0,
+                 tokens: Optional[List[int]] = None):
         self.req = req
         self.slot = slot
         self.sub = sub
         self.pos = pos
         self.rng0 = rng0
         self.last = None
+        self.tokens = list(tokens) if tokens is not None else req.prompt
 
 
 class ServingEngine:
     """Drives generation for many concurrent requests through one
     compiled decode step. Construct from a `Generator` (whose params /
     config / mesh treatment / rope tables are reused as-is)."""
+
+    # a restart this long ago no longer counts toward the crash-loop
+    # circuit breaker: the breaker exists to catch a LOOP (every
+    # restart crashing again within moments), not to accumulate
+    # isolated recovered faults over a replica's weeks-long lifetime
+    # into permanent 503
+    RESTART_DECAY_S = 300.0
 
     def __init__(self, generator: Generator, serving=None,
                  metrics: Optional[ServingMetrics] = None,
@@ -132,10 +179,20 @@ class ServingEngine:
         # here for engines constructed without going through validate.
         self._prefix_on = bool(self.serving.enable_prefix_cache)
         self._chunk = self.serving.prefill_chunk
+        self._preempt_on = bool(self.serving.preemption)
+        # re-assert ServingConfig.validate for engines constructed
+        # without it: one priority class makes preemption silently
+        # inert (every request clamps to 0 — nothing ever outranks a
+        # running slot)
+        assert not (self._preempt_on
+                    and self.serving.priority_levels < 2), (
+            "preemption requires priority_levels >= 2 — see "
+            "ServingConfig.validate")
         assert not (self.pool.rolling
-                    and (self._prefix_on or self._chunk is not None)), (
-            "enable_prefix_cache/prefill_chunk are unsupported on "
-            "ROLLING (sliding-window) KV pools — see "
+                    and (self._prefix_on or self._chunk is not None
+                         or self._preempt_on)), (
+            "enable_prefix_cache/prefill_chunk/preemption are "
+            "unsupported on ROLLING (sliding-window) KV pools — see "
             "ServingConfig.validate")
         # flash + int8 re-check with the RESOLVED pool dtype (validate
         # only sees an explicit kv_dtype string; None inherits the
@@ -144,18 +201,28 @@ class ServingEngine:
         # cache-on could not be token-exact vs cache-off
         assert not (cfg.attention_impl == "flash"
                     and self.pool.dtype == jnp.dtype(jnp.int8)
-                    and (self._prefix_on or self._chunk is not None)), (
-            "enable_prefix_cache/prefill_chunk are unsupported on "
-            "flash-impl int8 KV pools — see ServingConfig.validate")
+                    and (self._prefix_on or self._chunk is not None
+                         or self._preempt_on)), (
+            "enable_prefix_cache/prefill_chunk/preemption are "
+            "unsupported on flash-impl int8 KV pools — see "
+            "ServingConfig.validate")
         self._index = PrefixIndex(max(self.serving.prefill_bucket, 1))
         # a retained slot's KV is reclaimed lazily (alloc / retain
         # overflow) — forget its prefixes the moment that happens
         self.pool.on_reclaim = self._index.remove
         self._prefilling: List[_PendingPrefill] = []
+        self._admitting: List[GenRequest] = []  # mid-_admit pops
         self._sub0 = None  # lazily-built zero template for miss starts
-        self.scheduler = FIFOScheduler(self.serving.max_queue,
-                                       max_total_len=self.max_len)
+        self.scheduler = AdmissionScheduler(
+            self.serving.max_queue, max_total_len=self.max_len,
+            num_slots=self.num_slots,
+            shed_on_overload=self.serving.shed_on_overload,
+            default_deadline_s=self.serving.request_deadline_s)
         self.scheduler.notify = self._wake
+        # busy-slot feed for the shed estimate (reads host arrays the
+        # engine thread owns — a racy read only skews the estimate)
+        self.scheduler.active_fn = (
+            lambda: int(self._active.sum()) + len(self._prefilling))
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self._writer = writer
         self._report_interval = max(report_interval, 1)
@@ -224,6 +291,26 @@ class ServingEngine:
         self._draining = False
         self._deadline_s = self.serving.request_deadline_s
         self._broken: Optional[str] = None
+        # supervisor state: restarts consumed, wedged-iteration flag
+        # (set by the watchdog thread), and the detection-only watchdog
+        # itself (armed lazily after the first completed step so the
+        # compile-heavy warmup can't trip it)
+        self._restarts = 0
+        self._last_restart_t: Optional[float] = None
+        self._wedged = False
+        self._max_restarts = max(self.serving.max_engine_restarts, 0)
+        self._watchdog = None
+        self._idle_wait = 0.5
+        if self.serving.engine_step_timeout_s:
+            from megatron_tpu.resilience.watchdog import StepWatchdog
+            self._watchdog = StepWatchdog(
+                self.serving.engine_step_timeout_s,
+                on_timeout=self._on_hang, exit_process=False,
+                dump_stacks=False)
+            # idle waits must heartbeat faster than the deadline, or an
+            # EMPTY engine would look hung
+            self._idle_wait = min(
+                0.5, self.serving.engine_step_timeout_s / 4.0)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serving-engine")
         if start:
@@ -234,18 +321,30 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 64,
                sampling: SamplingOptions = SamplingOptions(),
-               seed: int = 0) -> GenRequest:
+               seed: int = 0, priority: int = 0,
+               deadline_s: Optional[float] = None) -> GenRequest:
         """Non-blocking: enqueue and return the request handle. Raises
-        QueueFullError (→ 429) when the bounded queue is full and
-        AdmissionError (→ 400) when the request can never fit."""
+        QueueFullError (→ 429) when the bounded queue is full,
+        OverloadShedError (→ 429 + Retry-After) when early shedding
+        fires, EngineUnhealthyError (→ 503) when the crash-loop
+        circuit breaker is open, and AdmissionError (→ 400) when the
+        request can never fit. `priority` clamps into
+        [0, priority_levels); `deadline_s` overrides the engine-wide
+        request_deadline_s for this request."""
         if self._broken:
-            raise RuntimeError(f"engine failed: {self._broken}")
+            raise EngineUnhealthyError(
+                f"engine unhealthy (circuit breaker open): "
+                f"{self._broken}")
         if self._draining:
             from megatron_tpu.serving.scheduler import QueueFullError
             raise QueueFullError(
                 "engine draining (shutdown in progress); retry against "
-                "another replica")
-        req = GenRequest(list(prompt), max_new_tokens, sampling, seed)
+                "another replica", retry_after=5,
+                queue_depth=self.scheduler.depth())
+        priority = max(0, min(int(priority),
+                              self.serving.priority_levels - 1))
+        req = GenRequest(list(prompt), max_new_tokens, sampling, seed,
+                         priority=priority, deadline_s=deadline_s)
         self.metrics.count("requests_received")
         try:
             if max_new_tokens == 0:
@@ -260,6 +359,10 @@ class ServingEngine:
                 self.metrics.record_completed(0.0, 0)
                 return req
             self.scheduler.submit(req)
+        except OverloadShedError:
+            self.metrics.count("requests_shed")
+            self.metrics.count("requests_rejected")
+            raise
         except Exception:
             self.metrics.count("requests_rejected")
             raise
@@ -292,6 +395,8 @@ class ServingEngine:
             self._cond.notify_all()
         if self._thread.ident is not None:  # was started
             self._thread.join(timeout=30)
+        if self._watchdog is not None:
+            self._watchdog.stop()
         for req in self.scheduler.close():
             req.fail("engine shut down")
         for req in self._slot_req:
@@ -300,6 +405,30 @@ class ServingEngine:
         for st in self._prefilling:
             if not st.req.done():
                 st.req.fail("engine shut down")
+
+    def health(self) -> dict:
+        """Liveness/readiness snapshot for `/healthz` (separate from
+        the `/metrics` counters): supervisor state, circuit breaker,
+        slot occupancy, queue depth. Host-state reads only — never
+        touches the device, so a wedged decode cannot wedge the health
+        endpoint too."""
+        broken = self._broken
+        state = ("unhealthy" if broken else
+                 "draining" if self._draining else
+                 "wedged" if self._wedged else "running")
+        return {
+            "healthy": broken is None and not self._wedged,
+            "state": state,
+            "loop_alive": self._thread.is_alive(),
+            "circuit_breaker_open": broken is not None,
+            "engine_restarts": self._restarts,
+            "max_engine_restarts": self._max_restarts,
+            "active_slots": int(self._active.sum()),
+            "prefilling": len(self._prefilling),
+            "num_slots": self.num_slots,
+            "queue_depth": self.scheduler.depth(),
+            "detail": broken or "",
+        }
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful shutdown: stop admitting (queued-but-unstarted
@@ -322,6 +451,8 @@ class ServingEngine:
             self._thread.join(timeout)
         drained = not self._thread.is_alive()
         if drained:
+            if self._watchdog is not None:
+                self._watchdog.stop()
             print_rank_0("serving engine drained: all in-flight "
                          "requests completed")
         return drained
@@ -480,7 +611,15 @@ class ServingEngine:
         with self._cond:
             self._cond.notify_all()
 
+    def _heartbeat(self):
+        if self._watchdog is not None and self._watchdog.started:
+            self._watchdog.heartbeat()
+
     def _loop(self):
+        """Supervisor: run `_session` until clean exit; on a crashed or
+        hung iteration, restart it (reset device state, fail only the
+        slotted requests, requeue the rest) up to `max_engine_restarts`
+        times, then trip the crash-loop circuit breaker."""
         print_rank_0(
             f"serving engine: {self.num_slots} slots x cap "
             f"{self.pool.cap} ({self.pool.dtype}"
@@ -488,63 +627,296 @@ class ServingEngine:
             f"pool {self.pool.nbytes() / 2**20:.1f} MiB, "
             f"queue bound {self.serving.max_queue}")
         while True:
+            try:
+                if self._session():
+                    return
+            except Exception as e:  # noqa: BLE001 — supervise, not hang
+                msg = repr(e)
+                if self._restarts >= self._max_restarts:
+                    self._trip_breaker(msg)
+                    return
+                self._restarts += 1
+                self._last_restart_t = time.monotonic()
+                self.metrics.count("engine_restarts")
+                print_rank_0(
+                    f"serving engine: loop failed ({msg}); restarting "
+                    f"({self._restarts}/{self._max_restarts})")
+                try:
+                    # suspend the watchdog across the reset: in the
+                    # CRASH path (unlike the hang path) it has not
+                    # fired/latched, and a slow device-state rebuild
+                    # must not trip it mid-restart — it would fail the
+                    # very requests the restart is requeuing and leak
+                    # _wedged into the fresh session
+                    if self._watchdog is not None:
+                        with self._watchdog.suspend():
+                            self._restart_session(msg)
+                    else:
+                        self._restart_session(msg)
+                except Exception as e2:  # noqa: BLE001
+                    self._trip_breaker(
+                        f"restart failed: {e2!r} (after {msg})")
+                    return
+
+    def _session(self) -> bool:
+        """The engine loop proper. Returns True on clean exit (stop /
+        drain complete); raises on a crashed or watchdog-flagged
+        iteration — the supervisor decides what survives."""
+        while True:
             with self._cond:
                 while (not self._stop and not self._draining
+                       and not self._wedged
                        and self.scheduler.depth() == 0
                        and not self._active.any()
                        and not self._prefilling):
-                    self._cond.wait(timeout=0.5)
+                    self._cond.wait(timeout=self._idle_wait)
+                    self._heartbeat()  # idleness is not a hang
                 if self._stop:
-                    return
+                    return True
                 if (self._draining and not self._active.any()
                         and not self._prefilling):
                     # drained: queue closed, slots empty, no prefill
                     # in flight (a mid-chunk request is in-flight work
                     # and decodes to completion like a running slot)
-                    return
-            try:
-                self._reap_cancelled()
-                self._reap_expired()
-                self._admit()
-                # ONE chunk per iteration (Sarathi-Serve): prefill work
-                # is interleaved with the decode step below, so running
-                # slots keep emitting tokens while a long prompt lands
-                self._advance_prefill()
-                if self._active.any():
-                    self._step()
-            except Exception as e:  # noqa: BLE001 — fail loudly, not hang
-                self._broken = repr(e)
-                print_rank_0(f"serving engine loop failed: {e!r}")
-                for req in self._slot_req:
-                    if req is not None:
-                        req.fail(self._broken)
-                for st in self._prefilling:
-                    st.req.fail(self._broken)
-                for req in self.scheduler.close():
-                    req.fail(self._broken)
-                return
+                    return True
+            if self._wedged:
+                raise EngineHungError(
+                    "engine iteration exceeded the watchdog deadline "
+                    f"({self.serving.engine_step_timeout_s}s); "
+                    "in-flight requests were failed by the watchdog")
+            self._maybe_decay_restarts()
+            self._reap_cancelled()
+            self._reap_expired()
+            self._preempt_for_priority()
+            self._admit()
+            # ONE chunk per iteration (Sarathi-Serve): prefill work
+            # is interleaved with the decode step below, so running
+            # slots keep emitting tokens while a long prompt lands
+            self._advance_prefill()
+            self._heartbeat()  # admit/prefill may compile; decode is
+            #                    the op the deadline protects
+            if self._active.any():
+                self._step()
+            if self._watchdog is not None:
+                if not self._watchdog.started:
+                    # arm only after a full iteration completed — the
+                    # first one includes the jit compiles, whose
+                    # duration is unrelated to steady-state health
+                    self._watchdog.start()
+                else:
+                    self._watchdog.heartbeat()
+
+    # ------------------------------------------------------------------
+    # supervisor: hang detection, restart, circuit breaker
+    # ------------------------------------------------------------------
+    def _maybe_decay_restarts(self):
+        """Forget consumed restarts after RESTART_DECAY_S of healthy
+        operation: a crash LOOP re-crashes within moments, so isolated
+        recovered faults spread over a long-lived replica's lifetime
+        must not accumulate into a tripped breaker. (The cumulative
+        `engine_restarts` metric is unaffected.)"""
+        if self._restarts and self._last_restart_t is not None and \
+                time.monotonic() - self._last_restart_t \
+                > self.RESTART_DECAY_S:
+            print_rank_0(
+                f"serving engine: {self._restarts} restart(s) aged out "
+                f"(> {self.RESTART_DECAY_S:.0f}s healthy); crash-loop "
+                "budget reset")
+            self._restarts = 0
+            self._last_restart_t = None
+
+    def _on_hang(self):
+        """Watchdog thread: the engine loop made no progress within the
+        deadline. Fail every in-flight future NOW (their device state
+        is suspect and the engine thread is stuck — waiting would
+        strand them), flag the session wedged, and let the supervisor
+        restart the loop when (if) the wedged dispatch returns. Queued
+        requests are untouched: they are host-side and will be served
+        after the restart (or expire against their deadlines)."""
+        self._wedged = True
+        msg = (f"engine hung: no decode-loop progress within "
+               f"{self.serving.engine_step_timeout_s:.1f}s (watchdog); "
+               "request failed, engine restarting")
+        print_rank_0("serving " + msg)
+        for req in list(self._slot_req):
+            if req is not None:
+                req.fail(msg)
+        for st in list(self._prefilling):
+            st.req.fail(msg)
+        # pops wedged mid-_admit (e.g. inside a batched group-prefill
+        # dispatch) are in neither list above — without this they
+        # would strand if the dispatch never returns
+        for req in list(self._admitting):
+            req.fail(msg)
+        self._wake()
+
+    def _trip_breaker(self, msg: str):
+        """Crash-loop circuit breaker: more restarts than
+        `max_engine_restarts`. The engine goes (and stays) unhealthy —
+        every in-flight and queued future resolves with a typed error,
+        submits raise EngineUnhealthyError (HTTP 503), `/healthz`
+        reports unhealthy."""
+        self._broken = (f"circuit breaker open after "
+                        f"{self._restarts} restart(s): {msg}")
+        print_rank_0(f"serving engine: {self._broken}")
+        for req in self._slot_req:
+            if req is not None:
+                req.fail(self._broken)
+        for st in self._prefilling:
+            st.req.fail(self._broken)
+        for req in self.scheduler.close():
+            req.fail(self._broken, kind="unavailable")
+
+    def _restart_session(self, msg: str):
+        """Reset after a crashed/hung iteration. The device-side state
+        (pool, logits, rng grids — possibly donated into the failed
+        call) is rebuilt from scratch; the compiled programs are kept,
+        so no retrace. Slotted requests FAIL (their generated stream
+        depended on state we can no longer trust); mid-prefill and
+        queued requests REQUEUE losslessly (nothing irrecoverable lives
+        on device for them — a replay recomputes their KV, and a
+        preempted request's resume_rng is host-side). Parked preemption
+        buffers are dropped for the same reason; their owners replay."""
+        for slot, req in enumerate(self._slot_req):
+            if req is not None:
+                req.fail(f"engine step failed while this request was "
+                         f"slotted: {msg}")
+        for st in self._prefilling:
+            req = st.req
+            if req.done():
+                continue  # watchdog already failed it
+            req.state = RequestState.QUEUED
+            self.scheduler.requeue(req)
+        self.scheduler.clear_parked()
+        self._prefilling = []
+        self._sub0 = None
+        self._index = PrefixIndex(max(self.serving.prefill_bucket, 1))
+        self.pool = SlotKVPool(self.cfg, self.num_slots, self.max_len,
+                               dtype=self.pool.dtype,
+                               retained_limit=self.serving.retained_slots)
+        self.pool.on_reclaim = self._index.remove
+        S, Vp = self.num_slots, self.cfg.padded_vocab_size
+        self._last_logits = jnp.zeros((S, Vp), jnp.float32)
+        self._rngs = jnp.zeros((S, 2), jnp.uint32)
+        self._lengths[:] = 0
+        self._active[:] = False
+        self._slot_req = [None] * S
+        self._sampling_dirty = True
+        self._lengths_dirty = True
+        self._wedged = False
+        if self._watchdog is not None:
+            self._watchdog.rearm()
+
+    # ------------------------------------------------------------------
+    # priority preemption
+    # ------------------------------------------------------------------
+    def _preempt_for_priority(self):
+        """A queued higher-priority request with NO allocatable slot
+        (free list and retained LRU both empty) evicts the
+        lowest-priority running slot; ties prefer the youngest victim
+        (least sunk cost). At most one victim per waiting iteration —
+        the freed slot is consumed by the very next `_admit` pop, so
+        preempting deeper would only thrash."""
+        if not self._preempt_on:
+            return
+        if self.pool.free_count() > 0:
+            return
+        top = self.scheduler.peek_priority()
+        if top is None:
+            return
+        victim, vprio = None, None
+        for slot in np.nonzero(self._active)[0]:
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            if (vprio is None or req.priority < vprio
+                    or (req.priority == vprio
+                        and req.id > self._slot_req[victim].id)):
+                victim, vprio = int(slot), req.priority
+        if victim is None or vprio >= top:
+            return
+        self._preempt(victim)
+
+    def _preempt(self, slot: int):
+        """Losslessly evict `slot`: park its KV region in a batch-1
+        sub-cache OUTSIDE the pool (`slice_slot` — the read half of
+        `clone_prefix`; a separate device buffer the grid's idle writes
+        can never touch) together with the carried logits row and a
+        HOST copy of the PRNG key, then requeue the request. Resume is
+        one `insert_prefill` — no re-prefill, token-exact, and the
+        decode trace is untouched (slot bookkeeping + two
+        already-compiled region copies). The park budget is the slot
+        count; beyond it (or after an engine restart) the sub is
+        dropped and the victim replays its effective prompt instead —
+        still token-exact via the host-side rng."""
+        req = self._slot_req[slot]
+        plen = int(self._lengths[slot])
+        assert plen == len(req.effective_prompt()), (
+            plen, len(req.prompt), len(req.generated))
+        # host copy FIRST: it survives restarts and the replay fallback
+        req.resume_rng = np.asarray(jax.device_get(self._rngs[slot]))
+        if self.scheduler.parked_count() < self.num_slots:
+            sub = self._slice(self.gen.params, self.pool.caches,
+                              jnp.int32(slot), jnp.int32(plen))
+            # row-index makes a NEW device buffer — safe across the
+            # next decode's donation of self._last_logits
+            req.parked = (sub, self._last_logits[slot])
+        else:
+            req.parked = None  # replay fallback
+        req.preemptions += 1
+        self.metrics.count("preemptions")
+        self._slot_req[slot] = None
+        self._active[slot] = False
+        self._sampling_dirty = True
+        self._lengths_dirty = True
+        # the region itself goes back to the free list (its KV lives in
+        # the parked sub now, a separate buffer), so the slot parks at
+        # position 0 like any hard-freed row — the grid's idle writes
+        # land in a region nothing references until the next insert
+        # overwrites it whole
+        self._index.remove(slot)
+        self.pool.release(slot)
+        self._lengths[slot] = 0
+        req.state = RequestState.QUEUED
+        self.scheduler.requeue(req)
 
     def _admit(self):
         popped = self.scheduler.pop_ready(self.pool.free_count())
         if not popped:
             return
         pending = list(popped)
+        # expose the not-yet-placed pops to the watchdog: a wedge
+        # inside a prefill dispatch below leaves them in neither
+        # _slot_req nor _prefilling, and the no-stranded-futures
+        # contract covers them too (`pending` is mutated as each
+        # request lands, so this alias always holds exactly the
+        # unplaced remainder)
+        self._admitting = pending
         try:
             groupable: List[GenRequest] = []
             for r in popped:
-                # prefix lookup caps the match at len(prompt)-1: at
-                # least one suffix token must forward to produce the
-                # sampling logits at position plen-1
-                src, hit = (self._index.lookup(r.prompt,
-                                               len(r.prompt) - 1)
+                if r.parked is not None:
+                    # preemption victim with intact parked KV: resume
+                    # with ONE insert — no forward at all
+                    self._resume_parked(r)
+                    pending.remove(r)
+                    continue
+                # a resumed request prefills its EFFECTIVE prompt
+                # (prompt + generated); == prompt when never preempted
+                toks = r.effective_prompt()
+                # prefix lookup caps the match at len-1: at least one
+                # suffix token must forward to produce the sampling
+                # logits at position plen-1
+                src, hit = (self._index.lookup(toks, len(toks) - 1)
                             if self._prefix_on else (None, 0))
-                if hit or (self._chunk is not None
-                           and len(r.prompt) > self._chunk):
+                if hit or r.resume_rng is not None \
+                        or (self._chunk is not None
+                            and len(toks) > self._chunk):
                     self._start_pending(r, src, hit)
                     pending.remove(r)
                 else:
                     groupable.append(r)
-            for padded, reqs in FIFOScheduler.group_by_bucket(
+            for padded, reqs in AdmissionScheduler.group_by_bucket(
                     groupable,
                     lambda rr: self._prefill_bucket(len(rr.prompt)),
                     self._prefill_max_batch):
@@ -558,14 +930,47 @@ class ServingEngine:
             for r in pending:
                 r.fail(repr(e))
             raise
+        finally:
+            self._admitting = []
+
+    def _resume_parked(self, req: GenRequest):
+        """Resume a preemption victim whose KV survived in its parked
+        sub-cache: allocate a slot and land the whole region with ONE
+        `insert_prefill` (plus the saved logits row and rng key) — the
+        request continues decoding exactly where it stopped, with zero
+        forward work and zero new compiles."""
+        sub, last = req.parked
+        req.parked = None
+        tokens = req.effective_prompt()
+        plen = len(tokens)
+        slot = self.pool.alloc()
+        assert slot is not None, "popped more requests than free slots"
+        try:
+            st = _PendingPrefill(req, slot, sub, plen,
+                                 jnp.asarray(req.resume_rng),
+                                 tokens=tokens)
+            st.last = last
+            first = req.admit_time is None
+            req.mark_admitted()  # no-op on a concurrently-failed req
+            if first and req.admit_time is not None:
+                self.metrics.record_admitted(req.admit_time
+                                             - req.submit_time)
+            self._activate_pending(st, plen)
+        except Exception:
+            self.pool.release(slot)
+            raise
 
     def _start_pending(self, req: GenRequest, src_slot: Optional[int],
                        prefix_len: int):
         """Reserve a slot and begin a suffix/chunked prefill. On a
         prefix hit the shared region slices out of `src_slot` (one
         on-device copy in place of L forward layers over those
-        tokens); otherwise the sub-cache starts empty at offset 0."""
-        plen = len(req.prompt)
+        tokens); otherwise the sub-cache starts empty at offset 0.
+        A preemption-replay request (resume_rng set, parked KV gone)
+        prefills its effective prompt and continues the saved PRNG
+        chain — token-exact either way."""
+        tokens = req.effective_prompt()
+        plen = len(tokens)
         if prefix_len:
             # matched at lookup — counted even when the allocation
             # below forfeits the hit, so hit_tokens - tokens_saved
@@ -597,11 +1002,16 @@ class ServingEngine:
                 if self._sub0 is None:
                     self._sub0 = self.pool.make_prefill_caches(1)
                 sub = self._sub0
-            st = _PendingPrefill(req, slot, sub, prefix_len,
-                                 self._initial_rng(req.seed, plen))
-            req.mark_admitted()
-            self.metrics.record_admitted(req.admit_time
-                                         - req.submit_time)
+            rng0 = (jnp.asarray(req.resume_rng)
+                    if req.resume_rng is not None
+                    else self._initial_rng(req.seed, plen))
+            st = _PendingPrefill(req, slot, sub, prefix_len, rng0,
+                                 tokens=tokens)
+            first = req.admit_time is None
+            req.mark_admitted()  # no-op on a concurrently-failed req
+            if first and req.admit_time is not None:
+                self.metrics.record_admitted(req.admit_time
+                                             - req.submit_time)
             self._prefilling.append(st)
         except Exception:
             self.pool.release(slot)
@@ -617,7 +1027,7 @@ class ServingEngine:
         if not self._prefilling:
             return
         st = self._prefilling[0]
-        plen = len(st.req.prompt)
+        plen = len(st.tokens)
         n = plen - st.pos
         if self._chunk is not None:
             n = min(n, self._chunk)
@@ -637,7 +1047,7 @@ class ServingEngine:
         padded = min(padded, self.max_len - st.pos)
         assert n <= padded, (n, padded, st.pos)
         toks = np.full((1, padded), self.gen.pad_id, np.int32)
-        toks[0, :n] = st.req.prompt[st.pos:st.pos + n]
+        toks[0, :n] = st.tokens[st.pos:st.pos + n]
         st.sub, st.last = self._chunk_fwd(
             self.gen.params, st.sub, jnp.asarray(toks),
             jnp.int32(n - 1), jnp.int32(st.pos + n))
@@ -667,17 +1077,18 @@ class ServingEngine:
         self._sampling_dirty = True
         self._lengths_dirty = True
         if self._prefix_on:
-            # the slot is now cloneable for its PROMPT (extended with
-            # the generated tokens at retain time)
-            self._index.insert(slot, req.prompt)
+            # the slot is now cloneable for its prefilled sequence —
+            # the PROMPT for a fresh request, prompt + generated-so-far
+            # for a resumed one (extended again at retain time)
+            self._index.insert(slot, st.tokens)
 
     def _drop_pending(self, st: _PendingPrefill, msg: str,
                       kind: str = "error"):
         self._prefilling.remove(st)
         self.pool.release(st.slot)
-        st.req.fail(msg, kind=kind)
-        self.metrics.count("requests_expired" if kind == "deadline"
-                           else "requests_cancelled")
+        if st.req.fail(msg, kind=kind):
+            self.metrics.count("requests_expired" if kind == "deadline"
+                               else "requests_cancelled")
 
     def _prefill_group(self, reqs: List[GenRequest], padded: int):
         """One batched prefill for same-bucket admissions. The batch
@@ -708,8 +1119,15 @@ class ServingEngine:
             self._top_ks[slot] = req.sampling.top_k
             self._top_ps[slot] = req.sampling.top_p
             self._slot_req[slot] = req
-            req.mark_admitted()
-            self.metrics.record_admitted(req.admit_time - req.submit_time)
+            # restart-requeued requests re-enter through this path
+            # too (the rebuilt PrefixIndex is empty): record the
+            # queue wait only for the FIRST admission, like
+            # _start_pending/_resume_parked
+            first = req.admit_time is None
+            req.mark_admitted()  # no-op on a concurrently-failed req
+            if first and req.admit_time is not None:
+                self.metrics.record_admitted(req.admit_time
+                                             - req.submit_time)
         self._sampling_dirty = True
         self._lengths_dirty = True
         self.metrics.count("prefill_calls")
@@ -730,32 +1148,33 @@ class ServingEngine:
                 self._drop_pending(st, "cancelled")
 
     def _reap_expired(self):
-        """Per-request deadline (ServingConfig.request_deadline_s):
-        evict running slots and drop queued requests whose wall clock
-        ran out — their callers have already timed out; decoding for
-        them starves live traffic."""
-        if self._deadline_s is None:
-            return
-        import time
+        """Effective per-request deadline (request `deadline_s`, else
+        ServingConfig.request_deadline_s): evict running slots and drop
+        queued/prefilling requests whose wall clock ran out — their
+        callers have already timed out; decoding for them starves live
+        traffic."""
         now = time.monotonic()
         for slot in np.nonzero(self._active)[0]:
             req = self._slot_req[slot]
-            if req is not None and \
-                    now - req.submit_time > self._deadline_s:
+            if req is None:
+                continue
+            ad = req.absolute_deadline(self._deadline_s)
+            if ad is not None and now > ad:
                 self._evict(
                     slot,
                     failed=(f"deadline exceeded after "
                             f"{now - req.submit_time:.1f}s "
-                            f"(deadline {self._deadline_s:.1f}s, "
+                            f"(deadline {ad - req.submit_time:.1f}s, "
                             f"{len(req.generated)} tokens generated)"),
                     kind="deadline")
         for st in list(self._prefilling):
-            if now - st.req.submit_time > self._deadline_s:
+            ad = st.req.absolute_deadline(self._deadline_s)
+            if ad is not None and now > ad:
                 self._drop_pending(
                     st,
                     f"deadline exceeded after "
                     f"{now - st.req.submit_time:.1f}s "
-                    f"(deadline {self._deadline_s:.1f}s, "
+                    f"(deadline {ad - st.req.submit_time:.1f}s, "
                     f"{st.pos} prompt tokens prefilled)",
                     kind="deadline")
         expired = self.scheduler.drop_expired(self._deadline_s, now)
@@ -794,13 +1213,23 @@ class ServingEngine:
             self.pool.release(slot)
             self._index.remove(slot)
         if failed is not None:
-            req.fail(failed, kind=kind)
-            self.metrics.count("requests_expired" if kind == "deadline"
-                               else "requests_cancelled")
+            # "nonfinite" evictions raise a plain RuntimeError for the
+            # caller and are counted via nonfinite_logit_fails at the
+            # guard, not as cancellations
+            transitioned = req.fail(
+                failed, kind="error" if kind == "nonfinite" else kind)
+            if transitioned:
+                if kind == "deadline":
+                    self.metrics.count("requests_expired")
+                elif kind != "nonfinite":
+                    self.metrics.count("requests_cancelled")
             return
-        req.finish()
-        self.metrics.record_completed(
-            req.finish_time - req.submit_time, len(req.generated))
+        if req.finish():
+            self.metrics.record_completed(
+                req.finish_time - req.submit_time, len(req.generated))
+            # feed the shed estimator: time this request held a slot
+            self.scheduler.observe_service(
+                req.finish_time - (req.admit_time or req.submit_time))
 
     @staticmethod
     def _fetch(tree):
@@ -823,6 +1252,22 @@ class ServingEngine:
         boundary. Per-request streams are token-exact vs K=1: slot
         rng/logits/KV chains never cross slots or sync boundaries."""
         K = self._sync_interval
+        inj = get_fault_injector()
+        if inj is not None:
+            # serving fault points (resilience/faults.py): stall the
+            # loop (watchdog bait), crash the iteration (supervisor
+            # bait), or NaN-poison ONE active slot's carried logits so
+            # the non-finite guard catches a REAL poisoned sample
+            call = inj.next_serve_step()
+            inj.maybe_serve_delay(call)
+            inj.check_serve_crash(call)
+            ordinal = inj.serve_nan_slot(call)
+            if ordinal is not None:
+                act = np.nonzero(self._active)[0]
+                if len(act):
+                    s = int(act[ordinal % len(act)])
+                    self._last_logits = self._last_logits.at[s].set(
+                        jnp.nan)
         if self._sampling_dirty:
             self._d_temps = jnp.asarray(self._temps)
             self._d_top_ks = jnp.asarray(self._top_ks)
@@ -848,6 +1293,13 @@ class ServingEngine:
             lp_steps.append(out[4])
         fetched = self._fetch((tok_steps, lp_steps))
         self.metrics.count("host_syncs")
+        if self._wedged:
+            # the watchdog flagged THIS iteration while it was in
+            # flight and already failed the slotted futures — do not
+            # consume results computed on state we no longer trust
+            raise EngineHungError(
+                "engine iteration exceeded the watchdog deadline "
+                "mid-dispatch")
         toks = [np.asarray(t) for t in fetched[0]]   # K x [S]
         tok_lp = [np.asarray(l) for l in fetched[1]]
         active_slots = np.nonzero(self._active)[0]
@@ -856,9 +1308,28 @@ class ServingEngine:
         for slot in active_slots:
             req = self._slot_req[slot]
             for k in range(K):
+                lp = float(tok_lp[k][slot])
+                if not math.isfinite(lp):
+                    # per-slot non-finite guard: NaN/inf logits poison
+                    # ONE request (numerical blowup, injected fault),
+                    # not the engine — fail it, free the slot, keep
+                    # every other slot decoding
+                    self.metrics.count("nonfinite_logit_fails")
+                    if K - 1 - k:
+                        self.metrics.count("wasted_decode_steps",
+                                           K - 1 - k)
+                    self._evict(
+                        slot,
+                        failed=(f"non-finite logits at position "
+                                f"{int(self._lengths[slot])} "
+                                f"(after {len(req.generated)} tokens); "
+                                "the poisoned request failed, the "
+                                "engine continues"),
+                        kind="nonfinite")
+                    break
                 first = not req.generated
                 tok = int(toks[k][slot])
-                req.append_token(tok, float(tok_lp[k][slot]))
+                req.append_token(tok, lp)
                 if first:
                     self.metrics.record_first_token(req.ttft)
                 self._lengths[slot] += 1

@@ -42,6 +42,15 @@ _BASE_COUNTERS = (
     # tokens whose forward was actually replaced by a region clone
     "prefix_hits", "prefix_hit_tokens", "prefill_tokens_saved",
     "prefill_chunks", "prefill_forward_tokens",
+    # overload & failure (docs/serving.md "Overload & failure
+    # behavior"): requests_shed = early load shedding at submit
+    # (subset of requests_rejected), preemptions = running slots
+    # evicted for a higher-priority arrival, engine_restarts =
+    # supervisor loop restarts after a crashed/hung step,
+    # nonfinite_logit_fails = per-slot NaN/inf-logits guard firings
+    # (the poisoned REQUEST fails, the engine survives)
+    "requests_shed", "preemptions", "engine_restarts",
+    "nonfinite_logit_fails",
 )
 
 
@@ -131,6 +140,8 @@ class ServingMetrics:
             "ttft_p50_ms": _percentile(ttft, 0.50) * 1e3,
             "ttft_p95_ms": _percentile(ttft, 0.95) * 1e3,
             "queue_wait_p50_ms": _percentile(qwait, 0.50) * 1e3,
+            "queue_wait_p95_ms": _percentile(qwait, 0.95) * 1e3,
+            "queue_wait_p99_ms": _percentile(qwait, 0.99) * 1e3,
             "latency_p50_ms": _percentile(lat, 0.50) * 1e3,
             "latency_p95_ms": _percentile(lat, 0.95) * 1e3,
             "tokens_per_s": self.tokens_per_s(),
@@ -138,15 +149,15 @@ class ServingMetrics:
         })
         # dispatch-overlap cadence (engine host_syncs / prefill_calls
         # counters): syncs per decode step — 1/decode_sync_interval —
-        # and prompts amortized per batched prefill call
+        # and prompts amortized per batched prefill call. Always
+        # present (0.0 before traffic) so the /metrics schema never
+        # mutates mid-run — scrapers key on a fixed key set.
         steps = counters.get("decode_steps", 0)
-        if counters.get("host_syncs"):
-            out["host_syncs_per_step"] = (
-                counters["host_syncs"] / max(steps, 1))
-        if counters.get("prefill_calls"):
-            out["prompts_per_prefill"] = (
-                counters.get("prefill_prompts", 0)
-                / counters["prefill_calls"])
+        out["host_syncs_per_step"] = (
+            counters.get("host_syncs", 0) / steps if steps else 0.0)
+        calls = counters.get("prefill_calls", 0)
+        out["prompts_per_prefill"] = (
+            counters.get("prefill_prompts", 0) / calls if calls else 0.0)
         return out
 
     def report(self, writer, step: Optional[int] = None):

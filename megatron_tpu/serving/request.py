@@ -12,6 +12,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import itertools
+import math
 import threading
 import time
 from typing import List, Optional
@@ -56,7 +57,8 @@ class GenRequest:
 
     def __init__(self, prompt: List[int], max_new_tokens: int,
                  sampling: SamplingOptions = SamplingOptions(),
-                 seed: int = 0):
+                 seed: int = 0, priority: int = 0,
+                 deadline_s: Optional[float] = None):
         assert prompt, "empty prompt"
         assert max_new_tokens >= 0, max_new_tokens
         self.id = next(_req_ids)
@@ -64,6 +66,21 @@ class GenRequest:
         self.max_new_tokens = int(max_new_tokens)
         self.sampling = sampling
         self.seed = int(seed)
+        # SLO fields: higher `priority` wins admission ordering and may
+        # preempt lower-priority running slots (ServingConfig.preemption);
+        # `deadline_s` overrides the engine-wide request_deadline_s for
+        # this request (None inherits the engine default)
+        self.priority = int(priority)
+        self.deadline_s = (None if deadline_s is None
+                           else float(deadline_s))
+        # a NaN deadline would make every expiry comparison False (an
+        # unreapable request) and poison the scheduler's EDF sort key
+        # for OTHER requests; the HTTP validator rejects these with a
+        # 400 before construction — this guards direct API callers
+        assert self.deadline_s is None or (
+            math.isfinite(self.deadline_s) and self.deadline_s > 0.0), (
+            f"deadline_s must be a finite number > 0, "
+            f"got {self.deadline_s}")
         self.state = RequestState.QUEUED
         self.generated: List[int] = []
         self.gen_logprobs: List[float] = []
@@ -83,6 +100,31 @@ class GenRequest:
         # pinned by the token-exact cache-on/off tests.
         self.prefix_len = 0
         self.prefill_chunks = 0
+        # preemption bookkeeping (engine thread): a preempted request
+        # re-queues carrying its resumption state — `resume_rng` is the
+        # HOST copy of the slot's PRNG key at preemption (the decode
+        # chain continues exactly where it stopped), `parked` holds the
+        # (sub_cache, last_logits_row) device refs sliced out of the
+        # victim slot (insert-only resume, no re-prefill). `parked` may
+        # be dropped (engine restart, park budget) — the request then
+        # replays its effective prompt through prefill, still
+        # token-exact because `resume_rng` survives on the host.
+        self.preemptions = 0
+        self.resume_rng = None
+        self.parked = None
+
+    def effective_prompt(self) -> List[int]:
+        """Tokens whose KV must be slot-resident before the next decode
+        step: the prompt plus everything generated so far. Equals
+        `prompt` for a never-preempted request."""
+        return self.prompt + self.generated
+
+    def absolute_deadline(self, default_s: Optional[float] = None
+                          ) -> Optional[float]:
+        """Monotonic-clock instant this request expires (per-request
+        deadline_s, else `default_s`, else None = no deadline)."""
+        d = self.deadline_s if self.deadline_s is not None else default_s
+        return None if d is None else self.submit_time + d
 
     def cancel(self):
         """Best-effort: a QUEUED request is dropped before admission; a
@@ -92,6 +134,12 @@ class GenRequest:
 
     # ---- engine side -------------------------------------------------
     def mark_admitted(self):
+        # never resurrect a terminal request: the watchdog (its own
+        # thread) may have failed this request while the engine was
+        # mid-admission — overwriting FAILED with RUNNING would make
+        # result() return partial tokens instead of raising
+        if self._done.is_set():
+            return
         self.state = RequestState.RUNNING
         self.admit_time = time.monotonic()
 
@@ -101,19 +149,34 @@ class GenRequest:
         self.generated.append(int(token))
         self.gen_logprobs.append(float(logprob))
 
-    def finish(self):
+    def finish(self) -> bool:
+        """First terminal transition wins: a request the engine
+        supervisor (or the hung-step watchdog, on its own thread)
+        already failed stays failed. Returns True when THIS call
+        transitioned the request."""
+        if self._done.is_set():
+            return False
         self.state = RequestState.FINISHED
         self.finish_time = time.monotonic()
         self._done.set()
+        return True
 
-    def fail(self, msg: str, kind: str = "error"):
+    def fail(self, msg: str, kind: str = "error") -> bool:
         """`kind` picks the exception `result()` raises: "deadline" →
-        DeadlineExceededError (504), anything else → RuntimeError."""
+        DeadlineExceededError (504), "unavailable" →
+        ServiceUnavailableError (503), anything else → RuntimeError.
+        Idempotent: the first terminal transition wins (the watchdog
+        and the engine loop may race to fail the same request).
+        Returns True when THIS call transitioned the request."""
+        if self._done.is_set():
+            return False
         self.state = RequestState.FAILED
         self.error = msg
         self.error_kind = kind
         self.finish_time = time.monotonic()
+        self.parked = None  # drop parked KV device refs promptly
         self._done.set()
+        return True
 
     # ---- caller side -------------------------------------------------
     def done(self) -> bool:
@@ -125,7 +188,10 @@ class GenRequest:
         inference/generation.py generate)."""
         if not self._done.wait(timeout):
             raise TimeoutError(f"request {self.id} still {self.state}")
-        if self.state is RequestState.FAILED:
+        # `error` is checked alongside state so a racing state write
+        # (admission bookkeeping vs the watchdog's fail) can never
+        # turn a failed request into a bogus success
+        if self.state is RequestState.FAILED or self.error is not None:
             kind = getattr(self, "error_kind", "error")
             if kind == "deadline":
                 raise DeadlineExceededError(

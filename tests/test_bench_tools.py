@@ -107,6 +107,27 @@ def test_serving_bench_emits_record(monkeypatch, tmp_path):
     assert rec["decode_steps"] >= 6  # 6 requests interleaved on 2 slots
 
 
+def test_serving_bench_overload_arm(monkeypatch, tmp_path):
+    """The overload arm (offered load > slot capacity, deadlines +
+    early shedding) must emit shed rate, goodput, and queue-delay
+    percentiles — and its accounting must cover every offered request."""
+    import json
+    text = run_tool(
+        monkeypatch, tmp_path, "serving_bench.py",
+        ["--overload", "--requests", "12", "--slots", "2",
+         "--prompt", "12", "--new", "6", "--deadline", "2.0",
+         "--layers", "2", "--hidden", "64", "--heads", "4",
+         "--vocab", "128", "--seq", "128"])
+    rec = json.loads(text)
+    assert rec["bench"] == "serving" and rec["mode"] == "overload"
+    assert 0.0 <= rec["shed_rate"] <= 1.0
+    assert 0.0 <= rec["goodput_frac"] <= 1.0
+    assert rec["queue_wait_p99_ms"] >= rec["queue_wait_p50_ms"] >= 0
+    # every offered request is accounted: shed, expired, or served
+    served = round(rec["goodput_frac"] * rec["requests"])
+    assert rec["shed"] + rec["expired_504"] + served == rec["requests"]
+
+
 def test_bench_prefix_emits_ab_record(monkeypatch, tmp_path):
     """The shared-prefix A/B must show the cache-on arm reusing prefix
     tokens (hits > 0, saved > 0) and forwarding strictly fewer REAL

@@ -787,6 +787,38 @@ def test_chaos_train_smoke(tmp_path):
     assert all(record["data_faults_detected"].values()), record
 
 
+@pytest.mark.slow
+def test_chaos_serve_smoke(tmp_path):
+    """tools/chaos_serve.py --smoke: overload + NaN slot + wedged
+    iteration + crash loop through a REAL engine — no stranded
+    futures, watchdog-restart recovery, breaker containment (ISSUE 6
+    acceptance drill)."""
+    import subprocess
+    import sys as _sys
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "chaos_serve.py")
+    out = str(tmp_path / "chaos_serve.json")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([_sys.executable, tool, "--smoke", "--out", out],
+                       capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    with open(out) as f:
+        record = json.load(f)
+    assert record["completed"] is True
+    for drill in ("overload", "hang", "crash_loop"):
+        assert record[drill]["ok"], record[drill]
+        assert record[drill]["outcomes"]["stranded"] == 0 \
+            if "outcomes" in record[drill] else True
+    assert record["overload"]["preemptions"] >= 1
+    assert record["overload"]["requests_shed"] >= 1
+    assert record["hang"]["engine_restarts"] >= 1
+    assert record["crash_loop"]["breaker_open"] is True
+    assert record["value"] is not None  # hang-recovery latency measured
+
+
 # ---------------------------------------------------------------------------
 # bit-exact resume: checkpointable data-iterator state (ISSUE 4 tentpole)
 # ---------------------------------------------------------------------------

@@ -1238,3 +1238,660 @@ class TestSeeding:
         srv2 = MegatronServer(gen, FakeTokenizer(),
                               serving=ServingConfig(serial_fallback=True))
         assert (srv2._seed_for({}), srv2._seed_for({})) != (a, b)
+
+
+class TestSLOAdmission:
+    """SLO-aware admission (scheduler units): the queue orders by
+    (priority desc, deadline asc, arrival), early shedding fails fast
+    with a retryable error + backoff hint, and requeue (the preemption
+    re-admission path) bypasses the bound and keeps arrival order."""
+
+    def _sched(self, **kw):
+        from megatron_tpu.serving.scheduler import AdmissionScheduler
+        base = dict(max_queue=16, max_total_len=64, num_slots=2)
+        base.update(kw)
+        return AdmissionScheduler(**base)
+
+    def _req(self, priority=0, deadline_s=None, plen=2):
+        return GenRequest(list(range(1, plen + 1)), 4,
+                          priority=priority, deadline_s=deadline_s)
+
+    def test_priority_then_edf_then_fifo(self):
+        s = self._sched()
+        r_low = self._req(priority=0)
+        r_hi_late = self._req(priority=1, deadline_s=50.0)
+        r_hi_soon = self._req(priority=1, deadline_s=1.0)
+        r_low_soon = self._req(priority=0, deadline_s=0.5)
+        for r in (r_low, r_hi_late, r_hi_soon, r_low_soon):
+            s.submit(r)
+        got = s.pop_ready(10)
+        # priority first; EDF within a level; deadline-less last (FIFO)
+        assert got == [r_hi_soon, r_hi_late, r_low_soon, r_low]
+        assert s.peek_priority() is None
+
+    def test_peek_priority_skips_cancelled(self):
+        s = self._sched()
+        hi, low = self._req(priority=3), self._req(priority=1)
+        s.submit(hi), s.submit(low)
+        assert s.peek_priority() == 3
+        hi.cancel()
+        assert s.peek_priority() == 1
+
+    def test_shed_requires_service_sample_then_sheds(self):
+        from megatron_tpu.serving import OverloadShedError
+        s = self._sched(shed_on_overload=True, num_slots=1)
+        s.active_fn = lambda: 1
+        # never sheds blind: no completion observed yet
+        s.submit(self._req(deadline_s=0.001))
+        s.observe_service(10.0)  # one slow completion observed
+        with pytest.raises(OverloadShedError) as ei:
+            s.submit(self._req(deadline_s=0.1))
+        assert ei.value.retry_after >= 1
+        assert ei.value.queue_depth == 1
+        # a deadline the estimate can meet is still admitted
+        s.submit(self._req(deadline_s=3600.0))
+        assert s.depth() == 2
+
+    def test_queue_full_carries_backoff_hint(self):
+        s = self._sched(max_queue=2)
+        s.submit(self._req()), s.submit(self._req())
+        with pytest.raises(QueueFullError) as ei:
+            s.submit(self._req())
+        assert ei.value.queue_depth == 2
+        assert ei.value.retry_after >= 1
+
+    def test_requeue_bypasses_bound_and_keeps_arrival_order(self):
+        s = self._sched(max_queue=2)
+        victim = self._req()     # earliest arrival id
+        later = self._req()
+        s.submit(later), s.submit(self._req())  # queue now full
+        assert s.requeue(victim)  # a victim is never bounced
+        assert s.depth() == 3
+        # same priority class: the requeued victim's ORIGINAL arrival
+        # id puts it ahead of later arrivals
+        assert s.pop_ready(1) == [victim]
+
+    def test_requeue_on_closed_scheduler_fails_503(self):
+        s = self._sched()
+        s.close()
+        r = self._req()
+        assert not s.requeue(r)
+        with pytest.raises(ServiceUnavailableError):
+            r.result(timeout=1)
+
+    def test_drop_expired_per_request_deadline_overrides_default(self):
+        s = self._sched()
+        tight = self._req(deadline_s=0.001)
+        slack = self._req(deadline_s=60.0)
+        inherit = self._req()  # inherits the default passed to drop
+        for r in (tight, slack, inherit):
+            s.submit(r)
+        expired = s.drop_expired(30.0, time.monotonic() + 1.0)
+        assert expired == [tight]
+        assert s.depth() == 2
+        with pytest.raises(Exception, match="deadline"):
+            tight.result(timeout=1)
+
+    def test_clear_parked_drops_device_refs(self):
+        s = self._sched()
+        r = self._req()
+        r.parked = ("sub", "logits")
+        s.submit(r)
+        assert s.parked_count() == 1
+        assert s.clear_parked() == 1
+        assert r.parked is None and s.parked_count() == 0
+
+    def test_new_overload_counters_in_fresh_snapshot(self):
+        snap = ServingMetrics().snapshot()
+        for key in ("requests_shed", "preemptions", "engine_restarts",
+                    "nonfinite_logit_fails"):
+            assert snap[key] == 0
+        for key in ("queue_wait_p95_ms", "queue_wait_p99_ms",
+                    "host_syncs_per_step", "prompts_per_prefill"):
+            assert snap[key] == 0.0
+
+
+class TestPreemption:
+    """Tentpole acceptance: a request preempted mid-decode and resumed
+    from its retained (parked) KV emits the IDENTICAL token sequence as
+    an un-preempted run — bf16 and int8 pools — and the decode step
+    compiles exactly once across the preemption."""
+
+    def _engine(self, gen, **kw):
+        base = dict(num_slots=1, max_queue=16, max_len=64,
+                    priority_levels=2, preemption=True)
+        base.update(kw)
+        return ServingEngine(gen, ServingConfig(**base))
+
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_preempted_resume_token_exact_single_compile(self, tiny_model,
+                                                         kv_dtype):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0,
+                        kv_cache_dtype=(jnp.int8 if kv_dtype else
+                                        jnp.bfloat16))
+        prompt, n, seed = [5, 17, 3, 42], 16, 9
+        sampling = SamplingOptions(temperature=0.9, top_k=5)
+        with self._engine(gen) as eng:
+            victim = eng.submit(prompt, n, sampling, seed=seed,
+                                priority=0)
+            # let it get properly mid-decode before the preemptor lands
+            t0 = time.monotonic()
+            while len(victim.generated) < 2 and not victim.done():
+                time.sleep(0.002)
+                assert time.monotonic() - t0 < 60
+            hp = eng.submit([7, 8, 9], 4, sampling, seed=11, priority=1)
+            hp_toks, _ = hp.result(timeout=300)
+            toks, _ = victim.result(timeout=300)
+            assert victim.preemptions >= 1  # it actually happened
+            snap = eng.metrics.snapshot()
+            assert snap["preemptions"] >= 1
+            assert eng._decode_traces == 1  # preemption = bookkeeping
+        want_toks, want_lens, _ = gen.generate(
+            [prompt], n, sampling=SamplingParams(temperature=0.9,
+                                                 top_k=5), seed=seed)
+        assert toks == want_toks[0, :want_lens[0]].tolist()
+        want_hp, hp_lens, _ = gen.generate(
+            [[7, 8, 9]], 4, sampling=SamplingParams(temperature=0.9,
+                                                    top_k=5), seed=11)
+        assert hp_toks == want_hp[0, :hp_lens[0]].tolist()
+
+    def test_replay_fallback_token_exact_after_parked_drop(self,
+                                                           tiny_model):
+        """When the parked KV is dropped (engine restart / park
+        budget), the victim replays its effective prompt through
+        prefill — still token-exact: the host-side PRNG copy carries
+        the decode chain across the gap."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        prompt, n, seed = [5, 17, 3, 42], 12, 13
+        sampling = SamplingOptions(temperature=0.9, top_k=5)
+        with self._engine(gen) as eng:
+            victim = eng.submit(prompt, n, sampling, seed=seed,
+                                priority=0)
+            t0 = time.monotonic()
+            while len(victim.generated) < 2 and not victim.done():
+                time.sleep(0.002)
+                assert time.monotonic() - t0 < 60
+            hp = eng.submit([7, 8, 9], 8, sampling, seed=11, priority=1)
+            # wait for the preemption, then drop the parked device refs
+            # (the engine-restart path) while the victim is queued
+            while victim.preemptions == 0 and not victim.done():
+                time.sleep(0.002)
+                assert time.monotonic() - t0 < 60
+            dropped = eng.scheduler.clear_parked()
+            hp.result(timeout=300)
+            toks, _ = victim.result(timeout=300)
+            assert victim.preemptions >= 1
+            assert dropped >= 1  # the fallback actually exercised
+        want_toks, want_lens, _ = gen.generate(
+            [prompt], n, sampling=SamplingParams(temperature=0.9,
+                                                 top_k=5), seed=seed)
+        assert toks == want_toks[0, :want_lens[0]].tolist()
+
+    def test_preemption_prefers_lowest_priority_youngest(self,
+                                                         tiny_model):
+        """With two running slots, the LOWEST-priority (tie: youngest)
+        one is evicted; an equal-or-higher-priority arrival never
+        preempts."""
+        params, cfg = tiny_model
+        # eos_id=-1: no early EOS, so both victims keep decoding until
+        # max_new — the preemption window is deterministic, not a race
+        # against sampling luck
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+        sampling = SamplingOptions(temperature=0.8)
+        with self._engine(gen, num_slots=2, priority_levels=3) as eng:
+            mid = eng.submit([5, 6, 7], 48, sampling, seed=1, priority=1)
+            low = eng.submit([8, 9], 48, sampling, seed=2, priority=0)
+            t0 = time.monotonic()
+            while (len(mid.generated) < 1 or len(low.generated) < 1):
+                time.sleep(0.002)
+                assert time.monotonic() - t0 < 60
+            # same priority as `low`: must NOT preempt (it queues);
+            # progress-based wait — several iterations pass untouched
+            peer = eng.submit([1, 2], 2, sampling, seed=3, priority=0)
+            mark = len(low.generated)
+            while len(low.generated) < mark + 3 and not low.done():
+                time.sleep(0.002)
+                assert time.monotonic() - t0 < 60
+            assert low.preemptions == 0 and mid.preemptions == 0
+            hi = eng.submit([3, 4], 2, sampling, seed=4, priority=2)
+            for r in (hi, peer, mid, low):
+                r.result(timeout=300)
+            assert low.preemptions >= 1  # lowest priority was the victim
+            assert mid.preemptions == 0
+
+
+class TestDeadlineMidChunkedPrefill:
+    """Satellite: a request whose deadline expires while MID-chunked-
+    prefill (the PR 5 pendings path) resolves 504 and its sub-cache
+    slot is reclaimed — interleaved with live decode that keeps
+    running."""
+
+    def test_expiry_mid_chunk_resolves_504_and_reclaims(self,
+                                                        tiny_model):
+        from megatron_tpu.serving import DeadlineExceededError
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        eng = ServingEngine(gen, ServingConfig(
+            num_slots=2, max_queue=8, max_len=64, prefill_chunk=4),
+            start=False)
+        try:
+            live = eng.submit([3, 4], 6, SamplingOptions(temperature=0.9,
+                                                         top_k=5),
+                              seed=1)
+            # 12 tokens -> 3 chunks; a deadline that expires mid-chunk
+            slow = eng.submit(list(range(1, 13)), 4,
+                              SamplingOptions(temperature=0.0),
+                              deadline_s=0.05)
+            eng._admit()
+            assert len(eng._prefilling) == 1
+            assert eng.pool.free_count() == 0
+            eng._advance_prefill()          # chunk 1 of 3 lands
+            assert eng._prefilling[0].pos == 4
+            eng._step()                     # live decode interleaves
+            assert len(live.generated) == 1
+            time.sleep(0.08)                # the deadline passes
+            eng._reap_expired()
+            assert slow.done() and not eng._prefilling
+            with pytest.raises(DeadlineExceededError):
+                slow.result(timeout=1)
+            assert eng.pool.free_count() == 1  # sub-cache slot reclaimed
+            assert eng.metrics.snapshot()["requests_expired"] == 1
+            # the live request decodes on to completion, token-exact
+            while not live.done():
+                eng._reap_expired()
+                eng._step()
+            toks, _ = live.result(timeout=1)
+        finally:
+            eng.close()
+        want, lens, _ = gen.generate(
+            [[3, 4]], 6, sampling=SamplingParams(temperature=0.9,
+                                                 top_k=5), seed=1)
+        assert toks == want[0, :lens[0]].tolist()
+
+
+class TestEngineSupervisor:
+    """Supervisor contracts (chaos tier): a crashed step restarts the
+    loop and fails only what it must; a crash loop trips the breaker;
+    a wedged iteration is detected by the watchdog and recovered; a
+    NaN-poisoned slot fails one REQUEST, not the engine."""
+
+    pytestmark = pytest.mark.chaos
+
+    def test_step_crash_restarts_and_serves_queued(self, tiny_model):
+        from megatron_tpu.resilience import (FaultInjector,
+                                             use_fault_injector)
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sampling = SamplingOptions(temperature=0.9, top_k=5)
+        with ServingEngine(gen, ServingConfig(
+                num_slots=1, max_queue=8, max_len=64,
+                max_engine_restarts=2)) as eng:
+            eng.generate([9, 9], 2, sampling, seed=0)  # warm compiles
+            with use_fault_injector(FaultInjector(
+                    serve_crash_calls={1})):
+                victim = eng.submit([1, 2, 3], 6, sampling, seed=1)
+                queued = eng.submit([4, 5], 4, sampling, seed=2)
+                with pytest.raises(RuntimeError, match="engine step"):
+                    victim.result(timeout=120)
+                toks, _ = queued.result(timeout=120)
+            snap = eng.metrics.snapshot()
+            health = eng.health()
+            assert snap["engine_restarts"] == 1
+            assert health["healthy"] and health["state"] == "running"
+        # the queued survivor is served token-exact after the restart
+        want, lens, _ = gen.generate(
+            [[4, 5]], 4, sampling=SamplingParams(temperature=0.9,
+                                                 top_k=5), seed=2)
+        assert toks == want[0, :lens[0]].tolist()
+
+    def test_crash_loop_trips_breaker_and_503s(self, tiny_model):
+        from megatron_tpu.resilience import (FaultInjector,
+                                             use_fault_injector)
+        from megatron_tpu.serving import EngineUnhealthyError
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sampling = SamplingOptions(temperature=0.8)
+        eng = ServingEngine(gen, ServingConfig(
+            num_slots=1, max_queue=8, max_len=64,
+            max_engine_restarts=0))
+        try:
+            eng.generate([9, 9], 2, sampling, seed=0)
+            with use_fault_injector(FaultInjector(
+                    serve_crash_calls=set(range(1, 32)))):
+                slotted = eng.submit([1, 2], 4, sampling, seed=1)
+                queued = eng.submit([3, 4], 4, sampling, seed=2)
+                with pytest.raises(RuntimeError):
+                    slotted.result(timeout=120)
+                # queued work resolves 503 (typed, retryable) — never
+                # stranded
+                with pytest.raises(ServiceUnavailableError):
+                    queued.result(timeout=120)
+            health = eng.health()
+            assert health["circuit_breaker_open"]
+            assert not health["healthy"]
+            assert health["state"] == "unhealthy"
+            assert eng.metrics.snapshot()["engine_restarts"] == 0
+            with pytest.raises(EngineUnhealthyError):
+                eng.submit([5], 2, sampling, seed=3)
+        finally:
+            eng.close()
+
+    def test_hung_iteration_watchdog_restart(self, tiny_model):
+        from megatron_tpu.resilience import (FaultInjector,
+                                             use_fault_injector)
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sampling = SamplingOptions(temperature=0.8)
+        with ServingEngine(gen, ServingConfig(
+                num_slots=1, max_queue=8, max_len=64,
+                engine_step_timeout_s=0.6, max_engine_restarts=2)) as eng:
+            # warmup completes an iteration -> watchdog armed
+            eng.generate([9, 9], 2, sampling, seed=0)
+            with use_fault_injector(FaultInjector(
+                    serve_delay_calls={1: 1.5})):
+                victim = eng.submit([1, 2], 8, sampling, seed=1)
+                t0 = time.monotonic()
+                with pytest.raises(RuntimeError, match="hung"):
+                    victim.result(timeout=120)
+                detect_s = time.monotonic() - t0
+                # failed by the watchdog DURING the stall, not after it
+                assert detect_s < 1.5
+                # the supervisor restarts once the stalled dispatch
+                # returns; fresh work completes
+                probe = eng.submit([3, 4], 2, sampling, seed=2)
+                probe.result(timeout=120)
+            snap = eng.metrics.snapshot()
+            health = eng.health()
+            assert snap["engine_restarts"] >= 1
+            assert health["healthy"] and health["state"] == "running"
+
+    def test_nonfinite_guard_fails_only_poisoned_slot(self, tiny_model):
+        from megatron_tpu.resilience import (FaultInjector,
+                                             use_fault_injector)
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sampling = SamplingOptions(temperature=0.9, top_k=5)
+        eng = ServingEngine(gen, ServingConfig(
+            num_slots=2, max_queue=8, max_len=64), start=False)
+        try:
+            ok_req = eng.submit([5, 17, 3], 5, sampling, seed=1)
+            poisoned = eng.submit([7, 8, 9], 5, sampling, seed=2)
+            eng._admit()  # one batched prefill: slots 0 and 1
+            with use_fault_injector(FaultInjector(
+                    serve_nan_calls={2: 1})):  # step 2, active slot 1
+                eng._step()  # both decode token 1
+                assert len(poisoned.generated) == 1
+                eng._step()  # slot 1's carried logits poisoned
+            assert poisoned.done()
+            with pytest.raises(RuntimeError, match="non-finite"):
+                poisoned.result(timeout=1)
+            assert eng.pool.free_count() == 1  # poisoned slot reclaimed
+            assert not ok_req.done()  # the grid keeps decoding
+            while not ok_req.done():
+                eng._step()
+            toks, _ = ok_req.result(timeout=1)
+            snap = eng.metrics.snapshot()
+            assert snap["nonfinite_logit_fails"] == 1
+            assert snap["engine_restarts"] == 0  # request died, not engine
+        finally:
+            eng.close()
+        want, lens, _ = gen.generate(
+            [[5, 17, 3]], 5, sampling=SamplingParams(temperature=0.9,
+                                                     top_k=5), seed=1)
+        assert toks == want[0, :lens[0]].tolist()
+
+
+class TestOverloadServerEndpoints:
+    """Satellite: 429/503 responses carry Retry-After + queue depth;
+    /healthz is the separate liveness probe; SLO payload fields
+    validate and pass through."""
+
+    @pytest.fixture(scope="class")
+    def server(self, tiny_model):
+        from megatron_tpu.inference.server import MegatronServer
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        srv = MegatronServer(gen, FakeTokenizer(),
+                             serving=ServingConfig(num_slots=2,
+                                                   max_queue=16,
+                                                   max_len=64))
+        yield srv
+        srv.close()
+
+    def test_healthz_healthy(self, server):
+        status, body = server.healthz()
+        assert status == 200
+        assert body["healthy"] and body["state"] == "running"
+        for key in ("circuit_breaker_open", "engine_restarts",
+                    "active_slots", "queue_depth", "num_slots"):
+            assert key in body
+
+    def test_healthz_serial_mode(self, tiny_model):
+        from megatron_tpu.inference.server import MegatronServer
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        srv = MegatronServer(gen, FakeTokenizer(),
+                             serving=ServingConfig(serial_fallback=True))
+        assert srv.healthz() == (200, {"healthy": True,
+                                       "serving": "serial"})
+
+    def test_429_carries_retry_after_and_queue_depth(self, tiny_model):
+        from megatron_tpu.inference.server import MegatronServer
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        srv = MegatronServer(gen, FakeTokenizer(),
+                             serving=ServingConfig(num_slots=1,
+                                                   max_queue=1,
+                                                   max_len=64))
+        srv.engine.close()
+        srv.engine = ServingEngine(
+            gen, ServingConfig(num_slots=1, max_queue=1, max_len=64),
+            start=False)
+        try:
+            srv.engine.submit([1, 2], 2)  # other traffic fills the queue
+            status, body = srv.handle({"prompts": ["a"],
+                                       "tokens_to_generate": 2})
+            assert status == 429
+            assert body["retry_after"] >= 1
+            assert body["queue_depth"] == 1
+            assert MegatronServer.response_headers(body) == {
+                "Retry-After": str(body["retry_after"])}
+        finally:
+            srv.close()
+
+    def test_unhealthy_engine_is_503_and_healthz_reports(self, tiny_model):
+        from megatron_tpu.inference.server import MegatronServer
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        srv = MegatronServer(gen, FakeTokenizer(),
+                             serving=ServingConfig(num_slots=1,
+                                                   max_queue=4,
+                                                   max_len=64))
+        try:
+            # breaker-open stand-in (the supervisor sets this after
+            # max_engine_restarts — see TestEngineSupervisor)
+            srv.engine._broken = "circuit breaker open after 2 restarts"
+            status, body = srv.handle({"prompts": ["a"],
+                                       "tokens_to_generate": 2})
+            assert status == 503
+            assert "circuit breaker" in body["message"]
+            assert body["retry_after"] >= 1 and "queue_depth" in body
+            hstatus, hbody = srv.healthz()
+            assert hstatus == 503
+            assert hbody["circuit_breaker_open"]
+            assert not hbody["healthy"]
+        finally:
+            srv.engine._broken = None
+            srv.close()
+
+    def test_bad_slo_fields_are_400(self, server):
+        for payload, frag in (
+                ({"prompts": ["x"], "priority": []}, "priority"),
+                ({"prompts": ["x"], "deadline_s": "soon"}, "deadline_s")):
+            status, body = server.handle(payload)
+            assert status == 400
+            assert frag in body["message"]
+
+    def test_slo_fields_pass_through(self, server):
+        status, body = server.handle({"prompts": ["hi"],
+                                      "tokens_to_generate": 2,
+                                      "priority": 1,
+                                      "deadline_s": 120.0})
+        assert status == 200 and len(body["text"]) == 1
+
+    def test_stdlib_healthz_endpoint(self, server):
+        import json as _json
+        import socket
+        import urllib.request
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        t = threading.Thread(target=server._run_stdlib,
+                             args=("127.0.0.1", port), daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=5) as resp:
+                    assert resp.status == 200
+                    body = _json.loads(resp.read())
+                break
+            except OSError:
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+        assert body["healthy"] and body["state"] == "running"
+
+    def test_healthz_503_while_draining(self, tiny_model):
+        """A draining replica rejects every new request — readiness
+        must pull it out of rotation, not keep reporting 200."""
+        from megatron_tpu.inference.server import MegatronServer
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        srv = MegatronServer(gen, FakeTokenizer(),
+                             serving=ServingConfig(num_slots=1,
+                                                   max_queue=4,
+                                                   max_len=64))
+        try:
+            assert srv.healthz()[0] == 200
+            assert srv.engine.drain(timeout=60)
+            status, body = srv.healthz()
+            assert status == 503
+            assert body["state"] == "draining"
+        finally:
+            srv.close()
+
+    def test_submit_after_close_is_typed_503(self, tiny_model):
+        """The submit-vs-close race window (breaker trip / drain
+        closing the queue between the engine's flag checks and the
+        enqueue) resolves as a typed, retryable 503 — never a bare
+        RuntimeError the HTTP layer would 500."""
+        from megatron_tpu.serving import EngineUnhealthyError
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        eng = ServingEngine(gen, ServingConfig(num_slots=1, max_queue=4,
+                                               max_len=64), start=False)
+        try:
+            eng.scheduler.close()  # the race, made deterministic
+            with pytest.raises(EngineUnhealthyError):
+                eng.scheduler.submit(GenRequest([1, 2], 2))
+        finally:
+            eng.close()
+
+    def test_preemption_requires_priority_levels(self, tiny_model):
+        """preemption with a single priority class is silently inert
+        (every request clamps to 0) — rejected loudly at validate()
+        AND by the engine constructor."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        with pytest.raises(AssertionError, match="priority_levels"):
+            ServingConfig(preemption=True).validate(cfg)
+        with pytest.raises(AssertionError, match="priority_levels"):
+            ServingEngine(gen, ServingConfig(num_slots=1, max_len=64,
+                                             preemption=True),
+                          start=False)
+
+    def test_nonfinite_or_nonpositive_deadline_is_400(self, server):
+        """json.loads parses NaN/Infinity: a NaN deadline would be
+        unreapable AND poison the scheduler's EDF sort key — rejected
+        at the boundary, and GenRequest guards direct callers."""
+        for bad in (float("nan"), float("inf"), 0.0, -1.0):
+            status, body = server.handle({"prompts": ["x"],
+                                          "tokens_to_generate": 1,
+                                          "deadline_s": bad})
+            assert status == 400, bad
+            assert "deadline_s" in body["message"]
+        with pytest.raises(AssertionError, match="deadline_s"):
+            GenRequest([1, 2], 2, deadline_s=float("nan"))
+
+    def test_restart_budget_decays_after_healthy_period(self, tiny_model):
+        """Isolated recovered faults spread over a long-lived replica
+        must not accumulate into a tripped breaker — consumed restarts
+        age out after RESTART_DECAY_S of healthy operation."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        eng = ServingEngine(gen, ServingConfig(num_slots=1, max_len=64),
+                            start=False)
+        try:
+            eng._restarts, eng._last_restart_t = 2, time.monotonic()
+            eng._maybe_decay_restarts()
+            assert eng._restarts == 2  # recent: still counts
+            eng._last_restart_t = (time.monotonic()
+                                   - eng.RESTART_DECAY_S - 1.0)
+            eng._maybe_decay_restarts()
+            assert eng._restarts == 0 and eng._last_restart_t is None
+        finally:
+            eng.close()
+
+    def test_watchdog_covers_mid_admit_pops(self, tiny_model):
+        """A wedge INSIDE a batched group-prefill dispatch leaves its
+        requests in neither _slot_req nor _prefilling — _on_hang must
+        still fail them (no stranded futures), via the _admitting
+        alias."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        eng = ServingEngine(gen, ServingConfig(
+            num_slots=2, max_queue=8, max_len=64,
+            engine_step_timeout_s=30.0), start=False)
+        try:
+            r = eng.submit([1, 2, 3], 4)
+            orig, seen = eng._prefill, {}
+
+            def wedged(*a):
+                # the watchdog fires while this dispatch is in flight
+                eng._on_hang()
+                seen["resolved_during_wedge"] = r.done()
+                return orig(*a)
+
+            eng._prefill = wedged
+            eng._admit()
+            assert seen["resolved_during_wedge"] is True
+            with pytest.raises(RuntimeError, match="hung"):
+                r.result(timeout=1)
+            assert eng._admitting == []  # cleared after the pass
+        finally:
+            eng.close()
+
+    def test_requeued_group_admission_records_wait_once(self,
+                                                        tiny_model):
+        """A restart-requeued request re-entering through the batched
+        group path must not push a second queue-wait sample (the
+        first-admission guard _start_pending/_resume_parked already
+        have)."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        eng = ServingEngine(gen, ServingConfig(num_slots=1, max_len=64),
+                            start=False)
+        try:
+            r = eng.submit([1, 2], 2)
+            r.mark_admitted()  # a pre-restart admission already happened
+            before = len(eng.metrics._queue_wait)
+            eng._admit()       # groupable path (no chunk, no hit)
+            assert eng._slot_req[0] is r  # it WAS re-admitted
+            assert len(eng.metrics._queue_wait) == before  # no resample
+        finally:
+            eng.close()

@@ -1,0 +1,299 @@
+"""Scripted serving chaos drill: overload + injected engine faults
+through a REAL engine, measure that nothing strands.
+
+tests/test_serving.py proves each overload/failure path in isolation;
+this tool composes them into ONE run the way a saturated replica's bad
+hour would — offered load far above slot capacity, a NaN-poisoned
+slot, a wedged decode iteration, a crash-looping step — and asserts
+the engine's three survival contracts end-to-end:
+
+1. **no stranded futures**: every submitted request resolves, as a
+   completion or a TYPED error (shed/504/503/RuntimeError) — never a
+   hang;
+2. **hang recovery**: a wedged iteration is detected by the watchdog
+   within `engine_step_timeout_s`, the in-flight futures fail, the
+   supervisor restarts the loop, and a fresh probe request completes;
+3. **crash-loop containment**: when every restart crashes again, the
+   circuit breaker trips after `max_engine_restarts`, queued work
+   resolves 503, `health()` reports unhealthy, and new submits raise
+   EngineUnhealthyError.
+
+Emits ONE BENCH-style JSON record on stdout (and to --out), like
+chaos_train.py, so hang-recovery regressions surface in the
+`BENCH_*.json` extras.
+
+  JAX_PLATFORMS=cpu python tools/chaos_serve.py --smoke [--out FILE]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_tpu.utils.platform import ensure_env_platform
+
+
+def _tiny_engine(serving_kwargs, hidden=64):
+    import jax
+
+    from megatron_tpu.config import ModelConfig, ServingConfig
+    from megatron_tpu.inference.generation import Generator
+    from megatron_tpu.models import language_model as lm
+    from megatron_tpu.serving import ServingEngine
+
+    cfg = ModelConfig(num_layers=2, hidden_size=hidden,
+                      num_attention_heads=2, num_kv_heads=1,
+                      vocab_size=128, seq_length=128,
+                      max_position_embeddings=128,
+                      make_vocab_size_divisible_by=64,
+                      compute_dtype="bfloat16").derived()
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    # eos_id=-1: no early EOS, so request lifetimes (and the overload
+    # backlog) are deterministic in max_new_tokens
+    gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+    serving = ServingConfig(**serving_kwargs).validate(cfg)
+    return ServingEngine(gen, serving), gen
+
+
+def _resolve_all(reqs, timeout=120.0):
+    """Resolve every future; classify outcomes. A timeout here IS the
+    stranded-future failure the drill exists to catch."""
+    out = {"ok": 0, "deadline_504": 0, "unavailable_503": 0,
+           "error": 0, "stranded": 0}
+    from megatron_tpu.serving import (DeadlineExceededError,
+                                      ServiceUnavailableError)
+    for r in reqs:
+        try:
+            r.result(timeout=timeout)
+            out["ok"] += 1
+        except DeadlineExceededError:
+            out["deadline_504"] += 1
+        except ServiceUnavailableError:
+            out["unavailable_503"] += 1
+        except TimeoutError:
+            out["stranded"] += 1
+        except Exception:  # noqa: BLE001 — typed-enough: it RESOLVED
+            out["error"] += 1
+    return out
+
+
+def overload_drill(new_tokens: int) -> dict:
+    """Offered load >> slot capacity with priorities, early shedding,
+    preemption, and one NaN-poisoned slot. Contract: every submitted
+    future resolves; sheds fail fast at submit; at least one
+    preemption fires and every preempted request still resolves."""
+    from megatron_tpu.resilience import FaultInjector, use_fault_injector
+    from megatron_tpu.serving import OverloadShedError, SamplingOptions
+
+    eng, _ = _tiny_engine(dict(
+        num_slots=2, max_queue=64, max_len=128, priority_levels=2,
+        shed_on_overload=True, preemption=True, max_engine_restarts=2))
+    sampling = SamplingOptions(temperature=1.0)
+    reqs, shed = [], 0
+    # NaN-poison one active slot a few steps in: the non-finite guard
+    # must fail exactly that REQUEST while the grid keeps decoding
+    injector = FaultInjector(serve_nan_calls={6: 0})
+    try:
+        with use_fault_injector(injector):
+            # warmup: compile + give the shed estimator its first
+            # service-time sample (it never sheds blind)
+            eng.generate([3, 1, 4], 2, sampling, seed=0)
+            # wave 1 — capacity pressure: low-priority work fills both
+            # slots and the queue ...
+            for i in range(6):
+                reqs.append(eng.submit([5 + i, 2, 7], new_tokens,
+                                       sampling, seed=i, priority=0))
+            # ... wait until low-priority work actually OCCUPIES the
+            # slots (otherwise the priority queue simply serves the
+            # high-priority wave first and nothing needs preempting) ...
+            t_wait = time.monotonic() + 30
+            while (eng.health()["active_slots"] < 2
+                   and time.monotonic() < t_wait):
+                time.sleep(0.002)
+            # ... then high-priority arrivals preempt running slots
+            for i in range(3):
+                reqs.append(eng.submit([9, 8 + i], max(new_tokens // 2, 2),
+                                       sampling, seed=100 + i,
+                                       priority=1))
+            # wave 2 — hopeless deadlines: the estimator (fed by the
+            # warmup completion) sheds these at SUBMIT time
+            for i in range(16):
+                try:
+                    reqs.append(eng.submit([2, i + 1], new_tokens,
+                                           sampling, seed=200 + i,
+                                           deadline_s=0.001))
+                except OverloadShedError:
+                    shed += 1
+            outcomes = _resolve_all(reqs)
+        snap = eng.metrics.snapshot()
+        health = eng.health()
+    finally:
+        eng.close()
+    fired = {k: sum(1 for f, _ in injector.fired if f == k)
+             for k in ("serve_nan",)}
+    return {
+        "submitted": len(reqs), "shed_at_submit": shed,
+        "outcomes": outcomes,
+        "preemptions": int(snap["preemptions"]),
+        "requests_shed": int(snap["requests_shed"]),
+        "nonfinite_logit_fails": int(snap["nonfinite_logit_fails"]),
+        "nan_faults_fired": fired["serve_nan"],
+        "healthy_after": bool(health["healthy"]),
+        "ok": (outcomes["stranded"] == 0
+               and shed + int(snap["requests_shed"]) >= 1
+               and int(snap["preemptions"]) >= 1
+               and int(snap["nonfinite_logit_fails"])
+               >= fired["serve_nan"] > 0
+               and health["healthy"]),
+    }
+
+
+def hang_drill(timeout_s: float, stall_s: float) -> dict:
+    """A wedged decode iteration: the watchdog must fail the in-flight
+    futures within its deadline and the supervisor must restart the
+    loop once the stalled dispatch returns — measured as the wall time
+    from the hang-victim's failure to a fresh probe completing."""
+    from megatron_tpu.resilience import FaultInjector, use_fault_injector
+    from megatron_tpu.serving import SamplingOptions
+
+    eng, _ = _tiny_engine(dict(
+        num_slots=1, max_queue=16, max_len=128,
+        engine_step_timeout_s=timeout_s, max_engine_restarts=2))
+    sampling = SamplingOptions(temperature=1.0)
+    try:
+        # warmup: compiles done AND the watchdog armed (it arms only
+        # after the first completed iteration)
+        eng.generate([1, 2, 3], 2, sampling, seed=0)
+        injector = FaultInjector(serve_delay_calls={1: stall_s})
+        with use_fault_injector(injector):
+            victim = eng.submit([4, 5], 8, sampling, seed=1)
+            t0 = time.monotonic()
+            try:
+                victim.result(timeout=stall_s + timeout_s + 30)
+                victim_failed = False
+            except TimeoutError:
+                victim_failed = False
+            except Exception:  # noqa: BLE001 — the watchdog failed it
+                victim_failed = True
+            detect_s = time.monotonic() - t0
+            # the supervisor restarts after the stalled dispatch
+            # returns; a fresh probe must then complete normally
+            probe = eng.submit([6, 7], 2, sampling, seed=2)
+            probe.result(timeout=60)
+            recovery_s = time.monotonic() - t0
+        health = eng.health()
+        snap = eng.metrics.snapshot()
+    finally:
+        eng.close()
+    return {
+        "watchdog_timeout_s": timeout_s, "stall_s": stall_s,
+        "victim_failed_typed": victim_failed,
+        "detect_s": round(detect_s, 3),
+        "recovery_s": round(recovery_s, 3),
+        "engine_restarts": int(snap["engine_restarts"]),
+        "healthy_after": bool(health["healthy"]),
+        "ok": (victim_failed and int(snap["engine_restarts"]) >= 1
+               # the victim must fail by watchdog detection (deadline +
+               # poll slack), i.e. strictly before the stalled dispatch
+               # itself would have returned and failed it anyway
+               and detect_s < stall_s + timeout_s
+               and health["healthy"] and health["state"] == "running"),
+    }
+
+
+def crash_loop_drill() -> dict:
+    """Every step crashes: the supervisor restarts max_engine_restarts
+    times, then trips the circuit breaker. Everything in flight or
+    queued resolves with a typed error, health() reports unhealthy,
+    and new submits raise EngineUnhealthyError (the server's 503)."""
+    from megatron_tpu.resilience import FaultInjector, use_fault_injector
+    from megatron_tpu.serving import EngineUnhealthyError, SamplingOptions
+
+    eng, _ = _tiny_engine(dict(
+        num_slots=1, max_queue=16, max_len=128, max_engine_restarts=1))
+    sampling = SamplingOptions(temperature=1.0)
+    try:
+        eng.generate([1, 2], 2, sampling, seed=0)  # warmup
+        injector = FaultInjector(
+            serve_crash_calls=set(range(1, 64)))
+        with use_fault_injector(injector):
+            reqs = [eng.submit([3 + i], 4, sampling, seed=i)
+                    for i in range(4)]
+            outcomes = _resolve_all(reqs, timeout=60)
+        health = eng.health()
+        snap = eng.metrics.snapshot()
+        try:
+            eng.submit([9], 2, sampling, seed=99)
+            submit_rejected_503 = False
+        except EngineUnhealthyError:
+            submit_rejected_503 = True
+    finally:
+        eng.close()
+    return {
+        "submitted": 4, "outcomes": outcomes,
+        "engine_restarts": int(snap["engine_restarts"]),
+        "breaker_open": bool(health["circuit_breaker_open"]),
+        "state": health["state"],
+        "submit_rejected_503": submit_rejected_503,
+        "ok": (outcomes["stranded"] == 0 and outcomes["ok"] == 0
+               and int(snap["engine_restarts"]) == 1
+               and health["circuit_breaker_open"]
+               and not health["healthy"]
+               and submit_rejected_503),
+    }
+
+
+def run_chaos(new_tokens: int, timeout_s: float, stall_s: float) -> dict:
+    t0 = time.monotonic()
+    overload = overload_drill(new_tokens)
+    hang = hang_drill(timeout_s, stall_s)
+    crash = crash_loop_drill()
+    wall_s = time.monotonic() - t0
+    ok = overload["ok"] and hang["ok"] and crash["ok"]
+    return {
+        "metric": "serve_chaos_hang_recovery_s",
+        "value": hang["recovery_s"],
+        "unit": (f"s hang-detect->restart->serve (watchdog "
+                 f"{timeout_s}s, stall {stall_s}s)"),
+        "vs_baseline": None,
+        "completed": ok,
+        "overload": overload,
+        "hang": hang,
+        "crash_loop": crash,
+        "wall_s": round(wall_s, 1),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny fixed scenario for bench extras / CI")
+    ap.add_argument("--new_tokens", type=int, default=24,
+                    help="decode length of the overload wave's requests")
+    ap.add_argument("--watchdog_s", type=float, default=1.0,
+                    help="engine_step_timeout_s for the hang drill")
+    ap.add_argument("--stall_s", type=float, default=3.0,
+                    help="injected serve_delay for the hang drill")
+    ap.add_argument("--out", type=str, default=None,
+                    help="also write the JSON record here")
+    args = ap.parse_args(argv)
+
+    ensure_env_platform()
+    if args.smoke:
+        args.new_tokens, args.watchdog_s, args.stall_s = 16, 1.0, 2.5
+
+    record = run_chaos(args.new_tokens, args.watchdog_s, args.stall_s)
+    line = json.dumps(record)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0 if record["completed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,17 +6,23 @@ load — the numbers a capacity plan needs: offered load vs sustained
 throughput, TTFT percentiles, slot occupancy. Emits ONE BENCH-style
 JSON record on stdout (and to --out), like bench.py.
 
-Two modes:
+Three modes:
 - in-process (default): builds a model (random params at the given
   shape), drives `ServingEngine` directly at `--rps` offered load
   (0 = submit everything at once);
 - `--url host:port`: fires the same load as concurrent HTTP PUTs at a
   RUNNING server (examples/serve.sh LOAD=1 wires this up). TTFT is not
   observable over the non-streaming HTTP contract, so the record
-  carries whole-request latency percentiles instead.
+  carries whole-request latency percentiles instead;
+- `--overload`: in-process engine driven past slot capacity with
+  per-request deadlines and early shedding on
+  (docs/serving.md "Overload & failure behavior") — reports shed rate,
+  goodput (completions within deadline, per second), and p99 queue
+  delay: the numbers an admission-control regression moves first.
 
   python tools/serving_bench.py [--requests N] [--slots N] [--rps R]
                                 [--prompt N] [--new N] [--out FILE]
+                                [--overload] [--deadline S]
 """
 from __future__ import annotations
 
@@ -38,14 +44,16 @@ def _percentile(vals, q):
     return p(sorted(vals), q)
 
 
-def _bench_engine(args) -> dict:
+def _build_workload(args, eos_id: int):
+    """Shared model/generator/prompt setup for the in-process arms —
+    one definition, so the engine and overload arms always measure
+    the same workload shape."""
     import jax
     import numpy as np
 
-    from megatron_tpu.config import ModelConfig, ServingConfig
+    from megatron_tpu.config import ModelConfig
     from megatron_tpu.inference.generation import Generator
     from megatron_tpu.models import language_model as lm
-    from megatron_tpu.serving import SamplingOptions, ServingEngine
 
     cfg = ModelConfig(
         num_layers=args.layers, hidden_size=args.hidden,
@@ -55,14 +63,31 @@ def _bench_engine(args) -> dict:
         make_vocab_size_divisible_by=64,
         compute_dtype="bfloat16").derived()
     params = lm.model_init(jax.random.PRNGKey(0), cfg)
-    gen = Generator(params, cfg, eos_id=0, pad_id=0)
-    serving = ServingConfig(num_slots=args.slots,
-                            max_queue=max(args.requests, 64))
+    gen = Generator(params, cfg, eos_id=eos_id, pad_id=0)
     rs = np.random.RandomState(0)
     prompts = [rs.randint(1, cfg.vocab_size,
                           size=rs.randint(max(args.prompt // 2, 1),
                                           args.prompt + 1)).tolist()
                for _ in range(args.requests)]
+    return gen, prompts
+
+
+def _pace(args, t0: float, i: int):
+    """Offered-load pacing shared by the in-process arms."""
+    if args.rps > 0:
+        target = t0 + i / args.rps
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+
+
+def _bench_engine(args) -> dict:
+    from megatron_tpu.config import ServingConfig
+    from megatron_tpu.serving import SamplingOptions, ServingEngine
+
+    gen, prompts = _build_workload(args, eos_id=0)
+    serving = ServingConfig(num_slots=args.slots,
+                            max_queue=max(args.requests, 64))
 
     with ServingEngine(gen, serving) as eng:
         # warmup: compile prefill buckets + the one decode step
@@ -71,11 +96,7 @@ def _bench_engine(args) -> dict:
         t0 = time.monotonic()
         reqs = []
         for i, p in enumerate(prompts):
-            if args.rps > 0:
-                target = t0 + i / args.rps
-                delay = target - time.monotonic()
-                if delay > 0:
-                    time.sleep(delay)
+            _pace(args, t0, i)
             reqs.append(eng.submit(p, args.new,
                                    SamplingOptions(temperature=1.0),
                                    seed=i))
@@ -97,6 +118,66 @@ def _bench_engine(args) -> dict:
         "ttft_p95_ms": round(_percentile(ttfts, 0.95) * 1e3, 1),
         "slot_occupancy": round(snap["slot_occupancy"], 3),
         "decode_steps": int(snap["decode_steps"]),
+    }
+
+
+def _bench_overload(args) -> dict:
+    """Offered load > slot capacity: every request carries a deadline,
+    the engine sheds what cannot make it (`shed_on_overload`) and
+    504s what expires anyway. Goodput counts completions WITHIN the
+    deadline — the engine enforces it, so every completion qualifies."""
+    from megatron_tpu.config import ServingConfig
+    from megatron_tpu.serving import (DeadlineExceededError,
+                                      QueueFullError, SamplingOptions,
+                                      ServingEngine)
+
+    # eos_id=-1: deterministic request lifetimes, so "offered load vs
+    # capacity" is controlled by --requests/--new, not sampling luck
+    gen, prompts = _build_workload(args, eos_id=-1)
+    serving = ServingConfig(num_slots=args.slots,
+                            max_queue=max(args.requests, 64),
+                            shed_on_overload=True,
+                            request_deadline_s=args.deadline)
+
+    with ServingEngine(gen, serving) as eng:
+        # warmup compiles AND seeds the shed estimator's service-time
+        # EWMA (it never sheds before the first observed completion);
+        # a per-request deadline override keeps the compile-heavy
+        # warmup from 504ing against the measured arm's tight default
+        eng.submit(prompts[0], args.new,
+                   SamplingOptions(temperature=1.0), seed=0,
+                   deadline_s=600.0).result(timeout=600)
+        t0 = time.monotonic()
+        reqs, shed = [], 0
+        for i, p in enumerate(prompts):
+            _pace(args, t0, i)
+            try:
+                reqs.append(eng.submit(p, args.new,
+                                       SamplingOptions(temperature=1.0),
+                                       seed=i))
+            except QueueFullError:  # shed (or bounded-queue overflow)
+                shed += 1
+        good, expired = 0, 0
+        for r in reqs:
+            try:
+                r.result(timeout=600)
+                good += 1
+            except DeadlineExceededError:
+                expired += 1
+        wall = time.monotonic() - t0
+        snap = eng.metrics.snapshot()
+    return {
+        "bench": "serving", "mode": "overload",
+        "slots": args.slots, "requests": args.requests,
+        "offered_rps": args.rps, "deadline_s": args.deadline,
+        "prompt_len_max": args.prompt, "new_tokens": args.new,
+        "wall_s": round(wall, 3),
+        "shed": shed, "expired_504": expired,
+        "shed_rate": round(shed / max(args.requests, 1), 3),
+        "goodput_rps": round(good / max(wall, 1e-9), 2),
+        "goodput_frac": round(good / max(args.requests, 1), 3),
+        "queue_wait_p99_ms": round(snap["queue_wait_p99_ms"], 1),
+        "queue_wait_p50_ms": round(snap["queue_wait_p50_ms"], 1),
     }
 
 
@@ -185,6 +266,12 @@ def main(argv=None):
                    help="max prompt length (engine mode draws uniform "
                         "lengths in [prompt/2, prompt])")
     p.add_argument("--new", type=int, default=32)
+    p.add_argument("--overload", action="store_true",
+                   help="overload arm: offered load > slot capacity "
+                        "with deadlines + early shedding; reports shed "
+                        "rate, goodput, p99 queue delay")
+    p.add_argument("--deadline", type=float, default=2.0,
+                   help="per-request deadline for the overload arm (s)")
     p.add_argument("--layers", type=int, default=4)
     p.add_argument("--hidden", type=int, default=256)
     p.add_argument("--heads", type=int, default=4)
@@ -192,7 +279,12 @@ def main(argv=None):
     p.add_argument("--seq", type=int, default=512)
     args = p.parse_args(argv)
 
-    record = _bench_url(args) if args.url else _bench_engine(args)
+    if args.url:
+        record = _bench_url(args)
+    elif args.overload:
+        record = _bench_overload(args)
+    else:
+        record = _bench_engine(args)
     line = json.dumps(record)
     print(line, flush=True)
     with open(args.out, "w") as f:
