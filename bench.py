@@ -354,6 +354,12 @@ def _run_extras():
         # chunking — ON CHIP this is the pending on-chip record for
         # the PR-5 serving work
         ("bench_prefix.py", [], "/tmp/bench_extras_prefix.log"),
+        # speculative-decoding A/B (PERF_NOTES serving section):
+        # k=0 baseline vs k in {2,4,8} on a decode-heavy workload —
+        # greedy arms assert token-agreement, the record is acceptance
+        # rate + accepted-tok/s vs the bench_decode HBM roofline; ON
+        # CHIP this is the pending record for the ISSUE-8 serving work
+        ("bench_spec.py", [], "/tmp/bench_extras_spec.log"),
         # resilience smoke: scripted chaos run (transient write fault +
         # NaN-streak rollback + corrupt-checkpoint fallback) — the
         # recovery-latency record makes regressions in the resilience

@@ -270,6 +270,15 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                         "at most this many finished slots keep their "
                         "KV for reuse (None retains all; they are "
                         "reclaimed lazily when admission needs a slot)")
+    g.add_argument("--speculative_k", type=int, default=0,
+                   help="serving: speculative decoding — propose this "
+                        "many draft tokens per running slot each "
+                        "iteration (self-drafting n-gram prompt-lookup "
+                        "by default) and verify all slots' drafts in "
+                        "one [slots, k+1]-token forward; greedy output "
+                        "stays token-exact vs non-speculative "
+                        "(0 disables; unsupported on rolling / "
+                        "flash-int8 pools — docs/serving.md)")
     g.add_argument("--priority_levels", type=int, default=1,
                    help="serving: distinct request priority classes — "
                         "requests carry priority in [0, levels); "
@@ -575,6 +584,7 @@ def config_from_args(args: argparse.Namespace,
             enable_prefix_cache=args.enable_prefix_cache,
             prefill_chunk=args.prefill_chunk,
             retained_slots=args.retained_slots,
+            speculative_k=args.speculative_k,
             priority_levels=args.priority_levels,
             shed_on_overload=args.shed_on_overload,
             preemption=args.preemption,

@@ -423,6 +423,25 @@ class ServingConfig:
     # reclaimed lazily when admission needs a slot anyway, so the only
     # cost of None is colder free-list slots.
     retained_slots: Optional[int] = None
+    # speculative decoding on the slot grid (docs/serving.md
+    # "Speculative decoding"): each engine iteration proposes k draft
+    # tokens per running slot (self-drafting n-gram prompt-lookup by
+    # default; ServingEngine(drafter=...) is the pluggable seam) and
+    # verifies ALL slots' drafts in ONE batched [slots, k+1]-token
+    # forward — k+1 committed tokens per weight stream when drafts
+    # accept, on the HBM-bandwidth-bound decode path. k is a
+    # compile-time bucket like prefill_bucket: one verify trace per
+    # enabled k, compiled alongside the (kept) plain decode step.
+    # Greedy rows accept by exact match (temperature=0 output is
+    # token-exact vs non-speculative); stochastic rows accept by
+    # standard point-mass rejection sampling (distribution-correct,
+    # not bit-reproducing the non-speculative RNG stream). 0 disables.
+    # Unsupported on ROLLING pools (a rejected draft's ring write
+    # already evicted history — the rewind invariant can't hold) and
+    # flash-impl int8 pools (the PR 5/6 offset-0-flash-vs-dequantized
+    # exclusion): validate() rejects both, the engine re-asserts on
+    # the RESOLVED pool dtype.
+    speculative_k: int = 0
     # --- overload & failure knobs (docs/serving.md "Overload &
     # failure behavior") -----------------------------------------------
     # distinct priority classes: requests carry priority in
@@ -485,6 +504,14 @@ class ServingConfig:
         assert self.max_engine_restarts >= 0, self.max_engine_restarts
         assert self.engine_step_timeout_s is None or \
             self.engine_step_timeout_s > 0.0, self.engine_step_timeout_s
+        assert self.speculative_k >= 0, self.speculative_k
+        if self.speculative_k:
+            max_len = self.max_len
+            if max_len is None and model is not None:
+                max_len = model.max_position_embeddings
+            assert max_len is None or self.speculative_k < max_len, (
+                f"speculative_k={self.speculative_k} must be smaller "
+                f"than the slot capacity (max_len={max_len})")
         if model is not None and model.sliding_window is not None:
             # ROLLING pools (flash impl caps the region to W < max_len)
             # hold the last W positions ring-ordered by the SOURCE's
@@ -512,6 +539,14 @@ class ServingConfig:
                 "replay continuation at offset>0) could read "
                 "already-evicted positions. Serve this model without "
                 "preemption.")
+            assert not (rolling and self.speculative_k), (
+                "speculative_k is unsupported on ROLLING "
+                "(sliding-window) KV pools: the verify window's ring "
+                "writes evict history as they land, so rewinding to "
+                "the accepted length cannot restore what a rejected "
+                "draft overwrote — the write-before-read rewind "
+                "invariant breaks. Serve this model without "
+                "speculative decoding.")
         if (model is not None and model.attention_impl == "flash"
                 and self.kv_dtype == "int8"):
             # the flash impl's OFFSET-0 prefill reads the RAW k/v
@@ -524,13 +559,15 @@ class ServingConfig:
             # an int8 Generator.)
             assert not (self.enable_prefix_cache
                         or self.prefill_chunk is not None
-                        or self.preemption), (
-                "enable_prefix_cache/prefill_chunk/preemption are "
-                "unsupported on flash-impl int8 KV pools: the offset-0 "
-                "flash prefill reads raw k/v while offset>0 "
-                "continuations (and a preemption replay) read the "
-                "dequantized cache, so outputs would not be "
-                "token-exact. Use the dot impl or a bf16/f32 pool.")
+                        or self.preemption
+                        or self.speculative_k), (
+                "enable_prefix_cache/prefill_chunk/preemption/"
+                "speculative_k are unsupported on flash-impl int8 KV "
+                "pools: the offset-0 flash prefill reads raw k/v while "
+                "offset>0 continuations (a preemption replay, a "
+                "verify window) read the dequantized cache, so "
+                "outputs would not be token-exact. Use the dot impl "
+                "or a bf16/f32 pool.")
         assert self.request_deadline_s is None or \
             self.request_deadline_s > 0.0, self.request_deadline_s
         assert self.kv_dtype is None or \

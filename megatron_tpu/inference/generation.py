@@ -137,6 +137,38 @@ def prefill_chunk(params, tokens, caches, cfg: ModelConfig, *, rope,
     return caches, last
 
 
+def verify_tokens(params, tokens, caches, cfg: ModelConfig, *, rope,
+                  lengths, max_len: int):
+    """Forward a [slots, w]-token window through the slot-grid cache at
+    per-row offsets `lengths` and return (logits [slots, w, Vp], caches).
+
+    The speculative-decode verify primitive (serving/engine.py
+    `--speculative_k`): `prefill_chunk`'s continuation form generalized
+    from batch-1/scalar-offset to the whole grid with vector offsets —
+    row i's w tokens append at positions lengths[i]..lengths[i]+w-1,
+    each query causally masked from its row's own offset
+    (models/attention.py grid-batched multi-token append). Rows parked
+    at the capacity clamp write nothing past max_len-1 (the scatter
+    drops out-of-region indices) and their rope positions clamp to the
+    table — garbage logits for garbage rows, discarded by the caller's
+    accept mask, never an OOB read/write. The caller owns the offset
+    bookkeeping: committed length after acceptance is a REWIND of the
+    window (lengths + accepted + 1 <= lengths + w), and rejected
+    positions' KV is overwritten write-before-read by the next
+    dispatch, the same invariant bucket-padded prefill relies on."""
+    w = tokens.shape[1]
+    L = caches.offset.shape[0]
+    caches = caches._replace(offset=jnp.broadcast_to(
+        lengths[None, :], (L, lengths.shape[0])).astype(jnp.int32))
+    positions = jnp.minimum(lengths[:, None] + jnp.arange(w)[None, :],
+                            jnp.int32(max_len - 1))
+    logits, caches = lm.model_forward(params, tokens, cfg,
+                                      kv_caches=caches,
+                                      position_ids=positions, rope=rope,
+                                      logits_dtype=jnp.float32)
+    return logits, caches
+
+
 def _decode_fn(params, tokens, lengths, rng, *, cfg: ModelConfig,
                max_len: int, min_prompt: int, sp: SamplingParams,
                eos_id: int, pad_id: int, rope, kv_dtype=jnp.bfloat16):

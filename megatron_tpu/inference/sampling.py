@@ -76,7 +76,7 @@ def _top_p_filter_rows(logits, p):
 
 
 def sample_batched(rngs, logits, *, temperature, top_k, top_p,
-                   vocab_size: int | None = None):
+                   vocab_size: int | None = None, banned=None):
     """One sampling step with PER-ROW keys and sampling params — the
     continuous-batching engine's path (serving/engine.py), where one
     compiled decode step serves slots carrying different requests.
@@ -88,7 +88,19 @@ def sample_batched(rngs, logits, *, temperature, top_k, top_p,
     bit-exactly: the filters are the same row-wise math with traced
     instead of static knobs, and a vmapped `categorical` over a [V] row
     draws the same threefry bits as the serial [1, V] call (the counter
-    stream depends only on the key and the element count)."""
+    stream depends only on the key and the element count).
+
+    `banned` (int32 [b], < 0 disables a row): mask ONE token per row
+    out of the PROCESSED distribution — i.e. AFTER temperature/top-k/
+    top-p, so the result is exactly the renormalized residual
+    norm(max(p - q, 0)) of point-mass rejection sampling against draft
+    q = delta(banned) (speculative decoding, serving engine). Applied
+    post-filter on purpose: masking before top-k would admit a
+    replacement token the original distribution filtered out. Greedy
+    rows ignore the ban — a greedy rejection already implies
+    banned != argmax, so the residual of the argmax point mass IS the
+    unchanged argmax. Rows with banned < 0 are bit-identical to the
+    banned=None call (the categorical consumes the same key bits)."""
     logits = logits.astype(jnp.float32)
     if vocab_size is not None and vocab_size < logits.shape[-1]:
         iota = jnp.arange(logits.shape[-1])
@@ -98,6 +110,44 @@ def sample_batched(rngs, logits, *, temperature, top_k, top_p,
     x = logits / jnp.maximum(temperature, 1e-6)[:, None]
     x = _top_k_filter_rows(x, top_k)
     x = _top_p_filter_rows(x, top_p)
+    if banned is not None:
+        iota = jnp.arange(x.shape[-1])
+        x = jnp.where((banned >= 0)[:, None]
+                      & (iota[None, :] == banned[:, None]), -jnp.inf, x)
     sampled = jax.vmap(
         lambda r, row: jax.random.categorical(r, row, axis=-1))(rngs, x)
     return jnp.where(greedy_rows, greedy, sampled).astype(jnp.int32)
+
+
+def verify_draft_probs(logits, drafts, *, temperature, top_k, top_p,
+                       vocab_size: int | None = None):
+    """Per-(row, position) acceptance inputs for speculative decoding.
+
+    logits: [b, w, vocab] — the verify forward's outputs, position j
+    holding the model's distribution for the token draft[:, j] claims;
+    drafts: [b, w] int32; temperature/top_p: float32 [b]; top_k:
+    int32 [b] (per-ROW knobs, shared across the row's positions).
+
+    Returns (probs [b, w] float32, greedy_targets [b, w] int32):
+    `probs[i, j]` is the PROCESSED probability of drafts[i, j] — the
+    same temperature/top-k/top-p pipeline `sample_batched` draws from,
+    which is what point-mass rejection sampling must accept against
+    (accept with probability min(1, p(d)/q(d)) = p(d) for q = delta(d));
+    `greedy_targets` is the plain argmax (greedy rows accept by exact
+    match). The [b, w] grid folds to [b*w] rows with each row's knobs
+    repeated, so the filters are bit-identical to a serial
+    one-position-at-a-time verify of the same logits."""
+    b, w, V = logits.shape
+    x = logits.astype(jnp.float32).reshape(b * w, V)
+    if vocab_size is not None and vocab_size < V:
+        iota = jnp.arange(V)
+        x = jnp.where(iota < vocab_size, x, -jnp.inf)
+    greedy_targets = jnp.argmax(x, axis=-1).astype(jnp.int32)
+    temp = jnp.repeat(temperature, w)
+    x = x / jnp.maximum(temp, 1e-6)[:, None]
+    x = _top_k_filter_rows(x, jnp.repeat(top_k, w))
+    x = _top_p_filter_rows(x, jnp.repeat(top_p, w))
+    p = jax.nn.softmax(x, axis=-1)
+    probs = jnp.take_along_axis(
+        p, drafts.reshape(b * w, 1).astype(jnp.int32), axis=-1)[:, 0]
+    return probs.reshape(b, w), greedy_targets.reshape(b, w)

@@ -217,16 +217,18 @@ def attention_apply(
         q_offset = kv_cache.offset
         # PER-SLOT offsets (vector [b]): every batch row sits at its own
         # sequence position — the continuous-batching engine's slot grid
-        # (serving/engine.py), where one compiled s==1 decode step serves
-        # requests of different lengths. Multi-token chunks with per-row
-        # offsets would need per-row dynamic slices; the engine prefills
-        # each request at batch=1 with a scalar offset instead.
+        # (serving/engine.py). s == 1 is the classic decode step; s > 1
+        # is the GRID-BATCHED multi-token append (the speculative-decode
+        # verify window, serving engine `--speculative_k`): row i writes
+        # its s tokens at positions offset[i]..offset[i]+s-1 and the
+        # causal mask starts at each row's own offset — prefill_chunk's
+        # continuation form generalized from batch-1/scalar-offset to
+        # the whole grid with vector offsets.
         per_slot = jnp.ndim(q_offset) == 1
         if per_slot:
-            assert s == 1 and not cross, (
-                "per-slot (vector) KV-cache offsets support only s == 1 "
-                "self-attention decode steps; prefill requests at "
-                "batch=1 with a scalar offset and insert into the pool")
+            assert not cross, (
+                "per-slot (vector) KV-cache offsets support only "
+                "self-attention")
         if position_ids is None:
             if per_slot:
                 position_ids = q_offset[:, None] + jnp.arange(s)[None, :]
@@ -263,9 +265,14 @@ def attention_apply(
     # offset-0 condition is ENFORCED below with a lax.cond: an
     # offset > 0 chunk gets the correct cached dot path, not silently
     # wrong flash over the fresh chunk only.
+    # per_slot excluded: a grid-batched s > 1 append (speculative
+    # verify) has VECTOR offsets — never all-zero (active rows sit at
+    # len >= 1), so the offset-0 flash shortcut can't apply and the
+    # lax.cond predicate below wouldn't even be a scalar; it takes the
+    # cached dot path, the same path the s == 1 grid decode uses.
     prefill_flash = (cfg.attention_impl == "flash" and kv_cache is not None
                      and s > 1 and segment_ids is None and causal
-                     and not cross and not dropout_active)
+                     and not cross and not dropout_active and not per_slot)
     k_raw, v_raw = k, v
 
     kv_positions = None
@@ -284,23 +291,39 @@ def attention_apply(
             ki, ks = quantize_rows(k)  # per (b, token, head) over head_dim
             vi, vs = quantize_rows(v)
         if per_slot:
-            # serving slot grid: row i writes its s==1 k/v at its own
-            # offset[i] (one scatter, [b] index vectors) — through the
-            # ring (position % W) when the buffer is rolling
-            rows = jnp.arange(b)
-            slots = kv_cache.offset % cap if rolling else kv_cache.offset
-
+            # serving slot grid: row i writes its s tokens' k/v at its
+            # own offset[i]..offset[i]+s-1 (one scatter, [b, s] index
+            # grids) — through the ring (position % W) when the buffer
+            # is rolling. s > 1 is the speculative-verify window; its
+            # rewind invariant (rejected-position KV overwritten
+            # write-before-read) cannot hold on a rolling ring, so the
+            # engine excludes that combination (ServingConfig.validate).
+            assert s == 1 or not rolling, (
+                "per-slot multi-token appends (speculative verify) are "
+                "undefined on ROLLING caches: a rejected draft's ring "
+                "write already evicted history — see "
+                "ServingConfig.validate")
+            rows = jnp.arange(b)[:, None]
+            slots = kv_cache.offset[:, None] + jnp.arange(s)[None, :]
+            if rolling:
+                slots = slots % cap
+            # mode="drop": a row parked at the capacity clamp
+            # (serving/engine.py keeps device lengths <= max_len-1)
+            # would index past the region with s > 1 — those writes are
+            # garbage for garbage rows and must vanish, not wrap or
+            # collide nondeterministically at cap-1
             def wr(buf, val):
-                return buf.at[rows, slots].set(val[:, 0].astype(buf.dtype))
+                return buf.at[rows, slots].set(val.astype(buf.dtype),
+                                               mode="drop")
 
             if quant:
                 kv_cache = KVCache(wr(kv_cache.k, ki), wr(kv_cache.v, vi),
-                                   kv_cache.offset + 1,
+                                   kv_cache.offset + s,
                                    wr(kv_cache.k_scale, ks),
                                    wr(kv_cache.v_scale, vs))
             else:
                 kv_cache = KVCache(wr(kv_cache.k, k), wr(kv_cache.v, v),
-                                   kv_cache.offset + 1)
+                                   kv_cache.offset + s)
             if rolling:
                 # per-row map: slot j holds the largest p <= t_last[row]
                 # with p % W == j (sentinel for never-written slots)

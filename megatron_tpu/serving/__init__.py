@@ -12,3 +12,5 @@ from megatron_tpu.serving.request import (  # noqa: F401
 from megatron_tpu.serving.scheduler import (  # noqa: F401
     AdmissionError, AdmissionScheduler, EngineUnhealthyError,
     FIFOScheduler, OverloadShedError, QueueFullError)
+from megatron_tpu.serving.spec_decode import (  # noqa: F401
+    Drafter, NGramDrafter)

@@ -47,6 +47,28 @@ TPU-native:
   cache's offset) and lands in the slot region with one
   `insert_prefill` when the last chunk completes.
 
+- Speculative decoding on the slot grid (`speculative_k`, Leviathan
+  et al. — PAPERS.md): steady-state decode streams all params + the KV
+  slice to emit ONE token per slot, so it is HBM-bandwidth-bound. Each
+  engine iteration instead proposes k draft tokens per running slot
+  (host-side self-drafting n-gram prompt-lookup by default;
+  `drafter=` is the pluggable seam) and verifies ALL slots' drafts in
+  ONE batched [slots, k+1]-token forward — the multi-token append at
+  nonzero offset (`generation.prefill_chunk`) generalized to the grid
+  with per-slot vector offsets (`generation.verify_tokens`). Greedy
+  rows accept by exact match (token-exact vs non-speculative);
+  stochastic rows by standard point-mass rejection sampling, with the
+  residual distribution carried as a per-slot banned token into the
+  next round's first sample. Per-slot accept counts ride the
+  device-resident lengths, so the cache offset simply REWINDS to the
+  accepted length and rejected-position KV is overwritten
+  write-before-read — the invariant bucketed prefill already relies
+  on. k is a compile-time bucket: the decode+verify pair compiles
+  exactly once, and the whole thing composes with
+  `decode_sync_interval=K` chaining (accept counts and the residual
+  carry stay on device between syncs), preemption, and the prefix
+  cache (a parked or retained slot carries only committed tokens —
+  draft state is host-side and droppable).
 - Overload robustness (docs/serving.md "Overload & failure behavior"):
   admission is priority + earliest-deadline-first with optional early
   load shedding (serving/scheduler.py), and a queued higher-priority
@@ -88,8 +110,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from megatron_tpu.inference.generation import Generator, prefill_chunk
-from megatron_tpu.inference.sampling import sample_batched
+from megatron_tpu.inference.generation import (Generator, prefill_chunk,
+                                               verify_tokens)
+from megatron_tpu.inference.sampling import (sample_batched,
+                                             verify_draft_probs)
 from megatron_tpu.models import language_model as lm
 from megatron_tpu.resilience.faults import get_fault_injector
 from megatron_tpu.serving.kv_pool import (SlotKVPool, insert_prefill,
@@ -101,6 +125,8 @@ from megatron_tpu.serving.request import (GenRequest, RequestState,
 from megatron_tpu.serving.scheduler import (AdmissionScheduler,
                                             EngineUnhealthyError,
                                             OverloadShedError)
+from megatron_tpu.serving.spec_decode import (NGramDrafter,
+                                              build_draft_rounds)
 from megatron_tpu.utils.logging import print_rank_0
 
 from megatron_tpu.config import SERVING_KV_DTYPES as _KV_DTYPES
@@ -153,7 +179,7 @@ class ServingEngine:
     def __init__(self, generator: Generator, serving=None,
                  metrics: Optional[ServingMetrics] = None,
                  writer=None, report_interval: int = 100,
-                 start: bool = True):
+                 start: bool = True, drafter=None):
         from megatron_tpu.config import ServingConfig
         self.gen = generator
         cfg = generator.cfg
@@ -206,6 +232,26 @@ class ServingEngine:
             "enable_prefix_cache/prefill_chunk/preemption are "
             "unsupported on flash-impl int8 KV pools — see "
             "ServingConfig.validate")
+        # speculative decoding: re-assert ServingConfig.validate with
+        # the RESOLVED pool dtype/layout (validate only sees an
+        # explicit kv_dtype string / sliding_window; engines can be
+        # constructed without it)
+        self._spec_k = int(self.serving.speculative_k or 0)
+        assert not (self._spec_k and self.pool.rolling), (
+            "speculative_k is unsupported on ROLLING (sliding-window) "
+            "KV pools: the verify window's ring writes evict history, "
+            "so the accepted-length rewind cannot restore what a "
+            "rejected draft overwrote — see ServingConfig.validate")
+        assert not (self._spec_k and cfg.attention_impl == "flash"
+                    and self.pool.dtype == jnp.dtype(jnp.int8)), (
+            "speculative_k is unsupported on flash-impl int8 KV pools "
+            "(the PR 5/6 offset-0-flash-vs-dequantized-cache "
+            "exclusion) — see ServingConfig.validate")
+        assert self._spec_k < self.max_len, (self._spec_k, self.max_len)
+        self.drafter = drafter if drafter is not None else NGramDrafter()
+        # test seam: set to a list to record per-round (window tokens,
+        # accept counts) for the serial-replay exactness pin
+        self._spec_trace = None
         self._index = PrefixIndex(max(self.serving.prefill_bucket, 1))
         # a retained slot's KV is reclaimed lazily (alloc / retain
         # overflow) — forget its prefixes the moment that happens
@@ -247,6 +293,12 @@ class ServingEngine:
         self._d_temps = jnp.asarray(self._temps)
         self._d_top_ks = jnp.asarray(self._top_ks)
         self._d_top_ps = jnp.asarray(self._top_ps)
+        # speculative-decode residual carry: per-slot token a stochastic
+        # rejection banned from the NEXT first sample (-1 = none); the
+        # host mirror is exact at sync boundaries (it rides the window
+        # fetch) and re-uploads with the lengths on slot churn
+        self._reject = np.full(S, -1, np.int32)
+        self._d_reject = jnp.asarray(self._reject)
         self._sampling_dirty = True
         self._lengths_dirty = True
         self._sync_interval = max(self.serving.decode_sync_interval, 1)
@@ -260,7 +312,15 @@ class ServingEngine:
         # flight hits the CPU jax 0.4.x donation-aliasing bug the
         # rollback path in training/loop.py documents (observed here as
         # rare wrong tokens on the 8-virtual-device CPU mesh)
-        self._decode = self.gen._jit(self._decode_fn, n_array_args=7,
+        self._decode = self.gen._jit(self._decode_fn, n_array_args=8,
+                                     donate_argnums=(1, 2, 3))
+        # speculative verify: ONE trace for the enabled k (drafts are
+        # a fixed [S, k] shape — k is a compile-time bucket), compiled
+        # alongside the decode step the first window dispatches it.
+        # Same donation set and the same lengths/rejects no-donate rule
+        # as _decode (both chain device-side across a window).
+        self._verify_traces = 0
+        self._verify = self.gen._jit(self._verify_fn, n_array_args=9,
                                      donate_argnums=(1, 2, 3))
         # one jit; jax retraces per (batch-bucket, padded prompt length)
         # combo (both bucketed — _prefill_bucket / _batch_bucket — so
@@ -467,7 +527,7 @@ class ServingEngine:
     # device programs
     # ------------------------------------------------------------------
     def _decode_fn(self, params, pool, last_logits, rngs, lengths,
-                   temps, top_ks, top_ps):
+                   temps, top_ks, top_ps, rejects):
         """ONE interleaved decode step for the whole slot grid: sample
         each slot's next token from its carried logits, then forward all
         slots' tokens (s=1) through the model with per-slot positions.
@@ -483,14 +543,25 @@ class ServingEngine:
         max_len-1 only ever binds for rows idling past their eviction
         inside a window — admission guarantees a live row never needs a
         position past max_len-1 — and keeps their rope/cache indices in
-        bounds until the boundary re-upload re-parks them."""
+        bounds until the boundary re-upload re-parks them.
+
+        `rejects` is the speculative residual carry: when a
+        speculative window's last verify round ended in a stochastic
+        rejection, the next sample for that slot must draw from the
+        residual distribution — the processed distribution with the
+        rejected draft masked out — so a plain decode step dispatched
+        after it (drafter came up empty → spec_fallback_steps) applies
+        the ban and returns it CLEARED. Non-speculative engines always
+        pass all -1, which is bit-identical to the pre-speculative
+        step (sample_batched's banned<0 contract)."""
         self._decode_traces += 1
         cfg = self.cfg
         split = jax.vmap(jax.random.split)(rngs)  # [S, 2, 2]
         new_rngs, step_keys = split[:, 0], split[:, 1]
         toks = sample_batched(step_keys, last_logits,
                               temperature=temps, top_k=top_ks,
-                              top_p=top_ps, vocab_size=cfg.vocab_size)
+                              top_p=top_ps, vocab_size=cfg.vocab_size,
+                              banned=rejects)
         # logprob of the chosen token under the RAW carried logits —
         # the serial path's convention (generation.py _decode_fn)
         lp = jax.nn.log_softmax(last_logits, axis=-1)
@@ -506,7 +577,115 @@ class ServingEngine:
             logits_dtype=jnp.float32)
         new_lengths = jnp.minimum(lengths + 1,
                                   jnp.int32(self.max_len - 1))
-        return pool, logits[:, 0], new_rngs, toks, tok_lp, new_lengths
+        return (pool, logits[:, 0], new_rngs, toks, tok_lp, new_lengths,
+                jnp.full_like(rejects, -1))
+
+    def _verify_fn(self, params, pool, last_logits, rngs, lengths,
+                   temps, top_ks, top_ps, drafts, rejects):
+        """ONE speculative draft/verify round for the whole slot grid
+        (`speculative_k`): sample each slot's next token t0 from its
+        carried logits (the residual distribution when `rejects` bans
+        last round's rejected draft), forward [t0, d_1..d_k] — all
+        slots, one [S, k+1] dispatch — through the pool at per-slot
+        vector offsets (generation.verify_tokens), then accept each
+        slot's drafts left-to-right: exact-match vs the argmax for
+        greedy rows, u < p_processed(d) point-mass rejection sampling
+        for stochastic rows (verify_draft_probs — the SAME
+        temperature/top-k/top-p pipeline sample_batched draws from),
+        each draft position consuming its own folded PRNG key.
+
+        Commits per slot = 1 + accepted in [1, k+1]: t0 plus the
+        accepted draft prefix. The all-accept bonus and the rejection
+        correction are NOT committed in-round — the carried logits
+        become the row at the last committed token, so the next round's
+        t0 IS that token, sampled through the engine's one invariant
+        (carried logits = distribution for the next token) with the
+        residual ban applied on a real rejection. Lengths advance by
+        1+a — the cache offset REWINDS below the k+1 writes, and
+        rejected-position KV is overwritten write-before-read by the
+        next dispatch (the bucketed-prefill invariant). The accept
+        mask is ANDed with a capacity clamp (draft j's write must land
+        at <= max_len-1), so finishing/idle rows never commit past the
+        region and the returned lengths clamp like the decode step's.
+
+        Returns (pool, new_last_logits, new_rngs, window [S, k+1],
+        window_logprobs [S, k+1], accepted [S], new_lengths,
+        new_rejects) — the host consumes 1+accepted tokens per live
+        row and discards the rest."""
+        self._verify_traces += 1
+        cfg = self.cfg
+        k = drafts.shape[1]
+        split = jax.vmap(jax.random.split)(rngs)  # [S, 2, 2]
+        new_rngs, step_keys = split[:, 0], split[:, 1]
+        # t0 consumes the SAME split key the plain decode step would,
+        # and the accept uniforms FOLD off it (positions 1..k) without
+        # advancing the chain — so a slot whose drafts are all filler
+        # commits exactly the token a decode step would have, and a
+        # request's stream never depends on what OTHER slots proposed
+        toks0 = sample_batched(step_keys, last_logits,
+                               temperature=temps, top_k=top_ks,
+                               top_p=top_ps, vocab_size=cfg.vocab_size,
+                               banned=rejects)
+        # logprob under the RAW carried logits — the serial convention
+        # (_decode_fn); for a residual-resampled t0 this reports the
+        # full-distribution logprob (observability only)
+        lp0 = jax.nn.log_softmax(last_logits, axis=-1)
+        lp0 = jnp.take_along_axis(lp0, toks0[:, None], axis=-1)[:, 0]
+        window = jnp.concatenate([toks0[:, None], drafts], axis=1)
+        logits, pool = verify_tokens(params, window, pool, cfg,
+                                     rope=self.gen.rope,
+                                     lengths=lengths,
+                                     max_len=self.max_len)
+        # logits[:, j] = the model's distribution for the token AFTER
+        # window position j — drafts[:, j] claims to be that token
+        ctx = logits[:, :k]
+        probs, targets = verify_draft_probs(
+            ctx, drafts, temperature=temps, top_k=top_ks, top_p=top_ps,
+            vocab_size=cfg.vocab_size)
+
+        def row_unifs(rk):
+            return jax.vmap(lambda i: jax.random.uniform(
+                jax.random.fold_in(rk, i)))(jnp.arange(1, k + 1))
+
+        u = jax.vmap(row_unifs)(step_keys)  # [S, k]
+        greedy_rows = (temps == 0.0) | (top_ks == 1)
+        accept = jnp.where(greedy_rows[:, None], drafts == targets,
+                           u < probs)
+        # filler positions (NO_DRAFT = -1: inactive row, empty or
+        # short proposal) are never accepted — and never counted as a
+        # stochastic rejection below
+        accept = accept & (drafts >= 0)
+        # capacity clamp: draft j commits at position lengths+1+j and
+        # its logits need every window write up to lengths+j in-region
+        allow = (lengths[:, None] + 1 + jnp.arange(k)[None, :]
+                 <= jnp.int32(self.max_len - 1))
+        acc = (accept & allow).astype(jnp.int32)
+        a = jnp.sum(jnp.cumprod(acc, axis=1), axis=1)  # [S] in [0, k]
+        lp = jax.nn.log_softmax(ctx, axis=-1)
+        draft_lp = jnp.take_along_axis(
+            lp, drafts[..., None], axis=-1)[..., 0]
+        tok_lp = jnp.concatenate([lp0[:, None], draft_lp], axis=1)
+        # carried logits = distribution after the LAST committed token
+        new_last = jnp.take_along_axis(
+            logits, a[:, None, None],
+            axis=1)[:, 0].astype(last_logits.dtype)
+        # residual carry: only a REAL stochastic rejection at the stop
+        # position bans its draft from the next t0 sample — a filler
+        # stop, a capacity stop, or an all-accept round carries nothing
+        # (and greedy rows' ban is inert by construction: rejection
+        # means the banned draft was not the argmax)
+        a_idx = jnp.clip(a, 0, k - 1)
+        d_stop = jnp.take_along_axis(drafts, a_idx[:, None],
+                                     axis=1)[:, 0]
+        allow_stop = jnp.take_along_axis(allow, a_idx[:, None],
+                                         axis=1)[:, 0]
+        new_rejects = jnp.where((a < k) & allow_stop & (d_stop >= 0),
+                                d_stop,
+                                jnp.int32(-1)).astype(jnp.int32)
+        new_lengths = jnp.minimum(lengths + 1 + a,
+                                  jnp.int32(self.max_len - 1))
+        return (pool, new_last, new_rngs, window, tok_lp, a,
+                new_lengths, new_rejects)
 
     def _prefill_fn(self, params, pool, last_logits, rngs, tokens,
                     plens, slots, rng0s):
@@ -800,6 +979,8 @@ class ServingEngine:
         self._rngs = jnp.zeros((S, 2), jnp.uint32)
         self._lengths[:] = 0
         self._active[:] = False
+        self._reject[:] = -1
+        self._d_reject = jnp.asarray(self._reject)
         self._slot_req = [None] * S
         self._sampling_dirty = True
         self._lengths_dirty = True
@@ -855,6 +1036,10 @@ class ServingEngine:
             plen, len(req.prompt), len(req.generated))
         # host copy FIRST: it survives restarts and the replay fallback
         req.resume_rng = np.asarray(jax.device_get(self._rngs[slot]))
+        # the residual carry is committed sampling state (unlike draft
+        # proposals, which are droppable): the mirror is exact here —
+        # preemption runs at a sync boundary
+        req.resume_reject = int(self._reject[slot])
         if self.scheduler.parked_count() < self.num_slots:
             sub = self._slice(self.gen.params, self.pool.caches,
                               jnp.int32(slot), jnp.int32(plen))
@@ -867,6 +1052,8 @@ class ServingEngine:
         self.metrics.count("preemptions")
         self._slot_req[slot] = None
         self._active[slot] = False
+        self._reject[slot] = -1  # draft state is droppable: a parked
+        #                          victim carries only committed tokens
         self._sampling_dirty = True
         self._lengths_dirty = True
         # the region itself goes back to the free list (its KV lives in
@@ -1073,6 +1260,9 @@ class ServingEngine:
         self._temps[slot] = req.sampling.temperature
         self._top_ks[slot] = req.sampling.top_k
         self._top_ps[slot] = req.sampling.top_p
+        # -1 for a fresh request; a preemption resume/replay restores
+        # the saved residual carry with the rng chain
+        self._reject[slot] = req.resume_reject
         self._slot_req[slot] = req
         self._sampling_dirty = True
         self._lengths_dirty = True
@@ -1118,6 +1308,7 @@ class ServingEngine:
             self._temps[slot] = req.sampling.temperature
             self._top_ks[slot] = req.sampling.top_k
             self._top_ps[slot] = req.sampling.top_p
+            self._reject[slot] = req.resume_reject  # -1 when fresh
             self._slot_req[slot] = req
             # restart-requeued requests re-enter through this path
             # too (the rebuilt PrefixIndex is empty): record the
@@ -1186,6 +1377,7 @@ class ServingEngine:
         req = self._slot_req[slot]
         self._slot_req[slot] = None
         self._active[slot] = False
+        self._reject[slot] = -1  # residual carry dies with the stream
         self._lengths_dirty = True  # device copy re-parks at next step
         self._sampling_dirty = True
         if failed is None and self._prefix_on:
@@ -1239,18 +1431,31 @@ class ServingEngine:
         return jax.device_get(tree)
 
     def _step(self):
-        """K chained decode dispatches + ONE host sync + bookkeeping.
+        """K chained decode/verify dispatches + ONE host sync +
+        bookkeeping.
 
         With decode_sync_interval=1 this is the classic per-token sync.
-        With K>1 the host enqueues K decode calls back-to-back — each
-        consumes the previous call's device outputs, so XLA runs them
-        gap-free — and fetches all K token grids in one transfer. The
-        host then consumes each slot's K tokens in order; a request
-        hitting EOS/max at inner step k discards the trailing K-1-k
-        tokens (its slot burned them as `wasted_decode_steps` — the
-        documented cost of the batched sync) and evicts at the
-        boundary. Per-request streams are token-exact vs K=1: slot
-        rng/logits/KV chains never cross slots or sync boundaries."""
+        With K>1 the host enqueues K calls back-to-back — each consumes
+        the previous call's device outputs, so XLA runs them gap-free —
+        and fetches all K token grids in one transfer. The host then
+        consumes each slot's tokens in order; a request hitting EOS/max
+        at inner step r discards the trailing K-1-r steps (its slot
+        burned them as `wasted_decode_steps` — the documented cost of
+        the batched sync) and evicts at the boundary. Per-request
+        streams are token-exact vs K=1: slot rng/logits/KV chains never
+        cross slots or sync boundaries.
+
+        With `speculative_k` each chained step is a draft/verify round
+        (`_verify_fn`): the window's draft grids are proposed UPFRONT
+        from the host-known committed history (spec_decode.
+        build_draft_rounds — later rounds draft under the optimistic
+        full-accept alignment; a wrong guess just gets rejected), each
+        round commits 1 + accepted tokens per live slot, and accept
+        counts + the residual carry chain on device between syncs. A
+        round with no real draft from any running slot dispatches the
+        cheaper plain decode step instead (`spec_fallback_steps`) —
+        which consumes the residual carry too, so fallback never skews
+        a stochastic stream."""
         K = self._sync_interval
         inj = get_fault_injector()
         if inj is not None:
@@ -1280,18 +1485,65 @@ class ServingEngine:
             # hard-freed slots, at their final length for retained
             # ones) so their device-side drift stays bounded by K
             self._d_lengths = jnp.asarray(self._lengths)
+            # the residual carry re-uploads with the lengths: the host
+            # mirror is exact at boundaries (it rides the window fetch)
+            # and churn sites rewrite it before setting the dirty flag
+            self._d_reject = jnp.asarray(self._reject)
             self._lengths_dirty = False
-        tok_steps, lp_steps = [], []
-        for _ in range(K):
-            out = self._decode(
-                self.gen.params, self.pool.caches, self._last_logits,
-                self._rngs, self._d_lengths, self._d_temps,
-                self._d_top_ks, self._d_top_ps)
+        spec_k = self._spec_k
+        spec_round = [False] * K
+        grids = None
+        if spec_k:
+            # draft proposal (host, once per window): per-slot
+            # committed history -> per-round [S, spec_k] grids. Draft
+            # state lives only inside this window — droppable by
+            # construction. Hand the drafter only the tail it can use
+            # (its scan_window, when it declares one): rebuilding the
+            # FULL prompt+generated list per slot per window would be
+            # O(context) python work on the dispatch thread at long
+            # contexts, for tokens the drafter immediately discards.
+            win = getattr(self.drafter, "scan_window", None)
+            histories: List[Optional[List[int]]] = \
+                [None] * self.num_slots
+            for slot in np.nonzero(self._active)[0]:
+                req = self._slot_req[slot]
+                if win is not None and len(req.generated) >= win:
+                    histories[slot] = req.generated[-win:]
+                elif win is not None:
+                    histories[slot] = (
+                        req.prompt[-(win - len(req.generated)):]
+                        + req.generated)
+                else:
+                    histories[slot] = req.prompt + req.generated
+            grids, spec_round = build_draft_rounds(
+                histories, self.drafter, spec_k, K)
+        tok_steps, lp_steps, acc_steps = [], [], []
+        for r in range(K):
+            if spec_round[r]:
+                out = self._verify(
+                    self.gen.params, self.pool.caches,
+                    self._last_logits, self._rngs, self._d_lengths,
+                    self._d_temps, self._d_top_ks, self._d_top_ps,
+                    jnp.asarray(grids[r]), self._d_reject)
+                acc_steps.append(out[5])
+                self.metrics.count("spec_rounds")
+            else:
+                out = self._decode(
+                    self.gen.params, self.pool.caches,
+                    self._last_logits, self._rngs, self._d_lengths,
+                    self._d_temps, self._d_top_ks, self._d_top_ps,
+                    self._d_reject)
+                acc_steps.append(None)
+                if spec_k:
+                    self.metrics.count("spec_fallback_steps")
             (self.pool.caches, self._last_logits, self._rngs) = out[:3]
-            self._d_lengths = out[5]
+            self._d_lengths = out[-2]
+            self._d_reject = out[-1]
             tok_steps.append(out[3])
             lp_steps.append(out[4])
-        fetched = self._fetch((tok_steps, lp_steps))
+        fetched = self._fetch(
+            (tok_steps, lp_steps,
+             [x for x in acc_steps if x is not None], self._d_reject))
         self.metrics.count("host_syncs")
         if self._wedged:
             # the watchdog flagged THIS iteration while it was in
@@ -1300,47 +1552,85 @@ class ServingEngine:
             raise EngineHungError(
                 "engine iteration exceeded the watchdog deadline "
                 "mid-dispatch")
-        toks = [np.asarray(t) for t in fetched[0]]   # K x [S]
+        toks = [np.asarray(t) for t in fetched[0]]   # [S] or [S, k+1]
         tok_lp = [np.asarray(l) for l in fetched[1]]
+        accs_flat = iter(fetched[2])
+        accs = [np.asarray(next(accs_flat)) if s else None
+                for s in spec_round]  # per-round accept counts [S]
+        if self._spec_trace is not None:
+            # test seam: per-round (window tokens, accept counts) so
+            # the exactness pin can REPLAY the verify pipeline serially
+            # (accs[r] is None for a fallback decode round)
+            for r in range(K):
+                self._spec_trace.append((toks[r], accs[r]))
+        # host mirror of the residual carry — exact as of this boundary
+        self._reject = np.asarray(fetched[3]).astype(np.int32).copy()
         active_slots = np.nonzero(self._active)[0]
         n_active = len(active_slots)
         consumed = np.zeros(K, np.int64)  # tokens delivered per step
         for slot in active_slots:
             req = self._slot_req[slot]
-            for k in range(K):
-                lp = float(tok_lp[k][slot])
-                if not math.isfinite(lp):
-                    # per-slot non-finite guard: NaN/inf logits poison
-                    # ONE request (numerical blowup, injected fault),
-                    # not the engine — fail it, free the slot, keep
-                    # every other slot decoding
-                    self.metrics.count("nonfinite_logit_fails")
-                    if K - 1 - k:
-                        self.metrics.count("wasted_decode_steps",
-                                           K - 1 - k)
-                    self._evict(
-                        slot,
-                        failed=(f"non-finite logits at position "
-                                f"{int(self._lengths[slot])} "
-                                f"(after {len(req.generated)} tokens); "
-                                "the poisoned request failed, the "
-                                "engine continues"),
-                        kind="nonfinite")
+            done = False
+            for r in range(K):
+                if done:
                     break
-                first = not req.generated
-                tok = int(toks[k][slot])
-                req.append_token(tok, lp)
-                if first:
-                    self.metrics.record_first_token(req.ttft)
-                self._lengths[slot] += 1
-                consumed[k] += 1
-                if (tok == self.gen.eos_id
-                        or len(req.generated) >= req.max_new_tokens):
-                    if K - 1 - k:
-                        self.metrics.count("wasted_decode_steps",
-                                           K - 1 - k)
-                    self._evict(slot)
-                    break
+                if accs[r] is not None:
+                    # verify round: 1 + accepted committed tokens (the
+                    # window sample + the accepted draft prefix); the
+                    # k - accepted rejected drafts were never committed
+                    # (their KV is overwritten write-before-read).
+                    # draft_tokens counts proposals for LIVE rows only;
+                    # accepted_tokens counts draft commits actually
+                    # DELIVERED (EOS/budget discards don't inflate the
+                    # acceptance-rate seam).
+                    a = int(accs[r][slot])
+                    row_toks = toks[r][slot, :1 + a]
+                    row_lps = tok_lp[r][slot, :1 + a]
+                    n_drafts = int((grids[r][slot] >= 0).sum())
+                    if n_drafts:
+                        self.metrics.count("draft_tokens", n_drafts)
+                else:
+                    row_toks = toks[r][slot:slot + 1]
+                    row_lps = tok_lp[r][slot:slot + 1]
+                for j in range(len(row_toks)):
+                    lp = float(row_lps[j])
+                    if not math.isfinite(lp):
+                        # per-slot non-finite guard: NaN/inf logits
+                        # poison ONE request (numerical blowup,
+                        # injected fault), not the engine — fail it,
+                        # free the slot, keep every other slot decoding
+                        self.metrics.count("nonfinite_logit_fails")
+                        if K - 1 - r:
+                            self.metrics.count("wasted_decode_steps",
+                                               K - 1 - r)
+                        self._evict(
+                            slot,
+                            failed=(f"non-finite logits at position "
+                                    f"{int(self._lengths[slot])} "
+                                    f"(after {len(req.generated)} "
+                                    "tokens); the poisoned request "
+                                    "failed, the engine continues"),
+                            kind="nonfinite")
+                        done = True
+                        break
+                    first = not req.generated
+                    tok = int(row_toks[j])
+                    req.append_token(tok, lp)
+                    if first:
+                        self.metrics.record_first_token(req.ttft)
+                    self._lengths[slot] += 1
+                    consumed[r] += 1
+                    if j > 0:
+                        self.metrics.count("accepted_tokens")
+                    if (tok == self.gen.eos_id
+                            or len(req.generated)
+                            >= req.max_new_tokens):
+                        if K - 1 - r:
+                            self.metrics.count("wasted_decode_steps",
+                                               K - 1 - r)
+                        self._evict(slot)
+                        done = True
+                        break
         self._steps += K
         depth = self.scheduler.depth()
         for k in range(K):

@@ -51,6 +51,15 @@ _BASE_COUNTERS = (
     # (the poisoned REQUEST fails, the engine survives)
     "requests_shed", "preemptions", "engine_restarts",
     "nonfinite_logit_fails",
+    # speculative decoding (docs/serving.md "Speculative decoding"):
+    # spec_rounds = batched draft/verify dispatches, draft_tokens =
+    # drafts proposed for active slots, accepted_tokens = drafts the
+    # verify forward accepted (accepted/draft is the acceptance-rate
+    # A/B seam, like prefill_forward_tokens was for the prefix cache),
+    # spec_fallback_steps = iterations a speculative engine fell back
+    # to the plain decode step because no running slot proposed a draft
+    "spec_rounds", "draft_tokens", "accepted_tokens",
+    "spec_fallback_steps",
 )
 
 

@@ -112,6 +112,14 @@ class GenRequest:
         self.preemptions = 0
         self.resume_rng = None
         self.parked = None
+        # speculative decoding: the residual-carry token banned from
+        # this request's next sample (a stochastic rejection in its
+        # last verify round; -1 = none). Saved at preemption alongside
+        # resume_rng — distribution correctness needs the ban to
+        # survive a park/replay exactly like the PRNG chain does.
+        # Unlike draft proposals (droppable, re-proposed every window)
+        # this IS committed sampling state.
+        self.resume_reject = -1
 
     def effective_prompt(self) -> List[int]:
         """Tokens whose KV must be slot-resident before the next decode

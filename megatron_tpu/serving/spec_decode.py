@@ -1,0 +1,135 @@
+"""Draft proposal for speculative decoding on the slot grid.
+
+Speculative decoding (Leviathan et al., ICML 2023 — PAPERS.md) converts
+the HBM-bandwidth-bound decode step into k tokens per weight stream:
+cheap DRAFT tokens are proposed per slot, then ALL slots' drafts are
+verified in one batched [slots, k+1]-token forward
+(serving/engine.py `--speculative_k`; the verify primitive is
+inference/generation.py `verify_tokens`).
+
+This module owns the DRAFT side — deliberately host-side and stateless
+between engine iterations, so draft state is droppable by construction:
+a preempted/parked/restarted slot carries only committed tokens, and
+the next window simply re-proposes from the committed history.
+
+`Drafter` is the pluggable seam: anything with
+`propose(tokens, n) -> list[int]` slots in (a small draft-model config
+can back one later). The default `NGramDrafter` is self-drafting
+prompt-lookup (the n-gram matcher popularized as prompt-lookup /
+lookahead decoding): match the history's trailing n-gram against the
+request's OWN prompt+generated tokens and propose the continuation of
+the most recent earlier occurrence — free to evaluate, surprisingly
+effective on the repetitive tails real serving traffic has (code,
+retrieval contexts, multi-turn chat), and correctness-free: a bad
+draft just gets rejected by the verify step.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Protocol, Sequence, runtime_checkable
+
+
+@runtime_checkable
+class Drafter(Protocol):
+    """Pluggable draft source. `propose(tokens, n)` returns up to `n`
+    guesses for the tokens FOLLOWING the committed history `tokens`
+    (an empty list = no proposal — the engine counts a fallback step
+    when no running slot proposes anything). Must be cheap: it runs on
+    the engine thread once per sync window per running slot."""
+
+    def propose(self, tokens: Sequence[int], n: int) -> List[int]:
+        ...
+
+
+class NGramDrafter:
+    """Self-drafting prompt-lookup: match the last `max_ngram` (down to
+    `min_ngram`) committed tokens against the history itself; propose
+    the continuation of the MOST RECENT earlier occurrence. Longer
+    patterns are tried first (fewer, higher-precision matches).
+
+    Cost discipline: this runs on the ENGINE thread once per running
+    slot per sync window — the latency-critical dispatch path
+    speculation exists to speed up — so a proposal is one
+    left-to-right pass over at most the last `scan_window` tokens
+    building an ngram->last-start dict (O(scan_window * max_ngram)
+    cheap tuple hashes, no per-candidate list slicing), then
+    max_ngram lookups. Recency falls out of the dict (later
+    occurrences overwrite earlier ones); repetition far outside the
+    window is rare enough that bounding the scan costs ~no acceptance
+    in practice."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
+                 scan_window: int = 1024):
+        assert 1 <= min_ngram <= max_ngram, (min_ngram, max_ngram)
+        assert scan_window > max_ngram, (scan_window, max_ngram)
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+        self.scan_window = scan_window
+
+    def propose(self, tokens: Sequence[int], n: int) -> List[int]:
+        toks = list(tokens[-self.scan_window:])
+        L = len(toks)
+        if n <= 0 or L < self.min_ngram + 1:
+            return []
+        hi = min(self.max_ngram, L - 1)
+        # one pass: for each size, the LAST start of every ngram —
+        # excluding starts whose match would be the trailing pattern
+        # itself (start + size == L)
+        last: dict = {}
+        for size in range(self.min_ngram, hi + 1):
+            for start in range(0, L - size):
+                last[(size, tuple(toks[start:start + size]))] = start
+        for size in range(hi, self.min_ngram - 1, -1):
+            start = last.get((size, tuple(toks[-size:])))
+            if start is not None:
+                cont = toks[start + size:start + size + n]
+                if cont:
+                    return cont
+        return []
+
+
+NO_DRAFT = -1  # filler: never accepted, never sets the residual carry
+
+
+def build_draft_rounds(histories: List[Optional[Sequence[int]]],
+                       drafter: Drafter, k: int, rounds: int):
+    """Per-round draft grids for one sync window of a speculative
+    engine: `histories[s]` is slot s's committed prompt+generated
+    tokens (None = inactive row). Returns (grids, any_real) where
+    `grids` is a list of `rounds` int32 [slots, k] numpy arrays and
+    `any_real[r]` says whether round r carries at least one real
+    draft — an all-filler round is the engine's cue to dispatch the
+    cheaper plain decode step instead (`spec_fallback_steps`).
+
+    Chained rounds (decode_sync_interval > 1) are proposed UPFRONT
+    from the same host-known history under the optimistic assumption
+    that every earlier round fully accepts — one continuation of
+    length rounds*(k+1) is proposed per slot and round r consumes
+    C[r*(k+1)+1 : r*(k+1)+1+k] (index r*(k+1) is the round's
+    device-sampled t0, which the host cannot know; when the guess for
+    it is wrong the round's drafts simply get rejected). Misalignment
+    costs acceptance, never correctness. Slots with no proposal (and
+    inactive rows, and the tail of a short proposal) fill with
+    NO_DRAFT — the verify step never accepts a filler position, so a
+    slot with no real drafts commits exactly its plain decode step's
+    token: per-request streams do not depend on what OTHER slots
+    proposed."""
+    import numpy as np
+    S = len(histories)
+    need = rounds * (k + 1)
+    conts = []
+    for hist in histories:
+        conts.append([] if hist is None
+                     else list(drafter.propose(hist, need)))
+    grids, any_real = [], []
+    for r in range(rounds):
+        grid = np.full((S, k), NO_DRAFT, np.int32)
+        real = False
+        for s, cont in enumerate(conts):
+            lo = r * (k + 1) + 1
+            piece = cont[lo:lo + k]
+            if piece:
+                grid[s, :len(piece)] = piece
+                real = True
+        grids.append(grid)
+        any_real.append(real)
+    return grids, any_real

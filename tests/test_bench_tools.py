@@ -158,6 +158,39 @@ def test_bench_prefix_emits_ab_record(monkeypatch, tmp_path):
     assert chnk["prefill_tokens_saved"] >= 32
 
 
+def test_bench_spec_emits_ab_record(monkeypatch, tmp_path):
+    """The speculative-decode A/B must run greedy arms token-exact vs
+    the k=0 baseline (the tool asserts agreement itself and exits
+    nonzero on divergence), actually draft and accept on the
+    repetitive-motif workload, and report the acceptance-rate /
+    tokens-per-round seam the on-chip roofline comparison keys on."""
+    import json
+    text = run_tool(
+        monkeypatch, tmp_path, "bench_spec.py",
+        ["--requests", "4", "--prompt", "12", "--new", "16",
+         "--slots", "3", "--ks", "2,4", "--layers", "2",
+         "--hidden", "64", "--heads", "4", "--vocab", "128",
+         "--seq", "128"])
+    rec = json.loads(text)
+    assert rec["bench"] == "speculative_decode"
+    assert rec["greedy_arms_token_exact"] is True
+    assert rec["baseline"]["speculative_k"] == 0
+    assert rec["baseline"]["draft_tokens"] == 0
+    assert [a["speculative_k"] for a in rec["arms"]] == [2, 4]
+    for arm in rec["arms"]:
+        assert arm["tokens_generated"] == \
+            rec["baseline"]["tokens_generated"]
+        assert arm["spec_rounds"] >= 1
+        assert arm["draft_tokens"] >= 1
+        # tokens_per_round = 1 + k * acceptance: the roofline scaler
+        assert arm["tokens_per_round"] == pytest.approx(
+            1 + arm["speculative_k"] * arm["acceptance_rate"],
+            abs=0.02)
+    # the repetitive-motif workload must actually exercise acceptance
+    assert rec["best_acceptance_rate"] > 0.0
+    assert rec["roofline"]["step_bytes"] > 0
+
+
 def test_bench_sync_emits_cadence_record(monkeypatch, tmp_path):
     """The host-sync cadence A/B must show the async window fetching
     fewer times than per-step and the K-window serving arm syncing at

@@ -817,6 +817,14 @@ def test_chaos_serve_smoke(tmp_path):
     assert record["hang"]["engine_restarts"] >= 1
     assert record["crash_loop"]["breaker_open"] is True
     assert record["value"] is not None  # hang-recovery latency measured
+    # ISSUE 8: the drills run speculative by default — preempt-mid-
+    # round / crash-restart / watchdog-hang drop uncommitted draft
+    # state cleanly (completions token-exact, probe token-exact)
+    assert record["speculative_k"] >= 1
+    assert record["overload"]["spec_rounds"] >= 1
+    assert record["overload"]["completed_token_exact"] is True
+    assert record["overload"]["completed_checked"] >= 1
+    assert record["hang"]["probe_token_exact"] is True
 
 
 # ---------------------------------------------------------------------------

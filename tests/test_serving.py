@@ -1895,3 +1895,313 @@ class TestOverloadServerEndpoints:
             assert len(eng.metrics._queue_wait) == before  # no resample
         finally:
             eng.close()
+
+
+class TestSpeculativeDecode:
+    """--speculative_k acceptance (ISSUE 8): greedy output is
+    token-exact vs the non-speculative engine AND the serial path for
+    bf16 and int8 pools; the decode+verify pair compiles exactly once
+    per k; stochastic rows are distribution-correct rejection sampling
+    whose accepted prefixes replay bit-exact against a serial (batch-1)
+    recomputation of the verify logits; the verify window clamps at
+    capacity; and draft state is droppable (preemption composes)."""
+
+    def _serial(self, gen, prompt, n, sampling, seed):
+        sp = SamplingParams(temperature=sampling.temperature,
+                            top_k=sampling.top_k, top_p=sampling.top_p)
+        t, l, _ = gen.generate([prompt], n, sampling=sp, seed=seed)
+        return t[0, :l[0]].tolist()
+
+    # prompts with repeated n-grams so the self-drafting matcher has
+    # something to look up (plus plain ones riding the same grid)
+    SPEC_PROMPTS = [[5, 6, 7, 5, 6, 7, 5, 6], [9, 2, 9, 2, 9, 2],
+                    [11, 12, 13, 14], [3, 4]]
+
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_greedy_token_exact_vs_nonspec_and_serial(self, tiny_model,
+                                                      kv_dtype):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0,
+                        kv_cache_dtype=(jnp.int8 if kv_dtype
+                                        else jnp.bfloat16))
+        sampling = SamplingOptions(temperature=0.0)
+        outs = {}
+        for k in (0, 4):
+            with ServingEngine(gen, ServingConfig(
+                    num_slots=3, max_queue=32, max_len=64,
+                    speculative_k=k)) as eng:
+                reqs = [eng.submit(p, 16, sampling, seed=0)
+                        for p in self.SPEC_PROMPTS]
+                outs[k] = [r.result(timeout=300)[0] for r in reqs]
+                if k:
+                    snap = eng.metrics.snapshot()
+                    assert snap["spec_rounds"] >= 1
+                    assert snap["draft_tokens"] >= 1
+                    # the drafter actually pays off on repetitive rows
+                    assert snap["accepted_tokens"] >= 1
+                    # single-compile pin: the decode+verify PAIR
+                    assert eng._decode_traces == 1
+                    assert eng._verify_traces == 1
+        assert outs[4] == outs[0]
+        for p, toks in zip(self.SPEC_PROMPTS, outs[4]):
+            assert toks == self._serial(gen, p, 16, sampling, 0), p
+
+    def test_composes_with_decode_sync_interval(self, tiny_model):
+        """K-chained verify rounds: accept counts and the residual
+        carry stay on device between syncs — greedy output identical
+        at K=1 and K=3, and still identical to serial."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sampling = SamplingOptions(temperature=0.0)
+        outs = {}
+        for K in (1, 3):
+            with ServingEngine(gen, ServingConfig(
+                    num_slots=3, max_queue=32, max_len=64,
+                    speculative_k=2, decode_sync_interval=K)) as eng:
+                reqs = [eng.submit(p, 12, sampling, seed=0)
+                        for p in self.SPEC_PROMPTS]
+                outs[K] = [r.result(timeout=300)[0] for r in reqs]
+                assert eng._verify_traces <= 1
+        assert outs[3] == outs[1]
+        for p, toks in zip(self.SPEC_PROMPTS, outs[1]):
+            assert toks == self._serial(gen, p, 12, sampling, 0), p
+
+    @pytest.mark.parametrize("plen", [27, 28, 30, 31])
+    def test_capacity_boundary_clamps_verify_window(self, tiny_model,
+                                                    plen):
+        """Slots at length cap-k-1 .. cap-1: the verify window must
+        clamp so nothing writes past max_len-1, accepted counts stop at
+        the region edge, and the output fills the budget token-exactly
+        (same clamp the K-chained decode uses for idle rows)."""
+        params, cfg = tiny_model
+        # eos_id=-1: rows decode all the way to the capacity boundary
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+        max_len, k = 32, 4
+        prompt = [(i % 90) + 1 for i in range(plen)]
+        # repetitive tail so drafts really are proposed near the edge
+        prompt[-6:] = [7, 8, 7, 8, 7, 8]
+        n = max_len - plen  # fills the slot region exactly
+        sampling = SamplingOptions(temperature=0.0)
+        with ServingEngine(gen, ServingConfig(
+                num_slots=2, max_queue=8, max_len=max_len,
+                speculative_k=k, decode_sync_interval=2)) as eng:
+            # a second, shorter row rides the same grid (idle/finishing
+            # rows cross the window boundary while row 0 clamps)
+            r0 = eng.submit(prompt, n, sampling, seed=0)
+            r1 = eng.submit([5, 6, 5, 6], 3, sampling, seed=0)
+            toks0, _ = r0.result(timeout=300)
+            r1.result(timeout=300)
+        assert len(toks0) == max_len  # filled to capacity, not past
+        assert toks0 == self._serial(gen, prompt, n, sampling, 0)
+
+    def test_stochastic_stream_independent_of_grid(self, tiny_model):
+        """A request's sampled stream depends only on its own seed,
+        drafts, and accepts — never on what OTHER slots proposed: a
+        1-slot engine (serial verify) and a 4-slot engine (grid-batched
+        verify) emit identical tokens and logprobs."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sampling = SamplingOptions(temperature=0.9, top_k=5)
+
+        def run(slots, serially):
+            outs = []
+            with ServingEngine(gen, ServingConfig(
+                    num_slots=slots, max_queue=32, max_len=64,
+                    speculative_k=3)) as eng:
+                if serially:
+                    for i, p in enumerate(self.SPEC_PROMPTS):
+                        outs.append(eng.submit(
+                            p, 10, sampling,
+                            seed=100 + i).result(timeout=300))
+                else:
+                    reqs = [eng.submit(p, 10, sampling, seed=100 + i)
+                            for i, p in enumerate(self.SPEC_PROMPTS)]
+                    outs = [r.result(timeout=300) for r in reqs]
+            return outs
+
+        one = run(1, True)
+        grid = run(4, False)
+        assert one == grid
+
+    def test_accepted_prefix_bitexact_vs_serial_verify_replay(
+            self, tiny_model):
+        """The stochastic pin: replay the engine's recorded rounds
+        through a SERIAL batch-1 recomputation of the verify pipeline —
+        same prefill shapes, same split/fold key schedule, same
+        processed-probability acceptance — and require bit-exact
+        agreement on every sampled token and accept count."""
+        from megatron_tpu.inference.generation import (init_kv_caches,
+                                                       verify_tokens)
+        from megatron_tpu.inference.sampling import (sample_batched,
+                                                     verify_draft_probs)
+        from megatron_tpu.models import language_model as lm2
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        prompt, n, seed, k, max_len = [5, 6, 7, 5, 6, 7, 5], 10, 7, 3, 64
+        sampling = SamplingOptions(temperature=0.9, top_k=5)
+        with ServingEngine(gen, ServingConfig(
+                num_slots=1, max_queue=8, max_len=max_len,
+                speculative_k=k), start=False) as eng:
+            eng._spec_trace = []
+            eng._thread.start()
+            req = eng.submit(prompt, n, sampling, seed=seed)
+            toks, _ = req.result(timeout=300)
+            trace = list(eng._spec_trace)
+        assert any(acc is not None for _, acc in trace), (
+            "no verify round ran — the pin tested nothing")
+
+        # --- serial replay -------------------------------------------
+        plen = len(prompt)
+        padded = -(-plen // 16) * 16  # the engine's prefill bucket
+        arr = np.full((1, padded), 0, np.int32)
+        arr[0, :plen] = prompt
+        caches = init_kv_caches(cfg, 1, max_len, dtype=jnp.bfloat16)
+        logits, caches = lm2.model_forward(
+            params, jnp.asarray(arr), cfg, kv_caches=caches,
+            rope=gen.rope, logits_dtype=jnp.float32)
+        carried = logits[0, plen - 1]
+        rng = ServingEngine._initial_rng(seed, plen)
+        temps = jnp.asarray([sampling.temperature], jnp.float32)
+        tks = jnp.asarray([sampling.top_k], jnp.int32)
+        tps = jnp.asarray([sampling.top_p], jnp.float32)
+        length, reject, committed = plen, -1, list(prompt)
+        for w_toks, acc in trace:
+            rng, step = jax.random.split(rng)
+            t0 = sample_batched(
+                step[None], carried[None], temperature=temps,
+                top_k=tks, top_p=tps, vocab_size=cfg.vocab_size,
+                banned=jnp.asarray([reject], jnp.int32))
+            w = np.atleast_2d(np.asarray(w_toks))  # [1, 1] or [1, k+1]
+            assert int(t0[0]) == int(w[0, 0]), "t0 diverged"
+            logits, caches = verify_tokens(
+                params, jnp.asarray(w), caches, cfg, rope=gen.rope,
+                lengths=jnp.asarray([length], jnp.int32),
+                max_len=max_len)
+            if acc is None:  # fallback decode round
+                committed.append(int(w[0, 0]))
+                carried, length, reject = logits[0, 0], length + 1, -1
+                continue
+            drafts = w[:, 1:].astype(np.int32)
+            probs, _ = verify_draft_probs(
+                logits[:, :k], jnp.asarray(drafts), temperature=temps,
+                top_k=tks, top_p=tps, vocab_size=cfg.vocab_size)
+            u = np.asarray([float(jax.random.uniform(
+                jax.random.fold_in(step, i))) for i in range(1, k + 1)])
+            allow = (length + 1 + np.arange(k)) <= max_len - 1
+            ok = (u < np.asarray(probs)[0]) & (drafts[0] >= 0) & allow
+            a = 0
+            while a < k and ok[a]:
+                a += 1
+            assert a == int(np.asarray(acc)[0]), "accept count diverged"
+            committed.extend(int(t) for t in w[0, :1 + a])
+            carried = logits[0, a]
+            reject = (int(drafts[0, a])
+                      if a < k and allow[a] and drafts[0, a] >= 0
+                      else -1)
+            length += 1 + a
+        # the request's tokens are exactly the replay's committed
+        # prefix (the last round may overshoot EOS/budget)
+        assert toks == committed[:len(toks)]
+
+    def test_spec_with_preemption_token_exact(self, tiny_model):
+        """Draft state is droppable: a greedy request preempted
+        mid-stream under --speculative_k resumes token-exact (only
+        committed tokens park; drafts re-propose from history)."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+        sampling = SamplingOptions(temperature=0.0)
+        prompt, n = [5, 6, 7, 5, 6, 7], 24
+        with ServingEngine(gen, ServingConfig(
+                num_slots=1, max_queue=16, max_len=64,
+                priority_levels=2, preemption=True,
+                speculative_k=3)) as eng:
+            victim = eng.submit(prompt, n, sampling, seed=1, priority=0)
+            t0 = time.monotonic()
+            while len(victim.generated) < 2 and not victim.done():
+                time.sleep(0.002)
+                assert time.monotonic() - t0 < 60
+            hp = eng.submit([9, 2, 9, 2], 4, sampling, seed=2,
+                            priority=1)
+            hp_toks, _ = hp.result(timeout=300)
+            toks, _ = victim.result(timeout=300)
+            assert victim.preemptions >= 1
+            assert eng._decode_traces == 1
+            assert eng._verify_traces <= 1
+        assert toks == self._serial(gen, prompt, n, sampling, 1)
+        assert hp_toks == self._serial(gen, [9, 2, 9, 2], 4, sampling,
+                                       2)
+
+    def test_empty_drafter_falls_back_bit_identical_to_nonspec(
+            self, tiny_model):
+        """A drafter with nothing to propose must cost nothing but the
+        fallback counter: the spec engine's stream — greedy AND
+        stochastic — is bit-identical to the non-speculative engine's
+        (the plain decode step consumes the same split keys and the
+        banned<0 path is bit-exact)."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+
+        class NoDraft:
+            def propose(self, tokens, n):
+                return []
+
+        sampling = SamplingOptions(temperature=1.1, top_p=0.8)
+        outs = {}
+        for spec in (0, 4):
+            with ServingEngine(
+                    gen, ServingConfig(num_slots=2, max_queue=16,
+                                       max_len=64, speculative_k=spec),
+                    drafter=NoDraft() if spec else None) as eng:
+                reqs = [eng.submit(p, 8, sampling, seed=200 + i)
+                        for i, p in enumerate(self.SPEC_PROMPTS)]
+                outs[spec] = [r.result(timeout=300) for r in reqs]
+                if spec:
+                    snap = eng.metrics.snapshot()
+                    assert snap["spec_fallback_steps"] >= 1
+                    assert snap["spec_rounds"] == 0
+                    assert eng._verify_traces == 0
+        assert outs[4] == outs[0]
+
+    def test_validate_rejects_rolling_and_flash_int8(self):
+        cfg_roll = tiny_cfg(sliding_window=16, attention_impl="flash",
+                            seq_length=64)
+        with pytest.raises(AssertionError, match="ROLLING"):
+            ServingConfig(speculative_k=4).validate(cfg_roll)
+        cfg_flash = tiny_cfg(attention_impl="flash")
+        with pytest.raises(AssertionError, match="flash-impl int8"):
+            ServingConfig(speculative_k=4,
+                          kv_dtype="int8").validate(cfg_flash)
+        # engine re-assert on the RESOLVED dtype (kv_dtype=None
+        # inheriting an int8 Generator never reaches validate's check)
+        params = lm.model_init(jax.random.PRNGKey(0), cfg_flash)
+        gen = Generator(params, cfg_flash, eos_id=0, pad_id=0,
+                        kv_cache_dtype=jnp.int8)
+        with pytest.raises(AssertionError, match="speculative_k"):
+            ServingEngine(gen, ServingConfig(num_slots=2, max_len=64,
+                                             speculative_k=4),
+                          start=False)
+
+    def test_spec_counters_in_base_schema(self):
+        snap = ServingMetrics().snapshot()
+        for key in ("spec_rounds", "draft_tokens", "accepted_tokens",
+                    "spec_fallback_steps"):
+            assert snap[key] == 0.0  # present before any traffic
+
+    def test_ngram_drafter_and_grid_builder(self):
+        from megatron_tpu.serving.spec_decode import (NO_DRAFT,
+                                                      NGramDrafter,
+                                                      build_draft_rounds)
+        d = NGramDrafter(max_ngram=3)
+        # trailing [7, 8] matched at the earlier occurrence -> proposes
+        # its continuation
+        assert d.propose([1, 7, 8, 9, 4, 7, 8], 2) == [9, 4]
+        # longest n-gram wins over a shorter, more recent match
+        assert d.propose([1, 2, 3, 9, 5, 1, 2, 3], 1) == [9]
+        assert d.propose([1, 2, 3], 2) == []  # no earlier occurrence
+        assert d.propose([4], 2) == []        # history too short
+        grids, any_real = build_draft_rounds(
+            [[1, 7, 8, 9, 4, 7, 8], None], d, k=2, rounds=2)
+        assert len(grids) == 2 and grids[0].shape == (2, 2)
+        assert grids[0][0].tolist() == [4, 7]  # C[1:3] of [9,4,7,8,...]
+        assert (grids[0][1] == NO_DRAFT).all()  # inactive row = filler
+        assert any_real[0] is True

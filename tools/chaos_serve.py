@@ -81,18 +81,28 @@ def _resolve_all(reqs, timeout=120.0):
     return out
 
 
-def overload_drill(new_tokens: int) -> dict:
+def overload_drill(new_tokens: int, spec_k: int = 0) -> dict:
     """Offered load >> slot capacity with priorities, early shedding,
-    preemption, and one NaN-poisoned slot. Contract: every submitted
-    future resolves; sheds fail fast at submit; at least one
-    preemption fires and every preempted request still resolves."""
+    preemption, one NaN-poisoned slot — and speculative decoding when
+    spec_k > 0. Contract: every submitted future resolves; sheds fail
+    fast at submit; at least one preemption fires and every preempted
+    request still resolves; and (the speculative addition) every
+    request that COMPLETES — preempted-and-resumed included — is
+    token-exact vs the serial greedy path: uncommitted draft state
+    must drop cleanly at preempt/park/resume, never leak into a
+    stream."""
+    from megatron_tpu.inference.generation import SamplingParams
     from megatron_tpu.resilience import FaultInjector, use_fault_injector
     from megatron_tpu.serving import OverloadShedError, SamplingOptions
 
-    eng, _ = _tiny_engine(dict(
+    eng, gen = _tiny_engine(dict(
         num_slots=2, max_queue=64, max_len=128, priority_levels=2,
-        shed_on_overload=True, preemption=True, max_engine_restarts=2))
-    sampling = SamplingOptions(temperature=1.0)
+        shed_on_overload=True, preemption=True, max_engine_restarts=2,
+        speculative_k=spec_k))
+    # greedy: seed-independent, so the exactness oracle is one serial
+    # generate per (prompt, n) — preemption/speculation must not move
+    # a single token
+    sampling = SamplingOptions(temperature=0.0)
     reqs, shed = [], 0
     # NaN-poison one active slot a few steps in: the non-finite guard
     # must fail exactly that REQUEST while the grid keeps decoding
@@ -103,10 +113,13 @@ def overload_drill(new_tokens: int) -> dict:
             # service-time sample (it never sheds blind)
             eng.generate([3, 1, 4], 2, sampling, seed=0)
             # wave 1 — capacity pressure: low-priority work fills both
-            # slots and the queue ...
+            # slots and the queue (a repeated motif gives the
+            # self-drafting matcher something to look up) ...
             for i in range(6):
-                reqs.append(eng.submit([5 + i, 2, 7], new_tokens,
-                                       sampling, seed=i, priority=0))
+                reqs.append((eng.submit([5 + i, 2, 7, 2, 7],
+                                        new_tokens, sampling, seed=i,
+                                        priority=0),
+                             [5 + i, 2, 7, 2, 7], new_tokens))
             # ... wait until low-priority work actually OCCUPIES the
             # slots (otherwise the priority queue simply serves the
             # high-priority wave first and nothing needs preempting) ...
@@ -115,22 +128,40 @@ def overload_drill(new_tokens: int) -> dict:
                    and time.monotonic() < t_wait):
                 time.sleep(0.002)
             # ... then high-priority arrivals preempt running slots
+            # (preempt-mid-round: the victim's in-window draft state
+            # is uncommitted by construction and must just vanish)
             for i in range(3):
-                reqs.append(eng.submit([9, 8 + i], max(new_tokens // 2, 2),
-                                       sampling, seed=100 + i,
-                                       priority=1))
+                n = max(new_tokens // 2, 2)
+                reqs.append((eng.submit([9, 8 + i], n, sampling,
+                                        seed=100 + i, priority=1),
+                             [9, 8 + i], n))
             # wave 2 — hopeless deadlines: the estimator (fed by the
             # warmup completion) sheds these at SUBMIT time
             for i in range(16):
                 try:
-                    reqs.append(eng.submit([2, i + 1], new_tokens,
-                                           sampling, seed=200 + i,
-                                           deadline_s=0.001))
+                    reqs.append((eng.submit([2, i + 1], new_tokens,
+                                            sampling, seed=200 + i,
+                                            deadline_s=0.001),
+                                 [2, i + 1], new_tokens))
                 except OverloadShedError:
                     shed += 1
-            outcomes = _resolve_all(reqs)
+            outcomes = _resolve_all([r for r, _, _ in reqs])
         snap = eng.metrics.snapshot()
         health = eng.health()
+        # exactness sweep over everything that finished OK
+        serial_cache, exact, checked = {}, True, 0
+        for r, prompt, n in reqs:
+            if r.state.value != "finished":
+                continue
+            key = (tuple(prompt), n)
+            if key not in serial_cache:
+                t, lens, _ = gen.generate(
+                    [prompt], n,
+                    sampling=SamplingParams(temperature=0.0))
+                serial_cache[key] = t[0, :lens[0]].tolist()
+            checked += 1
+            if r.prompt + r.generated != serial_cache[key]:
+                exact = False
     finally:
         eng.close()
     fired = {k: sum(1 for f, _ in injector.fired if f == k)
@@ -142,35 +173,48 @@ def overload_drill(new_tokens: int) -> dict:
         "requests_shed": int(snap["requests_shed"]),
         "nonfinite_logit_fails": int(snap["nonfinite_logit_fails"]),
         "nan_faults_fired": fired["serve_nan"],
+        "speculative_k": spec_k,
+        "spec_rounds": int(snap["spec_rounds"]),
+        "draft_tokens": int(snap["draft_tokens"]),
+        "completed_token_exact": exact,
+        "completed_checked": checked,
         "healthy_after": bool(health["healthy"]),
         "ok": (outcomes["stranded"] == 0
                and shed + int(snap["requests_shed"]) >= 1
                and int(snap["preemptions"]) >= 1
                and int(snap["nonfinite_logit_fails"])
                >= fired["serve_nan"] > 0
+               and exact and checked >= 1
+               and (spec_k == 0 or int(snap["spec_rounds"]) >= 1)
                and health["healthy"]),
     }
 
 
-def hang_drill(timeout_s: float, stall_s: float) -> dict:
+def hang_drill(timeout_s: float, stall_s: float,
+               spec_k: int = 0) -> dict:
     """A wedged decode iteration: the watchdog must fail the in-flight
     futures within its deadline and the supervisor must restart the
     loop once the stalled dispatch returns — measured as the wall time
-    from the hang-victim's failure to a fresh probe completing."""
+    from the hang-victim's failure to a fresh probe completing. With
+    spec_k > 0 the wedged iteration is a speculative window: the
+    restart must drop its uncommitted draft state with the rest of the
+    device state, and the greedy probe must come back token-exact."""
+    from megatron_tpu.inference.generation import SamplingParams
     from megatron_tpu.resilience import FaultInjector, use_fault_injector
     from megatron_tpu.serving import SamplingOptions
 
-    eng, _ = _tiny_engine(dict(
+    eng, gen = _tiny_engine(dict(
         num_slots=1, max_queue=16, max_len=128,
-        engine_step_timeout_s=timeout_s, max_engine_restarts=2))
-    sampling = SamplingOptions(temperature=1.0)
+        engine_step_timeout_s=timeout_s, max_engine_restarts=2,
+        speculative_k=spec_k))
+    sampling = SamplingOptions(temperature=0.0)
     try:
         # warmup: compiles done AND the watchdog armed (it arms only
         # after the first completed iteration)
         eng.generate([1, 2, 3], 2, sampling, seed=0)
         injector = FaultInjector(serve_delay_calls={1: stall_s})
         with use_fault_injector(injector):
-            victim = eng.submit([4, 5], 8, sampling, seed=1)
+            victim = eng.submit([4, 5, 4, 5], 8, sampling, seed=1)
             t0 = time.monotonic()
             try:
                 victim.result(timeout=stall_s + timeout_s + 30)
@@ -182,9 +226,13 @@ def hang_drill(timeout_s: float, stall_s: float) -> dict:
             detect_s = time.monotonic() - t0
             # the supervisor restarts after the stalled dispatch
             # returns; a fresh probe must then complete normally
-            probe = eng.submit([6, 7], 2, sampling, seed=2)
-            probe.result(timeout=60)
+            probe = eng.submit([6, 7, 6, 7], 4, sampling, seed=2)
+            probe_toks, _ = probe.result(timeout=60)
             recovery_s = time.monotonic() - t0
+        t, lens, _ = gen.generate([[6, 7, 6, 7]], 4,
+                                  sampling=SamplingParams(
+                                      temperature=0.0))
+        probe_exact = probe_toks == t[0, :lens[0]].tolist()
         health = eng.health()
         snap = eng.metrics.snapshot()
     finally:
@@ -195,26 +243,33 @@ def hang_drill(timeout_s: float, stall_s: float) -> dict:
         "detect_s": round(detect_s, 3),
         "recovery_s": round(recovery_s, 3),
         "engine_restarts": int(snap["engine_restarts"]),
+        "speculative_k": spec_k,
+        "probe_token_exact": probe_exact,
         "healthy_after": bool(health["healthy"]),
         "ok": (victim_failed and int(snap["engine_restarts"]) >= 1
                # the victim must fail by watchdog detection (deadline +
                # poll slack), i.e. strictly before the stalled dispatch
                # itself would have returned and failed it anyway
                and detect_s < stall_s + timeout_s
+               and probe_exact
                and health["healthy"] and health["state"] == "running"),
     }
 
 
-def crash_loop_drill() -> dict:
+def crash_loop_drill(spec_k: int = 0) -> dict:
     """Every step crashes: the supervisor restarts max_engine_restarts
     times, then trips the circuit breaker. Everything in flight or
     queued resolves with a typed error, health() reports unhealthy,
-    and new submits raise EngineUnhealthyError (the server's 503)."""
+    and new submits raise EngineUnhealthyError (the server's 503).
+    With spec_k > 0 the crashing step is a speculative window — the
+    restart/breaker path must behave identically (draft state is
+    host-side and dies with the window)."""
     from megatron_tpu.resilience import FaultInjector, use_fault_injector
     from megatron_tpu.serving import EngineUnhealthyError, SamplingOptions
 
     eng, _ = _tiny_engine(dict(
-        num_slots=1, max_queue=16, max_len=128, max_engine_restarts=1))
+        num_slots=1, max_queue=16, max_len=128, max_engine_restarts=1,
+        speculative_k=spec_k))
     sampling = SamplingOptions(temperature=1.0)
     try:
         eng.generate([1, 2], 2, sampling, seed=0)  # warmup
@@ -247,11 +302,12 @@ def crash_loop_drill() -> dict:
     }
 
 
-def run_chaos(new_tokens: int, timeout_s: float, stall_s: float) -> dict:
+def run_chaos(new_tokens: int, timeout_s: float, stall_s: float,
+              spec_k: int = 0) -> dict:
     t0 = time.monotonic()
-    overload = overload_drill(new_tokens)
-    hang = hang_drill(timeout_s, stall_s)
-    crash = crash_loop_drill()
+    overload = overload_drill(new_tokens, spec_k)
+    hang = hang_drill(timeout_s, stall_s, spec_k)
+    crash = crash_loop_drill(spec_k)
     wall_s = time.monotonic() - t0
     ok = overload["ok"] and hang["ok"] and crash["ok"]
     return {
@@ -261,6 +317,7 @@ def run_chaos(new_tokens: int, timeout_s: float, stall_s: float) -> dict:
                  f"{timeout_s}s, stall {stall_s}s)"),
         "vs_baseline": None,
         "completed": ok,
+        "speculative_k": spec_k,
         "overload": overload,
         "hang": hang,
         "crash_loop": crash,
@@ -278,6 +335,13 @@ def main(argv=None) -> int:
                     help="engine_step_timeout_s for the hang drill")
     ap.add_argument("--stall_s", type=float, default=3.0,
                     help="injected serve_delay for the hang drill")
+    ap.add_argument("--speculative_k", type=int, default=4,
+                    help="run every drill with speculative decoding at "
+                         "this k (0 = the pre-speculative drills): "
+                         "preempt-mid-round / crash-restart / "
+                         "watchdog-hang must drop uncommitted draft "
+                         "state cleanly — resumed requests token-exact, "
+                         "no stranded futures")
     ap.add_argument("--out", type=str, default=None,
                     help="also write the JSON record here")
     args = ap.parse_args(argv)
@@ -286,7 +350,8 @@ def main(argv=None) -> int:
     if args.smoke:
         args.new_tokens, args.watchdog_s, args.stall_s = 16, 1.0, 2.5
 
-    record = run_chaos(args.new_tokens, args.watchdog_s, args.stall_s)
+    record = run_chaos(args.new_tokens, args.watchdog_s, args.stall_s,
+                       args.speculative_k)
     line = json.dumps(record)
     print(line, flush=True)
     if args.out:
