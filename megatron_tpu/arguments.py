@@ -258,8 +258,8 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                    help="serving: retain finished slots' KV on an LRU "
                         "and reuse bucket-aligned shared prefixes "
                         "through one on-device region copy (token-"
-                        "exact vs off; unsupported on rolling "
-                        "sliding-window pools — docs/serving.md)")
+                        "exact vs off; rolling sliding-window pools "
+                        "need --kv_block_size — docs/serving.md)")
     g.add_argument("--prefill_chunk", type=int, default=None,
                    help="serving: split prompts/suffixes longer than "
                         "this into chunks interleaved with decode "
@@ -270,6 +270,17 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                         "at most this many finished slots keep their "
                         "KV for reuse (None retains all; they are "
                         "reclaimed lazily when admission needs a slot)")
+    g.add_argument("--kv_block_size", type=int, default=None,
+                   help="serving: block-granular KV pool — carve each "
+                        "slot's region into this many-token blocks "
+                        "over one arena with a per-slot block map "
+                        "resolved at dispatch (bit-identical outputs, "
+                        "one decode compile). Retention pins blocks "
+                        "instead of whole regions and holds no grid "
+                        "row, prefix hits alias shared blocks, and "
+                        "rolling pools become cloneable/preemptible. "
+                        "Must divide the slot capacity; None keeps "
+                        "whole-region layout (docs/serving.md)")
     g.add_argument("--speculative_k", type=int, default=0,
                    help="serving: speculative decoding — propose this "
                         "many draft tokens per running slot each "
@@ -277,8 +288,8 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                         "by default) and verify all slots' drafts in "
                         "one [slots, k+1]-token forward; greedy output "
                         "stays token-exact vs non-speculative "
-                        "(0 disables; unsupported on rolling / "
-                        "flash-int8 pools — docs/serving.md)")
+                        "(0 disables; unsupported on rolling pools — "
+                        "docs/serving.md)")
     g.add_argument("--priority_levels", type=int, default=1,
                    help="serving: distinct request priority classes — "
                         "requests carry priority in [0, levels); "
@@ -295,8 +306,8 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                    help="serving: a queued higher-priority request "
                         "with no allocatable slot evicts the lowest-"
                         "priority running slot; the victim's KV parks "
-                        "and it resumes token-exact later (unsupported "
-                        "on rolling / flash-int8 pools)")
+                        "and it resumes token-exact later (rolling "
+                        "pools need --kv_block_size)")
     g.add_argument("--max_engine_restarts", type=int, default=2,
                    help="serving: supervisor loop restarts after a "
                         "crashed/hung engine step before the crash-"
@@ -584,6 +595,7 @@ def config_from_args(args: argparse.Namespace,
             enable_prefix_cache=args.enable_prefix_cache,
             prefill_chunk=args.prefill_chunk,
             retained_slots=args.retained_slots,
+            kv_block_size=args.kv_block_size,
             speculative_k=args.speculative_k,
             priority_levels=args.priority_levels,
             shed_on_overload=args.shed_on_overload,

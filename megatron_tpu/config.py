@@ -407,8 +407,10 @@ class ServingConfig:
     # running) slot's prompt reuses it through ONE on-device region copy
     # and prefills only the suffix. Seeded outputs stay token-exact vs
     # the cache-off engine (the clone copies KV — int8 blocks + scales —
-    # verbatim). Unsupported on ROLLING (sliding-window) pools, whose
-    # ring order is source-length-dependent: validate() rejects it.
+    # verbatim). On ROLLING (sliding-window) pools this additionally
+    # requires the block-granular pool (kv_block_size): validate()
+    # rejects rolling whole-region retention, whose idle ring writes
+    # would clobber retained content.
     enable_prefix_cache: bool = False
     # chunked prefill (Sarathi-Serve): prompts/suffixes longer than this
     # split into chunks the engine interleaves with decode steps, so a
@@ -421,8 +423,27 @@ class ServingConfig:
     # finished slots keep their KV for reuse (the oldest demotes to the
     # free list beyond it). None retains every finished slot — they are
     # reclaimed lazily when admission needs a slot anyway, so the only
-    # cost of None is colder free-list slots.
+    # cost of None is colder free-list slots. With kv_block_size set
+    # this caps retained ENTRIES (each pins only its own blocks).
     retained_slots: Optional[int] = None
+    # block-granular KV pool (docs/serving.md "Block-granular KV
+    # pool"): carve each slot's cap-token region into cap/B fixed
+    # blocks over one flat arena, addressed through a device-resident
+    # per-slot block map resolved at dispatch time — static shapes and
+    # the one-compile decode trace are preserved (only block INDICES
+    # are data), but retention pins blocks instead of whole regions
+    # (a retained 3-block prefix costs 3 blocks and NO grid row), a
+    # prefix hit aliases shared blocks into the new slot's map, and
+    # rolling pools become retainable/cloneable/preemptible for the
+    # first time (the ring's garbage writes for idle rows land in a
+    # shared trash block instead of the retained ring). Seeded outputs
+    # are BIT-IDENTICAL with blocks on vs off for every pool flavor
+    # (the map resolve is pure data movement). Must divide the slot
+    # capacity (rolling W, else max_len); with the prefix cache it
+    # must also be a multiple of prefill_bucket so hits stay aligned
+    # to both block and jit-bucket boundaries. None (default) keeps
+    # the whole-region layout bit-compatibly.
+    kv_block_size: Optional[int] = None
     # speculative decoding on the slot grid (docs/serving.md
     # "Speculative decoding"): each engine iteration proposes k draft
     # tokens per running slot (self-drafting n-gram prompt-lookup by
@@ -437,10 +458,11 @@ class ServingConfig:
     # standard point-mass rejection sampling (distribution-correct,
     # not bit-reproducing the non-speculative RNG stream). 0 disables.
     # Unsupported on ROLLING pools (a rejected draft's ring write
-    # already evicted history — the rewind invariant can't hold) and
-    # flash-impl int8 pools (the PR 5/6 offset-0-flash-vs-dequantized
-    # exclusion): validate() rejects both, the engine re-asserts on
-    # the RESOLVED pool dtype.
+    # already evicted history — the rewind invariant can't hold, with
+    # or without kv_block_size): validate() rejects it, the engine
+    # re-asserts on the RESOLVED pool layout. flash-impl int8 pools
+    # are supported (the int8 prefill takes the cached dot path —
+    # models/attention.py).
     speculative_k: int = 0
     # --- overload & failure knobs (docs/serving.md "Overload &
     # failure behavior") -----------------------------------------------
@@ -463,9 +485,8 @@ class ServingConfig:
     # PRNG key, and it resumes later with one insert_prefill — no
     # re-prefill, token-exact vs never-preempted, and the decode trace
     # stays one compile (preemption is slot bookkeeping + two region
-    # copies, never a new program). Unsupported on ROLLING pools (the
-    # parked region's ring order is source-length-dependent) and
-    # flash-impl int8 pools (same exclusion as the prefix cache).
+    # copies, never a new program). On ROLLING pools this requires the
+    # block-granular pool (kv_block_size) — see validate().
     preemption: bool = False
     # engine supervisor: a crashed engine-loop step fails only the
     # slotted requests it must, requeues the rest, resets the device
@@ -492,6 +513,30 @@ class ServingConfig:
             self.prefill_chunk)
         assert self.retained_slots is None or self.retained_slots >= 0, (
             self.retained_slots)
+        assert self.kv_block_size is None or self.kv_block_size >= 1, (
+            self.kv_block_size)
+        if self.kv_block_size is not None:
+            if self.enable_prefix_cache:
+                # prefix hits must stay aligned to BOTH the jit-bucket
+                # grid (so suffix shapes keep hitting the existing
+                # compile cache) and block boundaries (so a hit is
+                # pure block-map aliasing, no partial-block
+                # copy-on-write)
+                assert self.kv_block_size % self.prefill_bucket == 0, (
+                    f"kv_block_size={self.kv_block_size} must be a "
+                    f"multiple of prefill_bucket="
+                    f"{self.prefill_bucket} when enable_prefix_cache "
+                    "is set (hits must align to block AND jit-bucket "
+                    "boundaries)")
+            if model is not None:
+                cap = self.max_len or model.max_position_embeddings
+                if (model.sliding_window is not None
+                        and model.attention_impl == "flash"):
+                    cap = min(cap, model.sliding_window)
+                assert cap % self.kv_block_size == 0 \
+                    or self.kv_block_size >= cap, (
+                    f"kv_block_size={self.kv_block_size} must divide "
+                    f"the slot capacity ({cap})")
         assert self.priority_levels >= 1, self.priority_levels
         # preemption triggers only when a QUEUED request outranks a
         # RUNNING one; with a single priority class every request
@@ -514,60 +559,68 @@ class ServingConfig:
                 f"than the slot capacity (max_len={max_len})")
         if model is not None and model.sliding_window is not None:
             # ROLLING pools (flash impl caps the region to W < max_len)
-            # hold the last W positions ring-ordered by the SOURCE's
-            # length: a cloned prefix may already be evicted and an
-            # offset>0 chunk would wrap over history its own queries
-            # need. Exclude LOUDLY rather than decode garbage.
+            # hold the last W positions ring-ordered by position % W.
+            # WHOLE-REGION rolling pools cannot retain, clone, or park:
+            # a retained ring row still rides every decode step and its
+            # idle garbage writes (at final_length % W) wrap INTO the
+            # live ring content. The BLOCK-GRANULAR pool
+            # (kv_block_size) lifts prefix-cache and preemption —
+            # retained ring blocks hold no grid row, so idle writes
+            # land in the shared trash block and the ring content
+            # survives verbatim; clones continue a retained sequence
+            # at its exact length (or any prefix, while the ring has
+            # not wrapped). Two exclusions REMAIN regardless of
+            # blocks, each pinned by tests:
+            # - prefill_chunk: an offset>0 multi-token chunk's ring
+            #   writes evict history its own early queries still need
+            #   (write-before-read breaks inside one dispatch);
+            # - speculative_k: a rejected draft's ring write already
+            #   evicted the position it displaced, so the
+            #   accepted-length rewind cannot restore it.
             max_len = self.max_len or model.max_position_embeddings
             rolling = (model.attention_impl == "flash"
                        and model.sliding_window < max_len)
-            assert not (rolling and self.enable_prefix_cache), (
-                "enable_prefix_cache is unsupported on ROLLING "
-                "(sliding-window) KV pools: the W-slot ring is ordered "
-                "by the source's length, so a prefix clone could copy "
-                "already-evicted positions. Serve this model with the "
-                "prefix cache off.")
+            blocks = self.kv_block_size is not None
+            assert not (rolling and self.enable_prefix_cache
+                        and not blocks), (
+                "enable_prefix_cache on a ROLLING (sliding-window) KV "
+                "pool requires the block-granular pool "
+                "(--kv_block_size): a retained whole-region ring row "
+                "still rides the decode grid and its idle writes wrap "
+                "into the live ring. Set kv_block_size (dividing the "
+                "window) or serve with the prefix cache off.")
+            assert not (rolling and self.preemption and not blocks), (
+                "preemption on a ROLLING (sliding-window) KV pool "
+                "requires the block-granular pool (--kv_block_size): "
+                "whole-region rolling rows cannot park/resume without "
+                "their idle ring writes clobbering retained state. "
+                "Set kv_block_size or serve without preemption.")
             assert not (rolling and self.prefill_chunk is not None), (
                 "prefill_chunk is unsupported on ROLLING "
-                "(sliding-window) KV pools: an offset>0 chunk would "
-                "wrap the W-slot ring over history its own queries "
-                "still need. Serve this model unchunked.")
-            assert not (rolling and self.preemption), (
-                "preemption is unsupported on ROLLING (sliding-window) "
-                "KV pools: the parked region's W-slot ring is ordered "
-                "by the victim's length, so an insert-resume (or a "
-                "replay continuation at offset>0) could read "
-                "already-evicted positions. Serve this model without "
-                "preemption.")
+                "(sliding-window) KV pools (with or without "
+                "kv_block_size): an offset>0 chunk's ring writes "
+                "evict history its own queries still need within one "
+                "dispatch. Serve this model unchunked — rolling "
+                "prefix-hit suffixes append single-token steps "
+                "instead.")
             assert not (rolling and self.speculative_k), (
                 "speculative_k is unsupported on ROLLING "
-                "(sliding-window) KV pools: the verify window's ring "
-                "writes evict history as they land, so rewinding to "
-                "the accepted length cannot restore what a rejected "
+                "(sliding-window) KV pools (with or without "
+                "kv_block_size): the verify window's ring writes "
+                "evict history as they land, so rewinding to the "
+                "accepted length cannot restore what a rejected "
                 "draft overwrote — the write-before-read rewind "
                 "invariant breaks. Serve this model without "
                 "speculative decoding.")
-        if (model is not None and model.attention_impl == "flash"
-                and self.kv_dtype == "int8"):
-            # the flash impl's OFFSET-0 prefill reads the RAW k/v
-            # (bypassing the quantized cache entirely) while an
-            # offset>0 continuation chunk / prefix suffix reads the
-            # DEQUANTIZED int8 region — mathematically different
-            # logits, so the token-exact cache-on/off contract cannot
-            # hold. Exclude LOUDLY. (The engine re-checks with the
-            # RESOLVED pool dtype, covering kv_dtype=None inheriting
-            # an int8 Generator.)
-            assert not (self.enable_prefix_cache
-                        or self.prefill_chunk is not None
-                        or self.preemption
-                        or self.speculative_k), (
-                "enable_prefix_cache/prefill_chunk/preemption/"
-                "speculative_k are unsupported on flash-impl int8 KV "
-                "pools: the offset-0 flash prefill reads raw k/v while "
-                "offset>0 continuations (a preemption replay, a "
-                "verify window) read the dequantized cache, so "
-                "outputs would not be token-exact. Use the dot impl "
-                "or a bf16/f32 pool.")
+        # flash-impl int8 pools: NO exclusions anymore. The offset-0
+        # flash prefill shortcut is disabled for quantized caches
+        # (models/attention.py): every cached int8 forward — prefill,
+        # chunk, prefix suffix, preemption replay, verify window —
+        # reads the same dequantized cache through the same dot path,
+        # so the token-exact cache-on/off contract holds structurally.
+        # (Rolling int8 keeps the flash shortcut for prompts longer
+        # than W but feeds it the quantize->dequantize round-trip of
+        # the fresh k/v — the values the ring actually stores.)
         assert self.request_deadline_s is None or \
             self.request_deadline_s > 0.0, self.request_deadline_s
         assert self.kv_dtype is None or \

@@ -51,6 +51,27 @@ KV_CACHE_AXES = ("layers", None, None, "kv_heads", None)
 PREFILL_BUCKET = 16
 
 
+def kv_region_cap(cfg: ModelConfig, max_len: int,
+                  prefill_len=None) -> int:
+    """Token capacity of one sequence's KV region — THE single source
+    of the rolling-cap decision. `init_kv_caches` allocates this many
+    positions per row, and `serving.kv_pool.slot_nbytes` sizes pools
+    from the same number, so the two can never disagree.
+
+    With cfg.sliding_window < max_len the region rolls (holds only the
+    last W positions) when the prefill can land in the W-slot buffer:
+    the flash impl computes prefill outputs from the raw k/v, and a
+    dot-impl prefill that FITS the window overwrites nothing. A
+    dot-impl prompt longer than the window keeps the full-length
+    region (correct, just not memory-bounded)."""
+    if cfg.sliding_window is not None and (
+            cfg.attention_impl == "flash"
+            or (prefill_len is not None
+                and prefill_len <= cfg.sliding_window)):
+        return min(max_len, cfg.sliding_window)
+    return max_len
+
+
 def init_kv_caches(cfg: ModelConfig, batch: int, max_len: int,
                    dtype=jnp.bfloat16, prefill_len=None,
                    per_slot_offsets: bool = False) -> KVCache:
@@ -79,16 +100,9 @@ def init_kv_caches(cfg: ModelConfig, batch: int, max_len: int,
     independent request at its own sequence position."""
     from megatron_tpu.parallel.sharding import constrain
     L = cfg.num_layers
-    if cfg.sliding_window is not None and (
-            cfg.attention_impl == "flash"
-            or (prefill_len is not None
-                and prefill_len <= cfg.sliding_window)):
-        # roll only when the prefill is exact in the W-slot buffer: the
-        # flash impl computes prefill outputs from the raw k/v, and a
-        # dot-impl prefill that FITS the window overwrites nothing. A
-        # dot-impl prompt longer than the window keeps the full-length
-        # cache (correct, just not memory-bounded).
-        max_len = min(max_len, cfg.sliding_window)
+    # rolling-cap decision single-sourced in kv_region_cap (the serving
+    # pool's slot_nbytes sizes from the same helper)
+    max_len = kv_region_cap(cfg, max_len, prefill_len)
     shape = (L, batch, max_len, cfg.num_kv_heads, cfg.kv_channels)
     # jnp.dtype normalization: "int8" (cfg-style spelling) must behave
     # exactly like jnp.int8 — see KVCache.create

@@ -270,9 +270,23 @@ def attention_apply(
     # len >= 1), so the offset-0 flash shortcut can't apply and the
     # lax.cond predicate below wouldn't even be a scalar; it takes the
     # cached dot path, the same path the s == 1 grid decode uses.
+    # QUANTIZED caches also skip the shortcut (except rolling buffers,
+    # which need it for prompts longer than the window): flash-over-raw
+    # reads different values than the dequantized int8 cache an
+    # offset>0 continuation (prefix suffix, chunk, preemption replay,
+    # speculative verify) reads, which is exactly the token-exactness
+    # hole the old flash-int8 serving exclusions papered over. Routing
+    # the int8 prefill through the cached dot path makes EVERY cached
+    # forward read the same dequantized values through the same
+    # algorithm — the exclusions are erased structurally, at the cost
+    # of O(s^2) score materialization for int8-flash prefills.
+    cache_rolling = (kv_cache is not None and cfg.sliding_window is not None
+                     and kv_cache.k.shape[1] == cfg.sliding_window)
+    cache_quant = kv_cache is not None and kv_cache.k.dtype == jnp.int8
     prefill_flash = (cfg.attention_impl == "flash" and kv_cache is not None
                      and s > 1 and segment_ids is None and causal
-                     and not cross and not dropout_active and not per_slot)
+                     and not cross and not dropout_active and not per_slot
+                     and (not cache_quant or cache_rolling))
     k_raw, v_raw = k, v
 
     kv_positions = None
@@ -290,6 +304,17 @@ def attention_apply(
             from megatron_tpu.ops.quantized import quantize_rows
             ki, ks = quantize_rows(k)  # per (b, token, head) over head_dim
             vi, vs = quantize_rows(v)
+            if prefill_flash:
+                # ROLLING int8 prefill keeps the flash shortcut (a
+                # prompt longer than W cannot take the cached dot
+                # path), but reads the quantize->dequantize ROUND-TRIP
+                # of the fresh k/v, i.e. exactly the values the cache
+                # now holds — so continuation steps (which read the
+                # dequantized ring) see the same numbers the prefill
+                # attended, and a retained rolling prefix clone stays
+                # token-consistent with the cache-off path.
+                k_raw = ki.astype(dtype) * ks.astype(dtype)
+                v_raw = vi.astype(dtype) * vs.astype(dtype)
         if per_slot:
             # serving slot grid: row i writes its s tokens' k/v at its
             # own offset[i]..offset[i]+s-1 (one scatter, [b, s] index

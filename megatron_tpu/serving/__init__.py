@@ -3,7 +3,8 @@ generator and the HTTP front end — see docs/serving.md)."""
 from megatron_tpu.serving.engine import (  # noqa: F401
     EngineHungError, ServingEngine)
 from megatron_tpu.serving.kv_pool import (  # noqa: F401
-    SlotKVPool, clone_prefix, insert_prefill, slice_slot)
+    BlockKV, RetainedPrefix, SlotKVPool, clone_prefix, insert_blocks,
+    insert_prefill, resolve_view, scatter_view, slice_blocks, slice_slot)
 from megatron_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from megatron_tpu.serving.prefix_index import PrefixIndex  # noqa: F401
 from megatron_tpu.serving.request import (  # noqa: F401

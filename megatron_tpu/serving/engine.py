@@ -116,7 +116,9 @@ from megatron_tpu.inference.sampling import (sample_batched,
                                              verify_draft_probs)
 from megatron_tpu.models import language_model as lm
 from megatron_tpu.resilience.faults import get_fault_injector
-from megatron_tpu.serving.kv_pool import (SlotKVPool, insert_prefill,
+from megatron_tpu.serving.kv_pool import (SlotKVPool, insert_blocks,
+                                          insert_prefill, resolve_view,
+                                          scatter_view, slice_blocks,
                                           slice_slot)
 from megatron_tpu.serving.metrics import ServingMetrics
 from megatron_tpu.serving.prefix_index import PrefixIndex
@@ -149,12 +151,22 @@ class _PendingPrefill:
     consumed, as the sampling logits at prompt position plen-1).
     `tokens` is the sequence being prefilled — `req.prompt` for a fresh
     request, `req.effective_prompt()` (prompt + generated so far) for a
-    preemption replay."""
+    preemption replay.
 
-    __slots__ = ("req", "slot", "sub", "pos", "rng0", "last", "tokens")
+    Block-granular pools additionally carry the reserved physical
+    `blocks` (refs held since admission; the slot's map stays on TRASH
+    until activation installs them, so idle grid writes can't touch
+    aliased prefix blocks), `pfx_blocks` (the aliased block count —
+    the insert's copy-on-write boundary), and `installed` (whether the
+    map row was installed, which decides who unrefs the blocks on an
+    aborted prefill)."""
+
+    __slots__ = ("req", "slot", "sub", "pos", "rng0", "last", "tokens",
+                 "blocks", "pfx_blocks", "installed")
 
     def __init__(self, req: GenRequest, slot: int, sub, pos: int, rng0,
-                 tokens: Optional[List[int]] = None):
+                 tokens: Optional[List[int]] = None,
+                 blocks: Optional[List[int]] = None, pfx_blocks: int = 0):
         self.req = req
         self.slot = slot
         self.sub = sub
@@ -162,6 +174,9 @@ class _PendingPrefill:
         self.rng0 = rng0
         self.last = None
         self.tokens = list(tokens) if tokens is not None else req.prompt
+        self.blocks = blocks
+        self.pfx_blocks = pfx_blocks
+        self.installed = False
 
 
 class ServingEngine:
@@ -195,14 +210,14 @@ class ServingEngine:
                     else _KV_DTYPES[self.serving.kv_dtype])
         self.pool = SlotKVPool(cfg, self.num_slots, self.max_len,
                                dtype=kv_dtype,
-                               retained_limit=self.serving.retained_slots)
-        # prefix cache + chunked prefill: both need the continuation
-        # form of prefill (append at offset > 0), which a ROLLING pool
-        # cannot express — its W-slot ring is ordered by the SOURCE's
-        # length, so a cloned prefix may already be evicted and a chunk
-        # would wrap over history its own queries need.
-        # ServingConfig.validate rejects the combination; assert again
-        # here for engines constructed without going through validate.
+                               retained_limit=self.serving.retained_slots,
+                               block_size=self.serving.kv_block_size)
+        # block-granular pool: the static per-slot block map is
+        # resolved at dispatch (kv_pool.resolve_view/scatter_view
+        # bracket every compiled program), so the one-compile contract
+        # survives and outputs are BIT-IDENTICAL to the whole-region
+        # pool — only the retention/alias/free accounting changes
+        self._blocks_on = self.pool.blocks_enabled
         self._prefix_on = bool(self.serving.enable_prefix_cache)
         self._chunk = self.serving.prefill_chunk
         self._preempt_on = bool(self.serving.preemption)
@@ -214,47 +229,48 @@ class ServingEngine:
                     and self.serving.priority_levels < 2), (
             "preemption requires priority_levels >= 2 — see "
             "ServingConfig.validate")
-        assert not (self.pool.rolling
-                    and (self._prefix_on or self._chunk is not None
-                         or self._preempt_on)), (
-            "enable_prefix_cache/prefill_chunk/preemption are "
-            "unsupported on ROLLING (sliding-window) KV pools — see "
+        # ROLLING exclusions, re-asserted with the RESOLVED pool layout
+        # (engines can be constructed without validate): whole-region
+        # rolling rows cannot retain/clone/park — their idle ring
+        # writes wrap into live content — so prefix cache and
+        # preemption need the block pool (where released rows' writes
+        # land in the shared trash block). Chunked prefill and
+        # speculative decoding stay excluded on rolling REGARDLESS of
+        # blocks: an offset>0 multi-token ring write evicts history
+        # its own queries (or a rejected draft's rewind) still needs.
+        assert not (self.pool.rolling and not self._blocks_on
+                    and (self._prefix_on or self._preempt_on)), (
+            "enable_prefix_cache/preemption on ROLLING "
+            "(sliding-window) KV pools requires kv_block_size — see "
             "ServingConfig.validate")
-        # flash + int8 re-check with the RESOLVED pool dtype (validate
-        # only sees an explicit kv_dtype string; None inherits the
-        # Generator's): the offset-0 flash prefill reads raw k/v while
-        # offset>0 continuations read the dequantized int8 cache, so
-        # cache-on could not be token-exact vs cache-off
-        assert not (cfg.attention_impl == "flash"
-                    and self.pool.dtype == jnp.dtype(jnp.int8)
-                    and (self._prefix_on or self._chunk is not None
-                         or self._preempt_on)), (
-            "enable_prefix_cache/prefill_chunk/preemption are "
-            "unsupported on flash-impl int8 KV pools — see "
-            "ServingConfig.validate")
-        # speculative decoding: re-assert ServingConfig.validate with
-        # the RESOLVED pool dtype/layout (validate only sees an
-        # explicit kv_dtype string / sliding_window; engines can be
-        # constructed without it)
+        assert not (self.pool.rolling and self._chunk is not None), (
+            "prefill_chunk is unsupported on ROLLING (sliding-window) "
+            "KV pools — see ServingConfig.validate")
         self._spec_k = int(self.serving.speculative_k or 0)
         assert not (self._spec_k and self.pool.rolling), (
             "speculative_k is unsupported on ROLLING (sliding-window) "
             "KV pools: the verify window's ring writes evict history, "
             "so the accepted-length rewind cannot restore what a "
             "rejected draft overwrote — see ServingConfig.validate")
-        assert not (self._spec_k and cfg.attention_impl == "flash"
-                    and self.pool.dtype == jnp.dtype(jnp.int8)), (
-            "speculative_k is unsupported on flash-impl int8 KV pools "
-            "(the PR 5/6 offset-0-flash-vs-dequantized-cache "
-            "exclusion) — see ServingConfig.validate")
+        # flash-impl int8 pools carry NO exclusions anymore: quantized
+        # caches skip the offset-0 flash prefill shortcut
+        # (models/attention.py), so every cached forward reads the
+        # same dequantized values through the same dot path and the
+        # token-exact contracts hold structurally.
         assert self._spec_k < self.max_len, (self._spec_k, self.max_len)
         self.drafter = drafter if drafter is not None else NGramDrafter()
         # test seam: set to a list to record per-round (window tokens,
         # accept counts) for the serial-replay exactness pin
         self._spec_trace = None
-        self._index = PrefixIndex(max(self.serving.prefill_bucket, 1))
-        # a retained slot's KV is reclaimed lazily (alloc / retain
-        # overflow) — forget its prefixes the moment that happens
+        # block mode indexes at BLOCK granularity (hits must be
+        # block-aligned for map aliasing; validate() requires the
+        # block size to be a prefill_bucket multiple, so suffix shapes
+        # still land in the existing jit buckets)
+        self._index = PrefixIndex(self.pool.block_size if self._blocks_on
+                                  else max(self.serving.prefill_bucket, 1))
+        # a retained slot's (or block-mode retained prefix's) KV is
+        # reclaimed lazily (alloc pressure / retain overflow) — forget
+        # its prefixes the moment that happens
         self.pool.on_reclaim = self._index.remove
         self._prefilling: List[_PendingPrefill] = []
         self._admitting: List[GenRequest] = []  # mid-_admit pops
@@ -301,6 +317,10 @@ class ServingEngine:
         self._d_reject = jnp.asarray(self._reject)
         self._sampling_dirty = True
         self._lengths_dirty = True
+        # KV gauges recompute only after pool churn (admit / evict /
+        # retain / preempt): the coverage walk is O(blocks) host work
+        # that has no place in a churn-free decode window
+        self._kv_dirty = True
         self._sync_interval = max(self.serving.decode_sync_interval, 1)
         self._prefill_max_batch = max(
             min(self.serving.prefill_max_batch, self.num_slots), 1)
@@ -345,6 +365,14 @@ class ServingEngine:
                                         n_array_args=4)
         self._insert = self.gen._jit(self._insert_fn, n_array_args=8,
                                      donate_argnums=(1, 2, 3))
+        # block-mode variants: slice by explicit physical-block list,
+        # insert through the slot's map row with the aliased-prefix
+        # copy-on-write boundary
+        self._slice_blk = self.gen._jit(self._slice_blocks_fn,
+                                        n_array_args=3)
+        self._insert_blk = self.gen._jit(self._insert_blocks_fn,
+                                         n_array_args=9,
+                                         donate_argnums=(1, 2, 3))
         self._steps = 0
         self._cond = threading.Condition()
         self._stop = False
@@ -553,8 +581,18 @@ class ServingEngine:
         after it (drafter came up empty → spec_fallback_steps) applies
         the ban and returns it CLEARED. Non-speculative engines always
         pass all -1, which is bit-identical to the pre-speculative
-        step (sample_batched's banned<0 contract)."""
+        step (sample_batched's banned<0 contract).
+
+        Block-granular pools pass a BlockKV here: the per-slot block
+        map resolves into the contiguous slot-grid view at the top and
+        the updated view scatters back at the bottom — pure data
+        movement bracketing the identical program, so outputs are
+        bit-identical with blocks on vs off and the trace count stays
+        one (block indices are data)."""
         self._decode_traces += 1
+        bkv = None
+        if self._blocks_on:
+            bkv, pool = pool, resolve_view(pool)
         cfg = self.cfg
         split = jax.vmap(jax.random.split)(rngs)  # [S, 2, 2]
         new_rngs, step_keys = split[:, 0], split[:, 1]
@@ -577,6 +615,8 @@ class ServingEngine:
             logits_dtype=jnp.float32)
         new_lengths = jnp.minimum(lengths + 1,
                                   jnp.int32(self.max_len - 1))
+        if bkv is not None:
+            pool = scatter_view(bkv, pool)
         return (pool, logits[:, 0], new_rngs, toks, tok_lp, new_lengths,
                 jnp.full_like(rejects, -1))
 
@@ -613,6 +653,9 @@ class ServingEngine:
         new_rejects) — the host consumes 1+accepted tokens per live
         row and discards the rest."""
         self._verify_traces += 1
+        bkv = None
+        if self._blocks_on:
+            bkv, pool = pool, resolve_view(pool)
         cfg = self.cfg
         k = drafts.shape[1]
         split = jax.vmap(jax.random.split)(rngs)  # [S, 2, 2]
@@ -684,6 +727,8 @@ class ServingEngine:
                                 jnp.int32(-1)).astype(jnp.int32)
         new_lengths = jnp.minimum(lengths + 1 + a,
                                   jnp.int32(self.max_len - 1))
+        if bkv is not None:
+            pool = scatter_view(bkv, pool)
         return (pool, new_last, new_rngs, window, tok_lp, a,
                 new_lengths, new_rejects)
 
@@ -696,6 +741,9 @@ class ServingEngine:
         B>1 prefill is the B=1 prefill done B times. Duplicate rows
         (the batch-bucket pads replicate row 0) rewrite the same slot
         with identical values — idempotent by construction."""
+        bkv = None
+        if self._blocks_on:
+            bkv, pool = pool, resolve_view(pool)
         B = tokens.shape[0]
         caches = self.pool.make_prefill_caches(B)
         logits, caches = lm.model_forward(
@@ -717,6 +765,8 @@ class ServingEngine:
                 logits[i], plens[i] - 1, 1, axis=0)[0]
             last_logits = last_logits.at[slots[i]].set(last)
             rngs = rngs.at[slots[i]].set(rng0s[i])
+        if bkv is not None:
+            pool = scatter_view(bkv, pool)
         return pool, last_logits, rngs
 
     def _slice_fn(self, params, pool, slot, start):
@@ -726,6 +776,13 @@ class ServingEngine:
         `params` rides along unused so the mesh-aware jit treatment
         applies uniformly (jit drops unused args at lowering)."""
         return slice_slot(pool, slot, start)
+
+    def _slice_blocks_fn(self, params, pool, blocks, start):
+        """Block-mode region read: gather an explicit physical-block
+        list (a row's map, or a row-less RetainedPrefix's blocks) into
+        a batch-1 cache at `start`. Block indices are data — one
+        compile serves every source."""
+        return slice_blocks(pool, blocks, start)
 
     def _chunk_fwd_fn(self, params, sub, tokens, last_idx, next_offset):
         """Append one [1, s] prompt chunk at `sub`'s current offset
@@ -744,6 +801,18 @@ class ServingEngine:
         write half of kv_pool.clone_prefix, fused with the slot's
         last-logits/rng activation)."""
         pool = insert_prefill(pool, sub, slot, plen)
+        last_logits = last_logits.at[slot].set(last)
+        rngs = rngs.at[slot].set(rng0)
+        return pool, last_logits, rngs
+
+    def _insert_blocks_fn(self, params, pool, last_logits, rngs, sub,
+                          slot, plen, pfx_blocks, last, rng0):
+        """Block-mode landing: write the sub through `slot`'s (freshly
+        installed) map row, skipping the first `pfx_blocks` ALIASED
+        prefix blocks — their content is already in the arena and
+        shared with other holders (kv_pool.insert_blocks redirects
+        those writes to the trash block)."""
+        pool = insert_blocks(pool, sub, slot, plen, pfx_blocks)
         last_logits = last_logits.at[slot].set(last)
         rngs = rngs.at[slot].set(rng0)
         return pool, last_logits, rngs
@@ -799,10 +868,12 @@ class ServingEngine:
         hung iteration, restart it (reset device state, fail only the
         slotted requests, requeue the rest) up to `max_engine_restarts`
         times, then trip the crash-loop circuit breaker."""
+        blocks = (f", {self.pool.block_size}-token blocks"
+                  if self._blocks_on else "")
         print_rank_0(
             f"serving engine: {self.num_slots} slots x cap "
             f"{self.pool.cap} ({self.pool.dtype}"
-            f"{', rolling' if self.pool.rolling else ''}), "
+            f"{', rolling' if self.pool.rolling else ''}{blocks}), "
             f"pool {self.pool.nbytes() / 2**20:.1f} MiB, "
             f"queue bound {self.serving.max_queue}")
         while True:
@@ -810,6 +881,9 @@ class ServingEngine:
                 if self._session():
                     return
             except Exception as e:  # noqa: BLE001 — supervise, not hang
+                import os, traceback
+                if os.environ.get("MTPU_DEBUG_LOOP"):
+                    traceback.print_exc()
                 msg = repr(e)
                 if self._restarts >= self._max_restarts:
                     self._trip_breaker(msg)
@@ -969,10 +1043,12 @@ class ServingEngine:
         self.scheduler.clear_parked()
         self._prefilling = []
         self._sub0 = None
-        self._index = PrefixIndex(max(self.serving.prefill_bucket, 1))
+        self._index = PrefixIndex(self.pool.block_size if self._blocks_on
+                                  else max(self.serving.prefill_bucket, 1))
         self.pool = SlotKVPool(self.cfg, self.num_slots, self.max_len,
                                dtype=self.pool.dtype,
-                               retained_limit=self.serving.retained_slots)
+                               retained_limit=self.serving.retained_slots,
+                               block_size=self.serving.kv_block_size)
         self.pool.on_reclaim = self._index.remove
         S, Vp = self.num_slots, self.cfg.padded_vocab_size
         self._last_logits = jnp.zeros((S, Vp), jnp.float32)
@@ -984,6 +1060,7 @@ class ServingEngine:
         self._slot_req = [None] * S
         self._sampling_dirty = True
         self._lengths_dirty = True
+        self._kv_dirty = True
         self._wedged = False
         if self._watchdog is not None:
             self._watchdog.rearm()
@@ -1041,8 +1118,14 @@ class ServingEngine:
         # preemption runs at a sync boundary
         req.resume_reject = int(self._reject[slot])
         if self.scheduler.parked_count() < self.num_slots:
-            sub = self._slice(self.gen.params, self.pool.caches,
-                              jnp.int32(slot), jnp.int32(plen))
+            if self._blocks_on:
+                sub = self._slice_blk(
+                    self.gen.params, self.pool.caches,
+                    jnp.asarray(self.pool.map_row(slot), jnp.int32),
+                    jnp.int32(plen))
+            else:
+                sub = self._slice(self.gen.params, self.pool.caches,
+                                  jnp.int32(slot), jnp.int32(plen))
             # row-index makes a NEW device buffer — safe across the
             # next decode's donation of self._last_logits
             req.parked = (sub, self._last_logits[slot])
@@ -1055,6 +1138,7 @@ class ServingEngine:
         self._reject[slot] = -1  # draft state is droppable: a parked
         #                          victim carries only committed tokens
         self._sampling_dirty = True
+        self._kv_dirty = True
         self._lengths_dirty = True
         # the region itself goes back to the free list (its KV lives in
         # the parked sub now, a separate buffer), so the slot parks at
@@ -1091,11 +1175,7 @@ class ServingEngine:
                 # a resumed request prefills its EFFECTIVE prompt
                 # (prompt + generated); == prompt when never preempted
                 toks = r.effective_prompt()
-                # prefix lookup caps the match at len-1: at least one
-                # suffix token must forward to produce the sampling
-                # logits at position plen-1
-                src, hit = (self._index.lookup(toks, len(toks) - 1)
-                            if self._prefix_on else (None, 0))
+                src, hit = self._lookup_prefix(toks)
                 if hit or r.resume_rng is not None \
                         or (self._chunk is not None
                             and len(toks) > self._chunk):
@@ -1120,6 +1200,40 @@ class ServingEngine:
         finally:
             self._admitting = []
 
+    def _lookup_prefix(self, toks):
+        """Longest reusable cached prefix of `toks` and its source —
+        an int (running slot) or a RetainedPrefix key. The lookup caps
+        the match at len-1: at least one suffix token must forward to
+        produce the sampling logits at position plen-1.
+
+        ROLLING pools (block mode only — whole-region rolling never
+        indexes) add a ring-validity gate: the retained ring holds only
+        the LAST W positions of its sequence, so a clone is sound only
+        when (a) the new prompt CONTINUES the retained sequence in full
+        — matched at the entry's exact length, not the block-floored
+        index match — or (b) the source never wrapped (final length <=
+        W), where any block-aligned prefix is still resident. Running
+        rolling slots are never indexed at all: their ring keeps
+        wrapping over the very prefix the index would advertise."""
+        if not self._prefix_on:
+            return None, 0
+        toks = list(toks)
+        src, hit = self._index.lookup(toks, len(toks) - 1)
+        if src is None or not hit:
+            return None, 0
+        if not self.pool.rolling:
+            return src, hit
+        ent = (None if isinstance(src, (int, np.integer))
+               else self.pool.entry(src))
+        if ent is None:
+            return None, 0
+        f = ent.length
+        if f <= len(toks) - 1 and toks[:f] == ent.tokens:
+            return src, f  # full continuation at the EXACT ring length
+        if f <= self.pool.cap:
+            return src, hit  # ring never wrapped: any prefix resident
+        return None, 0
+
     def _resume_parked(self, req: GenRequest):
         """Resume a preemption victim whose KV survived in its parked
         sub-cache: allocate a slot and land the whole region with ONE
@@ -1130,12 +1244,19 @@ class ServingEngine:
         req.parked = None
         tokens = req.effective_prompt()
         plen = len(tokens)
-        slot = self.pool.alloc()
-        assert slot is not None, "popped more requests than free slots"
+        blocks = None
+        if self._blocks_on:
+            got = self.pool.alloc_row(install=False)
+            assert got is not None, "popped more requests than free slots"
+            slot, blocks = got
+        else:
+            slot = self.pool.alloc()
+            assert slot is not None, "popped more requests than free slots"
+        st = None
         try:
             st = _PendingPrefill(req, slot, sub, plen,
                                  jnp.asarray(req.resume_rng),
-                                 tokens=tokens)
+                                 tokens=tokens, blocks=blocks)
             st.last = last
             first = req.admit_time is None
             req.mark_admitted()  # no-op on a concurrently-failed req
@@ -1144,18 +1265,26 @@ class ServingEngine:
                                              - req.submit_time)
             self._activate_pending(st, plen)
         except Exception:
+            if blocks is not None and not (st is not None
+                                           and st.installed):
+                self.pool.drop_blocks(blocks)
             self.pool.release(slot)
             raise
 
-    def _start_pending(self, req: GenRequest, src_slot: Optional[int],
+    def _start_pending(self, req: GenRequest, src,
                        prefix_len: int):
         """Reserve a slot and begin a suffix/chunked prefill. On a
-        prefix hit the shared region slices out of `src_slot` (one
-        on-device copy in place of L forward layers over those
-        tokens); otherwise the sub-cache starts empty at offset 0.
-        A preemption-replay request (resume_rng set, parked KV gone)
-        prefills its effective prompt and continues the saved PRNG
-        chain — token-exact either way."""
+        prefix hit the shared region slices out of `src` (a running
+        slot or a RetainedPrefix key — one on-device copy in place of
+        L forward layers over those tokens); otherwise the sub-cache
+        starts empty at offset 0. Block-granular pools additionally
+        ALIAS the shared prefix blocks into the new row's map (refs
+        taken at alloc, map installed at activation), so the prefix's
+        arena blocks are shared, not duplicated — the insert later
+        skips them (copy-on-write boundary). A preemption-replay
+        request (resume_rng set, parked KV gone) prefills its
+        effective prompt and continues the saved PRNG chain —
+        token-exact either way."""
         tokens = req.effective_prompt()
         plen = len(tokens)
         if prefix_len:
@@ -1163,23 +1292,71 @@ class ServingEngine:
             # below forfeits the hit, so hit_tokens - tokens_saved
             # measures slot-pressure forfeits
             self.metrics.count("prefix_hit_tokens", prefix_len)
-        slot = self.pool.alloc(
-            exclude=(src_slot,) if prefix_len else ())
-        if slot is None:
-            # the ONLY allocatable slot is the clone source itself:
-            # forfeit the hit and reclaim it as a plain slot
-            src_slot, prefix_len = None, 0
-            slot = self.pool.alloc()
-        assert slot is not None, "popped more requests than free slots"
+        blocks = None
+        pfx_blocks = 0
+        if self._blocks_on:
+            alias = []
+            roll_src_blocks = None
+            if prefix_len and self.pool.rolling:
+                # capture BEFORE alloc_row: block pressure may evict
+                # the source entry below. Its blocks' content stays
+                # valid for this iteration's slice regardless — the
+                # arena is functional, the gather reads this dispatch
+                # point's version.
+                roll_src_blocks = list(self.pool.entry(src).blocks)
+            if prefix_len and not self.pool.rolling:
+                pfx_blocks = prefix_len // self.pool.block_size
+                alias = self._src_blocks(src)[:pfx_blocks]
+            got = self.pool.alloc_row(alias=alias, install=False)
+            if got is None and prefix_len:
+                # block pressure: forfeit the hit, admit plain
+                src, prefix_len, pfx_blocks = None, 0, 0
+                got = self.pool.alloc_row(install=False)
+            assert got is not None, "popped more requests than free slots"
+            slot, blocks = got
+        else:
+            slot = self.pool.alloc(
+                exclude=(src,) if prefix_len else ())
+            if slot is None:
+                # the ONLY allocatable slot is the clone source itself:
+                # forfeit the hit and reclaim it as a plain slot
+                src, prefix_len = None, 0
+                slot = self.pool.alloc()
+            assert slot is not None, "popped more requests than free slots"
         try:
             if prefix_len:
-                self.pool.touch(src_slot)  # refresh the retained LRU
+                if isinstance(src, (int, np.integer)):
+                    self.pool.touch(int(src))  # refresh the retained LRU
+                else:
+                    self.pool.touch_key(src)
                 req.prefix_len = prefix_len
                 self.metrics.count("prefix_hits")
                 self.metrics.count("prefill_tokens_saved", prefix_len)
-                sub = self._slice(self.gen.params, self.pool.caches,
-                                  jnp.int32(src_slot),
-                                  jnp.int32(prefix_len))
+                if not self._blocks_on:
+                    sub = self._slice(self.gen.params, self.pool.caches,
+                                      jnp.int32(src),
+                                      jnp.int32(prefix_len))
+                elif self.pool.rolling:
+                    # rolling hit: FULL ring copy out of the retained
+                    # entry's blocks (aliasing is unsound on a ring —
+                    # the new row's later writes wrap into the early
+                    # blocks). The gather reads the arena version of
+                    # THIS dispatch point, so later reuse of the
+                    # entry's blocks cannot corrupt the copy.
+                    sub = self._slice_blk(
+                        self.gen.params, self.pool.caches,
+                        jnp.asarray(roll_src_blocks, jnp.int32),
+                        jnp.int32(prefix_len))
+                else:
+                    # slicing through the new row's OWN block list
+                    # reads the aliased prefix content (plus
+                    # fresh-block garbage past the offset, which the
+                    # causal mask never sees) — the suffix chunks
+                    # attend the prefix through this sub
+                    sub = self._slice_blk(
+                        self.gen.params, self.pool.caches,
+                        jnp.asarray(blocks, jnp.int32),
+                        jnp.int32(prefix_len))
             else:
                 # miss: start from the shared ZERO template instead of
                 # paying a full region copy out of the pool for content
@@ -1193,7 +1370,8 @@ class ServingEngine:
                     if req.resume_rng is not None
                     else self._initial_rng(req.seed, plen))
             st = _PendingPrefill(req, slot, sub, prefix_len, rng0,
-                                 tokens=tokens)
+                                 tokens=tokens, blocks=blocks,
+                                 pfx_blocks=pfx_blocks)
             first = req.admit_time is None
             req.mark_admitted()  # no-op on a concurrently-failed req
             if first and req.admit_time is not None:
@@ -1201,8 +1379,17 @@ class ServingEngine:
                                              - req.submit_time)
             self._prefilling.append(st)
         except Exception:
+            if blocks is not None:
+                self.pool.drop_blocks(blocks)  # map never installed
             self.pool.release(slot)
             raise
+
+    def _src_blocks(self, src) -> List[int]:
+        """Physical blocks backing a prefix source: a running slot's
+        map row, or a row-less RetainedPrefix's pinned blocks."""
+        if isinstance(src, (int, np.integer)):
+            return self.pool.map_row(int(src))
+        return list(self.pool.entry(src).blocks)
 
     def _advance_prefill(self):
         """Run ONE prefill chunk for the oldest pending request; when
@@ -1218,6 +1405,14 @@ class ServingEngine:
         n = plen - st.pos
         if self._chunk is not None:
             n = min(n, self._chunk)
+        if self.pool.rolling and st.pos > 0:
+            # rolling prefix-hit suffix: an offset>0 MULTI-token ring
+            # write evicts history its own early queries still need
+            # within one dispatch (the reason prefill_chunk stays
+            # excluded on rolling), but the decode-shaped s=1 append
+            # is exact on the ring — so the suffix lands one token per
+            # engine iteration, interleaved with decode like any chunk
+            n = 1
         # chunk shape bucketing: a FULL chunk is already a fixed shape;
         # only the tail pads up to the prefill bucket (capped at the
         # chunk size so chunking never widens the shape set, and at the
@@ -1225,13 +1420,18 @@ class ServingEngine:
         # slot — a clamped dynamic_update_slice would silently shift
         # backwards over real tokens)
         b = max(self.serving.prefill_bucket, 1)
-        if self._chunk is not None and n == self._chunk:
+        if self.pool.rolling:
+            # ring prefill is exact-length: pad positions fed through
+            # the ring would evict real tokens from the W-slot buffer
+            padded = n
+        elif self._chunk is not None and n == self._chunk:
             padded = n
         else:
             padded = -(-n // b) * b
             if self._chunk is not None:
                 padded = min(padded, max(self._chunk, n))
-        padded = min(padded, self.max_len - st.pos)
+        if not self.pool.rolling:
+            padded = min(padded, self.max_len - st.pos)
         assert n <= padded, (n, padded, st.pos)
         toks = np.full((1, padded), self.gen.pad_id, np.int32)
         toks[0, :n] = st.tokens[st.pos:st.pos + n]
@@ -1250,10 +1450,25 @@ class ServingEngine:
 
     def _activate_pending(self, st: _PendingPrefill, plen: int):
         slot, req = st.slot, st.req
-        out = self._insert(self.gen.params, self.pool.caches,
-                           self._last_logits, self._rngs, st.sub,
-                           jnp.int32(slot), jnp.int32(plen), st.last,
-                           st.rng0)
+        if self._blocks_on:
+            # install the row's block map NOW (not at admission): until
+            # this moment the row's map pointed at trash, so the
+            # K-chained decode dispatches that ran between chunks could
+            # never write into the reserved (and possibly aliased)
+            # blocks
+            self.pool.install_row(slot, st.blocks)
+            st.installed = True
+            out = self._insert_blk(self.gen.params, self.pool.caches,
+                                   self._last_logits, self._rngs,
+                                   st.sub, jnp.int32(slot),
+                                   jnp.int32(plen),
+                                   jnp.int32(st.pfx_blocks), st.last,
+                                   st.rng0)
+        else:
+            out = self._insert(self.gen.params, self.pool.caches,
+                               self._last_logits, self._rngs, st.sub,
+                               jnp.int32(slot), jnp.int32(plen),
+                               st.last, st.rng0)
         self.pool.caches, self._last_logits, self._rngs = out
         self._lengths[slot] = plen
         self._active[slot] = True
@@ -1265,16 +1480,25 @@ class ServingEngine:
         self._reject[slot] = req.resume_reject
         self._slot_req[slot] = req
         self._sampling_dirty = True
+        self._kv_dirty = True
         self._lengths_dirty = True
-        if self._prefix_on:
+        if self._prefix_on and not self.pool.rolling:
             # the slot is now cloneable for its prefilled sequence —
             # the PROMPT for a fresh request, prompt + generated-so-far
-            # for a resumed one (extended again at retain time)
+            # for a resumed one (extended again at retain time).
+            # Rolling slots index only at RETAIN time: a running ring
+            # keeps wrapping over the very prefix the index would
+            # advertise.
             self._index.insert(slot, st.tokens)
 
     def _drop_pending(self, st: _PendingPrefill, msg: str,
                       kind: str = "error"):
         self._prefilling.remove(st)
+        if st.blocks is not None:
+            # still pending => the map row was never installed, so the
+            # reserved/aliased blocks are held only by the pending
+            self.pool.drop_blocks(st.blocks)
+        self._kv_dirty = True
         self.pool.release(st.slot)
         if st.req.fail(msg, kind=kind):
             self.metrics.count("requests_expired" if kind == "deadline"
@@ -1286,7 +1510,19 @@ class ServingEngine:
         (identical re-write of the same slot — harmless)."""
         B_real = len(reqs)
         B = self._batch_bucket(B_real)
-        slots = [self.pool.alloc() for _ in reqs]
+        if self._blocks_on:
+            slots = []
+            for _ in reqs:
+                # sync=False: pay ONE device-map upload for the whole
+                # group (the _prefill dispatch below consumes only the
+                # final map state)
+                got = self.pool.alloc_row(install=True, sync=False)
+                assert got is not None, (
+                    "popped more requests than free slots")
+                slots.append(got[0])
+            self.pool._sync_map()
+        else:
+            slots = [self.pool.alloc() for _ in reqs]
         plens = [len(r.prompt) for r in reqs]
         toks = np.full((B, padded), self.gen.pad_id, np.int32)
         for i, r in enumerate(reqs):
@@ -1320,13 +1556,16 @@ class ServingEngine:
                 self.metrics.record_admitted(req.admit_time
                                              - req.submit_time)
         self._sampling_dirty = True
+        self._kv_dirty = True
         self._lengths_dirty = True
         self.metrics.count("prefill_calls")
         self.metrics.count("prefill_prompts", B_real)
         self.metrics.count("prefill_forward_tokens", int(sum(plens)))
         for slot, req in zip(slots, reqs):
             req.prefill_chunks = 1
-            if self._prefix_on:
+            if self._prefix_on and not self.pool.rolling:
+                # rolling slots index only at retain time (see
+                # _activate_pending)
                 self._index.insert(slot, req.prompt)
 
     def _reap_cancelled(self):
@@ -1374,13 +1613,34 @@ class ServingEngine:
 
     def _evict(self, slot: int, failed: Optional[str] = None,
                kind: str = "error"):
+        slot = int(slot)  # callers iterate np.nonzero -> np.int64;
+        #                   a numpy slot id must never become an index
+        #                   key (isinstance(src, int) gates on it)
         req = self._slot_req[slot]
         self._slot_req[slot] = None
         self._active[slot] = False
         self._reject[slot] = -1  # residual carry dies with the stream
+        self._kv_dirty = True
         self._lengths_dirty = True  # device copy re-parks at next step
         self._sampling_dirty = True
-        if failed is None and self._prefix_on:
+        if failed is None and self._prefix_on and self._blocks_on:
+            # block-granular retention: the finished row converts into
+            # a ROW-LESS RetainedPrefix pinning only the blocks its
+            # final sequence covers — the tail blocks AND the grid row
+            # free immediately (this is the slots-per-HBM-byte win:
+            # retained capacity is bounded by blocks, not rows). The
+            # freed row parks at length 0 with an all-TRASH map, so
+            # its idle decode writes land in the trash block — no
+            # park-at-final-length dance, and the reason rolling rings
+            # can retain at all.
+            final = int(self._lengths[slot])
+            tokens = req.prompt + req.generated
+            self._index.remove(slot)
+            rkey = self.pool.retain_row(slot, final, tokens)
+            if rkey is not None:
+                self._index.insert(rkey, tokens)
+            self._lengths[slot] = 0
+        elif failed is None and self._prefix_on:
             # prefix cache: RETAIN the finished slot's KV for reuse
             # instead of freeing it, and index the full sequence the
             # region now holds (prompt + generated — the decode loop
@@ -1636,6 +1896,17 @@ class ServingEngine:
         for k in range(K):
             self.metrics.record_step(n_active, self.num_slots,
                                      int(consumed[k]), depth)
+        # KV-pool occupancy/fragmentation gauges (host accounting
+        # only — no device sync): blocks in use / pinned by retention,
+        # and reserved-minus-live bytes (the fragmentation gauge the
+        # block-granular pool exists to shrink). Recomputed only after
+        # pool churn — the coverage walk is O(blocks) host python, and
+        # a churn-free decode window moves the gauges only through
+        # per-slot live lengths (waste drifts a few tokens at most)
+        if self._kv_dirty:
+            self.metrics.set_kv_gauges(
+                *self.pool.kv_gauges(self._lengths))
+            self._kv_dirty = False
         if self._writer is not None and \
                 self._steps % self._report_interval < K:
             self.metrics.report(self._writer, self._steps)

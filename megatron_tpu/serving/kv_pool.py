@@ -14,22 +14,46 @@ overwritten write-before-read during decode).
 
 Prefix-cache support (SGLang's RadixAttention, slot-grid native): a
 finished slot can be RETAINED instead of freed — its KV stays resident
-on an LRU list and is reclaimed lazily, only when admission needs a
-slot (`retain`/`touch`/`alloc`). A request whose prompt shares a prefix
+and is reclaimed lazily, only when admission needs the memory
+(`retain`/`touch`/`alloc`). A request whose prompt shares a prefix
 with a retained (or still-running) slot reuses the prefix KV through
 ONE on-device region copy — `clone_prefix` / `slice_slot` — instead of
 re-running L forward layers over the shared tokens.
+
+Block-granular mode (`block_size=B`, vLLM's PagedAttention trade made
+static-shape): the pool's storage becomes a flat ARENA of
+`cap/B`-token physical blocks ([L, total_blocks, B, nkv, hd]) plus a
+device-resident per-slot BLOCK MAP ([num_slots, cap/B] int32, logical
+block -> physical block). The map is resolved at dispatch time —
+`resolve_view` gathers each slot's blocks into the SAME contiguous
+[L, S, cap, ...] layout the grid's compiled programs already consume,
+and `scatter_view` writes the result back — so shapes stay static and
+the one-compile decode trace survives (unlike true paging, only block
+INDICES are data). What changes is the ACCOUNTING: physical blocks are
+refcounted, a retained prefix pins only the blocks it actually covers
+(a 3-block prefix costs 3 blocks, not a whole cap region — and holds
+NO grid row, so retained capacity is bounded by blocks, not slots), a
+prefix hit ALIASES the shared blocks into the new slot's map instead
+of copying them, and idle grid rows point every map entry at a shared
+TRASH block so their garbage writes can never clobber retained KV.
+The rolling W-slot ring rides the same machinery (ring positions live
+at block (p // B) % (W/B)), which is what makes ROLLING pools
+retainable/cloneable/preemptible for the first time: a released ring
+row's garbage writes land in trash, not in the retained ring.
 """
 from __future__ import annotations
 
 import collections
-from typing import Callable, List, Optional
+import itertools
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from megatron_tpu.config import ModelConfig
-from megatron_tpu.inference.generation import init_kv_caches
+from megatron_tpu.inference.generation import (KV_CACHE_AXES, init_kv_caches,
+                                               kv_region_cap)
 from megatron_tpu.models.attention import KVCache
 
 
@@ -100,7 +124,8 @@ def clone_prefix(pool: KVCache, src_slot, dst_slot, plen) -> KVCache:
     defined for contiguous (non-ROLLING) pools: a rolling region holds
     the last W positions ring-ordered by the SOURCE's length, so the
     prefix [0, plen) may already be evicted —
-    `ServingConfig.validate` / the engine exclude rolling pools.
+    `ServingConfig.validate` / the engine exclude rolling pools
+    (block-granular pools lift this: see SlotKVPool block mode).
 
     The engine's admission path runs this decomposed around the suffix
     forward (`slice_slot` → append suffix KV → `insert_prefill`), which
@@ -109,71 +134,284 @@ def clone_prefix(pool: KVCache, src_slot, dst_slot, plen) -> KVCache:
                           dst_slot, plen)
 
 
+# ---------------------------------------------------------------------
+# block-granular arena: static per-slot block map, resolved at dispatch
+# ---------------------------------------------------------------------
+class BlockKV(NamedTuple):
+    """Device state of a block-granular pool.
+
+    `arena` holds k/v as [L, total_blocks, B, nkv, hd] (int8 scales as
+    [L, total_blocks, B, nkv, 1]) and the PER-SLOT offsets [L, S] —
+    offsets are per-row state, not per-block. `map` is the static
+    per-slot block table [S, cap/B] int32: map[s, i] is the physical
+    block holding slot s's positions [i*B, (i+1)*B). The LAST physical
+    block is the shared TRASH block: every map entry of an idle row
+    points at it, so the grid's garbage writes for inactive rows land
+    somewhere nothing ever reads. Block indices are DATA — remapping a
+    slot never retraces anything."""
+    arena: KVCache
+    map: jax.Array  # [S, cap/B] int32
+
+
+def resolve_view(bkv: BlockKV) -> KVCache:
+    """Gather the arena through the block map into the contiguous
+    [L, S, cap, nkv, hd] slot-grid layout every compiled program
+    already consumes. Pure/jittable; the map is a traced operand, so
+    ONE compile serves every block assignment."""
+    S, nb = bkv.map.shape
+    flat = bkv.map.reshape(-1)
+
+    def g(x):
+        y = jnp.take(x, flat, axis=1)  # [L, S*nb, B, ...]
+        return y.reshape(x.shape[0], S, nb * x.shape[2], *x.shape[3:])
+
+    a = bkv.arena
+    return KVCache(
+        k=g(a.k), v=g(a.v), offset=a.offset,
+        k_scale=None if a.k_scale is None else g(a.k_scale),
+        v_scale=None if a.v_scale is None else g(a.v_scale))
+
+
+def scatter_view(bkv: BlockKV, view: KVCache) -> BlockKV:
+    """Write an updated contiguous view back through the block map —
+    the inverse of `resolve_view`, closing a dispatch. Duplicate map
+    entries (the shared TRASH block, or a prefix block aliased into
+    several slots) receive identical values by construction: nobody
+    writes below its own offset, and aliased prefix blocks sit below
+    every alias-holder's offset, so the unordered scatter is
+    deterministic where it matters."""
+    S, nb = bkv.map.shape
+    flat = bkv.map.reshape(-1)
+
+    def s(ax, vx):
+        B = ax.shape[2]
+        blocks = vx.reshape(vx.shape[0], S * nb, B, *vx.shape[3:])
+        return ax.at[:, flat].set(blocks.astype(ax.dtype))
+
+    a = bkv.arena
+    arena = a._replace(
+        k=s(a.k, view.k), v=s(a.v, view.v), offset=view.offset,
+        k_scale=None if a.k_scale is None else s(a.k_scale, view.k_scale),
+        v_scale=None if a.v_scale is None else s(a.v_scale, view.v_scale))
+    return bkv._replace(arena=arena)
+
+
+def slice_blocks(bkv: BlockKV, blocks, offset) -> KVCache:
+    """Gather an explicit physical-block list ([cap/B] int32, traced)
+    into a batch-1 cache positioned at `offset` — the block-mode read
+    half of `clone_prefix` (and the preemption park). Works for rows
+    AND row-less retained prefixes: the caller owns the block list."""
+    a = bkv.arena
+
+    def g(x):
+        y = jnp.take(x, blocks, axis=1)  # [L, nb, B, ...]
+        return y.reshape(x.shape[0], 1, -1, *x.shape[3:])
+
+    return KVCache(
+        k=g(a.k), v=g(a.v),
+        offset=jnp.full((a.k.shape[0],), offset, jnp.int32),
+        k_scale=None if a.k_scale is None else g(a.k_scale),
+        v_scale=None if a.v_scale is None else g(a.v_scale))
+
+
+def insert_blocks(bkv: BlockKV, sub: KVCache, slot, plen,
+                  pfx_blocks) -> BlockKV:
+    """Land a batch-1 cache in `slot`'s mapped blocks with the first
+    `plen` tokens live — the block-mode write half of `clone_prefix`.
+
+    `pfx_blocks` (traced) is the copy-on-write boundary: blocks below
+    it are ALIASED shared-prefix blocks whose content the sub carries
+    verbatim (it was sliced through the same map) — rewriting them
+    would race identical bytes against other alias holders for no
+    benefit, so their writes are redirected to the TRASH block instead.
+    Only the fresh blocks at/after the boundary are written. Pass 0 to
+    write the whole region (a miss, a preemption resume)."""
+    S, nb = bkv.map.shape
+    a = bkv.arena
+    trash = a.k.shape[1] - 1  # static: last physical block
+    slot = jnp.asarray(slot, jnp.int32)
+    row = jax.lax.dynamic_slice(bkv.map, (slot, jnp.int32(0)), (1, nb))[0]
+    idx = jnp.where(jnp.arange(nb) >= pfx_blocks, row, jnp.int32(trash))
+
+    def s(ax, sx):
+        B = ax.shape[2]
+        blocks = sx.reshape(sx.shape[0], nb, B, *sx.shape[3:])
+        return ax.at[:, idx].set(blocks.astype(ax.dtype))
+
+    offset = jax.lax.dynamic_update_slice(
+        a.offset, jnp.full((a.offset.shape[0], 1), plen, jnp.int32),
+        (jnp.int32(0), slot))
+    arena = a._replace(
+        k=s(a.k, sub.k), v=s(a.v, sub.v), offset=offset,
+        k_scale=None if a.k_scale is None else s(a.k_scale, sub.k_scale),
+        v_scale=None if a.v_scale is None else s(a.v_scale, sub.v_scale))
+    return bkv._replace(arena=arena)
+
+
+class RetainedPrefix:
+    """A finished sequence's KV pinned at BLOCK granularity: the
+    physical blocks covering its first `length` tokens (ALL ring
+    blocks for a rolling pool — the whole window is live), plus the
+    token sequence for index/continuation checks. Holds NO grid row:
+    retained capacity is bounded by free blocks, not by slots."""
+
+    __slots__ = ("key", "blocks", "length", "tokens")
+
+    def __init__(self, key, blocks: List[int], length: int,
+                 tokens: List[int]):
+        self.key = key
+        self.blocks = blocks
+        self.length = length
+        self.tokens = tokens
+
+
 class SlotKVPool:
-    """Pre-allocated slot-grid cache + host-side free-slot bookkeeping.
+    """Pre-allocated slot-grid cache + host-side free bookkeeping.
 
-    `caches` is the live device pytree ([L, S, cap, nkv, hd] with
-    per-slot offsets [L, S]); the engine replaces it functionally every
-    step. Slot alloc/release runs only on the engine thread.
+    `caches` is the live device pytree; the engine replaces it
+    functionally every step. Slot/block accounting runs only on the
+    engine thread.
 
-    Lazy eviction (prefix cache): `retain(slot)` parks a finished
-    slot's KV on an LRU "retained" list instead of the free list; it
-    stays clone-able until `alloc` actually needs the slot (free list
-    first, then oldest retained). `retained_limit` caps the list (None
-    = every finished slot retains); `on_reclaim(slot)` fires whenever a
-    retained slot's KV is about to be overwritten so the engine can
-    drop its prefix-index entries."""
+    Whole-region mode (block_size=None, the bit-compatible default):
+    `caches` is the [L, S, cap, nkv, hd] KVCache, each slot owns its
+    contiguous region, and lazy eviction works per-REGION: `retain`
+    parks a finished slot's KV on an LRU instead of the free list, and
+    `alloc` reclaims free-first-then-LRU (`exclude=` protects a
+    same-cycle clone source). `retained_limit` caps the list;
+    `on_reclaim(slot)` fires when a retained slot's KV is about to be
+    overwritten so the engine can drop its prefix-index entries.
+
+    Block mode (block_size=B dividing cap): `caches` is a `BlockKV`
+    (flat arena + per-slot block map) and the second resource besides
+    grid rows is the refcounted physical-block pool. Rows allocate
+    their cap/B blocks up front (`alloc_row`, optionally ALIASING
+    shared prefix blocks), release them on eviction (`release_row`),
+    and retention (`retain_row`) converts a finished row into a
+    row-less `RetainedPrefix` pinning only the blocks its tokens
+    cover — the tail blocks (and the grid row) free immediately, which
+    is where the slots-per-HBM-byte win comes from. `retained_limit`
+    caps retained ENTRIES; `on_reclaim(key)` fires with the entry key
+    when block pressure (or the limit) evicts one."""
 
     def __init__(self, cfg: ModelConfig, num_slots: int, max_len: int,
-                 dtype=jnp.bfloat16, retained_limit: Optional[int] = None):
+                 dtype=jnp.bfloat16, retained_limit: Optional[int] = None,
+                 block_size: Optional[int] = None):
         assert num_slots >= 1, num_slots
         self.cfg = cfg
         self.num_slots = num_slots
         self.max_len = max_len
         self.dtype = jnp.dtype(dtype)
-        self.caches = init_kv_caches(cfg, num_slots, max_len, dtype=dtype,
-                                     per_slot_offsets=True)
-        self.cap = self.caches.k.shape[2]  # rolling pools clamp to W
+        self.cap = kv_region_cap(cfg, max_len)  # rolling pools clamp to W
         self.rolling = (cfg.sliding_window is not None
                         and self.cap == cfg.sliding_window
                         and self.cap < max_len)
-        self._free: List[int] = list(range(num_slots))
-        # retained slots, oldest first (OrderedDict as an LRU: touch
-        # moves to the end, reclaim pops from the front)
-        self._retained: "collections.OrderedDict[int, None]" = \
+        if block_size is not None and block_size >= self.cap:
+            # whole-region blocks ARE the regions — EXCEPT on rolling
+            # pools, where block mode is what makes retention possible
+            # at all (row-less entries + the trash map): there a
+            # one-block-per-slot arena is the legitimate degenerate
+            # case, and silently coercing it away would break the
+            # validate()-accepted config at the engine's
+            # rolling-requires-blocks assertion
+            block_size = self.cap if self.rolling else None
+        self.block_size = block_size
+        self._free: collections.deque = collections.deque(range(num_slots))
+        # retained state, oldest first (OrderedDict as an LRU: touch
+        # moves to the end, reclaim pops from the front). Whole-region
+        # mode keys by SLOT; block mode keys by RetainedPrefix key.
+        self._retained: "collections.OrderedDict" = \
             collections.OrderedDict()
         self.retained_limit = retained_limit
-        self.on_reclaim: Optional[Callable[[int], None]] = None
+        self.on_reclaim: Optional[Callable] = None
+        if block_size is None:
+            self.caches = init_kv_caches(cfg, num_slots, max_len,
+                                         dtype=dtype,
+                                         per_slot_offsets=True)
+            assert self.cap == self.caches.k.shape[2], (
+                "kv_region_cap drifted from init_kv_caches")
+            return
+        # ---- block mode ----------------------------------------------
+        assert self.cap % block_size == 0, (
+            f"kv block_size={block_size} must divide the region "
+            f"capacity ({self.cap})")
+        self.blocks_per_slot = self.cap // block_size
+        # one block set per slot plus the shared TRASH block (last
+        # physical index): same usable token capacity as the
+        # whole-region pool, one block of overhead
+        self.total_blocks = num_slots * self.blocks_per_slot + 1
+        self.TRASH = self.total_blocks - 1
+        from megatron_tpu.parallel.sharding import constrain
+        L, nkv, hd = cfg.num_layers, cfg.num_kv_heads, cfg.kv_channels
+        quant = self.dtype == jnp.dtype(jnp.int8)
+        shape = (L, self.total_blocks, block_size, nkv, hd)
+        sshape = shape[:4] + (1,)
+        arena = KVCache(
+            k=constrain(jnp.zeros(shape, dtype), KV_CACHE_AXES),
+            v=constrain(jnp.zeros(shape, dtype), KV_CACHE_AXES),
+            offset=jnp.zeros((L, num_slots), jnp.int32),
+            k_scale=(constrain(jnp.ones(sshape, jnp.float32),
+                               KV_CACHE_AXES) if quant else None),
+            v_scale=(constrain(jnp.ones(sshape, jnp.float32),
+                               KV_CACHE_AXES) if quant else None),
+        )
+        self._map = np.full((num_slots, self.blocks_per_slot),
+                            self.TRASH, np.int32)
+        # jnp.array, not asarray: the device map must never alias the
+        # host buffer (see _sync_map)
+        self.caches = BlockKV(arena=arena, map=jnp.array(self._map))
+        self._rc = np.zeros(self.total_blocks, np.int64)
+        self._rc[self.TRASH] = 1 << 60  # never freed
+        self._free_blocks: collections.deque = collections.deque(
+            range(self.total_blocks - 1))
+        self._ret_ids = itertools.count()
+        # free_count memo: the reclaimable-block walk is O(retained
+        # blocks) and the engine calls it every loop iteration — cache
+        # it and invalidate on any accounting mutation (_acct_dirty)
+        self._acct_dirty = True
+        self._free_count_cache = 0
+
+    @property
+    def blocks_enabled(self) -> bool:
+        return self.block_size is not None
 
     def make_prefill_caches(self, batch: int = 1) -> KVCache:
         """A fresh request-local cache in the POOL's layout (same cap /
         dtype / rolling decision), for the prefill pass that precedes
-        `insert_prefill`."""
+        `insert_prefill` / `insert_blocks`."""
         return init_kv_caches(self.cfg, batch, self.max_len,
                               dtype=self.dtype)
 
-    # ---- slot bookkeeping (engine thread only) -----------------------
+    # ---- whole-region slot bookkeeping (engine thread only) ----------
     def alloc(self, exclude=()) -> Optional[int]:
         """Allocate a slot: free list first, then reclaim the
         least-recently-used retained slot (its KV is about to be
         overwritten — `on_reclaim` fires so the index can forget it).
         `exclude` protects slots that must survive this allocation
         (the source of a prefix clone in the same admission cycle);
-        returns None when nothing outside `exclude` is allocatable."""
+        returns None when nothing outside `exclude` is allocatable.
+        Alloc order is pinned (tested): free slots come back FIFO in
+        release order, then retained slots oldest-first."""
+        assert not self.blocks_enabled, "block pools use alloc_row"
         if self._free:
-            return self._free.pop(0)
-        for slot in list(self._retained):
+            return self._free.popleft()
+        victim = None
+        for slot in self._retained:  # oldest first; no copy
             if slot not in exclude:
-                del self._retained[slot]
-                self._reclaim(slot)
-                return slot
-        return None
+                victim = slot
+                break
+        if victim is None:
+            return None
+        del self._retained[victim]
+        self._reclaim(victim)
+        return victim
 
     def retain(self, slot: int):
         """Finished request: keep the slot's KV for prefix reuse. The
         slot moves to the retained LRU (most-recent end); if that
         overflows `retained_limit`, the OLDEST retained slot is
         demoted to the free list (and reclaimed for the index)."""
+        assert not self.blocks_enabled, "block pools use retain_row"
+        slot = int(slot)
         assert slot not in self._free and slot not in self._retained, (
             f"retain of non-busy slot {slot}")
         self._retained[slot] = None
@@ -189,42 +427,272 @@ class SlotKVPool:
         if slot in self._retained:
             self._retained.move_to_end(slot)
 
-    def _reclaim(self, slot: int):
+    def _reclaim(self, key):
         if self.on_reclaim is not None:
-            self.on_reclaim(slot)
+            self.on_reclaim(key)
 
     def release(self, slot: int):
         """Hard free (error/cancel eviction): the KV is NOT indexed for
-        reuse — the engine drops any index entries itself."""
+        reuse — the engine drops any index entries itself. In block
+        mode this is `release_row`."""
+        if self.blocks_enabled:
+            self.release_row(slot)
+            return
+        slot = int(slot)
         assert slot not in self._free, f"double free of slot {slot}"
         self._retained.pop(slot, None)
         self._free.append(slot)
 
+    # ---- block-mode accounting (engine thread only) ------------------
+    def _sync_map(self):
+        # jnp.array COPIES (unlike jnp.asarray, which on the CPU
+        # backend can alias the numpy buffer zero-copy). The copy is
+        # load-bearing twice over: the map rides inside the DONATED
+        # pool pytree, so an aliased buffer would be recycled by XLA
+        # as scratch and corrupt the host-side map mid-flight; and
+        # host-side map surgery must never mutate the map an already
+        # dispatched program is still consuming.
+        self.caches = self.caches._replace(map=jnp.array(self._map))
+
+    def _unref(self, block: int):
+        self._acct_dirty = True
+        self._rc[block] -= 1
+        assert self._rc[block] >= 0, f"refcount underflow on {block}"
+        if self._rc[block] == 0:
+            self._free_blocks.append(block)
+
+    def _evict_retained(self):
+        key, ent = self._retained.popitem(last=False)
+        for b in ent.blocks:
+            self._unref(b)
+        self._reclaim(key)
+
+    def _ensure_free_blocks(self, n: int) -> bool:
+        while len(self._free_blocks) < n and self._retained:
+            self._evict_retained()
+        return len(self._free_blocks) >= n
+
+    def map_row(self, slot: int) -> List[int]:
+        return [int(b) for b in self._map[slot]]
+
+    def alloc_row(self, alias: Sequence[int] = (), install: bool = True,
+                  sync: bool = True) -> Optional[Tuple[int, List[int]]]:
+        """Allocate a grid row plus its cap/B physical blocks.
+
+        `alias` (a prefix of shared blocks, from a running row's map or
+        a RetainedPrefix) is referenced IN PLACE — the hit's zero-copy
+        half; only the remaining blocks come fresh from the free pool,
+        evicting retained entries LRU-first under pressure (aliased
+        entries may evict too: the refs taken here keep their blocks
+        alive). Returns (slot, block_list) or None; with
+        `install=False` the map row stays on TRASH — the caller must
+        `install_row` at activation time, so that the grid's idle
+        writes for the still-inactive row can never touch the blocks
+        (aliased ones especially) before the prefill lands."""
+        assert self.blocks_enabled
+        if not self._free:
+            return None
+        alias = list(alias)
+        assert len(alias) <= self.blocks_per_slot
+        self._acct_dirty = True
+        for b in alias:
+            self._rc[b] += 1  # take refs FIRST: eviction-safe
+        need = self.blocks_per_slot - len(alias)
+        if not self._ensure_free_blocks(need):
+            for b in alias:
+                self._unref(b)
+            return None
+        fresh = [self._free_blocks.popleft() for _ in range(need)]
+        for b in fresh:
+            assert self._rc[b] == 0, b
+            self._rc[b] = 1
+        slot = self._free.popleft()
+        blocks = alias + fresh
+        if install:
+            self.install_row(slot, blocks, sync=sync)
+        return slot, blocks
+
+    def install_row(self, slot: int, blocks: Sequence[int],
+                    sync: bool = True):
+        """Point `slot`'s map at its blocks (refs already held by
+        alloc_row) — called at activation, right before the insert.
+        `sync=False` defers the device-map upload so a batched caller
+        (the engine's group prefill) can install several rows and pay
+        ONE `_sync_map` instead of one per row."""
+        assert self.blocks_enabled
+        self._map[slot] = blocks
+        if sync:
+            self._sync_map()
+
+    def drop_blocks(self, blocks: Sequence[int]):
+        """Unref blocks held OUTSIDE a map row (an aborted pending
+        prefill whose row was never installed)."""
+        for b in blocks:
+            self._unref(b)
+
+    def release_row(self, slot: int):
+        """Free a grid row: unref its mapped blocks, park the map on
+        TRASH (idle garbage writes land there), return the row."""
+        assert self.blocks_enabled
+        slot = int(slot)  # np.int64 from np.nonzero must not leak into
+        #                   the row deque and become index keys later
+        self._acct_dirty = True
+        assert slot not in self._free, f"double free of slot {slot}"
+        for b in self._map[slot]:
+            if b != self.TRASH:
+                self._unref(int(b))
+        self._map[slot] = self.TRASH
+        self._sync_map()
+        self._free.append(slot)
+
+    def retain_row(self, slot: int, length: int, tokens: List[int]):
+        """Finished request, block mode: convert the row into a
+        row-less RetainedPrefix pinning only the blocks covering
+        `length` tokens (ALL ring blocks for rolling pools — the
+        window is wholly live); the tail blocks and the grid row free
+        immediately. Returns the retained key (for the prefix index),
+        or None when `retained_limit` is 0. Overflowing the limit
+        evicts the OLDEST entry (on_reclaim fires with its key)."""
+        assert self.blocks_enabled
+        if self.retained_limit is not None and self.retained_limit <= 0:
+            self.release_row(slot)
+            return None
+        if self.rolling:
+            live = self.blocks_per_slot
+        else:
+            live = min(-(-int(length) // self.block_size),
+                       self.blocks_per_slot)
+        blocks = [int(b) for b in self._map[slot][:live]]
+        assert all(b != self.TRASH for b in blocks), (slot, blocks)
+        key = ("ret", next(self._ret_ids))
+        self._acct_dirty = True
+        for b in blocks:
+            self._rc[b] += 1  # the entry's refs, before the row drops its own
+        self.release_row(slot)
+        self._retained[key] = RetainedPrefix(key, blocks, int(length),
+                                             list(tokens))
+        if (self.retained_limit is not None
+                and len(self._retained) > self.retained_limit):
+            self._evict_retained()
+        return key
+
+    def entry(self, key) -> Optional[RetainedPrefix]:
+        return self._retained.get(key)
+
+    def touch_key(self, key):
+        if key in self._retained:
+            self._retained.move_to_end(key)
+
+    # ---- capacity / introspection ------------------------------------
     def free_count(self) -> int:
-        """Allocatable slots: truly free + lazily-evictable retained."""
-        return len(self._free) + len(self._retained)
+        """Allocatable slots. Whole-region mode: truly free + lazily
+        evictable retained. Block mode: the CONSERVATIVE bound
+        min(free rows, worst-case-fresh admissions the free +
+        reclaimable blocks can back) — prefix aliasing only ever needs
+        fewer fresh blocks than this assumes. A block is RECLAIMABLE
+        when every one of its refs comes from retained entries
+        (evicting them frees it) — counting only rc==1 blocks here
+        would be a LIVENESS bug: multi-turn chains retain entries that
+        alias each other's blocks (rc >= 2 with no row holding them),
+        and since pop_ready(free_count()) gates the only path that
+        evicts retained entries, undercounting them would starve
+        admission permanently."""
+        if not self.blocks_enabled:
+            return len(self._free) + len(self._retained)
+        if not self._acct_dirty:
+            return self._free_count_cache
+        retained_refs: collections.Counter = collections.Counter()
+        for ent in self._retained.values():
+            for b in ent.blocks:
+                retained_refs[b] += 1
+        avail = len(self._free_blocks) + sum(
+            1 for b, n in retained_refs.items() if self._rc[b] == n)
+        self._free_count_cache = min(len(self._free),
+                                     avail // self.blocks_per_slot)
+        self._acct_dirty = False
+        return self._free_count_cache
 
     def retained_count(self) -> int:
         return len(self._retained)
 
     def used_count(self) -> int:
+        if self.blocks_enabled:
+            return self.num_slots - len(self._free)
         return self.num_slots - self.free_count()
 
     def nbytes(self) -> int:
-        n = self.caches.k.nbytes + self.caches.v.nbytes
-        if self.caches.k_scale is not None:
-            n += self.caches.k_scale.nbytes + self.caches.v_scale.nbytes
+        c = self.caches.arena if self.blocks_enabled else self.caches
+        n = c.k.nbytes + c.v.nbytes
+        if c.k_scale is not None:
+            n += c.k_scale.nbytes + c.v_scale.nbytes
         return n
+
+    def bytes_per_token(self) -> int:
+        """k+v (and int8 scale) bytes one cached token costs across
+        layers — the unit behind kv_bytes_wasted."""
+        n = 2 * self.cfg.num_layers * self.cfg.num_kv_heads \
+            * self.cfg.kv_channels * self.dtype.itemsize
+        if self.dtype == jnp.dtype(jnp.int8):
+            n += 2 * self.cfg.num_layers * self.cfg.num_kv_heads * 4
+        return n
+
+    def kv_gauges(self, lengths) -> Tuple[int, int, int]:
+        """(kv_blocks_used, kv_blocks_retained, kv_bytes_wasted) for
+        the serving metrics. `lengths` is the engine's per-slot length
+        array (live token counts for rows; block mode adds retained
+        entries' own lengths — they hold no row). kv_bytes_wasted is
+        reserved-minus-live: the internal-fragmentation gauge the
+        block refactor exists to shrink. Whole-region pools report in
+        region units (1 region == 1 "block")."""
+        lengths = np.minimum(np.asarray(lengths), self.cap)
+        if self.blocks_enabled:
+            used = int(self.total_blocks - 1 - len(self._free_blocks))
+            # per-PHYSICAL-block live-token coverage: aliased blocks
+            # (one physical block in several maps/entries) count once,
+            # at their maximum coverage — so reserved-minus-live is
+            # the true fragmentation, not inflated by sharing
+            B = self.block_size
+            cover = np.zeros(self.total_blocks, np.int64)
+
+            def _cover(blocks, ntok):
+                for i, b in enumerate(blocks):
+                    c = min(max(ntok - i * B, 0), B)
+                    if c > cover[b]:
+                        cover[b] = c
+
+            for slot in range(self.num_slots):
+                if lengths[slot] > 0:
+                    _cover(self._map[slot], int(lengths[slot]))
+            pinned = set()
+            for e in self._retained.values():
+                _cover(e.blocks, min(e.length, self.cap))
+                pinned.update(e.blocks)
+            retained = len(pinned)
+            cover[self.TRASH] = 0
+            live = int(cover.sum())
+            reserved = used * B
+        else:
+            used = self.num_slots - len(self._free)
+            retained = len(self._retained)
+            live = int(lengths.sum())
+            reserved = used * self.cap
+        wasted = max(reserved - live, 0) * self.bytes_per_token()
+        return used, retained, wasted
 
 
 def slot_nbytes(cfg: ModelConfig, max_len: int,
-                dtype=jnp.bfloat16) -> int:
+                dtype=jnp.bfloat16, block_size: Optional[int] = None) -> int:
     """Bytes ONE slot's cache region will occupy (k+v, plus int8
     scales), without allocating — for sizing num_slots against free
-    device memory before building the pool."""
-    cap = max_len
-    if cfg.sliding_window is not None and cfg.attention_impl == "flash":
-        cap = min(cap, cfg.sliding_window)
+    device memory before building the pool. The capacity comes from
+    `generation.kv_region_cap`, the SAME helper `init_kv_caches`
+    allocates from, so this can never disagree with the pool the
+    engine actually builds. `block_size` rounds the region up to
+    whole blocks (a no-op when it divides the cap, which
+    ServingConfig.validate enforces)."""
+    cap = kv_region_cap(cfg, max_len)
+    if block_size is not None and block_size < cap:
+        cap = -(-cap // block_size) * block_size
     elems = cfg.num_layers * cap * cfg.num_kv_heads * cfg.kv_channels
     n = 2 * elems * jnp.dtype(dtype).itemsize
     if jnp.dtype(dtype) == jnp.dtype(jnp.int8):
@@ -233,7 +701,8 @@ def slot_nbytes(cfg: ModelConfig, max_len: int,
 
 
 def fit_num_slots(cfg: ModelConfig, max_len: int, dtype=jnp.bfloat16,
-                  requested: int = 8, headroom: float = 0.8) -> int:
+                  requested: int = 8, headroom: float = 0.8,
+                  block_size: Optional[int] = None) -> int:
     """Clamp `requested` slots to what the backend's free memory can
     hold (weights are assumed already resident, so bytes_limit -
     bytes_in_use is the pool's budget). Backends with no memory stats
@@ -247,5 +716,6 @@ def fit_num_slots(cfg: ModelConfig, max_len: int, dtype=jnp.bfloat16,
     if not stats or not stats.get("bytes_limit"):
         return requested
     free = stats["bytes_limit"] - stats.get("bytes_in_use", 0)
-    fit = int(free * headroom) // max(slot_nbytes(cfg, max_len, dtype), 1)
+    fit = int(free * headroom) // max(
+        slot_nbytes(cfg, max_len, dtype, block_size), 1)
     return max(1, min(requested, fit))

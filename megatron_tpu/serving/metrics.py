@@ -87,6 +87,14 @@ class ServingMetrics:
         self.queue_depth = 0
         self.active_slots = 0
         self.num_slots = 0
+        # KV-pool gauges (docs/serving.md observability): blocks in use
+        # / pinned by retained prefixes (whole-region pools report in
+        # region units), and reserved-minus-live bytes — the
+        # internal-fragmentation gauge the block-granular pool exists
+        # to shrink
+        self.kv_blocks_used = 0
+        self.kv_blocks_retained = 0
+        self.kv_bytes_wasted = 0
 
     # ---- recording ---------------------------------------------------
     def count(self, name: str, n: int = 1):
@@ -107,6 +115,15 @@ class ServingMetrics:
             self._counters["requests_completed"] += 1
             self._counters["tokens_generated"] += gen_tokens
             self._req_latency.append(latency_s)
+
+    def set_kv_gauges(self, blocks_used: int, blocks_retained: int,
+                      bytes_wasted: int):
+        """Engine-pushed KV-pool occupancy/fragmentation gauges (from
+        SlotKVPool.kv_gauges, refreshed every step window)."""
+        with self._lock:
+            self.kv_blocks_used = int(blocks_used)
+            self.kv_blocks_retained = int(blocks_retained)
+            self.kv_bytes_wasted = int(bytes_wasted)
 
     def record_step(self, active_slots: int, num_slots: int,
                     tokens_emitted: int, queue_depth: int):
@@ -141,7 +158,13 @@ class ServingMetrics:
                    if self._total_slot_steps else 0.0)
             gauges = {"queue_depth": float(self.queue_depth),
                       "active_slots": float(self.active_slots),
-                      "num_slots": float(self.num_slots)}
+                      "num_slots": float(self.num_slots),
+                      # always present (0.0 before traffic) like the
+                      # base counters: the /metrics schema never
+                      # mutates mid-run
+                      "kv_blocks_used": float(self.kv_blocks_used),
+                      "kv_blocks_retained": float(self.kv_blocks_retained),
+                      "kv_bytes_wasted": float(self.kv_bytes_wasted)}
         out = {k: 0.0 for k in _BASE_COUNTERS}
         out.update({k: float(v) for k, v in counters.items()})
         out.update(gauges)

@@ -139,10 +139,24 @@ def test_bench_prefix_emits_ab_record(monkeypatch, tmp_path):
         monkeypatch, tmp_path, "bench_prefix.py",
         ["--requests", "5", "--shared", "32", "--unique", "8",
          "--slots", "3", "--new", "4", "--chunk", "16",
+         "--sessions", "5", "--block", "16",
          "--layers", "2", "--hidden", "64", "--heads", "4",
          "--vocab", "128", "--seq", "128"])
     rec = json.loads(text)
     assert rec["bench"] == "prefix_cache"
+    # multi-turn-chat capacity arm (the block-pool acceptance seam):
+    # whole-region retention is bounded by the 3 slots and LRU-thrashes
+    # on 5 serial sessions, block retention keeps every session — the
+    # hit-rate ratio at FIXED pool bytes must clear 2x
+    whole, blocks = (rec["multiturn_whole_region"],
+                     rec["multiturn_blocks"])
+    assert whole["retained_after_turn1"] <= 3
+    assert blocks["retained_after_turn1"] == 5
+    assert blocks["turn2_session_hit_rate"] == 1.0
+    assert rec["retained_capacity_x"] >= 2.0
+    # fragmentation gauge: block retention wastes far fewer reserved
+    # bytes than whole-cap regions for the same live prefixes
+    assert blocks["kv_bytes_wasted"] < whole["kv_bytes_wasted"]
     base, pref, chnk = (rec["baseline"], rec["prefix"],
                         rec["prefix_chunked"])
     assert base["prefix_hits"] == 0

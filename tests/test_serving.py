@@ -924,45 +924,74 @@ class TestPrefixCacheEngine:
         assert snap["prefix_hit_tokens"] == 0
         assert snap["prefill_tokens_saved"] == 0
 
-    def test_flash_int8_pool_excluded_loudly(self):
-        """Flash-impl int8 pools can't honor the token-exact contract
-        (offset-0 flash prefill reads raw k/v, offset>0 continuations
-        read the dequantized cache) — rejected at validate() AND at
-        engine construction with the RESOLVED pool dtype."""
+    def test_flash_int8_pool_supported_token_exact(self):
+        """The old flash-int8 exclusion is ERASED: quantized caches
+        skip the offset-0 flash prefill shortcut (attention_apply), so
+        every cached int8 forward — prefill, chunk, prefix suffix —
+        reads the same dequantized cache through the same dot path and
+        the token-exact cache-on/off contract holds structurally."""
         cfg = tiny_cfg(attention_impl="flash")
-        with pytest.raises(AssertionError, match="flash-impl int8"):
-            ServingConfig(max_len=64, kv_dtype="int8",
-                          enable_prefix_cache=True).validate(cfg)
-        with pytest.raises(AssertionError, match="flash-impl int8"):
-            ServingConfig(max_len=64, kv_dtype="int8",
-                          prefill_chunk=8).validate(cfg)
-        # dot-impl int8 stays supported (both paths read the cache)
+        # validates clean now (was an AssertionError before the block
+        # refactor)
         ServingConfig(max_len=64, kv_dtype="int8",
-                      enable_prefix_cache=True).validate(tiny_cfg())
-        # kv_dtype=None inheriting an int8 Generator: engine catches it
+                      enable_prefix_cache=True,
+                      prefill_chunk=8).validate(cfg)
         params = lm.model_init(jax.random.PRNGKey(0), cfg)
         gen = Generator(params, cfg, eos_id=0, pad_id=0,
                         kv_cache_dtype=jnp.int8)
-        with pytest.raises(AssertionError, match="flash-impl int8"):
-            ServingEngine(gen, ServingConfig(max_len=64,
-                                             prefill_chunk=8),
-                          start=False)
+        shared = list(range(2, 34))
+        wave1 = [shared + [40 + i, 50 + i] for i in range(3)]
+        wave2 = [shared + [70 + i] for i in range(2)]
 
-    def test_rolling_pool_excluded_loudly(self):
-        cfg = tiny_cfg(sliding_window=16, attention_impl="flash",
+        def run(prefix):
+            with ServingEngine(gen, ServingConfig(
+                    num_slots=3, max_len=64, kv_dtype="int8",
+                    enable_prefix_cache=prefix,
+                    prefill_chunk=8 if prefix else None)) as eng:
+                outs = []
+                for wave in (wave1, wave2):  # wave 1 retains, 2 hits
+                    reqs = [eng.submit(p, 4,
+                                       SamplingOptions(temperature=0.8,
+                                                       top_k=5),
+                                       seed=i)
+                            for i, p in enumerate(wave)]
+                    outs += [r.result(timeout=300)[0] for r in reqs]
+                snap = eng.metrics.snapshot()
+            return outs, snap
+
+        off, _ = run(False)
+        on, snap = run(True)
+        assert on == off, "flash-int8 prefix cache diverged"
+        assert snap["prefix_hits"] >= 1
+        assert snap["prefill_tokens_saved"] > 0
+
+    def test_rolling_pool_requires_blocks(self):
+        """Rolling retention/preemption needs the block-granular pool
+        (a whole-region ring row's idle writes wrap into live
+        content); chunked prefill stays excluded on rolling with OR
+        without blocks. All four combinations pinned."""
+        cfg = tiny_cfg(sliding_window=32, attention_impl="flash",
                        seq_length=64, max_position_embeddings=64)
-        with pytest.raises(AssertionError, match="ROLLING"):
+        with pytest.raises(AssertionError, match="kv_block_size"):
             ServingConfig(max_len=64,
                           enable_prefix_cache=True).validate(cfg)
         with pytest.raises(AssertionError, match="ROLLING"):
             ServingConfig(max_len=64, prefill_chunk=8).validate(cfg)
+        with pytest.raises(AssertionError, match="ROLLING"):
+            ServingConfig(max_len=64, kv_block_size=16,
+                          prefill_chunk=8).validate(cfg)
+        # blocks lift the prefix-cache and preemption exclusions
+        ServingConfig(max_len=64, kv_block_size=16,
+                      enable_prefix_cache=True).validate(cfg)
+        ServingConfig(max_len=64, kv_block_size=16, preemption=True,
+                      priority_levels=2).validate(cfg)
         # non-rolling models validate fine
         ServingConfig(max_len=64, enable_prefix_cache=True,
                       prefill_chunk=8).validate(tiny_cfg())
         # the engine enforces it even without validate()
         params = lm.model_init(jax.random.PRNGKey(0), cfg)
         gen = Generator(params, cfg, eos_id=0, pad_id=0)
-        with pytest.raises(AssertionError, match="ROLLING"):
+        with pytest.raises(AssertionError, match="kv_block_size"):
             ServingEngine(gen, ServingConfig(
                 max_len=64, enable_prefix_cache=True), start=False)
 
@@ -2162,20 +2191,26 @@ class TestSpeculativeDecode:
                     assert eng._verify_traces == 0
         assert outs[4] == outs[0]
 
-    def test_validate_rejects_rolling_and_flash_int8(self):
+    def test_validate_rejects_rolling_keeps_flash_int8(self):
+        """Speculative decoding stays excluded on ROLLING pools (with
+        or without kv_block_size — a rejected draft's ring write
+        already evicted the position the rewind would need), but the
+        old flash-int8 exclusion is erased (the int8 prefill takes the
+        cached dot path, so verify windows read the same values)."""
         cfg_roll = tiny_cfg(sliding_window=16, attention_impl="flash",
                             seq_length=64)
         with pytest.raises(AssertionError, match="ROLLING"):
             ServingConfig(speculative_k=4).validate(cfg_roll)
-        cfg_flash = tiny_cfg(attention_impl="flash")
-        with pytest.raises(AssertionError, match="flash-impl int8"):
+        with pytest.raises(AssertionError, match="ROLLING"):
             ServingConfig(speculative_k=4,
-                          kv_dtype="int8").validate(cfg_flash)
-        # engine re-assert on the RESOLVED dtype (kv_dtype=None
-        # inheriting an int8 Generator never reaches validate's check)
-        params = lm.model_init(jax.random.PRNGKey(0), cfg_flash)
-        gen = Generator(params, cfg_flash, eos_id=0, pad_id=0,
-                        kv_cache_dtype=jnp.int8)
+                          kv_block_size=8).validate(cfg_roll)
+        cfg_flash = tiny_cfg(attention_impl="flash")
+        ServingConfig(speculative_k=4,
+                      kv_dtype="int8").validate(cfg_flash)
+        # engine re-assert on the RESOLVED pool layout, even without
+        # validate()
+        params = lm.model_init(jax.random.PRNGKey(0), cfg_roll)
+        gen = Generator(params, cfg_roll, eos_id=0, pad_id=0)
         with pytest.raises(AssertionError, match="speculative_k"):
             ServingEngine(gen, ServingConfig(num_slots=2, max_len=64,
                                              speculative_k=4),
@@ -2205,3 +2240,612 @@ class TestSpeculativeDecode:
         assert grids[0][0].tolist() == [4, 7]  # C[1:3] of [9,4,7,8,...]
         assert (grids[0][1] == NO_DRAFT).all()  # inactive row = filler
         assert any_real[0] is True
+
+
+class TestBlockPoolUnits:
+    """SlotKVPool block-mode accounting: refcounted free blocks,
+    aliasing, row-less retention, trash map, gauges, and the pinned
+    whole-region alloc order (the deque satellite)."""
+
+    def test_whole_region_alloc_order_pinned(self, tiny_model):
+        """Free slots come back FIFO in release order; exhausting the
+        free list reclaims retained slots OLDEST-first (with exclude
+        honored). This order is load-bearing for the prefix cache's
+        LRU semantics — pin it."""
+        _, cfg = tiny_model
+        pool = SlotKVPool(cfg, 4, 32)
+        assert [pool.alloc() for _ in range(4)] == [0, 1, 2, 3]
+        pool.release(2)
+        pool.release(0)
+        assert pool.alloc() == 2 and pool.alloc() == 0  # FIFO
+        pool.retain(3)
+        pool.retain(1)
+        reclaimed = []
+        pool.on_reclaim = reclaimed.append
+        assert pool.alloc(exclude=(3,)) == 1  # oldest outside exclude
+        assert pool.alloc() == 3
+        assert reclaimed == [1, 3]
+        assert pool.alloc() is None
+
+    def test_block_pool_refcounts_and_retention(self, tiny_model):
+        _, cfg = tiny_model
+        pool = SlotKVPool(cfg, 3, 32, block_size=8)  # 4 blocks/slot
+        assert pool.blocks_enabled and pool.blocks_per_slot == 4
+        assert pool.total_blocks == 13 and pool.TRASH == 12
+        # a fresh row owns 4 blocks; its map installs eagerly
+        s0, b0 = pool.alloc_row()
+        assert sorted(b0) == list(range(4))
+        assert list(pool._map[s0]) == b0
+        # retention pins only the covered blocks (11 tokens -> 2) and
+        # frees the row + tail immediately
+        key = pool.retain_row(s0, 11, list(range(11)))
+        assert key is not None and pool.entry(key).length == 11
+        assert len(pool.entry(key).blocks) == 2
+        assert len(pool._free_blocks) == 10  # 8 untouched + 2 tail
+        assert (pool._map[s0] == pool.TRASH).all()
+        assert pool.free_count() == 3
+        # aliasing: a new row reuses a retained prefix block; only 3
+        # fresh blocks leave the free pool
+        alias = pool.entry(key).blocks[:1]
+        s2, b2 = pool.alloc_row(alias=alias, install=False)
+        assert b2[:1] == alias and pool._rc[alias[0]] == 2
+        assert len(pool._free_blocks) == 7
+        # the map stays on TRASH until install (idle-write protection)
+        assert (pool._map[s2] == pool.TRASH).all()
+        pool.install_row(s2, b2)
+        assert list(pool._map[s2]) == b2
+        # evicting the retained entry keeps the aliased block alive
+        # (the row's ref) while its exclusive block frees
+        reclaimed = []
+        pool.on_reclaim = reclaimed.append
+        pool._evict_retained()
+        assert reclaimed == [key]
+        assert pool._rc[alias[0]] == 1
+        assert len(pool._free_blocks) == 8
+        pool.release_row(s2)
+        assert pool._rc[alias[0]] == 0
+        assert len(pool._free_blocks) == 12
+
+    def test_block_pressure_evicts_retained_lru(self, tiny_model):
+        _, cfg = tiny_model
+        pool = SlotKVPool(cfg, 2, 32, block_size=8)
+        s0, _ = pool.alloc_row()
+        k0 = pool.retain_row(s0, 8, list(range(8)))   # pins 1 block
+        s1, _ = pool.alloc_row()
+        k1 = pool.retain_row(s1, 8, list(range(8)))   # pins 1 block
+        reclaimed = []
+        pool.on_reclaim = reclaimed.append
+        # 6 free blocks; two fresh rows need 8 -> oldest entry evicts
+        pool.alloc_row()
+        pool.alloc_row()
+        assert reclaimed == [k0, k1]  # LRU order under pressure
+
+    def test_free_count_reclaims_chained_retained_blocks(self,
+                                                         tiny_model):
+        """Liveness: multi-turn chains retain entries that ALIAS each
+        other's blocks (rc >= 2 with no row holding them). free_count
+        must count those as reclaimable — pop_ready(free_count()) is
+        the only trigger that ever evicts retained entries, so
+        undercounting would starve admission permanently even though
+        evicting the chain frees a whole row."""
+        _, cfg = tiny_model
+        pool = SlotKVPool(cfg, 1, 32, block_size=8)  # 4 blocks, 1 row
+        s0, _ = pool.alloc_row()
+        k1 = pool.retain_row(s0, 16, list(range(16)))  # pins 2 blocks
+        # turn 2 aliases turn 1's blocks and retains a longer chain
+        alias = pool.entry(k1).blocks[:2]
+        s1, b1 = pool.alloc_row(alias=alias)
+        pool.retain_row(s1, 24, list(range(24)))  # pins alias + 1
+        # every real block is now referenced ONLY by retained entries
+        # (two of them at rc=2); nothing is exclusively-retained, yet
+        # evicting the chain frees the whole row
+        assert len(pool._free_blocks) == 1
+        assert pool.free_count() == 1
+        got = pool.alloc_row()  # must evict the chain and succeed
+        assert got is not None
+
+    def test_retained_limit_caps_entries(self, tiny_model):
+        _, cfg = tiny_model
+        pool = SlotKVPool(cfg, 3, 32, block_size=8, retained_limit=1)
+        reclaimed = []
+        pool.on_reclaim = reclaimed.append
+        s0, _ = pool.alloc_row()
+        k0 = pool.retain_row(s0, 8, list(range(8)))
+        s1, _ = pool.alloc_row()
+        pool.retain_row(s1, 8, list(range(8)))
+        assert reclaimed == [k0] and pool.retained_count() == 1
+        # limit 0: nothing retains, the row just frees
+        pool0 = SlotKVPool(cfg, 2, 32, block_size=8, retained_limit=0)
+        s, _ = pool0.alloc_row()
+        assert pool0.retain_row(s, 8, list(range(8))) is None
+        assert pool0.retained_count() == 0 and pool0.free_count() == 2
+
+    def test_slot_nbytes_matches_block_pool(self, tiny_model):
+        from megatron_tpu.serving.kv_pool import slot_nbytes
+        _, cfg = tiny_model
+        pool = SlotKVPool(cfg, 3, 64, block_size=16)
+        per_slot = slot_nbytes(cfg, 64, block_size=16)
+        # arena = slots * per-slot bytes + one trash block
+        assert pool.nbytes() == 3 * per_slot + per_slot // 4
+        # int8 pools include scale bytes
+        pool8 = SlotKVPool(cfg, 2, 64, dtype=jnp.int8, block_size=16)
+        per8 = slot_nbytes(cfg, 64, dtype=jnp.int8, block_size=16)
+        assert pool8.nbytes() == 2 * per8 + per8 // 4
+
+    def test_kv_gauges_modes(self, tiny_model):
+        import numpy as np
+        _, cfg = tiny_model
+        bpt = SlotKVPool(cfg, 2, 32).bytes_per_token()
+        # whole-region: reserved = used regions * cap
+        pool = SlotKVPool(cfg, 2, 32)
+        pool.alloc()
+        used, ret, wasted = pool.kv_gauges(np.array([10, 0]))
+        assert (used, ret) == (1, 0)
+        assert wasted == (32 - 10) * bpt
+        # blocks: reserved = allocated blocks * B; retention waste only
+        # spans the entry's last partial block
+        poolb = SlotKVPool(cfg, 2, 32, block_size=8)
+        s0, _ = poolb.alloc_row()
+        poolb.retain_row(s0, 11, list(range(11)))
+        used, ret, wasted = poolb.kv_gauges(np.array([0, 0]))
+        assert (used, ret) == (2, 2)
+        assert wasted == (16 - 11) * bpt
+
+    def test_validate_block_size_constraints(self):
+        cfg = tiny_cfg()
+        ServingConfig(max_len=64, kv_block_size=16).validate(cfg)
+        with pytest.raises(AssertionError, match="divide"):
+            ServingConfig(max_len=64, kv_block_size=24).validate(cfg)
+        with pytest.raises(AssertionError, match="prefill_bucket"):
+            ServingConfig(max_len=64, kv_block_size=8,
+                          enable_prefix_cache=True).validate(cfg)
+        # block_size >= cap degrades to whole-region mode
+        pool = SlotKVPool(cfg, 2, 32, block_size=64)
+        assert not pool.blocks_enabled
+
+    def test_kv_gauges_in_metrics_schema(self):
+        snap = ServingMetrics().snapshot()
+        for key in ("kv_blocks_used", "kv_blocks_retained",
+                    "kv_bytes_wasted"):
+            assert snap[key] == 0.0  # present before any traffic
+
+
+@pytest.fixture(scope="module")
+def block_model():
+    cfg = tiny_cfg(seq_length=96, max_position_embeddings=96)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+class TestBlockPoolEngine:
+    """The block-on-vs-off bit-exactness contract: the map resolve is
+    pure data movement, so EVERY path — plain decode, prefix-hit,
+    chunked prefill, preemption-resume, speculative — produces
+    bit-identical seeded outputs with kv_block_size set vs not, for
+    bf16 AND int8 pools, while decode + verify keep compiling exactly
+    once. These extend the existing exactness pins (same workloads,
+    same serial ground truth) to the block pool."""
+
+    def _outs(self, gen, serving, prompts, n=8,
+              sampling=SamplingOptions(temperature=0.9, top_k=5),
+              trace_check=None, second_wave=None):
+        with ServingEngine(gen, serving) as eng:
+            reqs = [eng.submit(p, n, sampling, seed=i)
+                    for i, p in enumerate(prompts)]
+            outs = [r.result(timeout=300)[0] for r in reqs]
+            if second_wave is not None:
+                rr = [eng.submit(p, n, sampling, seed=100 + i)
+                      for i, p in enumerate(second_wave)]
+                outs += [r.result(timeout=300)[0] for r in rr]
+            snap = eng.metrics.snapshot()
+            if trace_check is not None:
+                trace_check(eng)
+        return outs, snap
+
+    @pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8"])
+    def test_plain_decode_bit_identical_and_single_compile(
+            self, block_model, kv_dtype):
+        params, cfg = block_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+
+        def pin(eng):
+            assert eng._decode_traces == 1
+
+        off, _ = self._outs(gen, ServingConfig(
+            num_slots=3, max_len=96, kv_dtype=kv_dtype), PROMPTS)
+        on, _ = self._outs(gen, ServingConfig(
+            num_slots=3, max_len=96, kv_dtype=kv_dtype,
+            kv_block_size=16), PROMPTS, trace_check=pin)
+        assert on == off
+        # and the serial ground truth still holds through blocks
+        sp = SamplingParams(temperature=0.9, top_k=5)
+        want, lens, _ = gen.generate([PROMPTS[0]], 8, sampling=sp, seed=0)
+        assert on[0] == want[0, :lens[0]].tolist()
+
+    @pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8"])
+    def test_prefix_and_chunked_bit_identical(self, block_model,
+                                              kv_dtype):
+        params, cfg = block_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        shared = list(range(2, 36))
+        prompts = [shared + [40 + i, 50 + i, 60 + i] for i in range(6)]
+        base, _ = self._outs(gen, ServingConfig(
+            num_slots=3, max_len=96, kv_dtype=kv_dtype), prompts, n=6)
+        for chunk in (None, 16):
+            on, snap = self._outs(gen, ServingConfig(
+                num_slots=3, max_len=96, kv_dtype=kv_dtype,
+                kv_block_size=16, enable_prefix_cache=True,
+                prefill_chunk=chunk), prompts, n=6)
+            assert on == base, f"diverged with chunk={chunk}"
+            assert snap["prefix_hits"] >= 1
+            assert snap["prefill_tokens_saved"] > 0
+
+    def test_retained_capacity_exceeds_slots(self, block_model):
+        """THE capacity win: retained prefixes pin blocks, not grid
+        rows (or whole cap regions), so far more sessions stay
+        cloneable than the pool has slots. Five 1-block chat sessions
+        through a 3-slot pool, turns submitted serially: whole-region
+        retention LRU-thrashes (a retained sequence costs a full
+        96-token region, at most num_slots survive, and every turn-2
+        miss evicts another session) while the block pool keeps all
+        five 16-token prefixes resident — every turn 2 hits."""
+        params, cfg = block_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        greedy = SamplingOptions(temperature=0.0)
+        prompts = [[10 + i] * 12 for i in range(5)]
+
+        def run(block):
+            turn2 = []
+            with ServingEngine(gen, ServingConfig(
+                    num_slots=3, max_len=96, kv_block_size=block,
+                    enable_prefix_cache=True)) as eng:
+                turn1 = [eng.generate(p, 4, greedy, seed=i)[0]
+                         for i, p in enumerate(prompts)]  # serial
+                retained_after_t1 = eng.pool.retained_count()
+                for i, hist in enumerate(turn1):
+                    turn2.append(eng.generate(hist + [88], 4, greedy,
+                                              seed=100 + i)[0])
+                snap = eng.metrics.snapshot()
+            return turn1 + turn2, retained_after_t1, snap
+
+        off, ret_off, snap_off = run(None)
+        on, ret_on, snap_on = run(16)
+        assert on == off  # hit-path outputs stay bit-identical
+        # whole-region retention is bounded by the slot count; blocks
+        # keep every session
+        assert ret_off <= 3
+        assert ret_on == len(prompts)
+        # ...and turn 2 converts that into hits: all 5 for blocks,
+        # none for whole-region (LRU thrash)
+        assert snap_off["prefix_hits"] == 0
+        assert snap_on["prefix_hits"] == len(prompts)
+        assert snap_on["kv_blocks_retained"] > 0
+
+    def test_burst_hits_on_recycled_running_slots(self, block_model):
+        """Regression: slot ids flow through np.nonzero (np.int64) into
+        evictions, the free-row deque, and eventually the prefix index
+        as RUNNING-slot keys — which the hit path must still recognize
+        as slots, not retained-prefix keys (a np.int64 once fell
+        through `isinstance(src, int)` and crashed the engine loop
+        with pool.entry(np.int64) == None under concurrent
+        shared-prefix bursts). Drive chained retention + mixed bursts
+        and require every request served with ZERO engine restarts."""
+        params, cfg = block_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        greedy = SamplingOptions(temperature=0.0)
+        rs = np.random.RandomState(0)
+        with ServingEngine(gen, ServingConfig(
+                num_slots=4, max_len=96, kv_block_size=16,
+                enable_prefix_cache=True, max_queue=64)) as eng:
+            hist = [h % 90 + 2 for h in range(40)]
+            for _ in range(3):  # multi-turn chain retention
+                hist = eng.generate(hist, 6, greedy, seed=1,
+                                    timeout=300)[0] + [30]
+            for _ in range(4):  # concurrent mixed bursts
+                reqs = [eng.submit(
+                    (hist[:rs.randint(5, len(hist))] if i % 2 else
+                     rs.randint(2, 90, rs.randint(4, 40)).tolist()),
+                    8, greedy, seed=i) for i in range(10)]
+                for r in reqs:
+                    r.result(timeout=300)
+            snap = eng.metrics.snapshot()
+        assert snap["engine_restarts"] == 0
+        assert snap["requests_completed"] >= 43
+        assert snap["prefix_hits"] >= 1
+
+    @pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8"])
+    def test_preemption_resume_bit_identical(self, block_model,
+                                             kv_dtype):
+        params, cfg = block_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+
+        def run(block):
+            serving = ServingConfig(
+                num_slots=1, max_len=96, kv_dtype=kv_dtype,
+                kv_block_size=block, priority_levels=2, preemption=True)
+            with ServingEngine(gen, serving) as eng:
+                low = eng.submit([5, 6, 7, 8], 24,
+                                 SamplingOptions(temperature=0.8,
+                                                 top_k=5), seed=1,
+                                 priority=0)
+                t0 = time.monotonic()
+                while len(low.generated) < 2 and not low.done():
+                    time.sleep(0.002)
+                    assert time.monotonic() - t0 < 60
+                hi = eng.submit([50, 51], 4,
+                                SamplingOptions(temperature=0.0),
+                                seed=2, priority=1)
+                hi_out = hi.result(timeout=300)[0]
+                low_out = low.result(timeout=300)[0]
+                pre = eng.metrics.snapshot()["preemptions"]
+            return low_out, hi_out, pre
+
+        l_off, h_off, p_off = run(None)
+        l_on, h_on, p_on = run(16)
+        assert p_on >= 1, "premise: preemption fired in the block arm"
+        assert (l_on, h_on) == (l_off, h_off)
+
+    @pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8"])
+    def test_speculative_bit_identical_and_single_verify_compile(
+            self, block_model, kv_dtype):
+        params, cfg = block_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        prompts = [[5, 17, 3, 42, 9, 9, 5, 17], [7, 8, 9, 7, 8, 9, 7],
+                   [11, 12, 13, 11, 12]]
+
+        def pin(eng):
+            assert eng._decode_traces == 1
+            assert eng._verify_traces == 1
+
+        for temp in (0.0, 0.8):
+            sampling = SamplingOptions(temperature=temp)
+            off, s_off = self._outs(gen, ServingConfig(
+                num_slots=3, max_len=96, kv_dtype=kv_dtype,
+                speculative_k=4), prompts, n=10, sampling=sampling)
+            on, s_on = self._outs(gen, ServingConfig(
+                num_slots=3, max_len=96, kv_dtype=kv_dtype,
+                speculative_k=4, kv_block_size=16), prompts, n=10,
+                sampling=sampling, trace_check=pin)
+            assert on == off, f"spec diverged at temperature={temp}"
+            assert s_on["accepted_tokens"] == s_off["accepted_tokens"]
+        # greedy spec ALSO matches the non-speculative engine (the
+        # existing pin, extended through blocks)
+        nospec, _ = self._outs(gen, ServingConfig(
+            num_slots=3, max_len=96, kv_dtype=kv_dtype), prompts,
+            n=10, sampling=SamplingOptions(temperature=0.0))
+        spec, _ = self._outs(gen, ServingConfig(
+            num_slots=3, max_len=96, kv_dtype=kv_dtype,
+            kv_block_size=16, speculative_k=4), prompts, n=10,
+            sampling=SamplingOptions(temperature=0.0))
+        assert spec == nospec
+
+
+@pytest.fixture(scope="module")
+def rolling_model():
+    cfg = tiny_cfg(sliding_window=32, attention_impl="flash",
+                   seq_length=96, max_position_embeddings=96)
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+class TestRollingBlocks:
+    """The rolling exclusions, erased (clone, preempt) or narrowed
+    (speculative) by the block pool — the clone/preempt/speculative
+    exactness suite the refactor's acceptance demands."""
+
+    def _serve(self, gen, serving, waves, timeout=300):
+        outs = []
+        with ServingEngine(gen, serving) as eng:
+            for wave in waves:
+                reqs = [eng.submit(p, n, s, seed=seed)
+                        for (p, n, s, seed) in wave]
+                outs.append([r.result(timeout=timeout)[0]
+                             for r in reqs])
+            snap = eng.metrics.snapshot()
+        return outs, snap
+
+    def test_plain_rolling_blocks_bit_identical(self, rolling_model):
+        params, cfg = rolling_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        wave = [([5 + i, 6 + i, 7 + i], 8,
+                 SamplingOptions(temperature=0.7, top_k=5), i)
+                for i in range(4)]
+        off, _ = self._serve(gen, ServingConfig(num_slots=2,
+                                                max_len=96), [wave])
+        on, _ = self._serve(gen, ServingConfig(
+            num_slots=2, max_len=96, kv_block_size=16), [wave])
+        assert on == off
+
+    @pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8"])
+    def test_rolling_clone_cache_on_vs_off(self, rolling_model,
+                                           kv_dtype):
+        """Multi-turn continuation on a ROLLING pool: turn 2 extends
+        turn 1's full sequence, so the retained ring (wrapped at
+        f > W for the long session, unwrapped for the short one) is
+        cloned at its exact length and only the new turn forwards —
+        token-matching the cache-off engine, which re-prefills the
+        whole conversation."""
+        params, cfg = rolling_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sampling = SamplingOptions(temperature=0.7, top_k=5)
+        turn1 = [(list(range(2, 22)), 10, sampling, 0),     # f=30 <= W
+                 (list(range(3, 33)), 10, sampling, 1)]     # f=40 > W
+        base, _ = self._serve(gen, ServingConfig(
+            num_slots=2, max_len=96, kv_dtype=kv_dtype,
+            kv_block_size=16), [turn1])
+        turn2 = [(base[0][0] + [40, 41], 8, sampling, 100),
+                 (base[0][1] + [42, 43, 44], 8, sampling, 101)]
+
+        def run(prefix):
+            return self._serve(gen, ServingConfig(
+                num_slots=2, max_len=96, kv_dtype=kv_dtype,
+                kv_block_size=16, enable_prefix_cache=prefix),
+                [turn1, turn2])
+
+        off, s_off = run(False)
+        on, s_on = run(True)
+        assert on == off
+        assert s_on["prefix_hits"] == 2
+        # the WRAPPED source's clone saved its whole 40-token history
+        assert s_on["prefill_tokens_saved"] == 30 + 40
+        assert s_on["prefill_forward_tokens"] \
+            < s_off["prefill_forward_tokens"]
+
+    def test_rolling_partial_hit_only_when_unwrapped(self,
+                                                     rolling_model):
+        """A PARTIAL prefix hit (not a full continuation) is sound
+        only while the source ring never wrapped (f <= W): positions
+        below f-W are gone from a wrapped ring. Pin both sides: the
+        unwrapped source serves a shared-prefix sibling; the wrapped
+        source does not."""
+        params, cfg = rolling_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        greedy = SamplingOptions(temperature=0.0)
+        shared = list(range(2, 18))  # one 16-token block
+
+        def run(first_len, prefix):
+            turn1 = [(shared + list(range(60, 60 + first_len)), 10,
+                      greedy, 0)]
+            sibling = [(shared + [70, 71, 72], 6, greedy, 100)]
+            return self._serve(gen, ServingConfig(
+                num_slots=2, max_len=96, kv_block_size=16,
+                enable_prefix_cache=prefix), [turn1, sibling])
+
+        # unwrapped source (16+4+10 = 30 <= 32): sibling hits
+        off, _ = run(4, False)
+        on, snap = run(4, True)
+        assert on == off
+        assert snap["prefix_hits"] == 1
+        # wrapped source (16+10+10 = 36 > 32): the shared block is no
+        # longer resident — the engine must NOT clone it
+        _, snap_w = run(10, True)
+        assert snap_w["prefix_hits"] == 0
+
+    @pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8"])
+    def test_rolling_preemption_token_exact(self, rolling_model,
+                                            kv_dtype):
+        params, cfg = rolling_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+
+        def run(preempt):
+            serving = ServingConfig(
+                num_slots=1, max_len=96, kv_dtype=kv_dtype,
+                kv_block_size=16, priority_levels=2,
+                preemption=preempt)
+            with ServingEngine(gen, serving) as eng:
+                # prompt 38 > W=32: the ring has wrapped before the
+                # preemption lands
+                low = eng.submit(list(range(2, 40)), 30,
+                                 SamplingOptions(temperature=0.8,
+                                                 top_k=5), seed=1,
+                                 priority=0)
+                t0 = time.monotonic()
+                while len(low.generated) < 2 and not low.done():
+                    time.sleep(0.002)
+                    assert time.monotonic() - t0 < 60
+                hi = eng.submit([50, 51, 52], 5,
+                                SamplingOptions(temperature=0.0),
+                                seed=2, priority=1)
+                hi_out = hi.result(timeout=300)[0]
+                low_out = low.result(timeout=300)[0]
+                pre = eng.metrics.snapshot()["preemptions"]
+            return low_out, hi_out, pre
+
+        l_on, h_on, p_on = run(True)
+        l_off, h_off, _ = run(False)
+        assert p_on >= 1, "premise: preemption fired"
+        assert (l_on, h_on) == (l_off, h_off)
+
+    def test_rolling_replay_fallback_token_exact(self, rolling_model):
+        """Parked refs dropped (park budget 0 via a full parking lot is
+        hard to stage deterministically — instead drop them directly):
+        the victim replays prompt+generated through the offset-0 flash
+        prefill, exact on the ring because the replay writes the same
+        positions the original stream wrote."""
+        params, cfg = rolling_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+
+        def run(drop_parked):
+            serving = ServingConfig(
+                num_slots=1, max_len=96, kv_block_size=16,
+                priority_levels=2, preemption=True)
+            with ServingEngine(gen, serving) as eng:
+                low = eng.submit(list(range(2, 40)), 26,
+                                 SamplingOptions(temperature=0.8,
+                                                 top_k=5), seed=1,
+                                 priority=0)
+                t0 = time.monotonic()
+                while len(low.generated) < 2 and not low.done():
+                    time.sleep(0.002)
+                    assert time.monotonic() - t0 < 60
+                hi = eng.submit([50, 51, 52], 5,
+                                SamplingOptions(temperature=0.0),
+                                seed=2, priority=1)
+                if drop_parked:
+                    # between preemption and resume, drop the parked
+                    # device refs (the engine-restart / park-budget
+                    # path) — same seam the contiguous-pool replay
+                    # test uses
+                    t0 = time.monotonic()
+                    while low.preemptions == 0 and not low.done():
+                        time.sleep(0.002)
+                        assert time.monotonic() - t0 < 60
+                    dropped = eng.scheduler.clear_parked()
+                else:
+                    dropped = 0
+                hi.result(timeout=300)
+                low_out = low.result(timeout=300)[0]
+                pre = eng.metrics.snapshot()["preemptions"]
+            return low_out, pre, dropped
+
+        replay, p1, dropped = run(True)
+        parked, p2, _ = run(False)
+        assert p1 >= 1 and p2 >= 1 and dropped >= 1
+        assert replay == parked
+
+    def test_block_size_equal_window_stays_block_mode(self,
+                                                      rolling_model):
+        """Regression: kv_block_size == W passes validate (block_size
+        >= cap is the documented whole-region degrade) but on a
+        ROLLING pool block mode is what retention needs — the pool
+        must clamp to one block per slot, NOT silently coerce to
+        whole-region and crash the engine's rolling-requires-blocks
+        assertion."""
+        params, cfg = rolling_model  # W = 32
+        serving = ServingConfig(num_slots=2, max_len=96,
+                                kv_block_size=32,
+                                enable_prefix_cache=True)
+        serving.validate(cfg)
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        with ServingEngine(gen, serving) as eng:
+            assert eng.pool.blocks_enabled
+            assert eng.pool.blocks_per_slot == 1
+            # f = 30 + 10 = 40 > W: the ring wraps, and the sequence
+            # spans >= one 32-token index block so the continuation
+            # is findable (shorter-than-a-block sequences can't index
+            # — the granularity floor, same as any block size)
+            toks, _ = eng.generate(list(range(3, 33)), 10,
+                                   SamplingOptions(temperature=0.0),
+                                   seed=0, timeout=300)
+            toks2, _ = eng.generate(toks + [40, 41], 4,
+                                    SamplingOptions(temperature=0.0),
+                                    seed=1, timeout=300)
+            snap = eng.metrics.snapshot()
+        assert snap["prefix_hits"] >= 1
+        # non-rolling pools keep the whole-region degrade
+        pool = SlotKVPool(tiny_cfg(), 2, 32, block_size=64)
+        assert not pool.blocks_enabled
+
+    def test_rolling_speculative_still_excluded(self, rolling_model):
+        """The ONE remaining rolling exclusion, pinned with its
+        reason: a rejected draft's ring write evicted the position it
+        displaced — no rewind can restore it, blocks or not."""
+        params, cfg = rolling_model
+        with pytest.raises(AssertionError, match="ROLLING"):
+            ServingConfig(max_len=96, kv_block_size=16,
+                          speculative_k=4).validate(cfg)
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        with pytest.raises(AssertionError, match="speculative_k"):
+            ServingEngine(gen, ServingConfig(
+                num_slots=2, max_len=96, kv_block_size=16,
+                speculative_k=4), start=False)
