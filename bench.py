@@ -372,6 +372,12 @@ def _run_extras():
         # behavior"); the hang-recovery latency is the record
         ("chaos_serve.py", ["--smoke"],
          "/tmp/bench_extras_chaos_serve.log"),
+        # front-door chaos drill: replica kill / wedge / host-tier
+        # corruption over a REAL 2-replica router — zero lost
+        # requests, retried completions token-exact, checksum-gated
+        # host restores (docs/serving.md "Front door")
+        ("chaos_router.py", ["--smoke"],
+         "/tmp/bench_extras_chaos_router.log"),
         # corrupt-dataset detection smoke: inject truncated-.bin /
         # garbage-.idx / out-of-range-pointer faults, prove each raises
         # a typed DatasetCorruptionError at open (docs/resilience.md

@@ -319,6 +319,26 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                         "seconds fails the in-flight requests and "
                         "restarts the loop (None disables; must "
                         "exceed the worst prefill compile time)")
+    g.add_argument("--num_replicas", type=int, default=1,
+                   help="serving: engine replicas behind the in-process "
+                        "prefix-affinity router — requests route to the "
+                        "replica whose prefix cache holds the longest "
+                        "match (ties: least-loaded); unhealthy replicas "
+                        "are ejected and their work retries on a "
+                        "survivor (1 = no router, docs/serving.md "
+                        "'Front door')")
+    g.add_argument("--router_max_retries", type=int, default=2,
+                   help="serving: bounded failover retries per request "
+                        "before its error surfaces (503 only when "
+                        "every replica is down)")
+    g.add_argument("--host_kv_bytes", type=int, default=0,
+                   help="serving: host-RAM KV tier byte budget — "
+                        "retained prefix block lists evicted under "
+                        "block pressure demote to host memory "
+                        "(checksum-verified on restore) and restore "
+                        "via device_put on a later hit; needs "
+                        "--enable_prefix_cache + --kv_block_size "
+                        "(0 disables)")
 
     g = p.add_argument_group(
         "reference compat",
@@ -601,7 +621,10 @@ def config_from_args(args: argparse.Namespace,
             shed_on_overload=args.shed_on_overload,
             preemption=args.preemption,
             max_engine_restarts=args.max_engine_restarts,
-            engine_step_timeout_s=args.engine_step_timeout_s),
+            engine_step_timeout_s=args.engine_step_timeout_s,
+            num_replicas=args.num_replicas,
+            router_max_retries=args.router_max_retries,
+            host_kv_bytes=args.host_kv_bytes),
         resilience=ResilienceConfig(**{
             **_pick(args, ResilienceConfig),
             "checkpoint_integrity": not args.no_checkpoint_integrity}),

@@ -501,6 +501,36 @@ class ServingConfig:
     # when the wedged dispatch returns. None disables. Must comfortably
     # exceed the worst prefill-bucket compile time.
     engine_step_timeout_s: Optional[float] = None
+    # --- front door knobs (docs/serving.md "Front door") --------------
+    # engine replicas behind the in-process prefix-affinity router
+    # (serving/router.py): each replica is a full ServingEngine (own KV
+    # pool, queue, supervisor) over the SAME weights; the router routes
+    # each request to the replica whose prefix cache holds the longest
+    # match (ties: least-loaded), ejects unhealthy replicas from
+    # rotation (failed work retries on a survivor, token-exact), and
+    # re-admits recovered ones through a half-open canary. 1 = no
+    # router at all — the server drives the engine directly,
+    # bit-identical to the single-replica build (test-pinned).
+    num_replicas: int = 1
+    # bounded failover retries per request before its error surfaces
+    # (503 only when every replica is down)
+    router_max_retries: int = 2
+    # a replica that produced no healthy `health()` snapshot for this
+    # long is ejected from rotation (wedged replicas get this grace —
+    # their watchdog may restart them — hard-down states eject at once)
+    router_heartbeat_timeout_s: float = 5.0
+    # host-RAM KV tier byte budget (serving/host_tier.py): retained
+    # prefix BLOCK LISTS evicted under block pressure demote to host
+    # memory (checksum per entry, verified on restore — a corrupt
+    # demotion is a miss, never wrong tokens) and restore on a later
+    # prefix hit via one device_put, multiplying effective prefix-cache
+    # capacity ~10x beyond the grid. Requires enable_prefix_cache +
+    # kv_block_size. 0 = off, bit-identical to the tier-less engine
+    # (test-pinned).
+    host_kv_bytes: int = 0
+    # SSE stream registry TTL: a finished stream's request (and its
+    # committed tokens) stays resumable via Last-Event-ID for this long
+    stream_ttl_s: float = 600.0
 
     def validate(self, model: Optional["ModelConfig"] = None
                  ) -> "ServingConfig":
@@ -625,6 +655,24 @@ class ServingConfig:
             self.request_deadline_s > 0.0, self.request_deadline_s
         assert self.kv_dtype is None or \
             self.kv_dtype in SERVING_KV_DTYPES, self.kv_dtype
+        assert self.num_replicas >= 1, self.num_replicas
+        assert self.router_max_retries >= 0, self.router_max_retries
+        assert self.router_heartbeat_timeout_s > 0.0, \
+            self.router_heartbeat_timeout_s
+        assert self.stream_ttl_s > 0.0, self.stream_ttl_s
+        assert self.host_kv_bytes >= 0, self.host_kv_bytes
+        if self.host_kv_bytes:
+            # the tier demotes/restores retained BLOCK LISTS — the unit
+            # the block-granular pool pins and the prefix index routes
+            # hits through; without either there is nothing to demote
+            assert self.enable_prefix_cache \
+                and self.kv_block_size is not None, (
+                "host_kv_bytes requires enable_prefix_cache AND "
+                "kv_block_size: the host tier demotes retained prefix "
+                "BLOCK lists (docs/serving.md 'Front door')")
+        assert not (self.num_replicas > 1 and self.serial_fallback), (
+            "num_replicas > 1 routes through the continuous-batching "
+            "engine; serial_fallback has no replicas to route over")
         if self.max_len is not None:
             assert self.max_len >= 1
             if model is not None and model.max_position_embeddings:

@@ -31,6 +31,17 @@ running slots) and `deadline_s` (per-request SLO overriding
 request_deadline_s). SIGTERM drains gracefully: stop admitting, finish
 in-flight slots, then exit.
 
+Front door (docs/serving.md "Front door"): `ServingConfig(
+num_replicas=N)` puts N full engine replicas behind the in-process
+prefix-affinity router (serving/router.py) — health-driven failover,
+token-exact retry on survivors, degraded-vs-down /healthz. Payloads
+with `stream: true` (single prompt) switch the response to SSE
+(`text/event-stream`) on BOTH transports: one `token` event per
+committed token with `id:` = its monotonic index, a terminal `done` or
+typed `error` event, and reconnect-resume via `stream_id` +
+`Last-Event-ID` (the engine holds committed tokens per request, so
+resume replays the tail — no duplicated or missing tokens).
+
 The reference needs a rank-0 Flask thread that broadcasts a GENERATE/BEAM
 signal to all other ranks sitting in a receive loop
 (ref: text_generation_server.py:22-31); single-controller JAX needs none of
@@ -51,6 +62,25 @@ from megatron_tpu.inference.generation import Generator
 from megatron_tpu.utils.logging import print_rank_0
 
 MAX_PROMPTS = 128
+
+
+class _StreamEntry:
+    """Registry row for one SSE stream: the live request handle (its
+    `generated` list IS the resume buffer) plus the TTL bookkeeping."""
+
+    __slots__ = ("sid", "req", "created", "done_t")
+
+    def __init__(self, sid: str, req):
+        import time as _time
+        self.sid = sid
+        self.req = req
+        self.created = _time.monotonic()
+        self.done_t = None  # set when first observed done; TTL runs
+
+
+def _is_stream_body(body) -> bool:
+    import types as _types
+    return isinstance(body, _types.GeneratorType)
 
 
 def validate_generate_payload(payload) -> Optional[str]:
@@ -116,10 +146,31 @@ class MegatronServer:
         self._lock = threading.Lock()  # serial paths: one at a time (ref: :37)
         self._request_counter = itertools.count()
         self._timeout = request_timeout
+        # SSE stream registry: stream_id -> live request handle, so a
+        # dropped connection resumes via Last-Event-ID (the engine
+        # already holds every committed token on the request — resume
+        # is a replay of the tail, not recomputation)
+        self._streams: dict = {}
+        self._streams_lock = threading.Lock()
         self.engine = None
         if not self.serving.serial_fallback:
             from megatron_tpu.serving import ServingEngine
-            self.engine = ServingEngine(generator, self.serving)
+            if self.serving.num_replicas > 1:
+                # N full engine replicas (own KV pool / queue /
+                # supervisor each, same weights) behind the in-process
+                # prefix-affinity router. num_replicas=1 builds NO
+                # router at all — the bare engine, bit-identical to
+                # the single-replica server (test-pinned).
+                from megatron_tpu.serving import EngineRouter
+                engines = [ServingEngine(generator, self.serving)
+                           for _ in range(self.serving.num_replicas)]
+                self.engine = EngineRouter(
+                    engines,
+                    max_retries=self.serving.router_max_retries,
+                    heartbeat_timeout_s=
+                    self.serving.router_heartbeat_timeout_s)
+            else:
+                self.engine = ServingEngine(generator, self.serving)
 
     def close(self):
         if self.engine is not None:
@@ -176,18 +227,27 @@ class MegatronServer:
         return (secrets.randbits(31)
                 ^ (next(self._request_counter) & 0x7FFFFFFF))
 
-    def handle(self, payload: dict) -> Tuple[int, dict]:
+    def handle(self, payload: dict,
+               headers: Optional[dict] = None) -> Tuple[int, object]:
         """(ref: text_generation_server.py:31-228 MegatronGenerate.put).
-        Returns (http_status, body)."""
-        err = validate_generate_payload(payload)
-        if err is not None:
-            return 400, {"message": err}
+        Returns (http_status, body) — body is a JSON-able dict, or a
+        GENERATOR of SSE-formatted strings when the payload asked for
+        `stream: true` (both transports detect that and switch to
+        `text/event-stream`). `headers` carries the request headers
+        (Last-Event-ID for stream resume)."""
         from megatron_tpu.serving import (AdmissionError,
                                           DeadlineExceededError,
                                           EngineUnhealthyError,
                                           QueueFullError,
                                           ServiceUnavailableError)
         try:
+            if isinstance(payload, dict) and payload.get("stream"):
+                # streaming validates inside (a RESUME payload carries
+                # only stream_id — no prompts to validate)
+                return self._handle_stream(payload, headers or {})
+            err = validate_generate_payload(payload)
+            if err is not None:
+                return 400, {"message": err}
             if payload.get("beam_width"):
                 return 200, self._handle_beam(payload)
             if self.engine is not None and not payload.get("serial"):
@@ -229,7 +289,7 @@ class MegatronServer:
         depth, so clients can back off proportionally to the backlog
         instead of hammering a saturated replica."""
         if queue_depth is None:
-            queue_depth = (self.engine.scheduler.depth()
+            queue_depth = (self.engine.queue_depth()
                            if self.engine is not None else 0)
         return {"message": message,
                 "retry_after": int(retry_after) if retry_after else 1,
@@ -255,9 +315,15 @@ class MegatronServer:
         Serial mode has no engine loop to probe."""
         if self.engine is None:
             return 200, {"healthy": True, "serving": "serial"}
+        self._gc_streams()  # probes double as the registry's sweeper
         h = self.engine.health()
-        ok = (h["healthy"] and h["state"] == "running"
-              and h["loop_alive"])
+        # `accepting` is the readiness verdict both the engine and the
+        # router compute (a DEGRADED router — some replicas down, at
+        # least one serving — stays ready: pulling the whole front
+        # door would turn a partial failure into a total one)
+        ok = bool(h.get("accepting",
+                        h.get("healthy") and h.get("state") == "running"
+                        and h.get("loop_alive")))
         return (200 if ok else 503), h
 
     def _handle_beam(self, payload: dict) -> dict:
@@ -425,9 +491,195 @@ class MegatronServer:
             out["logprobs"] = logprobs
         return out
 
+    # ------------------------------------------------------------------
+    # SSE streaming (docs/serving.md "Front door": streaming protocol)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _sse(data: dict, event: Optional[str] = None,
+             event_id: Optional[int] = None) -> str:
+        """One SSE frame. Token events carry `id:` = the MONOTONIC
+        token index, which is what makes `Last-Event-ID` resume exact:
+        the client replays nothing and misses nothing."""
+        lines = []
+        if event_id is not None:
+            lines.append(f"id: {event_id}")
+        if event:
+            lines.append(f"event: {event}")
+        lines.append("data: " + json.dumps(data))
+        return "\n".join(lines) + "\n\n"
+
+    def _count_metric(self, name: str):
+        m = getattr(self.engine, "metrics", None)
+        if m is not None:
+            m.count(name)
+
+    def _gc_streams(self):
+        """Sweep the stream registry. Runs on every stream request AND
+        on the /metrics + /healthz scrape paths — a monitored server
+        sweeps periodically even when no new stream ever arrives, so
+        finished/abandoned entries (each pinning a live request and
+        its token lists) cannot outlive their TTL indefinitely."""
+        import time as _time
+        with self._streams_lock:
+            self._gc_streams_locked(_time.monotonic())
+
+    def _gc_streams_locked(self, now: float):
+        ttl = float(self.serving.stream_ttl_s)
+        for sid in list(self._streams):
+            e = self._streams[sid]
+            if e.done_t is None and e.req.done():
+                e.done_t = now
+            if e.done_t is not None and now - e.done_t > ttl:
+                del self._streams[sid]
+            elif e.done_t is None and now - e.created > ttl + self._timeout:
+                # a router-backed request's done() only settles when a
+                # caller pumps it — an abandoned stream (client gone,
+                # nobody waiting) would otherwise sit here forever.
+                # Past the request timeout + resume TTL nobody can
+                # legitimately resume it: cancel and drop.
+                try:
+                    self.engine.cancel(e.req)
+                except Exception:  # noqa: BLE001 — GC is best-effort
+                    pass
+                del self._streams[sid]
+
+    def _handle_stream(self, payload: dict, headers) -> Tuple[int, object]:
+        """`stream: true` payloads: fresh streams submit one request
+        and return an SSE generator; resume payloads (`stream_id` set)
+        re-attach to the live request and replay its committed tail
+        from `Last-Event-ID` + 1 — the engine holds every committed
+        token on the request, so resume is a replay, not a recompute."""
+        import time as _time
+        if self.engine is None:
+            return 400, {"message": "streaming requires the continuous-"
+                                    "batching engine (serial_fallback "
+                                    "serves whole completions only)"}
+        last = headers.get("Last-Event-ID") if headers else None
+        if last is None:
+            last = payload.get("last_event_id")
+        try:
+            last = int(last) if last is not None else -1
+        except (TypeError, ValueError):
+            return 400, {"message": "Last-Event-ID must be an integer "
+                                    "token index"}
+        sid = payload.get("stream_id")
+        if sid is not None:
+            with self._streams_lock:
+                self._gc_streams_locked(_time.monotonic())
+                entry = self._streams.get(sid)
+            if entry is None:
+                return 404, {"message": f"unknown or expired stream_id "
+                                        f"{sid!r}; start a new stream"}
+            self._count_metric("stream_reconnects")
+            return 200, self._stream_events(entry, start=last + 1,
+                                            resumed=True)
+        err = validate_generate_payload(payload)
+        if err is not None:
+            return 400, {"message": err}
+        if payload.get("beam_width"):
+            return 400, {"message": "beam search is whole-batch; it "
+                                    "does not stream"}
+        if len(payload["prompts"]) != 1:
+            return 400, {"message": "streaming supports exactly one "
+                                    "prompt per request"}
+        from megatron_tpu.serving import SamplingOptions
+        prompt_ids = self._preflight_lengths(payload, self.engine.max_len,
+                                             "max_len")
+        sampling = SamplingOptions(
+            temperature=float(payload.get("temperature", 1.0)),
+            top_k=int(payload.get("top_k", 0)),
+            top_p=float(payload.get("top_p", 0.0)))
+        deadline_s = payload.get("deadline_s")
+        req = self.engine.submit(
+            prompt_ids[0], int(payload.get("tokens_to_generate", 64)),
+            sampling, seed=self._seed_for(payload),
+            priority=int(payload.get("priority", 0) or 0),
+            deadline_s=None if deadline_s is None else float(deadline_s))
+        sid = secrets.token_hex(8)
+        entry = _StreamEntry(sid, req)
+        with self._streams_lock:
+            self._gc_streams_locked(_time.monotonic())
+            self._streams[sid] = entry
+        return 200, self._stream_events(entry, start=0, resumed=False)
+
+    def _stream_events(self, entry: "_StreamEntry", start: int,
+                       resumed: bool):
+        """The SSE event generator: `start` frame (stream_id for later
+        resumes), one `token` frame per committed token with `id:` =
+        its monotonic index, then exactly one terminal frame — `done`
+        with the full text, or `error` with the typed HTTP status a
+        non-streaming caller would have seen (a mid-stream replica
+        crash lands here as a clean terminal event, never a silent
+        hang; a retryable one invites reconnect-or-resubmit)."""
+        from megatron_tpu.serving import (DeadlineExceededError,
+                                          EngineUnhealthyError,
+                                          QueueFullError,
+                                          ServiceUnavailableError)
+        import time as _time
+        req = entry.req
+        yield self._sse({"stream_id": entry.sid, "resumed": resumed,
+                         "next_index": max(start, 0)}, event="start")
+        i = max(start, 0)
+        # same overall budget the non-streaming path enforces via
+        # result(timeout): a stuck request must end in a terminal
+        # frame, not an open connection that never emits again
+        stream_deadline = _time.monotonic() + self._timeout
+        while True:
+            gen = req.generated
+            if i < len(gen):
+                lps = req.gen_logprobs
+                data = {"index": i, "token": int(gen[i]),
+                        "text": self.tokenizer.detokenize([int(gen[i])])}
+                if i < len(lps):
+                    data["logprob"] = float(lps[i])
+                yield self._sse(data, event="token", event_id=i)
+                i += 1
+                continue
+            if req.done():
+                break
+            if _time.monotonic() > stream_deadline:
+                # buffered tokens above were all delivered; the
+                # request itself is stuck — terminal frame, not an
+                # open connection that never emits again
+                yield self._sse(
+                    {"message": f"stream timed out after "
+                                f"{self._timeout:.0f}s waiting for "
+                                "tokens", "status": 500,
+                     "retryable": True,
+                     "committed": len(req.generated)}, event="error")
+                return
+            # wait_token drives the router's retry pump too, so a
+            # failed-over request keeps streaming from a survivor
+            req.wait_token(i, timeout=0.25)
+        try:
+            toks, _ = req.result(timeout=self._timeout)
+        except Exception as e:  # noqa: BLE001 — typed terminal frame
+            if isinstance(e, DeadlineExceededError):
+                status = 504
+            elif isinstance(e, (ServiceUnavailableError,
+                                EngineUnhealthyError)):
+                status = 503
+            elif isinstance(e, QueueFullError):
+                status = 429
+            else:
+                status = 500
+            yield self._sse({"message": str(e), "status": status,
+                             "retryable": status in (429, 503),
+                             "committed": len(req.generated)},
+                            event="error")
+            return
+        yield self._sse({"text": self.tokenizer.detokenize(toks),
+                         "segments": toks,
+                         "generated": len(req.generated)}, event="done")
+
     def metrics_snapshot(self) -> dict:
         if self.engine is None:
             return {"serving": "serial"}
+        self._gc_streams()  # scrapes double as the registry's sweeper
+        if hasattr(self.engine, "aggregate_snapshot"):
+            # router: base counters summed across replicas + the
+            # router-level failover/retry/stream counters overlaid
+            return self.engine.aggregate_snapshot()
         return self.engine.metrics.snapshot()
 
     def run(self, host: str = "0.0.0.0", port: int = 5000):
@@ -443,7 +695,14 @@ class MegatronServer:
 
         @app.route("/api", methods=["PUT"])
         def api():
-            status, body = server.handle(request.get_json(silent=True))
+            status, body = server.handle(request.get_json(silent=True),
+                                         headers=request.headers)
+            if _is_stream_body(body):
+                from flask import Response
+                return Response(body, status=status,
+                                mimetype="text/event-stream",
+                                headers={"Cache-Control": "no-cache",
+                                         "X-Accel-Buffering": "no"})
             return (jsonify(body), status,
                     server.response_headers(body))
 
@@ -480,6 +739,25 @@ class MegatronServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _send_stream(self, status: int, gen):
+                """SSE response: no Content-Length, one flushed write
+                per event. A dropped client (BrokenPipe) stops the
+                WRITER only — the request keeps decoding server-side,
+                and a reconnect with Last-Event-ID resumes the tail."""
+                self.send_response(status)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    for chunk in gen:
+                        self.wfile.write(chunk.encode())
+                        self.wfile.flush()
+                except (ConnectionError, OSError):
+                    pass  # client gone; stream resumable via registry
+                finally:
+                    gen.close()
+
             def do_PUT(self):
                 if self.path.rstrip("/") != "/api":
                     self.send_error(404)
@@ -491,10 +769,14 @@ class MegatronServer:
                     self._send(400, {"message": f"invalid JSON: {e}"})
                     return
                 try:
-                    status, body = server.handle(payload)
+                    status, body = server.handle(payload,
+                                                 headers=self.headers)
                 except Exception as e:  # pragma: no cover — handle()
                     status, body = 500, {"message": str(e)}
-                self._send(status, body)
+                if _is_stream_body(body):
+                    self._send_stream(status, body)
+                else:
+                    self._send(status, body)
 
             def do_GET(self):
                 path = self.path.rstrip("/")

@@ -179,6 +179,18 @@ class _PendingPrefill:
         self.installed = False
 
 
+class _HostSrc:
+    """Prefix-lookup source living in the host-RAM KV tier (not in a
+    slot or retained entry): carries the tier key. `_start_pending`
+    restores it into a fresh batch-1 sub via device_put — no block
+    aliasing, no pool surgery."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key):
+        self.key = key
+
+
 class ServingEngine:
     """Drives generation for many concurrent requests through one
     compiled decode step. Construct from a `Generator` (whose params /
@@ -272,6 +284,24 @@ class ServingEngine:
         # reclaimed lazily (alloc pressure / retain overflow) — forget
         # its prefixes the moment that happens
         self.pool.on_reclaim = self._index.remove
+        # host-RAM KV tier (docs/serving.md "Front door"): when block
+        # pressure evicts a RetainedPrefix, demote its block list to
+        # host memory instead of dropping it; a later prefix hit
+        # restores via device_put. 0 bytes = off, bit-identical to the
+        # tier-less engine (test-pinned). Rolling rings never demote
+        # (a ring restore is only sound at the exact length — not
+        # worth a host copy that usually misses).
+        self._host_tier = None
+        host_bytes = int(getattr(self.serving, "host_kv_bytes", 0) or 0)
+        if host_bytes > 0:
+            assert self._blocks_on and self._prefix_on, (
+                "host_kv_bytes requires enable_prefix_cache and "
+                "kv_block_size — the tier demotes retained BLOCK "
+                "lists; see ServingConfig.validate")
+            from megatron_tpu.serving.host_tier import HostKVTier
+            self._host_tier = HostKVTier(host_bytes,
+                                         self._index.granularity)
+            self.pool.on_evict_entry = self._demote_entry
         self._prefilling: List[_PendingPrefill] = []
         self._admitting: List[GenRequest] = []  # mid-_admit pops
         self._sub0 = None  # lazily-built zero template for miss starts
@@ -410,7 +440,8 @@ class ServingEngine:
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 64,
                sampling: SamplingOptions = SamplingOptions(),
                seed: int = 0, priority: int = 0,
-               deadline_s: Optional[float] = None) -> GenRequest:
+               deadline_s: Optional[float] = None,
+               arrival_id: Optional[int] = None) -> GenRequest:
         """Non-blocking: enqueue and return the request handle. Raises
         QueueFullError (→ 429) when the bounded queue is full,
         OverloadShedError (→ 429 + Retry-After) when early shedding
@@ -418,7 +449,9 @@ class ServingEngine:
         circuit breaker is open, and AdmissionError (→ 400) when the
         request can never fit. `priority` clamps into
         [0, priority_levels); `deadline_s` overrides the engine-wide
-        request_deadline_s for this request."""
+        request_deadline_s for this request. `arrival_id` (router
+        failover retries only) preserves a resubmitted request's
+        original queue position."""
         if self._broken:
             raise EngineUnhealthyError(
                 f"engine unhealthy (circuit breaker open): "
@@ -432,7 +465,8 @@ class ServingEngine:
         priority = max(0, min(int(priority),
                               self.serving.priority_levels - 1))
         req = GenRequest(list(prompt), max_new_tokens, sampling, seed,
-                         priority=priority, deadline_s=deadline_s)
+                         priority=priority, deadline_s=deadline_s,
+                         arrival_id=arrival_id)
         self.metrics.count("requests_received")
         try:
             if max_new_tokens == 0:
@@ -497,17 +531,29 @@ class ServingEngine:
     def health(self) -> dict:
         """Liveness/readiness snapshot for `/healthz` (separate from
         the `/metrics` counters): supervisor state, circuit breaker,
-        slot occupancy, queue depth. Host-state reads only — never
-        touches the device, so a wedged decode cannot wedge the health
-        endpoint too."""
+        slot occupancy, queue depth — plus the ROUTING SIGNALS the
+        front-door router consumes (`free_slots`, `kv_blocks_retained`,
+        `service_time_ewma_ms`; schema pinned by a test so the router
+        contract can't drift). Host-state reads only — never touches
+        the device, so a wedged decode cannot wedge the health endpoint
+        too; the pool-accounting reads race the engine thread
+        harmlessly (a stale count only skews a routing hint)."""
         broken = self._broken
         state = ("unhealthy" if broken else
                  "draining" if self._draining else
                  "wedged" if self._wedged else "running")
+        # free_rows, NOT free_count: the latter's memoized
+        # reclaimable-block walk is engine-thread-only; these reads
+        # come from HTTP probe threads
+        free_slots = int(self.pool.free_rows())
+        kv_retained = int(self.pool.retained_count())
+        healthy = broken is None and not self._wedged
+        loop_alive = self._thread.is_alive()
         return {
-            "healthy": broken is None and not self._wedged,
+            "healthy": healthy,
             "state": state,
-            "loop_alive": self._thread.is_alive(),
+            "accepting": healthy and state == "running" and loop_alive,
+            "loop_alive": loop_alive,
             "circuit_breaker_open": broken is not None,
             "engine_restarts": self._restarts,
             "max_engine_restarts": self._max_restarts,
@@ -515,8 +561,35 @@ class ServingEngine:
             "prefilling": len(self._prefilling),
             "num_slots": self.num_slots,
             "queue_depth": self.scheduler.depth(),
+            "free_slots": free_slots,
+            "kv_blocks_retained": kv_retained,
+            "service_time_ewma_ms":
+                self.scheduler.service_time_ewma() * 1e3,
             "detail": broken or "",
         }
+
+    def prefix_peek(self, tokens: Sequence[int]) -> int:
+        """Longest cached prefix (device index OR host tier) this
+        replica could serve `tokens` with — the router's cache-affinity
+        signal. Called from HTTP threads while the engine thread
+        mutates the index: reads only, and any racy-iteration error
+        degrades to 0 (affinity is a hint, admission re-resolves the
+        real hit on the engine thread)."""
+        if not self._prefix_on or not tokens:
+            return 0
+        toks = list(tokens)
+        try:
+            src, hit = self._index.lookup(toks, len(toks) - 1)
+            best = hit if src is not None else 0
+            if self._host_tier is not None:
+                _, hhit = self._host_tier.lookup(toks, len(toks) - 1)
+                best = max(best, hhit)
+            return int(best)
+        except Exception:  # noqa: BLE001 — cross-thread peek
+            return 0
+
+    def queue_depth(self) -> int:
+        return self.scheduler.depth()
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful shutdown: stop admitting (queued-but-unstarted
@@ -1050,6 +1123,11 @@ class ServingEngine:
                                retained_limit=self.serving.retained_slots,
                                block_size=self.serving.kv_block_size)
         self.pool.on_reclaim = self._index.remove
+        if self._host_tier is not None:
+            # the tier itself survives a restart (host RAM is not
+            # device state) — only the demotion hook needs rewiring
+            # onto the rebuilt pool
+            self.pool.on_evict_entry = self._demote_entry
         S, Vp = self.num_slots, self.cfg.padded_vocab_size
         self._last_logits = jnp.zeros((S, Vp), jnp.float32)
         self._rngs = jnp.zeros((S, 2), jnp.uint32)
@@ -1220,19 +1298,29 @@ class ServingEngine:
         toks = list(toks)
         src, hit = self._index.lookup(toks, len(toks) - 1)
         if src is None or not hit:
-            return None, 0
-        if not self.pool.rolling:
-            return src, hit
-        ent = (None if isinstance(src, (int, np.integer))
-               else self.pool.entry(src))
-        if ent is None:
-            return None, 0
-        f = ent.length
-        if f <= len(toks) - 1 and toks[:f] == ent.tokens:
-            return src, f  # full continuation at the EXACT ring length
-        if f <= self.pool.cap:
-            return src, hit  # ring never wrapped: any prefix resident
-        return None, 0
+            src, hit = None, 0
+        elif self.pool.rolling:
+            ent = (None if isinstance(src, (int, np.integer))
+                   else self.pool.entry(src))
+            if ent is None:
+                src, hit = None, 0
+            else:
+                f = ent.length
+                if f <= len(toks) - 1 and toks[:f] == ent.tokens:
+                    # full continuation at the EXACT ring length
+                    src, hit = src, f
+                elif f <= self.pool.cap:
+                    pass  # ring never wrapped: any prefix resident
+                else:
+                    src, hit = None, 0
+        # host-RAM tier: a STRICTLY longer demoted match beats the
+        # device hit (restoring costs one device_put; at equal length
+        # the on-device copy wins)
+        if self._host_tier is not None:
+            hkey, hhit = self._host_tier.lookup(toks, len(toks) - 1)
+            if hkey is not None and hhit > hit:
+                return _HostSrc(hkey), hhit
+        return src, hit
 
     def _resume_parked(self, req: GenRequest):
         """Resume a preemption victim whose KV survived in its parked
@@ -1287,6 +1375,14 @@ class ServingEngine:
         token-exact either way."""
         tokens = req.effective_prompt()
         plen = len(tokens)
+        host_sub = None
+        if prefix_len and isinstance(src, _HostSrc):
+            # host-tier restore FIRST (checksum-verified): a corrupt
+            # demotion degrades to a plain miss here — the request
+            # recomputes its whole prefill, never reads wrong KV
+            host_sub = self._restore_host(src.key, prefix_len)
+            if host_sub is None:
+                src, prefix_len = None, 0
         if prefix_len:
             # matched at lookup — counted even when the allocation
             # below forfeits the hit, so hit_tokens - tokens_saved
@@ -1294,37 +1390,51 @@ class ServingEngine:
             self.metrics.count("prefix_hit_tokens", prefix_len)
         blocks = None
         pfx_blocks = 0
+        device_hit = prefix_len and host_sub is None
         if self._blocks_on:
             alias = []
             roll_src_blocks = None
-            if prefix_len and self.pool.rolling:
+            if device_hit and self.pool.rolling:
                 # capture BEFORE alloc_row: block pressure may evict
                 # the source entry below. Its blocks' content stays
                 # valid for this iteration's slice regardless — the
                 # arena is functional, the gather reads this dispatch
                 # point's version.
                 roll_src_blocks = list(self.pool.entry(src).blocks)
-            if prefix_len and not self.pool.rolling:
+            if device_hit and not self.pool.rolling:
                 pfx_blocks = prefix_len // self.pool.block_size
                 alias = self._src_blocks(src)[:pfx_blocks]
             got = self.pool.alloc_row(alias=alias, install=False)
             if got is None and prefix_len:
                 # block pressure: forfeit the hit, admit plain
                 src, prefix_len, pfx_blocks = None, 0, 0
+                host_sub = None
                 got = self.pool.alloc_row(install=False)
             assert got is not None, "popped more requests than free slots"
             slot, blocks = got
         else:
             slot = self.pool.alloc(
-                exclude=(src,) if prefix_len else ())
+                exclude=(src,) if device_hit else ())
             if slot is None:
                 # the ONLY allocatable slot is the clone source itself:
                 # forfeit the hit and reclaim it as a plain slot
                 src, prefix_len = None, 0
+                host_sub = None
                 slot = self.pool.alloc()
             assert slot is not None, "popped more requests than free slots"
         try:
-            if prefix_len:
+            if prefix_len and host_sub is not None:
+                # restored from the host tier: the sub ALREADY holds the
+                # prefix KV at offset prefix_len (device_put), so the
+                # suffix chunks append to it exactly like a sliced
+                # device hit — fresh blocks, no aliasing (pfx_blocks=0:
+                # the insert writes the restored prefix into this row's
+                # own blocks)
+                req.prefix_len = prefix_len
+                self.metrics.count("host_tier_hits")
+                self.metrics.count("prefill_tokens_saved", prefix_len)
+                sub = host_sub
+            elif prefix_len:
                 if isinstance(src, (int, np.integer)):
                     self.pool.touch(int(src))  # refresh the retained LRU
                 else:
@@ -1383,6 +1493,43 @@ class ServingEngine:
                 self.pool.drop_blocks(blocks)  # map never installed
             self.pool.release(slot)
             raise
+
+    def _demote_entry(self, ent):
+        """SlotKVPool.on_evict_entry: a retained prefix is dying under
+        block pressure (or the retained_limit) — gather its block list
+        to host memory so a later hit restores it instead of
+        recomputing. Rolling rings never demote (a ring restore is
+        only sound as an exact-length continuation). Best-effort: a
+        failed demotion loses only the host copy."""
+        if self._host_tier is None or self.pool.rolling:
+            return
+        # size gate BEFORE the device gather: an entry the budget can
+        # never hold must not pay a multi-MB device_get on the
+        # admission hot path just to be refused
+        est = (len(ent.blocks) * self.pool.block_size
+               * self.pool.bytes_per_token())
+        if est > self._host_tier.budget_bytes:
+            return
+        arrays = self.pool.gather_blocks_host(ent.blocks)
+        if self._host_tier.demote(ent.key, ent.tokens, ent.length,
+                                  arrays):
+            self.metrics.count("host_tier_demotions")
+
+    def _restore_host(self, key, plen: int):
+        """Checksum-verified host-tier restore: returns the batch-1
+        sub-cache holding the demoted prefix at offset `plen`
+        (device_put), or None on a checksum miss — the entry is
+        dropped and the caller degrades to a plain prefill (a corrupt
+        demotion is a MISS, never wrong tokens)."""
+        if not self._host_tier.has(key):
+            return None  # LRU-evicted since lookup: a plain miss
+        ent = self._host_tier.restore(key)
+        if ent is None:
+            self.metrics.count("host_tier_checksum_misses")
+            return None
+        nb = -(-plen // self.pool.block_size)
+        arrays = {k: v[:, :nb] for k, v in ent.arrays.items()}
+        return self.pool.host_blocks_to_sub(arrays, plen)
 
     def _src_blocks(self, src) -> List[int]:
         """Physical blocks backing a prefix source: a running slot's

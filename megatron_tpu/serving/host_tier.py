@@ -1,0 +1,154 @@
+"""Host-RAM tier for retained prefix KV (the capacity half of the
+ROADMAP's front-door item).
+
+The block-granular pool (serving/kv_pool.py) already bounds on-chip
+prefix retention by BLOCKS, not slots — but the arena is still HBM, so
+under block pressure the LRU retained entry is simply reclaimed and its
+prefix is recomputed on the next hit. This tier catches that eviction:
+`SlotKVPool.on_evict_entry` fires with the dying `RetainedPrefix`
+BEFORE its blocks are unreffed, the engine gathers the entry's block
+list to host memory (`gather_blocks_host`) and `demote()` stores it
+here with a checksum; a later prompt whose longest cached prefix lives
+only in this tier restores it with one `device_put` (the engine builds
+a batch-1 sub-cache from the host arrays and lands it through the
+normal `insert_blocks` path — no pool-accounting surgery). Effective
+prefix-cache capacity becomes host-RAM-bound, ~10x the grid.
+
+Safety model: host RAM is outside the device's functional-update
+discipline, so every entry carries a CRC over its arrays, verified at
+restore time — a corrupt demotion is a MISS (the entry is dropped and
+`host_tier_checksum_misses` counts it), never wrong tokens. The tier
+has its own byte budget with LRU eviction (`host_kv_bytes`); 0 keeps
+the tier off and the engine bit-identical to the tier-less build
+(test-pinned).
+
+Thread contract: all methods run on the engine thread, EXCEPT
+`lookup`, which the router's `prefix_peek` may call from HTTP threads —
+it only reads and swallows racy-iteration errors (affinity is a hint).
+"""
+from __future__ import annotations
+
+import collections
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from megatron_tpu.serving.prefix_index import PrefixIndex
+
+
+def _checksum(arrays: Dict[str, np.ndarray]) -> int:
+    """CRC32 chained over every array's raw bytes, keyed in sorted
+    order so the digest is layout-stable."""
+    crc = 0
+    for name in sorted(arrays):
+        a = arrays[name]
+        crc = zlib.crc32(np.ascontiguousarray(a).view(np.uint8), crc)
+    return crc
+
+
+class _HostEntry:
+    __slots__ = ("key", "tokens", "length", "arrays", "crc", "nbytes")
+
+    def __init__(self, key, tokens: List[int], length: int,
+                 arrays: Dict[str, np.ndarray]):
+        self.key = key
+        self.tokens = list(tokens)
+        self.length = int(length)
+        self.arrays = arrays
+        self.crc = _checksum(arrays)
+        self.nbytes = int(sum(a.nbytes for a in arrays.values()))
+
+
+class HostKVTier:
+    """LRU of demoted `RetainedPrefix` block lists in host memory,
+    bounded by `budget_bytes`, indexed by the same block-granular
+    `PrefixIndex` the engine routes hits through."""
+
+    def __init__(self, budget_bytes: int, granularity: int):
+        assert budget_bytes >= 0, budget_bytes
+        self.budget_bytes = int(budget_bytes)
+        self._entries: "collections.OrderedDict" = \
+            collections.OrderedDict()  # key -> _HostEntry (LRU order)
+        self._index = PrefixIndex(granularity)
+        # sequence dedup: retain keys are always fresh, so a hot
+        # prompt cycling demote->restore->retain->demote would
+        # otherwise fill the budget with near-identical copies of one
+        # sequence, LRU-evicting DISTINCT prefixes
+        self._by_seq: Dict[tuple, object] = {}  # tokens -> entry key
+        self.bytes_used = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # ---- demote ------------------------------------------------------
+    def demote(self, key, tokens: Sequence[int], length: int,
+               arrays: Dict[str, np.ndarray]) -> bool:
+        """Store a dying retained entry's host-gathered block arrays.
+        Returns False (and stores nothing) when the entry alone exceeds
+        the whole budget; otherwise evicts LRU entries until it fits.
+        An entry already holding the SAME sequence is replaced, not
+        duplicated (demote/restore/retain cycles of a hot prompt must
+        not fill the budget with copies of one prefix)."""
+        ent = _HostEntry(key, list(tokens), length, arrays)
+        if ent.nbytes > self.budget_bytes:
+            return False
+        seq = tuple(ent.tokens[:ent.length])
+        self.drop(self._by_seq.get(seq))
+        self.drop(key)
+        while self.bytes_used + ent.nbytes > self.budget_bytes \
+                and self._entries:
+            self._evict_lru()
+        self._entries[key] = ent
+        self.bytes_used += ent.nbytes
+        self._by_seq[seq] = key
+        self._index.insert(key, ent.tokens[:ent.length])
+        return True
+
+    def _evict_lru(self):
+        old_key, _ = next(iter(self._entries.items()))
+        self.drop(old_key)
+
+    def drop(self, key):
+        if key is None:
+            return
+        ent = self._entries.pop(key, None)
+        if ent is not None:
+            self.bytes_used -= ent.nbytes
+            self._index.remove(key)
+            seq = tuple(ent.tokens[:ent.length])
+            if self._by_seq.get(seq) == key:
+                del self._by_seq[seq]
+
+    # ---- lookup / restore --------------------------------------------
+    def lookup(self, tokens: Sequence[int],
+               max_tokens: Optional[int] = None) -> Tuple[object, int]:
+        """Longest demoted block-aligned prefix of `tokens` — the host
+        half of the engine's `_lookup_prefix` (and of the router's
+        `prefix_peek`, which may call from another thread: failures
+        here are a missed hint, never an error)."""
+        try:
+            key, hit = self._index.lookup(tokens, max_tokens)
+        except Exception:  # racy cross-thread peek — affinity is a hint
+            return None, 0
+        if key is None or key not in self._entries:
+            return None, 0
+        ent = self._entries[key]
+        return key, min(hit, ent.length)
+
+    def has(self, key) -> bool:
+        return key in self._entries
+
+    def restore(self, key) -> Optional[_HostEntry]:
+        """Checksum-verified fetch for a restore. A mismatch (the
+        corrupt-demotion case) DROPS the entry and returns None — the
+        caller treats it as a miss and recomputes; wrong tokens are
+        structurally impossible. A hit refreshes the LRU position."""
+        ent = self._entries.get(key)
+        if ent is None:
+            return None
+        if _checksum(ent.arrays) != ent.crc:
+            self.drop(key)
+            return None
+        self._entries.move_to_end(key)
+        return ent

@@ -55,6 +55,7 @@ from megatron_tpu.config import ModelConfig
 from megatron_tpu.inference.generation import (KV_CACHE_AXES, init_kv_caches,
                                                kv_region_cap)
 from megatron_tpu.models.attention import KVCache
+from megatron_tpu.utils.logging import print_rank_0
 
 
 def insert_prefill(pool: KVCache, prefill: KVCache, slot, plen) -> KVCache:
@@ -323,6 +324,11 @@ class SlotKVPool:
             collections.OrderedDict()
         self.retained_limit = retained_limit
         self.on_reclaim: Optional[Callable] = None
+        # block mode only: fires with the dying RetainedPrefix BEFORE
+        # its blocks are unreffed — the host-RAM tier's demotion hook
+        # (serving/host_tier.py); the entry's device content is still
+        # intact at call time (retained blocks receive no idle writes)
+        self.on_evict_entry: Optional[Callable] = None
         if block_size is None:
             self.caches = init_kv_caches(cfg, num_slots, max_len,
                                          dtype=dtype,
@@ -463,6 +469,15 @@ class SlotKVPool:
 
     def _evict_retained(self):
         key, ent = self._retained.popitem(last=False)
+        if self.on_evict_entry is not None:
+            # demotion BEFORE unref: the tier must gather the blocks'
+            # device content while the entry still pins them. A failed
+            # demotion only loses the host copy — eviction proceeds.
+            try:
+                self.on_evict_entry(ent)
+            except Exception as e:  # noqa: BLE001 — tier is best-effort
+                print_rank_0(
+                    f"kv_pool: on_evict_entry failed for {key}: {e!r}")
         for b in ent.blocks:
             self._unref(b)
         self._reclaim(key)
@@ -576,6 +591,58 @@ class SlotKVPool:
             self._evict_retained()
         return key
 
+    def gather_blocks_host(self, blocks: Sequence[int]):
+        """Fetch an explicit physical-block list's arena content to
+        HOST numpy arrays — the host-RAM tier's demotion read (engine
+        thread, during retained-entry eviction: the blocks are still
+        pinned, so the gather reads stable content). Returns
+        {"k", "v"[, "k_scale", "v_scale"]} shaped [L, nb, B, nkv, *]."""
+        assert self.blocks_enabled
+        a = self.caches.arena
+        idx = jnp.asarray(list(blocks), jnp.int32)
+        # np.array (copy): device_get may hand back a read-only view
+        # of the transfer buffer — the tier owns mutable host memory
+        out = {"k": np.array(jax.device_get(jnp.take(a.k, idx, axis=1))),
+               "v": np.array(jax.device_get(jnp.take(a.v, idx, axis=1)))}
+        if a.k_scale is not None:
+            out["k_scale"] = np.array(
+                jax.device_get(jnp.take(a.k_scale, idx, axis=1)))
+            out["v_scale"] = np.array(
+                jax.device_get(jnp.take(a.v_scale, idx, axis=1)))
+        return out
+
+    def host_blocks_to_sub(self, arrays, plen: int) -> KVCache:
+        """Assemble host-gathered block arrays into a batch-1 cache in
+        the pool's layout, positioned at `plen` — the host-RAM tier's
+        restore write (`device_put` half): the engine hands this sub to
+        the normal suffix-prefill + insert path, so a restore needs no
+        pool-accounting surgery and lands through already-compiled
+        programs. Positions past the restored blocks are zeros — they
+        sit at/after the sub's offset, where appends overwrite them
+        write-before-read (the bucketed-prefill invariant)."""
+        assert self.blocks_enabled
+        L, nb, B = arrays["k"].shape[:3]
+        cap = self.cap
+
+        def fill(name, tail_shape, fill_value, dtype):
+            full = np.full((L, 1, cap) + tail_shape, fill_value,
+                           dtype=dtype)
+            a = arrays[name]
+            full[:, 0, :nb * B] = a.reshape((L, nb * B) + a.shape[3:])
+            return jnp.asarray(full)
+
+        quant = "k_scale" in arrays
+        nkv, hd = arrays["k"].shape[3], arrays["k"].shape[4]
+        return KVCache(
+            k=fill("k", (nkv, hd), 0, arrays["k"].dtype),
+            v=fill("v", (nkv, hd), 0, arrays["v"].dtype),
+            offset=jnp.full((L,), plen, jnp.int32),
+            k_scale=(fill("k_scale", (nkv, 1), 1.0, np.float32)
+                     if quant else None),
+            v_scale=(fill("v_scale", (nkv, 1), 1.0, np.float32)
+                     if quant else None),
+        )
+
     def entry(self, key) -> Optional[RetainedPrefix]:
         return self._retained.get(key)
 
@@ -611,6 +678,14 @@ class SlotKVPool:
                                      avail // self.blocks_per_slot)
         self._acct_dirty = False
         return self._free_count_cache
+
+    def free_rows(self) -> int:
+        """Race-free free grid-row count. `health()` snapshots read
+        this from HTTP threads; `free_count()`'s memoized
+        reclaimable-block walk is ENGINE-THREAD-ONLY (a cross-thread
+        call could mark a dirty memo clean mid-mutation and feed
+        admission a stale gate)."""
+        return len(self._free)
 
     def retained_count(self) -> int:
         return len(self._retained)

@@ -60,6 +60,18 @@ _BASE_COUNTERS = (
     # to the plain decode step because no running slot proposed a draft
     "spec_rounds", "draft_tokens", "accepted_tokens",
     "spec_fallback_steps",
+    # front door (docs/serving.md "Front door"): router_failovers =
+    # replicas ejected from rotation (health-driven), router_retries =
+    # attempts resubmitted to a survivor after a replica failure,
+    # host_tier_hits = prefix restores served from the host-RAM KV
+    # tier, host_tier_demotions = retained block lists demoted to host
+    # memory on eviction, host_tier_checksum_misses = demoted entries
+    # dropped because their checksum no longer verified (a corrupt
+    # demotion is a MISS, never wrong tokens), stream_reconnects =
+    # SSE streams resumed via Last-Event-ID
+    "router_failovers", "router_retries", "host_tier_hits",
+    "host_tier_demotions", "host_tier_checksum_misses",
+    "stream_reconnects",
 )
 
 

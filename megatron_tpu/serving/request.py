@@ -58,10 +58,15 @@ class GenRequest:
     def __init__(self, prompt: List[int], max_new_tokens: int,
                  sampling: SamplingOptions = SamplingOptions(),
                  seed: int = 0, priority: int = 0,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 arrival_id: Optional[int] = None):
         assert prompt, "empty prompt"
         assert max_new_tokens >= 0, max_new_tokens
-        self.id = next(_req_ids)
+        # `arrival_id` lets the router's failover retries preserve the
+        # ORIGINAL arrival position: the scheduler's EDF key ties break
+        # on this id, so a resubmitted victim re-enters a survivor's
+        # queue where its first attempt stood, not at the back
+        self.id = next(_req_ids) if arrival_id is None else int(arrival_id)
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
         self.sampling = sampling
@@ -92,6 +97,10 @@ class GenRequest:
         self.first_token_time: Optional[float] = None
         self.finish_time: Optional[float] = None
         self._done = threading.Event()
+        # token-progress wakeups for SSE streaming consumers: notified
+        # on every append_token and on the terminal transition, so a
+        # streaming thread can sleep between tokens instead of polling
+        self._progress = threading.Condition()
         self.cancelled = False
         # prefix-cache bookkeeping (engine thread): tokens whose KV was
         # reused through a region clone instead of a forward pass, and
@@ -156,6 +165,30 @@ class GenRequest:
             self.first_token_time = time.monotonic()
         self.generated.append(int(token))
         self.gen_logprobs.append(float(logprob))
+        self._notify_progress()
+
+    def _notify_progress(self):
+        with self._progress:
+            self._progress.notify_all()
+
+    def wait_token(self, i: int, timeout: Optional[float] = None) -> bool:
+        """Block until token index `i` exists in `generated` or the
+        request is terminal (the SSE streaming cursor's wait). Returns
+        True in either of those cases, False on timeout — the caller
+        distinguishes "token ready" from "stream over" by re-checking
+        `len(generated)` and `done()`."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._progress:
+            while len(self.generated) <= i and not self._done.is_set():
+                if deadline is None:
+                    self._progress.wait()
+                    continue
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return False
+                self._progress.wait(rem)
+        return True
 
     def finish(self) -> bool:
         """First terminal transition wins: a request the engine
@@ -167,6 +200,7 @@ class GenRequest:
         self.state = RequestState.FINISHED
         self.finish_time = time.monotonic()
         self._done.set()
+        self._notify_progress()
         return True
 
     def fail(self, msg: str, kind: str = "error") -> bool:
@@ -184,6 +218,7 @@ class GenRequest:
         self.finish_time = time.monotonic()
         self.parked = None  # drop parked KV device refs promptly
         self._done.set()
+        self._notify_progress()
         return True
 
     # ---- caller side -------------------------------------------------

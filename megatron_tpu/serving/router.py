@@ -1,0 +1,604 @@
+"""Prefix-affinity router with health-driven failover: the in-process
+front door over N `ServingEngine` replicas.
+
+One engine replica dies with its process (or its crash-loop breaker);
+the ROADMAP's "millions of users" means N replicas behind a router
+that survives any one of them crashing, wedging, or draining. The
+signals were all built by earlier PRs — `health()` liveness + breaker
+state (PR 6), queue/shed accounting, service-time EWMA — this module
+consumes them:
+
+- **Cache-aware routing** (SGLang-style, PAPERS.md): each request goes
+  to the replica whose prefix index holds the LONGEST match for its
+  prompt (`ServingEngine.prefix_peek` — a cheap, racy-by-design
+  host-side read of the PrefixIndex + host KV tier), ties broken by
+  least-loaded: (queue_depth + busy slots) x the replica's
+  service-time EWMA, both straight from the `health()` snapshot.
+- **Health-driven failover**: a replica whose snapshot reports
+  draining, breaker-tripped, a dead loop — or which has not produced a
+  healthy snapshot within `heartbeat_timeout_s` (wedged counts after
+  the grace) — is EJECTED from rotation (`router_failovers`). Work
+  it already failed (or work stuck on it past the heartbeat grace) is
+  resubmitted to a survivor with bounded retries + backoff
+  (`router_retries`), the ORIGINAL arrival id preserved so the retry
+  re-enters the survivor's EDF queue at its original position. Every
+  request is submitted with a concrete seed, so a full resubmission
+  regenerates the identical token stream — retried completions are
+  token-exact (chaos-pinned). Only when EVERY replica is down does
+  submit fail with `NoReplicaAvailableError` (HTTP 503).
+- **Half-open recovery**: a DOWN replica whose health snapshot turns
+  healthy again re-enters as PROBING — exactly ONE canary request is
+  routed to it; success promotes it to full rotation, failure demotes
+  it back with `probe_backoff_s` before the next probe.
+
+Degradation is exact: with one replica the pick is the identity and a
+healthy replica's requests never retry, so behavior matches the bare
+engine (the server only builds a router for `num_replicas >= 2`,
+test-pinned).
+
+Thread contract: `submit`/`cancel`/`health`/`queue_depth` run on HTTP
+threads under the router lock; retries are driven by the CALLER's
+thread inside `RouterRequest.wait_done`/`wait_token` (every future a
+caller waits on resolves — there is no router thread to die).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from megatron_tpu.serving.metrics import _BASE_COUNTERS, ServingMetrics
+from megatron_tpu.serving.request import (RequestState, SamplingOptions,
+                                          ServiceUnavailableError)
+from megatron_tpu.serving.scheduler import (AdmissionError,
+                                            EngineUnhealthyError)
+from megatron_tpu.utils.logging import print_rank_0
+
+UP, DOWN, PROBING = "up", "down", "probing"
+
+# gauges summed across replicas in the aggregate /metrics snapshot
+_SUM_GAUGES = ("queue_depth", "active_slots", "num_slots",
+               "kv_blocks_used", "kv_blocks_retained", "kv_bytes_wasted")
+
+
+class NoReplicaAvailableError(ServiceUnavailableError):
+    """Every replica is ejected/down — the HTTP layer maps this to 503
+    (the router-level analogue of the breaker's EngineUnhealthyError)."""
+
+
+class _Replica:
+    __slots__ = ("idx", "engine", "state", "last_health",
+                 "last_healthy_t", "down_until", "canary", "canary_t")
+
+    def __init__(self, idx: int, engine):
+        self.idx = idx
+        self.engine = engine
+        self.state = UP
+        self.last_health: dict = {}
+        self.last_healthy_t = time.monotonic()
+        self.down_until = 0.0
+        self.canary = None  # RouterRequest probing this replica
+        self.canary_t = 0.0
+
+
+class RouterRequest:
+    """The future a router caller holds: a facade over the CURRENT
+    attempt's `GenRequest`, resubmitting on retryable failures. Token
+    reads (`generated`, `wait_token`) delegate to the live attempt —
+    after a retry the new attempt regenerates the identical stream
+    (same prompt/seed/sampling), so a streaming consumer's already-
+    emitted indices replay bit-equal and it simply waits for the
+    regeneration to pass its cursor."""
+
+    def __init__(self, router: "EngineRouter", spec: dict):
+        self._router = router
+        self.spec = spec
+        self.arrival_id: Optional[int] = None
+        self.attempts = 0
+        self.inner = None          # current attempt's GenRequest
+        self.replica: Optional[_Replica] = None
+        self.cancelled = False
+        self._terminal = None      # ("ok"|"err", GenRequest) | ("exc", e)
+        self._lock = threading.RLock()
+        self._last_health_check = 0.0  # rate-limits _pump's re-check
+
+    # -- facade fields the HTTP layer / tests read ---------------------
+    @property
+    def id(self):
+        return self.arrival_id
+
+    @property
+    def prompt(self) -> List[int]:
+        return self.spec["prompt"]
+
+    @property
+    def generated(self) -> List[int]:
+        inner = self.inner
+        return inner.generated if inner is not None else []
+
+    @property
+    def gen_logprobs(self) -> List[float]:
+        inner = self.inner
+        return inner.gen_logprobs if inner is not None else []
+
+    @property
+    def state(self):
+        if self._terminal is not None and self._terminal[0] == "ok":
+            return RequestState.FINISHED
+        if self._terminal is not None:
+            return RequestState.FAILED
+        inner = self.inner
+        return inner.state if inner is not None else RequestState.QUEUED
+
+    def done(self) -> bool:
+        return self._terminal is not None
+
+    def cancel(self):
+        self.cancelled = True
+        inner, rep = self.inner, self.replica
+        if inner is not None and rep is not None:
+            rep.engine.cancel(inner)
+
+    # -- retry pump (caller thread) ------------------------------------
+    def _settle(self, terminal: str, attempt_ok: Optional[bool]):
+        """Mark terminal; report the attempt verdict to the canary
+        machinery (None = inconclusive: clears the canary slot without
+        promoting or re-ejecting)."""
+        self._terminal = (terminal, self.inner)
+        self._router._note_attempt(self.replica, self, ok=attempt_ok)
+
+    def _on_inner_done(self):
+        with self._lock:
+            if self._terminal is not None:
+                return
+            inner = self.inner
+            if not inner.done():
+                return  # a concurrent pump already retried this attempt
+            if inner.state is RequestState.FINISHED and inner.error is None:
+                self._settle("ok", True)
+                return
+            kind = getattr(inner, "error_kind", "error")
+            if self.cancelled or kind == "deadline":
+                # client gave up / SLO burned: a retry cannot help —
+                # terminal here, inconclusive for the replica (neither
+                # outcome says the replica itself is broken)
+                self._settle("err", None)
+                return
+            # retryable infra failure (engine crash/shutdown/hang/drain)
+            self._retry(f"attempt on replica {self.replica.idx} failed: "
+                        f"{inner.error}")
+
+    def _retry(self, why: str):
+        failed = self.replica
+        if self.attempts >= self._router.max_retries:
+            inner = self.inner
+            if inner is not None and not inner.done():
+                # exhaustion can settle on a still-RUNNING inner (a
+                # wedged replica's cancel may never be consumed):
+                # fail it NOW so result() raises the typed retryable
+                # 503, not a TimeoutError-shaped 500. Idempotent —
+                # first terminal transition wins if the engine races.
+                inner.fail(
+                    "router: failover retries exhausted "
+                    f"({self._router.max_retries}) after replica "
+                    f"failures; retry against another front door "
+                    f"({why})", kind="unavailable")
+            self._settle("err", False)
+            return
+        self._router._note_attempt(failed, self, ok=False)
+        self._router.metrics.count("router_retries")
+        self.attempts += 1
+        time.sleep(min(self._router.retry_backoff_s * self.attempts, 1.0))
+        try:
+            self._router._dispatch(
+                self, exclude=(failed.idx,) if failed is not None else ())
+        except Exception as e:  # noqa: BLE001 — typed 503/429 preserved
+            self._terminal = ("exc", e)
+        else:
+            print_rank_0(f"router: requeued request {self.arrival_id} "
+                         f"onto replica {self.replica.idx} "
+                         f"(attempt {self.attempts + 1}; {why})")
+
+    def _pump(self, step: float, token_i: Optional[int] = None):
+        """One wait-and-check beat: wait on the current attempt (the
+        per-token condition when a streaming cursor passes `token_i` —
+        tokens deliver the moment they land, not at the poll edge),
+        then detect a mid-flight replica ejection (the attempt may
+        never resolve on a wedged-and-ejected replica — cancel it
+        there and retry on a survivor instead of stranding the
+        caller). The health re-check is rate-limited per request so N
+        waiting callers don't serialize health() refreshes on the
+        router lock every beat."""
+        inner, rep = self.inner, self.replica
+        if token_i is None:
+            inner._done.wait(step)
+        else:
+            inner.wait_token(token_i, step)
+        if inner.done():
+            self._on_inner_done()
+            return
+        now = time.monotonic()
+        if now - self._last_health_check < 0.5:
+            return
+        self._last_health_check = now
+        if rep is not None and self._router._check_replica(rep) == DOWN \
+                and not inner.done():
+            with self._lock:
+                if self._terminal is None and self.inner is inner:
+                    rep.engine.cancel(inner)
+                    self._retry(f"replica {rep.idx} ejected mid-flight")
+
+    def wait_done(self, timeout: Optional[float] = None) -> bool:
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while self._terminal is None:
+            step = 0.25
+            if deadline is not None:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return False
+                step = min(step, rem)
+            self._pump(step)
+        return True
+
+    def wait_token(self, i: int, timeout: Optional[float] = None) -> bool:
+        """True once token i exists on the live attempt or the request
+        is terminal — the streaming cursor's wait, driving the same
+        retry pump as `wait_done`."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            inner = self.inner
+            if inner is not None and len(inner.generated) > i:
+                return True
+            if self._terminal is not None:
+                return True
+            step = 0.25
+            if deadline is not None:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return False
+                step = min(step, rem)
+            self._pump(step, token_i=i)
+
+    def result(self, timeout: Optional[float] = None):
+        if not self.wait_done(timeout):
+            raise TimeoutError(
+                f"router request {self.arrival_id} still pending "
+                f"(attempt {self.attempts + 1})")
+        kind, val = self._terminal
+        if kind == "exc":
+            raise val
+        # "ok" returns the tokens; "err" raises the typed error —
+        # both via the settled attempt's own result()
+        return val.result(timeout=0.001)
+
+
+class EngineRouter:
+    """In-process front door over N engine replicas (module docstring
+    has the policy). API-compatible with `ServingEngine` where the HTTP
+    layer touches it: submit/cancel/generate/drain/close/health/
+    queue_depth/metrics/max_len."""
+
+    def __init__(self, engines: Sequence, metrics: Optional[ServingMetrics]
+                 = None, max_retries: int = 2,
+                 heartbeat_timeout_s: float = 5.0,
+                 probe_backoff_s: float = 0.5,
+                 retry_backoff_s: float = 0.05):
+        assert engines, "router needs at least one replica"
+        self.replicas = [_Replica(i, e) for i, e in enumerate(engines)]
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.max_retries = max(int(max_retries), 0)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.probe_backoff_s = float(probe_backoff_s)
+        self.retry_backoff_s = float(retry_backoff_s)
+        # canary verdicts are settled by the canary's WAITING caller;
+        # an abandoned caller (disconnect, caller-side timeout) would
+        # otherwise pin the replica in PROBING forever — after this
+        # long with no verdict the canary slot frees and the next
+        # request probes afresh
+        self.canary_timeout_s = max(self.heartbeat_timeout_s * 2, 10.0)
+        self.max_len = min(e.max_len for e in engines)
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+    # health tracking / ejection / half-open probing
+    # ------------------------------------------------------------------
+    def _eval_replica(self, rep: _Replica, now: float) -> str:
+        """Refresh one replica's snapshot and classify it. DOWN when the
+        snapshot is unobtainable, reports a hard-down state (breaker
+        open, draining, loop dead), or no healthy snapshot has been
+        seen within the heartbeat deadline (a wedged replica gets that
+        grace — its watchdog may restart it — then is ejected)."""
+        try:
+            h = rep.engine.health()
+        except Exception:  # snapshot itself failed: missed heartbeat
+            h = None
+        if h is not None:
+            rep.last_health = h
+        hard_down = (h is None or h.get("circuit_breaker_open")
+                     or h.get("state") in ("draining", "unhealthy")
+                     or not h.get("loop_alive", False))
+        if not hard_down and h.get("healthy") \
+                and h.get("state") == "running":
+            rep.last_healthy_t = now
+        missed = now - rep.last_healthy_t > self.heartbeat_timeout_s
+        return DOWN if (hard_down or missed) else UP
+
+    def _check_replica(self, rep: _Replica) -> str:
+        with self._lock:
+            self._refresh_one(rep, time.monotonic())
+            return rep.state
+
+    def _refresh_one(self, rep: _Replica, now: float):
+        verdict = self._eval_replica(rep, now)
+        if verdict == DOWN:
+            if rep.state != DOWN:
+                self.metrics.count("router_failovers")
+                why = (rep.last_health or {}).get("state", "no heartbeat")
+                print_rank_0(
+                    f"router: replica {rep.idx} ejected ({why}); "
+                    "traffic fails over to survivors")
+                rep.state = DOWN
+                rep.down_until = now + self.probe_backoff_s
+                rep.canary = None
+        elif rep.state == DOWN and now >= rep.down_until:
+            # healthy snapshot again: half-open — admit ONE canary
+            rep.state = PROBING
+            rep.canary = None
+            print_rank_0(f"router: replica {rep.idx} half-open "
+                         "(awaiting canary)")
+        elif rep.state == PROBING and rep.canary is not None \
+                and now - rep.canary_t > self.canary_timeout_s:
+            # abandoned canary (its caller stopped pumping): free the
+            # slot so the next request probes afresh instead of the
+            # replica idling in PROBING forever
+            rep.canary = None
+            print_rank_0(f"router: replica {rep.idx} canary abandoned "
+                         f"(> {self.canary_timeout_s:.0f}s); re-probing")
+
+    def _refresh_locked(self):
+        now = time.monotonic()
+        for rep in self.replicas:
+            self._refresh_one(rep, now)
+
+    def _note_attempt(self, rep: Optional[_Replica], rreq,
+                      ok: Optional[bool]):
+        """Canary bookkeeping: the probing replica's single canary
+        promotes it (success) or re-ejects it (failure); None is
+        inconclusive (cancel/deadline) — the canary slot frees and the
+        next pick sends a fresh canary."""
+        if rep is None:
+            return
+        with self._lock:
+            if rep.canary is not rreq:
+                return
+            rep.canary = None
+            if rep.state != PROBING or ok is None:
+                return
+            if ok:
+                rep.state = UP
+                print_rank_0(f"router: replica {rep.idx} canary "
+                             "succeeded; back in full rotation")
+            else:
+                rep.state = DOWN
+                rep.down_until = time.monotonic() + self.probe_backoff_s
+                print_rank_0(f"router: replica {rep.idx} canary failed; "
+                             "ejected again")
+
+    # ------------------------------------------------------------------
+    # routing
+    # ------------------------------------------------------------------
+    def _load(self, rep: _Replica) -> float:
+        """Least-loaded tie-break: work queued ahead x observed service
+        time (the PR 6 admission signals, read from the snapshot)."""
+        h = rep.last_health or {}
+        waiting = (h.get("queue_depth", 0) + h.get("active_slots", 0)
+                   + h.get("prefilling", 0))
+        return float(waiting) * max(
+            float(h.get("service_time_ewma_ms", 0.0)), 1.0)
+
+    def _pick_locked(self, tokens: Sequence[int], exclude=()):
+        """(replica, is_canary): longest `prefix_peek` match among UP
+        replicas, ties by least-loaded. A PROBING replica with no
+        canary in flight takes ONE request first — that request IS the
+        canary."""
+        self._refresh_locked()
+        for rep in self.replicas:
+            if rep.idx in exclude:
+                continue
+            if rep.state == PROBING and rep.canary is None:
+                return rep, True
+        best, best_key = None, None
+        for rep in self.replicas:
+            if rep.idx in exclude or rep.state != UP:
+                continue
+            pfx = rep.engine.prefix_peek(tokens)
+            key = (-pfx, self._load(rep), rep.idx)
+            if best_key is None or key < best_key:
+                best, best_key = rep, key
+        if best is None:
+            # no UP replica and every PROBING one has a canary in
+            # flight (e.g. a whole-fleet blip just recovered): route
+            # to a probing replica anyway — it is healthy-by-snapshot
+            # and serving its canary; 503 is reserved for replicas
+            # that are actually DOWN
+            for rep in self.replicas:
+                if rep.idx not in exclude and rep.state == PROBING:
+                    return rep, False
+        return best, False
+
+    def _dispatch(self, rreq: RouterRequest, exclude=()):
+        """Route one attempt. Tries candidates in pick order; a
+        submit-time rejection by one replica (queue full / breaker)
+        moves on to the next. Raises the last per-replica error when
+        every candidate rejected, NoReplicaAvailableError when no
+        candidate exists at all (every replica down)."""
+        spec = rreq.spec
+        tried = set()
+        relaxed = False
+        last_err: Optional[Exception] = None
+        while True:
+            with self._lock:
+                rep, is_canary = self._pick_locked(
+                    spec["prompt"], exclude=tried | set(exclude))
+                if rep is None and exclude and not relaxed:
+                    # the excluded (just-failed) replica may be the only
+                    # one left standing — re-admit it rather than 503
+                    relaxed = True
+                    rep, is_canary = self._pick_locked(spec["prompt"],
+                                                       exclude=tried)
+                if rep is None:
+                    break
+                if is_canary:
+                    rep.canary = rreq
+                    rep.canary_t = time.monotonic()
+            tried.add(rep.idx)
+            try:
+                inner = rep.engine.submit(
+                    spec["prompt"], spec["max_new_tokens"],
+                    spec["sampling"], seed=spec["seed"],
+                    priority=spec["priority"],
+                    deadline_s=spec["deadline_s"],
+                    arrival_id=rreq.arrival_id)
+            except AdmissionError:
+                with self._lock:
+                    if rep.canary is rreq:
+                        rep.canary = None
+                raise  # 400: no replica can serve an inadmissible request
+            except Exception as e:  # noqa: BLE001 — per-replica reject
+                last_err = e
+                with self._lock:
+                    if rep.canary is rreq:
+                        rep.canary = None
+                    if isinstance(e, EngineUnhealthyError):
+                        # breaker open: hard-eject without waiting for
+                        # the next health refresh
+                        self._refresh_one(rep, time.monotonic())
+                continue
+            with self._lock:
+                rreq.inner = inner
+                rreq.replica = rep
+                if rreq.arrival_id is None:
+                    rreq.arrival_id = inner.id
+            return
+        if last_err is not None:
+            raise last_err
+        raise NoReplicaAvailableError(
+            f"all {len(self.replicas)} replicas are down "
+            "(ejected by health checks); retry later")
+
+    # ------------------------------------------------------------------
+    # public API (ServingEngine-shaped)
+    # ------------------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 64,
+               sampling: SamplingOptions = SamplingOptions(),
+               seed: int = 0, priority: int = 0,
+               deadline_s: Optional[float] = None) -> RouterRequest:
+        rreq = RouterRequest(self, dict(
+            prompt=list(prompt), max_new_tokens=int(max_new_tokens),
+            sampling=sampling, seed=int(seed), priority=int(priority),
+            deadline_s=deadline_s))
+        # (requests_received is counted by the replica each attempt
+        # lands on — the aggregate snapshot sums those; counting here
+        # too would double it)
+        self._dispatch(rreq)
+        return rreq
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 64,
+                 sampling: SamplingOptions = SamplingOptions(),
+                 seed: int = 0, timeout: Optional[float] = None):
+        return self.submit(prompt, max_new_tokens, sampling,
+                           seed).result(timeout)
+
+    def cancel(self, rreq: RouterRequest):
+        rreq.cancel()
+
+    def queue_depth(self) -> int:
+        n = 0
+        for rep in self.replicas:
+            try:
+                n += rep.engine.queue_depth()
+            except Exception:  # noqa: BLE001 — a dead replica queues 0
+                pass
+        return n
+
+    def prefix_peek(self, tokens: Sequence[int]) -> int:
+        return max(rep.engine.prefix_peek(tokens)
+                   for rep in self.replicas)
+
+    def health(self) -> dict:
+        """Router-level `/healthz` payload: `state` distinguishes
+        DEGRADED (some replicas down, still serving — stays ready/200)
+        from DOWN (no replica left — 503). Per-replica summaries ride
+        along for operators."""
+        with self._lock:
+            self._refresh_locked()
+            states = [rep.state for rep in self.replicas]
+            up = sum(1 for s in states if s != DOWN)
+            if up == len(states):
+                state = "running"
+            elif up > 0:
+                state = "degraded"
+            else:
+                state = "down"
+            reps = []
+            for rep in self.replicas:
+                h = rep.last_health or {}
+                reps.append({
+                    "idx": rep.idx, "router_state": rep.state,
+                    "state": h.get("state", "unknown"),
+                    "healthy": bool(h.get("healthy", False)),
+                    "queue_depth": int(h.get("queue_depth", 0)),
+                    "active_slots": int(h.get("active_slots", 0)),
+                    "service_time_ewma_ms":
+                        float(h.get("service_time_ewma_ms", 0.0)),
+                })
+        return {
+            "healthy": up > 0,
+            "accepting": up > 0,
+            "state": state,
+            "loop_alive": any(r.get("healthy") or r["router_state"] != DOWN
+                              for r in reps),
+            "replicas_up": up,
+            "num_replicas": len(self.replicas),
+            "queue_depth": self.queue_depth(),
+            "replicas": reps,
+            "detail": "" if up else "all replicas down",
+        }
+
+    def aggregate_snapshot(self) -> dict:
+        """Router `/metrics`: base counters and occupancy gauges summed
+        across replicas, router-level counters (failovers/retries/
+        stream_reconnects) overlaid from the router's own registry,
+        latency/rate keys reported as the worst replica (max)."""
+        out = self.metrics.snapshot()
+        for rep in self.replicas:
+            try:
+                snap = rep.engine.metrics.snapshot()
+            except Exception:  # noqa: BLE001
+                continue
+            for k in _BASE_COUNTERS + _SUM_GAUGES:
+                out[k] = out.get(k, 0.0) + snap.get(k, 0.0)
+            for k, v in snap.items():
+                if k.endswith("_ms") or k in ("tokens_per_s",
+                                              "slot_occupancy"):
+                    out[k] = max(out.get(k, 0.0), v)
+        out["num_replicas"] = float(len(self.replicas))
+        return out
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        ok = True
+        for rep in self.replicas:
+            ok = rep.engine.drain(timeout) and ok
+        return ok
+
+    def close(self):
+        for rep in self.replicas:
+            rep.engine.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -109,6 +109,13 @@ class AdmissionScheduler:
             self._service_ewma = (s if self._service_ewma is None
                                   else 0.7 * self._service_ewma + 0.3 * s)
 
+    def service_time_ewma(self) -> float:
+        """Observed per-request slot service time (seconds; 0.0 before
+        the first completion) — exported through `engine.health()` as
+        `service_time_ewma_ms`, the router's least-loaded signal."""
+        with self._lock:
+            return float(self._service_ewma or 0.0)
+
     def _estimate_delay_locked(self, req: GenRequest) -> Optional[float]:
         """Coarse queue-delay estimate for `req`: requests that would be
         served before it (queued-ahead + busy slots) spread over the

@@ -827,6 +827,49 @@ def test_chaos_serve_smoke(tmp_path):
     assert record["hang"]["probe_token_exact"] is True
 
 
+@pytest.mark.slow
+def test_chaos_router_smoke(tmp_path):
+    """tools/chaos_router.py --smoke: replica kill / wedge-one-replica /
+    host-tier corruption over a REAL 2-replica router (ISSUE 10
+    acceptance drill) — zero lost accepted requests, every completed
+    request (requeued-and-retried included) token-exact vs a serial
+    single-replica run, /healthz degraded-not-down after a kill, the
+    wedged replica re-admitted via a half-open canary, and a corrupt
+    host-tier demotion caught by checksum as a miss."""
+    import subprocess
+    import sys as _sys
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "chaos_router.py")
+    out = str(tmp_path / "chaos_router.json")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([_sys.executable, tool, "--smoke", "--out", out],
+                       capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    with open(out) as f:
+        record = json.load(f)
+    assert record["completed"] is True
+    for drill in ("kill", "wedge", "host_tier"):
+        assert record[drill]["ok"], record[drill]
+    # kill: zero stranded, zero lost, all token-exact, degraded-ready
+    assert record["kill"]["outcomes"]["stranded"] == 0
+    assert record["kill"]["outcomes"]["error"] == 0
+    assert record["kill"]["completed_token_exact"] is True
+    assert record["kill"]["router_failovers"] >= 1
+    assert record["kill"]["health_state"] == "degraded"
+    assert record["kill"]["healthz_ready"] is True
+    # wedge: watchdog-failed work retried exactly; canary re-admission
+    assert record["wedge"]["completed_token_exact"] is True
+    assert record["wedge"]["recovered_both_up"] is True
+    # host tier: clean restore hits, corrupt restore is a checksum miss
+    assert record["host_tier"]["host_tier_hits"] >= 1
+    assert record["host_tier"]["host_tier_checksum_misses"] >= 1
+    assert record["host_tier"]["clean_restore_exact"] is True
+    assert record["host_tier"]["corrupt_restore_exact"] is True
+
+
 # ---------------------------------------------------------------------------
 # bit-exact resume: checkpointable data-iterator state (ISSUE 4 tentpole)
 # ---------------------------------------------------------------------------

@@ -12,6 +12,7 @@ The load-bearing contracts:
 - backpressure: bounded queue overflow rejects (429 at the HTTP layer),
   oversize requests fail admission (400).
 """
+import json
 import threading
 import time
 
@@ -2849,3 +2850,476 @@ class TestRollingBlocks:
             ServingEngine(gen, ServingConfig(
                 num_slots=2, max_len=96, kv_block_size=16,
                 speculative_k=4), start=False)
+
+
+class TestFrontDoorContracts:
+    """Satellites: the health() routing-signal schema is pinned (the
+    router contract can't drift), the new front-door counters sit in
+    the fixed /metrics schema, and the degenerate config — one
+    replica, no streaming, no host tier — builds the bare engine."""
+
+    HEALTH_KEYS = (
+        "healthy", "state", "accepting", "loop_alive",
+        "circuit_breaker_open", "engine_restarts", "max_engine_restarts",
+        "active_slots", "prefilling", "num_slots",
+        # the routing signals the router consumes:
+        "queue_depth", "free_slots", "kv_blocks_retained",
+        "service_time_ewma_ms",
+    )
+
+    def test_health_schema_pinned(self, engine):
+        gen, eng = engine
+        h = eng.health()
+        for key in self.HEALTH_KEYS:
+            assert key in h, f"health() lost routing signal {key!r}"
+        assert isinstance(h["free_slots"], int)
+        assert isinstance(h["kv_blocks_retained"], int)
+        assert isinstance(h["service_time_ewma_ms"], float)
+        # after at least one completion the EWMA must be live (>0) —
+        # the router's least-loaded signal feeds off it
+        eng.generate([3, 1, 4], 2, SamplingOptions(temperature=0.0),
+                     seed=0)
+        assert eng.health()["service_time_ewma_ms"] > 0.0
+
+    def test_front_door_counters_in_base_schema(self):
+        snap = ServingMetrics().snapshot()
+        for key in ("router_failovers", "router_retries",
+                    "host_tier_hits", "host_tier_demotions",
+                    "host_tier_checksum_misses", "stream_reconnects"):
+            assert snap[key] == 0.0, key
+
+    def test_default_config_builds_plain_engine(self, tiny_model):
+        """num_replicas=1 + host_kv_bytes=0 + no streaming client is
+        the PR 9 engine exactly: no router object exists at all."""
+        from megatron_tpu.inference.server import MegatronServer
+        from megatron_tpu.serving.router import EngineRouter
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        srv = MegatronServer(gen, FakeTokenizer(),
+                             serving=ServingConfig(num_slots=2,
+                                                   max_queue=8,
+                                                   max_len=64))
+        try:
+            assert isinstance(srv.engine, ServingEngine)
+            assert not isinstance(srv.engine, EngineRouter)
+            assert srv.engine._host_tier is None
+            status, body = srv.handle({"prompts": ["hi"],
+                                       "tokens_to_generate": 2,
+                                       "random_seed": 3})
+            assert status == 200 and len(body["text"]) == 1
+        finally:
+            srv.close()
+
+    def test_validate_front_door_knobs(self):
+        with pytest.raises(AssertionError, match="host_kv_bytes"):
+            ServingConfig(host_kv_bytes=1 << 20).validate()
+        with pytest.raises(AssertionError, match="host_kv_bytes"):
+            ServingConfig(host_kv_bytes=1 << 20,
+                          enable_prefix_cache=True).validate()
+        with pytest.raises(AssertionError):
+            ServingConfig(num_replicas=0).validate()
+        with pytest.raises(AssertionError, match="serial_fallback"):
+            ServingConfig(num_replicas=2, serial_fallback=True).validate()
+        # the legal combination validates
+        ServingConfig(num_replicas=2, enable_prefix_cache=True,
+                      kv_block_size=16, host_kv_bytes=1 << 20,
+                      max_len=64).validate()
+
+
+class TestRouter:
+    """Tentpole (a): prefix-affinity routing, health-driven failover
+    with token-exact requeue-and-retry, half-open recovery, and the
+    degraded-vs-down /healthz distinction."""
+
+    def _router(self, tiny_model, **kw):
+        from megatron_tpu.serving.router import EngineRouter
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sc = ServingConfig(num_slots=2, max_queue=32, max_len=64,
+                           enable_prefix_cache=True, kv_block_size=16,
+                           **kw).validate(cfg)
+        engines = [ServingEngine(gen, sc) for _ in range(2)]
+        return EngineRouter(engines, max_retries=2,
+                            heartbeat_timeout_s=3.0,
+                            probe_backoff_s=0.05), engines, gen
+
+    def test_routed_outputs_match_serial(self, tiny_model):
+        router, engines, gen = self._router(tiny_model)
+        try:
+            s = SamplingOptions(temperature=0.9, top_k=5)
+            reqs = [(router.submit([5 + i, 2, 7], 6, s, seed=i), i)
+                    for i in range(6)]
+            for r, i in reqs:
+                toks, lps = r.result(timeout=300)
+                want, lens, _ = gen.generate(
+                    [[5 + i, 2, 7]], 6,
+                    sampling=SamplingParams(temperature=0.9, top_k=5),
+                    seed=i)
+                assert toks == want[0, :lens[0]].tolist()
+                assert len(lps) == len(toks) - 3
+            # both replicas actually served (least-loaded spreads a
+            # 6-request burst over 2x2 slots)
+            used = sum(1 for e in engines
+                       if e.metrics.snapshot()["requests_received"] > 0)
+            assert used == 2
+        finally:
+            router.close()
+
+    def test_prefix_affinity_prefers_warm_replica(self, tiny_model):
+        router, engines, gen = self._router(tiny_model)
+        try:
+            prefix = list(range(2, 20))  # covers one 16-token block
+            s = SamplingOptions(temperature=0.0)
+            engines[1].generate(prefix, 4, s, seed=0)  # warm ONLY 1
+            assert engines[1].prefix_peek(prefix + [50, 51]) >= 16
+            assert engines[0].prefix_peek(prefix + [50, 51]) == 0
+            with router._lock:
+                rep, canary = router._pick_locked(prefix + [50, 51])
+            assert rep.idx == 1 and not canary
+            # and a request actually lands there with a prefix hit
+            r = router.submit(prefix + [50, 51], 4, s, seed=1)
+            toks, _ = r.result(timeout=120)
+            assert r.replica.idx == 1
+            assert engines[1].metrics.snapshot()["prefix_hits"] >= 1
+            want, lens, _ = gen.generate(
+                [prefix + [50, 51]], 4,
+                sampling=SamplingParams(temperature=0.0))
+            assert toks == want[0, :lens[0]].tolist()
+        finally:
+            router.close()
+
+    def test_replica_kill_mid_decode_failover_token_exact(self,
+                                                          tiny_model):
+        """Acceptance: killing a replica mid-traffic loses ZERO
+        accepted requests — every future resolves, every completion
+        (requeued-and-retried included) token-exact vs serial, and
+        /healthz reports DEGRADED (ready), not down."""
+        router, engines, gen = self._router(tiny_model)
+        try:
+            s = SamplingOptions(temperature=0.0)
+            for e in engines:  # warm both (compiles)
+                e.generate([3, 1, 4], 2, s, seed=0)
+            reqs = [(router.submit([9 + i, 3, 5], 8, s, seed=i), i)
+                    for i in range(6)]
+            deadline = time.monotonic() + 30
+            while (engines[0].health()["active_slots"] == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.002)
+            engines[0].close()  # the kill
+            for r, i in reqs:
+                toks, _ = r.result(timeout=300)  # no stranded futures
+                want, lens, _ = gen.generate(
+                    [[9 + i, 3, 5]], 8,
+                    sampling=SamplingParams(temperature=0.0))
+                assert toks == want[0, :lens[0]].tolist(), i
+            h = router.health()
+            assert h["state"] == "degraded" and h["healthy"]
+            snap = router.aggregate_snapshot()
+            assert snap["router_failovers"] >= 1
+            assert snap["router_retries"] >= 1
+            # retried attempts preserved their original arrival id
+            for r, _ in reqs:
+                assert r.inner.id == r.arrival_id
+        finally:
+            router.close()
+
+    def test_all_replicas_down_is_typed_503(self, tiny_model):
+        router, engines, _ = self._router(tiny_model)
+        try:
+            for e in engines:
+                e.close()
+            with pytest.raises(ServiceUnavailableError,
+                               match="replicas are down"):
+                router.submit([1, 2], 2)
+            h = router.health()
+            assert h["state"] == "down" and not h["healthy"]
+        finally:
+            router.close()
+
+    def test_half_open_canary_recovery(self, tiny_model):
+        router, engines, _ = self._router(tiny_model)
+        try:
+            s = SamplingOptions(temperature=0.0)
+            for e in engines:
+                e.generate([3, 1, 4], 2, s, seed=0)
+            rep0 = router.replicas[0]
+            with router._lock:
+                rep0.state = "down"  # ejected (simulated); engine fine
+                rep0.down_until = 0.0
+            # next refresh sees a healthy snapshot -> PROBING; the
+            # first submit becomes its canary and promotes it
+            r = router.submit([4, 5, 6], 2, s, seed=1)
+            canary_rep = r.replica
+            r.result(timeout=120)
+            # pump the canary verdict (result() settled it)
+            assert canary_rep.canary is None
+            if canary_rep is rep0:
+                assert rep0.state == "up"
+            else:  # probing replica was picked first by contract
+                pytest.fail("probing replica must receive the canary")
+        finally:
+            router.close()
+
+
+class TestSSEStreaming:
+    """Tentpole (b): SSE token streams with monotonic ids, resume via
+    Last-Event-ID (no duplicated or missing tokens), and clean typed
+    terminal error events."""
+
+    @pytest.fixture(scope="class")
+    def sse_server(self, tiny_model):
+        from megatron_tpu.inference.server import MegatronServer
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        srv = MegatronServer(gen, FakeTokenizer(),
+                             serving=ServingConfig(num_slots=2,
+                                                   max_queue=16,
+                                                   max_len=64))
+        yield srv
+        srv.close()
+
+    @staticmethod
+    def _frames(body):
+        import json as _json
+        frames = []
+        for block in "".join(body).strip().split("\n\n"):
+            f = {}
+            for line in block.split("\n"):
+                k, _, v = line.partition(": ")
+                f.setdefault(k, v)
+            f["data"] = _json.loads(f["data"])
+            frames.append(f)
+        return frames
+
+    def test_stream_matches_completed_future(self, sse_server):
+        payload = {"prompts": ["hello"], "tokens_to_generate": 8,
+                   "temperature": 0.0, "random_seed": 7}
+        status, body = sse_server.handle(dict(payload, stream=True))
+        assert status == 200
+        frames = self._frames(body)
+        assert frames[0]["event"] == "start"
+        assert frames[-1]["event"] == "done"
+        toks = [f["data"]["token"] for f in frames
+                if f.get("event") == "token"]
+        ids = [int(f["id"]) for f in frames if f.get("event") == "token"]
+        assert ids == list(range(len(toks)))  # monotonic token index
+        status2, body2 = sse_server.handle(payload)
+        ref = body2["segments"][0]
+        assert toks == ref[len(ref) - 8:]
+
+    def test_reconnect_resumes_exactly(self, sse_server):
+        status, body = sse_server.handle(
+            {"prompts": ["resume me"], "tokens_to_generate": 8,
+             "temperature": 0.0, "random_seed": 11, "stream": True})
+        frames = self._frames(body)
+        sid = frames[0]["data"]["stream_id"]
+        toks = [f["data"]["token"] for f in frames
+                if f.get("event") == "token"]
+        # client "dropped" after event id 2; reconnect with the header
+        status3, body3 = sse_server.handle(
+            {"stream": True, "stream_id": sid},
+            headers={"Last-Event-ID": "2"})
+        assert status3 == 200
+        frames3 = self._frames(body3)
+        assert frames3[0]["data"]["resumed"] is True
+        ids3 = [int(f["id"]) for f in frames3
+                if f.get("event") == "token"]
+        toks3 = [f["data"]["token"] for f in frames3
+                 if f.get("event") == "token"]
+        assert ids3 == list(range(3, len(toks)))  # no dup, no gap
+        assert toks3 == toks[3:]
+        assert frames3[-1]["event"] == "done"
+        assert sse_server.metrics_snapshot()["stream_reconnects"] >= 1
+
+    def test_unknown_stream_id_404_and_bad_payloads_400(self,
+                                                        sse_server):
+        s, b = sse_server.handle({"stream": True, "stream_id": "nope"})
+        assert s == 404 and "stream_id" in b["message"]
+        s, b = sse_server.handle({"prompts": ["a", "b"], "stream": True})
+        assert s == 400
+        s, b = sse_server.handle({"prompts": ["a"], "beam_width": 2,
+                                  "stream": True})
+        assert s == 400
+
+    def test_serial_fallback_stream_is_400(self, tiny_model):
+        from megatron_tpu.inference.server import MegatronServer
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        srv = MegatronServer(gen, FakeTokenizer(),
+                             serving=ServingConfig(serial_fallback=True))
+        s, b = srv.handle({"prompts": ["x"], "stream": True})
+        assert s == 400 and "engine" in b["message"]
+
+    def test_failed_request_yields_terminal_error_event(self,
+                                                        sse_server):
+        """A mid-stream failure surfaces as a CLEAN typed error event —
+        never a silent hang. Driven with a deadline expiry (504)."""
+        status, body = sse_server.handle(
+            {"prompts": ["doomed"], "tokens_to_generate": 48,
+             "temperature": 0.0, "random_seed": 13,
+             "deadline_s": 0.02, "stream": True})
+        assert status == 200  # stream opened; failure is in-band
+        frames = self._frames(body)
+        assert frames[-1]["event"] == "error"
+        assert frames[-1]["data"]["status"] == 504
+        assert "committed" in frames[-1]["data"]
+
+    def test_stdlib_sse_end_to_end(self, sse_server):
+        """Real HTTP: PUT a streaming payload through the stdlib
+        transport and read the text/event-stream response."""
+        import socket
+        import urllib.request
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        t = threading.Thread(target=sse_server._run_stdlib,
+                             args=("127.0.0.1", port), daemon=True)
+        t.start()
+        payload = json.dumps({"prompts": ["net"],
+                              "tokens_to_generate": 4,
+                              "temperature": 0.0, "random_seed": 5,
+                              "stream": True}).encode()
+        deadline = time.monotonic() + 15
+        while True:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/api", data=payload,
+                    method="PUT",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    assert resp.status == 200
+                    ctype = resp.headers.get("Content-Type")
+                    text = resp.read().decode()
+                break
+            except OSError:
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+        assert ctype == "text/event-stream"
+        frames = self._frames([text])
+        assert frames[0]["event"] == "start"
+        assert frames[-1]["event"] == "done"
+        assert sum(1 for f in frames if f.get("event") == "token") == 4
+
+
+class TestHostKVTier:
+    """Tentpole (c): retained-prefix block lists demote to host RAM on
+    eviction, restore via device_put on a later hit (token-exact), a
+    corrupt demotion is a checksum MISS (never wrong tokens), and
+    host_kv_bytes=0 is bit-identical to the tier-less engine."""
+
+    PREFIX = list(range(2, 20))  # 18 tokens: one full 16-token block
+
+    def _engine(self, tiny_model, host_bytes, retained=1):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sc = ServingConfig(num_slots=2, max_queue=32, max_len=64,
+                           enable_prefix_cache=True, kv_block_size=16,
+                           retained_slots=retained,
+                           host_kv_bytes=host_bytes).validate(cfg)
+        return ServingEngine(gen, sc), gen
+
+    def _churn(self, eng, s, seeds=(40, 50)):
+        """Finish filler requests so retained-entry pressure evicts
+        (and, with the tier on, demotes) earlier prefixes."""
+        for base in seeds:
+            eng.generate([base, base + 1, base + 2], 2, s, seed=0)
+
+    def test_unit_budget_lru_and_checksum(self):
+        import numpy as np
+        from megatron_tpu.serving import HostKVTier
+        tier = HostKVTier(budget_bytes=3000, granularity=4)
+        mk = lambda seed: {"k": np.full((2, 1, 4, 2, 8), seed,
+                                        np.float32),
+                           "v": np.full((2, 1, 4, 2, 8), seed,
+                                        np.float32)}
+        assert tier.demote("a", list(range(8)), 5, mk(1))
+        assert tier.demote("b", list(range(100, 108)), 5, mk(2))
+        # each entry is 2*512 floats = 1024B... two fit, third evicts
+        # the LRU ("a")
+        assert tier.demote("c", list(range(200, 208)), 5, mk(3))
+        assert not tier.has("a") and tier.has("b") and tier.has("c")
+        key, hit = tier.lookup(list(range(100, 108)), 7)
+        assert key == "b" and hit == 4  # block-aligned, capped
+        assert tier.restore("b") is not None
+        # corrupt "c": restore drops it and returns None
+        tier._entries["c"].arrays["k"].flat[0] = 99.0
+        assert tier.restore("c") is None
+        assert not tier.has("c")
+        # oversized entry refuses cleanly
+        big = {"k": np.zeros((2, 1, 64, 2, 64), np.float32),
+               "v": np.zeros((2, 1, 64, 2, 64), np.float32)}
+        assert not tier.demote("huge", list(range(8)), 5, big)
+        # same-sequence demotion REPLACES (demote/restore/retain
+        # cycles of one hot prompt must not duplicate), and the byte
+        # accounting stays exact through the replacement
+        before = tier.bytes_used
+        assert tier.demote("b2", list(range(100, 108)), 5, mk(4))
+        assert not tier.has("b") and tier.has("b2")
+        assert tier.bytes_used == before
+
+    def test_demote_restore_token_exact(self, tiny_model):
+        eng, gen = self._engine(tiny_model, host_bytes=1 << 22)
+        try:
+            s = SamplingOptions(temperature=0.0)
+            eng.generate(self.PREFIX, 6, s, seed=0)
+            self._churn(eng, s)  # evicts the prefix -> demotes
+            snap = eng.metrics.snapshot()
+            assert snap["host_tier_demotions"] >= 1
+            assert len(eng._host_tier) >= 1
+            p2 = self.PREFIX + [90, 91]
+            toks, _ = eng.generate(p2, 6, s, seed=2)
+            snap = eng.metrics.snapshot()
+            assert snap["host_tier_hits"] >= 1
+            assert snap["host_tier_checksum_misses"] == 0
+            want, lens, _ = gen.generate(
+                [p2], 6, sampling=SamplingParams(temperature=0.0))
+            assert toks == want[0, :lens[0]].tolist()
+        finally:
+            eng.close()
+
+    def test_corrupt_demotion_is_miss_never_wrong_tokens(self,
+                                                         tiny_model):
+        eng, gen = self._engine(tiny_model, host_bytes=1 << 22)
+        try:
+            s = SamplingOptions(temperature=0.0)
+            eng.generate(self.PREFIX, 6, s, seed=0)
+            self._churn(eng, s)
+            tier = eng._host_tier
+            for ent in tier._entries.values():
+                if ent.length >= 16:
+                    ent.arrays["k"].view("uint8").flat[0] ^= 0xFF
+            p2 = self.PREFIX + [90, 91]
+            toks, _ = eng.generate(p2, 6, s, seed=2)
+            snap = eng.metrics.snapshot()
+            assert snap["host_tier_checksum_misses"] >= 1
+            assert snap["host_tier_hits"] == 0
+            want, lens, _ = gen.generate(
+                [p2], 6, sampling=SamplingParams(temperature=0.0))
+            assert toks == want[0, :lens[0]].tolist()
+        finally:
+            eng.close()
+
+    def test_tier_off_is_bit_identical_baseline(self, tiny_model):
+        """host_kv_bytes=0: no tier object, zero host counters, and
+        the same seeded workload produces identical tokens."""
+        outs = {}
+        for host_bytes in (1 << 22, 0):
+            eng, gen = self._engine(tiny_model, host_bytes=host_bytes)
+            try:
+                s = SamplingOptions(temperature=0.0)
+                stream = []
+                stream.append(eng.generate(self.PREFIX, 6, s,
+                                           seed=0)[0])
+                self._churn(eng, s)
+                stream.append(eng.generate(self.PREFIX + [90, 91], 6,
+                                           s, seed=2)[0])
+                outs[host_bytes] = stream
+                snap = eng.metrics.snapshot()
+                if host_bytes == 0:
+                    assert eng._host_tier is None
+                    assert snap["host_tier_demotions"] == 0
+                    assert snap["host_tier_hits"] == 0
+            finally:
+                eng.close()
+        assert outs[0] == outs[1 << 22]
