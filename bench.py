@@ -360,6 +360,14 @@ def _run_extras():
         # rate + accepted-tok/s vs the bench_decode HBM roofline; ON
         # CHIP this is the pending record for the ISSUE-8 serving work
         ("bench_spec.py", [], "/tmp/bench_extras_spec.log"),
+        # block-native attention A/B (PERF_NOTES serving section):
+        # gather/scatter-bracketed vs block-native kernel decode at
+        # matched block size/dtype — greedy arms assert token
+        # agreement and the kernel arm pins kv_gather_bytes_per_step
+        # == 0; ON CHIP this is the pending record for the ISSUE-11
+        # bracket-removal claim (B in {16,64,256} x bf16/int8)
+        ("bench_block_attn.py", ["--smoke"],
+         "/tmp/bench_extras_block_attn.log"),
         # resilience smoke: scripted chaos run (transient write fault +
         # NaN-streak rollback + corrupt-checkpoint fallback) — the
         # recovery-latency record makes regressions in the resilience

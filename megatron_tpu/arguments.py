@@ -281,6 +281,16 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                         "rolling pools become cloneable/preemptible. "
                         "Must divide the slot capacity; None keeps "
                         "whole-region layout (docs/serving.md)")
+    g.add_argument("--block_native_attn", action="store_true",
+                   help="serving: block-NATIVE decode attention — the "
+                        "Pallas kernel reads the KV arena through the "
+                        "per-slot block map directly, dropping the "
+                        "per-step resolve/scatter full-pool bracket "
+                        "(gather bytes -> 0 on the decode/verify hot "
+                        "path) and scattering only the touched block "
+                        "on append; token-exact vs off, one compile. "
+                        "Inert without --kv_block_size; rejected on "
+                        "sliding-window models (docs/serving.md)")
     g.add_argument("--speculative_k", type=int, default=0,
                    help="serving: speculative decoding — propose this "
                         "many draft tokens per running slot each "
@@ -616,6 +626,7 @@ def config_from_args(args: argparse.Namespace,
             prefill_chunk=args.prefill_chunk,
             retained_slots=args.retained_slots,
             kv_block_size=args.kv_block_size,
+            block_native_attn=args.block_native_attn,
             speculative_k=args.speculative_k,
             priority_levels=args.priority_levels,
             shed_on_overload=args.shed_on_overload,

@@ -444,6 +444,28 @@ class ServingConfig:
     # to both block and jit-bucket boundaries. None (default) keeps
     # the whole-region layout bit-compatibly.
     kv_block_size: Optional[int] = None
+    # block-NATIVE decode attention (docs/serving.md "Block-native
+    # decode attention"): the Pallas kernel
+    # (ops/block_attention_pallas.py) reads the block arena THROUGH
+    # the per-slot block map — grid over (slot, kv block), online
+    # softmax carried across each slot's block chain, GQA head
+    # mapping, int8 dequant in kernel — so the decode / speculative-
+    # verify hot path drops the resolve_view/scatter_view bracket
+    # entirely: zero O(pool-bytes) gather/scatter traffic per step
+    # (the kv_gather_bytes_per_step gauge pins it at 0), and the
+    # step's KV append scatters only the touched blocks. Seeded
+    # outputs stay token-exact kernel-on vs off (bf16 AND int8 pools;
+    # test-pinned across decode / prefix-hit / chunked / preemption /
+    # speculative) and decode + verify keep ONE compile each. Inert
+    # without kv_block_size (auto-off: there is no arena to index);
+    # SLIDING-WINDOW models are EXCLUDED outright — the kernel has no
+    # window-band mask (a non-rolling windowed pool would silently
+    # attend outside the band), and ROLLING layouts additionally
+    # break its contiguous position arithmetic — so windowed pools
+    # keep the resolve/scatter bracket (validate() rejects the
+    # combination loudly; the engine re-asserts). On CPU the kernel
+    # runs in pallas interpret mode (the tier-1 test path).
+    block_native_attn: bool = False
     # speculative decoding on the slot grid (docs/serving.md
     # "Speculative decoding"): each engine iteration proposes k draft
     # tokens per running slot (self-drafting n-gram prompt-lookup by
@@ -579,6 +601,20 @@ class ServingConfig:
         assert self.max_engine_restarts >= 0, self.max_engine_restarts
         assert self.engine_step_timeout_s is None or \
             self.engine_step_timeout_s > 0.0, self.engine_step_timeout_s
+        if self.block_native_attn and model is not None:
+            # the block kernel implements plain causal masking only:
+            # no banded-window mask (a non-rolling sliding-window pool
+            # would silently need one) and no ring slot->position map
+            # (a ROLLING pool's layout breaks the kernel's contiguous
+            # position arithmetic) — sliding-window models keep the
+            # resolve_view/scatter_view bracket either way
+            assert model.sliding_window is None, (
+                "block_native_attn is unsupported on sliding-window "
+                "models: the block kernel has no window-band mask, "
+                "and ROLLING layouts additionally break its "
+                "contiguous position arithmetic — sliding-window "
+                "pools keep the resolve_view/scatter_view bracket. "
+                "Serve this model without --block_native_attn.")
         assert self.speculative_k >= 0, self.speculative_k
         if self.speculative_k:
             max_len = self.max_len

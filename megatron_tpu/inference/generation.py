@@ -169,7 +169,14 @@ def verify_tokens(params, tokens, caches, cfg: ModelConfig, *, rope,
     bookkeeping: committed length after acceptance is a REWIND of the
     window (lengths + accepted + 1 <= lengths + w), and rejected
     positions' KV is overwritten write-before-read by the next
-    dispatch, the same invariant bucket-padded prefill relies on."""
+    dispatch, the same invariant bucket-padded prefill relies on.
+
+    `caches` may be the contiguous slot-grid KVCache (the classic
+    view) OR a block-native BlockKVCache (models/attention.py —
+    serving's `--block_native_attn`): the offset broadcast and the
+    per-row positions below are layout-agnostic, and attention_apply
+    dispatches the window through the Pallas block-map kernel in the
+    latter case — speculative verify keeps ONE trace either way."""
     w = tokens.shape[1]
     L = caches.offset.shape[0]
     caches = caches._replace(offset=jnp.broadcast_to(

@@ -75,6 +75,92 @@ class KVCache(NamedTuple):
         )
 
 
+class BlockKVCache(NamedTuple):
+    """Block-NATIVE serving cache: the flat block arena plus the
+    per-slot block map, consumed directly by the Pallas block-native
+    decode-attention kernel (ops/block_attention_pallas.py) — no
+    contiguous [S, cap, ...] view is ever materialized (the
+    resolve_view/scatter_view bracket in serving/kv_pool.py is exactly
+    what this type exists to delete from the decode hot path).
+
+    Shapes are per LAYER once inside the stack scan (stack_apply scans
+    the leading layers dim off every leaf, the map included — it is
+    broadcast over layers by serving/kv_pool.block_native_cache):
+
+      k/v:     [total_blocks, B, nkv, hd]   flat arena (int8 for
+                                            quantized pools)
+      offset:  [num_slots] int32            per-slot live lengths
+      map:     [num_slots, cap/B] int32     logical -> physical block
+      k_scale/v_scale: [total_blocks, B, nkv, 1] fp32 (int8 pools)
+
+    attention_apply recognizes this type and takes the block-native
+    path: the step's k/v scatter ONLY into the touched arena blocks
+    (O(slots * tokens) bytes, not O(pool)), and the attention read
+    walks each slot's block chain through the map inside the kernel.
+    Causal self-attention with per-slot vector offsets only; ROLLING
+    (ring) layouts are excluded — the engine keeps the view bracket
+    for those."""
+    k: jax.Array
+    v: jax.Array
+    offset: jax.Array
+    map: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
+
+
+def _block_native_update_attend(q, k, v, cache: BlockKVCache, *,
+                                scale: float, dtype):
+    """Block-native KV append + kernel attention for one layer.
+
+    Append: row i's s tokens land at positions offset[i]..offset[i]+s-1
+    — physical block map[i, pos // B], in-block slot pos % B — as ONE
+    scatter touching only the written blocks (`mode="drop"` vanishes
+    writes past the region for rows parked at the capacity clamp, the
+    same contract as the contiguous per-slot scatter). Idle rows
+    (map parked on the shared TRASH block) write their garbage there,
+    exactly where scatter_view used to land it.
+
+    Read: the Pallas kernel walks the map — q attends each slot's
+    block-chained K/V causally from its own offset, dequantizing int8
+    in kernel. Write-before-read holds like the dot path: the kernel
+    consumes the post-append arena."""
+    from megatron_tpu.ops.block_attention_pallas import \
+        block_native_attention
+    S, s, nq, hd = q.shape
+    T, B, nkv, _ = cache.k.shape
+    nb = cache.map.shape[1]
+    cap = nb * B
+    offset = cache.offset
+    pos = offset[:, None] + jnp.arange(s)[None, :]          # [S, s]
+    blk_log = jnp.minimum(pos // B, nb - 1)
+    phys = jnp.take_along_axis(cache.map, blk_log, axis=1)  # [S, s]
+    # out-of-region writes (idle rows at the clamp with s > 1) index
+    # past the arena and are DROPPED — never wrap, never collide
+    phys = jnp.where(pos >= cap, jnp.int32(T), phys)
+    inblk = pos % B
+
+    def wr(arena, val):
+        return arena.at[phys, inblk].set(val.astype(arena.dtype),
+                                         mode="drop")
+
+    if cache.k.dtype == jnp.int8:
+        from megatron_tpu.ops.quantized import quantize_rows
+        ki, ks = quantize_rows(k)  # per (slot, token, head) scales
+        vi, vs = quantize_rows(v)
+        cache = cache._replace(
+            k=wr(cache.k, ki), v=wr(cache.v, vi),
+            k_scale=wr(cache.k_scale, ks),
+            v_scale=wr(cache.v_scale, vs),
+            offset=offset + s)
+    else:
+        cache = cache._replace(k=wr(cache.k, k), v=wr(cache.v, v),
+                               offset=offset + s)
+    out = block_native_attention(
+        q, cache.k, cache.v, cache.map, offset, scale=scale,
+        block_size=B, k_scale=cache.k_scale, v_scale=cache.v_scale)
+    return out.astype(dtype), cache
+
+
 def attention_init(rng, cfg: ModelConfig, dtype=jnp.float32):
     """Params: wq [h, nq*hd], wkv [h, 2*nkv*hd], wo [nq*hd, h]."""
     h = cfg.hidden_size
@@ -252,6 +338,29 @@ def attention_apply(
     assert cfg.sliding_window is None or (causal and not cross), (
         "sliding_window requires causal self-attention")
     dropout_active = not deterministic and cfg.attention_dropout > 0.0
+    if isinstance(kv_cache, BlockKVCache):
+        # block-NATIVE serving path (--block_native_attn): append this
+        # step's k/v into the touched arena blocks only and read the
+        # chain through the map inside the Pallas kernel — the
+        # contiguous view (and its resolve/scatter bracket) never
+        # exists. Decode (s == 1) and the speculative verify window
+        # (s > 1, causal within the window from each row's offset)
+        # share this one path.
+        assert causal and not cross and segment_ids is None, (
+            "block-native attention serves causal self-attention only")
+        assert cfg.sliding_window is None, (
+            "block-native attention excludes ROLLING (sliding-window) "
+            "layouts — the ring's slot->position map breaks the "
+            "kernel's contiguous position arithmetic; the engine keeps "
+            "the resolve/scatter bracket there (ServingConfig.validate)")
+        assert not dropout_active, "no dropout on the serving path"
+        out, kv_cache = _block_native_update_attend(
+            q, k, v, kv_cache, scale=1.0 / math.sqrt(hd), dtype=dtype)
+        out = out.reshape(b, s, nq * hd)
+        out = qdense(out, wcast(params["wo"], dtype), cfg.quantized_gemm)
+        if cfg.use_bias:
+            out = out + params["bo"].astype(dtype)
+        return out, kv_cache
     # A cached forward with s > 1 is either an offset-0 prefill
     # (generation.py's whole-prompt pass) or a CONTINUATION chunk at
     # offset > 0 (generation.py prefill_chunk — the serving engine's

@@ -116,8 +116,9 @@ from megatron_tpu.inference.sampling import (sample_batched,
                                              verify_draft_probs)
 from megatron_tpu.models import language_model as lm
 from megatron_tpu.resilience.faults import get_fault_injector
-from megatron_tpu.serving.kv_pool import (SlotKVPool, insert_blocks,
-                                          insert_prefill, resolve_view,
+from megatron_tpu.serving.kv_pool import (SlotKVPool, block_native_cache,
+                                          insert_blocks, insert_prefill,
+                                          pack_block_native, resolve_view,
                                           scatter_view, slice_blocks,
                                           slice_slot)
 from megatron_tpu.serving.metrics import ServingMetrics
@@ -230,6 +231,35 @@ class ServingEngine:
         # survives and outputs are BIT-IDENTICAL to the whole-region
         # pool — only the retention/alias/free accounting changes
         self._blocks_on = self.pool.blocks_enabled
+        # block-NATIVE attention (--block_native_attn): the decode /
+        # verify / batched-prefill programs consume the arena THROUGH
+        # the block map (Pallas kernel + per-row insert_blocks) and
+        # the resolve/scatter bracket never runs on the hot path —
+        # zero O(pool-bytes) gather traffic per step, token-exact vs
+        # the bracketed path (test-pinned). Auto-off without
+        # kv_block_size (no arena to index); ROLLING pools keep the
+        # bracket (the ring's slot->position map breaks the kernel's
+        # position arithmetic) and validate() rejects the combination
+        # before it gets here.
+        self._kernel_on = (self._blocks_on
+                           and bool(getattr(self.serving,
+                                            "block_native_attn", False)))
+        # re-assert ServingConfig.validate for engines constructed
+        # without it: the kernel carries no window-band mask (and no
+        # ring map), so EVERY sliding-window model — rolling or not —
+        # keeps the resolve/scatter bracket
+        assert not (self._kernel_on and cfg.sliding_window is not None), (
+            "block_native_attn is unsupported on sliding-window "
+            "models — see ServingConfig.validate")
+        # gather/scatter observability (kv_gather_bytes_per_step /
+        # kv_attn_path gauges): one resolve or scatter moves a full
+        # contiguous view; dispatch sites accumulate into
+        # _bracket_bytes (engine thread only) and _step flushes the
+        # per-step average each sync window
+        self._view_bytes = self.pool.view_nbytes()
+        self._bracket_bytes = 0
+        self._attn_path = (2 if self._kernel_on
+                           else 1 if self._blocks_on else 0)
         self._prefix_on = bool(self.serving.enable_prefix_cache)
         self._chunk = self.serving.prefill_chunk
         self._preempt_on = bool(self.serving.preemption)
@@ -661,10 +691,16 @@ class ServingEngine:
         the updated view scatters back at the bottom — pure data
         movement bracketing the identical program, so outputs are
         bit-identical with blocks on vs off and the trace count stays
-        one (block indices are data)."""
+        one (block indices are data). With `block_native_attn` the
+        bracket DISAPPEARS instead: the forward consumes a
+        BlockKVCache (arena + map) and the Pallas block kernel walks
+        each slot's chain in place — same outputs, zero full-pool
+        gather/scatter traffic."""
         self._decode_traces += 1
         bkv = None
-        if self._blocks_on:
+        if self._kernel_on:
+            bkv, pool = pool, block_native_cache(pool)
+        elif self._blocks_on:
             bkv, pool = pool, resolve_view(pool)
         cfg = self.cfg
         split = jax.vmap(jax.random.split)(rngs)  # [S, 2, 2]
@@ -689,7 +725,8 @@ class ServingEngine:
         new_lengths = jnp.minimum(lengths + 1,
                                   jnp.int32(self.max_len - 1))
         if bkv is not None:
-            pool = scatter_view(bkv, pool)
+            pool = (pack_block_native(pool, bkv.map) if self._kernel_on
+                    else scatter_view(bkv, pool))
         return (pool, logits[:, 0], new_rngs, toks, tok_lp, new_lengths,
                 jnp.full_like(rejects, -1))
 
@@ -727,7 +764,13 @@ class ServingEngine:
         row and discards the rest."""
         self._verify_traces += 1
         bkv = None
-        if self._blocks_on:
+        if self._kernel_on:
+            # block-native verify: the [S, k+1] window forwards
+            # through the SAME Pallas block kernel as decode (causal
+            # within the window) — speculative decoding keeps one
+            # trace and drops the bracket too
+            bkv, pool = pool, block_native_cache(pool)
+        elif self._blocks_on:
             bkv, pool = pool, resolve_view(pool)
         cfg = self.cfg
         k = drafts.shape[1]
@@ -801,7 +844,8 @@ class ServingEngine:
         new_lengths = jnp.minimum(lengths + 1 + a,
                                   jnp.int32(self.max_len - 1))
         if bkv is not None:
-            pool = scatter_view(bkv, pool)
+            pool = (pack_block_native(pool, bkv.map) if self._kernel_on
+                    else scatter_view(bkv, pool))
         return (pool, new_last, new_rngs, window, tok_lp, a,
                 new_lengths, new_rejects)
 
@@ -813,9 +857,14 @@ class ServingEngine:
         Row results are independent (per-row causal attention), so a
         B>1 prefill is the B=1 prefill done B times. Duplicate rows
         (the batch-bucket pads replicate row 0) rewrite the same slot
-        with identical values — idempotent by construction."""
+        with identical values — idempotent by construction.
+
+        With `block_native_attn` the rows land through per-row
+        `insert_blocks` (the group's map rows were installed at
+        admission; fresh misses, so pfx_blocks = 0) — same written
+        bytes, no resolve/scatter bracket."""
         bkv = None
-        if self._blocks_on:
+        if self._blocks_on and not self._kernel_on:
             bkv, pool = pool, resolve_view(pool)
         B = tokens.shape[0]
         caches = self.pool.make_prefill_caches(B)
@@ -831,7 +880,11 @@ class ServingEngine:
                          else row(caches.k_scale)),
                 v_scale=(None if caches.v_scale is None
                          else row(caches.v_scale)))
-            pool = insert_prefill(pool, sub, slots[i], plens[i])
+            if self._kernel_on:
+                pool = insert_blocks(pool, sub, slots[i], plens[i],
+                                     jnp.int32(0))
+            else:
+                pool = insert_prefill(pool, sub, slots[i], plens[i])
             # logits at the LAST REAL prompt position (bucket pads sit
             # after it and are causally invisible to it)
             last = jax.lax.dynamic_slice_in_dim(
@@ -943,6 +996,8 @@ class ServingEngine:
         times, then trip the crash-loop circuit breaker."""
         blocks = (f", {self.pool.block_size}-token blocks"
                   if self._blocks_on else "")
+        if self._kernel_on:
+            blocks += ", block-native attn"
         print_rank_0(
             f"serving engine: {self.num_slots} slots x cap "
             f"{self.pool.cap} ({self.pool.dtype}"
@@ -1139,6 +1194,7 @@ class ServingEngine:
         self._sampling_dirty = True
         self._lengths_dirty = True
         self._kv_dirty = True
+        self._bracket_bytes = 0
         self._wedged = False
         if self._watchdog is not None:
             self._watchdog.rearm()
@@ -1685,6 +1741,11 @@ class ServingEngine:
             self.gen.params, self.pool.caches, self._last_logits,
             self._rngs, jnp.asarray(toks), jnp.asarray(plens_a),
             jnp.asarray(slots_a), rng0s)
+        if self._blocks_on and not self._kernel_on:
+            # the batched-prefill program bracketed with resolve +
+            # scatter (block-native lands through insert_blocks
+            # instead) — flushed into the gauge at the next window
+            self._bracket_bytes += 2 * self._view_bytes
         for slot, plen, req in zip(slots, plens, reqs):
             self._lengths[slot] = plen
             self._active[slot] = True
@@ -2039,6 +2100,19 @@ class ServingEngine:
                         done = True
                         break
         self._steps += K
+        # attention-path A/B gauges: bytes any resolve/scatter
+        # full-pool bracket moved this window, averaged per step.
+        # Bracketed block-pool dispatches pay ONE view gather + ONE
+        # view scatter each; the block-native kernel (and whole-region
+        # pools) pay none — so "kernel on => kv_gather_bytes_per_step
+        # == 0" is a host-pinnable assertion (prefill brackets
+        # accumulated in _bracket_bytes fold into the same window)
+        window_bracket = self._bracket_bytes
+        self._bracket_bytes = 0
+        if self._blocks_on and not self._kernel_on:
+            window_bracket += K * 2 * self._view_bytes
+        self.metrics.set_attn_gauges(window_bracket // K,
+                                     self._attn_path)
         depth = self.scheduler.depth()
         for k in range(K):
             self.metrics.record_step(n_active, self.num_slots,

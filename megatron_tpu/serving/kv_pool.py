@@ -54,7 +54,7 @@ import numpy as np
 from megatron_tpu.config import ModelConfig
 from megatron_tpu.inference.generation import (KV_CACHE_AXES, init_kv_caches,
                                                kv_region_cap)
-from megatron_tpu.models.attention import KVCache
+from megatron_tpu.models.attention import BlockKVCache, KVCache
 from megatron_tpu.utils.logging import print_rank_0
 
 
@@ -195,6 +195,35 @@ def scatter_view(bkv: BlockKV, view: KVCache) -> BlockKV:
         k_scale=None if a.k_scale is None else s(a.k_scale, view.k_scale),
         v_scale=None if a.v_scale is None else s(a.v_scale, view.v_scale))
     return bkv._replace(arena=arena)
+
+
+def block_native_cache(bkv: BlockKV) -> BlockKVCache:
+    """View a BlockKV as the model-facing BlockKVCache WITHOUT moving
+    any data: arena leaves pass through, the per-slot map broadcasts
+    over layers so the stack scan can slice it per layer (a few KiB of
+    int32 — the whole point is that block INDICES, not block contents,
+    are what dispatch resolves). The engine's block-native decode /
+    verify programs (`--block_native_attn`) hand this to
+    lm.model_forward in place of the resolve_view gather; the Pallas
+    kernel (ops/block_attention_pallas.py) then reads the arena
+    through the map directly."""
+    a = bkv.arena
+    L = a.k.shape[0]
+    return BlockKVCache(
+        k=a.k, v=a.v, offset=a.offset,
+        map=jnp.broadcast_to(bkv.map[None], (L,) + bkv.map.shape),
+        k_scale=a.k_scale, v_scale=a.v_scale)
+
+
+def pack_block_native(cache: BlockKVCache, map2d) -> BlockKV:
+    """Inverse of `block_native_cache`: rewrap the forward pass's
+    updated arena (appends landed block-natively) as the pool's
+    BlockKV. `map2d` is the pool's own [S, nb] map — the forward never
+    remaps anything, so the original rides through."""
+    return BlockKV(
+        arena=KVCache(k=cache.k, v=cache.v, offset=cache.offset,
+                      k_scale=cache.k_scale, v_scale=cache.v_scale),
+        map=map2d)
 
 
 def slice_blocks(bkv: BlockKV, blocks, offset) -> KVCache:
@@ -700,6 +729,20 @@ class SlotKVPool:
         n = c.k.nbytes + c.v.nbytes
         if c.k_scale is not None:
             n += c.k_scale.nbytes + c.v_scale.nbytes
+        return n
+
+    def view_nbytes(self) -> int:
+        """Bytes of ONE materialized contiguous [L, S, cap, ...] view
+        (k + v + int8 scales) — the traffic unit of a single
+        `resolve_view` gather or `scatter_view` write-back, feeding
+        the engine's kv_gather_bytes_per_step gauge. Defined for every
+        layout (whole-region pools never bracket, but the unit is
+        still what a bracket WOULD move)."""
+        elems = (self.cfg.num_layers * self.num_slots * self.cap
+                 * self.cfg.num_kv_heads * self.cfg.kv_channels)
+        n = 2 * elems * self.dtype.itemsize
+        if self.dtype == jnp.dtype(jnp.int8):
+            n += 2 * (elems // self.cfg.kv_channels) * 4  # fp32 scales
         return n
 
     def bytes_per_token(self) -> int:

@@ -107,6 +107,17 @@ class ServingMetrics:
         self.kv_blocks_used = 0
         self.kv_blocks_retained = 0
         self.kv_bytes_wasted = 0
+        # attention-path A/B seam (docs/serving.md "Block-native
+        # decode attention"): kv_gather_bytes_per_step = bytes any
+        # resolve_view/scatter_view full-pool bracket moved, averaged
+        # over the last sync window's decode/verify dispatches —
+        # "kernel on => gather bytes == 0 on the decode path" is a
+        # CPU-pinnable assertion on this gauge, not an on-chip claim.
+        # kv_attn_path encodes which path the engine compiled:
+        # 0 = whole-region (no blocks), 1 = block pool through the
+        # resolve/scatter bracket, 2 = block-native Pallas kernel.
+        self.kv_gather_bytes_per_step = 0
+        self.kv_attn_path = 0
 
     # ---- recording ---------------------------------------------------
     def count(self, name: str, n: int = 1):
@@ -136,6 +147,16 @@ class ServingMetrics:
             self.kv_blocks_used = int(blocks_used)
             self.kv_blocks_retained = int(blocks_retained)
             self.kv_bytes_wasted = int(bytes_wasted)
+
+    def set_attn_gauges(self, gather_bytes_per_step: int, path: int):
+        """Engine-pushed attention-path gauges (per sync window):
+        bytes a resolve/scatter bracket moved per decode/verify step
+        (0 when the block-native kernel — or a whole-region pool —
+        dispatched), and the compiled path code (0 region / 1 block
+        view / 2 block-native kernel)."""
+        with self._lock:
+            self.kv_gather_bytes_per_step = int(gather_bytes_per_step)
+            self.kv_attn_path = int(path)
 
     def record_step(self, active_slots: int, num_slots: int,
                     tokens_emitted: int, queue_depth: int):
@@ -176,7 +197,10 @@ class ServingMetrics:
                       # mutates mid-run
                       "kv_blocks_used": float(self.kv_blocks_used),
                       "kv_blocks_retained": float(self.kv_blocks_retained),
-                      "kv_bytes_wasted": float(self.kv_bytes_wasted)}
+                      "kv_bytes_wasted": float(self.kv_bytes_wasted),
+                      "kv_gather_bytes_per_step":
+                          float(self.kv_gather_bytes_per_step),
+                      "kv_attn_path": float(self.kv_attn_path)}
         out = {k: 0.0 for k in _BASE_COUNTERS}
         out.update({k: float(v) for k, v in counters.items()})
         out.update(gauges)

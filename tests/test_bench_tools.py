@@ -172,6 +172,34 @@ def test_bench_prefix_emits_ab_record(monkeypatch, tmp_path):
     assert chnk["prefill_tokens_saved"] >= 32
 
 
+def test_bench_block_attn_emits_ab_record(monkeypatch, tmp_path):
+    """The block-native attention A/B must run both arms token-exact
+    (the tool asserts agreement itself and exits nonzero on
+    divergence), show the bracket arm paying real resolve/scatter
+    bytes per step, and pin the kernel arm's gather traffic at
+    EXACTLY zero — the ISSUE-11 acceptance seam on the metrics
+    gauge."""
+    import json
+    text = run_tool(
+        monkeypatch, tmp_path, "bench_block_attn.py",
+        ["--requests", "3", "--prompt", "8", "--new", "6",
+         "--slots", "2", "--blocks", "16", "--dtypes",
+         "bfloat16,int8", "--max_len", "64", "--layers", "2",
+         "--hidden", "64", "--heads", "4", "--vocab", "128"])
+    rec = json.loads(text)
+    assert rec["bench"] == "block_native_attn"
+    assert rec["greedy_arms_token_exact"] is True
+    assert [c["kv_dtype"] for c in rec["combos"]] == \
+        ["bfloat16", "int8"]
+    for combo in rec["combos"]:
+        assert combo["bracket"]["kv_gather_bytes_per_step"] > 0
+        assert combo["kernel"]["kv_gather_bytes_per_step"] == 0
+        assert combo["bracket"]["kv_attn_path"] == 1
+        assert combo["kernel"]["kv_attn_path"] == 2
+        assert combo["kernel"]["tokens_generated"] == \
+            combo["bracket"]["tokens_generated"] > 0
+
+
 def test_bench_spec_emits_ab_record(monkeypatch, tmp_path):
     """The speculative-decode A/B must run greedy arms token-exact vs
     the k=0 baseline (the tool asserts agreement itself and exits

@@ -2410,6 +2410,63 @@ class TestBlockPoolUnits:
                     "kv_bytes_wasted"):
             assert snap[key] == 0.0  # present before any traffic
 
+    @pytest.mark.parametrize("kv_dtype", [jnp.bfloat16, jnp.int8])
+    def test_view_roundtrip_identity_with_duplicates(self, tiny_model,
+                                                     kv_dtype):
+        """Property pin for the determinism argument that
+        kv_pool.scatter_view's docstring until now asserted only in
+        prose: scatter_view(resolve_view(x)) == x BIT-EXACTLY, map
+        duplicates included — the shared TRASH block (every idle row's
+        whole map) and prefix blocks aliased into several slots. The
+        gather reads a duplicated block identically into every view
+        row that maps it, so the unordered scatter writes identical
+        values back — the round trip can never lose or mix content.
+        Random arena payloads, random alias structure, k/v AND int8
+        scales, offsets ride through untouched."""
+        from megatron_tpu.serving.kv_pool import (resolve_view,
+                                                  scatter_view)
+        _, cfg = tiny_model
+        rs = np.random.RandomState(0)
+        pool = SlotKVPool(cfg, 4, 32, dtype=kv_dtype, block_size=8)
+        a = pool.caches.arena
+        shape, dt = a.k.shape, a.k.dtype
+
+        def payload():
+            if dt == jnp.int8:
+                return jnp.asarray(
+                    rs.randint(-127, 127, shape), jnp.int8)
+            return jnp.asarray(rs.randn(*shape), dt)
+
+        arena = a._replace(
+            k=payload(), v=payload(),
+            offset=jnp.asarray(rs.randint(0, 32, a.offset.shape),
+                               jnp.int32),
+            k_scale=(None if a.k_scale is None else jnp.asarray(
+                rs.rand(*a.k_scale.shape), jnp.float32)),
+            v_scale=(None if a.v_scale is None else jnp.asarray(
+                rs.rand(*a.v_scale.shape), jnp.float32)))
+        # map with every duplicate flavor: slot 0 fully on TRASH
+        # (idle), slots 1/2 aliasing a shared 2-block prefix, slot 3
+        # partially trash + one block aliased THREE ways
+        T = pool.TRASH
+        bmap = np.array([[T, T, T, T],
+                         [0, 1, 2, 3],
+                         [0, 1, 4, 5],
+                         [0, T, 6, 7]], np.int32)
+        bkv = pool.caches._replace(arena=arena,
+                                   map=jnp.asarray(bmap))
+        out = scatter_view(bkv, resolve_view(bkv))
+        for name in ("k", "v", "offset", "k_scale", "v_scale"):
+            want = getattr(bkv.arena, name)
+            got = getattr(out.arena, name)
+            if want is None:
+                assert got is None
+                continue
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want),
+                                          err_msg=name)
+        np.testing.assert_array_equal(np.asarray(out.map), bmap)
+
 
 @pytest.fixture(scope="module")
 def block_model():
@@ -2619,6 +2676,187 @@ class TestBlockPoolEngine:
             kv_block_size=16, speculative_k=4), prompts, n=10,
             sampling=SamplingOptions(temperature=0.0))
         assert spec == nospec
+
+
+class TestBlockNativeAttn:
+    """--block_native_attn: the Pallas block-map kernel replaces the
+    resolve_view/scatter_view bracket on the decode / verify /
+    batched-prefill hot path. The contract, pinned per ISSUE 11's
+    acceptance bar: seeded outputs stay token-exact kernel-on vs off
+    (bf16 AND int8 pools) across plain decode, prefix-hit, chunked
+    prefill, preemption-resume, and speculative verify; decode +
+    verify keep ONE compile each; and with the kernel on the hot path
+    performs ZERO full-pool brackets — kv_gather_bytes_per_step == 0,
+    asserted on the metrics seam (a CPU-pinnable claim, not an
+    on-chip one)."""
+
+    _outs = TestBlockPoolEngine._outs
+
+    @pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8"])
+    def test_plain_decode_token_exact_zero_gather(self, block_model,
+                                                  kv_dtype):
+        params, cfg = block_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+
+        def pin(eng):
+            assert eng._decode_traces == 1
+            assert eng._kernel_on
+
+        off, s_off = self._outs(gen, ServingConfig(
+            num_slots=3, max_len=96, kv_dtype=kv_dtype,
+            kv_block_size=16), PROMPTS)
+        on, s_on = self._outs(gen, ServingConfig(
+            num_slots=3, max_len=96, kv_dtype=kv_dtype,
+            kv_block_size=16, block_native_attn=True), PROMPTS,
+            trace_check=pin)
+        assert on == off
+        # THE merge gate: kernel on => zero resolve/scatter bracket
+        # bytes on the decode path; kernel off pays the full-view
+        # gather + scatter every step
+        assert s_on["kv_gather_bytes_per_step"] == 0.0
+        assert s_off["kv_gather_bytes_per_step"] > 0.0
+        assert s_on["kv_attn_path"] == 2.0
+        assert s_off["kv_attn_path"] == 1.0
+        # serial ground truth holds through the kernel too
+        sp = SamplingParams(temperature=0.9, top_k=5)
+        want, lens, _ = gen.generate([PROMPTS[0]], 8, sampling=sp,
+                                     seed=0)
+        assert on[0] == want[0, :lens[0]].tolist()
+
+    @pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8"])
+    def test_prefix_and_chunked_token_exact(self, block_model,
+                                            kv_dtype):
+        params, cfg = block_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        shared = list(range(2, 36))
+        prompts = [shared + [40 + i, 50 + i, 60 + i] for i in range(6)]
+        base, _ = self._outs(gen, ServingConfig(
+            num_slots=3, max_len=96, kv_dtype=kv_dtype), prompts, n=6)
+        for chunk in (None, 16):
+            on, snap = self._outs(gen, ServingConfig(
+                num_slots=3, max_len=96, kv_dtype=kv_dtype,
+                kv_block_size=16, enable_prefix_cache=True,
+                prefill_chunk=chunk, block_native_attn=True),
+                prompts, n=6)
+            assert on == base, f"diverged with chunk={chunk}"
+            assert snap["prefix_hits"] >= 1
+            # prefix hits + chunked prefill route through slice_blk /
+            # insert_blk (never bracketed) — the hot path stays clean
+            assert snap["kv_gather_bytes_per_step"] == 0.0
+
+    @pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8"])
+    def test_preemption_resume_token_exact(self, block_model,
+                                           kv_dtype):
+        params, cfg = block_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+
+        def run(kernel):
+            serving = ServingConfig(
+                num_slots=1, max_len=96, kv_dtype=kv_dtype,
+                kv_block_size=16, priority_levels=2, preemption=True,
+                block_native_attn=kernel)
+            with ServingEngine(gen, serving) as eng:
+                low = eng.submit([5, 6, 7, 8], 24,
+                                 SamplingOptions(temperature=0.8,
+                                                 top_k=5), seed=1,
+                                 priority=0)
+                t0 = time.monotonic()
+                while len(low.generated) < 2 and not low.done():
+                    time.sleep(0.002)
+                    assert time.monotonic() - t0 < 60
+                hi = eng.submit([50, 51], 4,
+                                SamplingOptions(temperature=0.0),
+                                seed=2, priority=1)
+                hi_out = hi.result(timeout=300)[0]
+                low_out = low.result(timeout=300)[0]
+                snap = eng.metrics.snapshot()
+            return low_out, hi_out, snap
+
+        l_off, h_off, s_off = run(False)
+        l_on, h_on, s_on = run(True)
+        assert s_on["preemptions"] >= 1, "premise: preemption fired"
+        assert (l_on, h_on) == (l_off, h_off)
+        assert s_on["kv_gather_bytes_per_step"] == 0.0
+
+    @pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8"])
+    def test_speculative_token_exact_single_verify_compile(
+            self, block_model, kv_dtype):
+        params, cfg = block_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        prompts = [[5, 17, 3, 42, 9, 9, 5, 17], [7, 8, 9, 7, 8, 9, 7],
+                   [11, 12, 13, 11, 12]]
+
+        def pin(eng):
+            assert eng._decode_traces == 1
+            assert eng._verify_traces == 1
+
+        for temp in (0.0, 0.8):
+            sampling = SamplingOptions(temperature=temp)
+            off, s_off = self._outs(gen, ServingConfig(
+                num_slots=3, max_len=96, kv_dtype=kv_dtype,
+                speculative_k=4, kv_block_size=16), prompts, n=10,
+                sampling=sampling)
+            on, s_on = self._outs(gen, ServingConfig(
+                num_slots=3, max_len=96, kv_dtype=kv_dtype,
+                speculative_k=4, kv_block_size=16,
+                block_native_attn=True), prompts, n=10,
+                sampling=sampling, trace_check=pin)
+            assert on == off, f"spec diverged at temperature={temp}"
+            assert s_on["accepted_tokens"] == s_off["accepted_tokens"]
+            # the verify grid is the same kernel (w = k+1): still no
+            # bracket anywhere on the hot path
+            assert s_on["kv_gather_bytes_per_step"] == 0.0
+            assert s_on["spec_rounds"] >= 1
+
+    def test_auto_off_without_blocks(self, block_model):
+        """block_native_attn without kv_block_size is INERT (there is
+        no arena to index): the engine builds the plain whole-region
+        programs, bit-identical to the flagless engine."""
+        params, cfg = block_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        base, _ = self._outs(gen, ServingConfig(
+            num_slots=3, max_len=96), PROMPTS, n=6)
+
+        def pin(eng):
+            assert not eng._kernel_on
+
+        on, snap = self._outs(gen, ServingConfig(
+            num_slots=3, max_len=96, block_native_attn=True), PROMPTS,
+            n=6, trace_check=pin)
+        assert on == base
+        assert snap["kv_attn_path"] == 0.0
+        assert snap["kv_gather_bytes_per_step"] == 0.0
+
+    def test_validate_rejects_sliding_window(self):
+        """The kernel has no window-band mask: EVERY sliding-window
+        model is rejected — the rolling (flash) layout AND the
+        non-rolling dot layout, whose full-cap pool would silently
+        need a banded mask the kernel doesn't apply (without this the
+        engine crash-loops at serve time on the kernel's own
+        assert)."""
+        for impl in ("flash", "dot"):
+            cfg = tiny_cfg(sliding_window=32, attention_impl=impl,
+                           seq_length=96, max_position_embeddings=96)
+            with pytest.raises(AssertionError, match="sliding-window"):
+                ServingConfig(max_len=96, kv_block_size=16,
+                              block_native_attn=True).validate(cfg)
+            # the engine constructor re-asserts for validate-less
+            # construction (the crash-loop repro path)
+            params = lm.model_init(jax.random.PRNGKey(0), cfg)
+            gen = Generator(params, cfg, eos_id=0, pad_id=0)
+            with pytest.raises(AssertionError, match="sliding-window"):
+                ServingEngine(gen, ServingConfig(
+                    max_len=96, kv_block_size=16,
+                    block_native_attn=True), start=False)
+        # windowless configs pass
+        ServingConfig(max_len=96, kv_block_size=16,
+                      block_native_attn=True).validate(tiny_cfg(
+                          seq_length=96, max_position_embeddings=96))
+
+    def test_attn_gauges_in_metrics_schema(self):
+        snap = ServingMetrics().snapshot()
+        for key in ("kv_gather_bytes_per_step", "kv_attn_path"):
+            assert snap[key] == 0.0  # present before any traffic
 
 
 @pytest.fixture(scope="module")

@@ -56,12 +56,19 @@ def _tiny_router(serving_kwargs, n_replicas=2, hidden=64,
     from megatron_tpu.models import language_model as lm
     from megatron_tpu.serving import EngineRouter, ServingEngine
 
+    # bf16 activations except under the block-native kernel
+    # (chaos_serve precedent): the drills pin retried completions
+    # token-exact vs a serial oracle, and the kernel's fp32 softmax
+    # only matches the oracle's dot path under matched activation
+    # dtypes — bracketed arms keep the production bf16 coverage
+    compute = ("float32" if serving_kwargs.get("block_native_attn")
+               else "bfloat16")
     cfg = ModelConfig(num_layers=2, hidden_size=hidden,
                       num_attention_heads=2, num_kv_heads=1,
                       vocab_size=128, seq_length=128,
                       max_position_embeddings=128,
                       make_vocab_size_divisible_by=64,
-                      compute_dtype="bfloat16").derived()
+                      compute_dtype=compute).derived()
     params = lm.model_init(jax.random.PRNGKey(0), cfg)
     # eos_id=-1: no early EOS, deterministic request lifetimes
     gen = Generator(params, cfg, eos_id=-1, pad_id=0)
@@ -112,7 +119,8 @@ def kill_drill(new_tokens: int) -> dict:
 
     router, engines, gen = _tiny_router(dict(
         num_slots=2, max_queue=64, max_len=128,
-        enable_prefix_cache=True, kv_block_size=16))
+        enable_prefix_cache=True, kv_block_size=16,
+        block_native_attn=True))
     sampling = SamplingOptions(temperature=0.0)
     want = _serial_oracle(gen)
     try:
@@ -227,7 +235,8 @@ def host_tier_drill(new_tokens: int) -> dict:
 
     router, engines, gen = _tiny_router(dict(
         num_slots=2, max_queue=32, max_len=128,
-        enable_prefix_cache=True, kv_block_size=16, retained_slots=1,
+        enable_prefix_cache=True, kv_block_size=16, block_native_attn=True,
+        retained_slots=1,
         host_kv_bytes=1 << 22))
     sampling = SamplingOptions(temperature=0.0)
     want = _serial_oracle(gen)

@@ -37,6 +37,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from megatron_tpu.utils.platform import ensure_env_platform
 
 
+def _pool_mode(block, kernel) -> dict:
+    """Serving kwargs for the drilled pool layout. Block mode IS the
+    production configuration (docs/serving.md pool-capability matrix),
+    so the default drills run with kv_block_size set — and with the
+    block-native attention kernel where legal — instead of only ever
+    chaos-testing the whole-region layout."""
+    kw = {}
+    if block:
+        kw["kv_block_size"] = int(block)
+        if kernel:
+            kw["block_native_attn"] = True
+    return kw
+
+
 def _tiny_engine(serving_kwargs, hidden=64):
     import jax
 
@@ -45,12 +59,21 @@ def _tiny_engine(serving_kwargs, hidden=64):
     from megatron_tpu.models import language_model as lm
     from megatron_tpu.serving import ServingEngine
 
+    # bf16 activations (the production numeric path) EXCEPT when the
+    # block-native kernel is drilled: the drills pin engine outputs
+    # token-exact vs the serial oracle, and the kernel's fp32 online
+    # softmax only matches the oracle's dot path under matched
+    # activation dtypes (bf16 rounds the dot path's scores — a flipped
+    # greedy token there is numerics, not a bug). Bracketed /
+    # whole-region arms keep their bf16 coverage.
+    compute = ("float32" if serving_kwargs.get("block_native_attn")
+               else "bfloat16")
     cfg = ModelConfig(num_layers=2, hidden_size=hidden,
                       num_attention_heads=2, num_kv_heads=1,
                       vocab_size=128, seq_length=128,
                       max_position_embeddings=128,
                       make_vocab_size_divisible_by=64,
-                      compute_dtype="bfloat16").derived()
+                      compute_dtype=compute).derived()
     params = lm.model_init(jax.random.PRNGKey(0), cfg)
     # eos_id=-1: no early EOS, so request lifetimes (and the overload
     # backlog) are deterministic in max_new_tokens
@@ -81,7 +104,8 @@ def _resolve_all(reqs, timeout=120.0):
     return out
 
 
-def overload_drill(new_tokens: int, spec_k: int = 0) -> dict:
+def overload_drill(new_tokens: int, spec_k: int = 0,
+                   pool_kwargs=None) -> dict:
     """Offered load >> slot capacity with priorities, early shedding,
     preemption, one NaN-poisoned slot — and speculative decoding when
     spec_k > 0. Contract: every submitted future resolves; sheds fail
@@ -98,7 +122,7 @@ def overload_drill(new_tokens: int, spec_k: int = 0) -> dict:
     eng, gen = _tiny_engine(dict(
         num_slots=2, max_queue=64, max_len=128, priority_levels=2,
         shed_on_overload=True, preemption=True, max_engine_restarts=2,
-        speculative_k=spec_k))
+        speculative_k=spec_k, **(pool_kwargs or {})))
     # greedy: seed-independent, so the exactness oracle is one serial
     # generate per (prompt, n) — preemption/speculation must not move
     # a single token
@@ -190,8 +214,8 @@ def overload_drill(new_tokens: int, spec_k: int = 0) -> dict:
     }
 
 
-def hang_drill(timeout_s: float, stall_s: float,
-               spec_k: int = 0) -> dict:
+def hang_drill(timeout_s: float, stall_s: float, spec_k: int = 0,
+               pool_kwargs=None) -> dict:
     """A wedged decode iteration: the watchdog must fail the in-flight
     futures within its deadline and the supervisor must restart the
     loop once the stalled dispatch returns — measured as the wall time
@@ -206,7 +230,7 @@ def hang_drill(timeout_s: float, stall_s: float,
     eng, gen = _tiny_engine(dict(
         num_slots=1, max_queue=16, max_len=128,
         engine_step_timeout_s=timeout_s, max_engine_restarts=2,
-        speculative_k=spec_k))
+        speculative_k=spec_k, **(pool_kwargs or {})))
     sampling = SamplingOptions(temperature=0.0)
     try:
         # warmup: compiles done AND the watchdog armed (it arms only
@@ -256,7 +280,7 @@ def hang_drill(timeout_s: float, stall_s: float,
     }
 
 
-def crash_loop_drill(spec_k: int = 0) -> dict:
+def crash_loop_drill(spec_k: int = 0, pool_kwargs=None) -> dict:
     """Every step crashes: the supervisor restarts max_engine_restarts
     times, then trips the circuit breaker. Everything in flight or
     queued resolves with a typed error, health() reports unhealthy,
@@ -269,7 +293,7 @@ def crash_loop_drill(spec_k: int = 0) -> dict:
 
     eng, _ = _tiny_engine(dict(
         num_slots=1, max_queue=16, max_len=128, max_engine_restarts=1,
-        speculative_k=spec_k))
+        speculative_k=spec_k, **(pool_kwargs or {})))
     sampling = SamplingOptions(temperature=1.0)
     try:
         eng.generate([1, 2], 2, sampling, seed=0)  # warmup
@@ -303,11 +327,13 @@ def crash_loop_drill(spec_k: int = 0) -> dict:
 
 
 def run_chaos(new_tokens: int, timeout_s: float, stall_s: float,
-              spec_k: int = 0) -> dict:
+              spec_k: int = 0, block: int = 16,
+              block_native: bool = True) -> dict:
     t0 = time.monotonic()
-    overload = overload_drill(new_tokens, spec_k)
-    hang = hang_drill(timeout_s, stall_s, spec_k)
-    crash = crash_loop_drill(spec_k)
+    pool_kwargs = _pool_mode(block, block_native)
+    overload = overload_drill(new_tokens, spec_k, pool_kwargs)
+    hang = hang_drill(timeout_s, stall_s, spec_k, pool_kwargs)
+    crash = crash_loop_drill(spec_k, pool_kwargs)
     wall_s = time.monotonic() - t0
     ok = overload["ok"] and hang["ok"] and crash["ok"]
     return {
@@ -318,6 +344,8 @@ def run_chaos(new_tokens: int, timeout_s: float, stall_s: float,
         "vs_baseline": None,
         "completed": ok,
         "speculative_k": spec_k,
+        "kv_block_size": block or None,
+        "block_native_attn": bool(block and block_native),
         "overload": overload,
         "hang": hang,
         "crash_loop": crash,
@@ -342,6 +370,15 @@ def main(argv=None) -> int:
                          "watchdog-hang must drop uncommitted draft "
                          "state cleanly — resumed requests token-exact, "
                          "no stranded futures")
+    ap.add_argument("--kv_block_size", type=int, default=16,
+                    help="run every drill on the BLOCK-granular pool "
+                         "at this block size — the production layout "
+                         "gets the chaos coverage, not only the "
+                         "whole-region fallback (0 = whole-region)")
+    ap.add_argument("--no_block_native", action="store_true",
+                    help="keep the resolve/scatter bracket instead of "
+                         "the block-native attention kernel (the "
+                         "kernel is on by default wherever legal)")
     ap.add_argument("--out", type=str, default=None,
                     help="also write the JSON record here")
     args = ap.parse_args(argv)
@@ -351,7 +388,8 @@ def main(argv=None) -> int:
         args.new_tokens, args.watchdog_s, args.stall_s = 16, 1.0, 2.5
 
     record = run_chaos(args.new_tokens, args.watchdog_s, args.stall_s,
-                       args.speculative_k)
+                       args.speculative_k, args.kv_block_size,
+                       not args.no_block_native)
     line = json.dumps(record)
     print(line, flush=True)
     if args.out:
