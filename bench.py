@@ -368,6 +368,13 @@ def _run_extras():
         # bracket-removal claim (B in {16,64,256} x bf16/int8)
         ("bench_block_attn.py", ["--smoke"],
          "/tmp/bench_extras_block_attn.log"),
+        # multi-tenant LoRA adapter A/B (PERF_NOTES queue item 9):
+        # base vs one-adapter vs mixed-8 decode on the slot grid —
+        # every row token-exact vs its own adapter's merged-weights
+        # serial oracle, one decode compile per arm; ON CHIP the
+        # record is the mixed-arm tok/s ratio judged against the
+        # adapter-gather bytes/step the tool reports
+        ("bench_lora.py", ["--smoke"], "/tmp/bench_extras_lora.log"),
         # resilience smoke: scripted chaos run (transient write fault +
         # NaN-streak rollback + corrupt-checkpoint fallback) — the
         # recovery-latency record makes regressions in the resilience

@@ -137,6 +137,26 @@ def main(argv=None):
     assert train_it is not None, "--data_path produced no training data"
     restore_data_state(train_it, data_state)
 
+    if getattr(args, "lora_rank", 0):
+        # LoRA finetune: train ONLY the low-rank adapter factors with
+        # the (possibly checkpoint-loaded) base frozen, then export the
+        # versioned .npz the serving bank loads (--adapter_slots /
+        # ServingEngine.register_adapter) — the training side feeding
+        # the serving side end to end (training/lora.py).
+        from megatron_tpu.training.lora import run_lora_finetune
+        export = args.lora_export or (
+            f"{cfg.training.checkpoint_dir}/adapter.npz"
+            if cfg.training.checkpoint_dir else "adapter.npz")
+        _, last_loss = run_lora_finetune(
+            cfg, state.params, train_it, rank=args.lora_rank,
+            alpha=args.lora_alpha, iters=cfg.training.train_iters,
+            lr=cfg.optimizer.lr, seed=cfg.training.seed,
+            export_path=export,
+            log_interval=cfg.training.log_interval)
+        print_rank_0(f"lora finetune done: final loss {last_loss:.4f}, "
+                     f"adapter at {export}")
+        return 0
+
     save_fn = None
     if cfg.training.checkpoint_dir:
         def save_fn(st, iteration, consumed_samples, data_state=None,

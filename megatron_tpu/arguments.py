@@ -349,6 +349,35 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                         "via device_put on a later hit; needs "
                         "--enable_prefix_cache + --kv_block_size "
                         "(0 disables)")
+    g.add_argument("--adapter_slots", type=int, default=0,
+                   help="serving: device-resident LoRA adapters "
+                        "servable concurrently (multi-tenant serving, "
+                        "docs/serving.md 'Multi-tenant LoRA serving') "
+                        "— a per-slot adapter index selects each "
+                        "request's A/B factors from a stacked bank "
+                        "inside the one compiled decode step; 0 "
+                        "disables (bit-identical engine)")
+    g.add_argument("--adapter_rank", type=int, default=8,
+                   help="serving: LoRA rank the adapter bank "
+                        "allocates for (smaller exported ranks "
+                        "zero-pad up; larger are rejected)")
+    g.add_argument("--adapter_host_bytes", type=int, default=0,
+                   help="serving: host-RAM overflow budget for "
+                        "adapters evicted from a full bank "
+                        "(checksum-verified on restore; a corrupt "
+                        "copy reloads from disk — never wrong "
+                        "weights; 0 = evictions drop to disk reload)")
+    g.add_argument("--lora_rank", type=int, default=0,
+                   help="finetune: train ONLY LoRA low-rank adapter "
+                        "factors at this rank (base frozen) and "
+                        "export them for the serving adapter bank "
+                        "(0 = normal full finetune)")
+    g.add_argument("--lora_alpha", type=float, default=16.0,
+                   help="finetune: LoRA alpha — the delta scales by "
+                        "alpha/rank (folded at serving load)")
+    g.add_argument("--lora_export", type=str, default=None,
+                   help="finetune: path for the trained adapter .npz "
+                        "(default <save>/adapter.npz)")
 
     g = p.add_argument_group(
         "reference compat",
@@ -635,7 +664,10 @@ def config_from_args(args: argparse.Namespace,
             engine_step_timeout_s=args.engine_step_timeout_s,
             num_replicas=args.num_replicas,
             router_max_retries=args.router_max_retries,
-            host_kv_bytes=args.host_kv_bytes),
+            host_kv_bytes=args.host_kv_bytes,
+            adapter_slots=args.adapter_slots,
+            adapter_rank=args.adapter_rank,
+            adapter_host_bytes=args.adapter_host_bytes),
         resilience=ResilienceConfig(**{
             **_pick(args, ResilienceConfig),
             "checkpoint_integrity": not args.no_checkpoint_integrity}),

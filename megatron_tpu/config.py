@@ -553,6 +553,35 @@ class ServingConfig:
     # SSE stream registry TTL: a finished stream's request (and its
     # committed tokens) stays resumable via Last-Event-ID for this long
     stream_ttl_s: float = 600.0
+    # --- multi-tenant LoRA serving (docs/serving.md "Multi-tenant
+    # LoRA serving"; serving/adapters.py) ------------------------------
+    # device-resident LoRA adapters servable concurrently: the engine
+    # allocates a stacked per-layer A/B factor bank of this many rows
+    # (plus the reserved identity row 0 — base-model requests ride the
+    # same trace with a zero delta) and a per-slot adapter_idx carried
+    # next to the KV block map. Indices are data: decode / speculative
+    # verify / prefill keep ONE compile each with adapters on, and 0
+    # (off) compiles bit-identically to the adapterless engine
+    # (test-pinned). Works on every pool flavor — bf16/f32/int8,
+    # block/whole-region, rolling — because the low-rank delta rides
+    # the q/k/v/o projections, orthogonal to KV layout.
+    adapter_slots: int = 0
+    # LoRA rank the bank allocates for (static shape). Adapters
+    # exported at a smaller rank zero-pad up (same delta); a larger
+    # rank is rejected at registration.
+    adapter_rank: int = 8
+    # host-RAM overflow budget for evicted adapters (bytes): loading
+    # adapter N+1 into a full bank demotes the LRU unpinned adapter to
+    # a checksummed host copy instead of failing; restore verifies the
+    # checksum and a corrupt demotion degrades to a reload of the
+    # adapter's .npz — a miss, never wrong weights. 0 = evictions drop
+    # the device copy (misses reload from disk).
+    adapter_host_bytes: int = 0
+    # optional hard ceiling on the device bank's bytes — reject a
+    # (slots, rank) combination that would silently eat the KV pool's
+    # HBM at validate time instead of OOMing at engine construction.
+    # None = no check.
+    adapter_max_bank_bytes: Optional[int] = None
 
     def validate(self, model: Optional["ModelConfig"] = None
                  ) -> "ServingConfig":
@@ -709,6 +738,55 @@ class ServingConfig:
         assert not (self.num_replicas > 1 and self.serial_fallback), (
             "num_replicas > 1 routes through the continuous-batching "
             "engine; serial_fallback has no replicas to route over")
+        # --- multi-tenant LoRA serving (serving/adapters.py) ----------
+        assert self.adapter_slots >= 0, self.adapter_slots
+        assert self.adapter_host_bytes >= 0, self.adapter_host_bytes
+        if self.adapter_slots:
+            assert self.adapter_rank >= 1, (
+                f"adapter_slots={self.adapter_slots} requires "
+                f"adapter_rank >= 1 (got {self.adapter_rank}): a "
+                "rank-0 bank holds no delta at all — disable adapters "
+                "(adapter_slots=0) or pick a positive rank")
+            assert not self.serial_fallback, (
+                "adapter_slots > 0 requires the continuous-batching "
+                "engine: the serial fallback path threads no adapter "
+                "bank, so adapter requests would silently decode the "
+                "BASE model. Drop serial_fallback or adapter_slots.")
+            if model is not None:
+                # the exactness contract (engine == merged-weights
+                # serial oracle) requires the projection be LINEAR in
+                # the weights: quantize(W)·x + A·B·x differs from
+                # quantize(W + A·B)·x because the int8 quantizer is
+                # not linear — per-tenant outputs would silently drift
+                # from any merged reference. int8 KV pools
+                # (kv_dtype="int8") stay fully supported: the cache
+                # quantizes the adapted k/v like any other values.
+                assert model.quantized_gemm == "none", (
+                    "adapter_slots > 0 is unsupported with "
+                    "quantized_gemm='int8': the low-rank delta rides "
+                    "OUTSIDE the quantized projection, so factored "
+                    "serving and a merged-weights reference are not "
+                    "token-equivalent (the quantizer is nonlinear). "
+                    "Serve adapters with fp GEMMs — int8 KV pools "
+                    "(kv_dtype='int8') and int8-resident base WEIGHTS "
+                    "via quantize_weights remain available.")
+            if self.adapter_max_bank_bytes is not None \
+                    and model is not None:
+                from megatron_tpu.serving.adapters import \
+                    adapter_bank_nbytes
+                need = adapter_bank_nbytes(model, self.adapter_slots,
+                                           self.adapter_rank)
+                assert need <= self.adapter_max_bank_bytes, (
+                    f"adapter bank of {self.adapter_slots} slots at "
+                    f"rank {self.adapter_rank} needs {need} device "
+                    f"bytes, exceeding adapter_max_bank_bytes="
+                    f"{self.adapter_max_bank_bytes}: lower the slot "
+                    "count or rank, or raise the budget")
+        else:
+            assert self.adapter_host_bytes == 0, (
+                "adapter_host_bytes > 0 without adapter_slots: there "
+                "is no bank to overflow — set adapter_slots or drop "
+                "the host budget")
         if self.max_len is not None:
             assert self.max_len >= 1
             if model is not None and model.max_position_embeddings:

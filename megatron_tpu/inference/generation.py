@@ -121,7 +121,7 @@ def init_kv_caches(cfg: ModelConfig, batch: int, max_len: int,
 
 
 def prefill_chunk(params, tokens, caches, cfg: ModelConfig, *, rope,
-                  last_idx, next_offset):
+                  last_idx, next_offset, adapters=None):
     """Forward one [1, s] prompt chunk through a batch-1 cache at the
     cache's CURRENT offset and return (caches, last_logits_row).
 
@@ -143,7 +143,8 @@ def prefill_chunk(params, tokens, caches, cfg: ModelConfig, *, rope,
     insert_prefill already rely on for the final pads."""
     logits, caches = lm.model_forward(params, tokens, cfg,
                                       kv_caches=caches, rope=rope,
-                                      logits_dtype=jnp.float32)
+                                      logits_dtype=jnp.float32,
+                                      adapters=adapters)
     last = jax.lax.dynamic_slice_in_dim(logits[0], last_idx, 1,
                                         axis=0)[0]
     caches = caches._replace(offset=jnp.full_like(
@@ -152,7 +153,7 @@ def prefill_chunk(params, tokens, caches, cfg: ModelConfig, *, rope,
 
 
 def verify_tokens(params, tokens, caches, cfg: ModelConfig, *, rope,
-                  lengths, max_len: int):
+                  lengths, max_len: int, adapters=None):
     """Forward a [slots, w]-token window through the slot-grid cache at
     per-row offsets `lengths` and return (logits [slots, w, Vp], caches).
 
@@ -186,7 +187,8 @@ def verify_tokens(params, tokens, caches, cfg: ModelConfig, *, rope,
     logits, caches = lm.model_forward(params, tokens, cfg,
                                       kv_caches=caches,
                                       position_ids=positions, rope=rope,
-                                      logits_dtype=jnp.float32)
+                                      logits_dtype=jnp.float32,
+                                      adapters=adapters)
     return logits, caches
 
 

@@ -130,6 +130,14 @@ def validate_generate_payload(payload) -> Optional[str]:
     if payload.get("beam_width") and len(prompts) > 1:
         # (ref: beam-search rejects multi-prompt requests)
         return "With beam_search only one prompt is allowed"
+    aid = payload.get("adapter_id")
+    if aid is not None and not isinstance(aid, (str, int)):
+        # multi-tenant LoRA serving: the id is an opaque registry key
+        # (unknown ids 400 at submit via UnknownAdapterError)
+        return "adapter_id must be a string or integer"
+    if aid is not None and payload.get("beam_width"):
+        return "beam search runs the serial path; adapters require " \
+               "the serving engine"
     return None
 
 
@@ -252,6 +260,14 @@ class MegatronServer:
                 return 200, self._handle_beam(payload)
             if self.engine is not None and not payload.get("serial"):
                 return 200, self._handle_engine(payload)
+            if payload.get("adapter_id") is not None:
+                # the serial path threads no adapter bank — silently
+                # decoding the BASE model would be wrong output, not a
+                # degraded mode
+                return 400, {"message":
+                             "adapter_id requires the serving-engine "
+                             "path (drop 'serial': true / "
+                             "serial_fallback)"}
             return 200, self._handle_serial(payload)
         except EngineUnhealthyError as e:
             # crash-loop circuit breaker open: this replica cannot
@@ -435,7 +451,8 @@ class MegatronServer:
                     try:
                         reqs[i] = self.engine.submit(
                             ids, n, sampling, seed=seed + i,
-                            priority=priority, deadline_s=deadline_s)
+                            priority=priority, deadline_s=deadline_s,
+                            adapter_id=payload.get("adapter_id"))
                         pending.append(i)
                         break
                     except OverloadShedError:
@@ -594,7 +611,8 @@ class MegatronServer:
             prompt_ids[0], int(payload.get("tokens_to_generate", 64)),
             sampling, seed=self._seed_for(payload),
             priority=int(payload.get("priority", 0) or 0),
-            deadline_s=None if deadline_s is None else float(deadline_s))
+            deadline_s=None if deadline_s is None else float(deadline_s),
+            adapter_id=payload.get("adapter_id"))
         sid = secrets.token_hex(8)
         entry = _StreamEntry(sid, req)
         with self._streams_lock:

@@ -75,6 +75,37 @@ class KVCache(NamedTuple):
         )
 
 
+class LoraAdapter(NamedTuple):
+    """Batched low-rank (LoRA) adapter factors for the q/k/v/o
+    projections — the model-facing half of multi-tenant adapter serving
+    (serving/adapters.py AdapterBank; S-LoRA / Punica, PAPERS.md).
+
+    Two shapes flow through the same type:
+      - STACKED (what the bank holds and stack_apply scans): every leaf
+        carries a leading 'layers' dim — [L, n, h, r] for the A factors,
+        [L, n, r, out] for the B factors — so the stack scan slices one
+        layer's [n, ...] bank per step exactly like it slices the KV
+        caches;
+      - PER-LAYER (what attention_apply consumes inside the scan):
+        [n, h, r] / [n, r, out].
+
+    `n` is the bank capacity (adapter slots + 1); ROW 0 IS THE IDENTITY
+    adapter (all-zero factors), so base-model requests ride the same
+    batched gather + matmul trace with a zero delta — adapter indices
+    are DATA, like the KV block map, and the decode/verify/prefill
+    programs keep one compile each. Scaling (alpha / rank) is folded
+    into the B factors at load time, so apply-time math is just
+    x @ A[idx] @ B[idx] added to the base projection."""
+    aq: jax.Array  # [.., n, h, r]
+    bq: jax.Array  # [.., n, r, nq*hd]
+    ak: jax.Array  # [.., n, h, r]
+    bk: jax.Array  # [.., n, r, nkv*hd]
+    av: jax.Array  # [.., n, h, r]
+    bv: jax.Array  # [.., n, r, nkv*hd]
+    ao: jax.Array  # [.., n, nq*hd, r]
+    bo: jax.Array  # [.., n, r, h]
+
+
 class BlockKVCache(NamedTuple):
     """Block-NATIVE serving cache: the flat block arena plus the
     per-slot block map, consumed directly by the Pallas block-native
@@ -272,6 +303,7 @@ def attention_apply(
     causal: bool = True,
     kv_input=None,
     cp_pre_zigzag: bool = False,
+    adapters=None,
 ):
     """Forward pass. x: [b, s, h]. Returns (out [b, s, h], new_kv_cache).
 
@@ -279,7 +311,16 @@ def attention_apply(
     ref: megatron/model/transformer.py AttnMaskType.padding).
     `kv_input` switches to CROSS-attention: keys/values projected from the
     encoder output, no rotary on k (ref: transformer.py:664-683 decoder
-    cross-attention)."""
+    cross-attention).
+
+    `adapters`: optional (LoraAdapter per-layer bank, adapter_idx [b])
+    pair — the multi-tenant LoRA path (serving/adapters.py). Each batch
+    row gathers its own adapter's A/B factors from the bank (one take
+    per factor) and adds the low-rank delta x @ A[idx] @ B[idx] to the
+    q/k/v/o projections — the Punica batched-gather-grouped-matmul
+    shape, with row 0 the identity (zero) adapter so base rows ride the
+    same trace. Indices are data: adapters on keeps one compile per
+    program; adapters=None compiles to exactly today's graph."""
     b, s, h = x.shape
     hd = cfg.kv_channels
     nq = cfg.num_attention_heads
@@ -287,15 +328,41 @@ def attention_apply(
     dtype = x.dtype
     cross = kv_input is not None
 
+    lw = aidx = None
+    if adapters is not None:
+        lw, aidx = adapters
+        assert not cross, (
+            "LoRA adapters apply to causal self-attention projections "
+            "only (the serving slot grid); cross-attention has no "
+            "adapter path")
+
+    def _lora(inp, a, bmat):
+        """Per-row low-rank delta: inp [b, s, d_in] -> [b, s, d_out]
+        through each row's gathered [d_in, r] / [r, d_out] factors.
+        Scaling (alpha/r) is pre-folded into bmat at bank-load time."""
+        at = jnp.take(a, aidx, axis=0).astype(dtype)      # [b, d_in, r]
+        bt = jnp.take(bmat, aidx, axis=0).astype(dtype)   # [b, r, d_out]
+        t = jnp.einsum("bsd,bdr->bsr", inp.astype(dtype), at)
+        return jnp.einsum("bsr,brd->bsd", t, bt)
+
     q = qdense(x, wcast(params["wq"], dtype), cfg.quantized_gemm)
     kv = qdense(kv_input if cross else x, wcast(params["wkv"], dtype),
                 cfg.quantized_gemm)
     if cfg.use_bias:
         q = q + params["bq"].astype(dtype)
         kv = kv + params["bkv"].astype(dtype)
+    if lw is not None:
+        # deltas join BEFORE the head reshape (and therefore before
+        # rope): (W + A·B) @ x semantics, the merged-weights oracle the
+        # exactness tests pin against
+        q = q + _lora(x, lw.aq, lw.bq)
     q = q.reshape(b, s, nq, hd)
     kv = kv.reshape(b, kv.shape[1], 2, nkv, hd)
     k, v = kv[:, :, 0], kv[:, :, 1]
+    if lw is not None:
+        t = kv.shape[1]
+        k = k + _lora(x, lw.ak, lw.bk).reshape(b, t, nkv, hd)
+        v = v + _lora(x, lw.av, lw.bv).reshape(b, t, nkv, hd)
 
     q_offset = None
     per_slot = False
@@ -357,7 +424,10 @@ def attention_apply(
         out, kv_cache = _block_native_update_attend(
             q, k, v, kv_cache, scale=1.0 / math.sqrt(hd), dtype=dtype)
         out = out.reshape(b, s, nq * hd)
-        out = qdense(out, wcast(params["wo"], dtype), cfg.quantized_gemm)
+        proj = qdense(out, wcast(params["wo"], dtype), cfg.quantized_gemm)
+        if lw is not None:
+            proj = proj + _lora(out, lw.ao, lw.bo)
+        out = proj
         if cfg.use_bias:
             out = out + params["bo"].astype(dtype)
         return out, kv_cache
@@ -634,7 +704,10 @@ def attention_apply(
             kv_positions=kv_positions)
 
     out = out.reshape(b, s, nq * hd)
-    out = qdense(out, wcast(params["wo"], dtype), cfg.quantized_gemm)
+    proj = qdense(out, wcast(params["wo"], dtype), cfg.quantized_gemm)
+    if lw is not None:
+        proj = proj + _lora(out, lw.ao, lw.bo)
+    out = proj
     if cfg.use_bias:
         out = out + params["bo"].astype(dtype)
     return out, kv_cache

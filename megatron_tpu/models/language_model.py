@@ -98,6 +98,7 @@ def model_forward(
     segment_ids=None,
     cp_pre_zigzag: bool = False,
     return_aux: bool = False,
+    adapters=None,
 ):
     """Forward to logits [b, s, padded_vocab]. Returns (logits, kv_caches),
     or (logits, kv_caches, moe_aux) with `return_aux=True` (loss_fn uses
@@ -105,7 +106,11 @@ def model_forward(
 
     `cp_pre_zigzag`: the caller pre-permuted tokens/positions into the
     ring-cp zigzag order (see loss_fn / parallel/ring_attention.py
-    data_zigzag_cp) — logits come back in the SAME permuted order."""
+    data_zigzag_cp) — logits come back in the SAME permuted order.
+
+    `adapters`: (stacked LoraAdapter bank, adapter_idx [b]) — per-row
+    low-rank deltas on the attention projections (multi-tenant LoRA
+    serving / LoRA finetuning; models/attention.py)."""
     from megatron_tpu.config import as_dtype
     compute_dtype = as_dtype(cfg.compute_dtype)
     emb = params["embedding"]["word_embeddings"]
@@ -138,7 +143,7 @@ def model_forward(
         rope_sin=rope.sin if rope else None,
         position_ids=position_ids, kv_caches=kv_caches,
         rng=rng, deterministic=deterministic, segment_ids=segment_ids,
-        cp_pre_zigzag=cp_pre_zigzag)
+        cp_pre_zigzag=cp_pre_zigzag, adapters=adapters)
 
     # final norm + SP gather + vocab-parallel head: ONE implementation
     # shared with both pp schedules (head_logits below)
@@ -184,9 +189,14 @@ def loss_fn(
     deterministic: bool = True,
     position_ids=None,
     segment_ids=None,
+    adapters=None,
 ):
     """Causal LM loss: mean CE over unmasked positions
-    (ref: finetune.py:83 loss_func — masked mean)."""
+    (ref: finetune.py:83 loss_func — masked mean).
+
+    `adapters` threads a LoRA factor bank + per-row index into the
+    forward (training/lora.py differentiates wrt the factors with the
+    base frozen — the train-side of multi-tenant adapter serving)."""
     if isinstance(tokens, tuple):
         inputs, labels = tokens
     else:
@@ -220,7 +230,7 @@ def loss_fn(
                                    position_ids=position_ids,
                                    segment_ids=segment_ids,
                                    cp_pre_zigzag=pre_zigzag,
-                                   return_aux=True)
+                                   return_aux=True, adapters=adapters)
     losses = cross_entropy_loss(logits, labels, vocab_size=cfg.vocab_size)
     # MoE router load-balancing loss (0 for dense stacks)
     aux_term = cfg.moe_aux_loss_coeff * aux if cfg.num_experts > 1 else 0.0

@@ -122,9 +122,14 @@ def layer_apply(
     causal: bool = True,
     encoder_output=None,
     cp_pre_zigzag: bool = False,
+    adapters=None,
 ):
     """One transformer layer. x: [b, s, h]. Returns (x, kv_cache, aux) —
     `aux` is the MoE router's load-balancing loss (0.0 for dense MLPs).
+
+    `adapters`: (per-layer LoraAdapter bank, adapter_idx [b]) for the
+    SELF-attention projections only (multi-tenant LoRA serving —
+    models/attention.py; cross-attention has no adapter path).
 
     `encoder_output` enables the decoder cross-attention sublayer between
     self-attention and the MLP (ref: transformer.py:782-794).
@@ -175,7 +180,7 @@ def layer_apply(
         kv_cache=kv_cache, layer_number=layer_number,
         dropout_rng=r_score, deterministic=deterministic,
         segment_ids=segment_ids, causal=causal,
-        cp_pre_zigzag=cp_pre_zigzag)
+        cp_pre_zigzag=cp_pre_zigzag, adapters=adapters)
 
     if cfg.parallel_attn:
         # Falcon block: no dropout-add after attention
@@ -262,6 +267,7 @@ def stack_apply(
     causal: bool = True,
     encoder_output=None,
     cp_pre_zigzag: bool = False,
+    adapters=None,
 ):
     """Apply all (or a pipeline stage's worth of) layers via lax.scan.
 
@@ -270,7 +276,14 @@ def stack_apply(
     cfg.moe_aux_loss_coeff).
 
     `layer_offset` preserves layer_number-dependent behavior across pipeline
-    stages (ref: transformer.py:1014-1044 layer offsets for vpp)."""
+    stages (ref: transformer.py:1014-1044 layer offsets for vpp).
+
+    `adapters`: (STACKED LoraAdapter with a leading 'layers' dim,
+    adapter_idx [b]) — the factor bank rides the scan like the KV
+    caches (each step slices one layer's [n, ...] bank), the per-row
+    index is layer-invariant and closes over the body. None compiles to
+    exactly today's graph (multi-tenant LoRA serving,
+    models/attention.py)."""
     num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
     drop_rates = lima_dropout_rates(cfg, cfg.num_layers)
     drop_rates = jax.lax.dynamic_slice_in_dim(drop_rates, layer_offset, num_layers)
@@ -278,10 +291,14 @@ def stack_apply(
         drop_path_rates(cfg, cfg.num_layers), layer_offset, num_layers)
     use_drop_path = cfg.drop_path_rate > 0.0
     layer_ids = layer_offset + jnp.arange(num_layers)
+    # the stacked factor bank scans with the params/caches; the per-row
+    # adapter index is the same for every layer and closes over the body
+    lora_stack, adapter_idx = (adapters if adapters is not None
+                               else (None, None))
 
     def body(carry, scanned):
         h, aux_sum = carry
-        p, rate, dp_rate, lid, cache = scanned
+        p, rate, dp_rate, lid, cache, lw = scanned
         layer_rng = None
         if rng is not None and not deterministic:
             layer_rng = jax.random.fold_in(rng, lid)
@@ -293,7 +310,8 @@ def stack_apply(
             rng=layer_rng,
             deterministic=deterministic, segment_ids=segment_ids,
             causal=causal, encoder_output=encoder_output,
-            cp_pre_zigzag=cp_pre_zigzag)
+            cp_pre_zigzag=cp_pre_zigzag,
+            adapters=(lw, adapter_idx) if lw is not None else None)
         return (h, aux_sum + aux), new_cache
 
     if cfg.recompute_granularity == "full":
@@ -306,15 +324,18 @@ def stack_apply(
             prevent_cse=False)
 
     aux0 = jnp.zeros((), jnp.float32)
-    xs = (stacked_params, drop_rates, dp_rates, layer_ids, kv_caches)
+    # None entries are empty pytrees: scan passes them through untouched
+    # (the no-adapters / no-cache cases scan the same body shape)
+    xs = (stacked_params, drop_rates, dp_rates, layer_ids, kv_caches,
+          lora_stack)
     if kv_caches is None:
         def body_nocache(carry, scanned):
-            p, rate, dp_rate, lid = scanned
-            c, _ = body(carry, (p, rate, dp_rate, lid, None))
+            p, rate, dp_rate, lid, lw = scanned
+            c, _ = body(carry, (p, rate, dp_rate, lid, None, lw))
             return c, None
         (x, aux), _ = jax.lax.scan(body_nocache, (x, aux0),
                                    (stacked_params, drop_rates, dp_rates,
-                                    layer_ids))
+                                    layer_ids, lora_stack))
         return x, None, aux
     (x, aux), new_caches = jax.lax.scan(body, (x, aux0), xs)
     return x, new_caches, aux

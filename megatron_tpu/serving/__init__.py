@@ -1,5 +1,8 @@
 """Continuous-batching serving subsystem (new layer between the
 generator and the HTTP front end — see docs/serving.md)."""
+from megatron_tpu.serving.adapters import (  # noqa: F401
+    AdapterBank, AdapterBankFullError, UnknownAdapterError,
+    adapter_bank_nbytes, load_adapter_npz)
 from megatron_tpu.serving.engine import (  # noqa: F401
     EngineHungError, ServingEngine)
 from megatron_tpu.serving.host_tier import HostKVTier  # noqa: F401

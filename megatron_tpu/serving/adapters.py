@@ -1,0 +1,553 @@
+"""Device-resident LoRA adapter bank for multi-tenant serving.
+
+The north star ("millions of users") never looks like one model — it
+looks like thousands of cheap fine-tuned variants of one base model on
+one grid. S-LoRA (Sheng et al., 2023) showed that batching
+heterogeneous low-rank adapters inside a single base-model forward is
+the unlock; Punica (Chen et al., 2023) showed the mechanism — a batched
+gather-grouped matmul keyed by a per-row adapter index. That is exactly
+the shape of this engine's per-slot, device-resident, dispatch-resolved
+index tables (the PR 9 KV block map), so the serving side is one more
+int32 per slot:
+
+- the BANK is a stacked `LoraAdapter` pytree (models/attention.py):
+  per-layer A/B factors for the q/k/v/o projections, `[L, n, h, r]` /
+  `[L, n, r, out]`, with ROW 0 the reserved IDENTITY (all-zero)
+  adapter so base-model requests ride the same trace with a zero
+  delta;
+- the per-slot `adapter_idx int32 [S]` rides next to the KV block map
+  as plain DATA — decode, speculative verify, and prefill keep ONE
+  compile each with adapters on, and `adapter_slots=0` compiles to
+  today's graph bit-identically (attention_apply's adapters=None
+  path adds no ops);
+- scaling (alpha / rank) is folded into the B factors at load time, and
+  adapters exported at a smaller rank zero-pad up to the bank's rank
+  (a zero-padded factor pair is numerically the same delta).
+
+Capacity management mirrors the prefix cache's retained-LRU plus the
+`HostKVTier` demote/restore/CRC discipline: loading adapter N+1 into a
+full bank DEMOTES the least-recently-used unpinned adapter (its device
+rows are gathered to host RAM with a checksum) rather than failing;
+restoring verifies the checksum, and a corrupt demotion degrades to a
+recompute-from-disk reload of the adapter's `.npz` — a miss, never
+wrong weights. Adapters pinned by running slots are never evicted;
+when every row is pinned `acquire` raises `AdapterBankFullError` and
+the engine simply requeues the request until a slot (and its pin)
+frees.
+
+Thread contract: `known`/`peek` may be called from HTTP threads (dict
+reads under the bank lock — the router's adapter-locality signal);
+`acquire`/`release`/`register`/`reset_pins` run on the engine thread.
+
+The `.npz` adapter format (written by training/lora.py
+`export_adapter`) is versioned: raw (unscaled, unpadded) factors
+`aq/bq/ak/bk/av/bv/ao/bo` each with a leading layers dim, plus
+`format_version`, `rank`, `alpha`, and an optional JSON `meta` blob.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import threading
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models.attention import LoraAdapter
+from megatron_tpu.serving.host_tier import _checksum
+from megatron_tpu.serving.scheduler import AdmissionError
+from megatron_tpu.utils.logging import print_rank_0
+
+ADAPTER_FORMAT_VERSION = 1
+
+FACTOR_NAMES = LoraAdapter._fields  # ("aq","bq","ak","bk","av","bv","ao","bo")
+
+
+class UnknownAdapterError(AdmissionError):
+    """Request named an adapter_id nothing registered — the HTTP layer
+    maps this to 400 (a typo'd adapter can never be served)."""
+
+
+class AdapterBankFullError(RuntimeError):
+    """Every non-identity bank row is pinned by a running slot: nothing
+    is evictable right now. The engine REQUEUES the request (a pin
+    frees when a slot finishes) instead of failing it."""
+
+
+def adapter_factor_shapes(cfg: ModelConfig, rank: int) -> Dict[str, tuple]:
+    """Per-adapter factor shapes (leading layers dim, no bank dim) —
+    the `.npz` export layout and the unit the bank zero-pads/folds."""
+    L = cfg.num_layers
+    h = cfg.hidden_size
+    dq = cfg.num_attention_heads * cfg.kv_channels
+    dkv = cfg.num_kv_heads * cfg.kv_channels
+    r = rank
+    return {
+        "aq": (L, h, r), "bq": (L, r, dq),
+        "ak": (L, h, r), "bk": (L, r, dkv),
+        "av": (L, h, r), "bv": (L, r, dkv),
+        "ao": (L, dq, r), "bo": (L, r, h),
+    }
+
+
+def adapter_bank_nbytes(cfg: ModelConfig, slots: int, rank: int,
+                        itemsize: int = 4) -> int:
+    """Device bytes the bank's stacked arrays will occupy (slots + the
+    identity row) — ServingConfig.validate sizes the budget check from
+    the same formula the bank allocates with."""
+    per = sum(int(np.prod(s))
+              for s in adapter_factor_shapes(cfg, rank).values())
+    return per * (slots + 1) * itemsize
+
+
+def load_adapter_npz(path: str):
+    """Read a versioned adapter export. Returns (factors dict of
+    float32 [L, ...] arrays, rank, alpha, meta dict)."""
+    with np.load(path, allow_pickle=False) as z:
+        version = int(z["format_version"])
+        if version > ADAPTER_FORMAT_VERSION:
+            raise ValueError(
+                f"adapter {path}: format_version={version} is newer "
+                f"than this build supports ({ADAPTER_FORMAT_VERSION})")
+        missing = [n for n in FACTOR_NAMES if n not in z]
+        if missing:
+            raise ValueError(f"adapter {path}: missing factors {missing}")
+        factors = {n: np.asarray(z[n], np.float32) for n in FACTOR_NAMES}
+        rank = int(z["rank"])
+        alpha = float(z["alpha"])
+        meta = json.loads(str(z["meta"])) if "meta" in z else {}
+    return factors, rank, alpha, meta
+
+
+def fold_factors(factors: Dict[str, np.ndarray], rank: int, alpha: float,
+                 cfg: ModelConfig, bank_rank: int) -> Dict[str, np.ndarray]:
+    """Validate raw factors against the model geometry, fold the
+    alpha/rank scale into the B factors, and zero-pad rank up to the
+    bank's (a padded pair is the same delta: the extra A columns meet
+    zero B rows). Raises ValueError on any mismatch — a wrong-shape
+    adapter must 400 at registration, never load garbage."""
+    if rank < 1:
+        raise ValueError(f"adapter rank {rank} must be >= 1")
+    if rank > bank_rank:
+        raise ValueError(
+            f"adapter rank {rank} exceeds the bank's adapter_rank="
+            f"{bank_rank}; rebuild the engine with a larger rank")
+    want = adapter_factor_shapes(cfg, rank)
+    scale = float(alpha) / float(rank)
+    out = {}
+    for name in FACTOR_NAMES:
+        a = np.asarray(factors[name], np.float32)
+        if a.shape != want[name]:
+            raise ValueError(
+                f"adapter factor {name}: shape {a.shape} != expected "
+                f"{want[name]} (model geometry or rank mismatch)")
+        if name.startswith("b"):
+            a = a * scale
+        else:
+            # ALWAYS copy: an aliased caller buffer stored as the
+            # bank's reload source would let later in-place mutation
+            # (e.g. continued training on the same numpy arrays)
+            # silently change the weights a post-eviction reload
+            # serves — no checksum would trip
+            a = np.array(a)
+        if rank < bank_rank:
+            pad = bank_rank - rank
+            # A factors pad the trailing rank dim, B factors the
+            # leading-after-layers rank dim
+            widths = ([(0, 0), (0, 0), (0, pad)] if name.startswith("a")
+                      else [(0, 0), (0, pad), (0, 0)])
+            a = np.pad(a, widths)
+        out[name] = np.ascontiguousarray(a)
+    return out
+
+
+def random_adapter_factors(cfg: ModelConfig, rank: int, seed: int,
+                           scale: float = 0.05) -> Dict[str, np.ndarray]:
+    """Random NONZERO raw factors — the shared builder for benches,
+    chaos drills, and tests (one copy so the scale that makes deltas
+    flip greedy tokens cannot drift between harnesses). Real adapters
+    come from training/lora.py, whose B factors start at zero."""
+    import jax.random as jrandom
+    shapes = adapter_factor_shapes(cfg, rank)
+    key = jrandom.PRNGKey(seed)
+    out = {}
+    for name, shape in sorted(shapes.items()):
+        key, k = jrandom.split(key)
+        out[name] = (np.asarray(jrandom.normal(k, shape))
+                     * scale).astype(np.float32)
+    return out
+
+
+class _HostAdapter:
+    """A demoted adapter's folded factors in host RAM, checksummed like
+    a HostKVTier entry — a corrupt demotion is a reload-from-disk miss,
+    never wrong weights."""
+
+    __slots__ = ("arrays", "crc", "nbytes")
+
+    def __init__(self, arrays: Dict[str, np.ndarray]):
+        self.arrays = arrays
+        self.crc = _checksum(arrays)
+        self.nbytes = int(sum(a.nbytes for a in arrays.values()))
+
+
+class AdapterBank:
+    """Up to `slots` LoRA adapters resident on device (plus the
+    identity row 0), LRU-managed with checksummed host-RAM overflow.
+
+    `stacked` is the live LoraAdapter pytree the engine passes into its
+    compiled programs every dispatch — replaced functionally on load,
+    so in-flight dispatches keep reading the buffer they captured."""
+
+    def __init__(self, cfg: ModelConfig, slots: int, rank: int,
+                 host_bytes: int = 0, metrics=None,
+                 dtype=jnp.float32):
+        assert slots >= 1, slots
+        assert rank >= 1, (
+            f"adapter_rank={rank} must be >= 1 (a rank-0 bank holds "
+            "no delta at all)")
+        self.cfg = cfg
+        self.capacity = slots + 1  # + the identity row
+        self.rank = int(rank)
+        self.dtype = dtype
+        self.metrics = metrics
+        self.host_budget = int(host_bytes)
+        shapes = adapter_factor_shapes(cfg, self.rank)
+        self._stacked = LoraAdapter(**{
+            # [L, n, ...]: the leading layers dim is what stack_apply
+            # scans; the bank dim is gathered per row at apply time
+            n: jnp.zeros((s[0], self.capacity) + s[1:], dtype)
+            for n, s in shapes.items()})
+        self._ids: list = [("identity",)] + [None] * slots
+        self._by_id: Dict[object, int] = {}
+        self._pins = np.zeros(self.capacity, np.int64)
+        self._lru: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()  # resident idx, oldest first
+        # id -> ("path", str) | ("arrays", folded dict): the reload
+        # source of truth (arrays-registered adapters keep their folded
+        # host copy forever, so they never demote — it would duplicate)
+        self._sources: Dict[object, tuple] = {}
+        self._host: "collections.OrderedDict[object, _HostAdapter]" = \
+            collections.OrderedDict()
+        self._host_used = 0
+        # one-shot warm cache: register(path=) must eager-validate the
+        # .npz anyway, so the folded result is kept for the FIRST
+        # acquire instead of re-reading the file (popped on use)
+        self._warm: Dict[object, Dict[str, np.ndarray]] = {}
+        # registration GENERATION per id: (id, generation) is the
+        # engine's prefix-cache namespace, so KV decoded under a
+        # previous registration of the SAME id can never prefix-hit a
+        # request running the re-registered weights
+        self._gen_counter = itertools.count(1)
+        self._gen: Dict[object, int] = {}
+        self._lock = threading.Lock()
+
+    # ---- registry (HTTP-thread readable) -----------------------------
+    def known(self, adapter_id) -> bool:
+        with self._lock:
+            return adapter_id in self._sources
+
+    def peek(self, adapter_id) -> int:
+        """Locality signal for the router: 2 = device-resident,
+        1 = registered (host/disk), 0 = unknown."""
+        with self._lock:
+            if adapter_id in self._by_id:
+                return 2
+            return 1 if adapter_id in self._sources else 0
+
+    def ids(self) -> list:
+        with self._lock:
+            return list(self._sources)
+
+    def active_count(self) -> int:
+        """Device-resident non-identity adapters (the active_adapters
+        gauge)."""
+        with self._lock:
+            return sum(1 for i in range(1, self.capacity)
+                       if self._ids[i] is not None)
+
+    def register(self, adapter_id, path: Optional[str] = None,
+                 factors: Optional[Dict[str, np.ndarray]] = None,
+                 rank: Optional[int] = None, alpha: float = 1.0):
+        """Make `adapter_id` servable. Exactly one of `path` (a
+        versioned `.npz` from training/lora.py export_adapter — its
+        rank/alpha ride in the file) or `factors` (+ `rank`/`alpha`)
+        must be given. Validation is EAGER — a wrong-shape or corrupt
+        adapter fails here, not at some later request's admission."""
+        if adapter_id is None:
+            raise ValueError("adapter_id must not be None")
+        if (path is None) == (factors is None):
+            raise ValueError("register: pass exactly one of path/factors")
+        warm = None
+        if path is not None:
+            f, r, a, _ = load_adapter_npz(path)
+            warm = fold_factors(f, r, a, self.cfg, self.rank)  # validate
+            src = ("path", str(path))
+        else:
+            if rank is None:
+                raise ValueError("register(factors=...) needs rank=")
+            folded = fold_factors(factors, int(rank), float(alpha),
+                                  self.cfg, self.rank)
+            src = ("arrays", folded)
+        with self._lock:
+            self._sources[adapter_id] = src
+            if warm is not None:
+                self._warm[adapter_id] = warm
+            self._gen[adapter_id] = next(self._gen_counter)
+            # a PREVIOUS registration's device row must never serve
+            # this id again: unmap it now (pinned rows keep their
+            # content for the slots still decoding under the old
+            # weights — they become anonymous and evictable once
+            # unpinned), and drop the old-weights host copy
+            self._invalidate_resident(adapter_id)
+            self._host_drop(adapter_id)
+
+    def deregister(self, adapter_id):
+        """Forget an adapter: future requests 400; a pinned
+        device-resident copy stays until its slots finish (their pins
+        keep the row's content valid), but is unmapped immediately."""
+        with self._lock:
+            self._sources.pop(adapter_id, None)
+            self._warm.pop(adapter_id, None)
+            self._gen.pop(adapter_id, None)
+            self._invalidate_resident(adapter_id)
+            self._host_drop(adapter_id)
+
+    def _invalidate_resident(self, adapter_id):
+        """(lock held) Unmap `adapter_id`'s device row. Unpinned rows
+        free immediately; pinned rows are renamed to an anonymous
+        stale marker — running slots keep reading the row content they
+        admitted with, and the row recycles once the pins drain."""
+        idx = self._by_id.pop(adapter_id, None)
+        if idx is None:
+            return
+        if self._pins[idx] == 0:
+            self._ids[idx] = None
+            self._lru.pop(idx, None)
+        else:
+            self._ids[idx] = ("stale", adapter_id,
+                              next(self._gen_counter))
+
+    def namespace(self, adapter_id):
+        """The prefix-cache namespace for `adapter_id`'s CURRENT
+        registration — (id, generation), or None when unregistered.
+        Generations make cross-REGISTRATION prefix hits structurally
+        impossible, the same way the id itself isolates tenants."""
+        with self._lock:
+            g = self._gen.get(adapter_id)
+            return None if g is None else (adapter_id, g)
+
+    # ---- device residency (engine thread) ----------------------------
+    @property
+    def stacked(self) -> LoraAdapter:
+        return self._stacked
+
+    def nbytes(self) -> int:
+        return sum(getattr(self._stacked, n).nbytes for n in FACTOR_NAMES)
+
+    def acquire(self, adapter_id) -> int:
+        """Resolve `adapter_id` to its bank row, loading it (host
+        restore, else source reload) if absent — demoting the LRU
+        unpinned resident under pressure — and PIN it for the lifetime
+        of the slot admission. Raises UnknownAdapterError (→ 400) for
+        unregistered ids and AdapterBankFullError when every row is
+        pinned (the engine requeues and retries).
+
+        Engine thread only for the load itself — which is what makes
+        the lock DROP across the slow middle section safe: no second
+        allocator exists, the lock only shields the registry/pin/LRU
+        dicts from the HTTP-thread readers (`known`/`peek`/
+        `active_count` back every submit and health probe), and
+        holding it across a multi-MB .npz read + CRC + the device
+        write would stall health() past the router's heartbeat
+        deadline and eject a healthy replica mid-load. register() MAY
+        run concurrently from an HTTP thread, so the publish
+        re-checks the registration GENERATION captured up front: a
+        re-register that raced the unlocked load discards the
+        now-stale row and retries with the fresh source — old weights
+        can never publish under a new registration."""
+        for _ in range(8):  # re-register storms bound the retry
+            with self._lock:
+                gen0 = self._gen.get(adapter_id)
+                if adapter_id not in self._sources or gen0 is None:
+                    raise UnknownAdapterError(
+                        f"unknown adapter_id {adapter_id!r}: register "
+                        "it before submitting requests against it")
+                idx = self._by_id.get(adapter_id)
+                if idx is not None:
+                    self._pins[idx] += 1
+                    self._lru[idx] = None
+                    self._lru.move_to_end(idx)
+                    return idx
+                # pick (and unmap) the target row under the lock; the
+                # row is invisible to readers until published below
+                idx, evicted_id = self._alloc_index()
+            try:
+                # the victim's host demotion, the host-restore CRC,
+                # the disk load, and the device write all run with the
+                # lock dropped (each takes it briefly for bookkeeping)
+                self._maybe_host_demote(idx, evicted_id)
+                arrays = self._fetch_host(adapter_id)
+                if arrays is None:
+                    arrays = self._load_source(adapter_id)  # disk I/O
+                self._write(idx, arrays)  # device writes
+            except Exception:
+                with self._lock:
+                    self._ids[idx] = None  # return the row unpublished
+                raise
+            with self._lock:
+                if self._gen.get(adapter_id) != gen0:
+                    # re-registered while the lock was dropped: the
+                    # arrays just written are the OLD registration's —
+                    # discard the row and retry against the new source
+                    self._ids[idx] = None
+                    continue
+                self._ids[idx] = adapter_id
+                self._by_id[adapter_id] = idx
+                self._count("adapter_loads")
+                self._pins[idx] += 1
+                self._lru[idx] = None
+                self._lru.move_to_end(idx)
+                return idx
+        raise RuntimeError(
+            f"adapter {adapter_id!r} was re-registered faster than it "
+            "could load, 8 times in a row; retry the request")
+
+    def release(self, idx: int):
+        """Unpin a row (slot finished / preempted / dropped). Row 0
+        (identity) is never pinned."""
+        if idx <= 0:
+            return
+        with self._lock:
+            self._pins[idx] = max(self._pins[idx] - 1, 0)
+
+    def reset_pins(self):
+        """Engine restart: every slotted request failed, so no pin
+        survives (device bank content does — it is not donated)."""
+        with self._lock:
+            self._pins[:] = 0
+
+    # ---- internals (lock held) ---------------------------------------
+    def _count(self, name: str, n: int = 1):
+        if self.metrics is not None:
+            self.metrics.count(name, n)
+
+    def _alloc_index(self):
+        """(lock held) Pick a row for a load: a free one, else EVICT
+        the LRU unpinned resident — unmapping it immediately; the host
+        demotion of its still-intact content happens OUTSIDE the lock
+        (`_maybe_host_demote`). Returns (idx, evicted_id or None)."""
+        for i in range(1, self.capacity):
+            if self._ids[i] is None:
+                return i, None
+        for i in list(self._lru):
+            if i == 0 or self._pins[i] > 0 or self._ids[i] is None:
+                continue
+            old_id = self._ids[i]
+            self._ids[i] = None
+            self._by_id.pop(old_id, None)
+            self._lru.pop(i, None)
+            self._count("adapter_evictions")
+            return i, old_id
+        raise AdapterBankFullError(
+            f"all {self.capacity - 1} adapter rows are pinned by "
+            "running slots; retried when a slot frees")
+
+    def _maybe_host_demote(self, idx: int, evicted_id):
+        """Gather an evicted adapter's device rows to a checksummed
+        host entry (path-sourced, still-registered adapters only — an
+        arrays-sourced adapter's folded host copy already exists, and
+        a stale/deregistered row's weights must not resurrect). Runs
+        with the lock DROPPED: the row content is untouched until the
+        caller's `_write`, and only `_host_put` re-takes the lock."""
+        if evicted_id is None or self.host_budget <= 0:
+            return
+        kind, _ = self._sources.get(evicted_id, ("gone", None))
+        if kind != "path":
+            return
+        arrays = {n: np.array(jax.device_get(
+            getattr(self._stacked, n)[:, idx]))
+            for n in FACTOR_NAMES}
+        ent = _HostAdapter(arrays)
+        with self._lock:
+            self._host_put(evicted_id, ent)
+
+    def _host_put(self, adapter_id, ent: _HostAdapter):
+        if ent.nbytes > self.host_budget:
+            return
+        self._host_drop(adapter_id)
+        while self._host_used + ent.nbytes > self.host_budget \
+                and self._host:
+            old, _ = next(iter(self._host.items()))
+            self._host_drop(old)
+        self._host[adapter_id] = ent
+        self._host_used += ent.nbytes
+
+    def _host_drop(self, adapter_id):
+        ent = self._host.pop(adapter_id, None)
+        if ent is not None:
+            self._host_used -= ent.nbytes
+
+    def _fetch_host(self, adapter_id) -> Optional[Dict[str, np.ndarray]]:
+        """Checksum-verified host-tier read. Called with the lock
+        DROPPED (acquire): the multi-MB CRC runs unlocked — a
+        concurrent drop/re-register just orphans the entry object
+        (still-valid memory), and acquire's generation re-check at
+        publish rejects anything that went stale meanwhile."""
+        with self._lock:
+            ent = self._host.get(adapter_id)
+        if ent is None:
+            return None
+        ok = _checksum(ent.arrays) == ent.crc
+        with self._lock:
+            if not ok:
+                # corrupt demotion: a MISS — drop it and reload from
+                # the source of truth; wrong weights are structurally
+                # impossible
+                if self._host.get(adapter_id) is ent:
+                    self._host_drop(adapter_id)
+                self._count("adapter_host_checksum_misses")
+            else:
+                if self._host.get(adapter_id) is ent:
+                    self._host.move_to_end(adapter_id)
+                self._count("adapter_host_hits")
+        if not ok:
+            print_rank_0(f"adapter bank: host copy of {adapter_id!r} "
+                         "failed its checksum; reloading from source")
+            return None
+        return ent.arrays
+
+    def _load_source(self, adapter_id) -> Dict[str, np.ndarray]:
+        """Runs OUTSIDE the lock (disk I/O — see acquire): GIL-atomic
+        dict reads, and a deregister racing in from an HTTP thread
+        surfaces as the typed unknown-adapter error."""
+        warm = self._warm.pop(adapter_id, None)
+        if warm is not None:
+            return warm
+        entry = self._sources.get(adapter_id)
+        if entry is None:
+            raise UnknownAdapterError(
+                f"adapter_id {adapter_id!r} was deregistered while "
+                "loading")
+        kind, src = entry
+        if kind == "arrays":
+            return src
+        factors, rank, alpha, _ = load_adapter_npz(src)
+        return fold_factors(factors, rank, alpha, self.cfg, self.rank)
+
+    def _write(self, idx: int, arrays: Dict[str, np.ndarray]):
+        """Functional row update — DELIBERATELY a full-buffer copy per
+        factor: the engine's chained decode dispatches may still hold
+        the previous stacked buffers as operands, so an in-place
+        (donated) row write could corrupt a program in flight. Loads
+        are rare control-plane events; the copy is the price of the
+        never-mutate-in-flight-buffers discipline the whole engine
+        rests on. Runs outside the bank lock (see acquire)."""
+        self._stacked = LoraAdapter(**{
+            n: getattr(self._stacked, n).at[:, idx].set(
+                jnp.asarray(arrays[n], self.dtype))
+            for n in FACTOR_NAMES})

@@ -163,7 +163,7 @@ class _PendingPrefill:
     aborted prefill)."""
 
     __slots__ = ("req", "slot", "sub", "pos", "rng0", "last", "tokens",
-                 "blocks", "pfx_blocks", "installed")
+                 "blocks", "pfx_blocks", "installed", "aidx")
 
     def __init__(self, req: GenRequest, slot: int, sub, pos: int, rng0,
                  tokens: Optional[List[int]] = None,
@@ -178,6 +178,9 @@ class _PendingPrefill:
         self.blocks = blocks
         self.pfx_blocks = pfx_blocks
         self.installed = False
+        # adapter bank row the chunks forward under (0 = identity;
+        # resolved + pinned at admission — serving/adapters.py)
+        self.aidx = int(req.bank_idx)
 
 
 class _HostSrc:
@@ -349,6 +352,36 @@ class ServingEngine:
         self._writer = writer
         self._report_interval = max(report_interval, 1)
 
+        # multi-tenant LoRA serving (serving/adapters.py): a device-
+        # resident bank of per-layer A/B factors, indexed per slot by
+        # adapter_idx — plain data next to the KV block map, so decode /
+        # verify / prefill keep ONE compile each with adapters on, and
+        # adapter_slots=0 passes adapters=None (today's graph, bit-
+        # identical). The bank's stacked pytree is NOT donated: it
+        # survives restarts and in-flight dispatches read the buffer
+        # they captured while loads replace it functionally.
+        self._adapter_slots = int(getattr(self.serving, "adapter_slots",
+                                          0) or 0)
+        self._adapters_on = self._adapter_slots > 0
+        self.adapters = None
+        if self._adapters_on:
+            from megatron_tpu.serving.adapters import AdapterBank
+            # re-assert ServingConfig.validate for engines constructed
+            # without it: a rank-0 bank holds no delta at all, and
+            # int8-quantized projections break the factored-vs-merged
+            # token-equivalence the adapter contract rests on
+            assert self.serving.adapter_rank >= 1, (
+                "adapter_slots > 0 requires adapter_rank >= 1 — see "
+                "ServingConfig.validate")
+            assert cfg.quantized_gemm == "none", (
+                "adapter_slots > 0 is unsupported with "
+                "quantized_gemm='int8' — see ServingConfig.validate")
+            self.adapters = AdapterBank(
+                cfg, self._adapter_slots, self.serving.adapter_rank,
+                host_bytes=int(getattr(self.serving,
+                                       "adapter_host_bytes", 0) or 0),
+                metrics=self.metrics)
+
         S, Vp = self.num_slots, cfg.padded_vocab_size
         # per-slot device state (functionally replaced every step)
         self._last_logits = jnp.zeros((S, Vp), jnp.float32)
@@ -375,6 +408,11 @@ class ServingEngine:
         # fetch) and re-uploads with the lengths on slot churn
         self._reject = np.full(S, -1, np.int32)
         self._d_reject = jnp.asarray(self._reject)
+        # per-slot adapter bank row (0 = identity): changes only on
+        # slot churn, re-uploaded with the lengths; idle rows ride the
+        # identity adapter so their garbage decode is the base model's
+        self._adapter_idx = np.zeros(S, np.int32)
+        self._d_adapter_idx = jnp.asarray(self._adapter_idx)
         self._sampling_dirty = True
         self._lengths_dirty = True
         # KV gauges recompute only after pool churn (admit / evict /
@@ -392,7 +430,7 @@ class ServingEngine:
         # flight hits the CPU jax 0.4.x donation-aliasing bug the
         # rollback path in training/loop.py documents (observed here as
         # rare wrong tokens on the 8-virtual-device CPU mesh)
-        self._decode = self.gen._jit(self._decode_fn, n_array_args=8,
+        self._decode = self.gen._jit(self._decode_fn, n_array_args=10,
                                      donate_argnums=(1, 2, 3))
         # speculative verify: ONE trace for the enabled k (drafts are
         # a fixed [S, k] shape — k is a compile-time bucket), compiled
@@ -400,12 +438,12 @@ class ServingEngine:
         # Same donation set and the same lengths/rejects no-donate rule
         # as _decode (both chain device-side across a window).
         self._verify_traces = 0
-        self._verify = self.gen._jit(self._verify_fn, n_array_args=9,
+        self._verify = self.gen._jit(self._verify_fn, n_array_args=11,
                                      donate_argnums=(1, 2, 3))
         # one jit; jax retraces per (batch-bucket, padded prompt length)
         # combo (both bucketed — _prefill_bucket / _batch_bucket — so
         # the cache hits across request sizes and arrival bursts)
-        self._prefill = self.gen._jit(self._prefill_fn, n_array_args=7,
+        self._prefill = self.gen._jit(self._prefill_fn, n_array_args=9,
                                       donate_argnums=(1, 2, 3))
         # prefix-cache / chunked-prefill programs (slot indices and
         # offsets are traced scalars — one compile serves every slot):
@@ -422,7 +460,7 @@ class ServingEngine:
         self._chunk_traces = 0
         self._slice = self.gen._jit(self._slice_fn, n_array_args=3)
         self._chunk_fwd = self.gen._jit(self._chunk_fwd_fn,
-                                        n_array_args=4)
+                                        n_array_args=6)
         self._insert = self.gen._jit(self._insert_fn, n_array_args=8,
                                      donate_argnums=(1, 2, 3))
         # block-mode variants: slice by explicit physical-block list,
@@ -471,7 +509,8 @@ class ServingEngine:
                sampling: SamplingOptions = SamplingOptions(),
                seed: int = 0, priority: int = 0,
                deadline_s: Optional[float] = None,
-               arrival_id: Optional[int] = None) -> GenRequest:
+               arrival_id: Optional[int] = None,
+               adapter_id=None) -> GenRequest:
         """Non-blocking: enqueue and return the request handle. Raises
         QueueFullError (→ 429) when the bounded queue is full,
         OverloadShedError (→ 429 + Retry-After) when early shedding
@@ -481,11 +520,25 @@ class ServingEngine:
         [0, priority_levels); `deadline_s` overrides the engine-wide
         request_deadline_s for this request. `arrival_id` (router
         failover retries only) preserves a resubmitted request's
-        original queue position."""
+        original queue position. `adapter_id` selects a registered LoRA
+        adapter (None = base model); an unknown id (or any id on an
+        adapterless engine) is an AdmissionError → 400."""
         if self._broken:
             raise EngineUnhealthyError(
                 f"engine unhealthy (circuit breaker open): "
                 f"{self._broken}")
+        if adapter_id is not None:
+            from megatron_tpu.serving.adapters import UnknownAdapterError
+            if self.adapters is None:
+                self.metrics.count("requests_rejected")
+                raise UnknownAdapterError(
+                    f"adapter_id {adapter_id!r} on an engine serving "
+                    "no adapters (adapter_slots=0)")
+            if not self.adapters.known(adapter_id):
+                self.metrics.count("requests_rejected")
+                raise UnknownAdapterError(
+                    f"unknown adapter_id {adapter_id!r}: register it "
+                    "before submitting requests against it")
         if self._draining:
             from megatron_tpu.serving.scheduler import QueueFullError
             raise QueueFullError(
@@ -496,7 +549,7 @@ class ServingEngine:
                               self.serving.priority_levels - 1))
         req = GenRequest(list(prompt), max_new_tokens, sampling, seed,
                          priority=priority, deadline_s=deadline_s,
-                         arrival_id=arrival_id)
+                         arrival_id=arrival_id, adapter_id=adapter_id)
         self.metrics.count("requests_received")
         try:
             if max_new_tokens == 0:
@@ -595,28 +648,66 @@ class ServingEngine:
             "kv_blocks_retained": kv_retained,
             "service_time_ewma_ms":
                 self.scheduler.service_time_ewma() * 1e3,
+            # adapter-locality routing signal (0 on adapterless
+            # engines; cheap dict read, HTTP-thread safe)
+            "active_adapters": (self.adapters.active_count()
+                                if self.adapters is not None else 0),
             "detail": broken or "",
         }
 
-    def prefix_peek(self, tokens: Sequence[int]) -> int:
+    def prefix_peek(self, tokens: Sequence[int], adapter_id=None) -> int:
         """Longest cached prefix (device index OR host tier) this
-        replica could serve `tokens` with — the router's cache-affinity
-        signal. Called from HTTP threads while the engine thread
-        mutates the index: reads only, and any racy-iteration error
-        degrades to 0 (affinity is a hint, admission re-resolves the
-        real hit on the engine thread)."""
+        replica could serve `tokens` with UNDER `adapter_id`'s
+        namespace — the router's cache-affinity signal. Called from
+        HTTP threads while the engine thread mutates the index: reads
+        only, and any racy-iteration error degrades to 0 (affinity is
+        a hint, admission re-resolves the real hit on the engine
+        thread)."""
         if not self._prefix_on or not tokens:
             return 0
+        ns = None
+        if adapter_id is not None:
+            # the index is keyed by (id, registration generation), so
+            # the peek resolves the CURRENT generation — KV from an
+            # older registration of the same id is invisible
+            if self.adapters is None:
+                return 0
+            ns = self.adapters.namespace(adapter_id)
+            if ns is None:
+                return 0
         toks = list(tokens)
         try:
-            src, hit = self._index.lookup(toks, len(toks) - 1)
+            src, hit = self._index.lookup(toks, len(toks) - 1,
+                                          namespace=ns)
             best = hit if src is not None else 0
             if self._host_tier is not None:
-                _, hhit = self._host_tier.lookup(toks, len(toks) - 1)
+                _, hhit = self._host_tier.lookup(toks, len(toks) - 1,
+                                                 namespace=ns)
                 best = max(best, hhit)
             return int(best)
         except Exception:  # noqa: BLE001 — cross-thread peek
             return 0
+
+    def register_adapter(self, adapter_id, path: Optional[str] = None,
+                         factors=None, rank: Optional[int] = None,
+                         alpha: float = 1.0):
+        """Make `adapter_id` servable on this replica (validated
+        eagerly; serving/adapters.py). Raises on an adapterless engine
+        — register requires `adapter_slots > 0`."""
+        if self.adapters is None:
+            raise RuntimeError(
+                "this engine serves no adapters (adapter_slots=0); "
+                "set ServingConfig.adapter_slots to register adapters")
+        self.adapters.register(adapter_id, path=path, factors=factors,
+                               rank=rank, alpha=alpha)
+
+    def adapter_peek(self, adapter_id) -> int:
+        """Adapter-locality routing signal: 2 = device-resident on
+        this replica, 1 = registered (host tier / disk reload away),
+        0 = unknown. Cheap dict reads — safe from HTTP threads."""
+        if self.adapters is None or adapter_id is None:
+            return 0
+        return self.adapters.peek(adapter_id)
 
     def queue_depth(self) -> int:
         return self.scheduler.depth()
@@ -658,7 +749,7 @@ class ServingEngine:
     # device programs
     # ------------------------------------------------------------------
     def _decode_fn(self, params, pool, last_logits, rngs, lengths,
-                   temps, top_ks, top_ps, rejects):
+                   temps, top_ks, top_ps, rejects, lora, aidx):
         """ONE interleaved decode step for the whole slot grid: sample
         each slot's next token from its carried logits, then forward all
         slots' tokens (s=1) through the model with per-slot positions.
@@ -695,8 +786,15 @@ class ServingEngine:
         bracket DISAPPEARS instead: the forward consumes a
         BlockKVCache (arena + map) and the Pallas block kernel walks
         each slot's chain in place — same outputs, zero full-pool
-        gather/scatter traffic."""
+        gather/scatter traffic.
+
+        `lora`/`aidx` are the adapter bank's stacked factors and the
+        per-slot bank rows (serving/adapters.py): the forward adds each
+        row's low-rank delta to the q/k/v/o projections — indices are
+        DATA like the block map, one trace. Both are None (empty
+        pytrees) with adapters off, which lowers to today's graph."""
         self._decode_traces += 1
+        adapters = (lora, aidx) if self._adapters_on else None
         bkv = None
         if self._kernel_on:
             bkv, pool = pool, block_native_cache(pool)
@@ -721,7 +819,7 @@ class ServingEngine:
         logits, pool = lm.model_forward(
             params, toks[:, None], cfg, kv_caches=pool,
             position_ids=lengths[:, None], rope=self.gen.rope,
-            logits_dtype=jnp.float32)
+            logits_dtype=jnp.float32, adapters=adapters)
         new_lengths = jnp.minimum(lengths + 1,
                                   jnp.int32(self.max_len - 1))
         if bkv is not None:
@@ -731,7 +829,7 @@ class ServingEngine:
                 jnp.full_like(rejects, -1))
 
     def _verify_fn(self, params, pool, last_logits, rngs, lengths,
-                   temps, top_ks, top_ps, drafts, rejects):
+                   temps, top_ks, top_ps, drafts, rejects, lora, aidx):
         """ONE speculative draft/verify round for the whole slot grid
         (`speculative_k`): sample each slot's next token t0 from its
         carried logits (the residual distribution when `rejects` bans
@@ -761,8 +859,14 @@ class ServingEngine:
         Returns (pool, new_last_logits, new_rngs, window [S, k+1],
         window_logprobs [S, k+1], accepted [S], new_lengths,
         new_rejects) — the host consumes 1+accepted tokens per live
-        row and discards the rest."""
+        row and discards the rest.
+
+        `lora`/`aidx`: per-slot adapter deltas (see _decode_fn) — the
+        verify window forwards under each row's OWN adapter, so
+        speculative decoding composes with multi-tenant serving at one
+        trace."""
         self._verify_traces += 1
+        adapters = (lora, aidx) if self._adapters_on else None
         bkv = None
         if self._kernel_on:
             # block-native verify: the [S, k+1] window forwards
@@ -794,7 +898,8 @@ class ServingEngine:
         logits, pool = verify_tokens(params, window, pool, cfg,
                                      rope=self.gen.rope,
                                      lengths=lengths,
-                                     max_len=self.max_len)
+                                     max_len=self.max_len,
+                                     adapters=adapters)
         # logits[:, j] = the model's distribution for the token AFTER
         # window position j — drafts[:, j] claims to be that token
         ctx = logits[:, :k]
@@ -850,7 +955,7 @@ class ServingEngine:
                 new_lengths, new_rejects)
 
     def _prefill_fn(self, params, pool, last_logits, rngs, tokens,
-                    plens, slots, rng0s):
+                    plens, slots, rng0s, lora, aidxs):
         """Batched prefill: B prompts (same padded bucket) forward in
         ONE call — the weight stream is paid once per batch instead of
         once per request — then each row's KV inserts into its slot.
@@ -862,7 +967,12 @@ class ServingEngine:
         With `block_native_attn` the rows land through per-row
         `insert_blocks` (the group's map rows were installed at
         admission; fresh misses, so pfx_blocks = 0) — same written
-        bytes, no resolve/scatter bracket."""
+        bytes, no resolve/scatter bracket.
+
+        `aidxs` [B]: per-ROW adapter bank rows — mixed-adapter
+        admissions batch into ONE prefill call (indices are data), so
+        adapter diversity never fragments the prefill coalescing."""
+        adapters = (lora, aidxs) if self._adapters_on else None
         bkv = None
         if self._blocks_on and not self._kernel_on:
             bkv, pool = pool, resolve_view(pool)
@@ -870,7 +980,8 @@ class ServingEngine:
         caches = self.pool.make_prefill_caches(B)
         logits, caches = lm.model_forward(
             params, tokens, self.cfg, kv_caches=caches,
-            rope=self.gen.rope, logits_dtype=jnp.float32)
+            rope=self.gen.rope, logits_dtype=jnp.float32,
+            adapters=adapters)
         for i in range(B):  # static unroll: B is a trace-time shape
             def row(x):
                 return jax.lax.dynamic_slice_in_dim(x, i, 1, axis=1)
@@ -910,15 +1021,19 @@ class ServingEngine:
         compile serves every source."""
         return slice_blocks(pool, blocks, start)
 
-    def _chunk_fwd_fn(self, params, sub, tokens, last_idx, next_offset):
+    def _chunk_fwd_fn(self, params, sub, tokens, last_idx, next_offset,
+                      lora, aidx1):
         """Append one [1, s] prompt chunk at `sub`'s current offset
         (generation.prefill_chunk: decode masking generalized to
         q-len > 1). Retraces once per padded chunk length — the same
-        bucket set as the monolithic prefill."""
+        bucket set as the monolithic prefill. `aidx1` [1] is the
+        pending request's adapter bank row (data — chunked prefills
+        under any adapter share the compile)."""
         self._chunk_traces += 1
+        adapters = (lora, aidx1) if self._adapters_on else None
         return prefill_chunk(params, tokens, sub, self.cfg,
                              rope=self.gen.rope, last_idx=last_idx,
-                             next_offset=next_offset)
+                             next_offset=next_offset, adapters=adapters)
 
     def _insert_fn(self, params, pool, last_logits, rngs, sub, slot,
                    plen, last, rng0):
@@ -1190,6 +1305,13 @@ class ServingEngine:
         self._active[:] = False
         self._reject[:] = -1
         self._d_reject = jnp.asarray(self._reject)
+        # every slotted request failed, so no adapter pin survives; the
+        # bank's device arrays DO (they are never donated), so resident
+        # adapters stay warm across the restart
+        self._adapter_idx[:] = 0
+        self._d_adapter_idx = jnp.asarray(self._adapter_idx)
+        if self.adapters is not None:
+            self.adapters.reset_pins()
         self._slot_req = [None] * S
         self._sampling_dirty = True
         self._lengths_dirty = True
@@ -1271,6 +1393,13 @@ class ServingEngine:
         self._active[slot] = False
         self._reject[slot] = -1  # draft state is droppable: a parked
         #                          victim carries only committed tokens
+        # the pin frees with the slot; the victim re-ACQUIRES at
+        # resume (the bank row may have been recycled meanwhile — the
+        # stable adapter_id on the request is what resumes, so the
+        # restored stream decodes under the same weights regardless of
+        # which row they land in next)
+        self._release_adapter(req)
+        self._adapter_idx[slot] = 0
         self._sampling_dirty = True
         self._kv_dirty = True
         self._lengths_dirty = True
@@ -1299,7 +1428,26 @@ class ServingEngine:
         self._admitting = pending
         try:
             groupable: List[GenRequest] = []
+            # head-of-line fairness: once a request blocks on a FULL
+            # adapter bank, every LATER adapter request this pass
+            # requeues untried — otherwise a saturating resident
+            # tenant keeps re-pinning its row behind the blocked head
+            # and starves it forever. Base requests (no pin) still
+            # admit; arrival ids preserve the order across requeues,
+            # so the blocked head is served first once a pin frees.
+            bank_blocked = False
             for r in popped:
+                if bank_blocked and r.adapter_id is not None:
+                    self.scheduler.requeue(r)
+                    pending.remove(r)
+                    continue
+                verdict = self._acquire_adapter(r)
+                if verdict != "ok":
+                    # "blocked": bank full, requeued until a pin frees;
+                    # "failed": typed error already set on the request
+                    bank_blocked = bank_blocked or verdict == "blocked"
+                    pending.remove(r)
+                    continue
                 if r.parked is not None:
                     # preemption victim with intact parked KV: resume
                     # with ONE insert — no forward at all
@@ -1309,7 +1457,7 @@ class ServingEngine:
                 # a resumed request prefills its EFFECTIVE prompt
                 # (prompt + generated); == prompt when never preempted
                 toks = r.effective_prompt()
-                src, hit = self._lookup_prefix(toks)
+                src, hit = self._lookup_prefix(toks, r.adapter_ns)
                 if hit or r.resume_rng is not None \
                         or (self._chunk is not None
                             and len(toks) > self._chunk):
@@ -1327,18 +1475,75 @@ class ServingEngine:
         except Exception as e:
             # anything not yet admitted is in neither _slot_req /
             # _prefilling nor the scheduler — fail it here or its
-            # caller would hang to the request timeout
+            # caller would hang to the request timeout (and its
+            # admission-time adapter pin must not leak)
             for r in pending:
+                self._release_adapter(r)
                 r.fail(repr(e))
             raise
         finally:
             self._admitting = []
 
-    def _lookup_prefix(self, toks):
-        """Longest reusable cached prefix of `toks` and its source —
-        an int (running slot) or a RetainedPrefix key. The lookup caps
-        the match at len-1: at least one suffix token must forward to
-        produce the sampling logits at position plen-1.
+    def _acquire_adapter(self, req: GenRequest) -> str:
+        """Resolve req.adapter_id to a pinned bank row (req.bank_idx)
+        and its registration-generation namespace (req.adapter_ns).
+        Returns "ok", or how the request left this admission pass:
+        "blocked" — bank full, REQUEUED (a pin frees when a slot
+        finishes; liveness holds because pins only come from
+        active/prefilling slots, and _admit stops admitting later
+        adapter requests behind a blocked head); "failed" —
+        deregistered-since-submit, unloadable source, or RE-REGISTERED
+        mid-flight (a preempted/requeued stream must never resume
+        under different weights than it started with)."""
+        req.bank_idx = 0
+        if self.adapters is None or req.adapter_id is None:
+            return "ok"
+        from megatron_tpu.serving.adapters import (AdapterBankFullError,
+                                                   UnknownAdapterError)
+        try:
+            idx = self.adapters.acquire(req.adapter_id)
+        except AdapterBankFullError:
+            self.scheduler.requeue(req)
+            return "blocked"
+        except UnknownAdapterError as e:
+            req.fail(str(e))
+            self.metrics.count("requests_cancelled")
+            return "failed"
+        except Exception as e:  # noqa: BLE001 — unloadable source
+            req.fail(f"adapter {req.adapter_id!r} failed to load: "
+                     f"{e!r}")
+            self.metrics.count("requests_cancelled")
+            return "failed"
+        ns = self.adapters.namespace(req.adapter_id)
+        if req.adapter_ns is not None and ns != req.adapter_ns:
+            self.adapters.release(idx)
+            req.fail(f"adapter {req.adapter_id!r} was re-registered "
+                     "while this request was queued or preempted; its "
+                     "stream cannot continue under different weights "
+                     "— resubmit")
+            self.metrics.count("requests_cancelled")
+            return "failed"
+        req.adapter_ns = ns
+        req.bank_idx = idx
+        return "ok"
+
+    def _release_adapter(self, req: Optional[GenRequest]):
+        """Drop the admission-time pin (slot freed / admission failed).
+        Idempotent via bank_idx=0 reset."""
+        if req is None or self.adapters is None:
+            return
+        if req.bank_idx:
+            self.adapters.release(int(req.bank_idx))
+            req.bank_idx = 0
+
+    def _lookup_prefix(self, toks, namespace=None):
+        """Longest reusable cached prefix of `toks` COMPUTED UNDER
+        `namespace` (the request's adapter id; None = base) and its
+        source — an int (running slot) or a RetainedPrefix key. The
+        lookup caps the match at len-1: at least one suffix token must
+        forward to produce the sampling logits at position plen-1.
+        Cross-adapter hits are structurally impossible: the namespace
+        is the first node on every indexed path (prefix_index.py).
 
         ROLLING pools (block mode only — whole-region rolling never
         indexes) add a ring-validity gate: the retained ring holds only
@@ -1352,7 +1557,8 @@ class ServingEngine:
         if not self._prefix_on:
             return None, 0
         toks = list(toks)
-        src, hit = self._index.lookup(toks, len(toks) - 1)
+        src, hit = self._index.lookup(toks, len(toks) - 1,
+                                      namespace=namespace)
         if src is None or not hit:
             src, hit = None, 0
         elif self.pool.rolling:
@@ -1373,7 +1579,8 @@ class ServingEngine:
         # device hit (restoring costs one device_put; at equal length
         # the on-device copy wins)
         if self._host_tier is not None:
-            hkey, hhit = self._host_tier.lookup(toks, len(toks) - 1)
+            hkey, hhit = self._host_tier.lookup(toks, len(toks) - 1,
+                                                namespace=namespace)
             if hkey is not None and hhit > hit:
                 return _HostSrc(hkey), hhit
         return src, hit
@@ -1568,7 +1775,9 @@ class ServingEngine:
             return
         arrays = self.pool.gather_blocks_host(ent.blocks)
         if self._host_tier.demote(ent.key, ent.tokens, ent.length,
-                                  arrays):
+                                  arrays,
+                                  namespace=getattr(ent, "namespace",
+                                                    None)):
             self.metrics.count("host_tier_demotions")
 
     def _restore_host(self, key, plen: int):
@@ -1638,9 +1847,12 @@ class ServingEngine:
         assert n <= padded, (n, padded, st.pos)
         toks = np.full((1, padded), self.gen.pad_id, np.int32)
         toks[0, :n] = st.tokens[st.pos:st.pos + n]
+        lora = self.adapters.stacked if self._adapters_on else None
+        aidx1 = (jnp.asarray([st.aidx], jnp.int32) if self._adapters_on
+                 else None)
         st.sub, st.last = self._chunk_fwd(
             self.gen.params, st.sub, jnp.asarray(toks),
-            jnp.int32(n - 1), jnp.int32(st.pos + n))
+            jnp.int32(n - 1), jnp.int32(st.pos + n), lora, aidx1)
         st.pos += n
         st.req.prefill_chunks += 1
         self.metrics.count("prefill_chunks")
@@ -1681,6 +1893,9 @@ class ServingEngine:
         # -1 for a fresh request; a preemption resume/replay restores
         # the saved residual carry with the rng chain
         self._reject[slot] = req.resume_reject
+        # the slot decodes under the request's adapter bank row
+        # (0 = identity/base; pinned since admission)
+        self._adapter_idx[slot] = st.aidx
         self._slot_req[slot] = req
         self._sampling_dirty = True
         self._kv_dirty = True
@@ -1688,15 +1903,19 @@ class ServingEngine:
         if self._prefix_on and not self.pool.rolling:
             # the slot is now cloneable for its prefilled sequence —
             # the PROMPT for a fresh request, prompt + generated-so-far
-            # for a resumed one (extended again at retain time).
+            # for a resumed one (extended again at retain time) — in
+            # the request's ADAPTER namespace (a different adapter's
+            # identical tokens must never hit it).
             # Rolling slots index only at RETAIN time: a running ring
             # keeps wrapping over the very prefix the index would
             # advertise.
-            self._index.insert(slot, st.tokens)
+            self._index.insert(slot, st.tokens,
+                               namespace=req.adapter_ns)
 
     def _drop_pending(self, st: _PendingPrefill, msg: str,
                       kind: str = "error"):
         self._prefilling.remove(st)
+        self._release_adapter(st.req)
         if st.blocks is not None:
             # still pending => the map row was never installed, so the
             # reserved/aliased blocks are held only by the pending
@@ -1737,10 +1956,18 @@ class ServingEngine:
             [self._initial_rng(r.seed, p)
              for r, p in zip(reqs, plens)]
             + [self._initial_rng(reqs[0].seed, plens[0])] * (B - B_real))
+        lora = aidxs = None
+        if self._adapters_on:
+            # per-row bank indices (resolved + pinned in _admit):
+            # mixed-adapter groups batch into the same compiled call
+            lora = self.adapters.stacked
+            rows = [r.bank_idx for r in reqs]
+            aidxs = jnp.asarray(rows + [rows[0]] * (B - B_real),
+                                jnp.int32)
         self.pool.caches, self._last_logits, self._rngs = self._prefill(
             self.gen.params, self.pool.caches, self._last_logits,
             self._rngs, jnp.asarray(toks), jnp.asarray(plens_a),
-            jnp.asarray(slots_a), rng0s)
+            jnp.asarray(slots_a), rng0s, lora, aidxs)
         if self._blocks_on and not self._kernel_on:
             # the batched-prefill program bracketed with resolve +
             # scatter (block-native lands through insert_blocks
@@ -1753,6 +1980,7 @@ class ServingEngine:
             self._top_ks[slot] = req.sampling.top_k
             self._top_ps[slot] = req.sampling.top_p
             self._reject[slot] = req.resume_reject  # -1 when fresh
+            self._adapter_idx[slot] = req.bank_idx
             self._slot_req[slot] = req
             # restart-requeued requests re-enter through this path
             # too (the rebuilt PrefixIndex is empty): record the
@@ -1774,7 +2002,8 @@ class ServingEngine:
             if self._prefix_on and not self.pool.rolling:
                 # rolling slots index only at retain time (see
                 # _activate_pending)
-                self._index.insert(slot, req.prompt)
+                self._index.insert(slot, req.prompt,
+                                   namespace=req.adapter_ns)
 
     def _reap_cancelled(self):
         for slot in np.nonzero(self._active)[0]:
@@ -1828,6 +2057,11 @@ class ServingEngine:
         self._slot_req[slot] = None
         self._active[slot] = False
         self._reject[slot] = -1  # residual carry dies with the stream
+        # the adapter pin frees with the slot: retained KV is plain
+        # data and needs no live bank row (the retained entry keeps the
+        # adapter NAMESPACE for index correctness, not the weights)
+        self._release_adapter(req)
+        self._adapter_idx[slot] = 0
         self._kv_dirty = True
         self._lengths_dirty = True  # device copy re-parks at next step
         self._sampling_dirty = True
@@ -1844,9 +2078,11 @@ class ServingEngine:
             final = int(self._lengths[slot])
             tokens = req.prompt + req.generated
             self._index.remove(slot)
-            rkey = self.pool.retain_row(slot, final, tokens)
+            rkey = self.pool.retain_row(slot, final, tokens,
+                                        namespace=req.adapter_ns)
             if rkey is not None:
-                self._index.insert(rkey, tokens)
+                self._index.insert(rkey, tokens,
+                                   namespace=req.adapter_ns)
             self._lengths[slot] = 0
         elif failed is None and self._prefix_on:
             # prefix cache: RETAIN the finished slot's KV for reuse
@@ -1866,7 +2102,8 @@ class ServingEngine:
             # on_reclaim -> _index.remove(slot) for the demoted slot —
             # inserting after would resurrect a stale entry over a
             # free-listed slot, and free-list alloc() never reclaims.
-            self._index.insert(slot, req.prompt + req.generated)
+            self._index.insert(slot, req.prompt + req.generated,
+                               namespace=req.adapter_ns)
             self.pool.retain(slot)
         else:
             self._lengths[slot] = 0  # inactive rows park at position 0
@@ -1957,6 +2194,8 @@ class ServingEngine:
             # mirror is exact at boundaries (it rides the window fetch)
             # and churn sites rewrite it before setting the dirty flag
             self._d_reject = jnp.asarray(self._reject)
+            # per-slot adapter rows change only on the same churn
+            self._d_adapter_idx = jnp.asarray(self._adapter_idx)
             self._lengths_dirty = False
         spec_k = self._spec_k
         spec_round = [False] * K
@@ -1985,6 +2224,11 @@ class ServingEngine:
                     histories[slot] = req.prompt + req.generated
             grids, spec_round = build_draft_rounds(
                 histories, self.drafter, spec_k, K)
+        # adapter bank args: the stacked factor pytree + per-slot rows
+        # (None/None with adapters off — the empty-pytree args lower to
+        # exactly the pre-adapter graph)
+        lora = self.adapters.stacked if self._adapters_on else None
+        d_aidx = self._d_adapter_idx if self._adapters_on else None
         tok_steps, lp_steps, acc_steps = [], [], []
         for r in range(K):
             if spec_round[r]:
@@ -1992,7 +2236,7 @@ class ServingEngine:
                     self.gen.params, self.pool.caches,
                     self._last_logits, self._rngs, self._d_lengths,
                     self._d_temps, self._d_top_ks, self._d_top_ps,
-                    jnp.asarray(grids[r]), self._d_reject)
+                    jnp.asarray(grids[r]), self._d_reject, lora, d_aidx)
                 acc_steps.append(out[5])
                 self.metrics.count("spec_rounds")
             else:
@@ -2000,7 +2244,7 @@ class ServingEngine:
                     self.gen.params, self.pool.caches,
                     self._last_logits, self._rngs, self._d_lengths,
                     self._d_temps, self._d_top_ks, self._d_top_ps,
-                    self._d_reject)
+                    self._d_reject, lora, d_aidx)
                 acc_steps.append(None)
                 if spec_k:
                     self.metrics.count("spec_fallback_steps")
@@ -2127,6 +2371,9 @@ class ServingEngine:
         if self._kv_dirty:
             self.metrics.set_kv_gauges(
                 *self.pool.kv_gauges(self._lengths))
+            if self.adapters is not None:
+                self.metrics.set_adapter_gauge(
+                    self.adapters.active_count())
             self._kv_dirty = False
         if self._writer is not None and \
                 self._steps % self._report_interval < K:

@@ -48,16 +48,20 @@ def _checksum(arrays: Dict[str, np.ndarray]) -> int:
 
 
 class _HostEntry:
-    __slots__ = ("key", "tokens", "length", "arrays", "crc", "nbytes")
+    __slots__ = ("key", "tokens", "length", "arrays", "crc", "nbytes",
+                 "namespace")
 
     def __init__(self, key, tokens: List[int], length: int,
-                 arrays: Dict[str, np.ndarray]):
+                 arrays: Dict[str, np.ndarray], namespace=None):
         self.key = key
         self.tokens = list(tokens)
         self.length = int(length)
         self.arrays = arrays
         self.crc = _checksum(arrays)
         self.nbytes = int(sum(a.nbytes for a in arrays.values()))
+        # adapter namespace the KV was computed under (None = base):
+        # lookups in any other namespace must miss (prefix_index.py)
+        self.namespace = namespace
 
 
 class HostKVTier:
@@ -83,17 +87,20 @@ class HostKVTier:
 
     # ---- demote ------------------------------------------------------
     def demote(self, key, tokens: Sequence[int], length: int,
-               arrays: Dict[str, np.ndarray]) -> bool:
-        """Store a dying retained entry's host-gathered block arrays.
-        Returns False (and stores nothing) when the entry alone exceeds
-        the whole budget; otherwise evicts LRU entries until it fits.
-        An entry already holding the SAME sequence is replaced, not
-        duplicated (demote/restore/retain cycles of a hot prompt must
-        not fill the budget with copies of one prefix)."""
-        ent = _HostEntry(key, list(tokens), length, arrays)
+               arrays: Dict[str, np.ndarray], namespace=None) -> bool:
+        """Store a dying retained entry's host-gathered block arrays
+        under `namespace` (the adapter id its KV was computed with;
+        None = base). Returns False (and stores nothing) when the entry
+        alone exceeds the whole budget; otherwise evicts LRU entries
+        until it fits. An entry already holding the SAME
+        (namespace, sequence) is replaced, not duplicated
+        (demote/restore/retain cycles of a hot prompt must not fill the
+        budget with copies of one prefix)."""
+        ent = _HostEntry(key, list(tokens), length, arrays,
+                         namespace=namespace)
         if ent.nbytes > self.budget_bytes:
             return False
-        seq = tuple(ent.tokens[:ent.length])
+        seq = (namespace, tuple(ent.tokens[:ent.length]))
         self.drop(self._by_seq.get(seq))
         self.drop(key)
         while self.bytes_used + ent.nbytes > self.budget_bytes \
@@ -102,7 +109,8 @@ class HostKVTier:
         self._entries[key] = ent
         self.bytes_used += ent.nbytes
         self._by_seq[seq] = key
-        self._index.insert(key, ent.tokens[:ent.length])
+        self._index.insert(key, ent.tokens[:ent.length],
+                           namespace=namespace)
         return True
 
     def _evict_lru(self):
@@ -116,19 +124,21 @@ class HostKVTier:
         if ent is not None:
             self.bytes_used -= ent.nbytes
             self._index.remove(key)
-            seq = tuple(ent.tokens[:ent.length])
+            seq = (ent.namespace, tuple(ent.tokens[:ent.length]))
             if self._by_seq.get(seq) == key:
                 del self._by_seq[seq]
 
     # ---- lookup / restore --------------------------------------------
     def lookup(self, tokens: Sequence[int],
-               max_tokens: Optional[int] = None) -> Tuple[object, int]:
-        """Longest demoted block-aligned prefix of `tokens` — the host
-        half of the engine's `_lookup_prefix` (and of the router's
-        `prefix_peek`, which may call from another thread: failures
-        here are a missed hint, never an error)."""
+               max_tokens: Optional[int] = None,
+               namespace=None) -> Tuple[object, int]:
+        """Longest demoted block-aligned prefix of `tokens` under
+        `namespace` — the host half of the engine's `_lookup_prefix`
+        (and of the router's `prefix_peek`, which may call from another
+        thread: failures here are a missed hint, never an error)."""
         try:
-            key, hit = self._index.lookup(tokens, max_tokens)
+            key, hit = self._index.lookup(tokens, max_tokens,
+                                          namespace=namespace)
         except Exception:  # racy cross-thread peek — affinity is a hint
             return None, 0
         if key is None or key not in self._entries:

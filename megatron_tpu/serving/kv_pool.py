@@ -283,16 +283,20 @@ class RetainedPrefix:
     physical blocks covering its first `length` tokens (ALL ring
     blocks for a rolling pool — the whole window is live), plus the
     token sequence for index/continuation checks. Holds NO grid row:
-    retained capacity is bounded by free blocks, not by slots."""
+    retained capacity is bounded by free blocks, not by slots.
+    `namespace` is the adapter id the KV was computed under (None =
+    base model) — it rides into the prefix index and the host tier so
+    a cross-adapter clone is structurally impossible."""
 
-    __slots__ = ("key", "blocks", "length", "tokens")
+    __slots__ = ("key", "blocks", "length", "tokens", "namespace")
 
     def __init__(self, key, blocks: List[int], length: int,
-                 tokens: List[int]):
+                 tokens: List[int], namespace=None):
         self.key = key
         self.blocks = blocks
         self.length = length
         self.tokens = tokens
+        self.namespace = namespace
 
 
 class SlotKVPool:
@@ -589,7 +593,8 @@ class SlotKVPool:
         self._sync_map()
         self._free.append(slot)
 
-    def retain_row(self, slot: int, length: int, tokens: List[int]):
+    def retain_row(self, slot: int, length: int, tokens: List[int],
+                   namespace=None):
         """Finished request, block mode: convert the row into a
         row-less RetainedPrefix pinning only the blocks covering
         `length` tokens (ALL ring blocks for rolling pools — the
@@ -614,7 +619,8 @@ class SlotKVPool:
             self._rc[b] += 1  # the entry's refs, before the row drops its own
         self.release_row(slot)
         self._retained[key] = RetainedPrefix(key, blocks, int(length),
-                                             list(tokens))
+                                             list(tokens),
+                                             namespace=namespace)
         if (self.retained_limit is not None
                 and len(self._retained) > self.retained_limit):
             self._evict_retained()

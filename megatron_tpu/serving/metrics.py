@@ -72,6 +72,16 @@ _BASE_COUNTERS = (
     "router_failovers", "router_retries", "host_tier_hits",
     "host_tier_demotions", "host_tier_checksum_misses",
     "stream_reconnects",
+    # multi-tenant LoRA serving (serving/adapters.py): adapter_loads =
+    # device-bank writes (cold load, host restore, or disk reload),
+    # adapter_evictions = LRU demotions of resident adapters under
+    # bank pressure, adapter_host_hits = loads served from the
+    # checksummed host-RAM overflow instead of disk,
+    # adapter_host_checksum_misses = demoted copies dropped because
+    # their checksum no longer verified (a corrupt demotion is a
+    # reload-from-disk miss, never wrong weights)
+    "adapter_loads", "adapter_evictions", "adapter_host_hits",
+    "adapter_host_checksum_misses",
 )
 
 
@@ -118,6 +128,10 @@ class ServingMetrics:
         # resolve/scatter bracket, 2 = block-native Pallas kernel.
         self.kv_gather_bytes_per_step = 0
         self.kv_attn_path = 0
+        # multi-tenant LoRA serving: device-resident (non-identity)
+        # adapters right now — 0 on adapterless engines, pushed by the
+        # engine on pool churn like the KV gauges
+        self.active_adapters = 0
 
     # ---- recording ---------------------------------------------------
     def count(self, name: str, n: int = 1):
@@ -147,6 +161,12 @@ class ServingMetrics:
             self.kv_blocks_used = int(blocks_used)
             self.kv_blocks_retained = int(blocks_retained)
             self.kv_bytes_wasted = int(bytes_wasted)
+
+    def set_adapter_gauge(self, active: int):
+        """Engine-pushed count of device-resident LoRA adapters
+        (serving/adapters.py AdapterBank.active_count)."""
+        with self._lock:
+            self.active_adapters = int(active)
 
     def set_attn_gauges(self, gather_bytes_per_step: int, path: int):
         """Engine-pushed attention-path gauges (per sync window):
@@ -200,7 +220,8 @@ class ServingMetrics:
                       "kv_bytes_wasted": float(self.kv_bytes_wasted),
                       "kv_gather_bytes_per_step":
                           float(self.kv_gather_bytes_per_step),
-                      "kv_attn_path": float(self.kv_attn_path)}
+                      "kv_attn_path": float(self.kv_attn_path),
+                      "active_adapters": float(self.active_adapters)}
         out = {k: 0.0 for k in _BASE_COUNTERS}
         out.update({k: float(v) for k, v in counters.items()})
         out.update(gauges)

@@ -18,6 +18,17 @@ a prompt's path gives the longest reusable prefix in one walk.
 `lookup` prefers the most recently indexed slot at the deepest node
 (ties go to the warmest KV). All methods run on the engine thread only
 — no locking.
+
+Every entry lives under a NAMESPACE (multi-tenant LoRA serving,
+serving/adapters.py): the namespace is the request's adapter_id (None
+for the base model) and is the FIRST node on every indexed path, so a
+same-tokens/different-adapter lookup structurally cannot hit — KV
+computed under adapter A is a different function of the tokens than KV
+under adapter B (or the base), and cloning it would be silently wrong
+output, not a cache win. Keyed by the stable adapter ID, not the bank
+row index: bank rows are recycled across adapter loads, and an index
+keyed on them would resurrect stale prefixes for whichever adapter
+lands in the row next.
 """
 from __future__ import annotations
 
@@ -50,16 +61,22 @@ class PrefixIndex:
     def __len__(self) -> int:
         return len(self._blocks)
 
-    def insert(self, slot: int, tokens: Sequence[int]):
-        """(Re)index `slot` as holding valid KV for `tokens[0:len)`.
+    @staticmethod
+    def _ns_key(namespace) -> tuple:
+        # tagged so a namespace id can never collide with a token block
+        return ("ns", namespace)
+
+    def insert(self, slot: int, tokens: Sequence[int], namespace=None):
+        """(Re)index `slot` as holding valid KV for `tokens[0:len)`
+        COMPUTED UNDER `namespace` (the adapter id; None = base model).
         Called at admission (the prompt) and again at retain time (the
         prompt + generated tokens, which the decode loop has already
         written into the region). Re-inserting replaces the old path."""
         self.remove(slot)
         g = self.granularity
         n_blocks = len(tokens) // g
-        blocks = [tuple(tokens[i * g:(i + 1) * g])
-                  for i in range(n_blocks)]
+        blocks = [self._ns_key(namespace)] + [
+            tuple(tokens[i * g:(i + 1) * g]) for i in range(n_blocks)]
         node = self._root
         for b in blocks:
             node = node.children.setdefault(b, _Node())
@@ -89,15 +106,19 @@ class PrefixIndex:
                 del parent.children[b]
 
     def lookup(self, tokens: Sequence[int],
-               max_tokens: Optional[int] = None
+               max_tokens: Optional[int] = None, namespace=None
                ) -> Tuple[Optional[int], int]:
         """Longest bucket-aligned prefix of `tokens` held by an indexed
-        slot, capped at `max_tokens` (the engine passes len(prompt)-1:
-        at least one suffix token must forward to produce sampling
-        logits). Returns (slot, matched_len) or (None, 0)."""
+        slot IN `namespace`, capped at `max_tokens` (the engine passes
+        len(prompt)-1: at least one suffix token must forward to
+        produce sampling logits). Returns (slot, matched_len) or
+        (None, 0). Entries under any other namespace are invisible —
+        cross-adapter prefix hits are structurally impossible."""
         g = self.granularity
         limit = len(tokens) if max_tokens is None else max_tokens
-        node = self._root
+        node = self._root.children.get(self._ns_key(namespace))
+        if node is None or not node.slots:
+            return (None, 0)
         best: Tuple[Optional[int], int] = (None, 0)
         depth = 0
         while (depth + 1) * g <= limit:

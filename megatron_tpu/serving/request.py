@@ -59,7 +59,8 @@ class GenRequest:
                  sampling: SamplingOptions = SamplingOptions(),
                  seed: int = 0, priority: int = 0,
                  deadline_s: Optional[float] = None,
-                 arrival_id: Optional[int] = None):
+                 arrival_id: Optional[int] = None,
+                 adapter_id=None):
         assert prompt, "empty prompt"
         assert max_new_tokens >= 0, max_new_tokens
         # `arrival_id` lets the router's failover retries preserve the
@@ -129,6 +130,19 @@ class GenRequest:
         # Unlike draft proposals (droppable, re-proposed every window)
         # this IS committed sampling state.
         self.resume_reject = -1
+        # multi-tenant LoRA serving (serving/adapters.py): the adapter
+        # this request decodes under (None = base model) and the bank
+        # row the engine resolved it to at admission (0 = identity;
+        # engine-thread bookkeeping, re-resolved after preemption /
+        # restart — the bank row may have been recycled meanwhile, the
+        # ID is the stable key). `adapter_ns` is the (id, registration
+        # generation) prefix-cache namespace captured at FIRST
+        # admission: a re-register mid-flight changes the generation,
+        # and the engine fails the request rather than resume its
+        # stream under different weights.
+        self.adapter_id = adapter_id
+        self.adapter_ns = None
+        self.bank_idx = 0
 
     def effective_prompt(self) -> List[int]:
         """Tokens whose KV must be slot-resident before the next decode

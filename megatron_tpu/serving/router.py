@@ -58,7 +58,8 @@ UP, DOWN, PROBING = "up", "down", "probing"
 
 # gauges summed across replicas in the aggregate /metrics snapshot
 _SUM_GAUGES = ("queue_depth", "active_slots", "num_slots",
-               "kv_blocks_used", "kv_blocks_retained", "kv_bytes_wasted")
+               "kv_blocks_used", "kv_blocks_retained", "kv_bytes_wasted",
+               "active_adapters")
 
 
 class NoReplicaAvailableError(ServiceUnavailableError):
@@ -398,11 +399,17 @@ class EngineRouter:
         return float(waiting) * max(
             float(h.get("service_time_ewma_ms", 0.0)), 1.0)
 
-    def _pick_locked(self, tokens: Sequence[int], exclude=()):
+    def _pick_locked(self, tokens: Sequence[int], exclude=(),
+                     adapter_id=None):
         """(replica, is_canary): longest `prefix_peek` match among UP
-        replicas, ties by least-loaded. A PROBING replica with no
-        canary in flight takes ONE request first — that request IS the
-        canary."""
+        replicas, then ADAPTER LOCALITY (a replica already holding the
+        request's adapter on device — 2 — beats one a host-restore or
+        disk reload away — 1; serving/adapters.py), ties by
+        least-loaded. Prefix affinity outranks adapter locality
+        because a prefix hit saves forward FLOPs every time while a
+        cold adapter load is paid once and then resident. A PROBING
+        replica with no canary in flight takes ONE request first —
+        that request IS the canary."""
         self._refresh_locked()
         for rep in self.replicas:
             if rep.idx in exclude:
@@ -413,8 +420,10 @@ class EngineRouter:
         for rep in self.replicas:
             if rep.idx in exclude or rep.state != UP:
                 continue
-            pfx = rep.engine.prefix_peek(tokens)
-            key = (-pfx, self._load(rep), rep.idx)
+            pfx = rep.engine.prefix_peek(tokens, adapter_id)
+            apeek = (rep.engine.adapter_peek(adapter_id)
+                     if adapter_id is not None else 0)
+            key = (-pfx, -apeek, self._load(rep), rep.idx)
             if best_key is None or key < best_key:
                 best, best_key = rep, key
         if best is None:
@@ -441,13 +450,15 @@ class EngineRouter:
         while True:
             with self._lock:
                 rep, is_canary = self._pick_locked(
-                    spec["prompt"], exclude=tried | set(exclude))
+                    spec["prompt"], exclude=tried | set(exclude),
+                    adapter_id=spec.get("adapter_id"))
                 if rep is None and exclude and not relaxed:
                     # the excluded (just-failed) replica may be the only
                     # one left standing — re-admit it rather than 503
                     relaxed = True
-                    rep, is_canary = self._pick_locked(spec["prompt"],
-                                                       exclude=tried)
+                    rep, is_canary = self._pick_locked(
+                        spec["prompt"], exclude=tried,
+                        adapter_id=spec.get("adapter_id"))
                 if rep is None:
                     break
                 if is_canary:
@@ -460,7 +471,8 @@ class EngineRouter:
                     spec["sampling"], seed=spec["seed"],
                     priority=spec["priority"],
                     deadline_s=spec["deadline_s"],
-                    arrival_id=rreq.arrival_id)
+                    arrival_id=rreq.arrival_id,
+                    adapter_id=spec.get("adapter_id"))
             except AdmissionError:
                 with self._lock:
                     if rep.canary is rreq:
@@ -494,11 +506,12 @@ class EngineRouter:
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 64,
                sampling: SamplingOptions = SamplingOptions(),
                seed: int = 0, priority: int = 0,
-               deadline_s: Optional[float] = None) -> RouterRequest:
+               deadline_s: Optional[float] = None,
+               adapter_id=None) -> RouterRequest:
         rreq = RouterRequest(self, dict(
             prompt=list(prompt), max_new_tokens=int(max_new_tokens),
             sampling=sampling, seed=int(seed), priority=int(priority),
-            deadline_s=deadline_s))
+            deadline_s=deadline_s, adapter_id=adapter_id))
         # (requests_received is counted by the replica each attempt
         # lands on — the aggregate snapshot sums those; counting here
         # too would double it)
@@ -523,9 +536,24 @@ class EngineRouter:
                 pass
         return n
 
-    def prefix_peek(self, tokens: Sequence[int]) -> int:
-        return max(rep.engine.prefix_peek(tokens)
+    def prefix_peek(self, tokens: Sequence[int], adapter_id=None) -> int:
+        return max(rep.engine.prefix_peek(tokens, adapter_id)
                    for rep in self.replicas)
+
+    def adapter_peek(self, adapter_id) -> int:
+        return max(rep.engine.adapter_peek(adapter_id)
+                   for rep in self.replicas)
+
+    def register_adapter(self, adapter_id, path: Optional[str] = None,
+                         factors=None, rank: Optional[int] = None,
+                         alpha: float = 1.0):
+        """Register on EVERY replica: failover must be able to resume
+        an adapter request on any survivor (each bank loads lazily —
+        registration is host-side bookkeeping + eager validation)."""
+        for rep in self.replicas:
+            rep.engine.register_adapter(adapter_id, path=path,
+                                        factors=factors, rank=rank,
+                                        alpha=alpha)
 
     def health(self) -> dict:
         """Router-level `/healthz` payload: `state` distinguishes

@@ -1,0 +1,196 @@
+"""LoRA finetuning: train low-rank adapter factors with the base
+frozen, and export them in the versioned `.npz` format the serving
+bank loads — the training side feeding the serving side end to end
+(Hu et al., 2021; the serving half is serving/adapters.py).
+
+The forward is the SAME adapters seam the serving engine compiles
+(models/attention.py `adapters=`): training builds a single-adapter
+stacked `LoraAdapter` (bank capacity 1, every row index 0) and
+differentiates `lm.loss_fn` with respect to the factors only. That
+shared seam is what makes the round trip exact: the function the
+optimizer descends is the function the engine serves, and `merge_lora`
+(base weights + A·B folded in) is the independent serial oracle the
+exactness tests pin engine outputs against.
+
+The optimizer here is a deliberately small self-contained Adam over
+the 8-leaf factor pytree — LoRA state is thousands of times smaller
+than the base model's, so none of the training stack's sharded
+optimizer machinery (ZeRO, pipelining, grad scaling) buys anything;
+what matters is that the LOSS goes through the real model forward.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models import language_model as lm
+from megatron_tpu.models.attention import LoraAdapter
+from megatron_tpu.serving.adapters import (ADAPTER_FORMAT_VERSION,
+                                           FACTOR_NAMES,
+                                           adapter_factor_shapes)
+from megatron_tpu.utils.logging import print_rank_0
+
+
+def lora_init(rng, cfg: ModelConfig, rank: int,
+              dtype=jnp.float32) -> Dict[str, jax.Array]:
+    """Factor pytree {aq,bq,...}, each with a leading layers dim. A
+    factors init gaussian, B factors ZERO — the standard LoRA start:
+    the delta begins at exactly 0 (finetuning starts from the base
+    model) and B's first gradient step switches it on."""
+    shapes = adapter_factor_shapes(cfg, rank)
+    keys = jax.random.split(rng, len(FACTOR_NAMES))
+    out = {}
+    for k, name in zip(keys, FACTOR_NAMES):
+        if name.startswith("a"):
+            out[name] = (jax.random.normal(k, shapes[name], dtype)
+                         * cfg.init_method_std)
+        else:
+            out[name] = jnp.zeros(shapes[name], dtype)
+    return out
+
+
+def lora_adapters(factors: Dict[str, jax.Array], rank: int,
+                  alpha: float, batch: int):
+    """Wrap raw factors as the `adapters=` argument for a whole-batch
+    single-adapter forward: a capacity-1 stacked bank (row 0 IS the
+    adapter — the identity-row-0 convention is the serving bank's, not
+    the model's) with the alpha/rank scale folded into B, plus an
+    all-zero index [batch]."""
+    scale = float(alpha) / float(rank)
+    stacked = LoraAdapter(**{
+        n: (f * scale if n.startswith("b") else f)[:, None]
+        for n, f in factors.items()})
+    return stacked, jnp.zeros((batch,), jnp.int32)
+
+
+def merge_lora(params, factors: Dict[str, np.ndarray], cfg: ModelConfig,
+               rank: int, alpha: float):
+    """Base params with A·B·(alpha/rank) folded into the attention
+    weights — the SERIAL ORACLE for adapter serving: an engine request
+    under this adapter must be token-exact vs a plain Generator built
+    from these merged weights. The wkv layout is (2, nkv, hd) flattened
+    (models/attention.py reshape), so k deltas land in the first
+    nkv*hd columns and v deltas in the rest."""
+    scale = float(alpha) / float(rank)
+    dkv = cfg.num_kv_heads * cfg.kv_channels
+    f = {n: jnp.asarray(factors[n], jnp.float32) for n in FACTOR_NAMES}
+
+    def delta(a, b):
+        return jnp.einsum("lir,lro->lio", a, b) * scale
+
+    # tree.map rebuilds every container, so the nested dict surgery
+    # below can never mutate the caller's params
+    merged = jax.tree.map(lambda x: x, params)
+    attn = dict(merged["transformer"]["attention"])
+    wq = attn["wq"]
+    attn["wq"] = (wq.astype(jnp.float32)
+                  + delta(f["aq"], f["bq"])).astype(wq.dtype)
+    wkv = attn["wkv"].astype(jnp.float32)
+    wkv = wkv.at[:, :, :dkv].add(delta(f["ak"], f["bk"]))
+    wkv = wkv.at[:, :, dkv:].add(delta(f["av"], f["bv"]))
+    attn["wkv"] = wkv.astype(attn["wkv"].dtype)
+    wo = attn["wo"]
+    attn["wo"] = (wo.astype(jnp.float32)
+                  + delta(f["ao"], f["bo"])).astype(wo.dtype)
+    merged["transformer"] = dict(merged["transformer"],
+                                 attention=attn)
+    return merged
+
+
+def export_adapter(path: str, factors: Dict[str, np.ndarray], *,
+                   rank: int, alpha: float,
+                   meta: Optional[dict] = None) -> str:
+    """Write the versioned `.npz` the serving bank loads
+    (serving/adapters.py load_adapter_npz): RAW (unscaled, unpadded)
+    float32 factors + format_version/rank/alpha + a JSON meta blob."""
+    arrays = {n: np.asarray(factors[n], np.float32)
+              for n in FACTOR_NAMES}
+    np.savez(path,
+             format_version=np.int64(ADAPTER_FORMAT_VERSION),
+             rank=np.int64(rank), alpha=np.float64(alpha),
+             meta=json.dumps(meta or {}), **arrays)
+    return path
+
+
+def make_lora_step(base_params, cfg: ModelConfig, rank: int,
+                   alpha: float, lr: float = 1e-3, b1: float = 0.9,
+                   b2: float = 0.999, eps: float = 1e-8, rope=None):
+    """One jitted Adam step over the factor pytree, base frozen.
+    Returns (step_fn, init_opt_state): step_fn(factors, opt, tokens,
+    loss_mask) -> (factors, opt, loss). `tokens` is [b, s+1] (loss_fn's
+    shift-by-one layout)."""
+    if rope is None:
+        rope = lm.make_rope(cfg)
+
+    def loss_of(factors, tokens, loss_mask):
+        adapters = lora_adapters(factors, rank, alpha,
+                                 tokens.shape[0])
+        return lm.loss_fn(base_params, tokens, cfg,
+                          loss_mask=loss_mask, rope=rope,
+                          adapters=adapters)
+
+    def init_opt(factors):
+        z = jax.tree.map(jnp.zeros_like, factors)
+        return {"m": z, "v": jax.tree.map(jnp.zeros_like, factors),
+                "t": jnp.zeros((), jnp.int32)}
+
+    @jax.jit
+    def step(factors, opt, tokens, loss_mask):
+        loss, grads = jax.value_and_grad(loss_of)(factors, tokens,
+                                                  loss_mask)
+        t = opt["t"] + 1
+        m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g,
+                         opt["m"], grads)
+        v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g,
+                         opt["v"], grads)
+        tf = t.astype(jnp.float32)
+        corr = jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+        factors = jax.tree.map(
+            lambda p, m_, v_: p - lr * corr * m_ / (jnp.sqrt(v_) + eps),
+            factors, m, v)
+        return factors, {"m": m, "v": v, "t": t}, loss
+
+    return step, init_opt
+
+
+def run_lora_finetune(cfg, base_params, train_it, *, rank: int,
+                      alpha: float, iters: int, lr: float = 1e-3,
+                      seed: int = 0, export_path: Optional[str] = None,
+                      log_interval: int = 10):
+    """Drive LoRA training from a BatchIterator (finetune.py's
+    `--lora_rank` path): microbatches flatten into per-step [b, s+1]
+    token grids (no grad accumulation — LoRA steps are tiny), then
+    export the trained factors. Returns (factors, last_loss)."""
+    model = cfg.model
+    factors = lora_init(jax.random.PRNGKey(seed), model, rank)
+    step, init_opt = make_lora_step(base_params, model, rank, alpha,
+                                    lr=lr)
+    opt = init_opt(factors)
+    loss = float("nan")
+    for it in range(iters):
+        batch = next(train_it)
+        toks = np.asarray(batch["tokens"])
+        toks = toks.reshape(-1, toks.shape[-1])  # fold microbatches
+        mask = batch.get("loss_mask")
+        if mask is not None:
+            mask = np.asarray(mask).reshape(-1, mask.shape[-1])
+        factors, opt, loss = step(factors, opt, jnp.asarray(toks),
+                                  None if mask is None
+                                  else jnp.asarray(mask))
+        if (it + 1) % max(log_interval, 1) == 0 or it + 1 == iters:
+            print_rank_0(f"lora iter {it + 1}/{iters} "
+                         f"loss {float(loss):.4f} (rank {rank}, "
+                         f"alpha {alpha}, base frozen)")
+    factors = {n: np.asarray(f) for n, f in factors.items()}
+    if export_path:
+        export_adapter(export_path, factors, rank=rank, alpha=alpha,
+                       meta={"iters": iters, "lr": lr,
+                             "hidden_size": model.hidden_size,
+                             "num_layers": model.num_layers})
+        print_rank_0(f"lora adapter exported -> {export_path}")
+    return factors, float(loss)

@@ -200,6 +200,33 @@ def test_bench_block_attn_emits_ab_record(monkeypatch, tmp_path):
             combo["bracket"]["tokens_generated"] > 0
 
 
+def test_bench_lora_emits_ab_record(monkeypatch, tmp_path):
+    """The multi-tenant LoRA A/B must run base / one-adapter / mixed
+    arms with every row token-exact vs its own adapter's
+    merged-weights serial oracle (the tool asserts agreement itself
+    and exits nonzero on divergence), keep ONE decode compile per arm
+    with adapters enabled, and report the adapter-gather bytes/step
+    seam the on-chip comparison keys on."""
+    import json
+    text = run_tool(
+        monkeypatch, tmp_path, "bench_lora.py", ["--smoke"])
+    rec = json.loads(text.splitlines()[-1])
+    assert rec["bench"] == "lora_adapters"
+    assert rec["rows_token_exact_vs_merged_oracle"] is True
+    assert rec["one_decode_compile_per_arm"] is True
+    assert rec["adapter_gather_bytes_per_step"] > 0
+    assert [a["arm"] for a in rec["arms"]] == \
+        ["base", "one_adapter", "mixed_3"]
+    base, one, mixed = rec["arms"]
+    assert base["adapter_loads"] == 0 and base["active_adapters"] == 0
+    assert one["active_adapters"] == 1
+    assert mixed["active_adapters"] == 3
+    # every arm generated the same token volume (eos_id=-1: no early
+    # EOS — the arms measure identical work)
+    assert base["tokens_generated"] == one["tokens_generated"] == \
+        mixed["tokens_generated"] > 0
+
+
 def test_bench_spec_emits_ab_record(monkeypatch, tmp_path):
     """The speculative-decode A/B must run greedy arms token-exact vs
     the k=0 baseline (the tool asserts agreement itself and exits

@@ -1,0 +1,726 @@
+"""Multi-tenant LoRA serving tests (serving/adapters.py + the
+adapters seam through models/attention.py and the engine).
+
+The load-bearing contracts (ISSUE 12 acceptance):
+- adapter_slots=0 is bit-identical to the adapterless engine, and
+  base-model requests on an adapters-enabled engine ride the identity
+  row with unchanged outputs;
+- a request under adapter k is token-exact vs a SERIAL engine whose
+  base weights have A·B·(alpha/rank) merged in (training/lora.py
+  merge_lora) — for bf16 AND int8 KV pools;
+- mixed-adapter batches are row-independent: each row matches its own
+  single-adapter run;
+- decode + speculative verify stay at ONE compile each with adapters
+  enabled (adapter indices are data);
+- bank eviction under pressure demotes to host RAM checksummed; a
+  corrupt demotion is a reload-from-disk miss, never wrong weights;
+- cross-adapter prefix-cache hits are structurally impossible (the
+  namespace is the first node on every indexed path);
+- the training side (lora_init -> adam steps -> export_adapter) feeds
+  the serving side end to end.
+"""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.config import ModelConfig, ServingConfig
+from megatron_tpu.inference.generation import Generator, SamplingParams
+from megatron_tpu.models import language_model as lm
+from megatron_tpu.serving import (AdapterBank, PrefixIndex,
+                                  SamplingOptions, ServingEngine,
+                                  ServingMetrics, UnknownAdapterError)
+from megatron_tpu.serving.host_tier import HostKVTier
+from megatron_tpu.training.lora import (export_adapter, lora_init,
+                                        make_lora_step, merge_lora)
+
+GREEDY = SamplingOptions(temperature=0.0)
+
+
+def tiny_cfg(**overrides):
+    # fp32 activations: the exactness pins compare the engine's
+    # FACTORED low-rank path against MERGED-weights serial oracles —
+    # ~1e-7 associativity drift, which bf16 rounding would amplify
+    # into flipped greedy tokens (numerics, not bugs)
+    base = dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+                num_kv_heads=2, vocab_size=96, seq_length=64,
+                make_vocab_size_divisible_by=32,
+                compute_dtype="float32")
+    base.update(overrides)
+    return ModelConfig(**base).derived()
+
+
+def make_factors(cfg, rank, seed):
+    """Random NONZERO factors (lora_init's B=0 start would make the
+    delta — and therefore every adapter-vs-base distinction — vanish)."""
+    from megatron_tpu.serving.adapters import random_adapter_factors
+    return random_adapter_factors(cfg, rank, seed)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = tiny_cfg()
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+@pytest.fixture(scope="module")
+def two_adapters(tiny_model):
+    _, cfg = tiny_model
+    return {"tenant-a": make_factors(cfg, 4, seed=11),
+            "tenant-b": make_factors(cfg, 4, seed=22)}
+
+
+RANK, ALPHA = 4, 8.0
+PROMPTS = [[5, 17, 3, 42], [7, 8, 9], [11, 12, 13, 14], [21, 22, 23]]
+
+
+def serial_oracle(params, cfg, factors=None, kv_dtype=jnp.bfloat16):
+    """Merged-weights serial Generator — the independent reference a
+    factored engine request must reproduce token-for-token."""
+    p = (params if factors is None
+         else merge_lora(params, factors, cfg, RANK, ALPHA))
+    return Generator(p, cfg, eos_id=0, pad_id=0, kv_cache_dtype=kv_dtype)
+
+
+def serial_tokens(oracle, prompt, n, sampling=SamplingParams(
+        temperature=0.0), seed=0):
+    t, lens, _ = oracle.generate([prompt], n, sampling=sampling,
+                                 seed=seed)
+    return t[0, :lens[0]].tolist()
+
+
+class TestAdaptersOffBitIdentical:
+    def test_base_requests_match_adapterless_engine(self, tiny_model,
+                                                    two_adapters):
+        """An adapters-ENABLED engine serving base (no adapter_id)
+        requests — greedy AND seeded-stochastic — reproduces the
+        adapterless engine token-for-token, and the decode step still
+        compiles exactly once: index 0 is the identity adapter."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        arms = [(GREEDY, 0), (SamplingOptions(temperature=0.9, top_k=5),
+                              100)]
+        outs = {}
+        for slots in (0, 2):
+            sc = ServingConfig(num_slots=2, max_len=64,
+                               adapter_slots=slots,
+                               adapter_rank=RANK).validate(cfg)
+            with ServingEngine(gen, sc) as eng:
+                if slots:
+                    for aid, f in two_adapters.items():
+                        eng.register_adapter(aid, factors=f, rank=RANK,
+                                             alpha=ALPHA)
+                got = []
+                for sampling, seed0 in arms:
+                    reqs = [eng.submit(p, 6, sampling, seed=seed0 + i)
+                            for i, p in enumerate(PROMPTS)]
+                    got.append([r.result(timeout=300)[0] for r in reqs])
+                assert eng._decode_traces == 1
+                outs[slots] = got
+        assert outs[0] == outs[2], (
+            "base requests through the identity adapter row diverged "
+            "from the adapterless engine")
+
+
+class TestAdapterExactness:
+    @pytest.mark.parametrize("kv", ["bfloat16", "int8"])
+    def test_adapter_serving_matches_merged_oracle(self, tiny_model,
+                                                   two_adapters, kv):
+        """Adapter-k requests are token-exact vs the serial engine
+        with A·B merged into the base weights — bf16 AND int8 pools
+        (the int8 arm quantizes the same KV the oracle's int8 cache
+        does, so the clone discipline carries over unchanged)."""
+        params, cfg = tiny_model
+        kv_dtype = jnp.int8 if kv == "int8" else jnp.bfloat16
+        gen = Generator(params, cfg, eos_id=0, pad_id=0,
+                        kv_cache_dtype=kv_dtype)
+        sc = ServingConfig(num_slots=2, max_len=64, kv_dtype=kv,
+                           adapter_slots=2,
+                           adapter_rank=RANK).validate(cfg)
+        with ServingEngine(gen, sc) as eng:
+            for aid, f in two_adapters.items():
+                eng.register_adapter(aid, factors=f, rank=RANK,
+                                     alpha=ALPHA)
+            for aid, f in two_adapters.items():
+                oracle = serial_oracle(params, cfg, f, kv_dtype)
+                for p in PROMPTS[:2]:
+                    got, _ = eng.submit(p, 6, GREEDY, seed=0,
+                                        adapter_id=aid).result(
+                                            timeout=300)
+                    assert got == serial_tokens(oracle, p, 6), (
+                        kv, aid, p)
+
+    def test_mixed_adapter_batch_rows_match_single_adapter_runs(
+            self, tiny_model, two_adapters):
+        """6 concurrent requests across base/tenant-a/tenant-b in ONE
+        grid: every row equals the run where only its adapter's
+        requests exist — batching heterogeneous adapters is row-
+        independent (the Punica contract)."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        assignment = [None, "tenant-a", "tenant-b",
+                      "tenant-a", None, "tenant-b"]
+        prompts = [PROMPTS[i % len(PROMPTS)] for i in range(6)]
+
+        def run(pairs):
+            sc = ServingConfig(num_slots=3, max_len=64, adapter_slots=2,
+                               adapter_rank=RANK).validate(cfg)
+            with ServingEngine(gen, sc) as eng:
+                for aid, f in two_adapters.items():
+                    eng.register_adapter(aid, factors=f, rank=RANK,
+                                         alpha=ALPHA)
+                reqs = [eng.submit(p, 6, GREEDY, seed=0, adapter_id=a)
+                        for p, a in pairs]
+                return [r.result(timeout=300)[0] for r in reqs]
+
+        mixed = run(list(zip(prompts, assignment)))
+        for aid in (None, "tenant-a", "tenant-b"):
+            only = [(p, a) for p, a in zip(prompts, assignment)
+                    if a == aid]
+            solo = run(only)
+            got = [t for t, a in zip(mixed, assignment) if a == aid]
+            assert got == solo, f"mixed rows under {aid!r} moved"
+
+    def test_decode_and_verify_one_compile_with_adapters(
+            self, tiny_model, two_adapters):
+        """Speculative engine + adapters: mixed traffic across
+        adapters keeps decode AND verify at one trace each (adapter
+        ids are data), and greedy outputs stay exact vs the merged
+        oracles."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sc = ServingConfig(num_slots=2, max_len=64, adapter_slots=2,
+                           adapter_rank=RANK,
+                           speculative_k=2).validate(cfg)
+        # repetitive motifs so the self-drafting matcher proposes
+        motif = [9, 4, 9, 4, 9, 4, 9, 4]
+        with ServingEngine(gen, sc) as eng:
+            for aid, f in two_adapters.items():
+                eng.register_adapter(aid, factors=f, rank=RANK,
+                                     alpha=ALPHA)
+            reqs = [eng.submit(motif, 8, GREEDY, seed=i, adapter_id=a)
+                    for i, a in enumerate([None, "tenant-a", "tenant-b",
+                                           "tenant-a"])]
+            outs = [r.result(timeout=300)[0] for r in reqs]
+            assert eng._decode_traces == 1
+            assert eng._verify_traces == 1
+            assert eng.metrics.snapshot()["spec_rounds"] >= 1
+        for out, aid in zip(outs, [None, "tenant-a", "tenant-b",
+                                   "tenant-a"]):
+            oracle = serial_oracle(params, cfg,
+                                   two_adapters.get(aid))
+            assert out == serial_tokens(oracle, motif, 8), aid
+
+
+class TestAdmission:
+    def test_unknown_adapter_is_400(self, tiny_model):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sc = ServingConfig(num_slots=1, max_len=64, adapter_slots=1,
+                           adapter_rank=RANK).validate(cfg)
+        with ServingEngine(gen, sc, start=False) as eng:
+            with pytest.raises(UnknownAdapterError):
+                eng.submit([1, 2, 3], 4, adapter_id="nope")
+            assert eng.metrics.snapshot()["requests_rejected"] == 1
+
+    def test_adapter_on_adapterless_engine_is_400(self, tiny_model):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        with ServingEngine(gen, ServingConfig(num_slots=1, max_len=64),
+                           start=False) as eng:
+            with pytest.raises(UnknownAdapterError):
+                eng.submit([1, 2, 3], 4, adapter_id="tenant-a")
+
+    def test_more_live_adapters_than_bank_rows_requeues(self,
+                                                        tiny_model,
+                                                        two_adapters):
+        """adapter_slots=1 with two distinct live adapters: the second
+        request waits for the first's pin to free (AdapterBankFullError
+        -> requeue, never a crash or a stranded future) and then
+        completes exact."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sc = ServingConfig(num_slots=2, max_len=64, adapter_slots=1,
+                           adapter_rank=RANK).validate(cfg)
+        with ServingEngine(gen, sc) as eng:
+            for aid, f in two_adapters.items():
+                eng.register_adapter(aid, factors=f, rank=RANK,
+                                     alpha=ALPHA)
+            ra = eng.submit(PROMPTS[0], 8, GREEDY, seed=0,
+                            adapter_id="tenant-a")
+            rb = eng.submit(PROMPTS[1], 8, GREEDY, seed=0,
+                            adapter_id="tenant-b")
+            ta, _ = ra.result(timeout=300)
+            tb, _ = rb.result(timeout=300)
+        assert ta == serial_tokens(
+            serial_oracle(params, cfg, two_adapters["tenant-a"]),
+            PROMPTS[0], 8)
+        assert tb == serial_tokens(
+            serial_oracle(params, cfg, two_adapters["tenant-b"]),
+            PROMPTS[1], 8)
+
+
+class TestBankEvictionAndHostTier:
+    def _npz(self, tmp_path, cfg, name, seed):
+        f = make_factors(cfg, RANK, seed=seed)
+        path = str(tmp_path / f"{name}.npz")
+        export_adapter(path, f, rank=RANK, alpha=ALPHA)
+        return f, path
+
+    def _folded(self, cfg, factors):
+        from megatron_tpu.serving.adapters import fold_factors
+        return fold_factors(factors, RANK, ALPHA, cfg, RANK)
+
+    def test_pressure_demotes_and_restores_checksummed(self, tiny_model,
+                                                       tmp_path):
+        """1-row bank, 2 adapters: loading the second demotes the
+        first to the checksummed host tier; re-acquiring the first is
+        a host hit (no disk), and the device row holds the right
+        folded factors after every swap."""
+        _, cfg = tiny_model
+        fa, pa = self._npz(tmp_path, cfg, "a", 1)
+        fb, pb = self._npz(tmp_path, cfg, "b", 2)
+        metrics = ServingMetrics()
+        bank = AdapterBank(cfg, slots=1, rank=RANK,
+                           host_bytes=1 << 22, metrics=metrics)
+        bank.register("a", path=pa)
+        bank.register("b", path=pb)
+        ia = bank.acquire("a")
+        bank.release(ia)
+        ib = bank.acquire("b")  # evicts a -> host
+        bank.release(ib)
+        snap = metrics.snapshot()
+        assert snap["adapter_evictions"] == 1
+        assert snap["adapter_host_hits"] == 0
+        ia2 = bank.acquire("a")  # restores a from host, evicts b
+        snap = metrics.snapshot()
+        assert snap["adapter_host_hits"] == 1
+        assert snap["adapter_evictions"] == 2
+        want = self._folded(cfg, fa)
+        got = np.asarray(bank.stacked.bq[:, ia2])
+        np.testing.assert_allclose(got, want["bq"], rtol=0, atol=0)
+        bank.release(ia2)
+
+    def test_corrupt_demotion_is_reload_from_disk_miss(self, tiny_model,
+                                                       tmp_path):
+        """Flip a byte in a demoted adapter's host copy: the next
+        acquire fails the checksum, counts the miss, RELOADS from the
+        .npz, and the device row still holds the correct factors —
+        wrong weights are structurally impossible."""
+        _, cfg = tiny_model
+        fa, pa = self._npz(tmp_path, cfg, "a", 3)
+        _, pb = self._npz(tmp_path, cfg, "b", 4)
+        metrics = ServingMetrics()
+        bank = AdapterBank(cfg, slots=1, rank=RANK,
+                           host_bytes=1 << 22, metrics=metrics)
+        bank.register("a", path=pa)
+        bank.register("b", path=pb)
+        bank.release(bank.acquire("a"))
+        bank.release(bank.acquire("b"))  # a demoted to host
+        assert "a" in bank._host
+        bank._host["a"].arrays["bq"][0, 0, 0] += 1.0  # corrupt it
+        ia = bank.acquire("a")
+        snap = metrics.snapshot()
+        assert snap["adapter_host_checksum_misses"] == 1
+        want = self._folded(cfg, fa)
+        got = np.asarray(bank.stacked.bq[:, ia])
+        np.testing.assert_allclose(got, want["bq"], rtol=0, atol=0)
+        bank.release(ia)
+
+    def test_engine_level_eviction_never_crashes(self, tiny_model,
+                                                 two_adapters):
+        """Serving a1 -> a2 -> a1 through a 1-row bank: every request
+        completes exact (loads/evictions churn under the hood)."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sc = ServingConfig(num_slots=1, max_len=64, adapter_slots=1,
+                           adapter_rank=RANK,
+                           adapter_host_bytes=1 << 22).validate(cfg)
+        with ServingEngine(gen, sc) as eng:
+            for aid, f in two_adapters.items():
+                eng.register_adapter(aid, factors=f, rank=RANK,
+                                     alpha=ALPHA)
+            for aid in ("tenant-a", "tenant-b", "tenant-a"):
+                got, _ = eng.submit(
+                    PROMPTS[0], 6, GREEDY, seed=0,
+                    adapter_id=aid).result(timeout=300)
+                oracle = serial_oracle(params, cfg, two_adapters[aid])
+                assert got == serial_tokens(oracle, PROMPTS[0], 6), aid
+            snap = eng.metrics.snapshot()
+            assert snap["adapter_loads"] >= 3
+            assert snap["adapter_evictions"] >= 2
+
+
+class TestReRegistration:
+    def test_reregister_serves_fresh_weights_and_fresh_namespace(
+            self, tiny_model):
+        """Re-registering an adapter_id with NEW factors must (a)
+        serve the new weights (the stale device row is unmapped at
+        register), and (b) never prefix-hit KV retained under the OLD
+        registration — the namespace is (id, generation)."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        f1 = make_factors(cfg, RANK, seed=31)
+        f2 = make_factors(cfg, RANK, seed=32)
+        sc = ServingConfig(num_slots=2, max_len=64, adapter_slots=1,
+                           adapter_rank=RANK, enable_prefix_cache=True,
+                           kv_block_size=16,
+                           prefill_bucket=16).validate(cfg)
+        prompt = list(range(1, 21))
+        with ServingEngine(gen, sc) as eng:
+            eng.register_adapter("t", factors=f1, rank=RANK, alpha=ALPHA)
+            v1, _ = eng.submit(prompt, 4, GREEDY, seed=0,
+                               adapter_id="t").result(timeout=300)
+            assert v1 == serial_tokens(serial_oracle(params, cfg, f1),
+                                       prompt, 4)
+            assert eng.prefix_peek(prompt, "t") >= 16
+            eng.register_adapter("t", factors=f2, rank=RANK, alpha=ALPHA)
+            # the v1 KV is invisible to the new generation
+            assert eng.prefix_peek(prompt, "t") == 0
+            v2, _ = eng.submit(prompt, 4, GREEDY, seed=0,
+                               adapter_id="t").result(timeout=300)
+            snap = eng.metrics.snapshot()
+            assert snap["prefix_hits"] == 0, (
+                "cross-generation prefix clone happened")
+            assert v2 == serial_tokens(serial_oracle(params, cfg, f2),
+                                       prompt, 4)
+            assert v2 != v1  # the new weights actually took effect
+            # and the SAME generation's repeat does hit
+            v2b, _ = eng.submit(prompt, 4, GREEDY, seed=0,
+                                adapter_id="t").result(timeout=300)
+            assert eng.metrics.snapshot()["prefix_hits"] >= 1
+            assert v2b == v2
+
+    def test_bank_reregister_unmaps_resident_row(self, tiny_model):
+        from megatron_tpu.serving.adapters import fold_factors
+        _, cfg = tiny_model
+        f1 = make_factors(cfg, RANK, seed=41)
+        f2 = make_factors(cfg, RANK, seed=42)
+        bank = AdapterBank(cfg, slots=1, rank=RANK,
+                           metrics=ServingMetrics())
+        bank.register("t", factors=f1, rank=RANK, alpha=ALPHA)
+        bank.release(bank.acquire("t"))
+        bank.register("t", factors=f2, rank=RANK, alpha=ALPHA)
+        i = bank.acquire("t")
+        want = fold_factors(f2, RANK, ALPHA, cfg, RANK)
+        np.testing.assert_array_equal(np.asarray(bank.stacked.bq[:, i]),
+                                      want["bq"])
+        bank.release(i)
+
+
+class TestPrefixNamespaces:
+    def test_index_same_tokens_different_namespace_misses(self):
+        idx = PrefixIndex(4)
+        toks = list(range(1, 13))
+        idx.insert(0, toks, namespace="a")
+        # same tokens, different adapter -> structurally no hit
+        assert idx.lookup(toks, len(toks) - 1, namespace=None) == (None, 0)
+        assert idx.lookup(toks, len(toks) - 1, namespace="b") == (None, 0)
+        assert idx.lookup(toks, len(toks) - 1, namespace="a") == (0, 8)
+        # removal prunes the namespaced path too
+        idx.remove(0)
+        assert idx.lookup(toks, len(toks) - 1, namespace="a") == (None, 0)
+        assert not idx._root.children
+
+    def test_host_tier_namespace_isolation(self):
+        tier = HostKVTier(1 << 20, granularity=4)
+        toks = list(range(1, 13))
+        arrays = {"k": np.zeros((2, 2, 4, 2, 8), np.float32)}
+        assert tier.demote(("ret", 1), toks, 8, arrays, namespace="a")
+        assert tier.lookup(toks, len(toks) - 1, namespace=None) == (None, 0)
+        assert tier.lookup(toks, len(toks) - 1, namespace="b") == (None, 0)
+        key, hit = tier.lookup(toks, len(toks) - 1, namespace="a")
+        assert key == ("ret", 1) and hit == 8
+        # same tokens under ANOTHER namespace dedup separately
+        assert tier.demote(("ret", 2), toks, 8,
+                           {"k": np.ones((2, 2, 4, 2, 8), np.float32)},
+                           namespace="b")
+        assert len(tier) == 2  # not deduped across namespaces
+
+    def test_engine_cross_adapter_prefix_hit_impossible(self, tiny_model,
+                                                        two_adapters):
+        """Retained KV decoded under tenant-a must never clone into a
+        base or tenant-b request with the SAME prompt; the same-adapter
+        request does hit."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sc = ServingConfig(num_slots=2, max_len=64, adapter_slots=2,
+                           adapter_rank=RANK, enable_prefix_cache=True,
+                           kv_block_size=16,
+                           prefill_bucket=16).validate(cfg)
+        prompt = list(range(1, 21))  # > one 16-token block
+        with ServingEngine(gen, sc) as eng:
+            for aid, f in two_adapters.items():
+                eng.register_adapter(aid, factors=f, rank=RANK,
+                                     alpha=ALPHA)
+            eng.submit(prompt, 4, GREEDY, seed=0,
+                       adapter_id="tenant-a").result(timeout=300)
+            # peeks resolve per namespace (the router's signal)
+            assert eng.prefix_peek(prompt, "tenant-a") >= 16
+            assert eng.prefix_peek(prompt) == 0
+            assert eng.prefix_peek(prompt, "tenant-b") == 0
+            base_toks, _ = eng.submit(prompt, 4, GREEDY,
+                                      seed=0).result(timeout=300)
+            snap = eng.metrics.snapshot()
+            assert snap["prefix_hits"] == 0, (
+                "cross-adapter prefix clone happened")
+            assert base_toks == serial_tokens(
+                serial_oracle(params, cfg), prompt, 4)
+            # the SAME adapter's identical prompt DOES hit
+            a_toks, _ = eng.submit(prompt, 4, GREEDY, seed=0,
+                                   adapter_id="tenant-a").result(
+                                       timeout=300)
+            snap = eng.metrics.snapshot()
+            assert snap["prefix_hits"] >= 1
+            assert a_toks == serial_tokens(
+                serial_oracle(params, cfg, two_adapters["tenant-a"]),
+                prompt, 4)
+
+
+class TestValidate:
+    def test_rank_zero_rejected(self):
+        with pytest.raises(AssertionError, match="adapter_rank >= 1"):
+            ServingConfig(adapter_slots=1, adapter_rank=0).validate()
+
+    def test_serial_fallback_rejected(self):
+        with pytest.raises(AssertionError, match="serial fallback"):
+            ServingConfig(adapter_slots=1,
+                          serial_fallback=True).validate()
+
+    def test_quantized_gemm_rejected(self, tiny_model):
+        """quantize(W)·x + A·B·x != quantize(W + A·B)·x — the int8
+        quantizer is nonlinear, so the factored path cannot be
+        token-equivalent to any merged-weights reference; the combo
+        must fail loudly, not drift silently."""
+        _, _ = tiny_model
+        cfg = tiny_cfg(quantized_gemm="int8")
+        with pytest.raises(AssertionError,
+                           match="unsupported with quantized_gemm"):
+            ServingConfig(adapter_slots=1).validate(cfg)
+
+    def test_host_bytes_without_slots_rejected(self):
+        with pytest.raises(AssertionError, match="no bank to overflow"):
+            ServingConfig(adapter_host_bytes=1024).validate()
+
+    def test_bank_budget_rejected(self, tiny_model):
+        _, cfg = tiny_model
+        with pytest.raises(AssertionError,
+                           match="exceeding adapter_max_bank_bytes"):
+            ServingConfig(adapter_slots=4, adapter_rank=8,
+                          adapter_max_bank_bytes=64).validate(cfg)
+
+    def test_bank_budget_accepts_fit(self, tiny_model):
+        _, cfg = tiny_model
+        from megatron_tpu.serving.adapters import adapter_bank_nbytes
+        need = adapter_bank_nbytes(cfg, 4, 8)
+        ServingConfig(adapter_slots=4, adapter_rank=8,
+                      adapter_max_bank_bytes=need).validate(cfg)
+
+    def test_wrong_shape_adapter_rejected_at_register(self, tiny_model):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sc = ServingConfig(num_slots=1, max_len=64, adapter_slots=1,
+                           adapter_rank=RANK).validate(cfg)
+        with ServingEngine(gen, sc, start=False) as eng:
+            bad = make_factors(cfg, RANK, seed=1)
+            bad["aq"] = bad["aq"][:, :-1]  # wrong hidden dim
+            with pytest.raises(ValueError, match="shape"):
+                eng.register_adapter("bad", factors=bad, rank=RANK)
+            # rank larger than the bank's is rejected too
+            big = make_factors(cfg, RANK * 2, seed=2)
+            with pytest.raises(ValueError, match="exceeds the bank"):
+                eng.register_adapter("big", factors=big, rank=RANK * 2)
+
+
+class TestTrainExportServeRoundTrip:
+    def test_lora_train_export_serve(self, tiny_model, tmp_path):
+        """The end-to-end loop the subsystem exists for: train the
+        low-rank factors (base frozen) -> export the versioned .npz ->
+        register it on a serving engine -> the served stream is
+        token-exact vs the merged-weights oracle of the SAME trained
+        factors. Also pins that training moved the loss and only the
+        factors (the base params object is untouched)."""
+        params, cfg = tiny_model
+        rank, alpha = 4, 8.0
+        factors = lora_init(jax.random.PRNGKey(0), cfg, rank)
+        step, init_opt = make_lora_step(params, cfg, rank, alpha,
+                                        lr=5e-2)
+        opt = init_opt(factors)
+        rs = np.random.RandomState(0)
+        toks = jnp.asarray(rs.randint(1, cfg.vocab_size, (4, 17)),
+                           jnp.int32)
+        losses = []
+        for _ in range(4):
+            factors, opt, loss = step(factors, opt, toks, None)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses  # it actually trained
+        # B factors switched on (lora_init starts them at zero)
+        assert float(jnp.abs(factors["bq"]).max()) > 0
+        host = {n: np.asarray(v) for n, v in factors.items()}
+        path = str(tmp_path / "trained.npz")
+        export_adapter(path, host, rank=rank, alpha=alpha,
+                       meta={"iters": 4})
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sc = ServingConfig(num_slots=2, max_len=64, adapter_slots=1,
+                           adapter_rank=rank).validate(cfg)
+        with ServingEngine(gen, sc) as eng:
+            eng.register_adapter("trained", path=path)
+            got, _ = eng.submit(PROMPTS[0], 8, GREEDY, seed=0,
+                                adapter_id="trained").result(timeout=300)
+        oracle = Generator(merge_lora(params, host, cfg, rank, alpha),
+                           cfg, eos_id=0, pad_id=0)
+        assert got == serial_tokens(oracle, PROMPTS[0], 8)
+
+    def test_smaller_rank_zero_pads_into_bank(self, tiny_model,
+                                              tmp_path):
+        """An adapter exported at rank 2 serves exactly through a
+        rank-4 bank (zero-padded factors are the same delta)."""
+        params, cfg = tiny_model
+        f2 = make_factors(cfg, 2, seed=7)
+        path = str(tmp_path / "r2.npz")
+        export_adapter(path, f2, rank=2, alpha=4.0)
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sc = ServingConfig(num_slots=1, max_len=64, adapter_slots=1,
+                           adapter_rank=4).validate(cfg)
+        with ServingEngine(gen, sc) as eng:
+            eng.register_adapter("r2", path=path)
+            got, _ = eng.submit(PROMPTS[1], 8, GREEDY, seed=0,
+                                adapter_id="r2").result(timeout=300)
+        oracle = Generator(merge_lora(params, f2, cfg, 2, 4.0),
+                           cfg, eos_id=0, pad_id=0)
+        assert got == serial_tokens(oracle, PROMPTS[1], 8)
+
+    def test_run_lora_finetune_drives_batch_iterator(self, tiny_model,
+                                                     tmp_path):
+        """finetune.py's --lora_rank path: dict microbatches in,
+        exported .npz out, loadable by the bank."""
+        import itertools
+
+        from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
+                                         TrainingConfig)
+        from megatron_tpu.serving.adapters import load_adapter_npz
+        from megatron_tpu.training.lora import run_lora_finetune
+        params, cfg = tiny_model
+        rs = np.random.RandomState(1)
+        batch = {"tokens": rs.randint(1, cfg.vocab_size, (2, 2, 17))
+                 .astype(np.int32)}
+        mcfg = MegatronConfig(model=cfg,
+                              training=TrainingConfig(train_iters=2),
+                              optimizer=OptimizerConfig(lr=1e-2))
+        path = str(tmp_path / "ft.npz")
+        factors, loss = run_lora_finetune(
+            mcfg, params, itertools.cycle([batch]), rank=3, alpha=6.0,
+            iters=2, lr=1e-2, export_path=path)
+        assert np.isfinite(loss)
+        loaded, rank, alpha, meta = load_adapter_npz(path)
+        assert rank == 3 and alpha == 6.0 and meta["iters"] == 2
+        np.testing.assert_array_equal(loaded["aq"], factors["aq"])
+
+
+class _FakeTokenizer:
+    vocab_size = 96
+    eod = 0
+    bos = 1
+
+    def tokenize(self, text):
+        return [2 + (ord(c) % 90) for c in text][:16]
+
+    def detokenize(self, ids):
+        return " ".join(str(i) for i in ids)
+
+
+class TestServerAdapterSurface:
+    """HTTP contract: `adapter_id` rides the payload; unknown ids and
+    serial-path requests answer 400, registered ids serve the adapted
+    stream."""
+
+    @pytest.fixture(scope="class")
+    def server(self, tiny_model, two_adapters):
+        from megatron_tpu.inference.server import MegatronServer
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        srv = MegatronServer(
+            gen, _FakeTokenizer(),
+            serving=ServingConfig(num_slots=2, max_queue=16, max_len=64,
+                                  adapter_slots=2,
+                                  adapter_rank=RANK).validate(cfg))
+        for aid, f in two_adapters.items():
+            srv.engine.register_adapter(aid, factors=f, rank=RANK,
+                                        alpha=ALPHA)
+        yield srv
+        srv.close()
+
+    def test_unknown_adapter_is_400(self, server):
+        code, body = server.handle(
+            {"prompts": ["hi"], "tokens_to_generate": 2,
+             "adapter_id": "nope"})
+        assert code == 400 and "unknown adapter_id" in body["message"]
+
+    def test_bad_adapter_type_is_400(self, server):
+        code, body = server.handle(
+            {"prompts": ["hi"], "tokens_to_generate": 2,
+             "adapter_id": ["a"]})
+        assert code == 400 and "adapter_id" in body["message"]
+
+    def test_serial_path_rejects_adapter(self, server):
+        code, body = server.handle(
+            {"prompts": ["hi"], "tokens_to_generate": 2,
+             "serial": True, "adapter_id": "tenant-a"})
+        assert code == 400 and "serving-engine" in body["message"]
+
+    def test_registered_adapter_serves_adapted_stream(
+            self, server, tiny_model, two_adapters):
+        params, cfg = tiny_model
+        payload = {"prompts": ["hi"], "tokens_to_generate": 6,
+                   "temperature": 0.0, "random_seed": 0}
+        code_b, base = server.handle(dict(payload))
+        code_a, adapted = server.handle(
+            dict(payload, adapter_id="tenant-a"))
+        assert code_b == 200 and code_a == 200
+        prompt = _FakeTokenizer().tokenize("hi")
+        oracle = serial_oracle(params, cfg, two_adapters["tenant-a"])
+        assert adapted["segments"][0] == serial_tokens(oracle, prompt, 6)
+        assert base["segments"][0] == serial_tokens(
+            serial_oracle(params, cfg), prompt, 6)
+        assert adapted["segments"][0] != base["segments"][0], (
+            "adapter delta did not change the stream — pick larger "
+            "factors for the fixture")
+
+
+class TestPreemptionCarriesAdapter:
+    def test_preempted_adapter_request_resumes_exact(self, tiny_model,
+                                                     two_adapters):
+        """A preempted adapter request must resume under ITS adapter
+        (the binding rides the request's stable adapter_id through
+        park/resume; the pin releases and re-acquires)."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=0, pad_id=0)
+        sc = ServingConfig(num_slots=1, max_len=64, adapter_slots=2,
+                           adapter_rank=RANK, priority_levels=2,
+                           preemption=True).validate(cfg)
+        with ServingEngine(gen, sc) as eng:
+            for aid, f in two_adapters.items():
+                eng.register_adapter(aid, factors=f, rank=RANK,
+                                     alpha=ALPHA)
+            victim = eng.submit(PROMPTS[0], 24, GREEDY, seed=0,
+                                priority=0, adapter_id="tenant-a")
+            # let it occupy the single slot, then outrank it
+            t_wait = time.monotonic() + 30
+            while (eng.health()["active_slots"] < 1
+                   and time.monotonic() < t_wait):
+                time.sleep(0.002)
+            hi = eng.submit(PROMPTS[1], 4, GREEDY, seed=0, priority=1,
+                            adapter_id="tenant-b")
+            hi_toks, _ = hi.result(timeout=300)
+            v_toks, _ = victim.result(timeout=300)
+            assert eng.metrics.snapshot()["preemptions"] >= 1
+        assert v_toks == serial_tokens(
+            serial_oracle(params, cfg, two_adapters["tenant-a"]),
+            PROMPTS[0], 24)
+        assert hi_toks == serial_tokens(
+            serial_oracle(params, cfg, two_adapters["tenant-b"]),
+            PROMPTS[1], 4)

@@ -60,13 +60,15 @@ def _tiny_engine(serving_kwargs, hidden=64):
     from megatron_tpu.serving import ServingEngine
 
     # bf16 activations (the production numeric path) EXCEPT when the
-    # block-native kernel is drilled: the drills pin engine outputs
-    # token-exact vs the serial oracle, and the kernel's fp32 online
-    # softmax only matches the oracle's dot path under matched
-    # activation dtypes (bf16 rounds the dot path's scores — a flipped
-    # greedy token there is numerics, not a bug). Bracketed /
-    # whole-region arms keep their bf16 coverage.
+    # block-native kernel or the LoRA adapter bank is drilled: the
+    # drills pin engine outputs token-exact vs the serial oracle, and
+    # the kernel's fp32 online softmax / the adapters' factored-vs-
+    # MERGED-weights comparison only match the oracle under fp32
+    # activations (bf16 rounds the scores — a flipped greedy token
+    # there is numerics, not a bug). Bracketed / whole-region /
+    # adapterless arms keep their bf16 coverage.
     compute = ("float32" if serving_kwargs.get("block_native_attn")
+               or serving_kwargs.get("adapter_slots")
                else "bfloat16")
     cfg = ModelConfig(num_layers=2, hidden_size=hidden,
                       num_attention_heads=2, num_kv_heads=1,
@@ -104,28 +106,51 @@ def _resolve_all(reqs, timeout=120.0):
     return out
 
 
+def _make_adapters(cfg, n_adapters: int, rank: int = 4):
+    """n random nonzero adapters (seeded) -> {adapter_id: factors}."""
+    from megatron_tpu.serving.adapters import random_adapter_factors
+    return {f"tenant-{a}": random_adapter_factors(cfg, rank, 1000 + a)
+            for a in range(n_adapters)}
+
+
 def overload_drill(new_tokens: int, spec_k: int = 0,
-                   pool_kwargs=None) -> dict:
+                   pool_kwargs=None, n_adapters: int = 2) -> dict:
     """Offered load >> slot capacity with priorities, early shedding,
-    preemption, one NaN-poisoned slot — and speculative decoding when
-    spec_k > 0. Contract: every submitted future resolves; sheds fail
-    fast at submit; at least one preemption fires and every preempted
-    request still resolves; and (the speculative addition) every
-    request that COMPLETES — preempted-and-resumed included — is
-    token-exact vs the serial greedy path: uncommitted draft state
-    must drop cleanly at preempt/park/resume, never leak into a
-    stream."""
-    from megatron_tpu.inference.generation import SamplingParams
+    preemption, one NaN-poisoned slot — speculative decoding when
+    spec_k > 0, and `n_adapters` LoRA adapters INTERLEAVED through the
+    traffic (multi-tenant serving under chaos). Contract: every
+    submitted future resolves; sheds fail fast at submit; at least one
+    preemption fires and every preempted request still resolves; and
+    every request that COMPLETES — preempted-and-resumed included — is
+    token-exact vs ITS OWN adapter's serial oracle (base weights with
+    that adapter's A·B merged in): uncommitted draft state must drop
+    cleanly, and preemption must save+restore the slot's adapter_idx
+    with the rest of its state (a resumed victim decoding under the
+    WRONG adapter would show up here as a token mismatch)."""
+    from megatron_tpu.inference.generation import (Generator,
+                                                   SamplingParams)
     from megatron_tpu.resilience import FaultInjector, use_fault_injector
     from megatron_tpu.serving import OverloadShedError, SamplingOptions
 
+    rank, alpha = 4, 8.0
     eng, gen = _tiny_engine(dict(
         num_slots=2, max_queue=64, max_len=128, priority_levels=2,
         shed_on_overload=True, preemption=True, max_engine_restarts=2,
-        speculative_k=spec_k, **(pool_kwargs or {})))
+        speculative_k=spec_k, adapter_slots=n_adapters or 0,
+        adapter_rank=rank, **(pool_kwargs or {})))
+    adapters = _make_adapters(gen.cfg, n_adapters, rank)
+    for aid, factors in sorted(adapters.items()):
+        eng.register_adapter(aid, factors=factors, rank=rank,
+                             alpha=alpha)
+    # round-robin adapter assignment over [base, t-0, t-1, ...]
+    cycle = [None] + sorted(adapters)
+
+    def aid_for(i):
+        return cycle[i % len(cycle)]
+
     # greedy: seed-independent, so the exactness oracle is one serial
-    # generate per (prompt, n) — preemption/speculation must not move
-    # a single token
+    # generate per (adapter, prompt, n) — preemption/speculation must
+    # not move a single token
     sampling = SamplingOptions(temperature=0.0)
     reqs, shed = [], 0
     # NaN-poison one active slot a few steps in: the non-finite guard
@@ -142,8 +167,10 @@ def overload_drill(new_tokens: int, spec_k: int = 0,
             for i in range(6):
                 reqs.append((eng.submit([5 + i, 2, 7, 2, 7],
                                         new_tokens, sampling, seed=i,
-                                        priority=0),
-                             [5 + i, 2, 7, 2, 7], new_tokens))
+                                        priority=0,
+                                        adapter_id=aid_for(i)),
+                             [5 + i, 2, 7, 2, 7], new_tokens,
+                             aid_for(i)))
             # ... wait until low-priority work actually OCCUPIES the
             # slots (otherwise the priority queue simply serves the
             # high-priority wave first and nothing needs preempting) ...
@@ -153,37 +180,52 @@ def overload_drill(new_tokens: int, spec_k: int = 0,
                 time.sleep(0.002)
             # ... then high-priority arrivals preempt running slots
             # (preempt-mid-round: the victim's in-window draft state
-            # is uncommitted by construction and must just vanish)
+            # is uncommitted by construction and must just vanish —
+            # and its adapter pin must release/re-acquire cleanly)
             for i in range(3):
                 n = max(new_tokens // 2, 2)
                 reqs.append((eng.submit([9, 8 + i], n, sampling,
-                                        seed=100 + i, priority=1),
-                             [9, 8 + i], n))
+                                        seed=100 + i, priority=1,
+                                        adapter_id=aid_for(i + 1)),
+                             [9, 8 + i], n, aid_for(i + 1)))
             # wave 2 — hopeless deadlines: the estimator (fed by the
             # warmup completion) sheds these at SUBMIT time
             for i in range(16):
                 try:
                     reqs.append((eng.submit([2, i + 1], new_tokens,
                                             sampling, seed=200 + i,
-                                            deadline_s=0.001),
-                                 [2, i + 1], new_tokens))
+                                            deadline_s=0.001,
+                                            adapter_id=aid_for(i)),
+                                 [2, i + 1], new_tokens, aid_for(i)))
                 except OverloadShedError:
                     shed += 1
-            outcomes = _resolve_all([r for r, _, _ in reqs])
+            outcomes = _resolve_all([r for r, _, _, _ in reqs])
         snap = eng.metrics.snapshot()
         health = eng.health()
-        # exactness sweep over everything that finished OK
+        # exactness sweep over everything that finished OK — each
+        # request against ITS adapter's merged-weights serial oracle
+        oracles = {None: gen}
+        if n_adapters:
+            from megatron_tpu.training.lora import merge_lora
+            for aid, factors in adapters.items():
+                oracles[aid] = Generator(
+                    merge_lora(gen.params, factors, gen.cfg, rank,
+                               alpha),
+                    gen.cfg, eos_id=-1, pad_id=0)
         serial_cache, exact, checked = {}, True, 0
-        for r, prompt, n in reqs:
+        adapter_checked = 0
+        for r, prompt, n, aid in reqs:
             if r.state.value != "finished":
                 continue
-            key = (tuple(prompt), n)
+            key = (aid, tuple(prompt), n)
             if key not in serial_cache:
-                t, lens, _ = gen.generate(
+                t, lens, _ = oracles[aid].generate(
                     [prompt], n,
                     sampling=SamplingParams(temperature=0.0))
                 serial_cache[key] = t[0, :lens[0]].tolist()
             checked += 1
+            if aid is not None:
+                adapter_checked += 1
             if r.prompt + r.generated != serial_cache[key]:
                 exact = False
     finally:
@@ -200,6 +242,9 @@ def overload_drill(new_tokens: int, spec_k: int = 0,
         "speculative_k": spec_k,
         "spec_rounds": int(snap["spec_rounds"]),
         "draft_tokens": int(snap["draft_tokens"]),
+        "adapters": n_adapters,
+        "adapter_loads": int(snap["adapter_loads"]),
+        "adapter_rows_checked": adapter_checked,
         "completed_token_exact": exact,
         "completed_checked": checked,
         "healthy_after": bool(health["healthy"]),
@@ -210,6 +255,9 @@ def overload_drill(new_tokens: int, spec_k: int = 0,
                >= fired["serve_nan"] > 0
                and exact and checked >= 1
                and (spec_k == 0 or int(snap["spec_rounds"]) >= 1)
+               and (n_adapters == 0
+                    or (int(snap["adapter_loads"]) >= 1
+                        and adapter_checked >= 1))
                and health["healthy"]),
     }
 
@@ -328,10 +376,11 @@ def crash_loop_drill(spec_k: int = 0, pool_kwargs=None) -> dict:
 
 def run_chaos(new_tokens: int, timeout_s: float, stall_s: float,
               spec_k: int = 0, block: int = 16,
-              block_native: bool = True) -> dict:
+              block_native: bool = True, n_adapters: int = 2) -> dict:
     t0 = time.monotonic()
     pool_kwargs = _pool_mode(block, block_native)
-    overload = overload_drill(new_tokens, spec_k, pool_kwargs)
+    overload = overload_drill(new_tokens, spec_k, pool_kwargs,
+                              n_adapters=n_adapters)
     hang = hang_drill(timeout_s, stall_s, spec_k, pool_kwargs)
     crash = crash_loop_drill(spec_k, pool_kwargs)
     wall_s = time.monotonic() - t0
@@ -346,6 +395,7 @@ def run_chaos(new_tokens: int, timeout_s: float, stall_s: float,
         "speculative_k": spec_k,
         "kv_block_size": block or None,
         "block_native_attn": bool(block and block_native),
+        "adapters": n_adapters,
         "overload": overload,
         "hang": hang,
         "crash_loop": crash,
@@ -370,6 +420,15 @@ def main(argv=None) -> int:
                          "watchdog-hang must drop uncommitted draft "
                          "state cleanly — resumed requests token-exact, "
                          "no stranded futures")
+    ap.add_argument("--adapters", type=int, default=2,
+                    help="run the overload drill with this many LoRA "
+                         "adapters interleaved through the traffic "
+                         "(multi-tenant serving under chaos): every "
+                         "completed request pins token-exact against "
+                         "its OWN adapter's merged-weights serial "
+                         "oracle — preempt/resume must save+restore "
+                         "the slot's adapter binding (0 = adapterless "
+                         "drills)")
     ap.add_argument("--kv_block_size", type=int, default=16,
                     help="run every drill on the BLOCK-granular pool "
                          "at this block size — the production layout "
@@ -389,7 +448,7 @@ def main(argv=None) -> int:
 
     record = run_chaos(args.new_tokens, args.watchdog_s, args.stall_s,
                        args.speculative_k, args.kv_block_size,
-                       not args.no_block_native)
+                       not args.no_block_native, args.adapters)
     line = json.dumps(record)
     print(line, flush=True)
     if args.out:

@@ -68,7 +68,26 @@ def main(argv=None):
     p.add_argument("--serial", action="store_true",
                    help="serve with the reference's serial one-lock "
                         "path instead of the continuous-batching engine")
+    p.add_argument("--adapter_slots", type=int, default=0,
+                   help="multi-tenant LoRA serving: device-resident "
+                        "adapters servable concurrently (0 disables; "
+                        "docs/serving.md 'Multi-tenant LoRA serving')")
+    p.add_argument("--adapter_rank", type=int, default=8,
+                   help="LoRA rank the adapter bank allocates for")
+    p.add_argument("--adapter_host_bytes", type=int, default=0,
+                   help="host-RAM overflow budget for evicted adapters")
+    p.add_argument("--adapter_dir", type=str, default=None,
+                   help="directory of adapter .npz exports (finetune "
+                        "--lora_rank) registered at start; adapter_id "
+                        "= file stem")
     args = p.parse_args(argv)
+    if args.adapter_dir and (args.serial or args.adapter_slots <= 0):
+        # fail loudly at the flag boundary: the serial path threads no
+        # adapter bank, and without --adapter_slots there is no bank
+        # to register into (server.engine would be None / bankless and
+        # the registration loop below would crash unexplanatorily)
+        p.error("--adapter_dir requires --adapter_slots > 0 and the "
+                "serving engine (drop --serial)")
 
     cfg = ckpt.load_config_from_checkpoint(args.load)
     assert cfg is not None, f"no checkpoint under {args.load}"
@@ -113,9 +132,25 @@ def main(argv=None):
                             max_queue=args.max_queue,
                             max_len=args.serving_max_len,
                             serial_fallback=args.serial,
-                            request_deadline_s=args.request_deadline_s)
-    MegatronServer(gen, tokenizer, serving=serving).run(args.host,
-                                                        args.port)
+                            request_deadline_s=args.request_deadline_s,
+                            adapter_slots=args.adapter_slots,
+                            adapter_rank=args.adapter_rank,
+                            adapter_host_bytes=args.adapter_host_bytes
+                            ).validate(mcfg)
+    server = MegatronServer(gen, tokenizer, serving=serving)
+    if args.adapter_dir:
+        # pre-register every exported adapter: adapter_id = file stem,
+        # validated eagerly (a corrupt export fails the server start,
+        # not some later request's admission)
+        import glob
+        from megatron_tpu.utils.logging import print_rank_0
+        for path in sorted(glob.glob(os.path.join(args.adapter_dir,
+                                                  "*.npz"))):
+            aid = os.path.splitext(os.path.basename(path))[0]
+            server.engine.register_adapter(aid, path=path)
+            print_rank_0(f"serving: registered adapter {aid!r} "
+                         f"from {path}")
+    server.run(args.host, args.port)
 
 
 if __name__ == "__main__":
