@@ -375,6 +375,13 @@ def _run_extras():
         # record is the mixed-arm tok/s ratio judged against the
         # adapter-gather bytes/step the tool reports
         ("bench_lora.py", ["--smoke"], "/tmp/bench_extras_lora.log"),
+        # interleave-vs-disaggregated serving A/B + serving-tp decode
+        # scaling (PERF_NOTES queue item 10): greedy arms assert token
+        # agreement, the disagg arm pins handoff_bytes_per_req ==
+        # ceil(plen/B) * block bytes; ON CHIP the record is the TTFT /
+        # inter-token-p99 split and the tp=2 decode tok/s ratio
+        ("bench_disagg.py", ["--smoke"],
+         "/tmp/bench_extras_disagg.log"),
         # resilience smoke: scripted chaos run (transient write fault +
         # NaN-streak rollback + corrupt-checkpoint fallback) — the
         # recovery-latency record makes regressions in the resilience

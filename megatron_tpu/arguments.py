@@ -349,6 +349,24 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                         "via device_put on a later hit; needs "
                         "--enable_prefix_cache + --kv_block_size "
                         "(0 disables)")
+    g.add_argument("--serving_tp", type=int, default=1,
+                   help="serving: tensor-parallel width of the serving "
+                        "mesh — weights, the KV arena, and prefill "
+                        "subs shard over 'tp' on the head axes with "
+                        "the same GSPMD rules training uses; dispatch "
+                        "data (block map, lengths, sampling state) "
+                        "stays replicated, so decode/verify/prefill "
+                        "keep one compile each (1 = no serving mesh, "
+                        "bit-identical; docs/serving.md 'Sharded & "
+                        "disaggregated serving')")
+    g.add_argument("--disaggregate_prefill", action="store_true",
+                   help="serving: split prefill and decode onto "
+                        "separate serving_tp-wide chip groups "
+                        "(DistServe) — prompts prefill on the prefill "
+                        "group and hand off to decode as a "
+                        "device-to-device copy of the sequence's live "
+                        "KV blocks only; needs --kv_block_size "
+                        "(docs/serving.md)")
     g.add_argument("--adapter_slots", type=int, default=0,
                    help="serving: device-resident LoRA adapters "
                         "servable concurrently (multi-tenant serving, "
@@ -665,6 +683,8 @@ def config_from_args(args: argparse.Namespace,
             num_replicas=args.num_replicas,
             router_max_retries=args.router_max_retries,
             host_kv_bytes=args.host_kv_bytes,
+            serving_tp=args.serving_tp,
+            disaggregate_prefill=args.disaggregate_prefill,
             adapter_slots=args.adapter_slots,
             adapter_rank=args.adapter_rank,
             adapter_host_bytes=args.adapter_host_bytes),

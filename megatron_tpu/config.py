@@ -553,6 +553,40 @@ class ServingConfig:
     # SSE stream registry TTL: a finished stream's request (and its
     # committed tokens) stays resumable via Last-Event-ID for this long
     stream_ttl_s: float = 600.0
+    # --- serving mesh (docs/serving.md "Sharded & disaggregated
+    # serving"; serving/topology.py) --------------------------------
+    # tensor-parallel width of the serving mesh: the engine's compiled
+    # programs run under the SAME GSPMD mesh treatment training uses —
+    # weights by the training tp rules, the KV arena / slot regions /
+    # batch-1 prefill subs sharded over 'tp' on the kv-head axis, the
+    # adapter bank's B factors by their projection specs — while the
+    # per-slot block map, lengths, adapter indices, and sampling state
+    # stay replicated dispatch DATA, so decode / speculative verify /
+    # batched prefill keep ONE compile each. The Pallas block-native
+    # kernel runs under shard_map on the head-sharded arena (the GQA
+    # head loop shrinks per shard). Requires query/kv head counts and
+    # the padded vocab divisible by tp. 1 (default) builds no serving
+    # mesh at all — the engine lowers bit-identically to today's
+    # single-device graph (test-pinned).
+    serving_tp: int = 1
+    # prefill/decode disaggregation (DistServe, PAPERS.md): the two
+    # phases have opposite rooflines (compute-bound vs HBM-bound), so
+    # each engine splits its serving devices into a (prefill-group,
+    # decode-group) pair of serving_tp-wide meshes. EVERY admission
+    # prefills on the prefill group through the standalone batch-1
+    # chunk path (`generation.prefill_chunk` — outside the pool, the
+    # exact unit to relocate), and "hand off to decode" is a
+    # device-to-device copy of the sequence's ceil(plen/B) live
+    # physical blocks ONLY (slice -> transfer -> insert_blocks; never
+    # a cap-region copy — handoff_bytes_per_req pins it). Requires
+    # kv_block_size (the handoff unit is the block) and excludes
+    # ROLLING pools; chunk-interleave on one chip group stays the
+    # fallback with the knob off (bit-identical, test-pinned). The
+    # EngineRouter is the control plane: a replica is a
+    # (prefill-group, decode-group) pair and the existing
+    # UP->DOWN->PROBING failover + token-exact resubmission cover a
+    # dead half.
+    disaggregate_prefill: bool = False
     # --- multi-tenant LoRA serving (docs/serving.md "Multi-tenant
     # LoRA serving"; serving/adapters.py) ------------------------------
     # device-resident LoRA adapters servable concurrently: the engine
@@ -722,6 +756,49 @@ class ServingConfig:
             self.kv_dtype in SERVING_KV_DTYPES, self.kv_dtype
         assert self.num_replicas >= 1, self.num_replicas
         assert self.router_max_retries >= 0, self.router_max_retries
+        # --- serving mesh (serving/topology.py) -----------------------
+        assert self.serving_tp >= 1, self.serving_tp
+        if self.serving_tp > 1:
+            assert not self.serial_fallback, (
+                "serving_tp > 1 requires the continuous-batching "
+                "engine: the serial fallback path builds no serving "
+                "mesh — drop serial_fallback or serving_tp")
+            if model is not None:
+                tp = self.serving_tp
+                assert model.num_attention_heads % tp == 0 and \
+                    model.num_kv_heads % tp == 0, (
+                    f"serving_tp={tp} must divide both the query head "
+                    f"count ({model.num_attention_heads}) and the kv "
+                    f"head count ({model.num_kv_heads}): the KV arena "
+                    "and the attention projections shard on the head "
+                    "axes (block_native_attn's shard_map'd kernel "
+                    "requires it too — fall back to serving_tp=1 or "
+                    "the resolve/scatter bracket)")
+                assert model.padded_vocab_size % tp == 0, (
+                    f"serving_tp={tp} must divide the padded vocab "
+                    f"({model.padded_vocab_size}): the embedding / LM "
+                    "head shard on the vocab dim — adjust "
+                    "make_vocab_size_divisible_by")
+        if self.disaggregate_prefill:
+            assert not self.serial_fallback, (
+                "disaggregate_prefill requires the continuous-batching "
+                "engine (the serial path has no prefill group)")
+            assert self.kv_block_size is not None, (
+                "disaggregate_prefill requires kv_block_size: the "
+                "prefill->decode handoff unit is the physical KV "
+                "block (ceil(plen/B) live blocks move, never a whole "
+                "cap region) — set --kv_block_size or serve "
+                "single-group")
+            if model is not None and model.sliding_window is not None:
+                max_len = self.max_len or model.max_position_embeddings
+                rolling = (model.attention_impl == "flash"
+                           and model.sliding_window < max_len)
+                assert not rolling, (
+                    "disaggregate_prefill is unsupported on ROLLING "
+                    "(sliding-window) KV pools: the ring's exact-"
+                    "length block handoff is not defined — serve "
+                    "rolling models single-group "
+                    "(chunk-interleave fallback)")
         assert self.router_heartbeat_timeout_s > 0.0, \
             self.router_heartbeat_timeout_s
         assert self.stream_ttl_s > 0.0, self.stream_ttl_s

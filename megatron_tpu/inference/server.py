@@ -163,6 +163,27 @@ class MegatronServer:
         self.engine = None
         if not self.serving.serial_fallback:
             from megatron_tpu.serving import ServingEngine
+            from megatron_tpu.serving.topology import devices_per_engine
+            # serving-mesh topology (docs/serving.md "Sharded &
+            # disaggregated serving"): each replica occupies its own
+            # window of the device list — serving_tp chips for the
+            # decode group plus serving_tp more for the prefill group
+            # when disaggregated, so an EngineRouter replica is a
+            # (prefill-group, decode-group) PAIR and killing either
+            # half fails over like any replica death. per == 1 passes
+            # devices=None (the topology-free engine, bit-identical).
+            per = devices_per_engine(self.serving)
+            slices = [None] * self.serving.num_replicas
+            if per > 1:
+                import jax
+                devs = jax.devices()
+                need = per * self.serving.num_replicas
+                assert len(devs) >= need, (
+                    f"serving topology needs {need} devices "
+                    f"({self.serving.num_replicas} replicas x {per}) "
+                    f"but the backend has {len(devs)}")
+                slices = [devs[i * per:(i + 1) * per]
+                          for i in range(self.serving.num_replicas)]
             if self.serving.num_replicas > 1:
                 # N full engine replicas (own KV pool / queue /
                 # supervisor each, same weights) behind the in-process
@@ -170,15 +191,17 @@ class MegatronServer:
                 # router at all — the bare engine, bit-identical to
                 # the single-replica server (test-pinned).
                 from megatron_tpu.serving import EngineRouter
-                engines = [ServingEngine(generator, self.serving)
-                           for _ in range(self.serving.num_replicas)]
+                engines = [ServingEngine(generator, self.serving,
+                                         devices=sl)
+                           for sl in slices]
                 self.engine = EngineRouter(
                     engines,
                     max_retries=self.serving.router_max_retries,
                     heartbeat_timeout_s=
                     self.serving.router_heartbeat_timeout_s)
             else:
-                self.engine = ServingEngine(generator, self.serving)
+                self.engine = ServingEngine(generator, self.serving,
+                                            devices=slices[0])
 
     def close(self):
         if self.engine is not None:

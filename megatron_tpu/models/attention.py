@@ -186,9 +186,45 @@ def _block_native_update_attend(q, k, v, cache: BlockKVCache, *,
     else:
         cache = cache._replace(k=wr(cache.k, k), v=wr(cache.v, v),
                                offset=offset + s)
-    out = block_native_attention(
-        q, cache.k, cache.v, cache.map, offset, scale=scale,
-        block_size=B, k_scale=cache.k_scale, v_scale=cache.v_scale)
+    # TP-sharded serving (serving/topology.py): XLA cannot partition a
+    # custom call, so with a tp mesh active the kernel runs under an
+    # explicit shard_map on the head-sharded arena — each tp shard
+    # walks its OWN nkv/tp kv heads' block chains (the GQA head loop
+    # shrinks per shard; attention is per-head independent, so no
+    # collective inside). Single-device traces (mesh None) lower the
+    # bare call, bit-identical to before.
+    from megatron_tpu.parallel.sharding import active_tp_mesh
+    mesh = active_tp_mesh()
+    if mesh is None:
+        out = block_native_attention(
+            q, cache.k, cache.v, cache.map, offset, scale=scale,
+            block_size=B, k_scale=cache.k_scale, v_scale=cache.v_scale)
+    else:
+        from jax.sharding import PartitionSpec as P
+        from megatron_tpu.parallel.mesh import TENSOR_AXIS
+        tp = mesh.shape[TENSOR_AXIS]
+        assert nq % tp == 0 and nkv % tp == 0, (
+            f"block_native_attn under serving_tp={tp} needs query "
+            f"({nq}) and kv ({nkv}) head counts divisible by tp — "
+            "serve with the resolve/scatter bracket instead "
+            "(ServingConfig.validate rejects this combination)")
+        h_spec = P(None, None, TENSOR_AXIS, None)
+        quant = cache.k_scale is not None
+
+        def _kern(q_, k_, v_, m_, off_, *sc):
+            ks_, vs_ = sc if quant else (None, None)
+            return block_native_attention(
+                q_, k_, v_, m_, off_, scale=scale, block_size=B,
+                k_scale=ks_, v_scale=vs_)
+
+        args = [q, cache.k, cache.v, cache.map, offset]
+        in_specs = [h_spec, h_spec, h_spec, P(), P()]
+        if quant:
+            args += [cache.k_scale, cache.v_scale]
+            in_specs += [h_spec, h_spec]
+        out = jax.shard_map(_kern, mesh=mesh,
+                            in_specs=tuple(in_specs),
+                            out_specs=h_spec, check_vma=False)(*args)
     return out.astype(dtype), cache
 
 

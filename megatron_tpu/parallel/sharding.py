@@ -177,6 +177,24 @@ def constrain(x, logical_axes: tuple):
     return with_sharding(x, mesh, logical_axes, rules)
 
 
+def active_tp_mesh():
+    """The activation-sharding context's mesh when it actually shards
+    the tensor axis (tp > 1), else None. Model code that must wrap a
+    hand-written kernel in an explicit shard_map (XLA cannot partition
+    a custom call — e.g. the serving block-attention Pallas kernel,
+    models/attention.py) reads the mesh from here at TRACE time, the
+    same context `constrain` uses — so the wrap appears exactly when
+    the enclosing jit runs the mesh treatment and never on
+    single-device traces."""
+    cur = getattr(_ACT_CTX, "cur", None)
+    if cur is None:
+        return None
+    mesh = cur[0]
+    if TENSOR_AXIS in mesh.shape and mesh.shape[TENSOR_AXIS] > 1:
+        return mesh
+    return None
+
+
 def distributed_opt_sharding(mesh: Mesh, logical_axes: tuple, rules,
                              shape: tuple,
                              pipelined: bool = False) -> NamedSharding:

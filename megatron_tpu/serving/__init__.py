@@ -21,3 +21,5 @@ from megatron_tpu.serving.scheduler import (  # noqa: F401
     FIFOScheduler, OverloadShedError, QueueFullError)
 from megatron_tpu.serving.spec_decode import (  # noqa: F401
     Drafter, NGramDrafter)
+from megatron_tpu.serving.topology import (  # noqa: F401
+    ServingTopology, build_topology, devices_per_engine)

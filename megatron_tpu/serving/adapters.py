@@ -205,7 +205,9 @@ class AdapterBank:
 
     def __init__(self, cfg: ModelConfig, slots: int, rank: int,
                  host_bytes: int = 0, metrics=None,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, shardings: Optional[LoraAdapter]
+                 = None, prefill_shardings: Optional[LoraAdapter]
+                 = None):
         assert slots >= 1, slots
         assert rank >= 1, (
             f"adapter_rank={rank} must be >= 1 (a rank-0 bank holds "
@@ -222,6 +224,21 @@ class AdapterBank:
             # scans; the bank dim is gathered per row at apply time
             n: jnp.zeros((s[0], self.capacity) + s[1:], dtype)
             for n, s in shapes.items()})
+        if shardings is not None:
+            # TP-sharded serving (serving/topology.py): the bank's
+            # B factors shard their projection out-dims over 'tp' like
+            # the base weights. Placement commits ONCE here — the
+            # functional row writes in _write update committed arrays,
+            # so the layout survives every load
+            self._stacked = jax.device_put(self._stacked, shardings)
+        # disaggregated serving: the prefill chip group's programs
+        # cannot consume a decode-group-committed bank, so a MIRROR
+        # copy lives on the prefill mesh and _write updates both —
+        # loads are rare control-plane events, and the bank is tiny
+        # next to the KV arena
+        self._stacked_pre = (
+            jax.device_put(self._stacked, prefill_shardings)
+            if prefill_shardings is not None else None)
         self._ids: list = [("identity",)] + [None] * slots
         self._by_id: Dict[object, int] = {}
         self._pins = np.zeros(self.capacity, np.int64)
@@ -345,6 +362,13 @@ class AdapterBank:
     @property
     def stacked(self) -> LoraAdapter:
         return self._stacked
+
+    @property
+    def stacked_prefill(self) -> LoraAdapter:
+        """The prefill chip group's bank copy (disaggregated engines;
+        == `stacked` on single-group topologies)."""
+        return (self._stacked_pre if self._stacked_pre is not None
+                else self._stacked)
 
     def nbytes(self) -> int:
         return sum(getattr(self._stacked, n).nbytes for n in FACTOR_NAMES)
@@ -551,3 +575,10 @@ class AdapterBank:
             n: getattr(self._stacked, n).at[:, idx].set(
                 jnp.asarray(arrays[n], self.dtype))
             for n in FACTOR_NAMES})
+        if self._stacked_pre is not None:
+            # keep the prefill-group mirror in lockstep (same
+            # functional-update discipline)
+            self._stacked_pre = LoraAdapter(**{
+                n: getattr(self._stacked_pre, n).at[:, idx].set(
+                    jnp.asarray(arrays[n], self.dtype))
+                for n in FACTOR_NAMES})

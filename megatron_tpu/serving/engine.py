@@ -163,7 +163,8 @@ class _PendingPrefill:
     aborted prefill)."""
 
     __slots__ = ("req", "slot", "sub", "pos", "rng0", "last", "tokens",
-                 "blocks", "pfx_blocks", "installed", "aidx")
+                 "blocks", "pfx_blocks", "installed", "aidx",
+                 "on_decode")
 
     def __init__(self, req: GenRequest, slot: int, sub, pos: int, rng0,
                  tokens: Optional[List[int]] = None,
@@ -181,6 +182,10 @@ class _PendingPrefill:
         # adapter bank row the chunks forward under (0 = identity;
         # resolved + pinned at admission — serving/adapters.py)
         self.aidx = int(req.bank_idx)
+        # disaggregated engines: True when `sub` already lives on the
+        # DECODE group (a preemption park resumed in place) — its
+        # activation inserts directly, no prefill->decode handoff
+        self.on_decode = False
 
 
 class _HostSrc:
@@ -210,7 +215,7 @@ class ServingEngine:
     def __init__(self, generator: Generator, serving=None,
                  metrics: Optional[ServingMetrics] = None,
                  writer=None, report_interval: int = 100,
-                 start: bool = True, drafter=None):
+                 start: bool = True, drafter=None, devices=None):
         from megatron_tpu.config import ServingConfig
         self.gen = generator
         cfg = generator.cfg
@@ -224,10 +229,68 @@ class ServingEngine:
         kv_dtype = (generator.kv_cache_dtype
                     if self.serving.kv_dtype is None
                     else _KV_DTYPES[self.serving.kv_dtype])
+        # serving mesh (serving/topology.py; docs/serving.md "Sharded
+        # & disaggregated serving"): with serving_tp > 1 (or
+        # disaggregation) the engine's programs run under the training
+        # mesh treatment — weights tp-sharded by the training rules,
+        # the KV arena on the kv-head axis, dispatch data replicated —
+        # and a disaggregated engine additionally holds a second
+        # weight copy on its prefill chip group. topo None (the
+        # default) keeps every code path below byte-for-byte what it
+        # was: _p_dec/_p_pre ARE generator.params and the jits route
+        # through Generator._jit exactly as before.
+        from megatron_tpu.serving.topology import build_topology
+        self.topo = build_topology(self.serving, devices=devices)
+        self._disagg = (self.topo is not None
+                        and self.topo.disaggregated)
+        if self.topo is not None:
+            assert generator.mesh is None, (
+                "serving_tp/disaggregate_prefill build their own "
+                "serving mesh — construct the Generator WITHOUT mesh= "
+                "(the engine owns placement; a Generator mesh would "
+                "fight it)")
+            tp = self.topo.tp
+            assert cfg.num_attention_heads % tp == 0 and \
+                cfg.num_kv_heads % tp == 0 and \
+                cfg.padded_vocab_size % tp == 0, (
+                f"serving_tp={tp} must divide the head counts "
+                f"({cfg.num_attention_heads} q / {cfg.num_kv_heads} "
+                f"kv) and the padded vocab ({cfg.padded_vocab_size}) "
+                "— see ServingConfig.validate")
+            self._p_dec, self._psh_dec = self.topo.place_params(
+                generator.params, cfg, self.topo.decode_mesh)
+            if self._disagg:
+                self._p_pre, self._psh_pre = self.topo.place_params(
+                    generator.params, cfg, self.topo.prefill_mesh)
+            else:
+                self._p_pre, self._psh_pre = self._p_dec, self._psh_dec
+            _jit_dec = (lambda fn, n_array_args, donate_argnums=():
+                        self.topo._jit(self.topo.decode_mesh,
+                                       self._psh_dec, fn, n_array_args,
+                                       donate_argnums))
+            _jit_pre = (lambda fn, n_array_args, donate_argnums=():
+                        self.topo._jit(self.topo.prefill_mesh,
+                                       self._psh_pre, fn, n_array_args,
+                                       donate_argnums))
+        else:
+            self._p_dec = self._p_pre = generator.params
+            _jit_dec = _jit_pre = self.gen._jit
         self.pool = SlotKVPool(cfg, self.num_slots, self.max_len,
                                dtype=kv_dtype,
                                retained_limit=self.serving.retained_slots,
                                block_size=self.serving.kv_block_size)
+        if self.topo is not None:
+            self.topo.place_pool(self.pool)
+        # disaggregation re-asserts (engines can be constructed
+        # without ServingConfig.validate): the handoff unit is the
+        # physical block, and a rolling ring's exact-length handoff is
+        # undefined
+        assert not (self._disagg and not self.pool.blocks_enabled), (
+            "disaggregate_prefill requires kv_block_size — see "
+            "ServingConfig.validate")
+        assert not (self._disagg and self.pool.rolling), (
+            "disaggregate_prefill is unsupported on ROLLING pools — "
+            "see ServingConfig.validate")
         # block-granular pool: the static per-slot block map is
         # resolved at dispatch (kv_pool.resolve_view/scatter_view
         # bracket every compiled program), so the one-compile contract
@@ -376,11 +439,22 @@ class ServingEngine:
             assert cfg.quantized_gemm == "none", (
                 "adapter_slots > 0 is unsupported with "
                 "quantized_gemm='int8' — see ServingConfig.validate")
+            bank_sh = bank_sh_pre = None
+            if self.topo is not None:
+                # tp-sharded bank rows: B factors by their projection
+                # out-dim specs, like the base weights (topology.py);
+                # a disaggregated engine keeps a mirror copy on the
+                # prefill mesh for the chunk forward
+                bank_sh = self.topo.adapter_shardings()
+                if self._disagg:
+                    bank_sh_pre = self.topo.adapter_shardings(
+                        self.topo.prefill_mesh)
             self.adapters = AdapterBank(
                 cfg, self._adapter_slots, self.serving.adapter_rank,
                 host_bytes=int(getattr(self.serving,
                                        "adapter_host_bytes", 0) or 0),
-                metrics=self.metrics)
+                metrics=self.metrics, shardings=bank_sh,
+                prefill_shardings=bank_sh_pre)
 
         S, Vp = self.num_slots, cfg.padded_vocab_size
         # per-slot device state (functionally replaced every step)
@@ -430,21 +504,21 @@ class ServingEngine:
         # flight hits the CPU jax 0.4.x donation-aliasing bug the
         # rollback path in training/loop.py documents (observed here as
         # rare wrong tokens on the 8-virtual-device CPU mesh)
-        self._decode = self.gen._jit(self._decode_fn, n_array_args=10,
-                                     donate_argnums=(1, 2, 3))
+        self._decode = _jit_dec(self._decode_fn, n_array_args=10,
+                                donate_argnums=(1, 2, 3))
         # speculative verify: ONE trace for the enabled k (drafts are
         # a fixed [S, k] shape — k is a compile-time bucket), compiled
         # alongside the decode step the first window dispatches it.
         # Same donation set and the same lengths/rejects no-donate rule
         # as _decode (both chain device-side across a window).
         self._verify_traces = 0
-        self._verify = self.gen._jit(self._verify_fn, n_array_args=11,
-                                     donate_argnums=(1, 2, 3))
+        self._verify = _jit_dec(self._verify_fn, n_array_args=11,
+                                donate_argnums=(1, 2, 3))
         # one jit; jax retraces per (batch-bucket, padded prompt length)
         # combo (both bucketed — _prefill_bucket / _batch_bucket — so
         # the cache hits across request sizes and arrival bursts)
-        self._prefill = self.gen._jit(self._prefill_fn, n_array_args=9,
-                                      donate_argnums=(1, 2, 3))
+        self._prefill = _jit_dec(self._prefill_fn, n_array_args=9,
+                                 donate_argnums=(1, 2, 3))
         # prefix-cache / chunked-prefill programs (slot indices and
         # offsets are traced scalars — one compile serves every slot):
         # _slice reads a region out of the pool (the read half of
@@ -458,19 +532,31 @@ class ServingEngine:
         # buffer hits the CPU jax 0.4.x aliasing bug documented at
         # _decode above.
         self._chunk_traces = 0
-        self._slice = self.gen._jit(self._slice_fn, n_array_args=3)
-        self._chunk_fwd = self.gen._jit(self._chunk_fwd_fn,
-                                        n_array_args=6)
-        self._insert = self.gen._jit(self._insert_fn, n_array_args=8,
-                                     donate_argnums=(1, 2, 3))
+        self._slice = _jit_dec(self._slice_fn, n_array_args=3)
+        # the chunk forward is the PREFILL-group program: on a
+        # disaggregated engine it compiles against the prefill mesh's
+        # weight copy (every other program below is decode-group)
+        self._chunk_fwd = _jit_pre(self._chunk_fwd_fn, n_array_args=6)
+        self._insert = _jit_dec(self._insert_fn, n_array_args=8,
+                                donate_argnums=(1, 2, 3))
         # block-mode variants: slice by explicit physical-block list,
         # insert through the slot's map row with the aliased-prefix
         # copy-on-write boundary
-        self._slice_blk = self.gen._jit(self._slice_blocks_fn,
-                                        n_array_args=3)
-        self._insert_blk = self.gen._jit(self._insert_blocks_fn,
-                                         n_array_args=9,
-                                         donate_argnums=(1, 2, 3))
+        self._slice_blk = _jit_dec(self._slice_blocks_fn,
+                                   n_array_args=3)
+        self._insert_blk = _jit_dec(self._insert_blocks_fn,
+                                    n_array_args=9,
+                                    donate_argnums=(1, 2, 3))
+        # disaggregated handoff programs: land the transferred live
+        # blocks on the decode group (pad-to-cap + insert_blocks +
+        # activation fused — one compile per live-block count), and
+        # widen a transferred prefix onto the prefill group for
+        # suffix chunks (the hit's decode->prefill ride)
+        self._handoff_insert = _jit_dec(self._handoff_insert_fn,
+                                        n_array_args=8,
+                                        donate_argnums=(1, 2, 3))
+        self._pad_sub_pre = _jit_pre(self._pad_sub_pre_fn,
+                                     n_array_args=2)
         self._steps = 0
         self._cond = threading.Condition()
         self._stop = False
@@ -652,6 +738,11 @@ class ServingEngine:
             # engines; cheap dict read, HTTP-thread safe)
             "active_adapters": (self.adapters.active_count()
                                 if self.adapters is not None else 0),
+            # serving-mesh topology (static per engine; operators and
+            # the chaos drills read which half a replica lost)
+            "serving_tp": (self.topo.tp if self.topo is not None
+                           else 1),
+            "disaggregated": self._disagg,
             "detail": broken or "",
         }
 
@@ -1058,6 +1149,63 @@ class ServingEngine:
         rngs = rngs.at[slot].set(rng0)
         return pool, last_logits, rngs
 
+    @staticmethod
+    def _widen_sub(sub, cap: int):
+        """Zero-pad a block-truncated batch-1 cache ([L, 1, n*B, ...])
+        back to the full region cap — positions past the live tokens
+        are garbage the causal mask never reads and appends overwrite
+        write-before-read (the bucketed-prefill invariant). int8
+        scales pad with 1.0 (a zero scale would NaN a dequantized
+        garbage read's softmax). Traced helper: one compile per
+        live-block count, bounded by blocks_per_slot."""
+        n = sub.k.shape[2]
+        pad = ((0, 0), (0, 0), (0, cap - n), (0, 0), (0, 0))
+        return sub._replace(
+            k=jnp.pad(sub.k, pad), v=jnp.pad(sub.v, pad),
+            k_scale=(None if sub.k_scale is None
+                     else jnp.pad(sub.k_scale, pad,
+                                  constant_values=1.0)),
+            v_scale=(None if sub.v_scale is None
+                     else jnp.pad(sub.v_scale, pad,
+                                  constant_values=1.0)))
+
+    def _handoff_insert_fn(self, params, pool, last_logits, rngs, sub,
+                           slot, plen, last, rng0):
+        """Disaggregated handoff landing (decode group): `sub` holds
+        ONLY the sequence's ceil(plen/B) live blocks, transferred from
+        the prefill group — widen to the region cap with zeros and
+        land through the slot's freshly-installed map row (pfx 0: a
+        disaggregated admission never aliases, its content arrived
+        from the other chip group). Fused with the slot activation
+        like _insert_blocks_fn."""
+        pool = insert_blocks(pool, self._widen_sub(sub, self.pool.cap),
+                             slot, plen, jnp.int32(0))
+        last_logits = last_logits.at[slot].set(last)
+        rngs = rngs.at[slot].set(rng0)
+        return pool, last_logits, rngs
+
+    def _pad_sub_pre_fn(self, params, sub, plen):
+        """Prefill-group widening of a transferred prefix: the
+        decode-side hit sliced down to its live blocks rides over as
+        [L, 1, nb*B, ...]; suffix chunks need the full-cap batch-1
+        layout at offset `plen`. `params` rides along unused so the
+        prefill mesh treatment applies uniformly (jit drops unused
+        args at lowering)."""
+        sub = self._widen_sub(sub, self.pool.cap)
+        return sub._replace(offset=jnp.full_like(sub.offset, plen))
+
+    @staticmethod
+    def _truncate_sub(sub, ntok: int):
+        """Host-side (eager) slice of a batch-1 cache down to its
+        first `ntok` token positions — the only bytes a cross-group
+        transfer moves (never a cap region)."""
+        return sub._replace(
+            k=sub.k[:, :, :ntok], v=sub.v[:, :, :ntok],
+            k_scale=(None if sub.k_scale is None
+                     else sub.k_scale[:, :, :ntok]),
+            v_scale=(None if sub.v_scale is None
+                     else sub.v_scale[:, :, :ntok]))
+
     def _prefill_bucket(self, plen: int) -> int:
         """Pad prompts up to a bucket so the prefill jit cache hits
         across request sizes. ROLLING pools prefill at the exact length:
@@ -1292,6 +1440,8 @@ class ServingEngine:
                                dtype=self.pool.dtype,
                                retained_limit=self.serving.retained_slots,
                                block_size=self.serving.kv_block_size)
+        if self.topo is not None:
+            self.topo.place_pool(self.pool)
         self.pool.on_reclaim = self._index.remove
         if self._host_tier is not None:
             # the tier itself survives a restart (host RAM is not
@@ -1376,11 +1526,11 @@ class ServingEngine:
         if self.scheduler.parked_count() < self.num_slots:
             if self._blocks_on:
                 sub = self._slice_blk(
-                    self.gen.params, self.pool.caches,
+                    self._p_dec, self.pool.caches,
                     jnp.asarray(self.pool.map_row(slot), jnp.int32),
                     jnp.int32(plen))
             else:
-                sub = self._slice(self.gen.params, self.pool.caches,
+                sub = self._slice(self._p_dec, self.pool.caches,
                                   jnp.int32(slot), jnp.int32(plen))
             # row-index makes a NEW device buffer — safe across the
             # next decode's donation of self._last_logits
@@ -1460,7 +1610,12 @@ class ServingEngine:
                 src, hit = self._lookup_prefix(toks, r.adapter_ns)
                 if hit or r.resume_rng is not None \
                         or (self._chunk is not None
-                            and len(toks) > self._chunk):
+                            and len(toks) > self._chunk) \
+                        or self._disagg:
+                    # disaggregated engines route EVERY admission
+                    # through the pending path: the batch-1 chunk
+                    # forward is the unit that runs on the prefill
+                    # group, and activation is the block handoff
                     self._start_pending(r, src, hit)
                     pending.remove(r)
                 else:
@@ -1609,6 +1764,9 @@ class ServingEngine:
                                  jnp.asarray(req.resume_rng),
                                  tokens=tokens, blocks=blocks)
             st.last = last
+            # a parked sub was sliced on the decode group and resumes
+            # there with one insert — no cross-group handoff
+            st.on_decode = True
             first = req.admit_time is None
             req.mark_admitted()  # no-op on a concurrently-failed req
             if first and req.admit_time is not None:
@@ -1657,6 +1815,7 @@ class ServingEngine:
         if self._blocks_on:
             alias = []
             roll_src_blocks = None
+            disagg_src_blocks = None
             if device_hit and self.pool.rolling:
                 # capture BEFORE alloc_row: block pressure may evict
                 # the source entry below. Its blocks' content stays
@@ -1664,7 +1823,17 @@ class ServingEngine:
                 # arena is functional, the gather reads this dispatch
                 # point's version.
                 roll_src_blocks = list(self.pool.entry(src).blocks)
-            if device_hit and not self.pool.rolling:
+            if device_hit and self._disagg:
+                # disaggregated hit: the prefix KV rides to the
+                # PREFILL group for the suffix chunks, and the handoff
+                # later writes the whole sequence back into the new
+                # row's own blocks — so the row never aliases (the
+                # zero-copy alias would leave the prefix on devices
+                # the chunks can't read). Captured before alloc_row
+                # for the same eviction-race reason as rolling.
+                disagg_src_blocks = self._src_blocks(src)[
+                    :prefix_len // self.pool.block_size]
+            elif device_hit and not self.pool.rolling:
                 pfx_blocks = prefix_len // self.pool.block_size
                 alias = self._src_blocks(src)[:pfx_blocks]
             got = self.pool.alloc_row(alias=alias, install=False)
@@ -1706,7 +1875,7 @@ class ServingEngine:
                 self.metrics.count("prefix_hits")
                 self.metrics.count("prefill_tokens_saved", prefix_len)
                 if not self._blocks_on:
-                    sub = self._slice(self.gen.params, self.pool.caches,
+                    sub = self._slice(self._p_dec, self.pool.caches,
                                       jnp.int32(src),
                                       jnp.int32(prefix_len))
                 elif self.pool.rolling:
@@ -1717,8 +1886,23 @@ class ServingEngine:
                     # THIS dispatch point, so later reuse of the
                     # entry's blocks cannot corrupt the copy.
                     sub = self._slice_blk(
-                        self.gen.params, self.pool.caches,
+                        self._p_dec, self.pool.caches,
                         jnp.asarray(roll_src_blocks, jnp.int32),
+                        jnp.int32(prefix_len))
+                elif self._disagg:
+                    # disaggregated hit: gather ONLY the prefix's live
+                    # blocks on the decode group ([L, 1, nb*B, ...]),
+                    # move them device-to-device, and widen to the
+                    # full-cap batch-1 layout on the prefill group —
+                    # the suffix chunks then append exactly like a
+                    # same-group hit. Block-granular both ways: a cap
+                    # region never crosses the group boundary.
+                    sub_t = self._slice_blk(
+                        self._p_dec, self.pool.caches,
+                        jnp.asarray(disagg_src_blocks, jnp.int32),
+                        jnp.int32(prefix_len))
+                    sub = self._pad_sub_pre(
+                        self._p_pre, self.topo.to_prefill(sub_t),
                         jnp.int32(prefix_len))
                 else:
                     # slicing through the new row's OWN block list
@@ -1727,7 +1911,7 @@ class ServingEngine:
                     # causal mask never sees) — the suffix chunks
                     # attend the prefix through this sub
                     sub = self._slice_blk(
-                        self.gen.params, self.pool.caches,
+                        self._p_dec, self.pool.caches,
                         jnp.asarray(blocks, jnp.int32),
                         jnp.int32(prefix_len))
             else:
@@ -1738,6 +1922,15 @@ class ServingEngine:
                 # donates its input — every chunk returns fresh buffers
                 if self._sub0 is None:
                     self._sub0 = self.pool.make_prefill_caches(1)
+                    if self.topo is not None:
+                        # commit the template to the PREFILL mesh once:
+                        # left uncommitted, every miss admission's
+                        # first chunk would re-transfer a full
+                        # cap-region of zeros to the prefill group —
+                        # the exact cross-group cap-region copy the
+                        # disaggregation design exists to avoid
+                        self._sub0 = self.topo.place_kv_tree(
+                            self._sub0, self.topo.prefill_mesh)
                 sub = self._sub0
             rng0 = (jnp.asarray(req.resume_rng)
                     if req.resume_rng is not None
@@ -1794,6 +1987,16 @@ class ServingEngine:
             return None
         nb = -(-plen // self.pool.block_size)
         arrays = {k: v[:, :nb] for k, v in ent.arrays.items()}
+        if self._disagg:
+            # disaggregated: upload ONLY the live blocks' bytes to the
+            # prefill group and widen on-device — the cap-sized zero
+            # tail never rides a transfer, the same block-granular
+            # discipline as the prefill->decode handoff
+            sub_t = self.pool.host_blocks_to_sub(arrays, plen,
+                                                 pad_to_cap=False)
+            return self._pad_sub_pre(self._p_pre,
+                                     self.topo.to_prefill(sub_t),
+                                     jnp.int32(plen))
         return self.pool.host_blocks_to_sub(arrays, plen)
 
     def _src_blocks(self, src) -> List[int]:
@@ -1847,11 +2050,14 @@ class ServingEngine:
         assert n <= padded, (n, padded, st.pos)
         toks = np.full((1, padded), self.gen.pad_id, np.int32)
         toks[0, :n] = st.tokens[st.pos:st.pos + n]
-        lora = self.adapters.stacked if self._adapters_on else None
+        # the PREFILL-group bank copy (== stacked on single-group
+        # topologies; serving/adapters.py stacked_prefill)
+        lora = (self.adapters.stacked_prefill if self._adapters_on
+                else None)
         aidx1 = (jnp.asarray([st.aidx], jnp.int32) if self._adapters_on
                  else None)
         st.sub, st.last = self._chunk_fwd(
-            self.gen.params, st.sub, jnp.asarray(toks),
+            self._p_pre, st.sub, jnp.asarray(toks),
             jnp.int32(n - 1), jnp.int32(st.pos + n), lora, aidx1)
         st.pos += n
         st.req.prefill_chunks += 1
@@ -1865,7 +2071,35 @@ class ServingEngine:
 
     def _activate_pending(self, st: _PendingPrefill, plen: int):
         slot, req = st.slot, st.req
-        if self._blocks_on:
+        if self._disagg and not st.on_decode:
+            # PREFILL->DECODE HANDOFF (docs/serving.md "Sharded &
+            # disaggregated serving"): the finished prefill's KV lives
+            # in a batch-1 sub on the prefill group. Move ONLY the
+            # sequence's ceil(plen/B) live blocks device-to-device —
+            # never the cap region (the handoff_bytes_per_req gauge
+            # pins exactly this) — and land them through the decode
+            # group's compiled pad+insert program. The carried logits
+            # row and rng key ride along (KiB-scale).
+            B = self.pool.block_size
+            nb_live = -(-plen // B)
+            sub_t = self._truncate_sub(st.sub, nb_live * B)
+            moved = self.topo.to_decode(sub_t)
+            last = jax.device_put(st.last,
+                                  self.topo.replicated(
+                                      self.topo.decode_mesh))
+            rng0 = jax.device_put(st.rng0,
+                                  self.topo.replicated(
+                                      self.topo.decode_mesh))
+            self.pool.install_row(slot, st.blocks)
+            st.installed = True
+            out = self._handoff_insert(self._p_dec, self.pool.caches,
+                                       self._last_logits, self._rngs,
+                                       moved, jnp.int32(slot),
+                                       jnp.int32(plen), last, rng0)
+            hbytes = nb_live * B * self.pool.bytes_per_token()
+            self.metrics.count("handoffs")
+            self.metrics.set_handoff_gauge(hbytes)
+        elif self._blocks_on:
             # install the row's block map NOW (not at admission): until
             # this moment the row's map pointed at trash, so the
             # K-chained decode dispatches that ran between chunks could
@@ -1873,14 +2107,14 @@ class ServingEngine:
             # blocks
             self.pool.install_row(slot, st.blocks)
             st.installed = True
-            out = self._insert_blk(self.gen.params, self.pool.caches,
+            out = self._insert_blk(self._p_dec, self.pool.caches,
                                    self._last_logits, self._rngs,
                                    st.sub, jnp.int32(slot),
                                    jnp.int32(plen),
                                    jnp.int32(st.pfx_blocks), st.last,
                                    st.rng0)
         else:
-            out = self._insert(self.gen.params, self.pool.caches,
+            out = self._insert(self._p_dec, self.pool.caches,
                                self._last_logits, self._rngs, st.sub,
                                jnp.int32(slot), jnp.int32(plen),
                                st.last, st.rng0)
@@ -1965,7 +2199,7 @@ class ServingEngine:
             aidxs = jnp.asarray(rows + [rows[0]] * (B - B_real),
                                 jnp.int32)
         self.pool.caches, self._last_logits, self._rngs = self._prefill(
-            self.gen.params, self.pool.caches, self._last_logits,
+            self._p_dec, self.pool.caches, self._last_logits,
             self._rngs, jnp.asarray(toks), jnp.asarray(plens_a),
             jnp.asarray(slots_a), rng0s, lora, aidxs)
         if self._blocks_on and not self._kernel_on:
@@ -2233,7 +2467,7 @@ class ServingEngine:
         for r in range(K):
             if spec_round[r]:
                 out = self._verify(
-                    self.gen.params, self.pool.caches,
+                    self._p_dec, self.pool.caches,
                     self._last_logits, self._rngs, self._d_lengths,
                     self._d_temps, self._d_top_ks, self._d_top_ps,
                     jnp.asarray(grids[r]), self._d_reject, lora, d_aidx)
@@ -2241,7 +2475,7 @@ class ServingEngine:
                 self.metrics.count("spec_rounds")
             else:
                 out = self._decode(
-                    self.gen.params, self.pool.caches,
+                    self._p_dec, self.pool.caches,
                     self._last_logits, self._rngs, self._d_lengths,
                     self._d_temps, self._d_top_ks, self._d_top_ps,
                     self._d_reject, lora, d_aidx)
@@ -2357,6 +2591,11 @@ class ServingEngine:
             window_bracket += K * 2 * self._view_bytes
         self.metrics.set_attn_gauges(window_bracket // K,
                                      self._attn_path)
+        # chip-group occupancy gauges (disaggregated A/B seam — also
+        # meaningful single-group: prefill pending vs slot occupancy)
+        self.metrics.set_group_gauges(
+            1.0 if self._prefilling else 0.0,
+            n_active / max(self.num_slots, 1))
         depth = self.scheduler.depth()
         for k in range(K):
             self.metrics.record_step(n_active, self.num_slots,

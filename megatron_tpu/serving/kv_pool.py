@@ -395,6 +395,11 @@ class SlotKVPool:
         )
         self._map = np.full((num_slots, self.blocks_per_slot),
                             self.TRASH, np.int32)
+        # TP-sharded serving (serving/topology.py place_pool) pins the
+        # map's replicated NamedSharding here so every _sync_map
+        # re-upload lands identically placed; None (default) keeps the
+        # uncommitted single-device upload
+        self._map_sharding = None
         # jnp.array, not asarray: the device map must never alias the
         # host buffer (see _sync_map)
         self.caches = BlockKV(arena=arena, map=jnp.array(self._map))
@@ -491,7 +496,10 @@ class SlotKVPool:
         # as scratch and corrupt the host-side map mid-flight; and
         # host-side map surgery must never mutate the map an already
         # dispatched program is still consuming.
-        self.caches = self.caches._replace(map=jnp.array(self._map))
+        m = jnp.array(self._map)
+        if self._map_sharding is not None:
+            m = jax.device_put(m, self._map_sharding)
+        self.caches = self.caches._replace(map=m)
 
     def _unref(self, block: int):
         self._acct_dirty = True
@@ -646,7 +654,8 @@ class SlotKVPool:
                 jax.device_get(jnp.take(a.v_scale, idx, axis=1)))
         return out
 
-    def host_blocks_to_sub(self, arrays, plen: int) -> KVCache:
+    def host_blocks_to_sub(self, arrays, plen: int,
+                           pad_to_cap: bool = True) -> KVCache:
         """Assemble host-gathered block arrays into a batch-1 cache in
         the pool's layout, positioned at `plen` — the host-RAM tier's
         restore write (`device_put` half): the engine hands this sub to
@@ -654,15 +663,24 @@ class SlotKVPool:
         pool-accounting surgery and lands through already-compiled
         programs. Positions past the restored blocks are zeros — they
         sit at/after the sub's offset, where appends overwrite them
-        write-before-read (the bucketed-prefill invariant)."""
+        write-before-read (the bucketed-prefill invariant).
+
+        `pad_to_cap=False` returns the TRUNCATED [L, 1, nb*B, ...]
+        layout instead — only the live blocks' bytes are uploaded; the
+        disaggregated engine widens it on the prefill mesh so the
+        cap-sized zero tail never rides a transfer (the same
+        block-granular discipline as the prefill→decode handoff)."""
         assert self.blocks_enabled
         L, nb, B = arrays["k"].shape[:3]
-        cap = self.cap
+        cap = self.cap if pad_to_cap else nb * B
 
         def fill(name, tail_shape, fill_value, dtype):
+            a = arrays[name]
+            if not pad_to_cap:
+                return jnp.asarray(
+                    a.reshape((L, 1, nb * B) + a.shape[3:]))
             full = np.full((L, 1, cap) + tail_shape, fill_value,
                            dtype=dtype)
-            a = arrays[name]
             full[:, 0, :nb * B] = a.reshape((L, nb * B) + a.shape[3:])
             return jnp.asarray(full)
 

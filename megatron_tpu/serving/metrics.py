@@ -82,6 +82,11 @@ _BASE_COUNTERS = (
     # reload-from-disk miss, never wrong weights)
     "adapter_loads", "adapter_evictions", "adapter_host_hits",
     "adapter_host_checksum_misses",
+    # sharded + disaggregated serving (docs/serving.md "Sharded &
+    # disaggregated serving"): handoffs = completed prefill-group ->
+    # decode-group block transfers (one per admission on a
+    # disaggregated engine; 0 on single-group engines)
+    "handoffs",
 )
 
 
@@ -132,6 +137,18 @@ class ServingMetrics:
         # adapters right now — 0 on adapterless engines, pushed by the
         # engine on pool churn like the KV gauges
         self.active_adapters = 0
+        # sharded + disaggregated serving gauges (always present, 0 on
+        # single-group engines): handoff_bytes_per_req = bytes the most
+        # recent prefill->decode handoff moved — the "only the
+        # sequence's live blocks" pin (ceil(plen/B) * block bytes,
+        # never a cap region); prefill_group_busy / decode_group_busy =
+        # instantaneous occupancy of each chip group at the last sync
+        # window (pending prefills > 0 -> 1.0; active slots /
+        # num_slots), the phase-interference A/B seam bench_disagg
+        # reads
+        self.handoff_bytes_per_req = 0
+        self.prefill_group_busy = 0.0
+        self.decode_group_busy = 0.0
 
     # ---- recording ---------------------------------------------------
     def count(self, name: str, n: int = 1):
@@ -167,6 +184,21 @@ class ServingMetrics:
         (serving/adapters.py AdapterBank.active_count)."""
         with self._lock:
             self.active_adapters = int(active)
+
+    def set_handoff_gauge(self, nbytes: int):
+        """Engine-pushed: bytes the just-completed prefill->decode
+        block handoff moved (disaggregated engines only)."""
+        with self._lock:
+            self.handoff_bytes_per_req = int(nbytes)
+
+    def set_group_gauges(self, prefill_busy: float, decode_busy: float):
+        """Engine-pushed per sync window: instantaneous prefill/decode
+        chip-group occupancy (single-group engines report the same
+        numbers — prefill pending vs slot occupancy — so the schema
+        never forks on the topology)."""
+        with self._lock:
+            self.prefill_group_busy = float(prefill_busy)
+            self.decode_group_busy = float(decode_busy)
 
     def set_attn_gauges(self, gather_bytes_per_step: int, path: int):
         """Engine-pushed attention-path gauges (per sync window):
@@ -221,7 +253,13 @@ class ServingMetrics:
                       "kv_gather_bytes_per_step":
                           float(self.kv_gather_bytes_per_step),
                       "kv_attn_path": float(self.kv_attn_path),
-                      "active_adapters": float(self.active_adapters)}
+                      "active_adapters": float(self.active_adapters),
+                      "handoff_bytes_per_req":
+                          float(self.handoff_bytes_per_req),
+                      "prefill_group_busy":
+                          float(self.prefill_group_busy),
+                      "decode_group_busy":
+                          float(self.decode_group_busy)}
         out = {k: 0.0 for k in _BASE_COUNTERS}
         out.update({k: float(v) for k, v in counters.items()})
         out.update(gauges)

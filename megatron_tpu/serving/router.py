@@ -60,6 +60,11 @@ UP, DOWN, PROBING = "up", "down", "probing"
 _SUM_GAUGES = ("queue_depth", "active_slots", "num_slots",
                "kv_blocks_used", "kv_blocks_retained", "kv_bytes_wasted",
                "active_adapters")
+# gauges reported as the WORST replica (max) — per-request /
+# per-group readings where summing fractions would be meaningless
+# (same treatment as the *_ms latency keys below)
+_MAX_GAUGES = ("handoff_bytes_per_req", "prefill_group_busy",
+               "decode_group_busy")
 
 
 class NoReplicaAvailableError(ServiceUnavailableError):
@@ -609,8 +614,9 @@ class EngineRouter:
             for k in _BASE_COUNTERS + _SUM_GAUGES:
                 out[k] = out.get(k, 0.0) + snap.get(k, 0.0)
             for k, v in snap.items():
-                if k.endswith("_ms") or k in ("tokens_per_s",
-                                              "slot_occupancy"):
+                if k.endswith("_ms") or k in (("tokens_per_s",
+                                               "slot_occupancy")
+                                              + _MAX_GAUGES):
                     out[k] = max(out.get(k, 0.0), v)
         out["num_replicas"] = float(len(self.replicas))
         return out

@@ -1,0 +1,218 @@
+"""Serving-mesh topology: TP-sharded engine state and prefill/decode
+chip groups.
+
+The training side has run tp·pp·dp GSPMD meshes since PR 1; the serving
+engine stayed single-device, so a model that trains fine cannot serve
+at all once its weights (or its KV pool) outgrow one chip. This module
+is the serving-side mesh plane:
+
+- **TP sharding** (`ServingConfig.serving_tp = T`): the engine's
+  compiled programs run under the SAME mesh treatment training uses —
+  params consumed in their tp-sharded layout
+  (`parallel/sharding.tree_logical_to_sharding`, the rules table that
+  drives the train step), the KV pool's arena/regions sharded over
+  'tp' on the kv-head axis (`KV_CACHE_AXES`, the constraint
+  `init_kv_caches` already carries), the AdapterBank's B factors
+  sharded on their projection out-dims. Everything else — the per-slot
+  block map, lengths, adapter indices, sampling knobs, PRNG grids — is
+  replicated DISPATCH DATA, exactly as before, so decode, speculative
+  verify, and batched prefill keep ONE compile each and `serving_tp=1`
+  builds no topology at all (the engine takes today's code paths
+  bit-identically).
+
+- **Disaggregation** (`ServingConfig.disaggregate_prefill`,
+  DistServe, PAPERS.md): prefill and decode have opposite rooflines —
+  prefill is compute-bound (one big matmul-heavy forward per prompt),
+  decode is HBM-bound (stream all weights + KV to emit one token per
+  slot) — so sharing chips means each phase stalls the other. The
+  topology splits the serving devices into a (prefill-group,
+  decode-group) pair of tp-sized meshes: the batch-1 chunked prefill
+  (`generation.prefill_chunk` — already a standalone forward OUTSIDE
+  the pool, exactly the unit to relocate) runs on the prefill group,
+  and "hand off to decode" is a device-to-device copy of the
+  sequence's live physical blocks ONLY (never a cap-region copy) that
+  lands through the decode group's compiled `insert_blocks`. The
+  engine loop stays one host thread: prefill and decode dispatches are
+  async, so the two groups genuinely overlap.
+
+Group layout over the engine's device list: `[decode group (tp), then
+prefill group (tp)]` — an `EngineRouter` replica over a disaggregated
+engine is a (prefill-group, decode-group) PAIR, and
+`inference/server.py` slices `jax.devices()` into
+`num_replicas x devices_per_replica` windows.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from megatron_tpu.inference.generation import KV_CACHE_AXES
+from megatron_tpu.parallel.mesh import MESH_AXES, TENSOR_AXIS
+from megatron_tpu.parallel import sharding as shd
+
+
+def devices_per_engine(serving) -> int:
+    """Devices ONE engine (router replica) occupies under `serving`'s
+    topology: serving_tp chips for the decode group, plus another
+    serving_tp for the prefill group when disaggregated. 1 for the
+    (default) no-topology engine."""
+    tp = int(getattr(serving, "serving_tp", 1) or 1)
+    return tp * (2 if getattr(serving, "disaggregate_prefill", False)
+                 else 1)
+
+
+class ServingTopology:
+    """The serving mesh plane one engine runs on. Built only when
+    `serving_tp > 1` or `disaggregate_prefill` — `build_topology`
+    returns None otherwise and the engine keeps its topology-free
+    (single-device) code paths untouched."""
+
+    def __init__(self, serving, devices: Optional[Sequence] = None):
+        self.tp = int(getattr(serving, "serving_tp", 1) or 1)
+        self.disaggregated = bool(
+            getattr(serving, "disaggregate_prefill", False))
+        need = devices_per_engine(serving)
+        if devices is None:
+            devices = jax.devices()[:need]
+        devices = list(devices)
+        assert len(devices) >= need, (
+            f"serving topology needs {need} devices "
+            f"(serving_tp={self.tp}"
+            f"{', disaggregated' if self.disaggregated else ''}) but "
+            f"only {len(devices)} were provided — lower serving_tp / "
+            "num_replicas or disable disaggregate_prefill")
+        self.devices = devices[:need]
+
+        def _mesh(devs):
+            return Mesh(np.asarray(devs).reshape(1, 1, 1, self.tp),
+                        MESH_AXES)
+
+        # decode group first: a non-disaggregated topology IS its
+        # decode mesh (prefill shares it)
+        self.decode_mesh = _mesh(self.devices[:self.tp])
+        self.prefill_mesh = (_mesh(self.devices[self.tp:2 * self.tp])
+                             if self.disaggregated else self.decode_mesh)
+        # the serving rules are the training rules (sequence_parallel
+        # off — serving activations are tiny): 'heads'/'kv_heads'/
+        # 'mlp'/'vocab' -> tp, everything else replicated
+        self.rules = shd.make_logical_rules(False)
+        self._kv_spec = shd.logical_to_spec(KV_CACHE_AXES, self.rules)
+
+    # ---- placement ---------------------------------------------------
+    def param_shardings(self, params, cfg, mesh: Mesh):
+        from megatron_tpu.models import language_model as lm
+        from megatron_tpu.ops.quantized import quantize_axes
+        return shd.tree_logical_to_sharding(
+            mesh, quantize_axes(lm.model_axes(cfg), params), self.rules)
+
+    def place_params(self, params, cfg, mesh: Mesh):
+        """(placed_params, shardings): weights laid out for `mesh`'s tp
+        shards — the jit consumes them in place (no per-call
+        re-layout), and a disaggregated engine holds one resident copy
+        per group.
+
+        Residency note: this is a COPY — the caller's source `params`
+        (the Generator's, usually on the default device) stay alive as
+        long as the caller references them, because sibling replicas,
+        the serial/beam server routes, and re-placement after a
+        restart all read them. A deployment tight on device 0's HBM
+        should load weights to HOST first (numpy/host-committed) so
+        the only device-resident copies are the sharded ones placed
+        here; deduplicating the source copy automatically is open
+        upside (ROADMAP)."""
+        sh = self.param_shardings(params, cfg, mesh)
+        return jax.device_put(params, sh), sh
+
+    def replicated(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, P())
+
+    def kv_sharding(self, mesh: Mesh) -> NamedSharding:
+        """Sharding of any 5-dim KV leaf ([L, rows|blocks, tokens, nkv,
+        hd|1] — region, arena, scale, and batch-1 sub layouts all put
+        kv-heads at axis 3): the 'kv_heads' -> tp rule of
+        KV_CACHE_AXES, the same placement `init_kv_caches` constrains
+        to inside traced programs."""
+        return NamedSharding(mesh, self._kv_spec)
+
+    def place_kv_tree(self, tree, mesh: Mesh):
+        """device_put a KVCache-shaped pytree (or the block arena):
+        5-dim leaves shard on the kv-head axis, everything else
+        (offsets, maps) replicates."""
+        kv = self.kv_sharding(mesh)
+        rep = self.replicated(mesh)
+        return jax.tree.map(
+            lambda x: jax.device_put(x, kv if jnp.ndim(x) == 5 else rep),
+            tree)
+
+    def place_pool(self, pool):
+        """Lay the freshly-built SlotKVPool out on the decode mesh:
+        arena/region k/v (and int8 scales) sharded on kv-heads,
+        offsets and the block map replicated. Also pins the pool's
+        map re-upload sharding so `_sync_map` keeps the placement
+        stable across slot churn."""
+        pool.caches = self.place_kv_tree(pool.caches, self.decode_mesh)
+        if pool.blocks_enabled:
+            pool._map_sharding = self.replicated(self.decode_mesh)
+
+    def adapter_shardings(self, mesh: Optional[Mesh] = None):
+        """AdapterBank factor placement (decode mesh by default; pass
+        the prefill mesh for a disaggregated engine's mirror copy), by
+        the same projection specs the base weights use: B factors
+        shard their out-dim ('heads' for bq, 'kv_heads' for bk/bv),
+        ao shards its in-dim (the q-projection out-dim it
+        right-multiplies); A factors and bo (out-dim = embed)
+        replicate. Rank dims are tiny and stay unsharded."""
+        from megatron_tpu.models.attention import LoraAdapter
+        if mesh is None:
+            mesh = self.decode_mesh
+        spec = {
+            "aq": P(), "ak": P(), "av": P(), "bo": P(),
+            "bq": P(None, None, None, TENSOR_AXIS),
+            "bk": P(None, None, None, TENSOR_AXIS),
+            "bv": P(None, None, None, TENSOR_AXIS),
+            "ao": P(None, None, TENSOR_AXIS, None),
+        }
+        return LoraAdapter(**{n: NamedSharding(mesh, spec[n])
+                              for n in LoraAdapter._fields})
+
+    # ---- mesh-aware jit (the Generator._jit treatment, per group) ----
+    def _jit(self, mesh: Mesh, param_sh, fn, n_array_args: int,
+             donate_argnums=()):
+        rules = self.rules
+
+        def fn_ctx(*args, **kwargs):
+            with shd.activation_shardings(mesh, rules):
+                return fn(*args, **kwargs)
+
+        return jax.jit(
+            fn_ctx,
+            in_shardings=(param_sh,) + (None,) * n_array_args,
+            donate_argnums=donate_argnums)
+
+    # ---- cross-group transfer (the disaggregated handoff) ------------
+    def to_decode(self, tree):
+        """Move a prefill-group pytree onto the decode group (the
+        prefill→decode handoff copy): 5-dim KV leaves land in their
+        kv-head-sharded layout, small leaves (logits rows, rng keys)
+        replicate. A plain device_put — the only data that ever crosses
+        the group boundary."""
+        return self.place_kv_tree(tree, self.decode_mesh)
+
+    def to_prefill(self, tree):
+        """Move a decode-group pytree onto the prefill group (the
+        prefix-hit's shared blocks, riding the other way)."""
+        return self.place_kv_tree(tree, self.prefill_mesh)
+
+
+def build_topology(serving, devices: Optional[Sequence] = None
+                   ) -> Optional[ServingTopology]:
+    """None when `serving` asks for no topology (serving_tp == 1 and
+    no disaggregation) — the bit-identical default."""
+    tp = int(getattr(serving, "serving_tp", 1) or 1)
+    if tp == 1 and not getattr(serving, "disaggregate_prefill", False):
+        return None
+    return ServingTopology(serving, devices=devices)

@@ -310,3 +310,48 @@ def test_bench_32k_fit_emits_extrapolation(monkeypatch, tmp_path):
     assert "_slice_train_tokens_per_sec_per_chip" in text
     assert "extrapolated_7b_" in text
     assert "EXTRAPOLATED" in text  # the honest-labeling contract
+
+
+def test_bench_disagg_emits_ab_record(monkeypatch, tmp_path):
+    """The interleave-vs-disaggregated A/B must run both serving arms
+    token-exact (the tool asserts agreement itself and exits nonzero
+    on divergence), pin the handoff at ceil(plen/B) live blocks —
+    never a cap region — and report the TTFT / inter-token-p99 /
+    decode-tok/s seams plus the tp=1-vs-2 decode arm the on-chip
+    comparison keys on (PERF_NOTES queue item 10)."""
+    import json
+    text = run_tool(monkeypatch, tmp_path, "bench_disagg.py",
+                    ["--smoke"])
+    rec = json.loads(text)
+    assert rec["bench"] == "disagg_serving"
+    assert rec["greedy_arms_token_exact"] is True
+    inter, dis = rec["interleave"], rec["disaggregated"]
+    assert inter["handoffs"] == 0  # the fallback never hands off
+    # on the 8-virtual-device harness both multi-group arms must RUN
+    assert "skipped" not in dis
+    assert dis["handoffs"] == rec["requests"]
+    assert dis["handoff_bytes_per_req"] > 0
+    assert dis["tokens_generated"] == inter["tokens_generated"] > 0
+    for key in ("ttft_p50_ms", "inter_token_p99_ms", "decode_tok_s"):
+        assert key in inter and key in dis
+    assert "skipped" not in rec["tp_arms"]
+    assert rec["tp_arms"]["tp_speedup_x"] > 0
+
+
+@pytest.mark.slow
+def test_bench_serving_queue_runs_pending_abs(monkeypatch, tmp_path):
+    """The one-window queue runner must execute every pending serving
+    A/B (PERF_NOTES items 8/9/10) as independent subprocesses and
+    collect their records into one combined line — the single log a
+    short tunnel window needs to clear the queue."""
+    import json
+    text = run_tool(monkeypatch, tmp_path, "bench_serving_queue.py",
+                    ["--smoke"])
+    rec = json.loads(text)
+    assert rec["bench"] == "serving_queue"
+    assert rec["all_green"] is True
+    assert [r["name"] for r in rec["runs"]] == \
+        ["block_attn", "lora", "disagg"]
+    assert rec["results"]["block_attn"]["bench"] == "block_native_attn"
+    assert rec["results"]["lora"]["bench"] == "lora_adapters"
+    assert rec["results"]["disagg"]["bench"] == "disagg_serving"

@@ -868,6 +868,22 @@ def test_chaos_router_smoke(tmp_path):
     assert record["host_tier"]["host_tier_checksum_misses"] >= 1
     assert record["host_tier"]["clean_restore_exact"] is True
     assert record["host_tier"]["corrupt_restore_exact"] is True
+    # disaggregated halves (ISSUE 13): losing either chip group of a
+    # (prefill-group, decode-group) replica fails over like a dead
+    # replica — token-exact resubmission on the surviving pair,
+    # degraded-not-down /healthz, the survivor still handing off
+    # (the tool forces a 4-virtual-device CPU platform, so the drills
+    # must RUN here, not skip)
+    for half in ("kill_prefill_half", "kill_decode_half"):
+        d = record[half]
+        assert "skipped" not in d, d
+        assert d["outcomes"]["stranded"] == 0
+        assert d["outcomes"]["error"] == 0
+        assert d["completed_token_exact"] is True
+        assert d["router_failovers"] >= 1
+        assert d["health_state"] == "degraded"
+        assert d["healthz_ready"] is True
+        assert d["survivor_handoffs"] >= 1
 
 
 # ---------------------------------------------------------------------------

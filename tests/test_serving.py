@@ -3037,7 +3037,16 @@ class TestRollingBlocks:
                 pre = eng.metrics.snapshot()["preemptions"]
             return low_out, pre, dropped
 
-        replay, p1, dropped = run(True)
+        # the drop races the engine loop: if `hi` finished and the
+        # victim resumed from its park before clear_parked ran,
+        # nothing was dropped and the replay path never exercised —
+        # that run proves nothing either way (the output is exact
+        # regardless), so retry the stage a few times instead of
+        # flaking under suite-wide CPU contention
+        for _ in range(4):
+            replay, p1, dropped = run(True)
+            if dropped >= 1:
+                break
         parked, p2, _ = run(False)
         assert p1 >= 1 and p2 >= 1 and dropped >= 1
         assert replay == parked

@@ -27,6 +27,20 @@ model:
    the request recomputes and stays token-exact — a corrupt demotion
    is a MISS, never wrong tokens — while an uncorrupted entry restores
    (`host_tier_hits`) token-exact.
+4. **kill-the-prefill-half / kill-the-decode-half** (docs/serving.md
+   "Sharded & disaggregated serving"): over a DISAGGREGATED 2-replica
+   router — each replica a (prefill-group, decode-group) device pair —
+   one replica permanently loses one HALF (its prefill or decode
+   dispatch raises, the in-process analogue of that chip group dying).
+   Contract: the half-dead replica's supervisor exhausts its restarts
+   and trips the breaker, the router ejects the REPLICA (a pair with a
+   dead half is a dead pair), every accepted request resolves
+   token-exact on the surviving pair (token-exact resubmission covers
+   a dead half exactly like a dead replica), `/healthz` reports
+   DEGRADED (not down), and the survivor keeps handing off
+   (`handoffs` still advances). Skipped with a note when the backend
+   has < 4 devices (2 replicas x 2 groups); the CPU smoke forces a
+   4-virtual-device host platform.
 
 Emits ONE BENCH-style JSON record on stdout (and to --out), like
 chaos_serve.py, so front-door regressions surface in the
@@ -290,13 +304,109 @@ def host_tier_drill(new_tokens: int) -> dict:
     }
 
 
+def _tiny_disagg_router(new_tokens: int):
+    """2-replica router over DISAGGREGATED engines: 4 devices, each
+    replica a (prefill-group, decode-group) pair."""
+    import jax
+
+    from megatron_tpu.config import ModelConfig, ServingConfig
+    from megatron_tpu.inference.generation import Generator
+    from megatron_tpu.models import language_model as lm
+    from megatron_tpu.serving import EngineRouter, ServingEngine
+
+    cfg = ModelConfig(num_layers=2, hidden_size=64,
+                      num_attention_heads=2, num_kv_heads=1,
+                      vocab_size=128, seq_length=128,
+                      max_position_embeddings=128,
+                      make_vocab_size_divisible_by=64,
+                      compute_dtype="bfloat16").derived()
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+    serving = ServingConfig(
+        num_slots=2, max_queue=64, max_len=128, kv_block_size=16,
+        disaggregate_prefill=True,
+        # a dead half keeps raising: one restart then the breaker —
+        # the replica must go hard-down fast so the router ejects it
+        max_engine_restarts=1).validate(cfg)
+    devs = jax.devices()
+    engines = [ServingEngine(gen, serving, devices=devs[i * 2:i * 2 + 2])
+               for i in range(2)]
+    router = EngineRouter(engines, max_retries=2,
+                          heartbeat_timeout_s=2.0, probe_backoff_s=30.0)
+    return router, engines, gen
+
+
+def kill_half_drill(new_tokens: int, half: str) -> dict:
+    """Kill one replica's prefill OR decode chip group mid-traffic
+    and pin token-exact resubmission on the surviving pair."""
+    import jax
+
+    from megatron_tpu.serving import SamplingOptions
+
+    if len(jax.devices()) < 4:
+        return {"skipped": f"{len(jax.devices())} device(s) < 4 "
+                           "(2 disaggregated replicas)", "ok": True}
+    router, engines, gen = _tiny_disagg_router(new_tokens)
+    sampling = SamplingOptions(temperature=0.0)
+    want = _serial_oracle(gen)
+    try:
+        for eng in engines:
+            eng.generate([3, 1, 4], 2, sampling, seed=0)
+
+        def dead(*a, **k):
+            raise RuntimeError(f"injected: {half} half down "
+                               "(chip group lost)")
+
+        # the half dies PERMANENTLY: every dispatch on it raises, so
+        # the supervisor's restart re-crashes and the breaker trips
+        if half == "prefill":
+            engines[0]._chunk_fwd = dead
+        else:
+            engines[0]._decode = dead
+        reqs = []
+        for i in range(6):
+            p = [5 + i, 2, 7, 2, 7]
+            reqs.append((router.submit(p, new_tokens, sampling, seed=i),
+                         p, new_tokens))
+        outcomes, exact = _resolve_exact(reqs, want)
+        health = router.health()
+        snap = router.aggregate_snapshot()
+        # the surviving PAIR still serves end-to-end — prefill group,
+        # handoff, decode group
+        post = router.submit([9, 9, 8], 4, sampling, seed=99)
+        post_toks, _ = post.result(timeout=60)
+        post_exact = post_toks == want([9, 9, 8], 4)
+        snap_post = router.aggregate_snapshot()
+    finally:
+        router.close()
+    return {
+        "half": half,
+        "submitted": len(reqs), "outcomes": outcomes,
+        "completed_token_exact": exact,
+        "router_failovers": int(snap["router_failovers"]),
+        "router_retries": int(snap["router_retries"]),
+        "health_state": health["state"],
+        "healthz_ready": bool(health["healthy"]),
+        "post_kill_serve_exact": post_exact,
+        "survivor_handoffs": int(snap_post["handoffs"]),
+        "ok": (outcomes["stranded"] == 0 and outcomes["error"] == 0
+               and outcomes["ok"] == len(reqs) and exact
+               and int(snap["router_failovers"]) >= 1
+               and health["state"] == "degraded" and health["healthy"]
+               and post_exact and int(snap_post["handoffs"]) >= 1),
+    }
+
+
 def run_chaos(new_tokens: int, timeout_s: float, stall_s: float) -> dict:
     t0 = time.monotonic()
     kill = kill_drill(new_tokens)
     wedge = wedge_drill(new_tokens, timeout_s, stall_s)
     host = host_tier_drill(new_tokens)
+    kill_prefill = kill_half_drill(new_tokens, "prefill")
+    kill_decode = kill_half_drill(new_tokens, "decode")
     wall_s = time.monotonic() - t0
-    ok = kill["ok"] and wedge["ok"] and host["ok"]
+    ok = (kill["ok"] and wedge["ok"] and host["ok"]
+          and kill_prefill["ok"] and kill_decode["ok"])
     return {
         "metric": "router_chaos_failover_retries",
         "value": kill["router_retries"] + wedge["router_retries"],
@@ -307,6 +417,8 @@ def run_chaos(new_tokens: int, timeout_s: float, stall_s: float) -> dict:
         "kill": kill,
         "wedge": wedge,
         "host_tier": host,
+        "kill_prefill_half": kill_prefill,
+        "kill_decode_half": kill_decode,
         "wall_s": round(wall_s, 1),
     }
 
@@ -325,6 +437,16 @@ def main(argv=None) -> int:
                     help="also write the JSON record here")
     args = ap.parse_args(argv)
 
+    # the disaggregated kill-half drills need 4 devices (2 replicas x
+    # 2 chip groups); on the CPU backend force a 4-virtual-device host
+    # platform BEFORE jax initializes (the same conftest trick — the
+    # caller's flags win if already set)
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "cpu"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
     ensure_env_platform()
     if args.smoke:
         args.new_tokens, args.watchdog_s, args.stall_s = 12, 1.0, 2.5

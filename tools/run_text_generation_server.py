@@ -80,6 +80,20 @@ def main(argv=None):
                    help="directory of adapter .npz exports (finetune "
                         "--lora_rank) registered at start; adapter_id "
                         "= file stem")
+    p.add_argument("--serving_tp", type=int, default=1,
+                   help="tensor-parallel width of the serving mesh "
+                        "(weights + KV arena shard over 'tp' on the "
+                        "head axes; 1 = single-device engine — "
+                        "docs/serving.md 'Sharded & disaggregated "
+                        "serving')")
+    p.add_argument("--kv_block_size", type=int, default=None,
+                   help="block-granular KV pool (required by "
+                        "--disaggregate_prefill; docs/serving.md)")
+    p.add_argument("--disaggregate_prefill", action="store_true",
+                   help="prefill and decode on separate serving_tp-"
+                        "wide chip groups; the handoff moves only the "
+                        "sequence's live KV blocks (needs "
+                        "--kv_block_size)")
     args = p.parse_args(argv)
     if args.adapter_dir and (args.serial or args.adapter_slots <= 0):
         # fail loudly at the flag boundary: the serial path threads no
@@ -135,7 +149,10 @@ def main(argv=None):
                             request_deadline_s=args.request_deadline_s,
                             adapter_slots=args.adapter_slots,
                             adapter_rank=args.adapter_rank,
-                            adapter_host_bytes=args.adapter_host_bytes
+                            adapter_host_bytes=args.adapter_host_bytes,
+                            serving_tp=args.serving_tp,
+                            kv_block_size=args.kv_block_size,
+                            disaggregate_prefill=args.disaggregate_prefill
                             ).validate(mcfg)
     server = MegatronServer(gen, tokenizer, serving=serving)
     if args.adapter_dir:
