@@ -400,6 +400,14 @@ def _run_extras():
         # host restores (docs/serving.md "Front door")
         ("chaos_router.py", ["--smoke"],
          "/tmp/bench_extras_chaos_router.log"),
+        # live-weight chaos drill: rolling upgrade under load with the
+        # draining replica killed mid-swap, a corrupt checkpoint
+        # publish mid-watch, and an upgrade racing the disaggregated
+        # handoff — zero 503s, every completion token-exact at its
+        # admitted version, refused swaps contained (docs/serving.md
+        # "Live weights & rolling upgrade")
+        ("chaos_upgrade.py", ["--smoke"],
+         "/tmp/bench_extras_chaos_upgrade.log"),
         # corrupt-dataset detection smoke: inject truncated-.bin /
         # garbage-.idx / out-of-range-pointer faults, prove each raises
         # a typed DatasetCorruptionError at open (docs/resilience.md

@@ -385,6 +385,25 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                         "(checksum-verified on restore; a corrupt "
                         "copy reloads from disk — never wrong "
                         "weights; 0 = evictions drop to disk reload)")
+    g.add_argument("--swap_timeout_s", type=float, default=120.0,
+                   help="serving: how long a live-weight hot swap "
+                        "waits for in-flight work to drain at the "
+                        "swap barrier before it is cancelled (typed "
+                        "refusal; the engine keeps serving — "
+                        "docs/serving.md 'Live weights & rolling "
+                        "upgrade')")
+    g.add_argument("--watch_checkpoints", type=str, default=None,
+                   help="serving: training checkpoint root to watch — "
+                        "every newly published (tracker-named, "
+                        "manifest-verified) checkpoint hot-swaps onto "
+                        "the running engine, or rolling-upgrades the "
+                        "replica fleet drain->swap->canary->re-admit "
+                        "with zero 503s; a corrupt publish is refused "
+                        "and retried only on the NEXT publish "
+                        "(docs/serving.md)")
+    g.add_argument("--watch_interval_s", type=float, default=5.0,
+                   help="serving: tracker poll cadence for "
+                        "--watch_checkpoints")
     g.add_argument("--lora_rank", type=int, default=0,
                    help="finetune: train ONLY LoRA low-rank adapter "
                         "factors at this rank (base frozen) and "
@@ -687,7 +706,10 @@ def config_from_args(args: argparse.Namespace,
             disaggregate_prefill=args.disaggregate_prefill,
             adapter_slots=args.adapter_slots,
             adapter_rank=args.adapter_rank,
-            adapter_host_bytes=args.adapter_host_bytes),
+            adapter_host_bytes=args.adapter_host_bytes,
+            swap_timeout_s=args.swap_timeout_s,
+            watch_checkpoints=args.watch_checkpoints,
+            watch_interval_s=args.watch_interval_s),
         resilience=ResilienceConfig(**{
             **_pick(args, ResilienceConfig),
             "checkpoint_integrity": not args.no_checkpoint_integrity}),

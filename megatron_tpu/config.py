@@ -616,6 +616,22 @@ class ServingConfig:
     # HBM at validate time instead of OOMing at engine construction.
     # None = no check.
     adapter_max_bank_bytes: Optional[int] = None
+    # --- live-weight serving (docs/serving.md "Live weights & rolling
+    # upgrade"; serving/weights.py) --------------------------------
+    # how long engine.swap_weights waits at the swap barrier for
+    # in-flight slots/prefills to finish under the current weights
+    # before the swap is cancelled (typed refusal; the engine keeps
+    # serving — admissions resume immediately)
+    swap_timeout_s: float = 120.0
+    # training checkpoint root to WATCH: poll its tracker and hot-swap
+    # (single engine) or rolling-upgrade (router fleet) to every newly
+    # published checkpoint — trainers drive the serving fleet with
+    # zero operator action. A refused (corrupt/mid-publish) checkpoint
+    # is counted and NOT retried until the tracker names a new one.
+    # None = off.
+    watch_checkpoints: Optional[str] = None
+    # tracker poll cadence for --watch_checkpoints
+    watch_interval_s: float = 5.0
 
     def validate(self, model: Optional["ModelConfig"] = None
                  ) -> "ServingConfig":
@@ -815,6 +831,13 @@ class ServingConfig:
         assert not (self.num_replicas > 1 and self.serial_fallback), (
             "num_replicas > 1 routes through the continuous-batching "
             "engine; serial_fallback has no replicas to route over")
+        # --- live-weight serving (serving/weights.py) ----------------
+        assert self.swap_timeout_s > 0.0, self.swap_timeout_s
+        assert self.watch_interval_s > 0.0, self.watch_interval_s
+        assert not (self.watch_checkpoints and self.serial_fallback), (
+            "watch_checkpoints requires the continuous-batching "
+            "engine: the serial fallback path has no engine to "
+            "hot-swap — drop serial_fallback or the watcher")
         # --- multi-tenant LoRA serving (serving/adapters.py) ----------
         assert self.adapter_slots >= 0, self.adapter_slots
         assert self.adapter_host_bytes >= 0, self.adapter_host_bytes

@@ -145,7 +145,7 @@ class MegatronServer:
     """(ref: text_generation_server.py:229-241 MegatronServer)"""
 
     def __init__(self, generator: Generator, tokenizer, serving=None,
-                 request_timeout: float = 600.0):
+                 request_timeout: float = 600.0, weight_version=None):
         from megatron_tpu.config import ServingConfig
         self.generator = generator
         self.tokenizer = tokenizer
@@ -192,7 +192,8 @@ class MegatronServer:
                 # the single-replica server (test-pinned).
                 from megatron_tpu.serving import EngineRouter
                 engines = [ServingEngine(generator, self.serving,
-                                         devices=sl)
+                                         devices=sl,
+                                         weight_version=weight_version)
                            for sl in slices]
                 self.engine = EngineRouter(
                     engines,
@@ -201,9 +202,56 @@ class MegatronServer:
                     self.serving.router_heartbeat_timeout_s)
             else:
                 self.engine = ServingEngine(generator, self.serving,
-                                            devices=slices[0])
+                                            devices=slices[0],
+                                            weight_version=weight_version)
+        # live-weight serving (docs/serving.md "Live weights & rolling
+        # upgrade"): watch the training tracker and drive the engine /
+        # fleet to every newly published checkpoint — the
+        # zero-operator-action half of the training->serving loop
+        self._watcher = None
+        if self.engine is not None and \
+                getattr(self.serving, "watch_checkpoints", None):
+            from megatron_tpu.serving.weights import CheckpointWatcher
+            initial_tag = None
+            if weight_version is not None:
+                # staged at boot from this very root: the CURRENT
+                # tracker tag (whatever its spelling — "release"
+                # included) is what the fleet already serves; seeding
+                # with it stops the first poll from redundantly
+                # re-swapping the boot checkpoint through a full
+                # drain->swap->canary walk
+                try:
+                    import os as _os
+
+                    from megatron_tpu.serving.weights import \
+                        manifest_digest
+                    from megatron_tpu.training.checkpointing import \
+                        read_tracker
+                    tag = read_tracker(self.serving.watch_checkpoints)
+                    # only when the tracker still names what we STAGED
+                    # — a publish that landed between staging and here
+                    # must NOT be skipped. Iteration tags compare by
+                    # number; a "release" tag compares by manifest
+                    # digest (the iteration alone can't distinguish
+                    # "we staged the release dir" from "release
+                    # published after we staged iter_N").
+                    if tag == str(weight_version.iteration):
+                        initial_tag = tag
+                    elif tag == "release" and manifest_digest(
+                            _os.path.join(
+                                self.serving.watch_checkpoints,
+                                "release")) == weight_version.digest:
+                        initial_tag = tag
+                except Exception:  # noqa: BLE001 — racing a publish
+                    initial_tag = str(weight_version.iteration)
+            self._watcher = CheckpointWatcher(
+                self.engine, self.serving.watch_checkpoints,
+                interval_s=self.serving.watch_interval_s,
+                initial_tag=initial_tag).start()
 
     def close(self):
+        if self._watcher is not None:
+            self._watcher.close()
         if self.engine is not None:
             self.engine.close()
 
@@ -280,9 +328,16 @@ class MegatronServer:
             if err is not None:
                 return 400, {"message": err}
             if payload.get("beam_width"):
+                err = self._stale_fallback_error("beam search")
+                if err is not None:
+                    return 409, {"message": err}
                 return 200, self._handle_beam(payload)
             if self.engine is not None and not payload.get("serial"):
                 return 200, self._handle_engine(payload)
+            if self.engine is not None:
+                err = self._stale_fallback_error("the serial route")
+                if err is not None:
+                    return 409, {"message": err}
             if payload.get("adapter_id") is not None:
                 # the serial path threads no adapter bank — silently
                 # decoding the BASE model would be wrong output, not a
@@ -318,6 +373,31 @@ class MegatronServer:
             return 400, {"message": str(e)}
         except Exception as e:  # noqa: BLE001 — 500 with message, both paths
             return 500, {"message": str(e)}
+
+    def _stale_fallback_error(self, what: str) -> Optional[str]:
+        """The serial/beam fallback routes forward through the
+        Generator's ORIGINAL params, which a live-weight hot swap
+        deliberately never touches (sibling replicas share one
+        Generator). Once any engine replica has swapped, those routes
+        would silently serve the OLD weights under a fleet reporting
+        the new version — a correctness lie, so they answer 409 typed
+        instead. Serial-only servers (engine=None) never swap and are
+        unaffected."""
+        if self.engine is None:
+            return None
+        try:
+            snap = (self.engine.aggregate_snapshot()
+                    if hasattr(self.engine, "aggregate_snapshot")
+                    else self.engine.metrics.snapshot())
+            swapped = snap.get("weight_swaps", 0) > 0
+        except Exception:  # noqa: BLE001 — can't tell: let it through
+            swapped = False
+        if not swapped:
+            return None
+        return (f"{what} is unavailable after a live-weight hot swap: "
+                "it forwards through the server's original startup "
+                "weights, not the engine's current version — restart "
+                "the server on the new checkpoint to use it")
 
     def _backoff_body(self, message: str,
                       retry_after: Optional[int] = None,
@@ -548,6 +628,16 @@ class MegatronServer:
         lines.append("data: " + json.dumps(data))
         return "\n".join(lines) + "\n\n"
 
+    def _req_weight_version(self, req) -> str:
+        """Weight-version label of the replica serving `req` right now:
+        router-backed requests read their CURRENT attempt's replica (a
+        failed-over stream reports the survivor's version), bare-engine
+        requests read the engine."""
+        rep = getattr(req, "replica", None)
+        eng = rep.engine if rep is not None else self.engine
+        v = getattr(eng, "weight_version", None)
+        return v.label if v is not None else "unversioned"
+
     def _count_metric(self, name: str):
         m = getattr(self.engine, "metrics", None)
         if m is not None:
@@ -659,7 +749,13 @@ class MegatronServer:
         import time as _time
         req = entry.req
         yield self._sse({"stream_id": entry.sid, "resumed": resumed,
-                         "next_index": max(start, 0)}, event="start")
+                         "next_index": max(start, 0),
+                         # the weight version of the replica actually
+                         # serving this stream — every start frame, so
+                         # a mixed-version fleet (mid-rolling-upgrade)
+                         # is observable per stream, resumes included
+                         "weight_version": self._req_weight_version(req)},
+                        event="start")
         i = max(start, 0)
         # same overall budget the non-streaming path enforces via
         # result(timeout): a stuck request must end in a terminal

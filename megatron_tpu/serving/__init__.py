@@ -7,7 +7,11 @@ from megatron_tpu.serving.engine import (  # noqa: F401
     EngineHungError, ServingEngine)
 from megatron_tpu.serving.host_tier import HostKVTier  # noqa: F401
 from megatron_tpu.serving.router import (  # noqa: F401
-    EngineRouter, NoReplicaAvailableError, RouterRequest)
+    EngineRouter, NoReplicaAvailableError, RollingUpgradeError,
+    RouterRequest)
+from megatron_tpu.serving.weights import (  # noqa: F401
+    CheckpointWatcher, StagedWeights, WeightSwapError, WeightVersion,
+    host_params, load_staged)
 from megatron_tpu.serving.kv_pool import (  # noqa: F401
     BlockKV, RetainedPrefix, SlotKVPool, clone_prefix, insert_blocks,
     insert_prefill, resolve_view, scatter_view, slice_blocks, slice_slot)

@@ -349,6 +349,28 @@ class AdapterBank:
             self._ids[idx] = ("stale", adapter_id,
                               next(self._gen_counter))
 
+    def bump_generations(self) -> int:
+        """Weight hot-swap compatibility sweep (serving/engine.py
+        `_apply_swap`): every registered adapter was trained against
+        the OLD base weights, so (a) its registration generation bumps
+        — the engine's prefix-cache namespaces change, and a preempted
+        or requeued stream pinned to the old (id, generation) fails
+        TYPED at re-acquire instead of silently resuming an N-era
+        adapter against N+1 base weights — and (b) its device row is
+        unmapped and its host-RAM overflow copy dropped, exactly like a
+        re-registration. Sources stay registered: the NEXT acquire
+        reloads from source under the new generation, so serving the
+        adapter against the new base is an explicit fresh start (and an
+        operator re-registration with retrained factors re-admits the
+        same way). Returns the number of adapters bumped."""
+        with self._lock:
+            ids = list(self._sources)
+            for adapter_id in ids:
+                self._gen[adapter_id] = next(self._gen_counter)
+                self._invalidate_resident(adapter_id)
+                self._host_drop(adapter_id)
+            return len(ids)
+
     def namespace(self, adapter_id):
         """The prefix-cache namespace for `adapter_id`'s CURRENT
         registration — (id, generation), or None when unregistered.

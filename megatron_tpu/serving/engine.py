@@ -82,6 +82,17 @@ TPU-native:
   buffers are dropped (engine restart, park budget), the victim
   replays its effective prompt through prefill instead — still
   token-exact, the host-side PRNG copy survives.
+- Live-weight hot swap (docs/serving.md "Live weights & rolling
+  upgrade"): `swap_weights(ckpt_dir)` verifies checkpoint N+1 against
+  its SHA-256 manifest, stages it HOST-side (NumPy), holds new
+  admissions while in-flight work completes under N, then flips the
+  param refs under the compiled programs between two iterations —
+  identical shapes/shardings, zero recompiles, KV arena untouched.
+  Pre-swap admissions are byte-identical to an engine at N, post-swap
+  to a fresh engine at N+1; a corrupt/mid-publish checkpoint is a
+  typed refusal that leaves N serving. Prefix/host-tier state is
+  swept AND namespaced by a weight generation so N-era KV can never
+  serve under N+1.
 - Engine supervisor: the loop runs under a supervisor that restarts it
   after a crashed or hung step (resilience/watchdog.py in
   detection-only mode detects the hang and fails the in-flight futures
@@ -188,6 +199,25 @@ class _PendingPrefill:
         self.on_decode = False
 
 
+class _SwapTicket:
+    """One pending weight hot swap: the host-staged tree rides in from
+    the calling thread, the engine thread applies it at the swap point
+    (between two iterations, in-flight work drained), and the caller
+    waits on `done` for the verdict. `taken` flips (under the engine
+    cond) the moment the engine commits to applying, so a timing-out
+    caller can tell 'still waiting for the barrier — cancellable' from
+    'mid-apply — wait for the verdict'."""
+
+    __slots__ = ("staged", "done", "taken", "version", "error")
+
+    def __init__(self, staged):
+        self.staged = staged
+        self.done = threading.Event()
+        self.taken = False
+        self.version = None
+        self.error: Optional[BaseException] = None
+
+
 class _HostSrc:
     """Prefix-lookup source living in the host-RAM KV tier (not in a
     slot or retained entry): carries the tier key. `_start_pending`
@@ -215,7 +245,8 @@ class ServingEngine:
     def __init__(self, generator: Generator, serving=None,
                  metrics: Optional[ServingMetrics] = None,
                  writer=None, report_interval: int = 100,
-                 start: bool = True, drafter=None, devices=None):
+                 start: bool = True, drafter=None, devices=None,
+                 weight_version=None):
         from megatron_tpu.config import ServingConfig
         self.gen = generator
         cfg = generator.cfg
@@ -273,7 +304,17 @@ class ServingEngine:
                                        self._psh_pre, fn, n_array_args,
                                        donate_argnums))
         else:
-            self._p_dec = self._p_pre = generator.params
+            src = generator.params
+            if any(isinstance(leaf, np.ndarray)
+                   for leaf in jax.tree.leaves(src)):
+                # HOST-STAGED source weights (serving/weights.py
+                # host_params / load_staged — the PR 13 residency fix
+                # on topology-free engines too): commit exactly ONE
+                # device copy for the compiled programs; the
+                # generator's host tree stays the staging buffer and
+                # never becomes device-resident.
+                src = jax.device_put(src)
+            self._p_dec = self._p_pre = src
             _jit_dec = _jit_pre = self.gen._jit
         self.pool = SlotKVPool(cfg, self.num_slots, self.max_len,
                                dtype=kv_dtype,
@@ -563,6 +604,20 @@ class ServingEngine:
         self._draining = False
         self._deadline_s = self.serving.request_deadline_s
         self._broken: Optional[str] = None
+        # live-weight serving (serving/weights.py; docs/serving.md
+        # "Live weights & rolling upgrade"): the version the compiled
+        # programs currently consume (None = unversioned startup
+        # weights), the prefix-namespace GENERATION that bumps at every
+        # applied swap (KV computed under version N becomes structurally
+        # invisible to post-swap lookups — the adapter-namespace
+        # pattern applied to base weights), and the pending-swap ticket
+        # the loop applies between iterations once in-flight work
+        # drains.
+        self.weight_version = weight_version
+        self._weight_gen = 0
+        self._pending_swap: Optional[_SwapTicket] = None
+        if weight_version is not None:
+            self.metrics.set_weight_version(weight_version.iteration)
         # supervisor state: restarts consumed, wedged-iteration flag
         # (set by the watchdog thread), and the detection-only watchdog
         # itself (armed lazily after the first completed step so the
@@ -681,6 +736,7 @@ class ServingEngine:
     def close(self):
         """Stop the loop; fail queued and in-flight requests. Safe on a
         never-started (start=False) engine."""
+        self._fail_pending_swap("engine closing")
         with self._cond:
             self._stop = True
             self._cond.notify_all()
@@ -743,6 +799,17 @@ class ServingEngine:
             "serving_tp": (self.topo.tp if self.topo is not None
                            else 1),
             "disaggregated": self._disagg,
+            # live-weight serving: the version the compiled programs
+            # consume right now ("unversioned" until a staged startup
+            # or first swap sets it) — the mixed-fleet observability
+            # signal (docs/serving.md "Live weights")
+            "weight_version": (self.weight_version.label
+                               if self.weight_version is not None
+                               else "unversioned"),
+            "weight_iteration": (self.weight_version.iteration
+                                 if self.weight_version is not None
+                                 else 0),
+            "weight_swap_pending": self._pending_swap is not None,
             "detail": broken or "",
         }
 
@@ -768,12 +835,13 @@ class ServingEngine:
                 return 0
         toks = list(tokens)
         try:
+            wns = self._ns(ns)  # current weight generation only
             src, hit = self._index.lookup(toks, len(toks) - 1,
-                                          namespace=ns)
+                                          namespace=wns)
             best = hit if src is not None else 0
             if self._host_tier is not None:
                 _, hhit = self._host_tier.lookup(toks, len(toks) - 1,
-                                                 namespace=ns)
+                                                 namespace=wns)
                 best = max(best, hhit)
             return int(best)
         except Exception:  # noqa: BLE001 — cross-thread peek
@@ -813,6 +881,7 @@ class ServingEngine:
         SIGTERM handler in inference/server.py calls this so a rolling
         restart never truncates a response mid-stream."""
         self._draining = True
+        self._fail_pending_swap("engine draining")
         backlog = self.scheduler.close()
         for req in backlog:
             req.fail("engine draining (shutdown in progress); retry "
@@ -835,6 +904,231 @@ class ServingEngine:
 
     def __exit__(self, *exc):
         self.close()
+
+    # ------------------------------------------------------------------
+    # live-weight hot swap (docs/serving.md "Live weights & rolling
+    # upgrade"; serving/weights.py)
+    # ------------------------------------------------------------------
+    def swap_weights(self, ckpt_dir: str,
+                     timeout: Optional[float] = None, staged=None):
+        """In-place weight hot swap on the RUNNING engine — zero
+        downtime, zero recompiles, token-safe.
+
+        Order of operations is the contract:
+        1. STAGE host-side on the calling thread: the checkpoint
+           verifies against its SHA-256 manifest and loads into NumPy
+           (serving/weights.py `load_staged`) BEFORE anything touches a
+           device. A corrupt/truncated/mid-publish checkpoint raises a
+           typed `WeightSwapError` here — the engine keeps serving the
+           current weights, `weight_swap_failures` counts it.
+        2. SWAP POINT on the engine thread: new admissions HOLD (queued
+           work waits, nothing is rejected), in-flight slots and
+           prefills run to completion under the CURRENT weights, then
+           between two iterations the staged tree device-puts through
+           `topology.place_params` onto the serving mesh(es) (both the
+           prefill and decode groups of a disaggregated engine, in one
+           host step) and the param refs under the compiled programs
+           flip. Shapes/shardings are identical, so the jit caches hit
+           — ZERO recompiles (test-pinned) — and the KV pool arena
+           survives untouched.
+        3. VERSION HYGIENE: the prefix index rebuilds, retained
+           prefixes and host-tier entries drop, the weight-generation
+           namespace bumps (a post-swap admission structurally cannot
+           clone KV computed under the old weights), queued requests
+           carrying mid-stream resume state fail typed/retryable, and
+           every registered adapter's generation bumps
+           (serving/adapters.py `bump_generations`).
+
+        The result: requests admitted BEFORE the swap are pure version
+        N (byte-identical to a never-swapped engine), requests admitted
+        AFTER are pure N+1 (byte-identical to a fresh engine at N+1).
+
+        Returns the new `WeightVersion`. Raises `WeightSwapError`
+        (typed refusal — current weights keep serving) on a manifest/
+        staging/placement failure or when the in-flight drain exceeds
+        `timeout` (default `ServingConfig.swap_timeout_s`). `staged`
+        (a `StagedWeights`) skips the verify+load step — the rolling
+        upgrade stages ONCE at the router and hands every replica the
+        same host buffer instead of paying N disk reads + deep
+        verifications per rollout."""
+        from megatron_tpu.serving.weights import (WeightSwapError,
+                                                  load_staged)
+        old = (self.weight_version.label
+               if self.weight_version is not None else "unversioned")
+        if self._broken:
+            raise WeightSwapError(
+                f"engine unhealthy (circuit breaker open): {self._broken}"
+                " — nothing to swap onto")
+        if staged is None:
+            try:
+                staged = load_staged(ckpt_dir, self.gen.params)
+            except WeightSwapError:
+                self.metrics.count("weight_swap_failures")
+                raise
+        ticket = _SwapTicket(staged)
+        with self._cond:
+            if self._stop or self._draining:
+                self.metrics.count("weight_swap_failures")
+                raise WeightSwapError(
+                    "engine stopping/draining; a shutting-down replica "
+                    "does not swap")
+            if self._pending_swap is not None:
+                self.metrics.count("weight_swap_failures")
+                raise WeightSwapError(
+                    "a weight swap is already in progress on this "
+                    "engine")
+            self._pending_swap = ticket
+            self._cond.notify_all()
+        budget = (timeout if timeout is not None
+                  else float(getattr(self.serving, "swap_timeout_s",
+                                     120.0) or 120.0))
+        if not ticket.done.wait(budget):
+            with self._cond:
+                if self._pending_swap is ticket and not ticket.taken:
+                    # still waiting at the barrier: cancel — the engine
+                    # resumes admissions, nothing changed
+                    self._pending_swap = None
+                    self.metrics.count("weight_swap_failures")
+                    raise WeightSwapError(
+                        f"weight swap timed out after {budget:.1f}s "
+                        "waiting for in-flight work to drain; the "
+                        f"engine keeps serving {old}")
+            # the engine committed to applying (device_put in flight,
+            # bounded work — but a big tree over a slow link can take
+            # a while): wait the full budget again for the verdict
+            if not ticket.done.wait(max(budget, 60.0)):
+                # the placement is STILL in flight: its verdict is
+                # genuinely unknown — the swap may yet land. Do not
+                # claim failure (and do not count one): the apply path
+                # counts weight_swaps/sets the gauge itself if it
+                # completes; the caller re-checks health().
+                raise WeightSwapError(
+                    f"weight swap verdict still pending after "
+                    f"{budget + max(budget, 60.0):.0f}s (device "
+                    "placement in flight); it may still complete — "
+                    "check health()['weight_version'] before retrying")
+        if ticket.error is not None:
+            self.metrics.count("weight_swap_failures")
+            raise WeightSwapError(
+                f"weight swap failed during device placement "
+                f"({ticket.error!r}); the engine keeps serving {old}"
+            ) from ticket.error
+        if ticket.version is None:
+            # breaker tripped / engine closed mid-swap
+            self.metrics.count("weight_swap_failures")
+            raise WeightSwapError(
+                f"weight swap aborted (engine went down mid-swap); "
+                f"last known version {old}")
+        return ticket.version
+
+    def _apply_swap(self, ticket: _SwapTicket):
+        """Engine thread, at the swap point (no active slots, no
+        pending prefills): place the staged tree and flip the param
+        refs. The placement either succeeds wholly or raises BEFORE any
+        ref flips — a device error leaves the engine on the old weights
+        (the rollback is that nothing moved)."""
+        staged = ticket.staged
+        try:
+            if self.topo is not None:
+                p_dec, _ = self.topo.place_params(
+                    staged.params, self.cfg, self.topo.decode_mesh)
+                if self._disagg:
+                    p_pre, _ = self.topo.place_params(
+                        staged.params, self.cfg, self.topo.prefill_mesh)
+                else:
+                    p_pre = p_dec
+            else:
+                p_dec = p_pre = jax.device_put(staged.params)
+            # surface device/placement errors HERE, not inside some
+            # later compiled dispatch where the supervisor would treat
+            # them as an engine crash
+            jax.block_until_ready(p_dec)
+            if p_pre is not p_dec:
+                jax.block_until_ready(p_pre)
+        except Exception as e:  # noqa: BLE001 — typed refusal upstream
+            ticket.error = e
+            ticket.done.set()
+            return
+        # THE SWAP POINT: both chip groups' param refs flip in one host
+        # step — atomic per replica (the disagg chaos drill pins it).
+        # Shapes/shardings/avals are identical, so every compiled
+        # program cache-hits: zero recompiles.
+        self._p_dec, self._p_pre = p_dec, p_pre
+        self.weight_version = staged.version
+        try:
+            self._swap_hygiene(staged)
+        except Exception:
+            # the refs ALREADY flipped — the engine IS on the new
+            # weights — so resolve the ticket as a landed swap, then
+            # re-raise: the supervisor's restart rebuilds the pool /
+            # index / parked state from scratch, a SUPERSET of the
+            # hygiene this block failed to finish (no N-era KV
+            # survives a session restart). Never leave the caller
+            # hanging on an unresolved ticket.
+            self.metrics.count("weight_swaps")
+            self.metrics.set_weight_version(staged.version.iteration)
+            ticket.version = staged.version
+            ticket.done.set()
+            raise
+        self.metrics.count("weight_swaps")
+        self.metrics.set_weight_version(staged.version.iteration)
+        ticket.version = staged.version
+        print_rank_0(
+            f"serving engine: weights hot-swapped to "
+            f"{staged.version.label} between iterations (zero "
+            "recompiles)")
+        ticket.done.set()
+
+    def _swap_hygiene(self, staged):
+        """Post-flip version hygiene (acceptance: a post-swap admission
+        can never clone N-era KV under N+1 weights)."""
+        self._weight_gen += 1
+        self._index = PrefixIndex(
+            self.pool.block_size if self._blocks_on
+            else max(self.serving.prefill_bucket, 1))
+        self.pool.on_reclaim = self._index.remove  # rebind to NEW index
+        dropped = self.pool.drop_retained()
+        tier_dropped = 0
+        if self._host_tier is not None:
+            tier_dropped = self._host_tier.clear()
+        # no active slots at the barrier: every row re-parks at 0 (the
+        # retained park-at-final-length rows just died with their
+        # entries)
+        self._lengths[:] = 0
+        self._reject[:] = -1
+        self._lengths_dirty = True
+        self._kv_dirty = True
+        # queued requests carrying MID-STREAM resume state committed
+        # tokens under the old weights; resuming/replaying them under
+        # the new ones would mix versions inside one stream — fail them
+        # typed + retryable (the router resubmits token-exact on a
+        # replica still serving the old version)
+        for req in self.scheduler.drop_resumed():
+            if req.fail(
+                    "weights hot-swapped while this preempted request "
+                    "was queued: its committed tokens were generated "
+                    f"under the previous version and cannot continue "
+                    f"under {staged.version.label} — resubmit",
+                    kind="unavailable"):
+                self.metrics.count("requests_cancelled")
+        # adapters were trained against the OLD base: bump every
+        # registration generation (rows unmap, host copies drop, prefix
+        # namespaces change; mid-flight pinned streams fail typed at
+        # re-acquire — serving/adapters.py)
+        if self.adapters is not None:
+            self.adapters.bump_generations()
+        print_rank_0(
+            f"serving engine: version hygiene swept {dropped} retained "
+            f"prefix(es) and {tier_dropped} host-tier entr(ies) for "
+            f"{staged.version.label}")
+
+    def _fail_pending_swap(self, msg: str):
+        """Resolve a pending (never-applied) swap ticket when the
+        engine goes down — its caller must not hang on the event."""
+        with self._cond:
+            ticket, self._pending_swap = self._pending_swap, None
+        if ticket is not None and not ticket.done.is_set():
+            ticket.done.set()  # version stays None -> typed abort
 
     # ------------------------------------------------------------------
     # device programs
@@ -1310,6 +1604,7 @@ class ServingEngine:
             with self._cond:
                 while (not self._stop and not self._draining
                        and not self._wedged
+                       and self._pending_swap is None
                        and self.scheduler.depth() == 0
                        and not self._active.any()
                        and not self._prefilling):
@@ -1331,8 +1626,27 @@ class ServingEngine:
             self._maybe_decay_restarts()
             self._reap_cancelled()
             self._reap_expired()
-            self._preempt_for_priority()
-            self._admit()
+            if self._pending_swap is not None:
+                # SWAP BARRIER (docs/serving.md "Live weights"): hold
+                # NEW admissions — queued work simply WAITS, nothing is
+                # rejected — while in-flight slots and pending prefills
+                # run to completion under the CURRENT weights. Once the
+                # grid is quiet the swap applies between iterations:
+                # pre-swap admissions are pure version N, post-swap
+                # admissions pure N+1 (the token-exactness pin).
+                if not self._active.any() and not self._prefilling:
+                    with self._cond:
+                        ticket = self._pending_swap
+                        if ticket is not None:
+                            ticket.taken = True
+                            self._pending_swap = None
+                    if ticket is not None:
+                        self._apply_swap(ticket)
+                    self._heartbeat()
+                    continue
+            else:
+                self._preempt_for_priority()
+                self._admit()
             # ONE chunk per iteration (Sarathi-Serve): prefill work
             # is interleaved with the decode step below, so running
             # slots keep emitting tokens while a long prompt lands
@@ -1403,6 +1717,7 @@ class ServingEngine:
         self._broken = (f"circuit breaker open after "
                         f"{self._restarts} restart(s): {msg}")
         print_rank_0(f"serving engine: {self._broken}")
+        self._fail_pending_swap(self._broken)
         for req in self._slot_req:
             if req is not None:
                 req.fail(self._broken)
@@ -1691,6 +2006,15 @@ class ServingEngine:
             self.adapters.release(int(req.bank_idx))
             req.bank_idx = 0
 
+    def _ns(self, adapter_ns):
+        """Prefix/host-tier namespace: (weight generation, adapter
+        namespace). The weight generation bumps at every applied hot
+        swap, so KV computed under version N is STRUCTURALLY invisible
+        to any post-swap lookup — the PR 12 adapter-namespace pattern
+        applied to the base weights (belt on top of the swap's eager
+        index/tier sweep)."""
+        return (self._weight_gen, adapter_ns)
+
     def _lookup_prefix(self, toks, namespace=None):
         """Longest reusable cached prefix of `toks` COMPUTED UNDER
         `namespace` (the request's adapter id; None = base) and its
@@ -1711,6 +2035,7 @@ class ServingEngine:
         wrapping over the very prefix the index would advertise."""
         if not self._prefix_on:
             return None, 0
+        namespace = self._ns(namespace)  # weight-generation isolation
         toks = list(toks)
         src, hit = self._index.lookup(toks, len(toks) - 1,
                                       namespace=namespace)
@@ -2144,7 +2469,7 @@ class ServingEngine:
             # keeps wrapping over the very prefix the index would
             # advertise.
             self._index.insert(slot, st.tokens,
-                               namespace=req.adapter_ns)
+                               namespace=self._ns(req.adapter_ns))
 
     def _drop_pending(self, st: _PendingPrefill, msg: str,
                       kind: str = "error"):
@@ -2237,7 +2562,7 @@ class ServingEngine:
                 # rolling slots index only at retain time (see
                 # _activate_pending)
                 self._index.insert(slot, req.prompt,
-                                   namespace=req.adapter_ns)
+                                   namespace=self._ns(req.adapter_ns))
 
     def _reap_cancelled(self):
         for slot in np.nonzero(self._active)[0]:
@@ -2311,12 +2636,12 @@ class ServingEngine:
             # can retain at all.
             final = int(self._lengths[slot])
             tokens = req.prompt + req.generated
+            ns = self._ns(req.adapter_ns)
             self._index.remove(slot)
             rkey = self.pool.retain_row(slot, final, tokens,
-                                        namespace=req.adapter_ns)
+                                        namespace=ns)
             if rkey is not None:
-                self._index.insert(rkey, tokens,
-                                   namespace=req.adapter_ns)
+                self._index.insert(rkey, tokens, namespace=ns)
             self._lengths[slot] = 0
         elif failed is None and self._prefix_on:
             # prefix cache: RETAIN the finished slot's KV for reuse
@@ -2337,7 +2662,7 @@ class ServingEngine:
             # inserting after would resurrect a stale entry over a
             # free-listed slot, and free-list alloc() never reclaims.
             self._index.insert(slot, req.prompt + req.generated,
-                               namespace=req.adapter_ns)
+                               namespace=self._ns(req.adapter_ns))
             self.pool.retain(slot)
         else:
             self._lengths[slot] = 0  # inactive rows park at position 0

@@ -128,6 +128,18 @@ class HostKVTier:
             if self._by_seq.get(seq) == key:
                 del self._by_seq[seq]
 
+    def clear(self) -> int:
+        """Drop every entry (the weight hot-swap's version-hygiene
+        sweep): demoted KV was computed under the old weights, and a
+        restore under the new ones would be silently wrong output, not
+        a cache win. Returns the count dropped."""
+        n = len(self._entries)
+        self._entries.clear()
+        self._by_seq.clear()
+        self._index = PrefixIndex(self._index.granularity)
+        self.bytes_used = 0
+        return n
+
     # ---- lookup / restore --------------------------------------------
     def lookup(self, tokens: Sequence[int],
                max_tokens: Optional[int] = None,

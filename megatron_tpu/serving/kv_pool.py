@@ -703,6 +703,31 @@ class SlotKVPool:
         if key in self._retained:
             self._retained.move_to_end(key)
 
+    def drop_retained(self) -> int:
+        """Reclaim EVERY retained entry/slot in one pass — the weight
+        hot-swap's version-hygiene sweep (serving/engine.py
+        `_apply_swap`): KV decoded under the old weights must not stay
+        cloneable once the new weights serve, so retained prefixes die
+        here rather than lingering unreachable until block pressure.
+        `on_evict_entry` (host-tier demotion) deliberately does NOT
+        fire — the caller is invalidating the old version everywhere,
+        host tier included — while `on_reclaim` fires per entry so the
+        (already rebuilt) index stays consistent. Returns the count."""
+        n = len(self._retained)
+        if self.blocks_enabled:
+            hook, self.on_evict_entry = self.on_evict_entry, None
+            try:
+                while self._retained:
+                    self._evict_retained()
+            finally:
+                self.on_evict_entry = hook
+        else:
+            while self._retained:
+                slot, _ = self._retained.popitem(last=False)
+                self._reclaim(slot)
+                self._free.append(slot)
+        return n
+
     # ---- capacity / introspection ------------------------------------
     def free_count(self) -> int:
         """Allocatable slots. Whole-region mode: truly free + lazily

@@ -87,6 +87,14 @@ _BASE_COUNTERS = (
     # decode-group block transfers (one per admission on a
     # disaggregated engine; 0 on single-group engines)
     "handoffs",
+    # live-weight serving (docs/serving.md "Live weights & rolling
+    # upgrade"): weight_swaps = in-place hot swaps applied on a running
+    # engine (zero recompiles, token-safe swap point),
+    # weight_swap_failures = checkpoints refused at the manifest gate
+    # or failed during staging/placement (the engine kept serving the
+    # old weights each time), rolling_upgrades = completed fleet
+    # rollouts through the router's drain->swap->canary walk
+    "weight_swaps", "weight_swap_failures", "rolling_upgrades",
 )
 
 
@@ -149,6 +157,12 @@ class ServingMetrics:
         self.handoff_bytes_per_req = 0
         self.prefill_group_busy = 0.0
         self.decode_group_busy = 0.0
+        # live-weight serving: the checkpoint ITERATION currently on
+        # the serving mesh (0 = unversioned startup weights). Always
+        # present; the router's aggregate carries it as per-replica
+        # min/max so a mixed-version fleet mid-rollout is visible on
+        # one scrape.
+        self.weight_version = 0.0
 
     # ---- recording ---------------------------------------------------
     def count(self, name: str, n: int = 1):
@@ -199,6 +213,12 @@ class ServingMetrics:
         with self._lock:
             self.prefill_group_busy = float(prefill_busy)
             self.decode_group_busy = float(decode_busy)
+
+    def set_weight_version(self, iteration) -> None:
+        """Engine-pushed at startup staging and every applied hot swap:
+        the checkpoint iteration the compiled programs now consume."""
+        with self._lock:
+            self.weight_version = float(iteration)
 
     def set_attn_gauges(self, gather_bytes_per_step: int, path: int):
         """Engine-pushed attention-path gauges (per sync window):
@@ -259,7 +279,8 @@ class ServingMetrics:
                       "prefill_group_busy":
                           float(self.prefill_group_busy),
                       "decode_group_busy":
-                          float(self.decode_group_busy)}
+                          float(self.decode_group_busy),
+                      "weight_version": float(self.weight_version)}
         out = {k: 0.0 for k in _BASE_COUNTERS}
         out.update({k: float(v) for k, v in counters.items()})
         out.update(gauges)

@@ -72,9 +72,19 @@ class NoReplicaAvailableError(ServiceUnavailableError):
     (the router-level analogue of the breaker's EngineUnhealthyError)."""
 
 
+class RollingUpgradeError(RuntimeError):
+    """A rolling fleet upgrade aborted partway: the failing replica
+    stayed on (or rolled back to) its previous weights and re-enters
+    rotation through the normal half-open canary — the FLEET KEEPS
+    SERVING throughout (replicas already upgraded stay on the new
+    version; the rest stay on the old one, which the weight_version
+    min/max gauges make visible)."""
+
+
 class _Replica:
     __slots__ = ("idx", "engine", "state", "last_health",
-                 "last_healthy_t", "down_until", "canary", "canary_t")
+                 "last_healthy_t", "down_until", "canary", "canary_t",
+                 "upgrading")
 
     def __init__(self, idx: int, engine):
         self.idx = idx
@@ -85,6 +95,10 @@ class _Replica:
         self.down_until = 0.0
         self.canary = None  # RouterRequest probing this replica
         self.canary_t = 0.0
+        # planned drain (rolling_upgrade): held DOWN — out of rotation,
+        # queued/in-flight work fails over through the normal retry
+        # path — until the swap verdict re-admits or re-ejects it
+        self.upgrading = False
 
 
 class RouterRequest:
@@ -337,6 +351,14 @@ class EngineRouter:
             return rep.state
 
     def _refresh_one(self, rep: _Replica, now: float):
+        if rep.upgrading:
+            # planned drain (rolling_upgrade): the replica is healthy
+            # but held out of rotation like a DOWN one — its work fails
+            # over through the SAME retry path — and no canary runs
+            # until the swap verdict decides re-admission
+            rep.state = DOWN
+            rep.canary = None
+            return
         verdict = self._eval_replica(rep, now)
         if verdict == DOWN:
             if rep.state != DOWN:
@@ -560,6 +582,153 @@ class EngineRouter:
                                         factors=factors, rank=rank,
                                         alpha=alpha)
 
+    # ------------------------------------------------------------------
+    # rolling fleet upgrade (docs/serving.md "Live weights & rolling
+    # upgrade"; serving/weights.py)
+    # ------------------------------------------------------------------
+    def rolling_upgrade(self, ckpt_dir: str,
+                        swap_timeout_s: Optional[float] = None,
+                        canary_timeout_s: float = 60.0):
+        """Zero-downtime fleet upgrade to `ckpt_dir`, one replica at a
+        time through drain → swap → canary → re-admit, reusing the
+        UP→DOWN→PROBING machinery:
+
+        - DRAIN: the replica is held DOWN (`upgrading`) — new traffic
+          routes to survivors, and its queued/in-flight work fails over
+          through the PR 10 retry path, resubmitted token-exact to
+          replicas still serving the OLD version (same prompt/seed →
+          identical stream). Work already decoding may simply finish on
+          the draining replica instead — either way every completion is
+          token-exact at its admitted version, and nothing 503s while
+          at least one survivor stands.
+        - SWAP: `engine.swap_weights` — manifest gate, host staging,
+          recompile-free flip between iterations. A refusal (corrupt
+          checkpoint, device error) leaves the replica ON ITS OLD
+          WEIGHTS; it re-enters rotation via the normal half-open
+          canary and the rollout ABORTS with the fleet still serving
+          (`RollingUpgradeError`).
+        - CANARY: the router itself drives one probe request through
+          the upgraded replica — it must COMPLETE under the new weights
+          (and the replica must still report accepting) before
+          re-admission, so an idle fleet still upgrades and a broken
+          swap never takes live traffic.
+        - RE-ADMIT: promotion back to UP; the walk moves to the next
+          replica only after the canary passes, so at most ONE replica
+          is ever out of rotation.
+
+        Returns the new `WeightVersion`. Counts `rolling_upgrades` on a
+        completed rollout; a staging refusal counts
+        `weight_swap_failures` once on the router, per-replica apply
+        failures on the replica that refused."""
+        from megatron_tpu.serving.weights import (WeightSwapError,
+                                                  load_staged)
+        # stage ONCE, before anything drains: every replica serves the
+        # SAME model, so one host buffer feeds the whole rollout — a
+        # corrupt publish is refused here with zero availability cost
+        # (no replica left rotation), and an N-replica fleet pays one
+        # disk read + deep verification instead of N
+        example = None
+        for rep in self.replicas:
+            try:
+                example = rep.engine.gen.params
+                break
+            except Exception:  # noqa: BLE001 — a dead replica
+                continue
+        try:
+            staged = load_staged(ckpt_dir, example)
+        except WeightSwapError as e:
+            self.metrics.count("weight_swap_failures")
+            raise RollingUpgradeError(
+                f"rolling upgrade refused before any replica drained: "
+                f"{e} — the fleet keeps serving") from e
+        version = None
+        for rep in self.replicas:
+            # a replica that is ALREADY hard-down (breaker open, loop
+            # dead) has nothing serving to drain and nothing to swap
+            # onto — skipping it lets the healthy rest of the fleet
+            # take the new weights instead of one dead replica
+            # blocking every rollout; it re-stages when it comes back
+            # (a restarted/replaced replica boots host-first from the
+            # current publish)
+            try:
+                h = rep.engine.health()
+            except Exception:  # noqa: BLE001 — unreachable == down
+                h = None
+            if h is None or h.get("circuit_breaker_open") \
+                    or not h.get("loop_alive", False):
+                print_rank_0(
+                    f"router: rolling upgrade — skipping replica "
+                    f"{rep.idx} (already down: "
+                    f"{(h or {}).get('detail', 'unreachable')}); it "
+                    "re-stages from the current publish when it "
+                    "returns")
+                continue
+            with self._lock:
+                rep.upgrading = True
+                rep.state = DOWN
+                rep.canary = None
+            print_rank_0(f"router: rolling upgrade — replica {rep.idx} "
+                         "draining (traffic fails over to survivors)")
+            try:
+                version = rep.engine.swap_weights(
+                    ckpt_dir, timeout=swap_timeout_s, staged=staged)
+            except Exception as e:
+                # the failed swap left the replica on its OLD weights
+                # (the manifest gate / placement failure flipped
+                # nothing): re-admit via the normal half-open canary,
+                # abort the rollout, fleet keeps serving
+                with self._lock:
+                    rep.upgrading = False
+                    rep.state = DOWN
+                    rep.down_until = time.monotonic()
+                raise RollingUpgradeError(
+                    f"rolling upgrade aborted at replica {rep.idx}: "
+                    f"{e} — the fleet keeps serving (already-upgraded "
+                    "replicas stay on the new version; this and later "
+                    "replicas stay on the old one)") from e
+            ok = self._canary_probe(rep, timeout=canary_timeout_s)
+            with self._lock:
+                rep.upgrading = False
+                if ok:
+                    rep.state = UP
+                    rep.last_healthy_t = time.monotonic()
+                else:
+                    rep.state = DOWN
+                    rep.down_until = (time.monotonic()
+                                      + self.probe_backoff_s)
+            if not ok:
+                raise RollingUpgradeError(
+                    f"rolling upgrade aborted: replica {rep.idx} "
+                    f"failed its post-swap canary under "
+                    f"{version.label}; it stays ejected (half-open "
+                    "re-admission applies) and the fleet keeps serving")
+            print_rank_0(f"router: replica {rep.idx} upgraded to "
+                         f"{version.label} and re-admitted (canary "
+                         "passed)")
+        if version is None:
+            # every replica was skipped as already-down: nothing
+            # swapped, so this is not a completed rollout
+            raise RollingUpgradeError(
+                "rolling upgrade applied to no replica (every replica "
+                "is already down); the fleet has nothing serving to "
+                "upgrade")
+        self.metrics.count("rolling_upgrades")
+        return version
+
+    def _canary_probe(self, rep: _Replica, timeout: float = 60.0) -> bool:
+        """One router-driven canary on a just-swapped replica: a tiny
+        greedy request submitted DIRECTLY to the engine (bypassing
+        rotation — the replica is still held out) must complete under
+        the new weights, and the replica must still report accepting."""
+        try:
+            req = rep.engine.submit(
+                [1], 1, SamplingOptions(temperature=0.0), seed=0,
+                deadline_s=max(timeout, 1.0))
+            req.result(timeout=timeout)
+            return bool(rep.engine.health().get("accepting"))
+        except Exception:  # noqa: BLE001 — any failure fails the canary
+            return False
+
     def health(self) -> dict:
         """Router-level `/healthz` payload: `state` distinguishes
         DEGRADED (some replicas down, still serving — stays ready/200)
@@ -586,6 +755,10 @@ class EngineRouter:
                     "active_slots": int(h.get("active_slots", 0)),
                     "service_time_ewma_ms":
                         float(h.get("service_time_ewma_ms", 0.0)),
+                    # mixed-version visibility mid-rollout
+                    "weight_version": h.get("weight_version",
+                                            "unversioned"),
+                    "upgrading": rep.upgrading,
                 })
         return {
             "healthy": up > 0,
@@ -606,6 +779,7 @@ class EngineRouter:
         stream_reconnects) overlaid from the router's own registry,
         latency/rate keys reported as the worst replica (max)."""
         out = self.metrics.snapshot()
+        versions = []
         for rep in self.replicas:
             try:
                 snap = rep.engine.metrics.snapshot()
@@ -618,6 +792,15 @@ class EngineRouter:
                                                "slot_occupancy")
                                               + _MAX_GAUGES):
                     out[k] = max(out.get(k, 0.0), v)
+            versions.append(float(snap.get("weight_version", 0.0)))
+        # live-weight serving: the version gauge aggregates as
+        # per-replica MIN/MAX — a mid-rollout fleet shows min < max on
+        # one scrape (docs/serving.md "Live weights & rolling upgrade");
+        # the plain key reports the fleet FLOOR (what every replica is
+        # guaranteed to serve at least)
+        out["weight_version_min"] = min(versions) if versions else 0.0
+        out["weight_version_max"] = max(versions) if versions else 0.0
+        out["weight_version"] = out["weight_version_min"]
         out["num_replicas"] = float(len(self.replicas))
         return out
 

@@ -247,6 +247,29 @@ class AdmissionScheduler:
                     n += 1
         return n
 
+    def drop_resumed(self) -> List[GenRequest]:
+        """Remove (and return) queued requests carrying MID-STREAM
+        resume state — parked preemption KV, a saved rng chain, or
+        already-committed tokens. The weight-swap point calls this:
+        such a request's committed tokens were generated under the old
+        weights, and resuming (or replaying) it under the new ones
+        would silently mix versions inside one stream — the engine
+        fails them typed/retryable instead (the router's failover path
+        resubmits them token-exact on a replica still serving the old
+        version). Fresh queued requests are untouched: they simply
+        admit after the swap at the new version."""
+        with self._lock:
+            keep: List[GenRequest] = []
+            out: List[GenRequest] = []
+            for r in self._q:
+                if (r.parked is not None or r.resume_rng is not None
+                        or r.generated):
+                    out.append(r)
+                else:
+                    keep.append(r)
+            self._q = keep
+        return out
+
     @staticmethod
     def group_by_bucket(reqs: List[GenRequest], bucket_fn,
                         max_group: int) -> list:

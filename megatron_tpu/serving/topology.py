@@ -115,15 +115,17 @@ class ServingTopology:
         re-layout), and a disaggregated engine holds one resident copy
         per group.
 
-        Residency note: this is a COPY — the caller's source `params`
-        (the Generator's, usually on the default device) stay alive as
-        long as the caller references them, because sibling replicas,
-        the serial/beam server routes, and re-placement after a
-        restart all read them. A deployment tight on device 0's HBM
-        should load weights to HOST first (numpy/host-committed) so
-        the only device-resident copies are the sharded ones placed
-        here; deduplicating the source copy automatically is open
-        upside (ROADMAP)."""
+        `params` may be a HOST-STAGED tree (NumPy leaves —
+        serving/weights.py `host_params`/`load_staged`): `device_put`
+        shards straight from host memory, so the only device-resident
+        copies are the per-group shards placed here. That is the fix
+        for the old residency limit where device 0 paid full-model +
+        shard residency: load weights host-first (the staging path is
+        the construction path — startup and hot swap share it) and no
+        device-committed source copy ever exists. A device-resident
+        source still works (it is a copy; the source stays alive as
+        long as the caller references it — sibling replicas and the
+        serial/beam routes may) but costs the double residency."""
         sh = self.param_shardings(params, cfg, mesh)
         return jax.device_put(params, sh), sh
 

@@ -535,6 +535,76 @@ def _restore_from_dir(
                             ckpt_dir=d)
 
 
+def _unflatten_host(example, flat: dict[str, np.ndarray]):
+    """Rebuild a pytree shaped like `example` from flat path->array with
+    every leaf a HOST NumPy array (cast to the example dtype) — the
+    no-device-transfer sibling of `_unflatten_like`, for weight-swap
+    staging (serving/weights.py)."""
+    paths_and_leaves = jax.tree_util.tree_flatten_with_path(example)
+    treedef = jax.tree_util.tree_structure(example)
+    leaves = []
+    for path, ex in paths_and_leaves[0]:
+        key = "/".join(_path_str(p) for p in path)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing tensor {key!r}")
+        arr = flat[key]
+        if tuple(arr.shape) != tuple(ex.shape):
+            raise ValueError(
+                f"shape mismatch for {key}: ckpt {arr.shape} vs model "
+                f"{ex.shape}")
+        leaves.append(np.asarray(arr, dtype=ex.dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def load_params_host(ckpt_dir: str, example_params):
+    """Load ONLY the params tree from one checkpoint dir into HOST
+    memory: every returned leaf is a NumPy array, no device transfer
+    happens at any point, and the optimizer state is never read off
+    disk. This is the weight-swap staging path (serving/weights.py
+    `load_staged`) and the host-first serving startup path — the
+    serving engine device-puts the staged tree straight onto its
+    serving mesh(es), so device 0 never pays a full-model source copy
+    on top of the shards (the PR 13 residency fix).
+
+    Shapes are validated against `example_params` (which also supplies
+    the dtype each leaf casts to); a mismatch raises — swapping a
+    different model's checkpoint under a running engine must refuse,
+    not reshape."""
+    state_path = os.path.join(os.path.abspath(ckpt_dir), STATE_DIR)
+    if os.path.isdir(state_path):
+        # orbax sharded payload: restore each leaf as a plain
+        # np.ndarray (RestoreArgs(restore_type=...)) — TensorStore
+        # reads land in host RAM, nothing rides a device transfer
+        ocp = _orbax()
+        target = {"params": jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            example_params)}
+        restore_args = jax.tree.map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), target)
+        kw = dict(item=target, restore_args=restore_args)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            try:
+                args = ocp.args.PyTreeRestore(partial_restore=True, **kw)
+            except TypeError:  # orbax < 0.9: transforms={} contract
+                args = ocp.args.PyTreeRestore(transforms={}, **kw)
+            restored = ckptr.restore(state_path, args=args)
+        flat_ex = jax.tree.leaves(example_params)
+        flat_got = jax.tree.leaves(restored["params"])
+        leaves = []
+        for ex, got in zip(flat_ex, flat_got):
+            arr = np.asarray(got)
+            if tuple(arr.shape) != tuple(ex.shape):
+                raise ValueError(
+                    f"shape mismatch: ckpt {arr.shape} vs model "
+                    f"{tuple(ex.shape)}")
+            leaves.append(arr.astype(ex.dtype))
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(example_params), leaves)
+    # legacy .npz payload
+    flat = dict(np.load(os.path.join(ckpt_dir, "params.npz")))
+    return _unflatten_host(example_params, flat)
+
+
 def load_config_from_checkpoint(root: str) -> Optional[MegatronConfig]:
     """`use_checkpoint_args` (ref: checkpointing.py:476-558). Shares
     load_checkpoint's tolerance for a garbage tracker: falls back to

@@ -886,6 +886,54 @@ def test_chaos_router_smoke(tmp_path):
         assert d["survivor_handoffs"] >= 1
 
 
+@pytest.mark.slow
+def test_chaos_upgrade_smoke(tmp_path):
+    """tools/chaos_upgrade.py --smoke: rolling fleet upgrade chaos
+    (ISSUE 14 acceptance drill) — the draining replica killed mid-swap
+    leaves the fleet degraded-not-down with every completion
+    token-exact at its admitted version; a corrupt checkpoint publish
+    mid-watch is refused at the manifest gate with no retry loop and
+    the fleet stays on the good version; an upgrade racing the
+    disaggregated prefill->decode handoff lands on both chip groups
+    atomically (zero 503s, token-exact throughout)."""
+    import subprocess
+    import sys as _sys
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "chaos_upgrade.py")
+    out = str(tmp_path / "chaos_upgrade.json")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([_sys.executable, tool, "--smoke", "--out", out],
+                       capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    with open(out) as f:
+        record = json.load(f)
+    assert record["completed"] is True
+    # kill-the-draining-replica: typed abort, degraded-not-down, all
+    # completions token-exact at their admitted version
+    k = record["kill_draining"]
+    assert k["ok"], k
+    assert k["errors"] == 0 and k["version_mismatches"] == 0
+    assert k["rollout_aborted_typed"] is True
+    assert k["health_state"] == "degraded" and k["healthz_ready"]
+    # corrupt publish mid-watch: refused, counted, no restart loop,
+    # next publish applies
+    w = record["corrupt_watch"]
+    assert w["ok"], w
+    assert w["corrupt_publish_refused"] and w["no_retry_loop"]
+    assert w["fleet_stayed_on_v2"] and w["next_publish_applied"]
+    assert w["weight_swap_failures"] >= 1
+    # upgrade racing the disagg handoff: both groups swap atomically
+    # (the tool forces a 4-virtual-device platform, so this must RUN)
+    d = record["disagg_race"]
+    assert "skipped" not in d, d
+    assert d["ok"], d
+    assert d["errors"] == 0 and d["version_mismatches"] == 0
+    assert d["rolling_upgrades"] == 1
+
+
 # ---------------------------------------------------------------------------
 # bit-exact resume: checkpointable data-iterator state (ISSUE 4 tentpole)
 # ---------------------------------------------------------------------------

@@ -94,7 +94,36 @@ def main(argv=None):
                         "wide chip groups; the handoff moves only the "
                         "sequence's live KV blocks (needs "
                         "--kv_block_size)")
+    p.add_argument("--watch_checkpoints", action="store_true",
+                   help="live-weight serving: poll --load's tracker "
+                        "and hot-swap (or rolling-upgrade the replica "
+                        "fleet to) every newly published checkpoint — "
+                        "trainers drive the server with zero operator "
+                        "action (docs/serving.md 'Live weights & "
+                        "rolling upgrade')")
+    p.add_argument("--watch_interval_s", type=float, default=5.0,
+                   help="tracker poll cadence for --watch_checkpoints")
+    p.add_argument("--swap_timeout_s", type=float, default=120.0,
+                   help="live-weight swap barrier budget: how long a "
+                        "hot swap waits for in-flight work before it "
+                        "cancels (typed refusal, engine keeps serving)")
     args = p.parse_args(argv)
+    if args.watch_checkpoints and args.serial:
+        p.error("--watch_checkpoints requires the serving engine "
+                "(drop --serial): the serial path has nothing to "
+                "hot-swap")
+    if args.watch_checkpoints and args.int8_weights:
+        # the engine's swap stages the published FP params tree against
+        # gen.params — an int8-resident engine holds the quantized tree
+        # (different structure), so every publish would be refused and
+        # weight_swap_failures would climb forever. Fail the flag combo
+        # loudly instead of shipping a watcher that can never apply.
+        p.error("--watch_checkpoints is unsupported with "
+                "--int8_weights: hot swap stages the published fp "
+                "checkpoint against the engine's params tree, and the "
+                "int8-resident tree has a different structure — serve "
+                "fp weights (--int8_kv stays available) or drop the "
+                "watcher")
     if args.adapter_dir and (args.serial or args.adapter_slots <= 0):
         # fail loudly at the flag boundary: the serial path threads no
         # adapter bank, and without --adapter_slots there is no bank
@@ -110,21 +139,44 @@ def main(argv=None):
         params=jax.eval_shape(lambda: lm.model_init(jax.random.PRNGKey(0),
                                                     mcfg)),
         opt_state=None, iteration=0)
-    state, _, _ = ckpt.load_checkpoint(args.load, example, no_load_optim=True)
-    assert state is not None, f"failed to load checkpoint from {args.load}"
     tokenizer = build_tokenizer(
         args.tokenizer_type, vocab_file=args.vocab_file,
         merge_file=args.merge_file, tokenizer_model=args.tokenizer_model)
     import jax.numpy as jnp
 
-    params = state.params
-    if args.int8_weights:
-        from megatron_tpu.ops.quantized import quantize_weights
-        params = quantize_weights(params)
-        # drop the fp originals BEFORE serving: `state` would otherwise
-        # pin them in device memory for the server's whole lifetime,
-        # growing residency ~1.25x instead of shrinking it ~4x
-        state = None
+    staged_version = None
+    if args.serial or args.int8_weights:
+        # serial fallback needs device params anyway; the int8 path
+        # quantizes on device and drops the fp originals below
+        state, _, _ = ckpt.load_checkpoint(args.load, example,
+                                           no_load_optim=True)
+        assert state is not None, \
+            f"failed to load checkpoint from {args.load}"
+        params = state.params
+        if args.int8_weights:
+            from megatron_tpu.ops.quantized import quantize_weights
+            params = quantize_weights(params)
+            # drop the fp originals BEFORE serving: `state` would
+            # otherwise pin them in device memory for the server's
+            # whole lifetime, growing residency ~1.25x instead of
+            # shrinking it ~4x
+            state = None
+    else:
+        # HOST-FIRST staging (docs/serving.md "Live weights & rolling
+        # upgrade"): params stay NumPy and the engine's placement
+        # (sharded per group under --serving_tp/--disaggregate_prefill)
+        # is the ONLY device residency — device 0 never pays
+        # full-model + shard residency — and the served weight_version
+        # (iteration + manifest digest) is known from startup. This is
+        # the same mechanism hot swap uses.
+        from megatron_tpu.serving.weights import stage_latest
+        from megatron_tpu.utils.logging import print_rank_0
+        staged = stage_latest(args.load, example.params)
+        params = staged.params
+        staged_version = staged.version
+        print_rank_0(f"serving: staged weights host-side "
+                     f"(version {staged_version.label}); device "
+                     "residency = the engine's placement only")
     gen = Generator(params, mcfg, eos_id=tokenizer.eod,
                     kv_cache_dtype=jnp.int8 if args.int8_kv
                     else jnp.bfloat16)
@@ -152,9 +204,15 @@ def main(argv=None):
                             adapter_host_bytes=args.adapter_host_bytes,
                             serving_tp=args.serving_tp,
                             kv_block_size=args.kv_block_size,
-                            disaggregate_prefill=args.disaggregate_prefill
+                            disaggregate_prefill=args.disaggregate_prefill,
+                            swap_timeout_s=args.swap_timeout_s,
+                            watch_checkpoints=(args.load
+                                               if args.watch_checkpoints
+                                               else None),
+                            watch_interval_s=args.watch_interval_s
                             ).validate(mcfg)
-    server = MegatronServer(gen, tokenizer, serving=serving)
+    server = MegatronServer(gen, tokenizer, serving=serving,
+                            weight_version=staged_version)
     if args.adapter_dir:
         # pre-register every exported adapter: adapter_id = file stem,
         # validated eagerly (a corrupt export fails the server start,
