@@ -408,6 +408,14 @@ def _run_extras():
         # "Live weights & rolling upgrade")
         ("chaos_upgrade.py", ["--smoke"],
          "/tmp/bench_extras_chaos_upgrade.log"),
+        # seeded chaos-mesh conformance (docs/resilience.md "Chaos
+        # conformance"): sampled configs across the serving capability
+        # matrix (adapters / disaggregation / live-weight swap in the
+        # smoke set) under randomized fault schedules, every
+        # system-wide invariant checked — a failing seed's record IS
+        # its repro line
+        ("chaos_mesh.py", ["--smoke"],
+         "/tmp/bench_extras_chaos_mesh.log"),
         # corrupt-dataset detection smoke: inject truncated-.bin /
         # garbage-.idx / out-of-range-pointer faults, prove each raises
         # a typed DatasetCorruptionError at open (docs/resilience.md

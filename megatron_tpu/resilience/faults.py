@@ -26,7 +26,16 @@ fires them deterministically:
   crash, or NaN-poison one slot of the serving engine's step loop, so
   the engine supervisor (watchdog restart, crash-loop circuit breaker,
   per-slot non-finite guard — serving/engine.py) is provable through a
-  REAL engine — tools/chaos_serve.py composes them with overload.
+  REAL engine — tools/chaos_serve.py composes them with overload;
+- **serving state corruption** (`serve_host_corrupt`/
+  `serve_adapter_corrupt`): flip bytes in a demoted host-RAM KV-tier
+  entry / a demoted host adapter copy at a scheduled engine step, so
+  the CRC gates (serving/host_tier.py, serving/adapters.py) are
+  provable under randomized schedules — a corrupt demotion must
+  degrade to a checksum MISS (recompute / reload), never to wrong
+  tokens or weights. tools/chaos_mesh.py draws these (with the kinds
+  above) from a single seed; see docs/resilience.md "Chaos
+  conformance" for the complete env-spec grammar.
 
 Activation is process-global (`activate`/`deactivate` or the
 `with use_fault_injector(...)` context) and OFF by default — production
@@ -116,6 +125,12 @@ class FaultInjector:
     carried logits are poisoned with NaN before the dispatch, so the
     non-finite guard has a REAL poisoned slot to catch (the fault rides
     the actual sampling + forward, no metric faking).
+    `serve_host_corrupt_calls`: engine-step calls at which one demoted
+    host-RAM KV-tier entry's bytes are flipped (the tier's CRC gate
+    must turn it into a miss — serving/host_tier.py).
+    `serve_adapter_corrupt_calls`: engine-step calls at which one
+    demoted host adapter copy's bytes are flipped (the bank's CRC gate
+    must reload from disk — serving/adapters.py).
     """
 
     def __init__(self,
@@ -124,7 +139,9 @@ class FaultInjector:
                  delay_step_calls: Optional[Dict[int, float]] = None,
                  serve_delay_calls: Optional[Dict[int, float]] = None,
                  serve_crash_calls: Optional[Set[int]] = None,
-                 serve_nan_calls: Optional[Dict[int, int]] = None):
+                 serve_nan_calls: Optional[Dict[int, int]] = None,
+                 serve_host_corrupt_calls: Optional[Set[int]] = None,
+                 serve_adapter_corrupt_calls: Optional[Set[int]] = None):
         self.transient_errors = {
             k: set(v) for k, v in (transient_errors or {}).items()}
         self.nan_step_calls = set(nan_step_calls or ())
@@ -132,6 +149,10 @@ class FaultInjector:
         self.serve_delay_calls = dict(serve_delay_calls or {})
         self.serve_crash_calls = set(serve_crash_calls or ())
         self.serve_nan_calls = dict(serve_nan_calls or {})
+        self.serve_host_corrupt_calls = set(
+            serve_host_corrupt_calls or ())
+        self.serve_adapter_corrupt_calls = set(
+            serve_adapter_corrupt_calls or ())
         self._counts: Dict[str, int] = {}
         self._step_calls = 0
         self._serve_steps = 0
@@ -206,6 +227,52 @@ class FaultInjector:
                 self.fired.append(("serve_crash", f"step@{step_call}"))
             raise InjectedFault(
                 f"injected engine-step crash (step {step_call})")
+
+    def serve_host_corrupt(self, step_call: int) -> bool:
+        """True when this engine step is scheduled to corrupt a demoted
+        host-tier KV entry (the engine then calls
+        `corrupt_host_tier_entry`, which records the firing only if it
+        actually flipped bytes — an empty tier is a no-op)."""
+        return step_call in self.serve_host_corrupt_calls
+
+    def serve_adapter_corrupt(self, step_call: int) -> bool:
+        """True when this engine step is scheduled to corrupt a demoted
+        host adapter copy (see `corrupt_adapter_host_entry`)."""
+        return step_call in self.serve_adapter_corrupt_calls
+
+    def corrupt_host_tier_entry(self, tier) -> bool:
+        """Flip one byte in the LARGEST demoted host-tier entry's
+        arrays (serving/host_tier.py HostKVTier). Returns True (and
+        records the firing) when an entry existed to corrupt; the
+        tier's CRC verify must then turn the next restore of that
+        entry into a checksum MISS."""
+        entries = getattr(tier, "_entries", None)
+        if not entries:
+            return False
+        ent = max(entries.values(), key=lambda e: e.nbytes)
+        name = sorted(ent.arrays)[0]
+        ent.arrays[name].view(np.uint8).flat[0] ^= 0xFF
+        with self._lock:
+            self.fired.append(("serve_host_corrupt",
+                               f"entry@{ent.key!r}"))
+        return True
+
+    def corrupt_adapter_host_entry(self, bank) -> bool:
+        """Flip one byte in one demoted host adapter copy
+        (serving/adapters.py AdapterBank._host). Returns True (and
+        records the firing) when a demoted copy existed; the bank's
+        CRC verify must then reload that adapter from its source
+        instead of serving the corrupt copy."""
+        host = getattr(bank, "_host", None)
+        if not host:
+            return False
+        aid, ent = next(iter(host.items()))
+        name = sorted(ent.arrays)[0]
+        ent.arrays[name].view(np.uint8).flat[0] ^= 0xFF
+        with self._lock:
+            self.fired.append(("serve_adapter_corrupt",
+                               f"adapter@{aid!r}"))
+        return True
 
     def serve_nan_slot(self, step_call: int) -> Optional[int]:
         """Active-slot ordinal to poison with NaN logits at this engine
@@ -360,6 +427,8 @@ class FaultInjector:
         serve_delays: Dict[int, float] = {}
         serve_crashes: Set[int] = set()
         serve_nans: Dict[int, int] = {}
+        serve_host_corrupts: Set[int] = set()
+        serve_adapter_corrupts: Set[int] = set()
         for item in spec.split(","):
             item = item.strip()
             if not item:
@@ -383,13 +452,22 @@ class FaultInjector:
             elif kind == "serve_nan":
                 n, _, slot = arg.partition(":")
                 serve_nans[int(n)] = int(slot or 0)
+            elif kind == "serve_host_corrupt":
+                serve_host_corrupts.add(int(arg))
+            elif kind == "serve_adapter_corrupt":
+                serve_adapter_corrupts.add(int(arg))
             else:
                 raise ValueError(
                     f"unknown fault kind {kind!r} in {cls.ENV_VAR} "
                     f"(valid: write_error, tracker_error, nan, delay, "
-                    f"serve_delay, serve_crash, serve_nan)")
+                    f"serve_delay, serve_crash, serve_nan, "
+                    f"serve_host_corrupt, serve_adapter_corrupt — "
+                    "docs/resilience.md 'Chaos conformance' has the "
+                    "full grammar)")
         return cls(transient_errors=transient, nan_step_calls=nans,
                    delay_step_calls=delays,
                    serve_delay_calls=serve_delays,
                    serve_crash_calls=serve_crashes,
-                   serve_nan_calls=serve_nans)
+                   serve_nan_calls=serve_nans,
+                   serve_host_corrupt_calls=serve_host_corrupts,
+                   serve_adapter_corrupt_calls=serve_adapter_corrupts)

@@ -665,34 +665,50 @@ class ServingEngine:
         adapter (None = base model); an unknown id (or any id on an
         adapterless engine) is an AdmissionError → 400."""
         if self._broken:
+            # pre-admission gate: the breaker bounces callers before
+            # the request is even constructed — deliberately OUTSIDE
+            # the received/rejected accounting (the conservation law
+            # covers requests the front door actually took in)
             raise EngineUnhealthyError(
                 f"engine unhealthy (circuit breaker open): "
                 f"{self._broken}")
-        if adapter_id is not None:
-            from megatron_tpu.serving.adapters import UnknownAdapterError
-            if self.adapters is None:
-                self.metrics.count("requests_rejected")
-                raise UnknownAdapterError(
-                    f"adapter_id {adapter_id!r} on an engine serving "
-                    "no adapters (adapter_slots=0)")
-            if not self.adapters.known(adapter_id):
-                self.metrics.count("requests_rejected")
-                raise UnknownAdapterError(
-                    f"unknown adapter_id {adapter_id!r}: register it "
-                    "before submitting requests against it")
-        if self._draining:
-            from megatron_tpu.serving.scheduler import QueueFullError
-            raise QueueFullError(
-                "engine draining (shutdown in progress); retry against "
-                "another replica", retry_after=5,
-                queue_depth=self.scheduler.depth())
-        priority = max(0, min(int(priority),
-                              self.serving.priority_levels - 1))
-        req = GenRequest(list(prompt), max_new_tokens, sampling, seed,
-                         priority=priority, deadline_s=deadline_s,
-                         arrival_id=arrival_id, adapter_id=adapter_id)
+        # received is counted FIRST so that every submit-time refusal
+        # below (adapter 400, draining 429, queue full, shed) lands in
+        # requests_rejected against a matching requests_received — the
+        # conservation law requests_received == completed + rejected +
+        # failed + cancelled + expired (serving/invariants.py) holds
+        # by construction, not by auditing call sites
         self.metrics.count("requests_received")
         try:
+            if adapter_id is not None:
+                from megatron_tpu.serving.adapters import \
+                    UnknownAdapterError
+                if self.adapters is None:
+                    raise UnknownAdapterError(
+                        f"adapter_id {adapter_id!r} on an engine "
+                        "serving no adapters (adapter_slots=0)")
+                if not self.adapters.known(adapter_id):
+                    raise UnknownAdapterError(
+                        f"unknown adapter_id {adapter_id!r}: register "
+                        "it before submitting requests against it")
+            if self._draining:
+                from megatron_tpu.serving.scheduler import QueueFullError
+                raise QueueFullError(
+                    "engine draining (shutdown in progress); retry "
+                    "against another replica", retry_after=5,
+                    queue_depth=self.scheduler.depth())
+            priority = max(0, min(int(priority),
+                                  self.serving.priority_levels - 1))
+            req = GenRequest(list(prompt), max_new_tokens, sampling,
+                             seed, priority=priority,
+                             deadline_s=deadline_s,
+                             arrival_id=arrival_id,
+                             adapter_id=adapter_id)
+            # terminal-accounting hook: the request's FIRST terminal
+            # transition — wherever it happens (engine loop, watchdog
+            # thread, cancel path, drain, breaker) — counts exactly
+            # one of requests_{completed,failed,cancelled,expired}
+            req._on_terminal = self._count_terminal
             if max_new_tokens == 0:
                 # nothing to decode: the serial path returns the prompt
                 # row unchanged — short-circuit without occupying a
@@ -702,7 +718,6 @@ class ServingEngine:
                 req.mark_admitted()
                 req.finish()
                 self.metrics.record_admitted(0.0)
-                self.metrics.record_completed(0.0, 0)
                 return req
             self.scheduler.submit(req)
         except OverloadShedError:
@@ -713,6 +728,22 @@ class ServingEngine:
             self.metrics.count("requests_rejected")
             raise
         return req
+
+    def _count_terminal(self, req: GenRequest, outcome: str):
+        """GenRequest._on_terminal hook (any thread; fires exactly once
+        per request — the terminal transition is atomic): the SINGLE
+        choke point for ALL terminal accounting, so the request-
+        conservation invariant cannot drift as failure paths are
+        added. Completions count here too (record_completed, with the
+        latency/token payload) — do NOT add per-site record_completed
+        calls, they would double-count requests_completed and break
+        the law."""
+        if outcome == "completed":
+            self.metrics.record_completed(
+                (req.finish_time or req.submit_time) - req.submit_time,
+                len(req.generated))
+        else:
+            self.metrics.count("requests_" + outcome)
 
     def cancel(self, req: GenRequest):
         """Best-effort cancellation: a QUEUED request is dropped and
@@ -763,16 +794,23 @@ class ServingEngine:
         the device, so a wedged decode cannot wedge the health endpoint
         too; the pool-accounting reads race the engine thread
         harmlessly (a stale count only skews a routing hint)."""
+        # read each flag ONCE: healthy/state/accepting must derive from
+        # the SAME snapshot, or the watchdog thread flipping _wedged
+        # between two reads yields a self-contradictory payload
+        # (state 'running' with healthy False) — the healthz
+        # consistency law (serving/invariants.py) holds per payload
         broken = self._broken
+        draining = self._draining
+        wedged = self._wedged
         state = ("unhealthy" if broken else
-                 "draining" if self._draining else
-                 "wedged" if self._wedged else "running")
+                 "draining" if draining else
+                 "wedged" if wedged else "running")
         # free_rows, NOT free_count: the latter's memoized
         # reclaimable-block walk is engine-thread-only; these reads
         # come from HTTP probe threads
         free_slots = int(self.pool.free_rows())
         kv_retained = int(self.pool.retained_count())
-        healthy = broken is None and not self._wedged
+        healthy = broken is None and not wedged
         loop_alive = self._thread.is_alive()
         return {
             "healthy": healthy,
@@ -811,6 +849,39 @@ class ServingEngine:
                                  else 0),
             "weight_swap_pending": self._pending_swap is not None,
             "detail": broken or "",
+        }
+
+    def invariant_state(self) -> dict:
+        """Read-only snapshot for the system-wide invariant checker
+        (serving/invariants.py). The in-flight pieces (slot requests,
+        pending prefills, mid-admit pops, queue depth) feed the
+        request-conservation law; the weight generation feeds the
+        namespace-isolation check. Host reads only — but unlike
+        `health()` this walks engine-thread-owned lists, so the STRICT
+        accounting sweeps should run against a quiesced (idle, drained,
+        or closed) engine; the live sweep only consumes the racy counts
+        as a conservative in-flight bound."""
+        slot_reqs = [(slot, r) for slot, r in enumerate(self._slot_req)
+                     if r is not None]
+        pend = [(st.req, st.slot, st.blocks, st.installed)
+                for st in self._prefilling]
+        admitting = list(self._admitting)
+        # in-flight counts only NON-terminal requests: a watchdog-
+        # failed slotted request (or a cancelled one lingering in the
+        # queue until the next pop) has already been terminal-counted
+        live = (sum(1 for _, r in slot_reqs if not r.done())
+                + sum(1 for r, _, _, _ in pend if not r.done())
+                + sum(1 for r in admitting if not r.done())
+                + self.scheduler.live_depth())
+        return {
+            "slot_requests": slot_reqs,
+            "prefilling": pend,
+            "admitting": admitting,
+            "queue_depth": self.scheduler.depth(),
+            "in_flight": live,
+            "weight_gen": self._weight_gen,
+            "lengths": self._lengths.copy(),
+            "active": self._active.copy(),
         }
 
     def prefix_peek(self, tokens: Sequence[int], adapter_id=None) -> int:
@@ -884,10 +955,11 @@ class ServingEngine:
         self._fail_pending_swap("engine draining")
         backlog = self.scheduler.close()
         for req in backlog:
+            # accepted-then-dropped work is a FAILURE (retryable 503),
+            # not a submit-time rejection — the terminal hook counts
+            # requests_failed per request
             req.fail("engine draining (shutdown in progress); retry "
                      "against another replica", kind="unavailable")
-        if backlog:
-            self.metrics.count("requests_rejected", len(backlog))
         self._wake()
         if self._thread.ident is not None:
             self._thread.join(timeout)
@@ -1104,13 +1176,12 @@ class ServingEngine:
         # typed + retryable (the router resubmits token-exact on a
         # replica still serving the old version)
         for req in self.scheduler.drop_resumed():
-            if req.fail(
-                    "weights hot-swapped while this preempted request "
-                    "was queued: its committed tokens were generated "
-                    f"under the previous version and cannot continue "
-                    f"under {staged.version.label} — resubmit",
-                    kind="unavailable"):
-                self.metrics.count("requests_cancelled")
+            req.fail(
+                "weights hot-swapped while this preempted request "
+                "was queued: its committed tokens were generated "
+                f"under the previous version and cannot continue "
+                f"under {staged.version.label} — resubmit",
+                kind="unavailable")  # terminal hook counts it failed
         # adapters were trained against the OLD base: bump every
         # registration generation (rows unmap, host copies drop, prefix
         # namespaces change; mid-flight pinned streams fail typed at
@@ -1977,12 +2048,10 @@ class ServingEngine:
             return "blocked"
         except UnknownAdapterError as e:
             req.fail(str(e))
-            self.metrics.count("requests_cancelled")
             return "failed"
         except Exception as e:  # noqa: BLE001 — unloadable source
             req.fail(f"adapter {req.adapter_id!r} failed to load: "
                      f"{e!r}")
-            self.metrics.count("requests_cancelled")
             return "failed"
         ns = self.adapters.namespace(req.adapter_id)
         if req.adapter_ns is not None and ns != req.adapter_ns:
@@ -1991,7 +2060,6 @@ class ServingEngine:
                      "while this request was queued or preempted; its "
                      "stream cannot continue under different weights "
                      "— resubmit")
-            self.metrics.count("requests_cancelled")
             return "failed"
         req.adapter_ns = ns
         req.bank_idx = idx
@@ -2481,9 +2549,7 @@ class ServingEngine:
             self.pool.drop_blocks(st.blocks)
         self._kv_dirty = True
         self.pool.release(st.slot)
-        if st.req.fail(msg, kind=kind):
-            self.metrics.count("requests_expired" if kind == "deadline"
-                               else "requests_cancelled")
+        st.req.fail(msg, kind=kind)  # terminal hook counts the bucket
 
     def _prefill_group(self, reqs: List[GenRequest], padded: int):
         """One batched prefill for same-bucket admissions. The batch
@@ -2603,9 +2669,9 @@ class ServingEngine:
                     f"(deadline {ad - st.req.submit_time:.1f}s, "
                     f"{st.pos} prompt tokens prefilled)",
                     kind="deadline")
-        expired = self.scheduler.drop_expired(self._deadline_s, now)
-        if expired:
-            self.metrics.count("requests_expired", len(expired))
+        # drop_expired fails each victim with kind="deadline" — the
+        # terminal hook counts requests_expired per request
+        self.scheduler.drop_expired(self._deadline_s, now)
 
     def _evict(self, slot: int, failed: Optional[str] = None,
                kind: str = "error"):
@@ -2669,21 +2735,16 @@ class ServingEngine:
             self.pool.release(slot)
             self._index.remove(slot)
         if failed is not None:
-            # "nonfinite" evictions raise a plain RuntimeError for the
-            # caller and are counted via nonfinite_logit_fails at the
-            # guard, not as cancellations
-            transitioned = req.fail(
-                failed, kind="error" if kind == "nonfinite" else kind)
-            if transitioned:
-                if kind == "deadline":
-                    self.metrics.count("requests_expired")
-                elif kind != "nonfinite":
-                    self.metrics.count("requests_cancelled")
+            # the terminal-accounting hook classifies the failure
+            # (expired / cancelled / failed — "nonfinite" rides the
+            # failed bucket, with nonfinite_logit_fails counted at the
+            # guard); no per-site counters to keep in sync
+            req.fail(failed, kind="error" if kind == "nonfinite"
+                     else kind)
             return
         if req.finish():
-            self.metrics.record_completed(
-                req.finish_time - req.submit_time, len(req.generated))
-            # feed the shed estimator: time this request held a slot
+            # completion metrics ride the terminal hook; only the
+            # shed-estimator feed is site-specific (slot service time)
             self.scheduler.observe_service(
                 req.finish_time - (req.admit_time or req.submit_time))
 
@@ -2730,6 +2791,17 @@ class ServingEngine:
             call = inj.next_serve_step()
             inj.maybe_serve_delay(call)
             inj.check_serve_crash(call)
+            # state-corruption faults (chaos-mesh coverage of the
+            # checksum gates): flip bytes in a demoted host-tier KV
+            # entry / a demoted host adapter copy so the CRC verify
+            # paths have REAL corruption to catch — a corrupt demotion
+            # must degrade to a miss, never to wrong tokens/weights
+            if inj.serve_host_corrupt(call) and \
+                    self._host_tier is not None:
+                inj.corrupt_host_tier_entry(self._host_tier)
+            if inj.serve_adapter_corrupt(call) and \
+                    self.adapters is not None:
+                inj.corrupt_adapter_host_entry(self.adapters)
             ordinal = inj.serve_nan_slot(call)
             if ordinal is not None:
                 act = np.nonzero(self._active)[0]

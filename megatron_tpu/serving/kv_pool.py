@@ -729,6 +729,44 @@ class SlotKVPool:
         return n
 
     # ---- capacity / introspection ------------------------------------
+    def accounting(self) -> dict:
+        """Read-only accounting snapshot for the system-wide invariant
+        checker (serving/invariants.py): the raw refcounts, block map,
+        free lists, and retained entries the KV-block conservation laws
+        (refcounts == row refs + retained refs + pending refs;
+        free + used == total; no cross-namespace block sharing) are
+        recomputed against. Copies everything — the checker can never
+        mutate pool state through it. Engine-thread state: call with
+        the engine quiesced (idle/drained/closed), like
+        `ServingEngine.invariant_state`."""
+        out = {
+            "blocks_enabled": self.blocks_enabled,
+            "num_slots": self.num_slots,
+            "free_rows": [int(s) for s in self._free],
+            "retained": {
+                key: {
+                    "blocks": (list(ent.blocks)
+                               if self.blocks_enabled else None),
+                    "length": (ent.length if self.blocks_enabled
+                               else None),
+                    "namespace": (getattr(ent, "namespace", None)
+                                  if self.blocks_enabled else None),
+                }
+                for key, ent in self._retained.items()
+            },
+            "rolling": self.rolling,
+        }
+        if self.blocks_enabled:
+            out.update({
+                "rc": self._rc.copy(),
+                "map": self._map.copy(),
+                "free_blocks": [int(b) for b in self._free_blocks],
+                "total_blocks": self.total_blocks,
+                "trash": self.TRASH,
+                "blocks_per_slot": self.blocks_per_slot,
+            })
+        return out
+
     def free_count(self) -> int:
         """Allocatable slots. Whole-region mode: truly free + lazily
         evictable retained. Block mode: the CONSERVATIVE bound

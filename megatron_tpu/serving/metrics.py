@@ -31,8 +31,21 @@ def _percentile(sorted_vals, q: float) -> float:
 # a /metrics scrape of a fresh engine must look like an idle engine,
 # not a different schema
 _BASE_COUNTERS = (
+    # request-conservation law (serving/invariants.py; every terminal
+    # transition is counted EXACTLY ONCE through GenRequest's
+    # _on_terminal hook, so on a quiesced engine):
+    #   requests_received == requests_completed + requests_rejected
+    #                        + requests_failed + requests_cancelled
+    #                        + requests_expired
+    # requests_rejected covers submit-time refusals (queue full, shed,
+    # 400s — requests_shed is its early-shedding SUBSET); requests_
+    # failed covers post-admission failures (crash/hang/breaker/drain/
+    # non-finite/adapter); cancelled and expired are caller
+    # cancellations and deadline deaths. A live engine additionally
+    # carries its in-flight requests on the left side.
     "requests_received", "requests_admitted", "requests_completed",
-    "requests_rejected", "requests_cancelled", "requests_expired",
+    "requests_rejected", "requests_failed",
+    "requests_cancelled", "requests_expired",
     "tokens_generated", "decode_steps", "host_syncs",
     "wasted_decode_steps", "sampling_uploads",
     "prefill_calls", "prefill_prompts",

@@ -36,6 +36,19 @@ class ServiceUnavailableError(RuntimeError):
     layer maps this to 503 so clients retry against another replica."""
 
 
+class RequestFailedError(RuntimeError):
+    """Generic terminal failure (engine crash/hang/breaker, non-finite
+    logits, cancellation, adapter load failure): the typed spelling of
+    what used to surface as a bare RuntimeError from `result()`. A
+    RuntimeError subclass, so every existing `except RuntimeError`
+    caller keeps working — but the serving invariant checker
+    (serving/invariants.py "typed-terminal law") can now assert that NO
+    request ever resolves with a BARE RuntimeError: every failure is
+    one of {DeadlineExceededError (504), ServiceUnavailableError (503,
+    retryable), RequestFailedError (500)} or a typed submit-time
+    rejection."""
+
+
 @dataclasses.dataclass(frozen=True)
 class SamplingOptions:
     """Per-REQUEST sampling knobs. The engine batches these into [slots]
@@ -98,6 +111,21 @@ class GenRequest:
         self.first_token_time: Optional[float] = None
         self.finish_time: Optional[float] = None
         self._done = threading.Event()
+        # terminal transitions are check-then-act (finish/fail race
+        # between the engine loop, the watchdog thread, and HTTP
+        # cancel paths); this lock makes first-wins ATOMIC so the
+        # terminal-accounting hook below can fire exactly once per
+        # request — the request-conservation invariant
+        # (serving/invariants.py) rests on it
+        self._term_lock = threading.Lock()
+        # terminal-accounting hook (set by the engine at submit):
+        # called exactly once, AFTER the winning terminal transition,
+        # with (request, outcome) where outcome is one of
+        # "completed" | "expired" | "cancelled" | "failed" — the single
+        # choke point behind the metrics conservation law
+        # requests_received == completed + rejected + failed +
+        # cancelled + expired (+ in-flight)
+        self._on_terminal = None
         # token-progress wakeups for SSE streaming consumers: notified
         # on every append_token and on the terminal transition, so a
         # streaming thread can sleep between tokens instead of polling
@@ -204,34 +232,65 @@ class GenRequest:
                 self._progress.wait(rem)
         return True
 
+    def _fire_terminal(self, outcome: str):
+        hook = self._on_terminal
+        if hook is not None:
+            hook(self, outcome)
+
     def finish(self) -> bool:
-        """First terminal transition wins: a request the engine
-        supervisor (or the hung-step watchdog, on its own thread)
-        already failed stays failed. Returns True when THIS call
-        transitioned the request."""
-        if self._done.is_set():
-            return False
-        self.state = RequestState.FINISHED
-        self.finish_time = time.monotonic()
-        self._done.set()
+        """First terminal transition wins — ATOMICALLY (the engine
+        loop, the hung-step watchdog, and HTTP cancel paths may race):
+        a request the watchdog already failed stays failed. Returns
+        True when THIS call transitioned the request.
+
+        The accounting hook fires BEFORE `_done` is set (and before any
+        waiter can wake): a caller unblocked by `result()` must find the
+        terminal counters already updated, or a strict conservation
+        sweep racing the terminal thread would see a phantom dropped
+        transition. The hook only takes the metrics lock — no cycle
+        with `_term_lock` — and `_done.set()` is in a finally so a
+        failing hook can never strand the waiters."""
+        with self._term_lock:
+            if self._done.is_set():
+                return False
+            self.state = RequestState.FINISHED
+            self.finish_time = time.monotonic()
+            try:
+                self._fire_terminal("completed")
+            finally:
+                self._done.set()
         self._notify_progress()
         return True
 
     def fail(self, msg: str, kind: str = "error") -> bool:
         """`kind` picks the exception `result()` raises: "deadline" →
         DeadlineExceededError (504), "unavailable" →
-        ServiceUnavailableError (503), anything else → RuntimeError.
-        Idempotent: the first terminal transition wins (the watchdog
-        and the engine loop may race to fail the same request).
-        Returns True when THIS call transitioned the request."""
-        if self._done.is_set():
-            return False
-        self.state = RequestState.FAILED
-        self.error = msg
-        self.error_kind = kind
-        self.finish_time = time.monotonic()
-        self.parked = None  # drop parked KV device refs promptly
-        self._done.set()
+        ServiceUnavailableError (503), anything else →
+        RequestFailedError. Idempotent AND atomic: the first terminal
+        transition wins (the watchdog and the engine loop may race to
+        fail the same request — the lock makes the winner unique, so
+        the terminal-accounting hook fires exactly once). Returns True
+        when THIS call transitioned the request."""
+        with self._term_lock:
+            if self._done.is_set():
+                return False
+            self.state = RequestState.FAILED
+            self.error = msg
+            self.error_kind = kind
+            self.finish_time = time.monotonic()
+            self.parked = None  # drop parked KV device refs promptly
+            try:
+                # terminal taxonomy for the conservation law: a
+                # deadline death is "expired", a caller-initiated
+                # cancellation "cancelled", everything else (crash/
+                # hang/breaker/drain/nonfinite/adapter) "failed" —
+                # exactly one bucket per request, counted BEFORE any
+                # waiter can wake (see finish())
+                self._fire_terminal("expired" if kind == "deadline"
+                                    else "cancelled" if self.cancelled
+                                    else "failed")
+            finally:
+                self._done.set()
         self._notify_progress()
         return True
 
@@ -256,7 +315,8 @@ class GenRequest:
             if kind == "unavailable":
                 raise ServiceUnavailableError(
                     f"request {self.id}: {self.error}")
-            raise RuntimeError(f"request {self.id} failed: {self.error}")
+            raise RequestFailedError(
+                f"request {self.id} failed: {self.error}")
         return self.prompt + self.generated, list(self.gen_logprobs)
 
     @property

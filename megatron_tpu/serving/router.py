@@ -554,6 +554,14 @@ class EngineRouter:
     def cancel(self, rreq: RouterRequest):
         rreq.cancel()
 
+    @property
+    def engines(self) -> List:
+        """The replica engines, in index order — the invariant
+        checker's (serving/invariants.py) walk surface: a router sweep
+        is each replica's engine sweep plus the router-level healthz /
+        aggregate-schema laws."""
+        return [rep.engine for rep in self.replicas]
+
     def queue_depth(self) -> int:
         n = 0
         for rep in self.replicas:

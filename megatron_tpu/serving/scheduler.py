@@ -328,6 +328,14 @@ class AdmissionScheduler:
         with self._lock:
             return len(self._q)
 
+    def live_depth(self) -> int:
+        """Queued requests that are NOT already terminal (a cancelled
+        request stays in the queue until the next pop drops it, but it
+        has already been terminal-counted) — the in-flight term of the
+        request-conservation law (serving/invariants.py)."""
+        with self._lock:
+            return sum(1 for r in self._q if not r.done())
+
     def close(self) -> List[GenRequest]:
         """Reject further submits; return the drained backlog so the
         engine can fail them."""

@@ -808,10 +808,15 @@ def test_chaos_serve_smoke(tmp_path):
     with open(out) as f:
         record = json.load(f)
     assert record["completed"] is True
+    assert "seed" in record  # unified chaos-record schema (ISSUE 15)
     for drill in ("overload", "hang", "crash_loop"):
         assert record[drill]["ok"], record[drill]
         assert record[drill]["outcomes"]["stranded"] == 0 \
             if "outcomes" in record[drill] else True
+        # ISSUE 15: every drill finishes with the system-wide
+        # invariant sweep (conservation law included) green
+        assert record[drill]["invariants_ok"] is True, \
+            record[drill]["invariant_violations"]
     assert record["overload"]["preemptions"] >= 1
     assert record["overload"]["requests_shed"] >= 1
     assert record["hang"]["engine_restarts"] >= 1
@@ -851,8 +856,13 @@ def test_chaos_router_smoke(tmp_path):
     with open(out) as f:
         record = json.load(f)
     assert record["completed"] is True
+    assert "seed" in record  # unified chaos-record schema (ISSUE 15)
     for drill in ("kill", "wedge", "host_tier"):
         assert record[drill]["ok"], record[drill]
+        # ISSUE 15: per-replica conservation/KV/schema + the router's
+        # degraded-not-down healthz law, swept after every storm
+        assert record[drill]["invariants_ok"] is True, \
+            record[drill]["invariant_violations"]
     # kill: zero stranded, zero lost, all token-exact, degraded-ready
     assert record["kill"]["outcomes"]["stranded"] == 0
     assert record["kill"]["outcomes"]["error"] == 0
@@ -877,6 +887,7 @@ def test_chaos_router_smoke(tmp_path):
     for half in ("kill_prefill_half", "kill_decode_half"):
         d = record[half]
         assert "skipped" not in d, d
+        assert d["invariants_ok"] is True, d["invariant_violations"]
         assert d["outcomes"]["stranded"] == 0
         assert d["outcomes"]["error"] == 0
         assert d["completed_token_exact"] is True
@@ -911,6 +922,11 @@ def test_chaos_upgrade_smoke(tmp_path):
     with open(out) as f:
         record = json.load(f)
     assert record["completed"] is True
+    assert "seed" in record  # unified chaos-record schema (ISSUE 15)
+    for drill in ("kill_draining", "corrupt_watch", "disagg_race"):
+        # ISSUE 15: invariant sweep green after every upgrade storm
+        assert record[drill]["invariants_ok"] is True, \
+            record[drill]["invariant_violations"]
     # kill-the-draining-replica: typed abort, degraded-not-down, all
     # completions token-exact at their admitted version
     k = record["kill_draining"]
@@ -1211,3 +1227,323 @@ class TestDatasetCorruptionDetection:
         FaultInjector.truncate_file(prefix + ".idx", keep_bytes=20)
         with pytest.raises(DatasetCorruptionError, match="truncated"):
             MMapIndexedDataset(prefix)
+
+
+# ---------------------------------------------------------------------------
+# system-wide serving invariants (ISSUE 15 tentpole): the laws, their
+# checkers, and hand-built violation fixtures proving the checkers are
+# not vacuous
+# ---------------------------------------------------------------------------
+
+class TestServingInvariants:
+    def _fresh_snapshot(self):
+        from megatron_tpu.serving import ServingMetrics
+        return ServingMetrics().snapshot()
+
+    def test_conservation_law_on_fresh_snapshot(self):
+        """submitted == completed + rejected + failed + cancelled +
+        expired holds trivially (0 == 0) on a fresh registry, and the
+        requests_failed bucket is part of the fixed schema."""
+        from megatron_tpu.serving import (check_metrics_conservation,
+                                          check_schema)
+        snap = self._fresh_snapshot()
+        assert snap["requests_failed"] == 0.0
+        balance = check_metrics_conservation(snap)
+        assert balance["received"] == 0.0
+        check_schema(snap)
+
+    def test_dropped_terminal_transition_is_caught(self):
+        """The checker-not-vacuous fixture: a snapshot with a request
+        that reached NO terminal bucket must violate conservation."""
+        from megatron_tpu.serving import (InvariantViolation,
+                                          check_metrics_conservation)
+        snap = self._fresh_snapshot()
+        snap["requests_received"] = 3.0
+        snap["requests_completed"] = 2.0
+        with pytest.raises(InvariantViolation,
+                           match="dropped terminal transition"):
+            check_metrics_conservation(snap)
+        # the same books balance as a LIVE engine with one in flight
+        check_metrics_conservation(snap, in_flight=1)
+        # ... and the live (inequality) sweep only catches the reverse
+        # direction: more terminals than receptions
+        check_metrics_conservation(snap, strict=False)
+        snap["requests_completed"] = 4.0
+        with pytest.raises(InvariantViolation, match="exceed"):
+            check_metrics_conservation(snap, strict=False)
+
+    def test_shed_subset_and_schema_fixtures(self):
+        from megatron_tpu.serving import (InvariantViolation,
+                                          check_metrics_conservation,
+                                          check_schema)
+        snap = self._fresh_snapshot()
+        snap["requests_shed"] = 2.0  # shed without matching rejected
+        snap["requests_received"] = snap["requests_rejected"] = 0.0
+        with pytest.raises(InvariantViolation, match="subset"):
+            check_metrics_conservation(snap, in_flight=0)
+        snap = self._fresh_snapshot()
+        del snap["requests_completed"]
+        with pytest.raises(InvariantViolation, match="schema drift"):
+            check_schema(snap)
+        snap = self._fresh_snapshot()
+        snap["surprise_gauge"] = 1.0
+        with pytest.raises(InvariantViolation, match="schema drift"):
+            check_schema(snap)
+
+    def test_healthz_consistency_fixtures(self):
+        from megatron_tpu.serving.invariants import (InvariantViolation,
+                                                     check_engine_health,
+                                                     check_router_health)
+        good = dict(healthy=True, state="running", accepting=True,
+                    loop_alive=True, circuit_breaker_open=False,
+                    active_slots=1, num_slots=2, queue_depth=0,
+                    free_slots=1)
+        check_engine_health(good)
+        bad = dict(good, accepting=False)  # running+healthy but refusing
+        with pytest.raises(InvariantViolation, match="accepting"):
+            check_engine_health(bad)
+        bad = dict(good, circuit_breaker_open=True)  # breaker yet "running"
+        with pytest.raises(InvariantViolation, match="breaker"):
+            check_engine_health(bad)
+        # router: degraded-not-down — 1/2 up must stay ready
+        check_router_health(dict(replicas_up=1, num_replicas=2,
+                                 state="degraded", healthy=True,
+                                 accepting=True))
+        with pytest.raises(InvariantViolation, match="degraded-not-down"):
+            check_router_health(dict(replicas_up=1, num_replicas=2,
+                                     state="degraded", healthy=False,
+                                     accepting=False))
+        with pytest.raises(InvariantViolation, match="router state"):
+            check_router_health(dict(replicas_up=0, num_replicas=2,
+                                     state="degraded", healthy=False,
+                                     accepting=False))
+
+    def test_typed_terminal_law_fixtures(self):
+        """resolve_terminals: a stranded future and a bare-RuntimeError
+        terminal both violate; the typed taxonomy passes."""
+        from megatron_tpu.serving import GenRequest
+        from megatron_tpu.serving.invariants import (InvariantViolation,
+                                                     resolve_terminals)
+        ok = GenRequest([1, 2], 4)
+        ok.finish()
+        failed = GenRequest([1, 2], 4)
+        failed.fail("engine crashed", kind="error")
+        expired = GenRequest([1, 2], 4, deadline_s=5.0)
+        expired.fail("too late", kind="deadline")
+        out = resolve_terminals([ok, failed, expired], timeout=1.0)
+        assert out["completed"] == 1
+        assert out["RequestFailedError"] == 1
+        assert out["DeadlineExceededError"] == 1
+
+        class _Stranded:
+            id = 99
+            prompt = [1]
+
+            def result(self, timeout=None):
+                raise TimeoutError("still pending")
+
+        with pytest.raises(InvariantViolation, match="STRANDED"):
+            resolve_terminals([_Stranded()], timeout=0.01)
+
+        class _Bare:
+            id = 98
+            prompt = [1]
+
+            def result(self, timeout=None):
+                raise RuntimeError("bare escape")
+
+        with pytest.raises(InvariantViolation, match="UNTYPED"):
+            resolve_terminals([_Bare()], timeout=0.01)
+
+    def _kv_stub(self, pool):
+        class _Stub:
+            def __init__(self, p):
+                self.pool = p
+
+            def invariant_state(self):
+                return {"slot_requests": [], "prefilling": [],
+                        "admitting": [], "queue_depth": 0,
+                        "in_flight": 0, "weight_gen": 0,
+                        "lengths": None, "active": None}
+
+        return _Stub(pool)
+
+    def test_kv_accounting_fixtures(self):
+        """A fresh block pool passes; a leaked refcount and a
+        cross-namespace shared block are each caught."""
+        from megatron_tpu.serving import (RetainedPrefix, SlotKVPool,
+                                          check_kv_accounting)
+        from megatron_tpu.serving.invariants import InvariantViolation
+        mcfg = ModelConfig(num_layers=2, hidden_size=64,
+                           num_attention_heads=2, num_kv_heads=1,
+                           vocab_size=128, seq_length=64,
+                           make_vocab_size_divisible_by=64).derived()
+        pool = SlotKVPool(mcfg, 2, 64, block_size=16)
+        check_kv_accounting(self._kv_stub(pool))
+        # fixture 1: a leaked reference (rc drift)
+        pool._rc[0] += 1
+        with pytest.raises(InvariantViolation, match="refcount drift"):
+            check_kv_accounting(self._kv_stub(pool))
+        pool._rc[0] -= 1
+        # fixture 2: two retained entries share block 0 under DIFFERENT
+        # namespaces (rc books balanced, so only the isolation law can
+        # catch it)
+        pool._free_blocks.remove(0)
+        pool._rc[0] = 2
+        pool._retained[("ret", 0)] = RetainedPrefix(
+            ("ret", 0), [0], 16, list(range(16)), namespace=(0, "A"))
+        pool._retained[("ret", 1)] = RetainedPrefix(
+            ("ret", 1), [0], 16, list(range(16)), namespace=(0, "B"))
+        with pytest.raises(InvariantViolation,
+                           match="cross-namespace"):
+            check_kv_accounting(self._kv_stub(pool))
+
+    def test_engine_sweep_after_traffic(self):
+        """A real engine after mixed traffic (completions + a cancel)
+        passes the FULL strict sweep and the books balance exactly —
+        the conservation law pinned on a real storm's aftermath, not
+        just on fixtures."""
+        from megatron_tpu.config import ServingConfig
+        from megatron_tpu.inference import Generator
+        from megatron_tpu.models import language_model as lm
+        from megatron_tpu.serving import ServingEngine, check_all
+        mcfg = ModelConfig(num_layers=2, hidden_size=64,
+                           num_attention_heads=2, num_kv_heads=1,
+                           vocab_size=96, seq_length=64,
+                           make_vocab_size_divisible_by=32,
+                           compute_dtype="float32").derived()
+        params = lm.model_init(jax.random.PRNGKey(0), mcfg)
+        gen = Generator(params, mcfg, eos_id=-1, pad_id=0)
+        eng = ServingEngine(gen, ServingConfig(
+            num_slots=2, max_queue=8, max_len=64,
+            enable_prefix_cache=True, kv_block_size=16,
+            block_native_attn=True))
+        try:
+            reqs = [eng.submit([3, 1, 4, 1], 5, seed=i)
+                    for i in range(2)]
+            cancelled = eng.submit([9, 9], 4, seed=7)
+            eng.cancel(cancelled)
+            for r in reqs:
+                r.result(timeout=120)
+            report = check_all(eng, requests=reqs + [cancelled],
+                               strict=True, raise_on_violation=True)
+            assert report["ok"], report["violations"]
+            snap = eng.metrics.snapshot()
+            assert snap["requests_received"] == 3.0
+            # the cancel may race a fast completion — either way every
+            # request lands in exactly one bucket
+            assert (snap["requests_completed"]
+                    + snap["requests_cancelled"]) == 3.0
+            assert snap["requests_completed"] >= 2.0
+            assert snap["requests_failed"] == 0.0
+        finally:
+            eng.close()
+
+
+class TestChaosMeshTool:
+    """tools/chaos_mesh.py in-process (tier-1): one seeded storm with
+    every invariant green, and the deliberately injected violation
+    caught with its repro seed (the ISSUE 15 acceptance pins)."""
+
+    def test_single_seed_green(self):
+        from tools.chaos_mesh import run_one
+        record = run_one(3, n_requests=5, new_tokens=6)
+        assert record["ok"], record["violations"]
+        assert record["seed"] == 3
+        assert "--seed 3" in record["repro"]
+        assert record["outcomes"].get("completed", 0) >= 1
+        assert record["token_exact"]["checked"] >= 1
+        for law in ("conservation", "typed_terminals", "kv_accounting",
+                    "metrics_schema", "healthz", "token_exact"):
+            assert law in record["laws_checked"], record["laws_checked"]
+
+    def test_injected_violation_caught_with_repro_seed(self):
+        from tools.chaos_mesh import run_one
+        record = run_one(3, n_requests=4, new_tokens=5,
+                         inject_violation=True)
+        assert record["injected_violation_caught"] is True
+        assert any("dropped terminal transition" in v
+                   for v in record["injected_sweep_violations"]), \
+            record["injected_sweep_violations"]
+        # the tampered sweep stays separate from the real storm's laws
+        assert record["violations"] == []
+        assert record["ok"] is True
+        # the repro line carries the workload knobs too — the rng
+        # stream depends on them, so a partial line replays a
+        # DIFFERENT storm
+        assert record["seed"] == 3
+        assert "--seed 3 --requests 4 --new_tokens 5" in record["repro"]
+
+    def test_sampler_records_loud_rejections(self):
+        """validate() is the rejection filter: walking seeds must hit
+        (and RECORD) illegal matrix points instead of skipping them."""
+        import random as _random
+
+        from tools.chaos_mesh import sample_config
+        seen = []
+        for seed in range(40):
+            _, _, rej = sample_config(_random.Random(seed))
+            seen.extend(r["rejected"] for r in rej)
+        assert seen, "40 seeds sampled no illegal combination — the " \
+            "sampler no longer exercises the capability matrix's edges"
+
+
+@pytest.mark.slow
+def test_chaos_mesh_smoke(tmp_path):
+    """tools/chaos_mesh.py --smoke (subprocess, the bench-extras
+    entry): >= 3 distinct sampled configs — at least one each with
+    adapters, disaggregation, and a live-weight swap in the schedule —
+    with every invariant green, every record carrying its repro
+    seed."""
+    import subprocess
+    import sys as _sys
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "chaos_mesh.py")
+    out = str(tmp_path / "chaos_mesh.json")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([_sys.executable, tool, "--smoke", "--out", out],
+                       capture_output=True, text=True, env=env,
+                       timeout=900)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    with open(out) as f:
+        record = json.load(f)
+    assert record["completed"] is True
+    assert record["value"] >= 3  # >= 3 distinct configs, all green
+    assert "seed" in record
+    runs = record["runs"]
+    assert len(runs) >= 3
+    for run in runs:
+        assert run["ok"], run["violations"]
+        assert "seed" in run and "--seed" in run["repro"]
+    # the fixed corner coverage: adapters / disaggregation / live swap
+    assert any(run["config"].get("adapter_slots") for run in runs)
+    assert any(run["config"].get("disaggregate_prefill")
+               for run in runs)
+    assert any(any(a == "swap_good" and v.startswith("swapped")
+                   for a, v in run["action_log"]) for run in runs)
+
+
+@pytest.mark.slow
+def test_chaos_mesh_soak(tmp_path):
+    """Soak mode (--minutes): walks seeds until the budget expires,
+    stopping at the first violation with its repro line."""
+    import subprocess
+    import sys as _sys
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "chaos_mesh.py")
+    out = str(tmp_path / "chaos_soak.json")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [_sys.executable, tool, "--minutes", "0.25", "--requests", "6",
+         "--new_tokens", "6", "--out", out],
+        capture_output=True, text=True, env=env, timeout=900)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    with open(out) as f:
+        record = json.load(f)
+    assert record["completed"] is True
+    assert record["value"] >= 1  # at least one seed walked, all green
+    assert record["first_violation"] is None

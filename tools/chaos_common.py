@@ -1,0 +1,288 @@
+"""Shared scaffolding for the serving chaos tools.
+
+`chaos_serve.py`, `chaos_router.py`, and `chaos_upgrade.py` each grew
+their own copy of the same harness pieces (tiny engine/router builders,
+serial oracles, outcome resolvers, checkpoint publish helpers) — three
+drifting copies of load-bearing test scaffolding. This module is the
+single copy they (and the seeded `chaos_mesh.py` conformance engine)
+import.
+
+Record contract: every chaos tool emits ONE line of JSON on stdout via
+`emit_record`, and every record carries a `seed` field — a CI-logged
+failure line is reproducible from the log line alone (the scripted
+drills run fixed scenarios, so their seed is the fixed workload seed 0;
+chaos_mesh's records carry the sampled seed that regenerates config +
+workload + fault schedule).
+
+Import side effects: none (jax imports live inside the builders, so
+`force_host_devices` can still set XLA flags first).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+
+def force_host_devices(n: int = 4) -> None:
+    """Force an n-virtual-device CPU host platform BEFORE jax
+    initializes (the conftest trick — disaggregated / tp drills need
+    2 replicas x 2 chip groups). The caller's flags win if already
+    set."""
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "cpu"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+
+
+def tiny_model_cfg(compute: str = "bfloat16", hidden: int = 64,
+                   num_kv_heads: int = 1, num_heads: int = 2,
+                   sliding_window: Optional[int] = None,
+                   attention_impl: Optional[str] = None):
+    """The chaos tools' shared tiny model: 2 layers, vocab 128,
+    seq 128. `sliding_window` + attention_impl='flash' builds the
+    ROLLING pool flavor for the capability-matrix sweeps."""
+    from megatron_tpu.config import ModelConfig
+    kw = {}
+    if sliding_window is not None:
+        kw["sliding_window"] = int(sliding_window)
+    if attention_impl is not None:
+        kw["attention_impl"] = attention_impl
+    return ModelConfig(num_layers=2, hidden_size=hidden,
+                       num_attention_heads=num_heads,
+                       num_kv_heads=num_kv_heads,
+                       vocab_size=128, seq_length=128,
+                       max_position_embeddings=128,
+                       make_vocab_size_divisible_by=64,
+                       compute_dtype=compute, **kw).derived()
+
+
+def auto_compute_dtype(serving_kwargs: dict) -> str:
+    """bf16 activations (the production numeric path) EXCEPT when the
+    block-native kernel or the LoRA adapter bank is drilled: the
+    drills pin engine outputs token-exact vs a serial oracle, and the
+    kernel's fp32 online softmax / the adapters' factored-vs-MERGED-
+    weights comparison only match the oracle under fp32 activations
+    (bf16 rounds the scores — a flipped greedy token there is
+    numerics, not a bug). Bracketed / whole-region / adapterless arms
+    keep their bf16 coverage."""
+    return ("float32" if serving_kwargs.get("block_native_attn")
+            or serving_kwargs.get("adapter_slots")
+            else "bfloat16")
+
+
+def tiny_generator(cfg, seed: int = 0):
+    """Seeded params + eos_id=-1 Generator (no early EOS, so request
+    lifetimes — and any overload backlog — are deterministic in
+    max_new_tokens)."""
+    import jax
+
+    from megatron_tpu.inference.generation import Generator
+    from megatron_tpu.models import language_model as lm
+    params = lm.model_init(jax.random.PRNGKey(seed), cfg)
+    return Generator(params, cfg, eos_id=-1, pad_id=0)
+
+
+def tiny_engine(serving_kwargs, hidden: int = 64,
+                compute: Optional[str] = None):
+    """(engine, generator) over the shared tiny model; `compute=None`
+    applies the `auto_compute_dtype` rule."""
+    from megatron_tpu.config import ServingConfig
+    from megatron_tpu.serving import ServingEngine
+    cfg = tiny_model_cfg(compute or auto_compute_dtype(serving_kwargs),
+                         hidden=hidden)
+    gen = tiny_generator(cfg)
+    serving = ServingConfig(**serving_kwargs).validate(cfg)
+    return ServingEngine(gen, serving), gen
+
+
+def tiny_router(serving_kwargs, n_replicas: int = 2, hidden: int = 64,
+                heartbeat_s: float = 2.0, probe_backoff_s: float = 0.2,
+                compute: Optional[str] = None, devices_per: int = 0):
+    """(router, engines, generator): N full replicas over ONE tiny
+    model behind an EngineRouter. `devices_per` slices jax.devices()
+    into per-replica windows (disaggregated replicas are a
+    (prefill-group, decode-group) pair)."""
+    from megatron_tpu.config import ServingConfig
+    from megatron_tpu.serving import EngineRouter, ServingEngine
+    cfg = tiny_model_cfg(compute or auto_compute_dtype(serving_kwargs),
+                         hidden=hidden)
+    gen = tiny_generator(cfg)
+    serving = ServingConfig(**serving_kwargs).validate(cfg)
+    if devices_per:
+        import jax
+        devs = jax.devices()
+        engines = [ServingEngine(gen, serving,
+                                 devices=devs[i * devices_per:
+                                              (i + 1) * devices_per])
+                   for i in range(n_replicas)]
+    else:
+        engines = [ServingEngine(gen, serving)
+                   for _ in range(n_replicas)]
+    router = EngineRouter(engines, max_retries=2,
+                          heartbeat_timeout_s=heartbeat_s,
+                          probe_backoff_s=probe_backoff_s)
+    return router, engines, gen
+
+
+def serial_oracle(gen):
+    """Serial ground truth, cached per (prompt, n, seed, sampling):
+    `want(prompt, n, seed=0, sampling=None)` — greedy when sampling is
+    None. The seeded engine contract (serving/engine.py) makes this
+    exact for stochastic seeded requests too (speculative stochastic
+    rows excepted — the drills go greedy there)."""
+    from megatron_tpu.inference.generation import SamplingParams
+    cache = {}
+
+    def want(prompt, n, seed=0, sampling=None):
+        sp = sampling if sampling is not None \
+            else SamplingParams(temperature=0.0)
+        key = (tuple(prompt), n, seed,
+               (sp.temperature, sp.top_k, sp.top_p))
+        if key not in cache:
+            t, lens, _ = gen.generate([list(prompt)], n, sampling=sp,
+                                      seed=seed)
+            cache[key] = t[0, :lens[0]].tolist()
+        return cache[key]
+
+    return want
+
+
+def resolve_all(reqs, timeout: float = 120.0) -> dict:
+    """Resolve every future; classify outcomes. A timeout here IS the
+    stranded-future failure the drills exist to catch."""
+    out = {"ok": 0, "deadline_504": 0, "unavailable_503": 0,
+           "error": 0, "stranded": 0}
+    from megatron_tpu.serving import (DeadlineExceededError,
+                                      ServiceUnavailableError)
+    for r in reqs:
+        try:
+            r.result(timeout=timeout)
+            out["ok"] += 1
+        except DeadlineExceededError:
+            out["deadline_504"] += 1
+        except ServiceUnavailableError:
+            out["unavailable_503"] += 1
+        except TimeoutError:
+            out["stranded"] += 1
+        except Exception:  # noqa: BLE001 — typed-enough: it RESOLVED
+            out["error"] += 1
+    return out
+
+
+def resolve_exact(reqs, want, timeout: float = 120.0):
+    """Resolve every (req, prompt, n) future; count outcomes and pin
+    every COMPLETED request token-exact vs the serial oracle."""
+    out = {"ok": 0, "error": 0, "stranded": 0}
+    exact = True
+    for r, prompt, n in reqs:
+        try:
+            toks, _ = r.result(timeout=timeout)
+            out["ok"] += 1
+            if toks != want(prompt, n):
+                exact = False
+        except TimeoutError:
+            out["stranded"] += 1
+        except Exception:  # noqa: BLE001 — typed-enough: it RESOLVED
+            out["error"] += 1
+    return out, exact
+
+
+def pool_mode(block, kernel) -> dict:
+    """Serving kwargs for the drilled pool layout. Block mode IS the
+    production configuration (docs/serving.md pool-capability matrix),
+    so the default drills run with kv_block_size set — and with the
+    block-native attention kernel where legal — instead of only ever
+    chaos-testing the whole-region layout."""
+    kw = {}
+    if block:
+        kw["kv_block_size"] = int(block)
+        if kernel:
+            kw["block_native_attn"] = True
+    return kw
+
+
+def make_adapters(cfg, n_adapters: int, rank: int = 4) -> dict:
+    """n random nonzero adapters (seeded) -> {adapter_id: factors}."""
+    from megatron_tpu.serving.adapters import random_adapter_factors
+    return {f"tenant-{a}": random_adapter_factors(cfg, rank, 1000 + a)
+            for a in range(n_adapters)}
+
+
+# ---------------------------------------------------------------------
+# checkpoint publish helpers (chaos_upgrade / chaos_mesh live-weight
+# schedules)
+# ---------------------------------------------------------------------
+def mega_cfg(model):
+    from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
+                                     TrainingConfig)
+    return MegatronConfig(
+        model=model, optimizer=OptimizerConfig(lr=1e-3),
+        training=TrainingConfig(micro_batch_size=1, global_batch_size=2,
+                                train_iters=1)).validate(n_devices=1)
+
+
+def publish_checkpoint(root, model, params, iteration):
+    """One manifest-sealed checkpoint publish, as a trainer would."""
+    import jax.numpy as jnp
+
+    from megatron_tpu.training.checkpointing import save_checkpoint
+    from megatron_tpu.training.train_step import TrainState
+    return save_checkpoint(
+        root, TrainState(params=params, opt_state=None,
+                         iteration=jnp.asarray(iteration, jnp.int32)),
+        mega_cfg(model), iteration=iteration)
+
+
+def corrupt_payload(ckpt_dir):
+    """Flip one byte of the largest non-manifest payload file — the
+    torn/bit-rotted publish the manifest gate must refuse."""
+    import glob
+    files = [p for p in glob.glob(os.path.join(ckpt_dir, "**"),
+                                  recursive=True)
+             if os.path.isfile(p)
+             and os.path.basename(p) != "manifest.json"]
+    target = max(files, key=os.path.getsize)
+    with open(target, "r+b") as f:
+        b0 = f.read(1)
+        f.seek(0)
+        f.write(bytes([b0[0] ^ 0xFF]))
+
+
+# ---------------------------------------------------------------------
+# invariant sweep + record emission
+# ---------------------------------------------------------------------
+def invariant_sweep(target, reqs=(), oracles=(), strict: bool = True,
+                    timeout: float = 120.0) -> dict:
+    """Run `serving.invariants.check_all` WITHOUT raising; returns the
+    report (report["ok"] / report["violations"]) so a drill can embed
+    the sweep verdict in its record next to its own assertions."""
+    from megatron_tpu.serving import invariants
+    try:
+        if strict:
+            # the strict sweep reads engine-thread accounting: wait for
+            # the grid to go quiet (resolved futures may lead the last
+            # eviction's bookkeeping by a beat)
+            invariants.wait_quiesced(target, timeout=min(timeout, 30.0))
+        return invariants.check_all(target, requests=reqs,
+                                    oracles=oracles, strict=strict,
+                                    timeout=timeout,
+                                    raise_on_violation=False)
+    except Exception as e:  # noqa: BLE001 — a crashed sweep is a finding
+        return {"ok": False,
+                "violations": [f"[sweep-crash] {type(e).__name__}: {e}"]}
+
+
+def emit_record(record: dict, out: Optional[str], seed=0) -> str:
+    """One-line JSON record on stdout (and to `out`): every chaos tool
+    carries a `seed` field so a CI-logged failure reproduces from the
+    log line alone."""
+    record.setdefault("seed", seed)
+    line = json.dumps(record)
+    print(line, flush=True)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return line

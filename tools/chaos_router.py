@@ -42,16 +42,22 @@ model:
    has < 4 devices (2 replicas x 2 groups); the CPU smoke forces a
    4-virtual-device host platform.
 
+Every drill finishes with a system-wide `invariants.check_all` sweep
+(serving/invariants.py): per-replica request conservation + KV
+accounting + schema, plus the router-level degraded-not-down healthz
+law — on top of each drill's own scenario assertions.
+
 Emits ONE BENCH-style JSON record on stdout (and to --out), like
 chaos_serve.py, so front-door regressions surface in the
-`BENCH_*.json` extras.
+`BENCH_*.json` extras. The scaffolding (tiny router builder, serial
+oracle, outcome resolver) lives in tools/chaos_common.py, shared with
+chaos_serve.py / chaos_upgrade.py / chaos_mesh.py.
 
   JAX_PLATFORMS=cpu python tools/chaos_router.py --smoke [--out FILE]
 """
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import time
@@ -59,73 +65,11 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from megatron_tpu.utils.platform import ensure_env_platform
-
-
-def _tiny_router(serving_kwargs, n_replicas=2, hidden=64,
-                 heartbeat_s=2.0, probe_backoff_s=0.2):
-    import jax
-
-    from megatron_tpu.config import ModelConfig, ServingConfig
-    from megatron_tpu.inference.generation import Generator
-    from megatron_tpu.models import language_model as lm
-    from megatron_tpu.serving import EngineRouter, ServingEngine
-
-    # bf16 activations except under the block-native kernel
-    # (chaos_serve precedent): the drills pin retried completions
-    # token-exact vs a serial oracle, and the kernel's fp32 softmax
-    # only matches the oracle's dot path under matched activation
-    # dtypes — bracketed arms keep the production bf16 coverage
-    compute = ("float32" if serving_kwargs.get("block_native_attn")
-               else "bfloat16")
-    cfg = ModelConfig(num_layers=2, hidden_size=hidden,
-                      num_attention_heads=2, num_kv_heads=1,
-                      vocab_size=128, seq_length=128,
-                      max_position_embeddings=128,
-                      make_vocab_size_divisible_by=64,
-                      compute_dtype=compute).derived()
-    params = lm.model_init(jax.random.PRNGKey(0), cfg)
-    # eos_id=-1: no early EOS, deterministic request lifetimes
-    gen = Generator(params, cfg, eos_id=-1, pad_id=0)
-    serving = ServingConfig(**serving_kwargs).validate(cfg)
-    engines = [ServingEngine(gen, serving) for _ in range(n_replicas)]
-    router = EngineRouter(engines, max_retries=2,
-                          heartbeat_timeout_s=heartbeat_s,
-                          probe_backoff_s=probe_backoff_s)
-    return router, engines, gen
-
-
-def _serial_oracle(gen):
-    """Greedy serial reference, cached per (prompt, n)."""
-    from megatron_tpu.inference.generation import SamplingParams
-    cache = {}
-
-    def want(prompt, n):
-        key = (tuple(prompt), n)
-        if key not in cache:
-            t, lens, _ = gen.generate(
-                [list(prompt)], n, sampling=SamplingParams(temperature=0.0))
-            cache[key] = t[0, :lens[0]].tolist()
-        return cache[key]
-
-    return want
-
-
-def _resolve_exact(reqs, want, timeout=120.0):
-    """Resolve every router future; count outcomes and pin every
-    COMPLETED request token-exact vs the serial oracle."""
-    out = {"ok": 0, "error": 0, "stranded": 0}
-    exact = True
-    for r, prompt, n in reqs:
-        try:
-            toks, _ = r.result(timeout=timeout)
-            out["ok"] += 1
-            if toks != want(prompt, n):
-                exact = False
-        except TimeoutError:
-            out["stranded"] += 1
-        except Exception:  # noqa: BLE001 — typed-enough: it RESOLVED
-            out["error"] += 1
-    return out, exact
+from tools.chaos_common import (emit_record, force_host_devices,
+                                invariant_sweep,
+                                resolve_exact as _resolve_exact,
+                                serial_oracle as _serial_oracle,
+                                tiny_router as _tiny_router)
 
 
 def kill_drill(new_tokens: int) -> dict:
@@ -160,6 +104,7 @@ def kill_drill(new_tokens: int) -> dict:
         post = router.submit([9, 9, 8], 4, sampling, seed=99)
         post_toks, _ = post.result(timeout=60)
         post_exact = post_toks == want([9, 9, 8], 4)
+        inv = invariant_sweep(router, [r for r, _, _ in reqs] + [post])
     finally:
         router.close()
     return {
@@ -170,11 +115,13 @@ def kill_drill(new_tokens: int) -> dict:
         "health_state": health["state"],
         "healthz_ready": bool(health["healthy"]),
         "post_kill_serve_exact": post_exact,
+        "invariants_ok": inv["ok"],
+        "invariant_violations": inv["violations"],
         "ok": (outcomes["stranded"] == 0 and outcomes["error"] == 0
                and outcomes["ok"] == len(reqs) and exact
                and int(snap["router_failovers"]) >= 1
                and health["state"] == "degraded" and health["healthy"]
-               and post_exact),
+               and post_exact and inv["ok"]),
     }
 
 
@@ -228,6 +175,7 @@ def wedge_drill(new_tokens: int, timeout_s: float,
                 pass
             time.sleep(0.05)
         health = router.health()
+        inv = invariant_sweep(router, [r for r, _, _ in reqs])
     finally:
         router.close()
     return {
@@ -239,8 +187,10 @@ def wedge_drill(new_tokens: int, timeout_s: float,
         "wedged_fired": bool(fired),
         "recovered_both_up": recovered,
         "health_state": health["state"],
+        "invariants_ok": inv["ok"],
+        "invariant_violations": inv["violations"],
         "ok": (outcomes["stranded"] == 0 and outcomes["error"] == 0
-               and exact and bool(fired) and recovered),
+               and exact and bool(fired) and recovered and inv["ok"]),
     }
 
 
@@ -286,6 +236,7 @@ def host_tier_drill(new_tokens: int) -> dict:
                               seed=2).result(60)
         exact2 = t2 == want(p2, new_tokens)
         snap2 = router.aggregate_snapshot()
+        inv = invariant_sweep(router)
     finally:
         router.close()
     return {
@@ -297,43 +248,25 @@ def host_tier_drill(new_tokens: int) -> dict:
             int(snap2["host_tier_checksum_misses"]),
         "clean_restore_exact": exact1,
         "corrupt_restore_exact": exact2,
+        "invariants_ok": inv["ok"],
+        "invariant_violations": inv["violations"],
         "ok": (demoted and affinity >= 16
                and int(snap1["host_tier_hits"]) >= 1 and exact1
                and int(snap2["host_tier_checksum_misses"]) >= 1
-               and exact2),
+               and exact2 and inv["ok"]),
     }
 
 
 def _tiny_disagg_router(new_tokens: int):
     """2-replica router over DISAGGREGATED engines: 4 devices, each
-    replica a (prefill-group, decode-group) pair."""
-    import jax
-
-    from megatron_tpu.config import ModelConfig, ServingConfig
-    from megatron_tpu.inference.generation import Generator
-    from megatron_tpu.models import language_model as lm
-    from megatron_tpu.serving import EngineRouter, ServingEngine
-
-    cfg = ModelConfig(num_layers=2, hidden_size=64,
-                      num_attention_heads=2, num_kv_heads=1,
-                      vocab_size=128, seq_length=128,
-                      max_position_embeddings=128,
-                      make_vocab_size_divisible_by=64,
-                      compute_dtype="bfloat16").derived()
-    params = lm.model_init(jax.random.PRNGKey(0), cfg)
-    gen = Generator(params, cfg, eos_id=-1, pad_id=0)
-    serving = ServingConfig(
-        num_slots=2, max_queue=64, max_len=128, kv_block_size=16,
-        disaggregate_prefill=True,
-        # a dead half keeps raising: one restart then the breaker —
-        # the replica must go hard-down fast so the router ejects it
-        max_engine_restarts=1).validate(cfg)
-    devs = jax.devices()
-    engines = [ServingEngine(gen, serving, devices=devs[i * 2:i * 2 + 2])
-               for i in range(2)]
-    router = EngineRouter(engines, max_retries=2,
-                          heartbeat_timeout_s=2.0, probe_backoff_s=30.0)
-    return router, engines, gen
+    replica a (prefill-group, decode-group) pair. A dead half keeps
+    raising: one restart then the breaker — the replica must go
+    hard-down fast so the router ejects it (max_engine_restarts=1)."""
+    return _tiny_router(
+        dict(num_slots=2, max_queue=64, max_len=128, kv_block_size=16,
+             disaggregate_prefill=True, max_engine_restarts=1),
+        heartbeat_s=2.0, probe_backoff_s=30.0, compute="bfloat16",
+        devices_per=2)
 
 
 def kill_half_drill(new_tokens: int, half: str) -> dict:
@@ -377,6 +310,7 @@ def kill_half_drill(new_tokens: int, half: str) -> dict:
         post_toks, _ = post.result(timeout=60)
         post_exact = post_toks == want([9, 9, 8], 4)
         snap_post = router.aggregate_snapshot()
+        inv = invariant_sweep(router, [r for r, _, _ in reqs] + [post])
     finally:
         router.close()
     return {
@@ -389,11 +323,14 @@ def kill_half_drill(new_tokens: int, half: str) -> dict:
         "healthz_ready": bool(health["healthy"]),
         "post_kill_serve_exact": post_exact,
         "survivor_handoffs": int(snap_post["handoffs"]),
+        "invariants_ok": inv["ok"],
+        "invariant_violations": inv["violations"],
         "ok": (outcomes["stranded"] == 0 and outcomes["error"] == 0
                and outcomes["ok"] == len(reqs) and exact
                and int(snap["router_failovers"]) >= 1
                and health["state"] == "degraded" and health["healthy"]
-               and post_exact and int(snap_post["handoffs"]) >= 1),
+               and post_exact and int(snap_post["handoffs"]) >= 1
+               and inv["ok"]),
     }
 
 
@@ -438,25 +375,14 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     # the disaggregated kill-half drills need 4 devices (2 replicas x
-    # 2 chip groups); on the CPU backend force a 4-virtual-device host
-    # platform BEFORE jax initializes (the same conftest trick — the
-    # caller's flags win if already set)
-    if "cpu" in os.environ.get("JAX_PLATFORMS", "cpu"):
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=4"
-            ).strip()
+    # 2 chip groups)
+    force_host_devices(4)
     ensure_env_platform()
     if args.smoke:
         args.new_tokens, args.watchdog_s, args.stall_s = 12, 1.0, 2.5
 
     record = run_chaos(args.new_tokens, args.watchdog_s, args.stall_s)
-    line = json.dumps(record)
-    print(line, flush=True)
-    if args.out:
-        with open(args.out, "w") as f:
-            f.write(line + "\n")
+    emit_record(record, args.out, seed=0)  # scripted: fixed workload
     return 0 if record["completed"] else 1
 
 

@@ -18,16 +18,22 @@ the engine's three survival contracts end-to-end:
    resolves 503, `health()` reports unhealthy, and new submits raise
    EngineUnhealthyError.
 
+Every drill finishes with a system-wide `invariants.check_all` sweep
+(serving/invariants.py): the drill's own assertions pin its scenario,
+the sweep pins the laws that must hold under ANY scenario (request
+conservation, typed terminals, KV accounting, schema, healthz).
+
 Emits ONE BENCH-style JSON record on stdout (and to --out), like
 chaos_train.py, so hang-recovery regressions surface in the
-`BENCH_*.json` extras.
+`BENCH_*.json` extras. The scaffolding (tiny engine builders, serial
+oracles, outcome resolvers) lives in tools/chaos_common.py, shared
+with chaos_router.py / chaos_upgrade.py / chaos_mesh.py.
 
   JAX_PLATFORMS=cpu python tools/chaos_serve.py --smoke [--out FILE]
 """
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import time
@@ -35,82 +41,11 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from megatron_tpu.utils.platform import ensure_env_platform
-
-
-def _pool_mode(block, kernel) -> dict:
-    """Serving kwargs for the drilled pool layout. Block mode IS the
-    production configuration (docs/serving.md pool-capability matrix),
-    so the default drills run with kv_block_size set — and with the
-    block-native attention kernel where legal — instead of only ever
-    chaos-testing the whole-region layout."""
-    kw = {}
-    if block:
-        kw["kv_block_size"] = int(block)
-        if kernel:
-            kw["block_native_attn"] = True
-    return kw
-
-
-def _tiny_engine(serving_kwargs, hidden=64):
-    import jax
-
-    from megatron_tpu.config import ModelConfig, ServingConfig
-    from megatron_tpu.inference.generation import Generator
-    from megatron_tpu.models import language_model as lm
-    from megatron_tpu.serving import ServingEngine
-
-    # bf16 activations (the production numeric path) EXCEPT when the
-    # block-native kernel or the LoRA adapter bank is drilled: the
-    # drills pin engine outputs token-exact vs the serial oracle, and
-    # the kernel's fp32 online softmax / the adapters' factored-vs-
-    # MERGED-weights comparison only match the oracle under fp32
-    # activations (bf16 rounds the scores — a flipped greedy token
-    # there is numerics, not a bug). Bracketed / whole-region /
-    # adapterless arms keep their bf16 coverage.
-    compute = ("float32" if serving_kwargs.get("block_native_attn")
-               or serving_kwargs.get("adapter_slots")
-               else "bfloat16")
-    cfg = ModelConfig(num_layers=2, hidden_size=hidden,
-                      num_attention_heads=2, num_kv_heads=1,
-                      vocab_size=128, seq_length=128,
-                      max_position_embeddings=128,
-                      make_vocab_size_divisible_by=64,
-                      compute_dtype=compute).derived()
-    params = lm.model_init(jax.random.PRNGKey(0), cfg)
-    # eos_id=-1: no early EOS, so request lifetimes (and the overload
-    # backlog) are deterministic in max_new_tokens
-    gen = Generator(params, cfg, eos_id=-1, pad_id=0)
-    serving = ServingConfig(**serving_kwargs).validate(cfg)
-    return ServingEngine(gen, serving), gen
-
-
-def _resolve_all(reqs, timeout=120.0):
-    """Resolve every future; classify outcomes. A timeout here IS the
-    stranded-future failure the drill exists to catch."""
-    out = {"ok": 0, "deadline_504": 0, "unavailable_503": 0,
-           "error": 0, "stranded": 0}
-    from megatron_tpu.serving import (DeadlineExceededError,
-                                      ServiceUnavailableError)
-    for r in reqs:
-        try:
-            r.result(timeout=timeout)
-            out["ok"] += 1
-        except DeadlineExceededError:
-            out["deadline_504"] += 1
-        except ServiceUnavailableError:
-            out["unavailable_503"] += 1
-        except TimeoutError:
-            out["stranded"] += 1
-        except Exception:  # noqa: BLE001 — typed-enough: it RESOLVED
-            out["error"] += 1
-    return out
-
-
-def _make_adapters(cfg, n_adapters: int, rank: int = 4):
-    """n random nonzero adapters (seeded) -> {adapter_id: factors}."""
-    from megatron_tpu.serving.adapters import random_adapter_factors
-    return {f"tenant-{a}": random_adapter_factors(cfg, rank, 1000 + a)
-            for a in range(n_adapters)}
+from tools.chaos_common import (emit_record, invariant_sweep,
+                                make_adapters as _make_adapters,
+                                pool_mode as _pool_mode,
+                                resolve_all as _resolve_all,
+                                tiny_engine as _tiny_engine)
 
 
 def overload_drill(new_tokens: int, spec_k: int = 0,
@@ -228,6 +163,10 @@ def overload_drill(new_tokens: int, spec_k: int = 0,
                 adapter_checked += 1
             if r.prompt + r.generated != serial_cache[key]:
                 exact = False
+        # system-wide law sweep (serving/invariants.py): conservation,
+        # typed terminals, KV accounting, schema, healthz — on top of
+        # the drill's own scenario assertions
+        inv = invariant_sweep(eng, [r for r, _, _, _ in reqs])
     finally:
         eng.close()
     fired = {k: sum(1 for f, _ in injector.fired if f == k)
@@ -248,6 +187,8 @@ def overload_drill(new_tokens: int, spec_k: int = 0,
         "completed_token_exact": exact,
         "completed_checked": checked,
         "healthy_after": bool(health["healthy"]),
+        "invariants_ok": inv["ok"],
+        "invariant_violations": inv["violations"],
         "ok": (outcomes["stranded"] == 0
                and shed + int(snap["requests_shed"]) >= 1
                and int(snap["preemptions"]) >= 1
@@ -258,7 +199,7 @@ def overload_drill(new_tokens: int, spec_k: int = 0,
                and (n_adapters == 0
                     or (int(snap["adapter_loads"]) >= 1
                         and adapter_checked >= 1))
-               and health["healthy"]),
+               and health["healthy"] and inv["ok"]),
     }
 
 
@@ -307,6 +248,7 @@ def hang_drill(timeout_s: float, stall_s: float, spec_k: int = 0,
         probe_exact = probe_toks == t[0, :lens[0]].tolist()
         health = eng.health()
         snap = eng.metrics.snapshot()
+        inv = invariant_sweep(eng, [victim, probe])
     finally:
         eng.close()
     return {
@@ -318,7 +260,10 @@ def hang_drill(timeout_s: float, stall_s: float, spec_k: int = 0,
         "speculative_k": spec_k,
         "probe_token_exact": probe_exact,
         "healthy_after": bool(health["healthy"]),
-        "ok": (victim_failed and int(snap["engine_restarts"]) >= 1
+        "invariants_ok": inv["ok"],
+        "invariant_violations": inv["violations"],
+        "ok": (victim_failed and inv["ok"]
+               and int(snap["engine_restarts"]) >= 1
                # the victim must fail by watchdog detection (deadline +
                # poll slack), i.e. strictly before the stalled dispatch
                # itself would have returned and failed it anyway
@@ -358,6 +303,9 @@ def crash_loop_drill(spec_k: int = 0, pool_kwargs=None) -> dict:
             submit_rejected_503 = False
         except EngineUnhealthyError:
             submit_rejected_503 = True
+        # the laws hold on a BROKEN engine too: every request terminal
+        # exactly once, healthz consistently unhealthy, schema stable
+        inv = invariant_sweep(eng, reqs)
     finally:
         eng.close()
     return {
@@ -366,11 +314,13 @@ def crash_loop_drill(spec_k: int = 0, pool_kwargs=None) -> dict:
         "breaker_open": bool(health["circuit_breaker_open"]),
         "state": health["state"],
         "submit_rejected_503": submit_rejected_503,
+        "invariants_ok": inv["ok"],
+        "invariant_violations": inv["violations"],
         "ok": (outcomes["stranded"] == 0 and outcomes["ok"] == 0
                and int(snap["engine_restarts"]) == 1
                and health["circuit_breaker_open"]
                and not health["healthy"]
-               and submit_rejected_503),
+               and submit_rejected_503 and inv["ok"]),
     }
 
 
@@ -449,11 +399,7 @@ def main(argv=None) -> int:
     record = run_chaos(args.new_tokens, args.watchdog_s, args.stall_s,
                        args.speculative_k, args.kv_block_size,
                        not args.no_block_native, args.adapters)
-    line = json.dumps(record)
-    print(line, flush=True)
-    if args.out:
-        with open(args.out, "w") as f:
-            f.write(line + "\n")
+    emit_record(record, args.out, seed=0)  # scripted: fixed workload
     return 0 if record["completed"] else 1
 
 

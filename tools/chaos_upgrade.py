@@ -30,16 +30,23 @@ manifest-sealed checkpoints on disk:
    split would corrupt tokens, not just flip versions) — and the
    survivors keep handing off throughout.
 
+Every drill finishes with a system-wide `invariants.check_all` sweep
+(serving/invariants.py) — the conservation / typed-terminal / KV /
+schema / healthz laws hold through every refused swap and aborted
+rollout, on top of the drills' own version-exactness assertions.
+
 Emits ONE BENCH-style JSON record on stdout (and to --out), like
 chaos_router.py, so live-weight regressions surface in the
-`BENCH_*.json` extras.
+`BENCH_*.json` extras. The scaffolding (tiny model/fleet builders,
+checkpoint publish helpers, serial oracle) lives in
+tools/chaos_common.py, shared with chaos_serve.py / chaos_router.py /
+chaos_mesh.py.
 
   JAX_PLATFORMS=cpu python tools/chaos_upgrade.py --smoke [--out FILE]
 """
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import tempfile
@@ -49,50 +56,16 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from megatron_tpu.utils.platform import ensure_env_platform
+from tools.chaos_common import (corrupt_payload as _corrupt_payload,
+                                emit_record, force_host_devices,
+                                invariant_sweep,
+                                publish_checkpoint as _publish,
+                                serial_oracle as _serial_oracle,
+                                tiny_generator, tiny_model_cfg)
 
 
 def _model_cfg():
-    from megatron_tpu.config import ModelConfig
-    return ModelConfig(num_layers=2, hidden_size=64,
-                       num_attention_heads=2, num_kv_heads=1,
-                       vocab_size=128, seq_length=128,
-                       max_position_embeddings=128,
-                       make_vocab_size_divisible_by=64,
-                       compute_dtype="float32").derived()
-
-
-def _mega_cfg(model):
-    from megatron_tpu.config import (MegatronConfig, OptimizerConfig,
-                                     TrainingConfig)
-    return MegatronConfig(
-        model=model, optimizer=OptimizerConfig(lr=1e-3),
-        training=TrainingConfig(micro_batch_size=1, global_batch_size=2,
-                                train_iters=1)).validate(n_devices=1)
-
-
-def _publish(root, model, params, iteration):
-    """One manifest-sealed checkpoint publish, as a trainer would."""
-    import jax.numpy as jnp
-
-    from megatron_tpu.training.checkpointing import save_checkpoint
-    from megatron_tpu.training.train_step import TrainState
-    return save_checkpoint(
-        root, TrainState(params=params, opt_state=None,
-                         iteration=jnp.asarray(iteration, jnp.int32)),
-        _mega_cfg(model), iteration=iteration)
-
-
-def _corrupt_payload(ckpt_dir):
-    import glob
-    files = [p for p in glob.glob(os.path.join(ckpt_dir, "**"),
-                                  recursive=True)
-             if os.path.isfile(p)
-             and os.path.basename(p) != "manifest.json"]
-    target = max(files, key=os.path.getsize)
-    with open(target, "r+b") as f:
-        b0 = f.read(1)
-        f.seek(0)
-        f.write(bytes([b0[0] ^ 0xFF]))
+    return tiny_model_cfg(compute="float32")
 
 
 def _versioned_fleet(serving_kwargs, n_replicas=2, devices_per=None):
@@ -101,18 +74,14 @@ def _versioned_fleet(serving_kwargs, n_replicas=2, devices_per=None):
     import jax
 
     from megatron_tpu.config import ServingConfig
-    from megatron_tpu.inference.generation import Generator
-    from megatron_tpu.models import language_model as lm
     from megatron_tpu.serving import EngineRouter, ServingEngine
 
     model = _model_cfg()
-    p1 = lm.model_init(jax.random.PRNGKey(0), model)
-    p2 = lm.model_init(jax.random.PRNGKey(1), model)
-    root = tempfile.mkdtemp(prefix="chaos_upgrade_")
-    d2 = _publish(root, model, p2, 2)
     # eos_id=-1: no early EOS, deterministic request lifetimes
-    gen1 = Generator(p1, model, eos_id=-1, pad_id=0)
-    gen2 = Generator(p2, model, eos_id=-1, pad_id=0)
+    gen1 = tiny_generator(model, seed=0)
+    gen2 = tiny_generator(model, seed=1)
+    root = tempfile.mkdtemp(prefix="chaos_upgrade_")
+    d2 = _publish(root, model, gen2.params, 2)
     serving = ServingConfig(**serving_kwargs).validate(model)
     if devices_per:
         devs = jax.devices()
@@ -126,22 +95,6 @@ def _versioned_fleet(serving_kwargs, n_replicas=2, devices_per=None):
     router = EngineRouter(engines, max_retries=2,
                           heartbeat_timeout_s=3.0, probe_backoff_s=0.2)
     return router, engines, gen1, gen2, root, d2
-
-
-def _serial_oracle(gen):
-    from megatron_tpu.inference.generation import SamplingParams
-    cache = {}
-
-    def want(prompt, n, seed):
-        key = (tuple(prompt), n, seed)
-        if key not in cache:
-            t, lens, _ = gen.generate(
-                [list(prompt)], n,
-                sampling=SamplingParams(temperature=0.0), seed=seed)
-            cache[key] = t[0, :lens[0]].tolist()
-        return cache[key]
-
-    return want
 
 
 def _load_workers(router, new_tokens, n_workers=3):
@@ -249,6 +202,7 @@ def kill_draining_drill(new_tokens: int) -> dict:
         post = router.submit([9, 9, 8], 4, sampling, seed=99)
         post_toks, _ = post.result(timeout=60)
         post_exact = post_toks == want1([9, 9, 8], 4, 99)
+        inv = invariant_sweep(router, [post])
     finally:
         router.close()
     return {
@@ -260,10 +214,12 @@ def kill_draining_drill(new_tokens: int) -> dict:
         "healthz_ready": bool(health["healthy"]),
         "weight_swap_failures": int(snap["weight_swap_failures"]),
         "post_kill_serve_exact": post_exact,
+        "invariants_ok": inv["ok"],
+        "invariant_violations": inv["violations"],
         "ok": (not errors and not bad and len(aborted) == 1
                and health["state"] == "degraded" and health["healthy"]
                and post_exact and (v1 + v2) == len(results)
-               and (v1 + v2) >= 4),
+               and (v1 + v2) >= 4 and inv["ok"]),
     }
 
 
@@ -314,6 +270,7 @@ def corrupt_watch_drill(new_tokens: int) -> dict:
         v4_serving = (snap3["weight_version_min"] == 4.0
                       == snap3["weight_version_max"])
         health = router.health()
+        inv = invariant_sweep(router)
     finally:
         router.close()
     return {
@@ -326,11 +283,13 @@ def corrupt_watch_drill(new_tokens: int) -> dict:
         "next_publish_applied": bool(recovered),
         "fleet_on_v4": v4_serving,
         "health_state": health["state"],
+        "invariants_ok": inv["ok"],
+        "invariant_violations": inv["violations"],
         "ok": (applied and v2_serving and exact_v2 and refused
                and re_polled and failures_2 == 1 and stayed
                and int(snap2["weight_swap_failures"]) >= 1
                and recovered and v4_serving
-               and health["state"] == "running"),
+               and health["state"] == "running" and inv["ok"]),
     }
 
 
@@ -371,6 +330,7 @@ def disagg_race_drill(new_tokens: int) -> dict:
         post_toks, _ = post.result(timeout=60)
         post_exact = post_toks == want2([9, 9, 8], 4, 99)
         snap_post = router.aggregate_snapshot()
+        inv = invariant_sweep(router, [post])
     finally:
         router.close()
     return {
@@ -382,11 +342,14 @@ def disagg_race_drill(new_tokens: int) -> dict:
         "health_state": health["state"],
         "handoffs": int(snap_post["handoffs"]),
         "post_upgrade_serve_exact": post_exact,
+        "invariants_ok": inv["ok"],
+        "invariant_violations": inv["violations"],
         "ok": (not errors and not bad and (v1 + v2) == len(results)
                and (v1 + v2) >= 4 and v2 >= 1
                and int(snap["rolling_upgrades"]) == 1
                and health["state"] == "running" and post_exact
-               and int(snap_post["handoffs"]) > pre_handoffs),
+               and int(snap_post["handoffs"]) > pre_handoffs
+               and inv["ok"]),
     }
 
 
@@ -423,25 +386,14 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     # the disaggregated race drill needs 4 devices (2 replicas x 2 chip
-    # groups); on the CPU backend force a 4-virtual-device host
-    # platform BEFORE jax initializes (chaos_router precedent — the
-    # caller's flags win if already set)
-    if "cpu" in os.environ.get("JAX_PLATFORMS", "cpu"):
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=4"
-            ).strip()
+    # groups)
+    force_host_devices(4)
     ensure_env_platform()
     if args.smoke:
         args.new_tokens = 8
 
     record = run_chaos(args.new_tokens)
-    line = json.dumps(record)
-    print(line, flush=True)
-    if args.out:
-        with open(args.out, "w") as f:
-            f.write(line + "\n")
+    emit_record(record, args.out, seed=0)  # scripted: fixed workload
     return 0 if record["completed"] else 1
 
 
