@@ -382,6 +382,14 @@ def _run_extras():
         # inter-token-p99 split and the tp=2 decode tok/s ratio
         ("bench_disagg.py", ["--smoke"],
          "/tmp/bench_extras_disagg.log"),
+        # structured-output + n-best A/B (PERF_NOTES serving section):
+        # constrained-vs-free decode (mask uploads ONLY on FSM state
+        # change, outputs assert-parsed) and n=1x4-vs-n=4 COW fan-out
+        # (one real prefill, samples token-exact vs serial twins) on
+        # ONE compiled decode step; ON CHIP the record is the
+        # constrained overhead ratio + the fan-out prefill reduction
+        ("bench_structured.py", ["--smoke"],
+         "/tmp/bench_extras_structured.log"),
         # resilience smoke: scripted chaos run (transient write fault +
         # NaN-streak rollback + corrupt-checkpoint fallback) — the
         # recovery-latency record makes regressions in the resilience

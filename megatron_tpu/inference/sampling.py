@@ -76,7 +76,8 @@ def _top_p_filter_rows(logits, p):
 
 
 def sample_batched(rngs, logits, *, temperature, top_k, top_p,
-                   vocab_size: int | None = None, banned=None):
+                   vocab_size: int | None = None, banned=None,
+                   mask=None):
     """One sampling step with PER-ROW keys and sampling params — the
     continuous-batching engine's path (serving/engine.py), where one
     compiled decode step serves slots carrying different requests.
@@ -100,7 +101,21 @@ def sample_batched(rngs, logits, *, temperature, top_k, top_p,
     rows ignore the ban — a greedy rejection already implies
     banned != argmax, so the residual of the argmax point mass IS the
     unchanged argmax. Rows with banned < 0 are bit-identical to the
-    banned=None call (the categorical consumes the same key bits)."""
+    banned=None call (the categorical consumes the same key bits).
+
+    `mask` (bool [b, vocab], True = allowed): the SET generalization
+    of `banned` — grammar-constrained decoding's per-slot legal-token
+    bitmask (serving/structured.py). Applied at the same post-filter
+    seam and composing with `banned` (an accepted residual carry must
+    also be grammar-legal). Unlike `banned`, greedy rows OBEY the
+    mask: the constrained greedy answer is the argmax over legal
+    tokens, not the unconstrained argmax. A row whose mask admits NO
+    candidate returns the sentinel -1 (for greedy AND stochastic rows)
+    instead of sampling from a renormalized-empty distribution — the
+    engine fails that request typed (GrammarDeadEndError -> 422). An
+    all-True mask row is bit-identical to mask=None (the masking
+    `where` is the identity and the categorical consumes the same key
+    bits), so free rows ride the same trace unchanged."""
     logits = logits.astype(jnp.float32)
     if vocab_size is not None and vocab_size < logits.shape[-1]:
         iota = jnp.arange(logits.shape[-1])
@@ -114,13 +129,21 @@ def sample_batched(rngs, logits, *, temperature, top_k, top_p,
         iota = jnp.arange(x.shape[-1])
         x = jnp.where((banned >= 0)[:, None]
                       & (iota[None, :] == banned[:, None]), -jnp.inf, x)
+    if mask is not None:
+        greedy = jnp.argmax(jnp.where(mask, logits, -jnp.inf),
+                            axis=-1).astype(jnp.int32)
+        x = jnp.where(mask, x, -jnp.inf)
     sampled = jax.vmap(
         lambda r, row: jax.random.categorical(r, row, axis=-1))(rngs, x)
-    return jnp.where(greedy_rows, greedy, sampled).astype(jnp.int32)
+    out = jnp.where(greedy_rows, greedy, sampled).astype(jnp.int32)
+    if mask is not None:
+        dead = ~jnp.any(mask, axis=-1)
+        out = jnp.where(dead, jnp.int32(-1), out)
+    return out
 
 
 def verify_draft_probs(logits, drafts, *, temperature, top_k, top_p,
-                       vocab_size: int | None = None):
+                       vocab_size: int | None = None, mask=None):
     """Per-(row, position) acceptance inputs for speculative decoding.
 
     logits: [b, w, vocab] — the verify forward's outputs, position j
@@ -136,17 +159,36 @@ def verify_draft_probs(logits, drafts, *, temperature, top_k, top_p,
     `greedy_targets` is the plain argmax (greedy rows accept by exact
     match). The [b, w] grid folds to [b*w] rows with each row's knobs
     repeated, so the filters are bit-identical to a serial
-    one-position-at-a-time verify of the same logits."""
+    one-position-at-a-time verify of the same logits.
+
+    `mask` (bool [b, w, vocab], True = allowed): grammar-constrained
+    rows' per-POSITION legal-token masks (the host steps the FSM along
+    the draft chain — serving/structured.py). Masked positions accept
+    against the masked renormalized distribution: an illegal draft's
+    processed probability is exactly 0 (never accepted, since the
+    acceptance uniform lives in [0, 1)), and greedy targets become
+    the masked argmax (-1 on a dead position, which never equals a
+    real draft). All-True positions are bit-identical to mask=None —
+    free rows share the trace unchanged."""
     b, w, V = logits.shape
     x = logits.astype(jnp.float32).reshape(b * w, V)
     if vocab_size is not None and vocab_size < V:
         iota = jnp.arange(V)
         x = jnp.where(iota < vocab_size, x, -jnp.inf)
-    greedy_targets = jnp.argmax(x, axis=-1).astype(jnp.int32)
+    if mask is not None:
+        m = mask.reshape(b * w, V)
+        greedy_targets = jnp.argmax(jnp.where(m, x, -jnp.inf),
+                                    axis=-1).astype(jnp.int32)
+        greedy_targets = jnp.where(jnp.any(m, axis=-1), greedy_targets,
+                                   jnp.int32(-1))
+    else:
+        greedy_targets = jnp.argmax(x, axis=-1).astype(jnp.int32)
     temp = jnp.repeat(temperature, w)
     x = x / jnp.maximum(temp, 1e-6)[:, None]
     x = _top_k_filter_rows(x, jnp.repeat(top_k, w))
     x = _top_p_filter_rows(x, jnp.repeat(top_p, w))
+    if mask is not None:
+        x = jnp.where(mask.reshape(b * w, V), x, -jnp.inf)
     p = jax.nn.softmax(x, axis=-1)
     probs = jnp.take_along_axis(
         p, drafts.reshape(b * w, 1).astype(jnp.int32), axis=-1)[:, 0]

@@ -138,6 +138,34 @@ def validate_generate_payload(payload) -> Optional[str]:
     if aid is not None and payload.get("beam_width"):
         return "beam search runs the serial path; adapters require " \
                "the serving engine"
+    rf = payload.get("response_format")
+    if rf is not None:
+        # structured output (docs/serving.md "Structured output &
+        # n-best"): shape-validate HERE so a malformed grammar 400s
+        # identically on both transports; whether the pattern COMPILES
+        # is the engine's admission check (also a 400)
+        from megatron_tpu.serving.structured import \
+            validate_response_format
+        msg = validate_response_format(rf)
+        if msg is not None:
+            return f"response_format: {msg}"
+    for field in ("n", "best_of"):
+        v = payload.get(field)
+        if v is None:
+            continue
+        # bool is an int subclass; `"n": true` must not mean 1
+        if isinstance(v, bool) or not isinstance(v, int):
+            return f"{field} must be an integer"
+        if v < 1:
+            return f"{field} must be >= 1"
+    n_samples = payload.get("n")
+    best_of = payload.get("best_of")
+    if n_samples is not None and best_of is not None \
+            and n_samples > best_of:
+        return f"n ({n_samples}) must be <= best_of ({best_of})"
+    if (best_of or n_samples or 1) > 1 and payload.get("beam_width"):
+        return "beam search does not compose with n/best_of parallel " \
+               "sampling"
     return None
 
 
@@ -317,6 +345,7 @@ class MegatronServer:
         from megatron_tpu.serving import (AdmissionError,
                                           DeadlineExceededError,
                                           EngineUnhealthyError,
+                                          GrammarDeadEndError,
                                           QueueFullError,
                                           ServiceUnavailableError)
         try:
@@ -346,6 +375,15 @@ class MegatronServer:
                              "adapter_id requires the serving-engine "
                              "path (drop 'serial': true / "
                              "serial_fallback)"}
+            if payload.get("response_format") is not None or \
+                    (payload.get("best_of") or payload.get("n") or 1) > 1:
+                # same reasoning: the serial path has no FSM masking
+                # and no slot grid to fan out on — unconstrained /
+                # single-sample output would be wrong, not degraded
+                return 400, {"message":
+                             "response_format and n/best_of require "
+                             "the serving-engine path (drop 'serial': "
+                             "true / serial_fallback)"}
             return 200, self._handle_serial(payload)
         except EngineUnhealthyError as e:
             # crash-loop circuit breaker open: this replica cannot
@@ -371,6 +409,14 @@ class MegatronServer:
             # ValueError from inside the model stack stays a 500 (it is
             # a server fault, not a fixable request)
             return 400, {"message": str(e)}
+        except GrammarDeadEndError as e:
+            # constrained generation reached a state with NO legal
+            # token: the request was well-formed (not a 400) and the
+            # server is healthy (not a 500) — the generation itself is
+            # unprocessable, which is exactly what 422 means. Not
+            # retryable as-is: the same grammar + budget + seed dead-
+            # ends again; the client should loosen one of them.
+            return 422, {"message": str(e)}
         except Exception as e:  # noqa: BLE001 — 500 with message, both paths
             return 500, {"message": str(e)}
 
@@ -517,7 +563,15 @@ class MegatronServer:
         multi-prompt payload uses seed+i (a single seeded prompt
         reproduces the serial path token-for-token; multi-prompt
         payloads sample independently per row instead of sharing the
-        serial path's one batch-wide key)."""
+        serial path's one batch-wide key).
+
+        With `n`/`best_of` each prompt fans out into best_of
+        independently seeded samples (seed+i, seed+i+1, ... would
+        collide across prompts, so prompt i's fan-out seeds from
+        seed + i*best_of) and the response's text/segments/logprobs
+        entries for that prompt become LISTS of the n best
+        completions. `response_format` rides through to the engine's
+        grammar-constrained decoding (docs/serving.md)."""
         from megatron_tpu.serving import (OverloadShedError,
                                           QueueFullError, SamplingOptions)
         n = int(payload.get("tokens_to_generate", 64))
@@ -527,6 +581,10 @@ class MegatronServer:
             top_p=float(payload.get("top_p", 0.0)))
         want_lp = bool(payload.get("logprobs", False))
         seed = self._seed_for(payload)
+        rf = payload.get("response_format")
+        n_samples = int(payload.get("n", 1) or 1)
+        best_of = int(payload.get("best_of", n_samples) or n_samples)
+        fanout = best_of > 1
         # SLO fields: priority orders admission (and may preempt, with
         # ServingConfig.preemption); deadline_s overrides the engine
         # default for THIS request (validated numeric above)
@@ -553,9 +611,12 @@ class MegatronServer:
                 while True:
                     try:
                         reqs[i] = self.engine.submit(
-                            ids, n, sampling, seed=seed + i,
+                            ids, n, sampling,
+                            seed=seed + i * best_of,
                             priority=priority, deadline_s=deadline_s,
-                            adapter_id=payload.get("adapter_id"))
+                            adapter_id=payload.get("adapter_id"),
+                            response_format=rf, n=n_samples,
+                            best_of=best_of)
                         pending.append(i)
                         break
                     except OverloadShedError:
@@ -598,6 +659,17 @@ class MegatronServer:
             raise
         texts, tokens, logprobs = [], [], []
         for i in range(len(prompt_ids)):
+            plen = len(reqs[i].prompt)
+            if fanout:
+                # FanoutRequest.result(): the n best samples, each a
+                # (tokens, logprobs) pair — per-prompt entries become
+                # lists of n completions
+                toks_list, lps_list = results[i]
+                texts.append([self.tokenizer.detokenize(t)
+                              for t in toks_list])
+                tokens.append(toks_list)
+                logprobs.append([[0.0] * plen + lp for lp in lps_list])
+                continue
             toks, gen_lps = results[i]
             texts.append(self.tokenizer.detokenize(toks))
             tokens.append(toks)
@@ -605,7 +677,7 @@ class MegatronServer:
             # positions are zero (the serial path fills some in-prompt
             # positions with scoring values — an artifact of its
             # bucketed prefill, not part of the contract)
-            logprobs.append([0.0] * len(reqs[i].prompt) + gen_lps)
+            logprobs.append([0.0] * plen + gen_lps)
         out = {"text": texts, "segments": tokens}
         if want_lp:
             out["logprobs"] = logprobs
@@ -701,6 +773,10 @@ class MegatronServer:
                 return 404, {"message": f"unknown or expired stream_id "
                                         f"{sid!r}; start a new stream"}
             self._count_metric("stream_reconnects")
+            if getattr(entry.req, "children", None):
+                return 200, self._stream_events_fanout(entry,
+                                                       start=last + 1,
+                                                       resumed=True)
             return 200, self._stream_events(entry, start=last + 1,
                                             resumed=True)
         err = validate_generate_payload(payload)
@@ -712,6 +788,16 @@ class MegatronServer:
         if len(payload["prompts"]) != 1:
             return 400, {"message": "streaming supports exactly one "
                                     "prompt per request"}
+        n_samples = int(payload.get("n", 1) or 1)
+        best_of = int(payload.get("best_of", n_samples) or n_samples)
+        if best_of > 1 and n_samples != best_of:
+            # n-best selection needs every sample finished before any
+            # can be ranked — incompatible with streaming tokens as
+            # they commit. Fan-out streams deliver ALL samples.
+            return 400, {"message": "streaming requires n == best_of "
+                                    "(n-best selection cannot stream; "
+                                    "drop best_of or stream all "
+                                    "samples)"}
         from megatron_tpu.serving import SamplingOptions
         prompt_ids = self._preflight_lengths(payload, self.engine.max_len,
                                              "max_len")
@@ -725,12 +811,17 @@ class MegatronServer:
             sampling, seed=self._seed_for(payload),
             priority=int(payload.get("priority", 0) or 0),
             deadline_s=None if deadline_s is None else float(deadline_s),
-            adapter_id=payload.get("adapter_id"))
+            adapter_id=payload.get("adapter_id"),
+            response_format=payload.get("response_format"),
+            n=n_samples, best_of=best_of)
         sid = secrets.token_hex(8)
         entry = _StreamEntry(sid, req)
         with self._streams_lock:
             self._gc_streams_locked(_time.monotonic())
             self._streams[sid] = entry
+        if getattr(req, "children", None):
+            return 200, self._stream_events_fanout(entry, start=0,
+                                                   resumed=False)
         return 200, self._stream_events(entry, start=0, resumed=False)
 
     def _stream_events(self, entry: "_StreamEntry", start: int,
@@ -744,6 +835,7 @@ class MegatronServer:
         hang; a retryable one invites reconnect-or-resubmit)."""
         from megatron_tpu.serving import (DeadlineExceededError,
                                           EngineUnhealthyError,
+                                          GrammarDeadEndError,
                                           QueueFullError,
                                           ServiceUnavailableError)
         import time as _time
@@ -798,6 +890,10 @@ class MegatronServer:
                 status = 503
             elif isinstance(e, QueueFullError):
                 status = 429
+            elif isinstance(e, GrammarDeadEndError):
+                status = 422  # constrained generation got stuck —
+                # deterministic for this (grammar, prompt, seed), so
+                # never retryable
             else:
                 status = 500
             yield self._sse({"message": str(e), "status": status,
@@ -808,6 +904,89 @@ class MegatronServer:
         yield self._sse({"text": self.tokenizer.detokenize(toks),
                          "segments": toks,
                          "generated": len(req.generated)}, event="done")
+
+    def _stream_events_fanout(self, entry: "_StreamEntry", start: int,
+                              resumed: bool):
+        """SSE generator for n>1 fan-out streams (docs/api.md
+        "Parallel sampling"). Frames are SAMPLE-MAJOR: sample 0 streams
+        to completion, then sample 1, ... — a single GLOBAL monotonic
+        frame id spans all samples, so `Last-Event-ID` resume is as
+        exact as the single-sample protocol (walk the children in
+        order, skip frames below `start`). Each token frame carries
+        `sample` (which child) alongside its per-sample `index`. A
+        child's typed failure emits an `error` frame tagged with its
+        sample and the stream CONTINUES to the remaining samples; the
+        terminal `done` frame reports every completed text."""
+        from megatron_tpu.serving import (DeadlineExceededError,
+                                          EngineUnhealthyError,
+                                          GrammarDeadEndError,
+                                          QueueFullError,
+                                          ServiceUnavailableError)
+        import time as _time
+        agg = entry.req
+        yield self._sse(
+            {"stream_id": entry.sid, "resumed": resumed,
+             "next_index": max(start, 0), "n": agg.n,
+             "weight_version": self._req_weight_version(agg.children[0])},
+            event="start")
+        gid = 0  # global frame counter across ALL samples
+        start = max(start, 0)
+        stream_deadline = _time.monotonic() + self._timeout
+        texts, errors = [], []
+        for k, req in enumerate(agg.children):
+            i = 0
+            while True:
+                gen = req.generated
+                if i < len(gen):
+                    if gid >= start:
+                        lps = req.gen_logprobs
+                        data = {"sample": k, "index": i,
+                                "token": int(gen[i]),
+                                "text": self.tokenizer.detokenize(
+                                    [int(gen[i])])}
+                        if i < len(lps):
+                            data["logprob"] = float(lps[i])
+                        yield self._sse(data, event="token",
+                                        event_id=gid)
+                    gid += 1
+                    i += 1
+                    continue
+                if req.done():
+                    break
+                if _time.monotonic() > stream_deadline:
+                    yield self._sse(
+                        {"message": f"stream timed out after "
+                                    f"{self._timeout:.0f}s waiting "
+                                    "for tokens", "status": 500,
+                         "retryable": True, "sample": k,
+                         "committed": len(req.generated)},
+                        event="error")
+                    return
+                req.wait_token(i, timeout=0.25)
+            try:
+                toks, _ = req.result(timeout=self._timeout)
+                texts.append(self.tokenizer.detokenize(toks))
+            except Exception as e:  # noqa: BLE001 — typed per-sample frame
+                if isinstance(e, DeadlineExceededError):
+                    status = 504
+                elif isinstance(e, (ServiceUnavailableError,
+                                    EngineUnhealthyError)):
+                    status = 503
+                elif isinstance(e, QueueFullError):
+                    status = 429
+                elif isinstance(e, GrammarDeadEndError):
+                    status = 422
+                else:
+                    status = 500
+                errors.append({"sample": k, "status": status})
+                yield self._sse({"message": str(e), "status": status,
+                                 "retryable": status in (429, 503),
+                                 "sample": k,
+                                 "committed": len(req.generated)},
+                                event="error")
+        yield self._sse({"text": texts, "n": agg.n,
+                         "completed": len(texts),
+                         "failed": errors}, event="done")
 
     def metrics_snapshot(self) -> dict:
         if self.engine is None:

@@ -7,9 +7,9 @@ from megatron_tpu.serving.engine import (  # noqa: F401
     EngineHungError, ServingEngine)
 from megatron_tpu.serving.host_tier import HostKVTier  # noqa: F401
 from megatron_tpu.serving.invariants import (  # noqa: F401
-    InvariantViolation, check_all, check_kv_accounting,
-    check_metrics_conservation, check_schema, check_token_exact,
-    resolve_terminals)
+    InvariantViolation, check_all, check_grammar_validity,
+    check_kv_accounting, check_metrics_conservation, check_schema,
+    check_token_exact, resolve_terminals)
 from megatron_tpu.serving.router import (  # noqa: F401
     EngineRouter, NoReplicaAvailableError, RollingUpgradeError,
     RouterRequest)
@@ -22,8 +22,12 @@ from megatron_tpu.serving.kv_pool import (  # noqa: F401
 from megatron_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from megatron_tpu.serving.prefix_index import PrefixIndex  # noqa: F401
 from megatron_tpu.serving.request import (  # noqa: F401
-    DeadlineExceededError, GenRequest, RequestFailedError, RequestState,
-    SamplingOptions, ServiceUnavailableError)
+    DeadlineExceededError, FanoutRequest, GenRequest, GrammarDeadEndError,
+    RequestFailedError, RequestState, SamplingOptions,
+    ServiceUnavailableError)
+from megatron_tpu.serving.structured import (  # noqa: F401
+    CharDFA, GrammarCompileError, TokenFSM, compile_regex,
+    compile_response_format, schema_to_regex, validate_response_format)
 from megatron_tpu.serving.scheduler import (  # noqa: F401
     AdmissionError, AdmissionScheduler, EngineUnhealthyError,
     FIFOScheduler, OverloadShedError, QueueFullError)

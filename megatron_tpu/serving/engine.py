@@ -134,13 +134,16 @@ from megatron_tpu.serving.kv_pool import (SlotKVPool, block_native_cache,
                                           slice_slot)
 from megatron_tpu.serving.metrics import ServingMetrics
 from megatron_tpu.serving.prefix_index import PrefixIndex
-from megatron_tpu.serving.request import (GenRequest, RequestState,
-                                          SamplingOptions)
-from megatron_tpu.serving.scheduler import (AdmissionScheduler,
+from megatron_tpu.serving.request import (FanoutRequest, GenRequest,
+                                          RequestState, SamplingOptions)
+from megatron_tpu.serving.scheduler import (AdmissionError,
+                                            AdmissionScheduler,
                                             EngineUnhealthyError,
                                             OverloadShedError)
 from megatron_tpu.serving.spec_decode import (NGramDrafter,
                                               build_draft_rounds)
+from megatron_tpu.serving.structured import (GrammarCompileError,
+                                             compile_response_format)
 from megatron_tpu.utils.logging import print_rank_0
 
 from megatron_tpu.config import SERVING_KV_DTYPES as _KV_DTYPES
@@ -246,7 +249,7 @@ class ServingEngine:
                  metrics: Optional[ServingMetrics] = None,
                  writer=None, report_interval: int = 100,
                  start: bool = True, drafter=None, devices=None,
-                 weight_version=None):
+                 weight_version=None, token_strings=None):
         from megatron_tpu.config import ServingConfig
         self.gen = generator
         cfg = generator.cfg
@@ -528,6 +531,25 @@ class ServingEngine:
         # identity adapter so their garbage decode is the base model's
         self._adapter_idx = np.zeros(S, np.int32)
         self._d_adapter_idx = jnp.asarray(self._adapter_idx)
+        # grammar-constrained decoding (serving/structured.py): the
+        # per-slot [padded_vocab] legal-token bitmask applied at
+        # sample_batched's post-filter seam. Free rows are ALL-True
+        # (bit-identical to mask=None — one trace serves mixed grids);
+        # a structured row carries its FSM state's mask over [:V] with
+        # the pad tail False, so a dead-end state yields an all-False
+        # row and the sampler's -1 sentinel. `_mask_state` mirrors each
+        # row's FSM state on the host (-1 = free row): the device rows
+        # re-upload ONLY when some row's state actually changed
+        # (`mask_uploads`) — a self-loop transition re-uses the
+        # resident copy.
+        self._masks = np.ones((S, Vp), np.bool_)
+        self._d_masks = jnp.asarray(self._masks)
+        self._mask_state = np.full(S, -1, np.int64)
+        self._masks_dirty = False
+        # tokenizer piece strings the per-request TokenFSMs compose
+        # over (None = byte-level identity, structured.py
+        # default_token_strings — the harness-scale ASCII models)
+        self._token_strings = token_strings
         self._sampling_dirty = True
         self._lengths_dirty = True
         # KV gauges recompute only after pool churn (admit / evict /
@@ -545,7 +567,7 @@ class ServingEngine:
         # flight hits the CPU jax 0.4.x donation-aliasing bug the
         # rollback path in training/loop.py documents (observed here as
         # rare wrong tokens on the 8-virtual-device CPU mesh)
-        self._decode = _jit_dec(self._decode_fn, n_array_args=10,
+        self._decode = _jit_dec(self._decode_fn, n_array_args=11,
                                 donate_argnums=(1, 2, 3))
         # speculative verify: ONE trace for the enabled k (drafts are
         # a fixed [S, k] shape — k is a compile-time bucket), compiled
@@ -553,8 +575,16 @@ class ServingEngine:
         # Same donation set and the same lengths/rejects no-donate rule
         # as _decode (both chain device-side across a window).
         self._verify_traces = 0
-        self._verify = _jit_dec(self._verify_fn, n_array_args=11,
+        self._verify = _jit_dec(self._verify_fn, n_array_args=14,
                                 donate_argnums=(1, 2, 3))
+        # resident grammar-neutral verify args (all-True per-position
+        # masks + no-guess sentinel): windows with no structured row
+        # dispatch these unchanged buffers, so the masked verify trace
+        # costs free traffic nothing
+        if self._spec_k:
+            self._d_free_dmask = jnp.ones((S, self._spec_k, Vp),
+                                          jnp.bool_)
+            self._d_no_guess = jnp.full((S,), -1, jnp.int32)
         # one jit; jax retraces per (batch-bucket, padded prompt length)
         # combo (both bucketed — _prefill_bucket / _batch_bucket — so
         # the cache hits across request sizes and arrival bursts)
@@ -651,7 +681,8 @@ class ServingEngine:
                seed: int = 0, priority: int = 0,
                deadline_s: Optional[float] = None,
                arrival_id: Optional[int] = None,
-               adapter_id=None) -> GenRequest:
+               adapter_id=None, response_format=None,
+               n: int = 1, best_of: Optional[int] = None):
         """Non-blocking: enqueue and return the request handle. Raises
         QueueFullError (→ 429) when the bounded queue is full,
         OverloadShedError (→ 429 + Retry-After) when early shedding
@@ -663,7 +694,25 @@ class ServingEngine:
         failover retries only) preserves a resubmitted request's
         original queue position. `adapter_id` selects a registered LoRA
         adapter (None = base model); an unknown id (or any id on an
-        adapterless engine) is an AdmissionError → 400."""
+        adapterless engine) is an AdmissionError → 400.
+
+        `response_format` (docs/serving.md "Structured output &
+        n-best"): a grammar the output must conform to —
+        {"type": "regex", "pattern": ...} or {"type": "json_schema",
+        "schema": ...}. Compiled ONCE here into a TokenFSM
+        (serving/structured.py); a malformed/unsupported/unsatisfiable
+        grammar is an AdmissionError → 400. At runtime the request's
+        tokens are sampled under the FSM's per-state vocab mask; a
+        dead end fails it typed (GrammarDeadEndError → 422).
+
+        `n` / `best_of` (parallel sampling): decode `best_of`
+        (default n) independently seeded samples of ONE prompt — seed,
+        seed+1, ... — and return the `n` highest-logprob completions.
+        With best_of > 1 the return value is a FanoutRequest
+        aggregating the child GenRequests; the children alias the
+        leader's prompt KV blocks copy-on-write (one prefill per
+        fan-out on prefix-cache engines). Each child is token-exact vs
+        a serial run at its own seed."""
         if self._broken:
             # pre-admission gate: the breaker bounces callers before
             # the request is even constructed — deliberately OUTSIDE
@@ -672,13 +721,27 @@ class ServingEngine:
             raise EngineUnhealthyError(
                 f"engine unhealthy (circuit breaker open): "
                 f"{self._broken}")
-        # received is counted FIRST so that every submit-time refusal
-        # below (adapter 400, draining 429, queue full, shed) lands in
-        # requests_rejected against a matching requests_received — the
-        # conservation law requests_received == completed + rejected +
-        # failed + cancelled + expired (serving/invariants.py) holds
-        # by construction, not by auditing call sites
-        self.metrics.count("requests_received")
+        # fan-out shape errors are pre-accounting refusals too (the
+        # request set was never even constructed): the HTTP boundary
+        # 400s these before they get here; this guards API callers
+        n = int(n)
+        best_of = n if best_of is None else int(best_of)
+        if not 1 <= n <= best_of:
+            raise AdmissionError(
+                f"need 1 <= n <= best_of, got n={n} best_of={best_of}")
+        if best_of > self.num_slots:
+            raise AdmissionError(
+                f"best_of={best_of} exceeds the engine's {self.num_slots}"
+                " slots: the fan-out could never decode concurrently")
+        # received is counted FIRST (once per SAMPLE — each child is a
+        # unit of terminal accounting) so that every submit-time
+        # refusal below (adapter 400, grammar 400, draining 429, queue
+        # full, shed) lands in requests_rejected against matching
+        # requests_received — the conservation law requests_received ==
+        # completed + rejected + failed + cancelled + expired
+        # (serving/invariants.py) holds by construction, not by
+        # auditing call sites
+        self.metrics.count("requests_received", best_of)
         try:
             if adapter_id is not None:
                 from megatron_tpu.serving.adapters import \
@@ -697,37 +760,75 @@ class ServingEngine:
                     "engine draining (shutdown in progress); retry "
                     "against another replica", retry_after=5,
                     queue_depth=self.scheduler.depth())
+            fsm = None
+            if response_format is not None:
+                # ONE compile shared by every sample of the fan-out;
+                # compile failures are admission refusals (→ 400),
+                # never runtime errors
+                try:
+                    fsm = compile_response_format(
+                        response_format, self.cfg.vocab_size,
+                        token_strings=self._token_strings,
+                        eos_id=self.gen.eos_id)
+                except GrammarCompileError as e:
+                    raise AdmissionError(
+                        f"response_format does not compile: {e}") from e
             priority = max(0, min(int(priority),
                                   self.serving.priority_levels - 1))
-            req = GenRequest(list(prompt), max_new_tokens, sampling,
-                             seed, priority=priority,
-                             deadline_s=deadline_s,
-                             arrival_id=arrival_id,
-                             adapter_id=adapter_id)
-            # terminal-accounting hook: the request's FIRST terminal
-            # transition — wherever it happens (engine loop, watchdog
-            # thread, cancel path, drain, breaker) — counts exactly
-            # one of requests_{completed,failed,cancelled,expired}
-            req._on_terminal = self._count_terminal
+            children: List[GenRequest] = []
+            for i in range(best_of):
+                req = GenRequest(list(prompt), max_new_tokens, sampling,
+                                 seed + i, priority=priority,
+                                 deadline_s=deadline_s,
+                                 arrival_id=(arrival_id if i == 0
+                                             else None),
+                                 adapter_id=adapter_id)
+                req.response_format = response_format
+                req.fsm = fsm
+                req.sample_index = i
+                if i > 0:
+                    # sample 0 is the PREFILL LEADER: siblings gate
+                    # their admission on its prompt KV being indexed
+                    # so they alias it copy-on-write (_admit)
+                    req.fanout_leader = children[0]
+                # terminal-accounting hook: the request's FIRST
+                # terminal transition — wherever it happens (engine
+                # loop, watchdog thread, cancel path, drain, breaker)
+                # — counts exactly one of
+                # requests_{completed,failed,cancelled,expired}
+                req._on_terminal = self._count_terminal
+                children.append(req)
+            if fsm is not None:
+                self.metrics.count("structured_requests", best_of)
             if max_new_tokens == 0:
                 # nothing to decode: the serial path returns the prompt
                 # row unchanged — short-circuit without occupying a
                 # slot, but through the SAME admission check (an
                 # oversize prompt must 400 on both routes)
-                self.scheduler.check_admissible(req)
-                req.mark_admitted()
-                req.finish()
-                self.metrics.record_admitted(0.0)
-                return req
-            self.scheduler.submit(req)
+                self.scheduler.check_admissible(children[0])
+                for req in children:
+                    req.mark_admitted()
+                    req.finish()
+                    self.metrics.record_admitted(0.0)
+            elif best_of == 1:
+                self.scheduler.submit(children[0])
+            else:
+                # atomic batch admission: all samples queue or none do
+                # (a half-admitted fan-out would return fewer than n)
+                self.scheduler.submit_many(children)
+            if best_of > 1:
+                self.metrics.count("fanout_requests")
+                self.metrics.count("fanout_samples", best_of)
         except OverloadShedError:
-            self.metrics.count("requests_shed")
-            self.metrics.count("requests_rejected")
+            self.metrics.count("requests_shed", best_of)
+            self.metrics.count("requests_rejected", best_of)
             raise
         except Exception:
-            self.metrics.count("requests_rejected")
+            self.metrics.count("requests_rejected", best_of)
             raise
-        return req
+        if best_of == 1:
+            return children[0]
+        return FanoutRequest(children, n)
 
     def _count_terminal(self, req: GenRequest, outcome: str):
         """GenRequest._on_terminal hook (any thread; fires exactly once
@@ -745,15 +846,17 @@ class ServingEngine:
         else:
             self.metrics.count("requests_" + outcome)
 
-    def cancel(self, req: GenRequest):
+    def cancel(self, req):
         """Best-effort cancellation: a QUEUED request is dropped and
         failed immediately; a RUNNING one is flagged and evicted at the
         next decode step (frees its slot without decoding to
         completion). Used by the HTTP layer to avoid orphaned work when
-        a multi-prompt payload fails partway through submission."""
-        req.cancel()
-        if not req.done():
-            self.scheduler.cancel(req)
+        a multi-prompt payload fails partway through submission. A
+        FanoutRequest aggregate cancels every child."""
+        for child in getattr(req, "children", None) or [req]:
+            child.cancel()
+            if not child.done():
+                self.scheduler.cancel(child)
         self._wake()
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 64,
@@ -1205,7 +1308,7 @@ class ServingEngine:
     # device programs
     # ------------------------------------------------------------------
     def _decode_fn(self, params, pool, last_logits, rngs, lengths,
-                   temps, top_ks, top_ps, rejects, lora, aidx):
+                   temps, top_ks, top_ps, rejects, masks, lora, aidx):
         """ONE interleaved decode step for the whole slot grid: sample
         each slot's next token from its carried logits, then forward all
         slots' tokens (s=1) through the model with per-slot positions.
@@ -1232,6 +1335,17 @@ class ServingEngine:
         the ban and returns it CLEARED. Non-speculative engines always
         pass all -1, which is bit-identical to the pre-speculative
         step (sample_batched's banned<0 contract).
+
+        `masks` is the grammar seam ([S, Vp] bool): each structured
+        row's FSM-legal vocabulary for its NEXT token, applied by
+        sample_batched after banned at the post-temp/top-k/top-p
+        point (serving/structured.py). Free rows carry all-True rows
+        — bit-identical to no mask — so one grid, one trace serves
+        mixed traffic. A dead-end row (all-False) samples the -1
+        sentinel; the host evicts it typed (GrammarDeadEndError)
+        before the token is ever consumed, and the s=1 forward of the
+        sentinel below is harmless garbage into a row about to be
+        freed.
 
         Block-granular pools pass a BlockKV here: the per-slot block
         map resolves into the contiguous slot-grid view at the top and
@@ -1262,7 +1376,7 @@ class ServingEngine:
         toks = sample_batched(step_keys, last_logits,
                               temperature=temps, top_k=top_ks,
                               top_p=top_ps, vocab_size=cfg.vocab_size,
-                              banned=rejects)
+                              banned=rejects, mask=masks)
         # logprob of the chosen token under the RAW carried logits —
         # the serial path's convention (generation.py _decode_fn)
         lp = jax.nn.log_softmax(last_logits, axis=-1)
@@ -1285,7 +1399,8 @@ class ServingEngine:
                 jnp.full_like(rejects, -1))
 
     def _verify_fn(self, params, pool, last_logits, rngs, lengths,
-                   temps, top_ks, top_ps, drafts, rejects, lora, aidx):
+                   temps, top_ks, top_ps, drafts, rejects, t0_masks,
+                   draft_masks, guess0, lora, aidx):
         """ONE speculative draft/verify round for the whole slot grid
         (`speculative_k`): sample each slot's next token t0 from its
         carried logits (the residual distribution when `rejects` bans
@@ -1320,7 +1435,23 @@ class ServingEngine:
         `lora`/`aidx`: per-slot adapter deltas (see _decode_fn) — the
         verify window forwards under each row's OWN adapter, so
         speculative decoding composes with multi-tenant serving at one
-        trace."""
+        trace.
+
+        Grammar seam (serving/structured.py): `t0_masks` [S, Vp] is
+        each row's FSM-legal vocabulary for t0 (all-True for free
+        rows), `draft_masks` [S, k, Vp] the per-position legal sets
+        the HOST pre-walked along [guess0, d_1..d_k] (all-True for
+        free rows), and `guess0` [S] the drafter's host-known guess
+        for t0 (-1 = no guess / free row). The masks for positions
+        1..k are only valid if the device's t0 equals the guess the
+        host stepped its FSM with, so acceptance is gated on
+        toks0 == guess0 for rows carrying a real guess — a wrong
+        guess rejects the round's drafts (misalignment costs
+        acceptance, never correctness — the contract chained rounds
+        already have). verify_draft_probs zeroes illegal drafts'
+        target probabilities under draft_masks, so an FSM-illegal
+        draft can never be accepted; a gate rejection is NOT a
+        stochastic rejection, so it never sets the residual carry."""
         self._verify_traces += 1
         adapters = (lora, aidx) if self._adapters_on else None
         bkv = None
@@ -1344,7 +1475,7 @@ class ServingEngine:
         toks0 = sample_batched(step_keys, last_logits,
                                temperature=temps, top_k=top_ks,
                                top_p=top_ps, vocab_size=cfg.vocab_size,
-                               banned=rejects)
+                               banned=rejects, mask=t0_masks)
         # logprob under the RAW carried logits — the serial convention
         # (_decode_fn); for a residual-resampled t0 this reports the
         # full-distribution logprob (observability only)
@@ -1361,7 +1492,7 @@ class ServingEngine:
         ctx = logits[:, :k]
         probs, targets = verify_draft_probs(
             ctx, drafts, temperature=temps, top_k=top_ks, top_p=top_ps,
-            vocab_size=cfg.vocab_size)
+            vocab_size=cfg.vocab_size, mask=draft_masks)
 
         def row_unifs(rk):
             return jax.vmap(lambda i: jax.random.uniform(
@@ -1375,6 +1506,12 @@ class ServingEngine:
         # short proposal) are never accepted — and never counted as a
         # stochastic rejection below
         accept = accept & (drafts >= 0)
+        # grammar gate: rows with a real host guess for t0 only keep
+        # their drafts when the device sampled that guess — otherwise
+        # the host-walked draft_masks were stepped from the wrong
+        # state and nothing downstream of them is trustworthy
+        gate_ok = (guess0 < 0) | (toks0 == guess0)
+        accept = accept & gate_ok[:, None]
         # capacity clamp: draft j commits at position lengths+1+j and
         # its logits need every window write up to lengths+j in-region
         allow = (lengths[:, None] + 1 + jnp.arange(k)[None, :]
@@ -1399,7 +1536,11 @@ class ServingEngine:
                                      axis=1)[:, 0]
         allow_stop = jnp.take_along_axis(allow, a_idx[:, None],
                                          axis=1)[:, 0]
-        new_rejects = jnp.where((a < k) & allow_stop & (d_stop >= 0),
+        # ... and a grammar-gate rejection is NOT a stochastic
+        # rejection: banning the stop draft after one would skew the
+        # next t0's residual vs the serial masked oracle
+        new_rejects = jnp.where(gate_ok & (a < k) & allow_stop
+                                & (d_stop >= 0),
                                 d_stop,
                                 jnp.int32(-1)).astype(jnp.int32)
         new_lengths = jnp.minimum(lengths + 1 + a,
@@ -1848,6 +1989,14 @@ class ServingEngine:
         self._d_adapter_idx = jnp.asarray(self._adapter_idx)
         if self.adapters is not None:
             self.adapters.reset_pins()
+        # grammar masks reset with the grid: a requeued structured
+        # request keeps its FSM and its advanced fsm_state (both
+        # host-side, like resume_rng), so re-activation re-installs
+        # the right mask via _set_slot_mask
+        self._masks = np.ones((S, Vp), np.bool_)
+        self._d_masks = jnp.asarray(self._masks)
+        self._mask_state = np.full(S, -1, np.int64)
+        self._masks_dirty = False
         self._slot_req = [None] * S
         self._sampling_dirty = True
         self._lengths_dirty = True
@@ -1936,6 +2085,13 @@ class ServingEngine:
         # which row they land in next)
         self._release_adapter(req)
         self._adapter_idx[slot] = 0
+        if self._mask_state[slot] >= 0:
+            # the mask row frees with the slot; the victim's grammar
+            # walk lives on the REQUEST (fsm_state) and re-installs
+            # at resume via _set_slot_mask
+            self._masks[slot, :] = True
+            self._mask_state[slot] = -1
+            self._masks_dirty = True
         self._sampling_dirty = True
         self._kv_dirty = True
         self._lengths_dirty = True
@@ -1994,6 +2150,28 @@ class ServingEngine:
                 # (prompt + generated); == prompt when never preempted
                 toks = r.effective_prompt()
                 src, hit = self._lookup_prefix(toks, r.adapter_ns)
+                if r.fanout_leader is not None \
+                        and not r.fanout_leader.done() \
+                        and not hit \
+                        and self._prefix_on and not self.pool.rolling \
+                        and r.resume_rng is None:
+                    # n-best fan-out: siblings wait for the LEADER's
+                    # prompt KV to land in the prefix index, then
+                    # admit through the COW-alias hit path — ONE
+                    # prefill forward serves the whole fan-out (the
+                    # one-prefill pin). Gate on the sibling's OWN
+                    # index hit, not leader state: the leader is
+                    # RUNNING from admission but indexed only at
+                    # activation. No deadlock: a leader terminal in
+                    # any way (done()) releases the gate, and
+                    # prefixless engines never enter it. Prompts too
+                    # short to hit at index granularity re-prefill
+                    # standalone once the leader finishes — correct,
+                    # just without the saving.
+                    self._release_adapter(r)
+                    self.scheduler.requeue(r)
+                    pending.remove(r)
+                    continue
                 if hit or r.resume_rng is not None \
                         or (self._chunk is not None
                             and len(toks) > self._chunk) \
@@ -2524,6 +2702,11 @@ class ServingEngine:
         # (0 = identity/base; pinned since admission)
         self._adapter_idx[slot] = st.aidx
         self._slot_req[slot] = req
+        if req.fsm is not None:
+            # mask for the request's CURRENT FSM state — 0 when
+            # fresh, the saved state on a preemption resume (the
+            # grammar walk survives park/requeue with the rng chain)
+            self._set_slot_mask(slot, req)
         self._sampling_dirty = True
         self._kv_dirty = True
         self._lengths_dirty = True
@@ -2607,6 +2790,8 @@ class ServingEngine:
             self._reject[slot] = req.resume_reject  # -1 when fresh
             self._adapter_idx[slot] = req.bank_idx
             self._slot_req[slot] = req
+            if req.fsm is not None:
+                self._set_slot_mask(slot, req)
             # restart-requeued requests re-enter through this path
             # too (the rebuilt PrefixIndex is empty): record the
             # queue wait only for the FIRST admission, like
@@ -2687,6 +2872,12 @@ class ServingEngine:
         # adapter NAMESPACE for index correctness, not the weights)
         self._release_adapter(req)
         self._adapter_idx[slot] = 0
+        if self._mask_state[slot] >= 0:
+            # grammar hygiene: the freed row must sample unmasked —
+            # a stale mask would constrain the NEXT tenant's tokens
+            self._masks[slot, :] = True
+            self._mask_state[slot] = -1
+            self._masks_dirty = True
         self._kv_dirty = True
         self._lengths_dirty = True  # device copy re-parks at next step
         self._sampling_dirty = True
@@ -2755,6 +2946,77 @@ class ServingEngine:
         cadence tests and tools/bench_sync.py)."""
         return jax.device_get(tree)
 
+    def _set_slot_mask(self, slot: int, req: GenRequest):
+        """Write `req`'s CURRENT FSM state's legal-vocab row into the
+        host mask grid and flag the upload. Called at activation and
+        after every host FSM transition to a NEW state; a self-loop
+        transition skips it, so grammars that sit in one state (`a*`)
+        upload exactly once — the `mask_uploads` pin. The FSM's vocab
+        may be narrower than the padded grid; the padding columns stay
+        False (padded vocab ids are never legal)."""
+        row = self._masks[slot]
+        row[:] = False
+        tbl = req.fsm.mask_table[req.fsm_state]
+        row[:tbl.shape[0]] = tbl
+        self._mask_state[slot] = req.fsm_state
+        self._masks_dirty = True
+
+    def _build_round_masks(self, grid, g0, k: int):
+        """Host pre-walk for ONE speculative verify round under
+        grammar: for each structured slot whose drafter guessed t0
+        (g0[slot] >= 0), step its FSM along [g0, d_1..d_k] and emit
+        the per-position legal-vocab masks the device verify applies
+        (verify_draft_probs). Returns (draft_masks [S, k, Vp] device
+        bool, guess0 [S] device int32 — -1 where the round carries no
+        usable guess, which makes the device's acceptance gate inert
+        for that row).
+
+        Free rows keep all-True masks and guess0 = -1: their drafts
+        verify exactly as before (the gate never fires), so mixed
+        structured/free traffic shares the one verify trace. An
+        FSM-illegal draft (or a guess the FSM rejects outright)
+        truncates `grid` IN PLACE from that position — proposing
+        tokens the masks already outlaw would only burn verify accept
+        probability."""
+        S, Vp = self.num_slots, self.cfg.padded_vocab_size
+        dm = np.ones((S, k, Vp), np.bool_)
+        g0_eff = np.full(S, -1, np.int32)
+        for slot in np.nonzero(self._mask_state >= 0)[0]:
+            req = self._slot_req[slot]
+            if req is None or req.fsm is None:
+                continue
+            fsm = req.fsm
+            g = int(g0[slot])
+            if g < 0:
+                # no guess → no drafts proposed for this slot either
+                # (build_draft_rounds proposes one continuation); the
+                # t0 sample still runs under the slot's resident mask
+                continue
+            g0_eff[slot] = g
+            cur = fsm.step(req.fsm_state, g)
+            if cur < 0:
+                # the guess itself is illegal: the device CANNOT
+                # sample it (t0 is masked), so the gate rejects the
+                # round's drafts no matter what — drop them now
+                grid[slot, :] = -1
+                continue
+            V = fsm.mask_table.shape[1]
+            for j in range(k):
+                d = int(grid[slot, j])
+                if d < 0:
+                    break
+                dm[slot, j, :] = False
+                dm[slot, j, :V] = fsm.mask_table[cur]
+                nxt = fsm.step(cur, d)
+                if nxt < 0:
+                    # draft leaves the grammar: truncate — positions
+                    # past an illegal draft can never commit anyway
+                    # (left-to-right acceptance)
+                    grid[slot, j:] = -1
+                    break
+                cur = nxt
+        return jnp.asarray(dm), jnp.asarray(g0_eff)
+
     def _step(self):
         """K chained decode/verify dispatches + ONE host sync +
         bookkeeping.
@@ -2780,8 +3042,18 @@ class ServingEngine:
         round with no real draft from any running slot dispatches the
         cheaper plain decode step instead (`spec_fallback_steps`) —
         which consumes the residual carry too, so fallback never skews
-        a stochastic stream."""
-        K = self._sync_interval
+        a stochastic stream.
+
+        Structured rows pin the window to K=1: a grammar row's mask
+        for token t+1 depends on token t (host FSM step), so chaining
+        plain decode dispatches under a stale mask would commit
+        illegal tokens. Speculative verify still commits up to 1+k
+        tokens per window — the host pre-walks the draft masks along
+        the drafter's guess (spec_decode.build_draft_rounds) — so
+        throughput recovery under grammar comes from `speculative_k`,
+        not from the sync interval."""
+        structured_on = bool((self._mask_state >= 0).any())
+        K = 1 if structured_on else self._sync_interval
         inj = get_fault_injector()
         if inj is not None:
             # serving fault points (resilience/faults.py): stall the
@@ -2815,6 +3087,15 @@ class ServingEngine:
             self._d_top_ps = jnp.asarray(self._top_ps)
             self._sampling_dirty = False
             self.metrics.count("sampling_uploads")
+        if self._masks_dirty:
+            # grammar masks upload ONLY when some slot's FSM state
+            # actually changed since the last window (_set_slot_mask /
+            # eviction hygiene) — a self-loop state (e.g. `a*`
+            # mid-run) re-uses the resident device mask, which is the
+            # `mask_uploads` counter pin (tests/test_structured.py)
+            self._d_masks = jnp.asarray(self._masks)
+            self._masks_dirty = False
+            self.metrics.count("mask_uploads")
         if self._lengths_dirty or not self._active.all():
             # churn re-syncs positions from the host truth; partially
             # active grids also re-park idle rows each window (at 0 for
@@ -2831,6 +3112,7 @@ class ServingEngine:
         spec_k = self._spec_k
         spec_round = [False] * K
         grids = None
+        guesses = None
         if spec_k:
             # draft proposal (host, once per window): per-slot
             # committed history -> per-round [S, spec_k] grids. Draft
@@ -2853,7 +3135,7 @@ class ServingEngine:
                         + req.generated)
                 else:
                     histories[slot] = req.prompt + req.generated
-            grids, spec_round = build_draft_rounds(
+            grids, spec_round, guesses = build_draft_rounds(
                 histories, self.drafter, spec_k, K)
         # adapter bank args: the stacked factor pytree + per-slot rows
         # (None/None with adapters off — the empty-pytree args lower to
@@ -2863,11 +3145,22 @@ class ServingEngine:
         tok_steps, lp_steps, acc_steps = [], [], []
         for r in range(K):
             if spec_round[r]:
+                if structured_on:
+                    # host pre-walk: step each structured row's FSM
+                    # along [guess0, d_1..d_k] into per-position
+                    # verify masks (truncates grids[r] in place at
+                    # the first illegal draft — do this BEFORE the
+                    # grid uploads)
+                    d_dm, d_g0 = self._build_round_masks(
+                        grids[r], guesses[r], spec_k)
+                else:
+                    d_dm, d_g0 = self._d_free_dmask, self._d_no_guess
                 out = self._verify(
                     self._p_dec, self.pool.caches,
                     self._last_logits, self._rngs, self._d_lengths,
                     self._d_temps, self._d_top_ks, self._d_top_ps,
-                    jnp.asarray(grids[r]), self._d_reject, lora, d_aidx)
+                    jnp.asarray(grids[r]), self._d_reject,
+                    self._d_masks, d_dm, d_g0, lora, d_aidx)
                 acc_steps.append(out[5])
                 self.metrics.count("spec_rounds")
             else:
@@ -2875,7 +3168,7 @@ class ServingEngine:
                     self._p_dec, self.pool.caches,
                     self._last_logits, self._rngs, self._d_lengths,
                     self._d_temps, self._d_top_ks, self._d_top_ps,
-                    self._d_reject, lora, d_aidx)
+                    self._d_reject, self._d_masks, lora, d_aidx)
                 acc_steps.append(None)
                 if spec_k:
                     self.metrics.count("spec_fallback_steps")
@@ -2956,8 +3249,28 @@ class ServingEngine:
                             kind="nonfinite")
                         done = True
                         break
-                    first = not req.generated
                     tok = int(row_toks[j])
+                    if req.fsm is not None and tok < 0:
+                        # grammar dead end: EVERY candidate token is
+                        # masked out at this state (sample_batched's
+                        # all-False sentinel) — the request fails
+                        # typed (GrammarDeadEndError → 422), the slot
+                        # frees, every other slot keeps decoding
+                        self.metrics.count("grammar_dead_ends")
+                        if K - 1 - r:
+                            self.metrics.count("wasted_decode_steps",
+                                               K - 1 - r)
+                        self._evict(
+                            slot,
+                            failed=("grammar dead end: every "
+                                    "candidate token is masked out "
+                                    "at FSM state "
+                                    f"{req.fsm_state} (after "
+                                    f"{len(req.generated)} tokens)"),
+                            kind="grammar")
+                        done = True
+                        break
+                    first = not req.generated
                     req.append_token(tok, lp)
                     if first:
                         self.metrics.record_first_token(req.ttft)
@@ -2965,7 +3278,33 @@ class ServingEngine:
                     consumed[r] += 1
                     if j > 0:
                         self.metrics.count("accepted_tokens")
-                    if (tok == self.gen.eos_id
+                    fsm_done = False
+                    if req.fsm is not None:
+                        ns = req.fsm.step(req.fsm_state, tok)
+                        if ns < 0:
+                            # defensive: a masked sample can only be
+                            # FSM-legal, so an illegal commit means
+                            # host/device mask state diverged — fail
+                            # the request, never emit illegal text
+                            self.metrics.count("grammar_dead_ends")
+                            if K - 1 - r:
+                                self.metrics.count(
+                                    "wasted_decode_steps", K - 1 - r)
+                            self._evict(
+                                slot,
+                                failed=("grammar violation: token "
+                                        f"{tok} is illegal at FSM "
+                                        f"state {req.fsm_state}"),
+                                kind="grammar")
+                            done = True
+                            break
+                        req.fsm_state = ns
+                        # a state with no legal NON-EOS continuation
+                        # finishes the request here — eos-less models
+                        # (eos_id=None/-1) would otherwise dead-end
+                        # on the very next step
+                        fsm_done = req.fsm.is_terminal(ns)
+                    if (tok == self.gen.eos_id or fsm_done
                             or len(req.generated)
                             >= req.max_new_tokens):
                         if K - 1 - r:
@@ -2974,6 +3313,13 @@ class ServingEngine:
                         self._evict(slot)
                         done = True
                         break
+                    if (req.fsm is not None
+                            and self._mask_state[slot]
+                            != req.fsm_state):
+                        # refresh the slot's device mask row for the
+                        # NEW state; a self-loop (state unchanged)
+                        # skips this — no upload next window
+                        self._set_slot_mask(slot, req)
         self._steps += K
         # attention-path A/B gauges: bytes any resolve/scatter
         # full-pool bracket moved this window, averaged per step.

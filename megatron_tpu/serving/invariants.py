@@ -49,6 +49,14 @@ The laws (each independently checkable, composed by `check_all`):
    ⟺ unhealthy) and the router distinguishes DEGRADED (some replicas
    down, still ready/200) from DOWN — partial failure must degrade,
    never lie.
+7. **Grammar validity** — every token a COMPLETED structured request
+   emitted is FSM-legal from the state its predecessors reached
+   (TokenFSM.replay), and when the grammar is bounded and the token
+   budget covers its longest path, the final text PARSES
+   (final_text_valid — `json.loads` for json_schema grammars).
+   Constrained decoding may never emit an illegal token, under any
+   storm; a grammar with no legal continuation fails TYPED
+   (GrammarDeadEndError → 422), which rides law 2's taxonomy.
 
 Thread contract: the strict sweeps (`check_all(..., strict=True)`,
 `check_kv_accounting`) read engine-thread-owned accounting — run them
@@ -63,6 +71,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from megatron_tpu.serving.metrics import ServingMetrics
 from megatron_tpu.serving.request import (DeadlineExceededError,
+                                          GrammarDeadEndError,
                                           RequestFailedError,
                                           ServiceUnavailableError)
 from megatron_tpu.serving.scheduler import (AdmissionError,
@@ -93,6 +102,7 @@ TYPED_TERMINAL_ERRORS = (
     QueueFullError,              # 429 (OverloadShedError ⊂)
     AdmissionError,              # 400 (UnknownAdapterError ⊂)
     RequestFailedError,          # 500
+    GrammarDeadEndError,         # 422 (constrained generation stuck)
 )
 
 # terminal-side counters of the conservation law (completed is checked
@@ -221,7 +231,12 @@ def check_token_exact(requests: Sequence,
     sw = sweep or _Sweep()
     counts = {f"oracle_{i}": 0 for i in range(len(oracles))}
     counts["checked"] = 0
-    for req in requests:
+    flat = []
+    for r in requests:
+        # FanoutRequest aggregates check per CHILD: each sample is
+        # independently seeded and must match its own serial oracle
+        flat.extend(getattr(r, "children", None) or [r])
+    for req in flat:
         if not req.done() or getattr(req, "error", None) is not None:
             continue
         state = getattr(req, "state", None)
@@ -240,6 +255,60 @@ def check_token_exact(requests: Sequence,
                 f"(seed={getattr(req, 'seed', '?')}, "
                 f"adapter={getattr(req, 'adapter_id', None)!r}) matches "
                 f"NO oracle: got {got[:24]}...")
+    if sweep is None:
+        sw.raise_if_violated()
+    return counts
+
+
+# ---------------------------------------------------------------------
+# law 7: grammar validity (structured output)
+# ---------------------------------------------------------------------
+def check_grammar_validity(requests: Sequence,
+                           sweep: Optional[_Sweep] = None
+                           ) -> Dict[str, int]:
+    """Every COMPLETED grammar-constrained request's stream must be
+    FSM-legal end to end (TokenFSM.replay: each token allowed from the
+    state its predecessors reached, EOS only from an accepting state),
+    and — when the grammar is BOUNDED (acyclic DFA, max_path_len not
+    None) and the request's token budget covers its longest path — the
+    final text must PARSE (TokenFSM.final_text_valid: the char-DFA
+    accepts, and json.loads succeeds for json_schema grammars). The
+    parse check is skipped for unbounded grammars or tight budgets:
+    there a run can legitimately end mid-structure at max_new_tokens
+    (replay-legality still holds; guaranteed-parse is only promised
+    when the budget makes it reachable). FanoutRequest aggregates are
+    flattened to their children. Returns counts."""
+    sw = sweep or _Sweep()
+    flat = []
+    for r in requests:
+        flat.extend(getattr(r, "children", None) or [r])
+    counts = {"checked": 0, "parsed": 0}
+    for req in flat:
+        fsm = getattr(req, "fsm", None)
+        if fsm is None or not req.done() \
+                or getattr(req, "error", None) is not None:
+            continue
+        state = getattr(req, "state", None)
+        if state is not None and getattr(state, "value", "") != "finished":
+            continue
+        counts["checked"] += 1
+        toks = list(req.generated)
+        legal, final_state = fsm.replay(toks)
+        sw.note("grammar_validity", legal,
+                f"structured request {getattr(req, 'id', '?')} emitted "
+                f"an FSM-ILLEGAL token (seed={req.seed}, tokens "
+                f"{toks[:24]}...) — constrained decoding must never "
+                "commit outside the grammar")
+        if (legal and fsm.max_path_len is not None
+                and req.max_new_tokens >= fsm.max_path_len):
+            ok = fsm.final_text_valid(toks)
+            counts["parsed"] += int(ok)
+            sw.note("grammar_validity", ok,
+                    f"structured request {getattr(req, 'id', '?')} "
+                    "completed with text that does not parse "
+                    f"(seed={req.seed}, budget {req.max_new_tokens} >= "
+                    f"longest path {fsm.max_path_len}: a parse was "
+                    "guaranteed-reachable)")
     if sweep is None:
         sw.raise_if_violated()
     return counts
@@ -513,6 +582,8 @@ def check_all(target, requests: Sequence = (),
     if requests and oracles:
         report["token_exact"] = check_token_exact(requests, oracles,
                                                   sweep=sw)
+    if requests:
+        report["grammar"] = check_grammar_validity(requests, sweep=sw)
     report["laws_checked"] = list(sw.checked)
     report["violations"] = [f"[{law}] {d}" for law, d in sw.violations]
     report["ok"] = not sw.violations

@@ -806,6 +806,27 @@ class SlotKVPool:
     def retained_count(self) -> int:
         return len(self._retained)
 
+    def shared_block_count(self) -> int:
+        """Physical blocks held by MORE than one owner (row maps,
+        retained entries, pending-prefill aliases) — the COW-alias
+        gauge. An n-best fan-out aliasing the leader's prompt blocks
+        raises this by (children sharing) × (prompt blocks); when the
+        fan-out finishes and every child releases, it must return to
+        its pre-fan-out value — the refcount no-leak pin
+        (tests/test_structured.py, measured with retained_slots=0:
+        a retained prefix LEGITIMATELY keeps the prompt blocks pinned
+        across requests, which is reuse, not a leak). 0 for
+        whole-region pools (they never alias)."""
+        if not self.blocks_enabled:
+            return 0
+        return int(np.sum(self._rc[:self.TRASH] > 1))
+
+    def block_refcount(self, block: int) -> int:
+        """One block's live reference count (engine-thread accounting
+        truth) — test introspection for the COW-alias lifecycle."""
+        assert self.blocks_enabled
+        return int(self._rc[int(block)])
+
     def used_count(self) -> int:
         if self.blocks_enabled:
             return self.num_slots - len(self._free)

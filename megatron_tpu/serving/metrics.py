@@ -108,6 +108,20 @@ _BASE_COUNTERS = (
     # old weights each time), rolling_upgrades = completed fleet
     # rollouts through the router's drain->swap->canary walk
     "weight_swaps", "weight_swap_failures", "rolling_upgrades",
+    # structured output + parallel sampling (serving/structured.py,
+    # docs/serving.md "Structured output & n-best"):
+    # structured_requests = grammar-constrained requests admitted,
+    # mask_uploads = per-slot vocab-mask device uploads — incremented
+    # ONLY when a slot's FSM state actually changes (a self-loop state
+    # re-uses the resident row; the "uploads only on state change"
+    # contract is counter-pinned on this), grammar_dead_ends =
+    # structured requests failed typed (422) because every candidate
+    # token was masked, fanout_requests = n>1 parallel-sampling
+    # fan-outs admitted, fanout_samples = total samples those fan-outs
+    # expanded into (each sample also counts in requests_received, so
+    # the conservation law holds unchanged)
+    "structured_requests", "mask_uploads", "grammar_dead_ends",
+    "fanout_requests", "fanout_samples",
 )
 
 

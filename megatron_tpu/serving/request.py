@@ -49,6 +49,17 @@ class RequestFailedError(RuntimeError):
     rejection."""
 
 
+class GrammarDeadEndError(RuntimeError):
+    """A grammar-constrained request reached a state where EVERY
+    candidate token is masked out (the model must emit something, the
+    grammar admits nothing — e.g. max_new_tokens ran out mid-structure
+    with no legal stopping point, or the sampler returned the all-
+    banned sentinel). The request fails TYPED instead of sampling from
+    a renormalized-empty distribution; the HTTP layer maps this to
+    422 — the request was well-formed, the constrained generation is
+    unprocessable."""
+
+
 @dataclasses.dataclass(frozen=True)
 class SamplingOptions:
     """Per-REQUEST sampling knobs. The engine batches these into [slots]
@@ -171,6 +182,27 @@ class GenRequest:
         self.adapter_id = adapter_id
         self.adapter_ns = None
         self.bank_idx = 0
+        # structured output (serving/structured.py): `fsm` is the
+        # TokenFSM compiled at submit (shared across an n-best
+        # fan-out's samples — compile once), `fsm_state` the integer
+        # automaton state after the committed tokens. HOST-side by
+        # construction, so it survives preemption/park/resume and
+        # engine restarts exactly like the PRNG chain does — replaying
+        # the effective prompt re-lands the slot at the same state the
+        # host already tracks. `response_format` keeps the source
+        # grammar for observability / the invariant checker.
+        self.response_format = None
+        self.fsm = None
+        self.fsm_state = 0
+        # parallel sampling (n-best fan-out): which sample of a
+        # fan-out this request is (0 = the PREFILL LEADER whose
+        # retained prompt KV the siblings alias copy-on-write), and
+        # the leader request siblings gate their admission on — a
+        # sibling admits after its leader's prompt KV is indexed (or
+        # the leader went terminal, in which case it admits standalone
+        # rather than deadlock). None/0 for plain requests.
+        self.sample_index = 0
+        self.fanout_leader: Optional["GenRequest"] = None
 
     def effective_prompt(self) -> List[int]:
         """Tokens whose KV must be slot-resident before the next decode
@@ -265,7 +297,8 @@ class GenRequest:
     def fail(self, msg: str, kind: str = "error") -> bool:
         """`kind` picks the exception `result()` raises: "deadline" →
         DeadlineExceededError (504), "unavailable" →
-        ServiceUnavailableError (503), anything else →
+        ServiceUnavailableError (503), "grammar" →
+        GrammarDeadEndError (422), anything else →
         RequestFailedError. Idempotent AND atomic: the first terminal
         transition wins (the watchdog and the engine loop may race to
         fail the same request — the lock makes the winner unique, so
@@ -315,6 +348,9 @@ class GenRequest:
             if kind == "unavailable":
                 raise ServiceUnavailableError(
                     f"request {self.id}: {self.error}")
+            if kind == "grammar":
+                raise GrammarDeadEndError(
+                    f"request {self.id}: {self.error}")
             raise RequestFailedError(
                 f"request {self.id} failed: {self.error}")
         return self.prompt + self.generated, list(self.gen_logprobs)
@@ -324,3 +360,67 @@ class GenRequest:
         if self.first_token_time is None:
             return None
         return self.first_token_time - self.submit_time
+
+
+class FanoutRequest:
+    """Aggregate handle over an n-best fan-out's child GenRequests
+    (engine.submit with n > 1): ONE prompt, `best_of` independently
+    seeded decode streams sharing the prompt's physical KV blocks
+    copy-on-write, of which the `n` highest-scoring completions are
+    returned. Each child is a full GenRequest (its own slot, seed
+    `seed + i`, terminal accounting) — this wrapper only aggregates.
+
+    Ranking: cumulative generated logprob, descending (ties break on
+    sample index for determinism). With n == best_of the ranking is a
+    stable reorder of all samples."""
+
+    def __init__(self, children: List[GenRequest], n: int):
+        assert children, "fan-out with no samples"
+        assert 1 <= n <= len(children), (n, len(children))
+        self.children = list(children)
+        self.n = int(n)
+        self.best_of = len(children)
+        self.id = children[0].id
+        self.prompt = children[0].prompt
+
+    def done(self) -> bool:
+        return all(c.done() for c in self.children)
+
+    def cancel(self) -> None:
+        for c in self.children:
+            c.cancel()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        for c in self.children:
+            rem = (None if deadline is None
+                   else max(deadline - time.monotonic(), 0.0))
+            if not c._done.wait(rem):
+                return False
+        return True
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until every sample resolves; returns (tokens_list,
+        logprobs_list) — the n best completions, each entry the same
+        (prompt + generated, logprobs) shape a plain GenRequest's
+        result() has. If fewer than n samples completed, the first
+        failed child's typed error propagates (so a deadline/grammar/
+        crash death keeps its HTTP status)."""
+        if not self.wait(timeout):
+            pending = [c.id for c in self.children if not c.done()]
+            raise TimeoutError(f"fan-out {self.id}: samples {pending} "
+                               "still running")
+        completed, first_error = [], None
+        for c in self.children:
+            try:
+                toks, lps = c.result(timeout=0)
+                completed.append((c.sample_index, toks, lps))
+            except Exception as e:  # noqa: BLE001 — typed, re-raised below
+                if first_error is None:
+                    first_error = e
+        if len(completed) < self.n:
+            raise first_error
+        completed.sort(key=lambda t: (-sum(t[2]), t[0]))
+        top = completed[:self.n]
+        return [t[1] for t in top], [t[2] for t in top]

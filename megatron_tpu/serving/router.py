@@ -178,10 +178,13 @@ class RouterRequest:
                 self._settle("ok", True)
                 return
             kind = getattr(inner, "error_kind", "error")
-            if self.cancelled or kind == "deadline":
-                # client gave up / SLO burned: a retry cannot help —
-                # terminal here, inconclusive for the replica (neither
-                # outcome says the replica itself is broken)
+            if self.cancelled or kind in ("deadline", "grammar"):
+                # client gave up / SLO burned / constrained generation
+                # dead-ended: a retry cannot help (a grammar dead end
+                # is deterministic in (grammar, prompt, seed) — every
+                # replica would walk into the same wall) — terminal
+                # here, inconclusive for the replica (neither outcome
+                # says the replica itself is broken)
                 self._settle("err", None)
                 return
             # retryable infra failure (engine crash/shutdown/hang/drain)
@@ -499,7 +502,8 @@ class EngineRouter:
                     priority=spec["priority"],
                     deadline_s=spec["deadline_s"],
                     arrival_id=rreq.arrival_id,
-                    adapter_id=spec.get("adapter_id"))
+                    adapter_id=spec.get("adapter_id"),
+                    response_format=spec.get("response_format"))
             except AdmissionError:
                 with self._lock:
                     if rep.canary is rreq:
@@ -534,11 +538,25 @@ class EngineRouter:
                sampling: SamplingOptions = SamplingOptions(),
                seed: int = 0, priority: int = 0,
                deadline_s: Optional[float] = None,
-               adapter_id=None) -> RouterRequest:
+               adapter_id=None, response_format=None, n: int = 1,
+               best_of: Optional[int] = None) -> RouterRequest:
+        # structured output rides the spec dict straight through to the
+        # replica engine (each attempt recompiles the FSM at admission,
+        # so a failover resubmission replays the identical constrained
+        # stream). Fan-out does NOT: the retry pump is a facade over
+        # ONE GenRequest, and a FanoutRequest aggregate has no
+        # state/error_kind surface for it — typed refusal, not a wedge
+        # (docs/serving.md capability matrix).
+        if (best_of or n or 1) > 1:
+            raise AdmissionError(
+                "parallel sampling (n/best_of > 1) is not supported "
+                "behind the EngineRouter; submit to a replica engine "
+                "directly or fan out client-side with n=1 requests")
         rreq = RouterRequest(self, dict(
             prompt=list(prompt), max_new_tokens=int(max_new_tokens),
             sampling=sampling, seed=int(seed), priority=int(priority),
-            deadline_s=deadline_s, adapter_id=adapter_id))
+            deadline_s=deadline_s, adapter_id=adapter_id,
+            response_format=response_format))
         # (requests_received is counted by the replica each attempt
         # lands on — the aggregate snapshot sums those; counting here
         # too would double it)

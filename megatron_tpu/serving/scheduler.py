@@ -179,6 +179,48 @@ class AdmissionScheduler:
         self.notify()
         return req
 
+    def submit_many(self, reqs: List[GenRequest]) -> List[GenRequest]:
+        """ATOMIC all-or-nothing admission for an n-best fan-out's
+        child requests: either every sample enqueues or none does — a
+        partially admitted fan-out would strand the caller with fewer
+        streams than it asked for (and its admitted samples would
+        burn slots for a result that can never be complete). The bound
+        check covers the WHOLE group against max_queue; the shed
+        estimate runs once on the first child (the samples share one
+        deadline and one queue position)."""
+        assert reqs, "empty fan-out"
+        for r in reqs:
+            self.check_admissible(r)
+        with self._lock:
+            if self._closed:
+                raise EngineUnhealthyError(
+                    "engine unavailable (queue closed by drain or "
+                    "circuit breaker); retry against another replica")
+            depth = len(self._q)
+            if depth + len(reqs) > self.max_queue:
+                raise QueueFullError(
+                    f"request queue full ({depth} + {len(reqs)}-sample "
+                    f"fan-out exceeds {self.max_queue}); retry later",
+                    retry_after=self._retry_after_locked(depth),
+                    queue_depth=depth)
+            if self.shed_on_overload:
+                head = reqs[0]
+                est = self._estimate_delay_locked(head)
+                ad = head.absolute_deadline(self.default_deadline_s)
+                if est is not None and ad is not None \
+                        and head.submit_time + est > ad:
+                    budget = ad - head.submit_time
+                    raise OverloadShedError(
+                        f"overloaded: estimated queue delay {est:.1f}s "
+                        f"exceeds the fan-out deadline ({budget:.1f}s); "
+                        "shed early — retry later or against another "
+                        "replica",
+                        retry_after=max(1, int(math.ceil(est - budget))),
+                        queue_depth=depth)
+            self._q.extend(reqs)
+        self.notify()
+        return reqs
+
     def requeue(self, req: GenRequest) -> bool:
         """Re-admit a preempted request (no bound check — a victim is
         never *rejected* by its own preemption). On a closed (draining)

@@ -94,11 +94,20 @@ def build_draft_rounds(histories: List[Optional[Sequence[int]]],
                        drafter: Drafter, k: int, rounds: int):
     """Per-round draft grids for one sync window of a speculative
     engine: `histories[s]` is slot s's committed prompt+generated
-    tokens (None = inactive row). Returns (grids, any_real) where
-    `grids` is a list of `rounds` int32 [slots, k] numpy arrays and
+    tokens (None = inactive row). Returns (grids, any_real, guesses)
+    where `grids` is a list of `rounds` int32 [slots, k] numpy arrays,
     `any_real[r]` says whether round r carries at least one real
     draft — an all-filler round is the engine's cue to dispatch the
-    cheaper plain decode step instead (`spec_fallback_steps`).
+    cheaper plain decode step instead (`spec_fallback_steps`) — and
+    `guesses[r]` is the int32 [slots] t0 GUESS each round's drafts
+    were proposed after (the drafter's prediction for the round's
+    device-sampled first token; NO_DRAFT where it proposed nothing).
+    The guess is host-known, so grammar-constrained rows can step
+    their FSM along [guess, d1..dk] to build per-position verify
+    masks; the engine gates acceptance on toks0 == guess for those
+    rows (a wrong guess invalidates the masks, so the round's drafts
+    must reject — misalignment costs acceptance, never correctness,
+    the same contract chained rounds already have).
 
     Chained rounds (decode_sync_interval > 1) are proposed UPFRONT
     from the same host-known history under the optimistic assumption
@@ -120,9 +129,10 @@ def build_draft_rounds(histories: List[Optional[Sequence[int]]],
     for hist in histories:
         conts.append([] if hist is None
                      else list(drafter.propose(hist, need)))
-    grids, any_real = [], []
+    grids, any_real, guesses = [], [], []
     for r in range(rounds):
         grid = np.full((S, k), NO_DRAFT, np.int32)
+        g0 = np.full((S,), NO_DRAFT, np.int32)
         real = False
         for s, cont in enumerate(conts):
             lo = r * (k + 1) + 1
@@ -130,6 +140,9 @@ def build_draft_rounds(histories: List[Optional[Sequence[int]]],
             if piece:
                 grid[s, :len(piece)] = piece
                 real = True
+            if lo - 1 < len(cont):
+                g0[s] = cont[lo - 1]
         grids.append(grid)
         any_real.append(real)
-    return grids, any_real
+        guesses.append(g0)
+    return grids, any_real, guesses

@@ -351,7 +351,42 @@ def test_bench_serving_queue_runs_pending_abs(monkeypatch, tmp_path):
     assert rec["bench"] == "serving_queue"
     assert rec["all_green"] is True
     assert [r["name"] for r in rec["runs"]] == \
-        ["block_attn", "lora", "disagg"]
+        ["block_attn", "lora", "disagg", "structured"]
     assert rec["results"]["block_attn"]["bench"] == "block_native_attn"
     assert rec["results"]["lora"]["bench"] == "lora_adapters"
     assert rec["results"]["disagg"]["bench"] == "disagg_serving"
+    assert rec["results"]["structured"]["bench"] == "structured_nbest"
+
+
+def test_bench_structured_emits_ab_record(monkeypatch, tmp_path):
+    """The structured-output/n-best A/B must run the constrained arm
+    with every output FSM-legal AND parsed (the tool asserts both and
+    exits nonzero on violation), pin mask uploads to FSM state changes
+    (zero on the free arm), run the n=4 fan-out token-exact vs its
+    serially-seeded n=1 twins, and keep ONE decode compile across
+    free + constrained + fan-out traffic — the tentpole's zero-new-
+    traces contract."""
+    import json
+    text = run_tool(monkeypatch, tmp_path, "bench_structured.py",
+                    ["--smoke"])
+    rec = json.loads(text)
+    assert rec["bench"] == "structured_nbest"
+    assert rec["decode_compiles"] == 1
+    ab = rec["constrained_vs_free"]
+    assert ab["outputs_parse"] is True
+    assert ab["free"]["mask_uploads"] == 0
+    assert ab["free"]["structured_requests"] == 0
+    assert ab["constrained"]["mask_uploads"] > 0
+    assert ab["constrained"]["structured_requests"] == 4
+    assert ab["constrained"]["grammar_dead_ends"] == 0
+    # mask uploads follow state changes, never one per step per slot
+    assert ab["constrained"]["mask_uploads"] <= \
+        ab["constrained"]["tokens_generated"] + \
+        ab["constrained"]["structured_requests"]
+    nb = rec["n1_vs_n4"]
+    assert nb["samples_token_exact"] is True
+    assert nb["fanout"]["fanout_requests"] == 1
+    assert nb["fanout"]["fanout_samples"] == nb["n"] == 4
+    assert nb["fanout"]["prefill_tokens_saved"] > 0
+    # the aggregate never prefills the prompt once per sample
+    assert nb["fanout"]["prefill_forward_tokens"] < nb["n"] * 24

@@ -2235,12 +2235,15 @@ class TestSpeculativeDecode:
         assert d.propose([1, 2, 3, 9, 5, 1, 2, 3], 1) == [9]
         assert d.propose([1, 2, 3], 2) == []  # no earlier occurrence
         assert d.propose([4], 2) == []        # history too short
-        grids, any_real = build_draft_rounds(
+        grids, any_real, guesses = build_draft_rounds(
             [[1, 7, 8, 9, 4, 7, 8], None], d, k=2, rounds=2)
         assert len(grids) == 2 and grids[0].shape == (2, 2)
         assert grids[0][0].tolist() == [4, 7]  # C[1:3] of [9,4,7,8,...]
         assert (grids[0][1] == NO_DRAFT).all()  # inactive row = filler
         assert any_real[0] is True
+        # the host-known t0 guess the drafts were proposed after (C[0])
+        # — grammar rows pre-walk their FSM along [guess, d1..dk]
+        assert guesses[0].tolist() == [9, NO_DRAFT]
 
 
 class TestBlockPoolUnits:

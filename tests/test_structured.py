@@ -1,0 +1,793 @@
+"""Structured output + parallel sampling (megatron_tpu/serving).
+
+The load-bearing contracts (ISSUE 16 tentpole):
+- grammar compilation: regex / JSON-schema subset -> trimmed char DFA
+  -> token-level FSM with precomputed mask/next tables; malformed,
+  unsupported, unsatisfiable, or untokenizable grammars refuse LOUDLY
+  at compile time (GrammarCompileError -> 400);
+- the sampler's mask seam: `sample_batched(mask=...)` applies the
+  per-slot legal-vocab bitmask at the post-temperature/top-k/top-p
+  seam, all-True rows are BIT-IDENTICAL to mask=None (free traffic
+  rides the same trace), and an all-banned row returns the -1 sentinel
+  instead of sampling from a renormalized-empty distribution;
+- constrained engine streams are token-exact vs a host-driven masked
+  oracle (an independent serial reimplementation: per-token model
+  forwards + the FSM's own tables through sample_batched) — bf16 AND
+  int8 pools, speculative decoding on AND off — with mask uploads only
+  on FSM state CHANGE and zero extra decode/verify compiles;
+- the FSM state lives on the REQUEST (host-side): it survives
+  preemption park/resume, parked-KV drops, and engine restarts;
+- grammar dead ends fail typed (GrammarDeadEndError -> 422), never a
+  bare RuntimeError, and never poison the engine;
+- n-best fan-out (`n`/`best_of`): one real prefill, COW-aliased prompt
+  blocks, independently seeded token-exact samples, best-first result
+  ordering, and block refcounts that return to baseline (no leak).
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.config import ModelConfig, ServingConfig
+from megatron_tpu.inference import Generator, SamplingParams
+from megatron_tpu.inference.sampling import sample_batched, verify_draft_probs
+from megatron_tpu.models import language_model as lm
+from megatron_tpu.serving import (AdmissionError, FanoutRequest,
+                                  GrammarCompileError, GrammarDeadEndError,
+                                  SamplingOptions, ServingEngine, TokenFSM,
+                                  compile_regex, compile_response_format,
+                                  schema_to_regex, validate_response_format)
+
+# vocab 128 so byte-level identity tokens cover lowercase AND the JSON
+# structural characters ({ } " : 123/125/34/58) the schema grammars emit
+def tiny_cfg(**overrides):
+    base = dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+                num_kv_heads=2, vocab_size=128, seq_length=64,
+                make_vocab_size_divisible_by=64, compute_dtype="float32")
+    base.update(overrides)
+    return ModelConfig(**base).derived()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = tiny_cfg()
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+PROMPT = [5, 17, 3, 42]
+REGEX_RF = {"type": "regex", "pattern": "[0-9]{2,6}"}
+SCHEMA_RF = {"type": "json_schema",
+             "schema": {"type": "object",
+                        "properties": {
+                            "id": {"type": "integer", "minimum": 0,
+                                   "maxDigits": 2},
+                            "ok": {"type": "boolean"}}}}
+
+
+# ---------------------------------------------------------------------
+# grammar compiler units (no engine, no device)
+# ---------------------------------------------------------------------
+class TestGrammarCompiler:
+    def test_char_dfa_matches(self):
+        dfa = compile_regex("(ab|ba){2,3}")
+        assert dfa.matches("abba") and dfa.matches("ababab")
+        assert not dfa.matches("ab") and not dfa.matches("abb")
+        assert not dfa.matches("abbaabba")  # 4 reps > {2,3}
+
+    @pytest.mark.parametrize("pattern", [
+        "(", "a)", "*a", "a{3,1}", "[z-a]", "[]", "a{", "(a",
+    ])
+    def test_malformed_regex_refuses(self, pattern):
+        with pytest.raises(GrammarCompileError):
+            compile_regex(pattern)
+
+    def test_trimmed_dfa_has_no_dead_states(self):
+        # "ab|ac": after 'a' both continuations survive; a transition
+        # into a state that cannot reach accept must not exist, so
+        # "has a next state" IS "can still complete"
+        dfa = compile_regex("ab|ac")
+        for s in range(dfa.n_states):
+            # every state reaches accept: walk any-first-edge greedily
+            cur, hops = s, 0
+            while not dfa.accepting[cur]:
+                assert dfa.trans[cur], f"dead-end state {cur} survived trim"
+                cur = next(iter(dfa.trans[cur].values()))
+                hops += 1
+                assert hops <= dfa.n_states
+
+    def test_schema_lowering_and_unsupported(self):
+        assert schema_to_regex({"type": "boolean"}) == "(true|false)"
+        assert schema_to_regex({"const": "hi"}) == '"hi"'
+        with pytest.raises(GrammarCompileError):
+            schema_to_regex({"type": "frobnicate"})
+        with pytest.raises(GrammarCompileError):
+            schema_to_regex({"type": "array"})  # items required
+        with pytest.raises(GrammarCompileError):
+            schema_to_regex({"type": "object", "properties": {}})
+
+    def test_schema_fsm_accepts_canonical_json_only(self):
+        fsm = compile_response_format(SCHEMA_RF, 128)
+        good = json.dumps({"id": 42, "ok": True}, separators=(",", ":"))
+        toks = [ord(c) for c in good]
+        legal, final = fsm.replay(toks)
+        assert legal and fsm.is_accepting(final)
+        assert fsm.final_text_valid(toks)
+        # whitespace / reordered properties are NOT canonical
+        assert not fsm.dfa.matches('{"ok":true,"id":42}')
+        assert not fsm.dfa.matches('{"id": 42,"ok":true}')
+        # bounded: a budget >= max_path_len guarantees a parse
+        assert fsm.max_path_len is not None
+        assert fsm.max_path_len >= len('{"id":10,"ok":false}')
+
+    def test_token_fsm_tables_identity_tokenizer(self):
+        fsm = compile_response_format(REGEX_RF, 128)
+        digits = set(range(ord("0"), ord("9") + 1))
+        assert set(np.nonzero(fsm.allowed(0))[0].tolist()) == digits
+        s = fsm.step(0, ord("4"))
+        assert s >= 0 and not fsm.is_accepting(s)  # 1 digit < {2,..}
+        s = fsm.step(s, ord("2"))
+        assert fsm.is_accepting(s)
+        assert fsm.step(s, ord("x")) == -1
+        assert fsm.max_path_len == 6
+        legal, _ = fsm.replay([ord("1"), ord("2"), ord("3")])
+        assert legal and fsm.final_text_valid([ord("1"), ord("2")])
+        assert not fsm.final_text_valid([ord("1")])  # too short to parse
+        # cyclic grammar: unbounded
+        assert compile_response_format(
+            {"type": "regex", "pattern": "A[BC]*D"}, 128).max_path_len is None
+
+    def test_eos_column_tracks_acceptance(self):
+        fsm = TokenFSM(compile_regex("[0-9]{2,3}"),
+                       [chr(i) for i in range(128)], eos_id=9)
+        assert (fsm.mask_table[:, 9] == fsm.accepting).all()
+        assert fsm.step(0, 9) == -1  # EOS before any digit: illegal
+        s = fsm.step(fsm.step(0, ord("1")), ord("2"))
+        assert fsm.step(s, 9) == s  # EOS from accept self-loops
+        legal, _ = fsm.replay([ord("1"), ord("2"), 9])
+        assert legal
+        legal, _ = fsm.replay([ord("1"), 9, ord("2")])  # EOS mid-stream
+        assert not legal
+
+    def test_untokenizable_grammar_refuses(self):
+        # vocab {a, b} can never emit a digit: the FSM would dead-end
+        # every sample at its first token — refuse at compile instead
+        with pytest.raises(GrammarCompileError, match="no legal first"):
+            TokenFSM(compile_regex("[0-9]+"), ["a", "b"])
+
+    @pytest.mark.parametrize("rf,frag", [
+        ("nope", "must be an object"),
+        ({"type": "regex"}, "pattern"),
+        ({"type": "regex", "pattern": ""}, "pattern"),
+        ({"type": "json_schema"}, "schema"),
+        ({"type": "xml"}, "regex"),
+    ])
+    def test_validate_response_format(self, rf, frag):
+        assert frag in validate_response_format(rf)
+        assert validate_response_format(REGEX_RF) is None
+        assert validate_response_format(SCHEMA_RF) is None
+
+
+# ---------------------------------------------------------------------
+# sampler mask seam units
+# ---------------------------------------------------------------------
+class TestSamplerMaskSeam:
+    def _knobs(self, b, temp=1.0, top_k=0, top_p=0.0):
+        return dict(temperature=jnp.full((b,), temp, jnp.float32),
+                    top_k=jnp.full((b,), top_k, jnp.int32),
+                    top_p=jnp.full((b,), top_p, jnp.float32))
+
+    def test_all_true_mask_bit_identical_to_none(self):
+        rngs = jax.vmap(jax.random.PRNGKey)(jnp.arange(4))
+        logits = jax.random.normal(jax.random.PRNGKey(7), (4, 32))
+        for knobs in (self._knobs(4, temp=0.9, top_k=5),
+                      self._knobs(4, temp=0.0),
+                      self._knobs(4, temp=1.1, top_p=0.8)):
+            free = sample_batched(rngs, logits, **knobs)
+            masked = sample_batched(rngs, logits, **knobs,
+                                    mask=jnp.ones((4, 32), bool))
+            assert (np.asarray(free) == np.asarray(masked)).all()
+
+    def test_greedy_rows_obey_mask(self):
+        logits = jnp.asarray([[0.0, 5.0, 1.0, 3.0]])
+        mask = jnp.asarray([[True, False, True, True]])
+        rngs = jax.random.PRNGKey(0)[None]
+        out = sample_batched(rngs, logits, **self._knobs(1, temp=0.0),
+                             mask=mask)
+        assert int(out[0]) == 3  # argmax over LEGAL tokens, not 1
+
+    def test_all_banned_row_returns_sentinel(self):
+        logits = jax.random.normal(jax.random.PRNGKey(1), (2, 16))
+        mask = jnp.zeros((2, 16), bool)
+        for knobs in (self._knobs(2, temp=0.0),
+                      self._knobs(2, temp=0.9, top_k=4)):
+            out = sample_batched(jax.vmap(jax.random.PRNGKey)(jnp.arange(2)),
+                                 logits, **knobs, mask=mask)
+            assert (np.asarray(out) == -1).all()
+
+    def test_mask_composes_with_banned_residual(self):
+        # mask admits {0, 1}; the residual carry bans 1 -> only 0 left
+        logits = jnp.asarray([[1.0, 4.0, 9.0, 9.0]])
+        out = sample_batched(
+            jax.random.PRNGKey(3)[None], logits, **self._knobs(1),
+            banned=jnp.asarray([1], jnp.int32),
+            mask=jnp.asarray([[True, True, False, False]]))
+        assert int(out[0]) == 0
+
+    def test_verify_probs_zero_illegal_drafts(self):
+        logits = jax.random.normal(jax.random.PRNGKey(2), (1, 3, 16))
+        drafts = jnp.asarray([[4, 5, 6]], jnp.int32)
+        mask = np.ones((1, 3, 16), bool)
+        mask[0, 1, 5] = False       # position 1's draft is FSM-illegal
+        mask[0, 2, :] = False       # position 2 is a dead position
+        probs, targets = verify_draft_probs(
+            logits, drafts, temperature=jnp.asarray([0.9]),
+            top_k=jnp.asarray([0], jnp.int32), top_p=jnp.asarray([0.0]),
+            mask=jnp.asarray(mask))
+        assert float(probs[0, 1]) == 0.0  # can never be accepted
+        assert int(targets[0, 2]) == -1   # never equals a real draft
+        free_p, free_t = verify_draft_probs(
+            logits, drafts, temperature=jnp.asarray([0.9]),
+            top_k=jnp.asarray([0], jnp.int32), top_p=jnp.asarray([0.0]))
+        # all-True position is bit-identical to mask=None
+        assert float(probs[0, 0]) == float(free_p[0, 0])
+        assert int(targets[0, 0]) == int(free_t[0, 0])
+
+
+# ---------------------------------------------------------------------
+# host-driven masked oracle: an independent serial reimplementation of
+# constrained decoding (per-token model forwards + the FSM tables
+# through sample_batched, the engine's exact PRNG chain)
+# ---------------------------------------------------------------------
+def masked_oracle(gen, prompt, max_new, sampling, seed, fsm):
+    from megatron_tpu.inference.generation import (PREFILL_BUCKET,
+                                                   init_kv_caches)
+    cfg, params, rope = gen.cfg, gen.params, gen.rope
+    plen = len(prompt)
+    min_prompt = max((plen // PREFILL_BUCKET) * PREFILL_BUCKET, 1)
+    caches = init_kv_caches(cfg, 1, 64, dtype=gen.kv_cache_dtype,
+                            prefill_len=min_prompt)
+    toks = jnp.asarray([prompt], jnp.int32)
+    logits, caches = lm.model_forward(params, toks[:, :min_prompt], cfg,
+                                      kv_caches=caches, rope=rope,
+                                      logits_dtype=jnp.float32)
+    last = logits[:, -1]
+    rng = jax.random.PRNGKey(seed)
+    temps = jnp.asarray([sampling.temperature], jnp.float32)
+    tks = jnp.asarray([sampling.top_k], jnp.int32)
+    tps = jnp.asarray([sampling.top_p], jnp.float32)
+    state, out, pos = 0, [], min_prompt
+    while True:
+        rng, r = jax.random.split(rng)
+        if pos < plen:
+            cur = int(prompt[pos])  # in-prompt: keep the prompt token
+        else:
+            mask = np.zeros((1, last.shape[-1]), np.bool_)
+            row = fsm.mask_table[state]
+            mask[0, :row.shape[0]] = row
+            cur = int(sample_batched(
+                r[None], last, temperature=temps, top_k=tks, top_p=tps,
+                vocab_size=cfg.vocab_size, mask=jnp.asarray(mask))[0])
+            assert cur >= 0, f"oracle dead-ended at state {state}"
+            state = fsm.step(state, cur)
+            assert state >= 0
+            out.append(cur)
+            if fsm.is_terminal(state) or len(out) >= max_new:
+                return out, state
+        logits, caches = lm.model_forward(
+            params, jnp.asarray([[cur]], jnp.int32), cfg,
+            kv_caches=caches, rope=rope, logits_dtype=jnp.float32)
+        last = logits[:, 0]
+        pos += 1
+
+
+class TestConstrainedTokenExact:
+    """Tentpole acceptance: constrained streams are token-exact vs the
+    host-driven masked oracle on bf16 AND int8 pools, mixed with free
+    traffic on the same grid, at ONE decode compile."""
+
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_masked_streams_match_oracle(self, tiny_model, kv_dtype):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0,
+                        kv_cache_dtype=(jnp.int8 if kv_dtype
+                                        else jnp.bfloat16))
+        schema_fsm = compile_response_format(SCHEMA_RF, cfg.vocab_size)
+        # (response_format, sampling, seed, budget); top_k/top_p stay
+        # off for the stochastic rows so the grammar's legal set always
+        # intersects the filtered support (dead ends are a separate,
+        # deliberately-constructed test)
+        cases = [
+            (REGEX_RF, SamplingOptions(temperature=0.0), 3, 6),
+            (REGEX_RF, SamplingOptions(temperature=0.9), 11, 6),
+            (SCHEMA_RF, SamplingOptions(temperature=0.8), 7,
+             schema_fsm.max_path_len),
+        ]
+        with ServingEngine(gen, ServingConfig(
+                num_slots=4, max_queue=16, max_len=64)) as eng:
+            snap0 = eng.metrics.snapshot()
+            reqs = [eng.submit(PROMPT, budget, sp, seed=seed,
+                               response_format=rf)
+                    for rf, sp, seed, budget in cases]
+            # free traffic interleaves on the same grid
+            free = eng.submit([7, 8, 9], 6,
+                              SamplingOptions(temperature=0.9), seed=5)
+            for (rf, sp, seed, budget), r in zip(cases, reqs):
+                toks, lps = r.result(timeout=300)
+                got = toks[len(PROMPT):]
+                fsm = compile_response_format(rf, cfg.vocab_size)
+                want, final = masked_oracle(gen, PROMPT, budget, sp,
+                                            seed, fsm)
+                assert got == want, (rf, seed, got, want)
+                legal, _ = fsm.replay(got)
+                assert legal and fsm.final_text_valid(got)
+                assert len(lps) == len(got)
+            free_toks, _ = free.result(timeout=300)
+            want_toks, want_lens, _ = gen.generate(
+                [[7, 8, 9]], 6,
+                sampling=SamplingParams(temperature=0.9), seed=5)
+            assert free_toks == want_toks[0, :want_lens[0]].tolist()
+            snap = eng.metrics.snapshot()
+            d = {k: int(snap[k] - snap0[k]) for k in snap0}
+            assert eng._decode_traces == 1  # grammar = data, not a trace
+            assert d["structured_requests"] == 3
+            assert d["grammar_dead_ends"] == 0
+            # uploads track FSM state CHANGES, never one per step/slot
+            transitions = sum(len(r.generated) for r in reqs) + len(reqs)
+            assert 0 < d["mask_uploads"] <= transitions
+
+    def test_speculative_composition(self, tiny_model):
+        """Draft/verify rounds under grammar: greedy stays token-exact
+        vs the oracle (speculation is a scheduling change), stochastic
+        streams stay FSM-legal (FSM-illegal drafts can never be
+        accepted), and decode AND verify each compile once."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+        with ServingEngine(gen, ServingConfig(
+                num_slots=3, max_queue=16, max_len=64,
+                speculative_k=4)) as eng:
+            greedy = eng.submit(PROMPT, 6, SamplingOptions(temperature=0.0),
+                                seed=3, response_format=REGEX_RF)
+            stoch = eng.submit(PROMPT, 6, SamplingOptions(temperature=0.9),
+                               seed=21, response_format=REGEX_RF)
+            free = eng.submit([7, 8, 9], 6,
+                              SamplingOptions(temperature=0.0), seed=0)
+            fsm = compile_response_format(REGEX_RF, cfg.vocab_size)
+            g_toks, _ = greedy.result(timeout=300)
+            want, _ = masked_oracle(gen, PROMPT, 6,
+                                    SamplingOptions(temperature=0.0), 3, fsm)
+            assert g_toks[len(PROMPT):] == want
+            s_toks, _ = stoch.result(timeout=300)
+            legal, _ = fsm.replay(s_toks[len(PROMPT):])
+            assert legal and fsm.final_text_valid(s_toks[len(PROMPT):])
+            f_toks, _ = free.result(timeout=300)
+            want_toks, want_lens, _ = gen.generate(
+                [[7, 8, 9]], 6, sampling=SamplingParams(temperature=0.0))
+            assert f_toks == want_toks[0, :want_lens[0]].tolist()
+            assert eng._decode_traces == 1
+            assert eng._verify_traces == 1
+
+    def test_mask_upload_cadence_self_loop_vs_chain(self, tiny_model):
+        """A grammar that sits in ONE state (`A*`) uploads its mask
+        once at activation; a state-per-token chain re-uploads per
+        transition — the counter proves uploads track state changes."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+        with ServingEngine(gen, ServingConfig(
+                num_slots=1, max_queue=8, max_len=64)) as eng:
+            snap0 = eng.metrics.snapshot()
+            r = eng.submit(PROMPT, 8, SamplingOptions(temperature=0.0),
+                           response_format={"type": "regex",
+                                            "pattern": "A*"})
+            toks, _ = r.result(timeout=300)
+            assert toks[len(PROMPT):] == [ord("A")] * 8
+            d1 = int(eng.metrics.snapshot()["mask_uploads"]
+                     - snap0["mask_uploads"])
+            # 1 activation upload (+ at most 1 eviction clear)
+            assert 1 <= d1 <= 2, d1
+            snap0 = eng.metrics.snapshot()
+            r = eng.submit(PROMPT, 6, SamplingOptions(temperature=0.0),
+                           response_format={"type": "regex",
+                                            "pattern": "[0-9]{6}"})
+            r.result(timeout=300)
+            d2 = int(eng.metrics.snapshot()["mask_uploads"]
+                     - snap0["mask_uploads"])
+            assert d2 >= 5 > d1, (d1, d2)
+
+    def test_grammar_dead_end_fails_typed_engine_survives(self,
+                                                          tiny_model):
+        """Force a dead end deterministically: top_p keeps ONLY the
+        unconstrained argmax, the grammar bans exactly that token, so
+        the masked distribution is empty -> GrammarDeadEndError (422),
+        counted, and the engine keeps serving."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+        with ServingEngine(gen, ServingConfig(
+                num_slots=1, max_queue=8, max_len=64)) as eng:
+            toks, _ = eng.generate(PROMPT, 1,
+                                   SamplingOptions(temperature=0.0),
+                                   timeout=300)
+            g = toks[len(PROMPT)]  # the unconstrained argmax token
+            lone = ord("A") if g != ord("A") else ord("B")
+            r = eng.submit(PROMPT, 4, SamplingOptions(temperature=1.0,
+                                                      top_p=1e-6),
+                           seed=1,
+                           response_format={"type": "regex",
+                                            "pattern": chr(lone)})
+            with pytest.raises(GrammarDeadEndError):
+                r.result(timeout=300)
+            snap = eng.metrics.snapshot()
+            assert snap["grammar_dead_ends"] >= 1
+            assert snap["requests_failed"] >= 1
+            # the grid still serves fresh requests afterwards
+            after, _ = eng.generate([9, 10], 3,
+                                    SamplingOptions(temperature=0.0),
+                                    timeout=300)
+            want, lens, _ = gen.generate(
+                [[9, 10]], 3, sampling=SamplingParams(temperature=0.0))
+            assert after == want[0, :lens[0]].tolist()
+
+    def test_uncompilable_grammar_is_admission_error(self, tiny_model):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+        with ServingEngine(gen, ServingConfig(
+                num_slots=1, max_queue=8, max_len=64)) as eng:
+            with pytest.raises(AdmissionError, match="does not compile"):
+                eng.submit(PROMPT, 4, response_format={"type": "regex",
+                                                       "pattern": "("})
+            snap = eng.metrics.snapshot()
+            assert snap["requests_received"] == snap["requests_rejected"]
+
+
+# ---------------------------------------------------------------------
+# FSM persistence: preemption park/resume, parked drop, engine restart
+# ---------------------------------------------------------------------
+class TestFsmPersistence:
+    def _engine(self, gen, **kw):
+        base = dict(num_slots=1, max_queue=16, max_len=64,
+                    priority_levels=2, preemption=True)
+        base.update(kw)
+        return ServingEngine(gen, ServingConfig(**base))
+
+    def _preempt_victim(self, eng, victim, hp_seed=11):
+        t0 = time.monotonic()
+        while len(victim.generated) < 2 and not victim.done():
+            time.sleep(0.002)
+            assert time.monotonic() - t0 < 60
+        hp = eng.submit([7, 8, 9], 4, SamplingOptions(temperature=0.9),
+                        seed=hp_seed, priority=1)
+        return hp, t0
+
+    def test_fsm_survives_preempt_resume_token_exact(self, tiny_model):
+        """A structured request preempted mid-grammar resumes from its
+        parked KV with the SAME fsm_state (host-side, on the request)
+        and stays token-exact vs the masked oracle."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+        rf = {"type": "regex", "pattern": "[0-9]{8,12}"}
+        sp = SamplingOptions(temperature=0.9)
+        with self._engine(gen) as eng:
+            victim = eng.submit(PROMPT, 12, sp, seed=9, priority=0,
+                                response_format=rf)
+            hp, _ = self._preempt_victim(eng, victim)
+            hp.result(timeout=300)
+            toks, _ = victim.result(timeout=300)
+            assert victim.preemptions >= 1
+            assert eng._decode_traces == 1
+        fsm = compile_response_format(rf, cfg.vocab_size)
+        want, _ = masked_oracle(gen, PROMPT, 12, sp, 9, fsm)
+        assert toks[len(PROMPT):] == want
+        assert fsm.final_text_valid(toks[len(PROMPT):])
+
+    def test_fsm_survives_parked_drop_replay(self, tiny_model):
+        """When the parked KV is dropped, the victim replays its
+        effective prompt through prefill — the FSM state (like the
+        PRNG copy) carries the grammar walk across the gap."""
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+        rf = {"type": "regex", "pattern": "[0-9]{8,12}"}
+        sp = SamplingOptions(temperature=0.9)
+        with self._engine(gen) as eng:
+            victim = eng.submit(PROMPT, 12, sp, seed=13, priority=0,
+                                response_format=rf)
+            hp, t0 = self._preempt_victim(eng, victim)
+            while victim.preemptions == 0 and not victim.done():
+                time.sleep(0.002)
+                assert time.monotonic() - t0 < 60
+            dropped = eng.scheduler.clear_parked()
+            hp.result(timeout=300)
+            toks, _ = victim.result(timeout=300)
+            assert victim.preemptions >= 1
+            assert dropped >= 1  # the fallback actually exercised
+        fsm = compile_response_format(rf, cfg.vocab_size)
+        want, _ = masked_oracle(gen, PROMPT, 12, sp, 13, fsm)
+        assert toks[len(PROMPT):] == want
+
+    @pytest.mark.chaos
+    def test_fsm_survives_engine_restart(self, tiny_model):
+        """A queued structured request rides through a crash-restart:
+        its admission-time FSM (request-side, host-side) needs no
+        device state, so the restarted session serves it token-exact."""
+        from megatron_tpu.resilience import (FaultInjector,
+                                             use_fault_injector)
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+        sp = SamplingOptions(temperature=0.9)
+        with ServingEngine(gen, ServingConfig(
+                num_slots=1, max_queue=8, max_len=64,
+                max_engine_restarts=2)) as eng:
+            eng.generate([9, 9], 2, sp, seed=0, timeout=300)  # warm
+            with use_fault_injector(FaultInjector(serve_crash_calls={1})):
+                victim = eng.submit([1, 2, 3], 6, sp, seed=1)
+                queued = eng.submit(PROMPT, 6,
+                                    SamplingOptions(temperature=0.8),
+                                    seed=2, response_format=REGEX_RF)
+                with pytest.raises(RuntimeError, match="engine step"):
+                    victim.result(timeout=120)
+                toks, _ = queued.result(timeout=120)
+            assert eng.metrics.snapshot()["engine_restarts"] == 1
+        fsm = compile_response_format(REGEX_RF, cfg.vocab_size)
+        want, _ = masked_oracle(gen, PROMPT, 6,
+                                SamplingOptions(temperature=0.8), 2, fsm)
+        assert toks[len(PROMPT):] == want
+
+
+# ---------------------------------------------------------------------
+# n-best fan-out: one prefill, COW blocks, independent seeds, no leaks
+# ---------------------------------------------------------------------
+class TestFanout:
+    # NOT a multiple of the 16-token block: a whole-prompt prefix hit
+    # caps at plen-1, so a block-aligned prompt would round the COW
+    # alias down to zero blocks and hide the savings
+    FPROMPT = [1 + (i * 7) % 90 for i in range(24)]
+
+    @pytest.fixture(scope="class")
+    def block_engine(self, tiny_model):
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+        # retained_slots=0: finished rows RELEASE instead of converting
+        # to retained prefixes — a retained entry would legitimately
+        # keep the shared prompt block pinned and mask the refcount
+        # no-leak check (COW aliasing itself rides the PENDING-prefill
+        # index entries, which retention does not gate)
+        eng = ServingEngine(gen, ServingConfig(
+            num_slots=4, max_queue=32, max_len=64, kv_block_size=16,
+            enable_prefix_cache=True, retained_slots=0))
+        yield gen, eng
+        eng.close()
+
+    def test_one_prefill_cow_alias_token_exact_seeding(self, block_engine):
+        gen, eng = block_engine
+        sp = SamplingOptions(temperature=0.8)
+        # warm the compile caches so counter deltas are pure fan-out
+        eng.generate([3, 4, 5], 2, SamplingOptions(temperature=0.0),
+                     timeout=300)
+        baseline_shared = eng.pool.shared_block_count()
+        snap0 = eng.metrics.snapshot()
+        agg = eng.submit(self.FPROMPT, 6, sp, seed=5, n=4, best_of=4)
+        assert isinstance(agg, FanoutRequest) and agg.n == 4
+        toks_list, lps_list = agg.result(timeout=300)
+        d = {k: int(v - snap0[k])
+             for k, v in eng.metrics.snapshot().items() if k in snap0}
+        # the COW seam: ONE real prefill, siblings alias whole blocks
+        assert d["fanout_requests"] == 1 and d["fanout_samples"] == 4
+        assert d["prefix_hits"] >= 3
+        assert d["prefill_tokens_saved"] > 0
+        assert d["prefill_forward_tokens"] < 4 * len(self.FPROMPT)
+        # independent seeding: child i == a lone submit at seed + i
+        # (children keep sample-index order; result() is best-first)
+        for i, c in enumerate(agg.children):
+            assert c.seed == 5 + i
+            want, lens, _ = gen.generate(
+                [self.FPROMPT], 6,
+                sampling=SamplingParams(temperature=0.8), seed=5 + i)
+            assert (list(c.prompt) + list(c.generated)
+                    == want[0, :lens[0]].tolist()), i
+        # best-first ordering by summed generated logprob
+        ranked = sorted(
+            ((c.sample_index, list(c.prompt) + list(c.generated),
+              list(c.gen_logprobs)) for c in agg.children),
+            key=lambda t: (-sum(t[2]), t[0]))
+        assert toks_list == [t[1] for t in ranked]
+        assert lps_list == [t[2] for t in ranked]
+        assert eng._decode_traces == 1
+        # refcount no-leak: every aliased block released -> the shared
+        # count returns to its pre-fan-out value (eviction is lazy, so
+        # poll bounded)
+        t0 = time.monotonic()
+        while eng.pool.shared_block_count() != baseline_shared:
+            time.sleep(0.01)
+            assert time.monotonic() - t0 < 30, (
+                eng.pool.shared_block_count(), baseline_shared)
+
+    def test_n_best_of_subset_and_admission_bounds(self, block_engine):
+        gen, eng = block_engine
+        sp = SamplingOptions(temperature=0.9)
+        agg = eng.submit(self.FPROMPT, 4, sp, seed=40, n=2, best_of=4)
+        toks_list, lps_list = agg.result(timeout=300)
+        assert len(toks_list) == 2 == len(lps_list)
+        # the 2 returned are the best of all 4 by summed logprob
+        all_scores = sorted(-sum(c.gen_logprobs) for c in agg.children)
+        got_scores = sorted(-sum(lp) for lp in lps_list)
+        assert got_scores == all_scores[:2]
+        with pytest.raises(AdmissionError, match="exceeds"):
+            eng.submit(self.FPROMPT, 2, sp, n=5, best_of=5)
+        with pytest.raises(AdmissionError, match="n <= best_of"):
+            eng.submit(self.FPROMPT, 2, sp, n=3, best_of=2)
+
+    def test_fanout_composes_with_grammar(self, block_engine):
+        """Structured n-best: ONE FSM compile shared by the fan-out,
+        every sample independently seeded AND grammar-valid."""
+        gen, eng = block_engine
+        agg = eng.submit(self.FPROMPT, 6,
+                         SamplingOptions(temperature=0.9), seed=60,
+                         n=2, best_of=2, response_format=REGEX_RF)
+        toks_list, _ = agg.result(timeout=300)
+        fsm = agg.children[0].fsm
+        assert fsm is agg.children[1].fsm  # one compile, shared
+        for toks in toks_list:
+            got = toks[len(self.FPROMPT):]
+            legal, _ = fsm.replay(got)
+            assert legal and fsm.final_text_valid(got)
+        # samples differ (independent seeds) with overwhelming odds
+        assert toks_list[0] != toks_list[1]
+
+    def test_invariant_sweep_covers_structured_and_fanout(self,
+                                                          block_engine):
+        from megatron_tpu.serving import invariants
+        gen, eng = block_engine
+        reqs = [
+            eng.submit(self.FPROMPT, 4, SamplingOptions(temperature=0.7),
+                       seed=80, n=2, best_of=2),
+            eng.submit(PROMPT, 6, SamplingOptions(temperature=0.0),
+                       seed=81, response_format=REGEX_RF),
+        ]
+        for r in reqs:
+            r.result(timeout=300)
+        report = invariants.check_all(eng, requests=reqs)
+        assert report["ok"]
+        assert report["grammar"]["checked"] == 1
+        assert report["grammar"]["parsed"] == 1
+        assert "grammar_validity" in report["laws_checked"]
+
+    def test_grammar_validity_law_catches_illegal_stream(self):
+        from megatron_tpu.serving.invariants import (InvariantViolation,
+                                                     check_grammar_validity)
+        from megatron_tpu.serving.request import GenRequest
+        req = GenRequest(list(PROMPT), 4, SamplingOptions(temperature=0.0),
+                         seed=0)
+        req.fsm = compile_response_format(REGEX_RF, 128)
+        req.fsm_state = 0
+        req.generated = [ord("1"), ord("x")]  # 'x' is FSM-illegal
+        req.finish()
+        with pytest.raises(InvariantViolation, match="FSM-ILLEGAL"):
+            check_grammar_validity([req])
+
+
+# ---------------------------------------------------------------------
+# HTTP boundary: typed 400s on both transports' shared validator, 422
+# dead ends, fan-out response shapes
+# ---------------------------------------------------------------------
+class FakeTokenizer:
+    vocab_size = 128
+    eod = 0
+    bos = 1
+
+    def tokenize(self, text):
+        return [2 + (ord(c) % 120) for c in text][:16]
+
+    def detokenize(self, ids):
+        return " ".join(str(i) for i in ids)
+
+
+class TestHttpBoundary:
+    @pytest.fixture(scope="class")
+    def server(self, tiny_model):
+        from megatron_tpu.inference.server import MegatronServer
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+        srv = MegatronServer(gen, FakeTokenizer(),
+                             serving=ServingConfig(num_slots=4,
+                                                   max_queue=16,
+                                                   max_len=64))
+        yield srv
+        srv.close()
+
+    @pytest.mark.parametrize("payload,frag", [
+        ({"prompts": ["hi"], "response_format": "x"},
+         "must be an object"),
+        ({"prompts": ["hi"], "response_format": {"type": "regex"}},
+         "pattern"),
+        ({"prompts": ["hi"], "response_format": {"type": "xml"}},
+         "regex"),
+        ({"prompts": ["hi"], "n": True}, "must be an integer"),
+        ({"prompts": ["hi"], "n": 0}, ">= 1"),
+        ({"prompts": ["hi"], "best_of": "two"}, "must be an integer"),
+        ({"prompts": ["hi"], "n": 3, "best_of": 2}, "must be <="),
+        ({"prompts": ["hi"], "n": 2, "best_of": 2, "beam_width": 2},
+         "beam search"),
+        ({"prompts": ["hi"], "n": 2, "best_of": 2, "serial": True},
+         "serving-engine path"),
+        ({"prompts": ["hi"], "serial": True,
+          "response_format": {"type": "regex", "pattern": "[0-9]+"}},
+         "serving-engine path"),
+        ({"prompts": ["hi"], "tokens_to_generate": 4,
+          "response_format": {"type": "regex", "pattern": "("}},
+         "does not compile"),
+    ])
+    def test_structured_payload_400s(self, server, payload, frag):
+        status, body = server.handle({"tokens_to_generate": 2, **payload})
+        assert status == 400, (payload, body)
+        assert frag in body["message"], body
+
+    def test_constrained_output_parses_through_server(self, server):
+        status, body = server.handle(
+            {"prompts": ["hi"], "tokens_to_generate": 6,
+             "temperature": 0.0,
+             "response_format": {"type": "regex",
+                                 "pattern": "[0-9]{2,6}"}})
+        assert status == 200, body
+        seg = body["segments"][0]
+        plen = len(FakeTokenizer().tokenize("hi"))
+        text = "".join(chr(t) for t in seg[plen:])
+        assert text.isdigit() and 2 <= len(text) <= 6, text
+
+    def test_fanout_response_shapes(self, server):
+        status, body = server.handle(
+            {"prompts": ["hi", "yo"], "tokens_to_generate": 3,
+             "temperature": 0.8, "random_seed": 3, "n": 2, "best_of": 2,
+             "logprobs": True})
+        assert status == 200, body
+        # per-prompt entries become LISTS of n samples
+        for field in ("text", "segments", "logprobs"):
+            assert len(body[field]) == 2
+            assert all(isinstance(e, list) and len(e) == 2
+                       for e in body[field]), body[field]
+        assert all(isinstance(t, str) for t in body["text"][0])
+
+    def test_grammar_dead_end_is_422(self, server):
+        # find the unconstrained argmax, then ban exactly it (top_p
+        # keeps only the argmax; the single-char grammar excludes it)
+        status, body = server.handle(
+            {"prompts": ["hi"], "tokens_to_generate": 1,
+             "temperature": 0.0})
+        assert status == 200
+        plen = len(FakeTokenizer().tokenize("hi"))
+        g = body["segments"][0][plen]
+        lone = "A" if g != ord("A") else "B"
+        status, body = server.handle(
+            {"prompts": ["hi"], "tokens_to_generate": 4,
+             "temperature": 1.0, "top_p": 1e-6, "random_seed": 1,
+             "response_format": {"type": "regex", "pattern": lone}})
+        assert status == 422, body
+        # a well-formed follow-up still serves
+        status, _ = server.handle({"prompts": ["ok"],
+                                   "tokens_to_generate": 2,
+                                   "temperature": 0.0})
+        assert status == 200
+
+    def test_router_refuses_fanout_typed(self, tiny_model):
+        from megatron_tpu.serving.router import EngineRouter
+        params, cfg = tiny_model
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+        serving = ServingConfig(num_slots=2, max_queue=8,
+                                max_len=64).validate(cfg)
+        router = EngineRouter([ServingEngine(gen, serving)])
+        try:
+            with pytest.raises(AdmissionError, match="not supported"):
+                router.submit(PROMPT, 4, SamplingOptions(temperature=0.8),
+                              n=2, best_of=2)
+            # structured n=1 rides the router fine
+            r = router.submit(PROMPT, 6, SamplingOptions(temperature=0.0),
+                              seed=2, response_format=REGEX_RF)
+            toks, _ = r.result(timeout=300)
+            fsm = compile_response_format(REGEX_RF, cfg.vocab_size)
+            want, _ = masked_oracle(gen, PROMPT, 6,
+                                    SamplingOptions(temperature=0.0),
+                                    2, fsm)
+            assert toks[len(PROMPT):] == want
+        finally:
+            router.close()

@@ -42,6 +42,9 @@ QUEUE = [
     ("block_attn", "bench_block_attn.py", ["--smoke"], []),
     ("lora", "bench_lora.py", ["--smoke"], []),
     ("disagg", "bench_disagg.py", ["--smoke"], []),
+    # structured output + COW n-best (constrained-vs-free mask-upload
+    # cadence, n=1x4-vs-n=4 one-prefill fan-out)
+    ("structured", "bench_structured.py", ["--smoke"], []),
 ]
 
 

@@ -16,7 +16,11 @@ complement: from a single ``--seed`` it
    silently skipped;
 2. **generates a randomized workload** — shared prefixes, priorities,
    hopeless deadlines, adapter mix, seeded stochastic sampling, a
-   streaming consumer, mid-flight cancels;
+   streaming consumer, mid-flight cancels, grammar-constrained
+   requests (seeded draws from a bounded/cyclic regex + json_schema
+   pool, checked by the grammar-validity law AND token-exact vs a
+   quiet single-slot oracle engine), and n=2 COW fan-out requests
+   (each sample independently seed-checked);
 3. **interleaves a randomized fault schedule** — engine-step faults
    drawn from the extended `FaultInjector` (serve_delay / serve_crash /
    serve_nan / serve_host_corrupt / serve_adapter_corrupt) plus
@@ -44,6 +48,7 @@ vacuous (test-pinned).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import random
 import sys
@@ -60,9 +65,27 @@ N_DEVICES = 4  # forced host platform: disagg/tp configs need 2x2
 
 # smoke seed set: each (seed, require) pair is a full repro line; the
 # `require` tokens bias the sampler toward a matrix corner so the
-# fixed smoke always covers adapters, disaggregation, and a
-# live-weight swap regardless of what the bare seed would draw
-SMOKE_SEEDS = [(7, ("adapters",)), (11, ("disagg",)), (23, ("swap",))]
+# fixed smoke always covers adapters, disaggregation, a live-weight
+# swap, structured output, and n-best fan-out regardless of what the
+# bare seed would draw
+SMOKE_SEEDS = [(7, ("adapters",)), (11, ("disagg",)), (23, ("swap",)),
+               (31, ("structured",)), (43, ("fanout",))]
+
+# the seeded grammar pool: every entry compiles against the tiny
+# model's vocab-128 identity token table (token i <-> chr(i)), so
+# masked decoding emits literal ASCII. `bounded` entries have an
+# acyclic DFA — the workload gives them max_new_tokens >= the longest
+# path, which arms the law-7 PARSE check (final_text_valid), not just
+# per-token legality; the cyclic entry keeps unbounded-grammar
+# coverage (validity-only).
+GRAMMAR_POOL = [
+    {"type": "regex", "pattern": "(ab|ba){2,3}"},
+    {"type": "regex", "pattern": "[0-9]{2,5}"},
+    {"type": "regex", "pattern": "(foo|bar|quux)"},
+    {"type": "regex", "pattern": "a[bc]*d"},  # cyclic: validity-only
+    {"type": "json_schema",
+     "schema": {"type": "integer", "minimum": 0, "maxDigits": 3}},
+]
 
 
 # ---------------------------------------------------------------------
@@ -118,6 +141,11 @@ def sample_config(rng: random.Random, require=()):
             kw["num_replicas"] = 2
         if "tp" in require:
             kw["serving_tp"] = 2
+        if "fanout" in require:
+            # fan-out aggregates are engine-level (the router's retry
+            # pump refuses best_of > 1 typed) — pin a bare engine so
+            # the required n=2 specs actually admit
+            kw["num_replicas"] = 1
         # resource clamp (not a matrix exclusion): N_DEVICES virtual
         # devices must fit num_replicas x devices_per_engine
         per = kw["serving_tp"] * (2 if kw["disaggregate_prefill"]
@@ -146,17 +174,23 @@ def sample_config(rng: random.Random, require=()):
 # 2. seeded workload
 # ---------------------------------------------------------------------
 def build_workload(rng: random.Random, serving_kw: dict,
-                   n_requests: int, new_tokens: int):
+                   n_requests: int, new_tokens: int, require=()):
     """Randomized request specs: shared prefixes, priorities, hopeless
     deadlines, adapter mix, seeded stochastic sampling (greedy-only
     when speculative — stochastic spec rows are distribution-correct,
-    not serial-bit-reproducing). Returns (specs, cancel_idx,
+    not serial-bit-reproducing), grammar-constrained requests from
+    GRAMMAR_POOL, and n=2 fan-out requests (bare engines only — the
+    router refuses best_of > 1 typed). The grammar draw rides the SAME
+    seeded rng stream as everything else, so the ``--seed`` repro line
+    regenerates the exact grammars too. Returns (specs, cancel_idx,
     stream_idx)."""
     from megatron_tpu.serving import SamplingOptions
+    from megatron_tpu.serving.structured import compile_response_format
     prefixes = [[rng.randrange(2, 120) for _ in range(rng.choice([16, 20]))]
                 for _ in range(2)]
     adapters = ([None, "tenant-0", "tenant-1"]
                 if serving_kw.get("adapter_slots") else [None])
+    fanout_ok = serving_kw.get("num_replicas", 1) == 1
     specs = []
     for i in range(n_requests):
         if rng.random() < 0.4:
@@ -179,6 +213,24 @@ def build_workload(rng: random.Random, serving_kw: dict,
             deadline_s=(0.001 if rng.random() < 0.12 else None),
             adapter_id=rng.choice(adapters),
         ))
+        # structured axis: grammar-constrained decode under the storm
+        # (law 7 checks FSM legality + parse; the quiet-engine oracle
+        # pins the masked stream token-exact)
+        if rng.random() < 0.25 or ("structured" in require and i == 1):
+            rf = rng.choice(GRAMMAR_POOL)
+            fsm = compile_response_format(rf, 128)
+            specs[i]["response_format"] = rf
+            specs[i]["deadline_s"] = None  # completed streams feed law 7
+            if fsm.max_path_len is not None:
+                # bounded grammar: budget covers the longest path, so
+                # the sweep's PARSE check arms (not just legality)
+                specs[i]["max_new_tokens"] = fsm.max_path_len
+        # fan-out axis: n=2 COW samples off one prefill (num_slots=2
+        # caps best_of at 2 here); composes with structured draws
+        if fanout_ok and (rng.random() < 0.2
+                          or ("fanout" in require and i == 1)):
+            specs[i]["n"] = 2
+            specs[i]["best_of"] = 2
         # at least one deadline-less greedy request so the storm
         # always has an oracle-checkable completion
         if i == 0:
@@ -267,11 +319,17 @@ def _build_target(model_kwargs: dict, serving_kw: dict):
 
 
 def _make_oracles(gen, model_kwargs: dict, serving_kw: dict,
-                  adapters: dict, gen_v2=None):
+                  adapters: dict, gen_v2=None, aux=None):
     """Per-weight-version oracle fns for invariants.check_token_exact:
     each maps a completed request -> the serial ground truth for its
     (prompt, n, seed, sampling) under its adapter's MERGED weights.
-    Int8 pools get int8-kv serial generators (matched cache numerics)."""
+    Int8 pools get int8-kv serial generators (matched cache numerics).
+    Grammar-constrained requests route to a lazily-built QUIET oracle
+    engine instead (single slot, no faults, no speculation): the
+    serial Generator has no mask seam, but a calm engine walking the
+    same seeded chain is the ground truth the stormed engine must
+    match. Engines built here are appended to `aux` for the caller to
+    close."""
     import jax.numpy as jnp
 
     from megatron_tpu.inference.generation import (Generator,
@@ -279,6 +337,7 @@ def _make_oracles(gen, model_kwargs: dict, serving_kw: dict,
     kv_dtype = (jnp.int8 if serving_kw.get("kv_dtype") == "int8"
                 else jnp.bfloat16)
     rank, alpha = 4, 8.0
+    aux = aux if aux is not None else []
 
     def _mk(base_gen):
         cache = {}
@@ -297,6 +356,28 @@ def _make_oracles(gen, model_kwargs: dict, serving_kw: dict,
                                               kv_cache_dtype=kv_dtype)
             return cache[adapter_id]
 
+        quiet = []
+
+        def _quiet_engine():
+            if not quiet:
+                from megatron_tpu.config import ServingConfig
+                from megatron_tpu.serving import ServingEngine
+                skw = dict(num_slots=1, max_queue=64,
+                           max_len=serving_kw.get("max_len", 128))
+                if serving_kw.get("kv_dtype"):
+                    skw["kv_dtype"] = serving_kw["kv_dtype"]
+                if serving_kw.get("adapter_slots"):
+                    skw["adapter_slots"] = serving_kw["adapter_slots"]
+                eng = ServingEngine(
+                    base_gen,
+                    ServingConfig(**skw).validate(base_gen.cfg))
+                for aid, factors in sorted(adapters.items()):
+                    eng.register_adapter(aid, factors=factors,
+                                         rank=rank, alpha=alpha)
+                aux.append(eng)
+                quiet.append(eng)
+            return quiet[0]
+
         want_cache = {}
 
         def want(req):
@@ -308,16 +389,29 @@ def _make_oracles(gen, model_kwargs: dict, serving_kw: dict,
             aid = getattr(req, "adapter_id", None)
             if aid is None and hasattr(req, "spec"):
                 aid = req.spec.get("adapter_id")
+            rf = getattr(req, "response_format", None)
+            if rf is None and hasattr(req, "spec"):
+                rf = req.spec.get("response_format")
             key = (aid, tuple(req.prompt), n, seed,
-                   (sp.temperature, sp.top_k, sp.top_p))
+                   (sp.temperature, sp.top_k, sp.top_p),
+                   json.dumps(rf, sort_keys=True) if rf else None)
             if key not in want_cache:
-                t, lens, _ = _gen_for(aid).generate(
-                    [list(req.prompt)], n,
-                    sampling=SamplingParams(temperature=sp.temperature,
-                                            top_k=sp.top_k,
-                                            top_p=sp.top_p),
-                    seed=seed)
-                want_cache[key] = t[0, :lens[0]].tolist()
+                if rf is not None:
+                    r2 = _quiet_engine().submit(
+                        list(req.prompt), n, sp, seed=seed,
+                        adapter_id=aid, response_format=rf)
+                    # result() is prompt + generated, same shape the
+                    # token-exact law compares against
+                    toks, _ = r2.result(timeout=120.0)
+                    want_cache[key] = list(toks)
+                else:
+                    t, lens, _ = _gen_for(aid).generate(
+                        [list(req.prompt)], n,
+                        sampling=SamplingParams(
+                            temperature=sp.temperature,
+                            top_k=sp.top_k, top_p=sp.top_p),
+                        seed=seed)
+                    want_cache[key] = t[0, :lens[0]].tolist()
             return want_cache[key]
 
         return want
@@ -345,7 +439,7 @@ def run_one(seed: int, require=(), n_requests: int = 12,
              + f" --requests {n_requests} --new_tokens {new_tokens}")
     model_kwargs, serving_kw, rejections = sample_config(rng, require)
     specs, cancel_idx, stream_idx = build_workload(
-        rng, serving_kw, n_requests, new_tokens)
+        rng, serving_kw, n_requests, new_tokens, require=require)
     injector, fault_kinds = build_fault_injector(rng, serving_kw)
     actions = build_actions(rng, serving_kw, require)
 
@@ -372,11 +466,18 @@ def run_one(seed: int, require=(), n_requests: int = 12,
         "validate_rejections": len(rejections),
         "rejection_kinds": [r["rejected"] for r in rejections],
         "fault_kinds": fault_kinds, "actions": actions,
+        # the seeded structured/fan-out draw (grammars regenerate from
+        # the --seed repro line; recorded for log-line readability)
+        "grammars": sorted({json.dumps(s["response_format"],
+                                       sort_keys=True)
+                            for s in specs if s.get("response_format")}),
+        "fanout_specs": sum(1 for s in specs if s.get("best_of", 1) > 1),
     }
     reqs: list = []
     action_log = []
     stream_seen: list = []
     violations: list = []
+    aux_engines: list = []  # quiet oracle engines (closed in finally)
     try:
         # warmup: compiles + the shed estimator's first sample, BEFORE
         # the injector arms (the fault schedule indexes steady steps)
@@ -388,9 +489,13 @@ def run_one(seed: int, require=(), n_requests: int = 12,
                     r = target.submit(**spec)
                     reqs.append(r)
                     if i == stream_idx:
+                        # fan-out aggregates have no token stream of
+                        # their own — follow sample 0, like the SSE
+                        # layer's sample-major generator does
+                        watch = (getattr(r, "children", None) or [r])[0]
                         threading.Thread(
                             target=_stream_watch,
-                            args=(r, stream_seen), daemon=True).start()
+                            args=(watch, stream_seen), daemon=True).start()
                     if i == cancel_idx:
                         time.sleep(0.01)
                         target.cancel(r)
@@ -419,7 +524,8 @@ def run_one(seed: int, require=(), n_requests: int = 12,
         # terminals / zero stranded), full accounting, oracle
         # exactness at every admitted weight version
         oracles = _make_oracles(gen, model_kwargs, serving_kw,
-                                adapters, gen_v2=gen_v2)
+                                adapters, gen_v2=gen_v2,
+                                aux=aux_engines)
         final = cc.invariant_sweep(target, reqs=reqs, oracles=oracles,
                                    strict=True, timeout=120.0)
         violations.extend(final["violations"])
@@ -444,6 +550,11 @@ def run_one(seed: int, require=(), n_requests: int = 12,
             target.close()
         except Exception:  # noqa: BLE001
             pass
+        for eng in aux_engines:
+            try:
+                eng.close()
+            except Exception:  # noqa: BLE001
+                pass
     record.update({
         "faults_fired": [f"{k}:{d}" for k, d in injector.fired],
         "action_log": action_log,
@@ -537,7 +648,8 @@ def run_smoke(n_requests: int, new_tokens: int) -> dict:
         "metric": "chaos_mesh_configs_green",
         "value": sum(1 for r in runs if r["ok"]),
         "unit": (f"seeded configs with every invariant green "
-                 f"(of {len(runs)}: adapters/disagg/live-swap corners)"),
+                 f"(of {len(runs)}: adapters/disagg/live-swap/"
+                 f"structured/fanout corners)"),
         "vs_baseline": None,
         "completed": ok,
         "seed": SMOKE_SEEDS[0][0],
@@ -590,11 +702,12 @@ def main(argv=None) -> int:
     ap.add_argument("--require", type=str, default="",
                     help="comma-separated sampler biases (part of the "
                          "repro line): adapters, disagg, router, tp, "
-                         "swap")
+                         "swap, structured, fanout")
     ap.add_argument("--smoke", action="store_true",
-                    help="fixed seed set for bench extras / CI: >= 3 "
+                    help="fixed seed set for bench extras / CI: >= 5 "
                          "distinct configs covering adapters, "
-                         "disaggregation, and a live-weight swap")
+                         "disaggregation, a live-weight swap, "
+                         "structured output, and n-best fan-out")
     ap.add_argument("--minutes", type=float, default=None,
                     help="soak mode: walk seeds until the wall-clock "
                          "budget expires; stop at the first violation")
