@@ -408,6 +408,14 @@ def _run_extras():
         # host restores (docs/serving.md "Front door")
         ("chaos_router.py", ["--smoke"],
          "/tmp/bench_extras_chaos_router.log"),
+        # multi-PROCESS front-door drill: a real 2-replica fleet of
+        # --replica_mode server processes behind the remote router,
+        # one SIGKILLed mid-decode — zero stranded futures, failed-
+        # over completions token-exact, respawn re-admitted via the
+        # half-open canary, fleet invariants aggregated over HTTP
+        # (docs/serving.md "Front door")
+        ("chaos_fleet.py", ["--smoke"],
+         "/tmp/bench_extras_chaos_fleet.log"),
         # live-weight chaos drill: rolling upgrade under load with the
         # draining replica killed mid-swap, a corrupt checkpoint
         # publish mid-watch, and an upgrade racing the disaggregated

@@ -341,6 +341,38 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                    help="serving: bounded failover retries per request "
                         "before its error surfaces (503 only when "
                         "every replica is down)")
+    g.add_argument("--replica_mode", action="store_true",
+                   help="serving: run this server as one fleet replica "
+                        "process — accepts the pre-tokenized "
+                        "prompt_tokens wire format plus the /admin, "
+                        "/invariants and /affinity control-plane "
+                        "routes a remote front tier (--fleet) drives "
+                        "(docs/serving.md 'Front door')")
+    g.add_argument("--fleet", type=str, default=None,
+                   help="serving: run the router as a thin front tier "
+                        "over remote replica processes at these "
+                        "host:port addresses (comma-separated) — "
+                        "health polling, typed transport faults, "
+                        "token-exact failover, and rolling upgrades "
+                        "over TCP; this process loads no weights")
+    g.add_argument("--remote_connect_timeout_s", type=float,
+                   default=2.0,
+                   help="serving (fleet): per-call TCP connect and "
+                        "health-probe read budget to a replica")
+    g.add_argument("--remote_read_timeout_s", type=float, default=30.0,
+                   help="serving (fleet): per-call read budget on "
+                        "replica responses and SSE inter-frame gaps")
+    g.add_argument("--remote_max_retries", type=int, default=2,
+                   help="serving (fleet): bounded transport-level "
+                        "retries per remote call (backoff + jitter, "
+                        "Retry-After honored); request-level failover "
+                        "is --router_max_retries on top")
+    g.add_argument("--remote_digest_interval_s", type=float,
+                   default=2.0,
+                   help="serving (fleet): refresh cadence of each "
+                        "replica's prefix-affinity digest "
+                        "(GET /affinity); staleness skews routing "
+                        "hints only, never tokens")
     g.add_argument("--host_kv_bytes", type=int, default=0,
                    help="serving: host-RAM KV tier byte budget — "
                         "retained prefix block lists evicted under "
@@ -701,6 +733,12 @@ def config_from_args(args: argparse.Namespace,
             engine_step_timeout_s=args.engine_step_timeout_s,
             num_replicas=args.num_replicas,
             router_max_retries=args.router_max_retries,
+            replica_mode=args.replica_mode,
+            fleet=args.fleet,
+            remote_connect_timeout_s=args.remote_connect_timeout_s,
+            remote_read_timeout_s=args.remote_read_timeout_s,
+            remote_max_retries=args.remote_max_retries,
+            remote_digest_interval_s=args.remote_digest_interval_s,
             host_kv_bytes=args.host_kv_bytes,
             serving_tp=args.serving_tp,
             disaggregate_prefill=args.disaggregate_prefill,

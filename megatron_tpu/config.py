@@ -632,6 +632,33 @@ class ServingConfig:
     watch_checkpoints: Optional[str] = None
     # tracker poll cadence for --watch_checkpoints
     watch_interval_s: float = 5.0
+    # --- networked front door (serving/remote.py; docs/serving.md
+    # "Front door") -----------------------------------------------------
+    # run THIS server as one fleet replica: the engine serves the
+    # token-level wire surface a remote front tier consumes —
+    # `prompt_tokens` payloads (pre-tokenized admission), GET
+    # /invariants (the replica runs its own strict sweep on its live
+    # objects and serves the report — KV accounting cannot be checked
+    # over the wire), stream cancel, and the admin swap/register
+    # endpoints rolling_upgrade drives over HTTP
+    replica_mode: bool = False
+    # run the ROUTER as a thin front tier over remote replicas:
+    # comma-separated "host:port,host:port" of replica-mode servers.
+    # The server builds EngineRouter over RemoteReplica handles and
+    # holds no model weights at all. None = in-process replicas
+    # (num_replicas) as before.
+    fleet: Optional[str] = None
+    # RemoteReplica transport knobs: per-call connect/read timeouts and
+    # bounded transport retries (exponential backoff + jitter,
+    # Retry-After honored). These govern the CLIENT side of one HTTP
+    # call — whole-request failover retries stay router_max_retries.
+    remote_connect_timeout_s: float = 2.0
+    remote_read_timeout_s: float = 30.0
+    remote_max_retries: int = 2
+    # cadence for refreshing each remote replica's affinity digest
+    # (prefix_peek/adapter residency snapshot) — affinity stays a HINT;
+    # admission re-resolves on the replica
+    remote_digest_interval_s: float = 2.0
 
     def validate(self, model: Optional["ModelConfig"] = None
                  ) -> "ServingConfig":
@@ -831,6 +858,33 @@ class ServingConfig:
         assert not (self.num_replicas > 1 and self.serial_fallback), (
             "num_replicas > 1 routes through the continuous-batching "
             "engine; serial_fallback has no replicas to route over")
+        # --- networked front door (serving/remote.py) ----------------
+        assert self.remote_connect_timeout_s > 0.0, \
+            self.remote_connect_timeout_s
+        assert self.remote_read_timeout_s > 0.0, self.remote_read_timeout_s
+        assert self.remote_max_retries >= 0, self.remote_max_retries
+        assert self.remote_digest_interval_s > 0.0, \
+            self.remote_digest_interval_s
+        if self.fleet is not None:
+            addrs = [a for a in self.fleet.split(",") if a.strip()]
+            assert addrs, "fleet must name at least one host:port"
+            for a in addrs:
+                assert ":" in a, (
+                    f"fleet address {a!r} must be host:port")
+            assert not self.serial_fallback, (
+                "fleet mode routes over remote replicas; the serial "
+                "fallback path has no router to run")
+            assert self.num_replicas == 1, (
+                "fleet mode and in-process replicas are exclusive: "
+                "the front tier holds no engines — drop num_replicas "
+                "or fleet")
+            assert not self.replica_mode, (
+                "a server is either one fleet replica (replica_mode) "
+                "or the front tier over them (fleet), not both")
+        if self.replica_mode:
+            assert not self.serial_fallback, (
+                "replica_mode serves the continuous-batching engine's "
+                "wire surface; the serial path has none")
         # --- live-weight serving (serving/weights.py) ----------------
         assert self.swap_timeout_s > 0.0, self.swap_timeout_s
         assert self.watch_interval_s > 0.0, self.watch_interval_s

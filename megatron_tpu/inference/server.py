@@ -90,15 +90,38 @@ def validate_generate_payload(payload) -> Optional[str]:
     200 + {"message": ...} under flask."""
     if not isinstance(payload, dict):
         return "request body must be a JSON object"
-    if "prompts" not in payload:
+    has_text = "prompts" in payload
+    has_tokens = "prompt_tokens" in payload
+    if has_text and has_tokens:
+        return "prompts and prompt_tokens are mutually exclusive"
+    if not has_text and not has_tokens:
         return "prompts argument required"
-    prompts = payload["prompts"]
-    if not isinstance(prompts, list) or not prompts:
-        return "prompts must be a non-empty list"
-    if len(prompts) > MAX_PROMPTS:
-        return f"Maximum number of prompts is {MAX_PROMPTS}"
-    if not all(isinstance(p, str) and p for p in prompts):
-        return "prompts must be non-empty strings"
+    if has_tokens:
+        # replica-mode wire format (serving/remote.py): the front tier
+        # already tokenized, so rows of token ids skip this replica's
+        # tokenizer — the stream stays token-exact across the process
+        # hop and across a failover resubmission
+        rows = payload["prompt_tokens"]
+        if not isinstance(rows, list) or not rows:
+            return "prompt_tokens must be a non-empty list"
+        if len(rows) > MAX_PROMPTS:
+            return f"Maximum number of prompts is {MAX_PROMPTS}"
+        for r in rows:
+            if not isinstance(r, list) or not r or not all(
+                    isinstance(t, int) and not isinstance(t, bool)
+                    for t in r):
+                return ("prompt_tokens rows must be non-empty lists "
+                        "of integer token ids")
+        n_prompts = len(rows)
+    else:
+        prompts = payload["prompts"]
+        if not isinstance(prompts, list) or not prompts:
+            return "prompts must be a non-empty list"
+        if len(prompts) > MAX_PROMPTS:
+            return f"Maximum number of prompts is {MAX_PROMPTS}"
+        if not all(isinstance(p, str) and p for p in prompts):
+            return "prompts must be non-empty strings"
+        n_prompts = len(prompts)
     try:
         n = int(payload.get("tokens_to_generate", 64))
     except (TypeError, ValueError):
@@ -110,7 +133,8 @@ def validate_generate_payload(payload) -> Optional[str]:
     for field, conv in (("temperature", float), ("top_k", int),
                         ("top_p", float), ("length_penalty", float),
                         ("beam_width", int), ("random_seed", int),
-                        ("priority", int), ("deadline_s", float)):
+                        ("priority", int), ("deadline_s", float),
+                        ("arrival_id", int)):
         v = payload.get(field)
         if v is None:
             continue
@@ -127,9 +151,13 @@ def validate_generate_payload(payload) -> Optional[str]:
         d = float(payload["deadline_s"])
         if not _math.isfinite(d) or d <= 0.0:
             return "deadline_s must be a finite number > 0"
-    if payload.get("beam_width") and len(prompts) > 1:
+    if payload.get("beam_width") and n_prompts > 1:
         # (ref: beam-search rejects multi-prompt requests)
         return "With beam_search only one prompt is allowed"
+    if has_tokens and payload.get("beam_width"):
+        # beam search runs the serial path, which needs text prompts
+        return "prompt_tokens requires the serving-engine path; beam " \
+               "search is text-prompt only"
     aid = payload.get("adapter_id")
     if aid is not None and not isinstance(aid, (str, int)):
         # multi-tenant LoRA serving: the id is an opaque registry key
@@ -177,8 +205,12 @@ class MegatronServer:
         from megatron_tpu.config import ServingConfig
         self.generator = generator
         self.tokenizer = tokenizer
+        # a fleet front tier (serving.fleet) holds NO weights — the
+        # replica processes do — so generator may be None there; every
+        # route that forwards locally (serial, beam) guards on it
         self.serving = (serving if serving is not None
-                        else ServingConfig()).validate(generator.cfg)
+                        else ServingConfig()).validate(
+            generator.cfg if generator is not None else None)
         self._lock = threading.Lock()  # serial paths: one at a time (ref: :37)
         self._request_counter = itertools.count()
         self._timeout = request_timeout
@@ -189,7 +221,37 @@ class MegatronServer:
         self._streams: dict = {}
         self._streams_lock = threading.Lock()
         self.engine = None
-        if not self.serving.serial_fallback:
+        if self.serving.fleet:
+            # fleet front tier (docs/serving.md "Front door"): the SAME
+            # EngineRouter, but each replica is a RemoteReplica client
+            # over a standalone --replica_mode server process — health
+            # polling, typed transport faults, token-exact failover,
+            # and rolling upgrades all run over TCP. The shared
+            # ServingMetrics registry is BOTH the router's overlay
+            # registry and the transport-fault counter sink, so one
+            # /metrics scrape shows fleet counters next to the summed
+            # per-replica ones.
+            from megatron_tpu.serving import EngineRouter
+            from megatron_tpu.serving.metrics import ServingMetrics
+            from megatron_tpu.serving.remote import RemoteReplica
+            counters = ServingMetrics()
+            replicas = [
+                RemoteReplica(
+                    addr.strip(), counters=counters,
+                    connect_timeout_s=self.serving
+                    .remote_connect_timeout_s,
+                    read_timeout_s=self.serving.remote_read_timeout_s,
+                    max_retries=self.serving.remote_max_retries,
+                    digest_interval_s=self.serving
+                    .remote_digest_interval_s)
+                for addr in self.serving.fleet.split(",")
+                if addr.strip()]
+            self.engine = EngineRouter(
+                replicas, metrics=counters,
+                max_retries=self.serving.router_max_retries,
+                heartbeat_timeout_s=self.serving
+                .router_heartbeat_timeout_s)
+        elif not self.serving.serial_fallback:
             from megatron_tpu.serving import ServingEngine
             from megatron_tpu.serving.topology import devices_per_engine
             # serving-mesh topology (docs/serving.md "Sharded &
@@ -349,6 +411,22 @@ class MegatronServer:
                                           QueueFullError,
                                           ServiceUnavailableError)
         try:
+            if isinstance(payload, dict) \
+                    and payload.get("prompt_tokens") is not None \
+                    and not self.serving.replica_mode:
+                # the pre-tokenized wire format is the FRONT TIER's
+                # protocol to a replica process; a public server keeps
+                # speaking text prompts (its tokenizer is the contract)
+                return 400, {"message":
+                             "prompt_tokens is the replica-mode wire "
+                             "format (run the server with "
+                             "--replica_mode); send text prompts"}
+            if isinstance(payload, dict) and payload.get("cancel"):
+                # remote cancel (serving/remote.py RemoteReplica
+                # .cancel): best-effort eviction of a stream the front
+                # tier abandoned — frees the slot instead of decoding
+                # tokens nobody will read
+                return self._handle_cancel(payload)
             if isinstance(payload, dict) and payload.get("stream"):
                 # streaming validates inside (a RESUME payload carries
                 # only stream_id — no prompts to validate)
@@ -357,10 +435,24 @@ class MegatronServer:
             if err is not None:
                 return 400, {"message": err}
             if payload.get("beam_width"):
+                if self.generator is None:
+                    return 400, {"message":
+                                 "beam search forwards locally; a "
+                                 "fleet front tier holds no weights"}
                 err = self._stale_fallback_error("beam search")
                 if err is not None:
                     return 409, {"message": err}
                 return 200, self._handle_beam(payload)
+            if payload.get("serial") and self.generator is None:
+                return 400, {"message":
+                             "the serial route forwards locally; a "
+                             "fleet front tier holds no weights"}
+            if payload.get("prompt_tokens") is not None \
+                    and (self.engine is None or payload.get("serial")):
+                return 400, {"message":
+                             "prompt_tokens requires the serving-"
+                             "engine path (drop 'serial': true / "
+                             "serial_fallback)"}
             if self.engine is not None and not payload.get("serial"):
                 return 200, self._handle_engine(payload)
             if self.engine is not None:
@@ -518,6 +610,20 @@ class MegatronServer:
         twice."""
         from megatron_tpu.serving import AdmissionError
         n = int(payload.get("tokens_to_generate", 64))
+        if payload.get("prompt_tokens") is not None:
+            # replica-mode wire format: rows are ALREADY token ids (the
+            # front tier tokenized; add_BOS was applied there too) —
+            # only the length admission runs here, so an oversize row
+            # still 400s identically to a text prompt
+            prompt_ids = []
+            for i, row in enumerate(payload["prompt_tokens"]):
+                ids = [int(t) for t in row]
+                if len(ids) + n > max_total:
+                    raise AdmissionError(
+                        f"prompt {i} ({len(ids)} tokens) + tokens_to_"
+                        f"generate ({n}) exceeds {what}={max_total}")
+                prompt_ids.append(ids)
+            return prompt_ids
         add_bos = bool(payload.get("add_BOS", False))
         prompt_ids = []
         for i, p in enumerate(payload["prompts"]):
@@ -591,6 +697,12 @@ class MegatronServer:
         priority = int(payload.get("priority", 0) or 0)
         deadline_s = payload.get("deadline_s")
         deadline_s = None if deadline_s is None else float(deadline_s)
+        # replica mode: a resubmitted failover request carries its
+        # ORIGINAL arrival position across the wire, so it re-enters
+        # this replica's EDF queue where its first attempt stood
+        # (prompt i offsets by i to keep multi-prompt rows distinct)
+        aid0 = payload.get("arrival_id")
+        aid0 = None if aid0 is None else int(aid0)
         # tokenize + validate EVERY prompt before submitting ANY, so a
         # bad prompt 400s without leaving earlier rows decoding for a
         # response that will never be read
@@ -614,6 +726,8 @@ class MegatronServer:
                             ids, n, sampling,
                             seed=seed + i * best_of,
                             priority=priority, deadline_s=deadline_s,
+                            arrival_id=(None if aid0 is None
+                                        else aid0 + i),
                             adapter_id=payload.get("adapter_id"),
                             response_format=rf, n=n_samples,
                             best_of=best_of)
@@ -785,7 +899,9 @@ class MegatronServer:
         if payload.get("beam_width"):
             return 400, {"message": "beam search is whole-batch; it "
                                     "does not stream"}
-        if len(payload["prompts"]) != 1:
+        n_rows = len(payload.get("prompts")
+                     or payload.get("prompt_tokens") or ())
+        if n_rows != 1:
             return 400, {"message": "streaming supports exactly one "
                                     "prompt per request"}
         n_samples = int(payload.get("n", 1) or 1)
@@ -806,11 +922,13 @@ class MegatronServer:
             top_k=int(payload.get("top_k", 0)),
             top_p=float(payload.get("top_p", 0.0)))
         deadline_s = payload.get("deadline_s")
+        aid = payload.get("arrival_id")
         req = self.engine.submit(
             prompt_ids[0], int(payload.get("tokens_to_generate", 64)),
             sampling, seed=self._seed_for(payload),
             priority=int(payload.get("priority", 0) or 0),
             deadline_s=None if deadline_s is None else float(deadline_s),
+            arrival_id=None if aid is None else int(aid),
             adapter_id=payload.get("adapter_id"),
             response_format=payload.get("response_format"),
             n=n_samples, best_of=best_of)
@@ -998,6 +1116,179 @@ class MegatronServer:
             return self.engine.aggregate_snapshot()
         return self.engine.metrics.snapshot()
 
+    # ------------------------------------------------------------------
+    # replica/fleet control plane (serving/remote.py speaks these)
+    # ------------------------------------------------------------------
+    def _handle_cancel(self, payload: dict) -> Tuple[int, dict]:
+        """`{"stream_id": ..., "cancel": true}`: evict a live stream —
+        the front tier's best-effort cleanup when a client vanished or
+        a request failed over to a survivor, so this replica's slot
+        stops decoding tokens nobody will read."""
+        import time as _time
+        if self.engine is None:
+            return 400, {"message": "cancel requires the serving engine"}
+        sid = payload.get("stream_id")
+        if not isinstance(sid, str) or not sid:
+            return 400, {"message": "cancel requires a stream_id"}
+        with self._streams_lock:
+            self._gc_streams_locked(_time.monotonic())
+            entry = self._streams.get(sid)
+        if entry is None:
+            # idempotent: an already-collected stream is as cancelled
+            # as it gets — the front tier's retry must not 4xx-loop
+            return 200, {"cancelled": False, "stream_id": sid,
+                         "message": "unknown or already-expired stream"}
+        self.engine.cancel(entry.req)
+        return 200, {"cancelled": True, "stream_id": sid}
+
+    def handle_admin(self, payload: dict) -> Tuple[int, dict]:
+        """`PUT /admin` (replica/fleet processes): the control-plane
+        ops a remote front tier drives over the wire — swap_weights
+        (each replica stages itself from shared storage; a router-
+        fronted process runs its own rolling_upgrade), register_adapter
+        (path-only: factors cannot cross the process boundary), drain.
+        Refusals stay typed: 409 for a rejected swap (the process
+        keeps serving its old weights), 400 for bad requests."""
+        if self.engine is None:
+            return 400, {"message": "admin ops require the serving "
+                                    "engine (serial_fallback has no "
+                                    "control plane)"}
+        if not isinstance(payload, dict):
+            return 400, {"message": "request body must be a JSON object"}
+        op = payload.get("op")
+        if op == "swap_weights":
+            ckpt = payload.get("ckpt_dir")
+            if not ckpt:
+                return 400, {"message": "swap_weights requires ckpt_dir"}
+            timeout = payload.get("timeout")
+            timeout = float(timeout) if timeout is not None else 120.0
+            from megatron_tpu.serving.router import RollingUpgradeError
+            from megatron_tpu.serving.weights import WeightSwapError
+            try:
+                if hasattr(self.engine, "rolling_upgrade"):
+                    version = self.engine.rolling_upgrade(
+                        str(ckpt), swap_timeout_s=timeout)
+                else:
+                    version = self.engine.swap_weights(str(ckpt),
+                                                       timeout=timeout)
+            except (WeightSwapError, RollingUpgradeError) as e:
+                # refused swap: the old weights still serve — conflict
+                # with current state, not a server fault
+                return 409, {"message": str(e)}
+            return 200, {"label": version.label,
+                         "iteration": int(getattr(version, "iteration",
+                                                  0) or 0)}
+        if op == "register_adapter":
+            aid = payload.get("adapter_id")
+            if aid is None:
+                return 400, {"message": "register_adapter requires "
+                                        "adapter_id"}
+            from megatron_tpu.serving import AdmissionError
+            try:
+                rank = payload.get("rank")
+                self.engine.register_adapter(
+                    aid, path=payload.get("path"),
+                    rank=None if rank is None else int(rank),
+                    alpha=float(payload.get("alpha", 1.0)))
+            except AdmissionError as e:
+                return 400, {"message": str(e)}
+            return 200, {"registered": aid}
+        if op == "drain":
+            timeout = payload.get("timeout")
+            drained = self.engine.drain(
+                float(timeout) if timeout is not None else 120.0)
+            return 200, {"drained": bool(drained)}
+        return 400, {"message": f"unknown admin op {op!r} (swap_weights"
+                                " | register_adapter | drain)"}
+
+    def invariant_report(self, strict: bool = False) -> dict:
+        """`GET /invariants`: this process runs its OWN sweep
+        (serving/invariants.py) on its live engines — KV accounting
+        and in-flight walks need the real objects, which cannot cross
+        the wire — and serves the verdict. The fleet's `check_all`
+        folds each replica's report into the fleet-wide sweep. Default
+        strict=False: a live replica is rarely quiesced; the caller
+        opts into the strict accounting sweep once traffic stops."""
+        from megatron_tpu.serving.invariants import (
+            _Sweep, _check_remote_engine, check_engine,
+            check_router_health, check_schema)
+        if self.engine is None:
+            return {"engines": 0, "laws_checked": [], "violations": [],
+                    "ok": True}
+        sweep = _Sweep()
+        unreachable = []
+        engines = getattr(self.engine, "engines", None)
+        is_router = engines is not None
+        if not is_router:
+            engines = [self.engine]
+        for e in engines:
+            try:
+                if hasattr(e, "invariant_report"):
+                    # fleet mode: a RemoteReplica client — the replica
+                    # process runs its OWN sweep and ships the report;
+                    # an unreachable (killed/ejected) replica is
+                    # recorded, not convicted — the router-level laws
+                    # below must still show degraded-not-down
+                    res = _check_remote_engine(e, strict, sweep)
+                    if "unreachable" in res:
+                        unreachable.append(res["remote"])
+                else:
+                    check_engine(e, strict=strict, sweep=sweep)
+            except Exception as ex:  # noqa: BLE001 — a sweep crash is
+                # itself a reportable violation, not a 500
+                sweep.violations.append(
+                    ("sweep", f"check_engine raised {type(ex).__name__}:"
+                              f" {ex}"))
+        if is_router:
+            try:
+                check_router_health(self.engine.health(), sweep=sweep)
+                check_schema(self.engine.aggregate_snapshot(),
+                             router=True, sweep=sweep)
+            except Exception as ex:  # noqa: BLE001
+                sweep.violations.append(
+                    ("sweep", f"router sweep raised "
+                              f"{type(ex).__name__}: {ex}"))
+        report = {"engines": len(engines),
+                  "laws_checked": list(sweep.checked),
+                  "violations": [[law, detail]
+                                 for law, detail in sweep.violations],
+                  "ok": not sweep.violations}
+        if unreachable:
+            report["unreachable"] = unreachable
+        return report
+
+    def affinity_digest(self) -> dict:
+        """`GET /affinity` (replica mode): the compact routing digest a
+        remote front tier peeks instead of calling prefix_peek over
+        the wire per request — per-namespace cumulative-CRC32 block
+        chains plus adapter residency (engine.affinity_digest). A
+        router-fronted process merges its replicas' digests (union of
+        chains, max residency): affinity is a hint, so over-claiming
+        a hit costs a suboptimal pick, never a wrong token."""
+        if self.engine is None:
+            return {"granularity": 0, "namespaces": {}, "adapters": {}}
+        engines = getattr(self.engine, "engines", None)
+        if engines is None:
+            return self.engine.affinity_digest()
+        merged: dict = {"granularity": 0, "namespaces": {},
+                        "adapters": {}}
+        for e in engines:
+            try:
+                d = e.affinity_digest()
+            except Exception:  # noqa: BLE001 — a dead replica has none
+                continue
+            merged["granularity"] = merged["granularity"] or \
+                int(d.get("granularity", 0))
+            for label, chain in d.get("namespaces", {}).items():
+                bucket = merged["namespaces"].setdefault(label, set())
+                bucket.update(chain)
+            for aid, lvl in d.get("adapters", {}).items():
+                merged["adapters"][aid] = max(
+                    merged["adapters"].get(aid, 0), int(lvl))
+        merged["namespaces"] = {label: sorted(v) for label, v
+                                in merged["namespaces"].items()}
+        return merged
+
     def run(self, host: str = "0.0.0.0", port: int = 5000):
         try:
             self._run_flask(host, port)
@@ -1022,6 +1313,12 @@ class MegatronServer:
             return (jsonify(body), status,
                     server.response_headers(body))
 
+        @app.route("/admin", methods=["PUT"])
+        def admin():
+            status, body = server.handle_admin(
+                request.get_json(silent=True))
+            return jsonify(body), status
+
         @app.route("/metrics", methods=["GET"])
         def metrics():
             return jsonify(server.metrics_snapshot()), 200
@@ -1030,6 +1327,16 @@ class MegatronServer:
         def healthz():
             status, body = server.healthz()
             return jsonify(body), status
+
+        @app.route("/invariants", methods=["GET"])
+        def invariants():
+            strict = request.args.get("strict", "0") \
+                not in ("0", "", "false")
+            return jsonify(server.invariant_report(strict=strict)), 200
+
+        @app.route("/affinity", methods=["GET"])
+        def affinity():
+            return jsonify(server.affinity_digest()), 200
 
         print_rank_0(f"serving (flask) on {host}:{port}/api")
         # flask's dev server has no programmatic shutdown, and the
@@ -1075,7 +1382,9 @@ class MegatronServer:
                     gen.close()
 
             def do_PUT(self):
-                if self.path.rstrip("/") != "/api":
+                from urllib.parse import urlsplit
+                path = urlsplit(self.path).path.rstrip("/")
+                if path not in ("/api", "/admin"):
                     self.send_error(404)
                     return
                 length = int(self.headers.get("Content-Length", 0))
@@ -1085,8 +1394,11 @@ class MegatronServer:
                     self._send(400, {"message": f"invalid JSON: {e}"})
                     return
                 try:
-                    status, body = server.handle(payload,
-                                                 headers=self.headers)
+                    if path == "/admin":
+                        status, body = server.handle_admin(payload)
+                    else:
+                        status, body = server.handle(payload,
+                                                     headers=self.headers)
                 except Exception as e:  # pragma: no cover — handle()
                     status, body = 500, {"message": str(e)}
                 if _is_stream_body(body):
@@ -1095,12 +1407,28 @@ class MegatronServer:
                     self._send(status, body)
 
             def do_GET(self):
-                path = self.path.rstrip("/")
+                from urllib.parse import parse_qs, urlsplit
+                parts = urlsplit(self.path)
+                path = parts.path.rstrip("/")
                 if path == "/metrics":
                     self._send(200, server.metrics_snapshot())
                 elif path == "/healthz":
                     status, body = server.healthz()
                     self._send(status, body)
+                elif path == "/invariants":
+                    qs = parse_qs(parts.query)
+                    strict = (qs.get("strict", ["0"])[0]
+                              not in ("0", "", "false"))
+                    try:
+                        self._send(200,
+                                   server.invariant_report(strict=strict))
+                    except Exception as e:  # noqa: BLE001 — report, not 500
+                        self._send(500, {"message": str(e)})
+                elif path == "/affinity":
+                    try:
+                        self._send(200, server.affinity_digest())
+                    except Exception as e:  # noqa: BLE001
+                        self._send(500, {"message": str(e)})
                 else:
                     self.send_error(404)
 

@@ -21,6 +21,10 @@ from megatron_tpu.serving.kv_pool import (  # noqa: F401
     insert_prefill, resolve_view, scatter_view, slice_blocks, slice_slot)
 from megatron_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from megatron_tpu.serving.prefix_index import PrefixIndex  # noqa: F401
+from megatron_tpu.serving.remote import (  # noqa: F401
+    RemoteConnectionRefusedError, RemoteConnectionResetError,
+    RemoteProtocolError, RemoteReplica, RemoteRequest,
+    RemoteTimeoutError, RemoteTransportError, digest_peek)
 from megatron_tpu.serving.request import (  # noqa: F401
     DeadlineExceededError, FanoutRequest, GenRequest, GrammarDeadEndError,
     RequestFailedError, RequestState, SamplingOptions,

@@ -940,6 +940,9 @@ class ServingEngine:
             "serving_tp": (self.topo.tp if self.topo is not None
                            else 1),
             "disaggregated": self._disagg,
+            # static admission bound, served over the wire so a remote
+            # front tier can pre-flight lengths without holding weights
+            "max_len": int(self.max_len),
             # live-weight serving: the version the compiled programs
             # consume right now ("unversioned" until a staged startup
             # or first swap sets it) — the mixed-fleet observability
@@ -1020,6 +1023,64 @@ class ServingEngine:
             return int(best)
         except Exception:  # noqa: BLE001 — cross-thread peek
             return 0
+
+    def affinity_digest(self) -> dict:
+        """Compact routing-affinity summary a REMOTE front tier polls
+        (serving/remote.py; docs/serving.md "Front door"): per-namespace
+        cumulative CRC32 chains over the prefix index's block paths
+        (device index + host tier, current weight generation only) plus
+        the adapter-residency map. A remote `prefix_peek` recomputes
+        the same chain over its prompt and counts consecutive matches —
+        no token ever crosses the wire, and a hash collision or stale
+        digest only skews a HINT (admission re-resolves on this
+        replica's engine thread). HTTP-thread safe like prefix_peek:
+        reads only, racy iteration degrades to an empty digest."""
+        import zlib as _zlib
+        out: dict = {"granularity": 0, "namespaces": {}, "adapters": {}}
+        if self.adapters is not None:
+            try:
+                out["adapters"] = {str(a): int(self.adapters.peek(a))
+                                   for a in self.adapters.ids()}
+            except Exception:  # noqa: BLE001 — cross-thread peek
+                pass
+        if not self._prefix_on:
+            return out
+        out["granularity"] = int(self._index.granularity)
+        ns: dict = {}
+
+        def _walk(index):
+            for blocks in list(index._blocks.values()):
+                if not blocks:
+                    continue
+                tag = blocks[0]  # ("ns", (weight_gen, adapter_ns))
+                if not (isinstance(tag, tuple) and len(tag) == 2
+                        and tag[0] == "ns"):
+                    continue
+                wns = tag[1]
+                if not (isinstance(wns, tuple) and len(wns) == 2):
+                    continue
+                wg, ans = wns
+                if wg != self._weight_gen:
+                    continue  # stale-version KV is invisible remotely too
+                label = ("" if ans is None
+                         else str(ans[0] if isinstance(ans, tuple)
+                                  else ans))
+                bucket = ns.setdefault(label, set())
+                cum = 0
+                for b in blocks[1:]:
+                    cum = _zlib.crc32(
+                        ",".join(str(int(t)) for t in b).encode(), cum)
+                    bucket.add(cum)
+
+        try:
+            _walk(self._index)
+            if self._host_tier is not None:
+                _walk(self._host_tier._index)
+        except Exception:  # noqa: BLE001 — racy cross-thread walk
+            return {"granularity": 0, "namespaces": {},
+                    "adapters": out["adapters"]}
+        out["namespaces"] = {k: sorted(v) for k, v in ns.items()}
+        return out
 
     def register_adapter(self, adapter_id, path: Optional[str] = None,
                          factors=None, rank: Optional[int] = None,

@@ -520,11 +520,22 @@ def wait_quiesced(target, timeout: float = 60.0) -> bool:
     while True:
         quiet = True
         for e in engines:
-            h = e.health()
+            try:
+                h = e.health()
+            except Exception:  # noqa: BLE001 — an unreachable REMOTE
+                # replica (serving/remote.py) has no local accounting
+                # mutating under the sweep: it counts as quiet, same
+                # as a dead loop
+                continue
             if not h.get("loop_alive") or h.get("circuit_breaker_open"):
                 continue
-            if (h.get("active_slots") or h.get("prefilling")
-                    or e.scheduler.live_depth()):
+            # remote replicas have no scheduler object to ask — their
+            # health payload's queue_depth is the wire spelling of the
+            # same "live queued work" question
+            sched = getattr(e, "scheduler", None)
+            depth = (sched.live_depth() if sched is not None
+                     else h.get("queue_depth", 0))
+            if h.get("active_slots") or h.get("prefilling") or depth:
                 quiet = False
                 break
         if quiet:
@@ -555,16 +566,47 @@ def check_engine(engine, strict: bool = True,
     return stats
 
 
+def _check_remote_engine(e, strict: bool, sw: _Sweep) -> dict:
+    """Fleet mode: one REMOTE replica's sweep. KV accounting and
+    in-flight walks need the live objects, which cannot cross the
+    wire — so the replica process runs its OWN sweep
+    (`GET /invariants`, server.invariant_report) and this side folds
+    the report's violations into the fleet sweep verbatim. An
+    UNREACHABLE replica is recorded, not convicted: a process that is
+    gone has no accounting left to violate — its in-flight work must
+    instead show up in law 1/2 on the SURVIVORS' counters and the
+    storm's tracked futures."""
+    addr = getattr(e, "addr", repr(e))
+    try:
+        rep = e.invariant_report(strict=strict)
+    except Exception as ex:  # noqa: BLE001 — typed transport faults
+        return {"remote": addr, "unreachable": str(ex)}
+    for law in rep.get("laws_checked", ()):
+        if law not in sw.checked:
+            sw.checked.append(str(law))
+    for v in rep.get("violations", ()):
+        if isinstance(v, (list, tuple)) and len(v) == 2:
+            law, detail = v
+        else:
+            law, detail = "remote", str(v)
+        sw.violations.append((str(law),
+                              f"replica {addr}: {detail}"))
+    return {"remote": addr, "report": rep}
+
+
 def check_all(target, requests: Sequence = (),
               oracles: Sequence[Callable] = (),
               strict: bool = True, timeout: float = 120.0,
               raise_on_violation: bool = True) -> dict:
     """The system-wide sweep, callable against a `ServingEngine` OR an
     `EngineRouter` (each replica engine is swept, then the router-level
-    laws). `requests` are the tracked futures of the storm (engine
-    GenRequests or RouterRequests) — resolved and typed-checked, and,
-    when `oracles` are given, token-exactness-checked. Returns a report
-    dict; raises InvariantViolation listing EVERY broken law unless
+    laws) — including a router over REMOTE replicas, where each
+    replica's sweep runs in its own process and arrives over HTTP
+    (fleet mode: `_check_remote_engine`). `requests` are the tracked
+    futures of the storm (engine GenRequests or RouterRequests) —
+    resolved and typed-checked, and, when `oracles` are given,
+    token-exactness-checked. Returns a report dict; raises
+    InvariantViolation listing EVERY broken law unless
     `raise_on_violation=False` (the report then carries them)."""
     sw = _Sweep()
     report: dict = {}
@@ -573,8 +615,11 @@ def check_all(target, requests: Sequence = (),
                                                sweep=sw)
     engines = getattr(target, "engines", None)
     if engines is not None:  # router
-        report["replicas"] = [check_engine(e, strict=strict, sweep=sw)
-                              for e in engines]
+        report["replicas"] = [
+            _check_remote_engine(e, strict, sw)
+            if hasattr(e, "invariant_report")
+            else check_engine(e, strict=strict, sweep=sw)
+            for e in engines]
         check_router_health(target.health(), sweep=sw)
         check_schema(target.aggregate_snapshot(), router=True, sweep=sw)
     else:

@@ -122,6 +122,17 @@ _BASE_COUNTERS = (
     # the conservation law holds unchanged)
     "structured_requests", "mask_uploads", "grammar_dead_ends",
     "fanout_requests", "fanout_samples",
+    # networked front door (serving/remote.py, docs/serving.md "Front
+    # door"): router_remote_timeouts = remote calls that hit a
+    # connect/read timeout (the replica may be wedged, not dead),
+    # router_remote_retries = transport-level retry attempts the
+    # RemoteReplica client made (backoff+jitter; distinct from
+    # router_retries, which counts whole-request resubmissions to a
+    # SURVIVOR), router_probe_failures = health probes (GET /healthz)
+    # that failed with a typed transport fault — the signal that walks
+    # a replica through UP -> DOWN -> EJECTED
+    "router_remote_timeouts", "router_remote_retries",
+    "router_probe_failures",
 )
 
 
@@ -190,6 +201,11 @@ class ServingMetrics:
         # min/max so a mixed-version fleet mid-rollout is visible on
         # one scrape.
         self.weight_version = 0.0
+        # networked front door: replicas currently UP in the router's
+        # rotation (0 on a plain engine — the gauge is always present
+        # so a fresh fleet scrape never mutates the schema; the
+        # router's aggregate overwrites it with the live count)
+        self.fleet_replicas_up = 0.0
 
     # ---- recording ---------------------------------------------------
     def count(self, name: str, n: int = 1):
@@ -246,6 +262,12 @@ class ServingMetrics:
         the checkpoint iteration the compiled programs now consume."""
         with self._lock:
             self.weight_version = float(iteration)
+
+    def set_fleet_gauge(self, replicas_up: int) -> None:
+        """Router-pushed: replicas currently UP in rotation (the
+        fleet-health gauge a front-tier scrape leads with)."""
+        with self._lock:
+            self.fleet_replicas_up = float(replicas_up)
 
     def set_attn_gauges(self, gather_bytes_per_step: int, path: int):
         """Engine-pushed attention-path gauges (per sync window):
@@ -307,7 +329,9 @@ class ServingMetrics:
                           float(self.prefill_group_busy),
                       "decode_group_busy":
                           float(self.decode_group_busy),
-                      "weight_version": float(self.weight_version)}
+                      "weight_version": float(self.weight_version),
+                      "fleet_replicas_up":
+                          float(self.fleet_replicas_up)}
         out = {k: 0.0 for k in _BASE_COUNTERS}
         out.update({k: float(v) for k, v in counters.items()})
         out.update(gauges)

@@ -538,6 +538,7 @@ class EngineRouter:
                sampling: SamplingOptions = SamplingOptions(),
                seed: int = 0, priority: int = 0,
                deadline_s: Optional[float] = None,
+               arrival_id: Optional[int] = None,
                adapter_id=None, response_format=None, n: int = 1,
                best_of: Optional[int] = None) -> RouterRequest:
         # structured output rides the spec dict straight through to the
@@ -557,6 +558,11 @@ class EngineRouter:
             sampling=sampling, seed=int(seed), priority=int(priority),
             deadline_s=deadline_s, adapter_id=adapter_id,
             response_format=response_format))
+        if arrival_id is not None:
+            # an upstream front tier resubmitting across the process
+            # boundary pins the ORIGINAL arrival position here, so the
+            # first attempt's EDF tie-break matches the original run
+            rreq.arrival_id = int(arrival_id)
         # (requests_received is counted by the replica each attempt
         # lands on — the aggregate snapshot sums those; counting here
         # too would double it)
@@ -658,15 +664,26 @@ class EngineRouter:
             try:
                 example = rep.engine.gen.params
                 break
-            except Exception:  # noqa: BLE001 — a dead replica
+            except Exception:  # noqa: BLE001 — a dead or REMOTE replica
                 continue
-        try:
-            staged = load_staged(ckpt_dir, example)
-        except WeightSwapError as e:
-            self.metrics.count("weight_swap_failures")
-            raise RollingUpgradeError(
-                f"rolling upgrade refused before any replica drained: "
-                f"{e} — the fleet keeps serving") from e
+        if example is None:
+            # all-remote fleet (serving/remote.py): no replica exposes
+            # local params to stage against, and host buffers cannot
+            # cross the process boundary anyway — pass staged=None so
+            # each replica stages itself from ckpt_dir (shared
+            # storage) inside its own swap_weights; the walk below
+            # keeps the drain→swap→canary choreography and its abort
+            # semantics unchanged, the fleet just pays one disk read
+            # per process instead of one total
+            staged = None
+        else:
+            try:
+                staged = load_staged(ckpt_dir, example)
+            except WeightSwapError as e:
+                self.metrics.count("weight_swap_failures")
+                raise RollingUpgradeError(
+                    f"rolling upgrade refused before any replica "
+                    f"drained: {e} — the fleet keeps serving") from e
         version = None
         for rep in self.replicas:
             # a replica that is ALREADY hard-down (breaker open, loop
@@ -764,6 +781,11 @@ class EngineRouter:
             self._refresh_locked()
             states = [rep.state for rep in self.replicas]
             up = sum(1 for s in states if s != DOWN)
+            # the fleet-health gauge a front-tier scrape leads with —
+            # pushed here (every probe refreshes replica states) so a
+            # /metrics-only scraper sees it move without ever
+            # touching /healthz
+            self.metrics.set_fleet_gauge(up)
             if up == len(states):
                 state = "running"
             elif up > 0:
@@ -828,6 +850,11 @@ class EngineRouter:
         out["weight_version_max"] = max(versions) if versions else 0.0
         out["weight_version"] = out["weight_version_min"]
         out["num_replicas"] = float(len(self.replicas))
+        # overlay the CURRENT rotation state rather than whatever the
+        # last health() push recorded — an aggregate scrape must never
+        # report a stale fleet gauge next to fresh replica counters
+        out["fleet_replicas_up"] = float(
+            sum(1 for rep in self.replicas if rep.state != DOWN))
         return out
 
     def drain(self, timeout: Optional[float] = None) -> bool:

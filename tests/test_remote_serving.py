@@ -1,0 +1,530 @@
+"""serving/remote.py — the process-boundary front door (ISSUE 17).
+
+Fast tier: the RemoteReplica fault-mapping unit matrix (every
+transport fault kind lands in a TYPED error, never a bare exception),
+the UP->DOWN state transition a dead process drives, aggregate-
+snapshot parity between live in-process snapshots and the same
+snapshots round-tripped through JSON (what the wire delivers), and
+`digest_peek` agreement with the engine's own `affinity_digest` /
+`prefix_peek`.
+
+Slow tier: the stdlib-transport SSE e2e across a REAL process — a
+client disconnect mid-stream resumes via `stream_id` + Last-Event-ID
+with no dup / no gap, and after the replica process is killed and
+restarted the stale stream is refused TYPED while a seed-identical
+resubmission regenerates the exact token stream (the failover path's
+cross-restart guarantee).
+"""
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from megatron_tpu.serving import (AdmissionError, QueueFullError,
+                                  ServiceUnavailableError)
+from megatron_tpu.serving.metrics import ServingMetrics
+from megatron_tpu.serving.remote import (RemoteConnectionRefusedError,
+                                         RemoteConnectionResetError,
+                                         RemoteProtocolError,
+                                         RemoteReplica,
+                                         RemoteTimeoutError,
+                                         RemoteTransportError,
+                                         digest_peek)
+
+
+# ---------------------------------------------------------------------
+# scaffolding: one-shot fake replicas speaking raw bytes
+# ---------------------------------------------------------------------
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _drain_request(conn) -> bytes:
+    """Read one HTTP request (headers + Content-Length body) off the
+    socket so the fake's response can't race the client's send."""
+    conn.settimeout(5.0)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        d = conn.recv(4096)
+        if not d:
+            return buf
+        buf += d
+    head, _, body = buf.partition(b"\r\n\r\n")
+    want = 0
+    for line in head.split(b"\r\n"):
+        if line.lower().startswith(b"content-length:"):
+            want = int(line.split(b":")[1])
+    while len(body) < want:
+        d = conn.recv(4096)
+        if not d:
+            break
+        body += d
+    return buf
+
+
+def _serve_once(handler):
+    """Spawn a localhost server that handles exactly ONE connection
+    with `handler(conn)` (request already drained) and closes."""
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    s.listen(1)
+    port = s.getsockname()[1]
+
+    def run():
+        try:
+            conn, _ = s.accept()
+        except OSError:
+            return
+        try:
+            _drain_request(conn)
+            handler(conn)
+        except Exception:  # noqa: BLE001 — the CLIENT side is under test
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            s.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    return port
+
+
+def _http(body: bytes, status: bytes = b"200 OK",
+          ctype: bytes = b"application/json",
+          extra: bytes = b"") -> bytes:
+    return (b"HTTP/1.1 " + status + b"\r\nContent-Type: " + ctype
+            + b"\r\nContent-Length: " + str(len(body)).encode()
+            + b"\r\n" + extra + b"Connection: close\r\n\r\n" + body)
+
+
+def _rep(port: int, counters=None, **kw) -> RemoteReplica:
+    kw.setdefault("connect_timeout_s", 1.0)
+    kw.setdefault("read_timeout_s", 1.0)
+    kw.setdefault("max_retries", 0)
+    kw.setdefault("backoff_s", 0.01)
+    return RemoteReplica(f"127.0.0.1:{port}", counters=counters, **kw)
+
+
+class TestFaultMapping:
+    """The unit matrix: refused / reset mid-body / timeout / truncated
+    SSE / garbage JSON / 5xx+Retry-After each land in the correct
+    typed error — every one a ServiceUnavailableError (or the typed
+    local admission error), NEVER a bare socket/http exception."""
+
+    def test_connection_refused(self):
+        counters = ServingMetrics()
+        rep = _rep(_free_port(), counters)
+        with pytest.raises(RemoteConnectionRefusedError) as ei:
+            rep.health()
+        assert ei.value.kind == "refused"
+        assert isinstance(ei.value, ServiceUnavailableError)
+        # a failed probe is counted — the fleet scrape sees it
+        assert counters.snapshot()["router_probe_failures"] == 1.0
+
+    def test_timeout(self):
+        counters = ServingMetrics()
+        port = _serve_once(lambda conn: time.sleep(3.0))
+        rep = _rep(port, counters, connect_timeout_s=0.3)
+        with pytest.raises(RemoteTimeoutError) as ei:
+            rep.health()
+        assert ei.value.kind == "timeout"
+        snap = counters.snapshot()
+        assert snap["router_remote_timeouts"] == 1.0
+        assert snap["router_probe_failures"] == 1.0
+
+    def test_reset_mid_body(self):
+        # headers promise 9999 bytes, the socket dies after 24: the
+        # http client's IncompleteRead must surface as a typed reset
+        def handler(conn):
+            conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                         b"Content-Type: application/json\r\n"
+                         b"Content-Length: 9999\r\n\r\n"
+                         b'{"requests_received": 1')
+        rep = _rep(_serve_once(handler))
+        with pytest.raises(RemoteConnectionResetError) as ei:
+            rep.metrics.snapshot()
+        assert ei.value.kind == "reset"
+
+    def test_garbage_json(self):
+        port = _serve_once(
+            lambda conn: conn.sendall(_http(b"<html>not json</html>")))
+        rep = _rep(port)
+        with pytest.raises(RemoteProtocolError) as ei:
+            rep.metrics.snapshot()
+        assert ei.value.kind == "protocol"
+
+    def test_truncated_sse(self):
+        # the stream Content-Type arrives but the socket closes before
+        # the start frame: submit must refuse typed, not hang or
+        # return a half-attached request
+        def handler(conn):
+            conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                         b"Content-Type: text/event-stream\r\n"
+                         b"Connection: close\r\n\r\n")
+        rep = _rep(_serve_once(handler))
+        with pytest.raises(RemoteProtocolError):
+            rep.submit([1, 2, 3], 4)
+
+    def test_503_retry_after(self):
+        body = json.dumps({"message": "draining"}).encode()
+        port = _serve_once(lambda conn: conn.sendall(
+            _http(body, status=b"503 Service Unavailable",
+                  extra=b"Retry-After: 1.5\r\n")))
+        rep = _rep(port)
+        with pytest.raises(ServiceUnavailableError) as ei:
+            rep.submit([1, 2, 3], 4)
+        # the REMOTE 503 maps to the same local type, backoff hint
+        # preserved — indistinguishable from an in-process rejection
+        assert not isinstance(ei.value, RemoteTransportError)
+        assert ei.value.retry_after == 1.5
+
+    def test_429_maps_to_queue_full(self):
+        body = json.dumps({"message": "queue full", "retry_after": 2,
+                           "queue_depth": 31}).encode()
+        port = _serve_once(lambda conn: conn.sendall(
+            _http(body, status=b"429 Too Many Requests")))
+        rep = _rep(port)
+        with pytest.raises(QueueFullError) as ei:
+            rep.submit([1, 2, 3], 4)
+        assert ei.value.retry_after == 2
+
+    def test_400_maps_to_admission_error(self):
+        body = json.dumps({"message": "prompt too long"}).encode()
+        port = _serve_once(lambda conn: conn.sendall(
+            _http(body, status=b"400 Bad Request")))
+        rep = _rep(port)
+        with pytest.raises(AdmissionError):
+            rep.submit([1, 2, 3], 4)
+
+    def test_dead_process_drives_replica_down(self):
+        """State transition: a refused fleet address ejects through
+        the SAME missed-heartbeat machinery as a dead in-process
+        replica — the router lands DOWN and refuses TYPED."""
+        from megatron_tpu.serving import EngineRouter
+        rep = _rep(_free_port())
+        router = EngineRouter([rep], max_retries=0,
+                              heartbeat_timeout_s=0.05,
+                              probe_backoff_s=0.05)
+        try:
+            deadline = time.monotonic() + 10.0
+            state = None
+            while time.monotonic() < deadline:
+                state = router.health()["state"]
+                if state == "down":
+                    break
+                time.sleep(0.05)
+            assert state == "down"
+            with pytest.raises(ServiceUnavailableError):
+                r = router.submit([1, 2, 3], 2)
+                r.result(timeout=30)
+        finally:
+            router.close()
+
+
+# ---------------------------------------------------------------------
+# aggregate parity: live snapshots vs parsed-JSON snapshots
+# ---------------------------------------------------------------------
+class _StubEngine:
+    """Minimal engine duck type whose snapshot is a FIXED dict — the
+    in-process arm hands the dict itself, the remote arm hands what
+    the wire would deliver (json round-trip)."""
+
+    def __init__(self, snap: dict):
+        self._snap = dict(snap)
+        self.max_len = 128
+
+        class _M:
+            def __init__(self, outer):
+                self._outer = outer
+
+            def snapshot(self):
+                return dict(self._outer._snap)
+
+        self.metrics = _M(self)
+
+    def health(self):
+        return {"healthy": True, "state": "running", "accepting": True,
+                "queue_depth": 0, "active_slots": 0,
+                "service_time_ewma_ms": 1.0}
+
+    def close(self):
+        pass
+
+
+def _fleet_snaps():
+    base = ServingMetrics().snapshot()
+    a, b = dict(base), dict(base)
+    a.update({"requests_received": 5.0, "requests_completed": 4.0,
+              "handoff_bytes_per_req": 100.0, "prefill_group_busy": 0.2,
+              "ttft_p95_ms": 10.0, "tokens_per_s": 80.0,
+              "slot_occupancy": 0.5, "weight_version": 3.0})
+    b.update({"requests_received": 7.0, "requests_completed": 7.0,
+              "handoff_bytes_per_req": 300.0, "prefill_group_busy": 0.8,
+              "ttft_p95_ms": 25.0, "tokens_per_s": 40.0,
+              "slot_occupancy": 0.75, "weight_version": 5.0})
+    return a, b
+
+
+class TestAggregateParity:
+    def test_parsed_json_snapshots_aggregate_identically(self):
+        from megatron_tpu.serving import EngineRouter
+        a, b = _fleet_snaps()
+        live = EngineRouter([_StubEngine(a), _StubEngine(b)])
+        wire = EngineRouter(
+            [_StubEngine(json.loads(json.dumps(a))),
+             _StubEngine(json.loads(json.dumps(b)))])
+        try:
+            sl, sw = live.aggregate_snapshot(), wire.aggregate_snapshot()
+        finally:
+            live.close()
+            wire.close()
+        assert sl == sw
+        # PR 13 semantics survive the wire: counters sum, worst-replica
+        # gauges take max, the version gauge spreads min/max
+        assert sl["requests_received"] == 12.0
+        assert sl["handoff_bytes_per_req"] == 300.0
+        assert sl["prefill_group_busy"] == 0.8
+        assert sl["ttft_p95_ms"] == 25.0
+        assert sl["tokens_per_s"] == 80.0
+        assert sl["slot_occupancy"] == 0.75
+        assert sl["weight_version_min"] == 3.0
+        assert sl["weight_version_max"] == 5.0
+        assert sl["weight_version"] == 3.0
+        assert sl["fleet_replicas_up"] == 2.0
+
+
+# ---------------------------------------------------------------------
+# digest_peek: the remote affinity hint agrees with the engine
+# ---------------------------------------------------------------------
+class TestDigestPeek:
+    def test_synthetic_chain_walk(self):
+        import zlib
+        g = 4
+        toks = list(range(1, 17))  # 16 tokens, 4 full blocks
+        chain, cum = [], 0
+        for i in range(0, len(toks), g):
+            cum = zlib.crc32(",".join(str(t) for t in toks[i:i + g])
+                             .encode(), cum)
+            chain.append(cum)
+        digest = {"granularity": g, "namespaces": {"": chain},
+                  "adapters": {}}
+        # full prompt: capped at len-1 (the engine never reuses the
+        # whole prompt — the last token must decode)
+        assert digest_peek(digest, toks + [99, 98], None) == 16
+        assert digest_peek(digest, toks, None) == 12
+        # diverging third block: only the consecutive prefix counts
+        bad = toks[:8] + [77, 77, 77, 77] + toks[12:]
+        assert digest_peek(digest, bad + [99], None) == 8
+        # wrong namespace (adapter) sees nothing
+        assert digest_peek(digest, toks + [99], "tenant-0") == 0
+        # no digest / empty digest: never an error, just no hint
+        assert digest_peek(None, toks, None) == 0
+        assert digest_peek({"granularity": 0, "namespaces": {}},
+                           toks, None) == 0
+
+    def test_agrees_with_engine_prefix_peek(self):
+        """The REMOTE peek over the served digest must equal the
+        LOCAL peek for the same prompts — otherwise fleet affinity
+        routing silently diverges from in-process routing."""
+        import jax
+
+        from megatron_tpu.config import ModelConfig, ServingConfig
+        from megatron_tpu.inference import Generator
+        from megatron_tpu.models import language_model as lm
+        from megatron_tpu.serving import SamplingOptions, ServingEngine
+        cfg = ModelConfig(num_layers=2, hidden_size=64,
+                          num_attention_heads=2, num_kv_heads=1,
+                          vocab_size=128, seq_length=64,
+                          max_position_embeddings=64,
+                          make_vocab_size_divisible_by=64,
+                          compute_dtype="float32").derived()
+        params = lm.model_init(jax.random.PRNGKey(0), cfg)
+        gen = Generator(params, cfg, eos_id=-1, pad_id=0)
+        eng = ServingEngine(gen, ServingConfig(
+            num_slots=2, max_queue=8, max_len=64,
+            enable_prefix_cache=True, kv_block_size=16).validate(cfg))
+        try:
+            base = [7, 3, 11, 2, 9, 4, 6, 8, 1, 5, 10, 12, 13, 14,
+                    15, 16, 17, 18]
+            eng.generate(base, 6, SamplingOptions(temperature=0.0),
+                         seed=0)
+            digest = eng.affinity_digest()
+            assert digest["granularity"] > 0
+            probes = [base + [30, 31], base[:16] + [40, 41, 42],
+                      base[:8] + [50], [99, 98, 97, 96]]
+            for p in probes:
+                assert digest_peek(digest, p, None) \
+                    == eng.prefix_peek(p), p
+        finally:
+            eng.close()
+
+
+class TestFleetInvariantReport:
+    """The front tier's GET /invariants must dispatch REMOTE replicas
+    to the replica-side report (`_check_remote_engine`), never walk
+    the client object with `check_engine` (whose KV/in-flight sweeps
+    need live objects the client doesn't have), and must record an
+    unreachable replica instead of convicting it — a killed process
+    shows up in the router-level degraded-not-down law, not as a
+    sweep crash."""
+
+    def test_remote_dispatch_and_unreachable(self):
+        import http.server
+        from megatron_tpu.config import ServingConfig
+        from megatron_tpu.inference.server import MegatronServer
+
+        fresh = json.loads(json.dumps(ServingMetrics().snapshot()))
+        replica_report = {"engines": 1,
+                          "laws_checked": ["conservation", "healthz"],
+                          "violations": [["conservation",
+                                          "planted replica-side drift"]],
+                          "ok": False}
+        health = {"healthy": True, "accepting": True, "state": "running",
+                  "loop_alive": True, "queue_depth": 0, "max_len": 64,
+                  "weight_version": "unversioned"}
+
+        class _H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.startswith("/healthz"):
+                    body, code = health, 200
+                elif self.path.startswith("/metrics"):
+                    body, code = fresh, 200
+                elif self.path.startswith("/invariants"):
+                    body, code = replica_report, 200
+                elif self.path.startswith("/affinity"):
+                    body, code = {"granularity": 16, "namespaces": {},
+                                  "adapters": {}}, 200
+                else:
+                    body, code = {"message": "unknown"}, 404
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):  # keep pytest output clean
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        live = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        dead = _free_port()
+        serving = ServingConfig(
+            fleet=f"127.0.0.1:{live},127.0.0.1:{dead}",
+            remote_connect_timeout_s=1.0, remote_read_timeout_s=2.0,
+            remote_max_retries=0).validate(None)
+        server = MegatronServer(None, object(), serving=serving)
+        try:
+            rep = server.invariant_report(strict=False)
+        finally:
+            server.engine.close()
+            srv.shutdown()
+        assert rep["engines"] == 2
+        assert rep.get("unreachable") == [f"127.0.0.1:{dead}"]
+        flat = [f"{law}: {detail}" for law, detail in rep["violations"]]
+        # the live replica's own violation is folded in, addr-tagged
+        assert any("planted replica-side drift" in v
+                   and f"127.0.0.1:{live}" in v for v in flat), flat
+        # the old bug walked the RemoteReplica client with check_engine
+        # and surfaced as a sweep-crash AttributeError
+        assert not any("AttributeError" in v for v in flat), flat
+        assert rep["ok"] is False
+
+
+# ---------------------------------------------------------------------
+# slow tier: SSE resume over a real process, across a restart
+# ---------------------------------------------------------------------
+@pytest.mark.slow
+def test_sse_resume_across_process_restart(tmp_path):
+    """stdlib transport, real replica process: (1) a client that
+    disconnects mid-stream resumes via stream_id + Last-Event-ID and
+    the replayed tail has no dup / no gap; (2) after the process is
+    SIGKILLed and restarted on the same port, the stale stream is
+    refused TYPED (its registry died with the process) and a
+    seed-identical resubmission regenerates the exact same tokens —
+    the cross-restart guarantee the router's failover path rests on."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from megatron_tpu.serving.remote import _read_frame
+    from tools.chaos_common import (free_port, spawn_replica,
+                                    wait_replica_ready)
+
+    port = free_port()
+    proc = spawn_replica(port)
+    try:
+        addr = f"127.0.0.1:{port}"
+        wait_replica_ready(addr, proc=proc)
+        rep = RemoteReplica(addr, connect_timeout_s=2.0,
+                            read_timeout_s=30.0, max_retries=0)
+        payload = {"prompt_tokens": [[5, 17, 3, 42]],
+                   "tokens_to_generate": 12, "temperature": 0.0,
+                   "random_seed": 5, "logprobs": True, "stream": True}
+
+        # -- open, read 3 tokens, disconnect mid-stream --------------
+        conn, resp, start = rep._open_stream(dict(payload))
+        sid = start["stream_id"]
+        assert start["resumed"] is False
+        head = []
+        while len(head) < 3:
+            ev, data, _ = _read_frame(resp)
+            if ev == "token":
+                assert data["index"] == len(head)  # no gap
+                head.append(data["token"])
+        conn.close()  # the dropped client
+
+        # -- resume: the committed tail replays, no dup / no gap -----
+        conn2, resp2, start2 = rep._open_stream(
+            {"stream_id": sid, "stream": True},
+            headers={"Last-Event-ID": str(len(head) - 1)})
+        assert start2["resumed"] is True
+        assert start2["next_index"] == len(head)
+        tail = []
+        while True:
+            frame = _read_frame(resp2)
+            assert frame is not None, "stream truncated before done"
+            ev, data, _ = frame
+            if ev == "token":
+                assert data["index"] == len(head) + len(tail)
+                tail.append(data["token"])
+            elif ev == "done":
+                break
+        conn2.close()
+        assert len(head) + len(tail) == 12
+        full_first = head + tail
+
+        # -- kill + restart: stale stream refused typed --------------
+        proc.kill()
+        proc.wait()
+        proc = spawn_replica(port)
+        wait_replica_ready(addr, proc=proc)
+        with pytest.raises(Exception) as ei:
+            rep._open_stream({"stream_id": sid, "stream": True},
+                             headers={"Last-Event-ID": "11"})
+        # the registry died with the process: a TYPED http-level
+        # refusal (404 -> RequestFailedError), never a hang or a bare
+        # socket error
+        from megatron_tpu.serving import RequestFailedError
+        assert isinstance(ei.value, RequestFailedError), ei.value
+
+        # -- seed-exact regeneration across the restart --------------
+        from megatron_tpu.serving import SamplingOptions
+        req = rep.submit([5, 17, 3, 42], 12,
+                         SamplingOptions(temperature=0.0), seed=5)
+        toks, _ = req.result(timeout=120)
+        assert toks[4:] == full_first  # prompt + regenerated tail
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()

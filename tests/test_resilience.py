@@ -898,6 +898,47 @@ def test_chaos_router_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_chaos_fleet_smoke(tmp_path):
+    """tools/chaos_fleet.py --smoke: a REAL multi-process fleet — two
+    `--replica_mode` server processes behind the remote router, one
+    SIGKILLed mid-decode (ISSUE 17 acceptance drill). Zero stranded
+    futures, every completion token-exact vs the serial oracle
+    (failed-over streams included), the router degraded-not-down, the
+    respawned process re-admitted through the half-open canary, and
+    the fleet-wide invariant sweep (per-replica conservation over
+    HTTP + the router healthz law) green."""
+    import subprocess
+    import sys as _sys
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "chaos_fleet.py")
+    out = str(tmp_path / "chaos_fleet.json")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([_sys.executable, tool, "--smoke", "--out", out],
+                       capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    with open(out) as f:
+        record = json.load(f)
+    assert record["completed"] is True
+    assert "seed" in record  # unified chaos-record schema (ISSUE 15)
+    assert "repro" in record  # the violation repro line's command
+    kill = record["drills"]["sigkill"]
+    assert kill["ok"], kill
+    assert kill["outcomes"]["stranded"] == 0
+    assert kill["outcomes"]["error"] == 0  # typed-or-completed only
+    assert kill["exact"] is True
+    assert kill["state_after_kill"] == "degraded"
+    assert kill["post_ok"] is True  # still serving after the kill
+    assert kill["readmitted"] is True  # respawn back in rotation
+    assert kill["invariants_ok"] is True, kill["violations"]
+    # the transport-fault counters moved: the failover was REMOTE
+    assert record["fleet_counters"]["router_failovers"] >= 1
+    assert record["fleet_counters"]["fleet_replicas_up"] == 2.0
+
+
+@pytest.mark.slow
 def test_chaos_upgrade_smoke(tmp_path):
     """tools/chaos_upgrade.py --smoke: rolling fleet upgrade chaos
     (ISSUE 14 acceptance drill) — the draining replica killed mid-swap

@@ -3135,8 +3135,16 @@ class TestFrontDoorContracts:
         snap = ServingMetrics().snapshot()
         for key in ("router_failovers", "router_retries",
                     "host_tier_hits", "host_tier_demotions",
-                    "host_tier_checksum_misses", "stream_reconnects"):
+                    "host_tier_checksum_misses", "stream_reconnects",
+                    # the remote-transport taxonomy (serving/remote.py)
+                    # lives in the SAME fixed schema — a fleet scrape
+                    # needs no new keys to alert on
+                    "router_remote_timeouts", "router_remote_retries",
+                    "router_probe_failures"):
             assert snap[key] == 0.0, key
+        # fleet health is an always-present gauge, 0 on a fresh
+        # registry (no router has pushed replica states yet)
+        assert snap["fleet_replicas_up"] == 0.0
 
     def test_default_config_builds_plain_engine(self, tiny_model):
         """num_replicas=1 + host_kv_bytes=0 + no streaming client is

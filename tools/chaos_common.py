@@ -212,6 +212,88 @@ def make_adapters(cfg, n_adapters: int, rank: int = 4) -> dict:
 
 
 # ---------------------------------------------------------------------
+# multi-process fleet helpers (chaos_fleet / the subprocess SSE tests)
+# ---------------------------------------------------------------------
+class IntTokenizer:
+    """Space-separated-integers tokenizer for replica processes serving
+    the tiny chaos model: the fleet wire format is pre-tokenized
+    `prompt_tokens`, so only `detokenize` matters — and it must be
+    deterministic across processes, not linguistic."""
+
+    eod = 0
+    bos = None
+
+    def tokenize(self, s):
+        return [int(t) for t in str(s).split()]
+
+    def detokenize(self, ids):
+        return " ".join(str(int(t)) for t in ids)
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (tiny bind/close race with the
+    child's own bind — acceptable for test scaffolding)."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def replica_env() -> dict:
+    """Child-process environment for a fleet replica: plain CPU jax,
+    no inherited multi-device XLA flags (a replica process is one
+    engine on one host device)."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def spawn_replica(port: int, extra_args=(), stdout=None, stderr=None):
+    """Start `tools/chaos_fleet.py --serve_replica` as a real process
+    serving the tiny model on 127.0.0.1:port (stdlib HTTP transport).
+    Child stdout/stderr default to DEVNULL so the parent keeps the
+    one-line record contract on ITS stdout."""
+    import subprocess
+    import sys
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "chaos_fleet.py")
+    return subprocess.Popen(
+        [sys.executable, script, "--serve_replica", "--port", str(port),
+         *map(str, extra_args)],
+        env=replica_env(),
+        stdout=stdout if stdout is not None else subprocess.DEVNULL,
+        stderr=stderr if stderr is not None else subprocess.DEVNULL)
+
+
+def wait_replica_ready(addr: str, timeout: float = 120.0,
+                       proc=None) -> None:
+    """Block until the replica at host:port answers /healthz accepting
+    (the tiny model still pays a jit compile at boot). Raises on
+    timeout or if `proc` exits first."""
+    import time
+
+    from megatron_tpu.serving.remote import RemoteReplica
+    probe = RemoteReplica(addr, connect_timeout_s=1.0,
+                          read_timeout_s=5.0, max_retries=0)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"replica {addr} exited with {proc.returncode} before "
+                "becoming ready")
+        try:
+            h = probe.health()
+            if h.get("accepting"):
+                return
+        except Exception:  # noqa: BLE001 — not up yet
+            pass
+        time.sleep(0.1)
+    raise TimeoutError(f"replica {addr} not ready within {timeout:.0f}s")
+
+
+# ---------------------------------------------------------------------
 # checkpoint publish helpers (chaos_upgrade / chaos_mesh live-weight
 # schedules)
 # ---------------------------------------------------------------------

@@ -29,7 +29,9 @@ def main(argv=None):
     from megatron_tpu.training.train_step import TrainState
 
     p = argparse.ArgumentParser()
-    p.add_argument("--load", required=True)
+    p.add_argument("--load", default=None,
+                   help="checkpoint root to serve (required unless "
+                        "--fleet: a front tier holds no weights)")
     p.add_argument("--tokenizer_type", default="SentencePieceTokenizer")
     p.add_argument("--tokenizer_model", default=None)
     p.add_argument("--vocab_file", default=None)
@@ -107,7 +109,73 @@ def main(argv=None):
                    help="live-weight swap barrier budget: how long a "
                         "hot swap waits for in-flight work before it "
                         "cancels (typed refusal, engine keeps serving)")
+    # networked front door (docs/serving.md "Front door": process-
+    # boundary deployment; serving/remote.py)
+    p.add_argument("--replica_mode", action="store_true",
+                   help="run this server as one fleet replica process: "
+                        "accepts the pre-tokenized prompt_tokens wire "
+                        "format plus the /admin /invariants /affinity "
+                        "control-plane routes a remote front tier "
+                        "(--fleet) drives")
+    p.add_argument("--fleet", type=str, default=None,
+                   help="run as a thin FRONT TIER over remote replica "
+                        "processes at these host:port addresses "
+                        "(comma-separated): the prefix-affinity router "
+                        "with health polling, typed transport faults, "
+                        "token-exact failover, and rolling upgrades "
+                        "over TCP — no weights load in this process")
+    p.add_argument("--remote_connect_timeout_s", type=float, default=2.0,
+                   help="fleet: per-call TCP connect (and health-probe "
+                        "read) budget to a replica")
+    p.add_argument("--remote_read_timeout_s", type=float, default=30.0,
+                   help="fleet: per-call read budget on replica "
+                        "responses and SSE inter-frame gaps")
+    p.add_argument("--remote_max_retries", type=int, default=2,
+                   help="fleet: bounded transport-level retries per "
+                        "remote call (exponential backoff + jitter, "
+                        "Retry-After honored); whole-request failover "
+                        "to a survivor is governed by "
+                        "--router_max_retries on top")
+    p.add_argument("--remote_digest_interval_s", type=float, default=2.0,
+                   help="fleet: refresh cadence of each replica's "
+                        "prefix-affinity digest (GET /affinity) — "
+                        "staleness only skews routing hints, never "
+                        "tokens")
     args = p.parse_args(argv)
+    if args.fleet and args.load:
+        p.error("--fleet is a thin front tier over remote replicas; it "
+                "loads no weights (drop --load)")
+    if not args.fleet and not args.load:
+        p.error("--load is required (or --fleet for a front tier)")
+    if args.fleet and (args.serial or args.replica_mode):
+        p.error("--fleet excludes --serial and --replica_mode: the "
+                "front tier routes, it does not serve an engine")
+    if args.replica_mode and args.serial:
+        p.error("--replica_mode requires the serving engine (drop "
+                "--serial)")
+    if args.fleet:
+        # the front tier needs only a tokenizer (text prompts in,
+        # pre-tokenized prompt_tokens over the wire) and the router —
+        # build neither model nor engine here
+        from megatron_tpu.config import ServingConfig
+        from megatron_tpu.data import build_tokenizer as _bt
+        tokenizer = _bt(args.tokenizer_type, vocab_file=args.vocab_file,
+                        merge_file=args.merge_file,
+                        tokenizer_model=args.tokenizer_model)
+        serving = ServingConfig(
+            fleet=args.fleet,
+            max_queue=args.max_queue,
+            request_deadline_s=args.request_deadline_s,
+            remote_connect_timeout_s=args.remote_connect_timeout_s,
+            remote_read_timeout_s=args.remote_read_timeout_s,
+            remote_max_retries=args.remote_max_retries,
+            remote_digest_interval_s=args.remote_digest_interval_s,
+            watch_checkpoints=(args.load if args.watch_checkpoints
+                               else None),
+            watch_interval_s=args.watch_interval_s).validate(None)
+        server = MegatronServer(None, tokenizer, serving=serving)
+        server.run(args.host, args.port)
+        return
     if args.watch_checkpoints and args.serial:
         p.error("--watch_checkpoints requires the serving engine "
                 "(drop --serial): the serial path has nothing to "
@@ -206,6 +274,7 @@ def main(argv=None):
                             kv_block_size=args.kv_block_size,
                             disaggregate_prefill=args.disaggregate_prefill,
                             swap_timeout_s=args.swap_timeout_s,
+                            replica_mode=args.replica_mode,
                             watch_checkpoints=(args.load
                                                if args.watch_checkpoints
                                                else None),
