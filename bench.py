@@ -382,6 +382,15 @@ def _run_extras():
         # inter-token-p99 split and the tp=2 decode tok/s ratio
         ("bench_disagg.py", ["--smoke"],
          "/tmp/bench_extras_disagg.log"),
+        # symmetric-vs-asymmetric per-phase topology A/B (PERF_NOTES
+        # queue item 12): disaggregated arms at (1,1)/(1,2)/(2,1)
+        # prefill:decode splits over one staggered workload — greedy
+        # arms assert token agreement (the P!=D handoff reshards the
+        # kv-head axis inside the one device_put) and the handoff
+        # bytes stay pinned; ON CHIP the record is the decode-heavy
+        # ITL ratio + the prefill-heavy TTFT ratio vs symmetric
+        ("bench_phase_topology.py", ["--smoke"],
+         "/tmp/bench_extras_phase_topology.log"),
         # structured-output + n-best A/B (PERF_NOTES serving section):
         # constrained-vs-free decode (mask uploads ONLY on FSM state
         # change, outputs assert-parsed) and n=1x4-vs-n=4 COW fan-out

@@ -399,6 +399,33 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                         "device-to-device copy of the sequence's live "
                         "KV blocks only; needs --kv_block_size "
                         "(docs/serving.md)")
+    g.add_argument("--prefill_tp", type=int, default=None,
+                   help="serving: tensor-parallel width of the PREFILL "
+                        "group (defaults to --serving_tp) — prefill is "
+                        "compute-bound, so a disaggregated engine may "
+                        "run it wider or narrower than decode; unequal "
+                        "widths need --disaggregate_prefill, and the "
+                        "handoff device_put reshards the kv-head axis "
+                        "P->D in the one transfer (docs/serving.md "
+                        "'Per-phase topology & placement')")
+    g.add_argument("--decode_tp", type=int, default=None,
+                   help="serving: tensor-parallel width of the DECODE "
+                        "group (defaults to --serving_tp) — decode is "
+                        "HBM-bound; see --prefill_tp")
+    g.add_argument("--placement_auto", action="store_true",
+                   help="serving: let serving/placement.py choose the "
+                        "prefill:decode split and per-phase tp widths "
+                        "from the replica's device budget at build, "
+                        "re-planned from observed busy/queue/TTFT "
+                        "signals ONLY at the rolling-upgrade drain "
+                        "barrier; the chosen plan is exported through "
+                        "health() and /metrics (needs "
+                        "--disaggregate_prefill)")
+    g.add_argument("--placement_budget", type=int, default=None,
+                   help="serving: device budget per replica for "
+                        "--placement_auto (the optimizer picks "
+                        "prefill_tp + decode_tp <= budget; default = "
+                        "what the explicit widths occupy)")
     g.add_argument("--adapter_slots", type=int, default=0,
                    help="serving: device-resident LoRA adapters "
                         "servable concurrently (multi-tenant serving, "
@@ -742,6 +769,10 @@ def config_from_args(args: argparse.Namespace,
             host_kv_bytes=args.host_kv_bytes,
             serving_tp=args.serving_tp,
             disaggregate_prefill=args.disaggregate_prefill,
+            prefill_tp=args.prefill_tp,
+            decode_tp=args.decode_tp,
+            placement_auto=args.placement_auto,
+            placement_budget=args.placement_budget,
             adapter_slots=args.adapter_slots,
             adapter_rank=args.adapter_rank,
             adapter_host_bytes=args.adapter_host_bytes,

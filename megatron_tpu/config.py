@@ -587,6 +587,34 @@ class ServingConfig:
     # UP->DOWN->PROBING failover + token-exact resubmission cover a
     # dead half.
     disaggregate_prefill: bool = False
+    # --- per-phase serving topology (docs/serving.md "Per-phase
+    # topology & placement"; serving/topology.py) --------------------
+    # per-phase tensor-parallel widths (DistServe's second half):
+    # prefill is compute-bound and decode is HBM-bound, so the optimal
+    # width differs per phase — a disaggregated engine's prefill group
+    # runs `prefill_tp` wide and its decode group `decode_tp` wide,
+    # the replica's device budget becomes decode_tp + prefill_tp, and
+    # the one handoff device_put reshards the kv-head axis P->D inside
+    # the transfer (no extra copy). None (default) = `serving_tp` for
+    # both — the symmetric layout, bit-compatible. Unequal widths
+    # require disaggregate_prefill (one shared mesh has one width),
+    # and each width must divide the head counts and the padded vocab.
+    prefill_tp: Optional[int] = None
+    decode_tp: Optional[int] = None
+    # signal-driven placement (serving/placement.py): let the engine
+    # choose the prefill:decode split and per-phase widths from its
+    # device budget at build (and from the observed
+    # prefill_group_busy / decode_group_busy / queue-depth / TTFT
+    # signals at the rolling-upgrade drain barrier — the ONE moment a
+    # replica is already quiesced; never mid-serve). Explicit
+    # prefill_tp/decode_tp act as the initial plan. The chosen plan is
+    # exported through health() and the router aggregate, and every
+    # re-plan counts `placement_replans`.
+    placement_auto: bool = False
+    # device budget per replica for placement_auto (the optimizer
+    # picks prefill_tp + decode_tp <= budget). None = the budget the
+    # explicit/default widths already occupy (devices_per_engine).
+    placement_budget: Optional[int] = None
     # --- multi-tenant LoRA serving (docs/serving.md "Multi-tenant
     # LoRA serving"; serving/adapters.py) ------------------------------
     # device-resident LoRA adapters servable concurrently: the engine
@@ -801,27 +829,44 @@ class ServingConfig:
         assert self.router_max_retries >= 0, self.router_max_retries
         # --- serving mesh (serving/topology.py) -----------------------
         assert self.serving_tp >= 1, self.serving_tp
-        if self.serving_tp > 1:
+        assert self.prefill_tp is None or self.prefill_tp >= 1, \
+            self.prefill_tp
+        assert self.decode_tp is None or self.decode_tp >= 1, \
+            self.decode_tp
+        eff_pre = self.prefill_tp or self.serving_tp
+        eff_dec = self.decode_tp or self.serving_tp
+        if eff_pre != eff_dec:
+            assert self.disaggregate_prefill, (
+                f"prefill_tp={eff_pre} != decode_tp={eff_dec} requires "
+                "disaggregate_prefill: a single-group engine runs both "
+                "phases on ONE mesh, so the widths must agree — enable "
+                "disaggregation or drop the per-phase overrides")
+        if eff_pre > 1 or eff_dec > 1:
             assert not self.serial_fallback, (
-                "serving_tp > 1 requires the continuous-batching "
-                "engine: the serial fallback path builds no serving "
-                "mesh — drop serial_fallback or serving_tp")
+                "serving_tp/prefill_tp/decode_tp > 1 requires the "
+                "continuous-batching engine: the serial fallback path "
+                "builds no serving mesh — drop serial_fallback or the "
+                "tp widths")
             if model is not None:
-                tp = self.serving_tp
-                assert model.num_attention_heads % tp == 0 and \
-                    model.num_kv_heads % tp == 0, (
-                    f"serving_tp={tp} must divide both the query head "
-                    f"count ({model.num_attention_heads}) and the kv "
-                    f"head count ({model.num_kv_heads}): the KV arena "
-                    "and the attention projections shard on the head "
-                    "axes (block_native_attn's shard_map'd kernel "
-                    "requires it too — fall back to serving_tp=1 or "
-                    "the resolve/scatter bracket)")
-                assert model.padded_vocab_size % tp == 0, (
-                    f"serving_tp={tp} must divide the padded vocab "
-                    f"({model.padded_vocab_size}): the embedding / LM "
-                    "head shard on the vocab dim — adjust "
-                    "make_vocab_size_divisible_by")
+                for phase, tp in (("prefill", eff_pre),
+                                  ("decode", eff_dec)):
+                    assert model.num_attention_heads % tp == 0 and \
+                        model.num_kv_heads % tp == 0, (
+                        f"{phase} serving width {tp} (prefill_tp/"
+                        "decode_tp/serving_tp) must divide both the "
+                        "query head count "
+                        f"({model.num_attention_heads}) and the kv "
+                        f"head count ({model.num_kv_heads}): the KV "
+                        "arena and the attention projections shard on "
+                        "the head axes (block_native_attn's "
+                        "shard_map'd kernel requires it too — fall "
+                        "back to width 1 or the resolve/scatter "
+                        "bracket)")
+                    assert model.padded_vocab_size % tp == 0, (
+                        f"{phase} serving width {tp} must divide the "
+                        f"padded vocab ({model.padded_vocab_size}): "
+                        "the embedding / LM head shard on the vocab "
+                        "dim — adjust make_vocab_size_divisible_by")
         if self.disaggregate_prefill:
             assert not self.serial_fallback, (
                 "disaggregate_prefill requires the continuous-batching "
@@ -842,6 +887,20 @@ class ServingConfig:
                     "length block handoff is not defined — serve "
                     "rolling models single-group "
                     "(chunk-interleave fallback)")
+        # --- placement optimizer (serving/placement.py) ---------------
+        if self.placement_budget is not None:
+            assert self.placement_auto, (
+                "placement_budget without placement_auto is inert: the "
+                "budget is the optimizer's search space — enable "
+                "placement_auto or drop the budget")
+            assert self.placement_budget >= 2, (
+                f"placement_budget={self.placement_budget} cannot fit "
+                "a prefill:decode split (each group needs >= 1 device)")
+        if self.placement_auto:
+            assert self.disaggregate_prefill, (
+                "placement_auto plans the prefill:decode device split "
+                "— it requires disaggregate_prefill (a single-group "
+                "engine has no split to plan)")
         assert self.router_heartbeat_timeout_s > 0.0, \
             self.router_heartbeat_timeout_s
         assert self.stream_ttl_s > 0.0, self.stream_ttl_s

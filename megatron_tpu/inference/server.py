@@ -255,11 +255,14 @@ class MegatronServer:
             from megatron_tpu.serving import ServingEngine
             from megatron_tpu.serving.topology import devices_per_engine
             # serving-mesh topology (docs/serving.md "Sharded &
-            # disaggregated serving"): each replica occupies its own
-            # window of the device list — serving_tp chips for the
-            # decode group plus serving_tp more for the prefill group
-            # when disaggregated, so an EngineRouter replica is a
-            # (prefill-group, decode-group) PAIR and killing either
+            # disaggregated serving" / "Per-phase topology &
+            # placement"): each replica occupies its own window of the
+            # device list — decode_tp chips for the decode group plus
+            # prefill_tp more for the prefill group when disaggregated
+            # (each phase its own width; both default to serving_tp),
+            # or exactly placement_budget chips when the placement
+            # optimizer holds the split — so an EngineRouter replica is
+            # a (prefill-group, decode-group) PAIR and killing either
             # half fails over like any replica death. per == 1 passes
             # devices=None (the topology-free engine, bit-identical).
             per = devices_per_engine(self.serving)

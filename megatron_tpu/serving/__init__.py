@@ -38,4 +38,8 @@ from megatron_tpu.serving.scheduler import (  # noqa: F401
 from megatron_tpu.serving.spec_decode import (  # noqa: F401
     Drafter, NGramDrafter)
 from megatron_tpu.serving.topology import (  # noqa: F401
-    ServingTopology, build_topology, devices_per_engine)
+    ServingTopology, build_topology, devices_per_engine,
+    resolve_phase_tp)
+from megatron_tpu.serving.placement import (  # noqa: F401
+    PlacementError, PlacementPlan, feasible_splits, plan_placement,
+    signals_from_snapshot)

@@ -263,6 +263,24 @@ class AdapterBank:
         self._gen: Dict[object, int] = {}
         self._lock = threading.Lock()
 
+    def reshard(self, shardings: Optional[LoraAdapter] = None,
+                prefill_shardings: Optional[LoraAdapter] = None):
+        """Re-commit the bank under new per-group shardings — the
+        placement re-mesh path (ServingEngine._apply_placement, only
+        ever at the quiesced upgrade barrier). Value-preserving:
+        `device_put` re-lays the SAME factor values out, so every
+        registered row survives and the registry / LRU / pin / source
+        state is untouched. `shardings=None` commits an unsharded copy
+        (topology dropped to one device); `prefill_shardings=None`
+        drops the mirror (the new topology is not disaggregated)."""
+        with self._lock:
+            self._stacked = (jax.device_put(self._stacked, shardings)
+                             if shardings is not None
+                             else jax.device_put(self._stacked))
+            self._stacked_pre = (
+                jax.device_put(self._stacked, prefill_shardings)
+                if prefill_shardings is not None else None)
+
     # ---- registry (HTTP-thread readable) -----------------------------
     def known(self, adapter_id) -> bool:
         with self._lock:

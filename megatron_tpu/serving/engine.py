@@ -273,8 +273,36 @@ class ServingEngine:
         # default) keeps every code path below byte-for-byte what it
         # was: _p_dec/_p_pre ARE generator.params and the jits route
         # through Generator._jit exactly as before.
-        from megatron_tpu.serving.topology import build_topology
-        self.topo = build_topology(self.serving, devices=devices)
+        from megatron_tpu.serving.topology import (build_topology,
+                                                   devices_per_engine,
+                                                   resolve_phase_tp)
+        # per-replica device window, kept verbatim for the placement
+        # re-mesh at the upgrade barrier (None = the topology takes the
+        # process default device list)
+        self._device_window = (list(devices) if devices is not None
+                               else None)
+        # signal-driven placement (serving/placement.py): the STATIC
+        # plan is chosen here — explicit prefill_tp/decode_tp widths
+        # win whenever they fit; an explicit placement_budget with no
+        # widths lets the optimizer pick the split. Signals only exist
+        # later, and a re-plan is only ever applied at the quiesced
+        # swap/upgrade barrier (_apply_swap).
+        self._placement_auto = bool(getattr(self.serving,
+                                            "placement_auto", False))
+        self._placement_plan = None
+        if self._placement_auto:
+            from megatron_tpu.serving.placement import plan_placement
+            budget = devices_per_engine(self.serving)
+            explicit = (getattr(self.serving, "prefill_tp", None)
+                        or getattr(self.serving, "decode_tp", None)
+                        or not getattr(self.serving, "placement_budget",
+                                       None))
+            self._placement_plan = plan_placement(
+                budget, cfg, signals=None,
+                current=(resolve_phase_tp(self.serving) if explicit
+                         else None))
+        self.topo = build_topology(self._planned_serving(),
+                                   devices=devices)
         self._disagg = (self.topo is not None
                         and self.topo.disaggregated)
         if self.topo is not None:
@@ -283,29 +311,7 @@ class ServingEngine:
                 "serving mesh — construct the Generator WITHOUT mesh= "
                 "(the engine owns placement; a Generator mesh would "
                 "fight it)")
-            tp = self.topo.tp
-            assert cfg.num_attention_heads % tp == 0 and \
-                cfg.num_kv_heads % tp == 0 and \
-                cfg.padded_vocab_size % tp == 0, (
-                f"serving_tp={tp} must divide the head counts "
-                f"({cfg.num_attention_heads} q / {cfg.num_kv_heads} "
-                f"kv) and the padded vocab ({cfg.padded_vocab_size}) "
-                "— see ServingConfig.validate")
-            self._p_dec, self._psh_dec = self.topo.place_params(
-                generator.params, cfg, self.topo.decode_mesh)
-            if self._disagg:
-                self._p_pre, self._psh_pre = self.topo.place_params(
-                    generator.params, cfg, self.topo.prefill_mesh)
-            else:
-                self._p_pre, self._psh_pre = self._p_dec, self._psh_dec
-            _jit_dec = (lambda fn, n_array_args, donate_argnums=():
-                        self.topo._jit(self.topo.decode_mesh,
-                                       self._psh_dec, fn, n_array_args,
-                                       donate_argnums))
-            _jit_pre = (lambda fn, n_array_args, donate_argnums=():
-                        self.topo._jit(self.topo.prefill_mesh,
-                                       self._psh_pre, fn, n_array_args,
-                                       donate_argnums))
+            _jit_dec, _jit_pre = self._place_weights(generator.params)
         else:
             src = generator.params
             if any(isinstance(leaf, np.ndarray)
@@ -560,74 +566,15 @@ class ServingEngine:
         self._prefill_max_batch = max(
             min(self.serving.prefill_max_batch, self.num_slots), 1)
 
-        self._decode_traces = 0  # trace count — MUST stay 1 in steady state
-        # lengths (arg 4) chains device-side but is NOT donated: it is
-        # [S] int32 (nothing to save), and donating a buffer that the
-        # next chained call consumes while the previous one is still in
-        # flight hits the CPU jax 0.4.x donation-aliasing bug the
-        # rollback path in training/loop.py documents (observed here as
-        # rare wrong tokens on the 8-virtual-device CPU mesh)
-        self._decode = _jit_dec(self._decode_fn, n_array_args=11,
-                                donate_argnums=(1, 2, 3))
-        # speculative verify: ONE trace for the enabled k (drafts are
-        # a fixed [S, k] shape — k is a compile-time bucket), compiled
-        # alongside the decode step the first window dispatches it.
-        # Same donation set and the same lengths/rejects no-donate rule
-        # as _decode (both chain device-side across a window).
-        self._verify_traces = 0
-        self._verify = _jit_dec(self._verify_fn, n_array_args=14,
-                                donate_argnums=(1, 2, 3))
-        # resident grammar-neutral verify args (all-True per-position
-        # masks + no-guess sentinel): windows with no structured row
-        # dispatch these unchanged buffers, so the masked verify trace
-        # costs free traffic nothing
-        if self._spec_k:
-            self._d_free_dmask = jnp.ones((S, self._spec_k, Vp),
-                                          jnp.bool_)
-            self._d_no_guess = jnp.full((S,), -1, jnp.int32)
-        # one jit; jax retraces per (batch-bucket, padded prompt length)
-        # combo (both bucketed — _prefill_bucket / _batch_bucket — so
-        # the cache hits across request sizes and arrival bursts)
-        self._prefill = _jit_dec(self._prefill_fn, n_array_args=9,
-                                 donate_argnums=(1, 2, 3))
-        # prefix-cache / chunked-prefill programs (slot indices and
-        # offsets are traced scalars — one compile serves every slot):
-        # _slice reads a region out of the pool (the read half of
-        # kv_pool.clone_prefix; start=0 on a miss just yields a
-        # masked-garbage batch-1 cache at offset 0), _chunk_fwd appends
-        # one chunk at the sub-cache's offset (retraces per padded
-        # chunk length, same bucketing as _prefill), _insert is the
-        # write half — the whole region lands in the dst slot and the
-        # slot activates. `sub` is deliberately NOT donated across the
-        # _chunk_fwd chain: chained donation of a consumed-in-flight
-        # buffer hits the CPU jax 0.4.x aliasing bug documented at
-        # _decode above.
-        self._chunk_traces = 0
-        self._slice = _jit_dec(self._slice_fn, n_array_args=3)
-        # the chunk forward is the PREFILL-group program: on a
-        # disaggregated engine it compiles against the prefill mesh's
-        # weight copy (every other program below is decode-group)
-        self._chunk_fwd = _jit_pre(self._chunk_fwd_fn, n_array_args=6)
-        self._insert = _jit_dec(self._insert_fn, n_array_args=8,
-                                donate_argnums=(1, 2, 3))
-        # block-mode variants: slice by explicit physical-block list,
-        # insert through the slot's map row with the aliased-prefix
-        # copy-on-write boundary
-        self._slice_blk = _jit_dec(self._slice_blocks_fn,
-                                   n_array_args=3)
-        self._insert_blk = _jit_dec(self._insert_blocks_fn,
-                                    n_array_args=9,
-                                    donate_argnums=(1, 2, 3))
-        # disaggregated handoff programs: land the transferred live
-        # blocks on the decode group (pad-to-cap + insert_blocks +
-        # activation fused — one compile per live-block count), and
-        # widen a transferred prefix onto the prefill group for
-        # suffix chunks (the hit's decode->prefill ride)
-        self._handoff_insert = _jit_dec(self._handoff_insert_fn,
-                                        n_array_args=8,
-                                        donate_argnums=(1, 2, 3))
-        self._pad_sub_pre = _jit_pre(self._pad_sub_pre_fn,
-                                     n_array_args=2)
+        self._compile_programs(_jit_dec, _jit_pre)
+        # per-phase topology gauges + the placement plan, visible from
+        # the first scrape (0s on topology-free engines — the schema
+        # never forks on the topology)
+        if self.topo is not None:
+            d = self.topo.describe()
+            self.metrics.set_topology_gauges(
+                d["prefill_tp"], d["decode_tp"],
+                d["prefill_devices"], d["decode_devices"])
         self._steps = 0
         self._cond = threading.Condition()
         self._stop = False
@@ -935,11 +882,28 @@ class ServingEngine:
             # engines; cheap dict read, HTTP-thread safe)
             "active_adapters": (self.adapters.active_count()
                                 if self.adapters is not None else 0),
-            # serving-mesh topology (static per engine; operators and
-            # the chaos drills read which half a replica lost)
+            # serving-mesh topology (static per engine between replan
+            # barriers; operators and the chaos drills read which half
+            # a replica lost)
             "serving_tp": (self.topo.tp if self.topo is not None
                            else 1),
             "disaggregated": self._disagg,
+            # per-phase topology + the live placement plan
+            # (docs/serving.md "Per-phase topology & placement"):
+            # width/device-count keys are ALWAYS present (1s on
+            # topology-free engines — the schema never forks);
+            # "placement" carries the resolved layout plus the plan's
+            # budget/reason when a placement optimizer ran, None on a
+            # topology-free engine
+            "prefill_tp": (self.topo.prefill_tp
+                           if self.topo is not None else 1),
+            "decode_tp": (self.topo.decode_tp
+                          if self.topo is not None else 1),
+            "prefill_devices": (self.topo.describe()["prefill_devices"]
+                                if self.topo is not None else 1),
+            "decode_devices": (self.topo.decode_tp
+                               if self.topo is not None else 1),
+            "placement": self._placement_health(),
             # static admission bound, served over the wire so a remote
             # front tier can pre-flight lengths without holding weights
             "max_len": int(self.max_len),
@@ -1265,22 +1229,50 @@ class ServingEngine:
         (the rollback is that nothing moved)."""
         staged = ticket.staged
         try:
-            if self.topo is not None:
-                p_dec, _ = self.topo.place_params(
-                    staged.params, self.cfg, self.topo.decode_mesh)
-                if self._disagg:
-                    p_pre, _ = self.topo.place_params(
-                        staged.params, self.cfg, self.topo.prefill_mesh)
+            # placement re-plan hook (serving/placement.py): the swap
+            # barrier is THE quiesced moment (no active slots, no
+            # pending prefills, admissions held), so it is the only
+            # place a `placement_auto` engine re-decides its
+            # prefill:decode split from the observed signals. A changed
+            # split re-meshes (staged weights land directly on the NEW
+            # meshes — one placement, not two) and re-pays the compile
+            # bill here; an unchanged split just refreshes the plan's
+            # reason and takes the zero-recompile path below.
+            replanned = False
+            if (self._placement_auto and self.topo is not None
+                    and self._placement_plan is not None):
+                from megatron_tpu.serving.placement import (
+                    plan_placement, signals_from_snapshot)
+                plan = plan_placement(
+                    self._placement_plan.budget, self.cfg,
+                    signals=signals_from_snapshot(
+                        self.metrics.snapshot()),
+                    current=(self.topo.prefill_tp, self.topo.decode_tp))
+                if plan.split() != (self.topo.prefill_tp,
+                                    self.topo.decode_tp):
+                    self._apply_placement(plan, staged.params)
+                    p_dec, p_pre = self._p_dec, self._p_pre
+                    replanned = True
                 else:
-                    p_pre = p_dec
-            else:
-                p_dec = p_pre = jax.device_put(staged.params)
-            # surface device/placement errors HERE, not inside some
-            # later compiled dispatch where the supervisor would treat
-            # them as an engine crash
-            jax.block_until_ready(p_dec)
-            if p_pre is not p_dec:
-                jax.block_until_ready(p_pre)
+                    self._placement_plan = plan  # held — fresher reason
+            if not replanned:
+                if self.topo is not None:
+                    p_dec, _ = self.topo.place_params(
+                        staged.params, self.cfg, self.topo.decode_mesh)
+                    if self._disagg:
+                        p_pre, _ = self.topo.place_params(
+                            staged.params, self.cfg,
+                            self.topo.prefill_mesh)
+                    else:
+                        p_pre = p_dec
+                else:
+                    p_dec = p_pre = jax.device_put(staged.params)
+                # surface device/placement errors HERE, not inside some
+                # later compiled dispatch where the supervisor would
+                # treat them as an engine crash
+                jax.block_until_ready(p_dec)
+                if p_pre is not p_dec:
+                    jax.block_until_ready(p_pre)
         except Exception as e:  # noqa: BLE001 — typed refusal upstream
             ticket.error = e
             ticket.done.set()
@@ -1311,8 +1303,9 @@ class ServingEngine:
         ticket.version = staged.version
         print_rank_0(
             f"serving engine: weights hot-swapped to "
-            f"{staged.version.label} between iterations (zero "
-            "recompiles)")
+            f"{staged.version.label} between iterations "
+            + ("(placement re-planned — compile bill paid at the "
+               "barrier)" if replanned else "(zero recompiles)"))
         ticket.done.set()
 
     def _swap_hygiene(self, staged):
@@ -1364,6 +1357,223 @@ class ServingEngine:
             ticket, self._pending_swap = self._pending_swap, None
         if ticket is not None and not ticket.done.is_set():
             ticket.done.set()  # version stays None -> typed abort
+
+    # ------------------------------------------------------------------
+    # per-phase placement (serving/placement.py + serving/topology.py;
+    # docs/serving.md "Per-phase topology & placement")
+    # ------------------------------------------------------------------
+    def _planned_serving(self):
+        """The config the topology builds from: `self.serving` with the
+        placement plan's widths substituted. Identity when no plan —
+        the explicit widths ARE the plan."""
+        if self._placement_plan is None:
+            return self.serving
+        import dataclasses
+        return dataclasses.replace(
+            self.serving,
+            prefill_tp=self._placement_plan.prefill_tp,
+            decode_tp=self._placement_plan.decode_tp)
+
+    def _placement_health(self):
+        """`health()["placement"]`: the resolved per-phase layout,
+        annotated with the optimizer's budget/reason when a plan
+        exists. None on topology-free engines (nothing was placed)."""
+        if self.topo is None:
+            return None
+        out = dict(self.topo.describe())
+        if self._placement_plan is not None:
+            out["budget"] = self._placement_plan.budget
+            out["reason"] = self._placement_plan.reason
+        else:
+            out["budget"] = None
+            out["reason"] = "explicit"
+        return out
+
+    def _place_weights(self, params):
+        """Place `params` (host-staged NumPy or device tree) for the
+        current topology — one resident copy per phase group, each laid
+        out under its OWN width's rules — and return the per-group jit
+        factories the compiled programs build from. The constructor and
+        the placement re-mesh share this path."""
+        cfg = self.cfg
+        for phase, tp in (("prefill", self.topo.prefill_tp),
+                          ("decode", self.topo.decode_tp)):
+            assert cfg.num_attention_heads % tp == 0 and \
+                cfg.num_kv_heads % tp == 0 and \
+                cfg.padded_vocab_size % tp == 0, (
+                f"{phase} serving width {tp} (prefill_tp/decode_tp/"
+                f"serving_tp) must divide the head counts "
+                f"({cfg.num_attention_heads} q / {cfg.num_kv_heads} "
+                f"kv) and the padded vocab ({cfg.padded_vocab_size}) "
+                "— see ServingConfig.validate")
+        self._p_dec, self._psh_dec = self.topo.place_params(
+            params, cfg, self.topo.decode_mesh)
+        if self._disagg:
+            self._p_pre, self._psh_pre = self.topo.place_params(
+                params, cfg, self.topo.prefill_mesh)
+        else:
+            self._p_pre, self._psh_pre = self._p_dec, self._psh_dec
+        return self._jit_factories()
+
+    def _jit_factories(self):
+        """(decode-group, prefill-group) jit builders against the
+        CURRENT topology + param shardings."""
+        _jit_dec = (lambda fn, n_array_args, donate_argnums=():
+                    self.topo._jit(self.topo.decode_mesh,
+                                   self._psh_dec, fn, n_array_args,
+                                   donate_argnums))
+        _jit_pre = (lambda fn, n_array_args, donate_argnums=():
+                    self.topo._jit(self.topo.prefill_mesh,
+                                   self._psh_pre, fn, n_array_args,
+                                   donate_argnums))
+        return _jit_dec, _jit_pre
+
+    def _compile_programs(self, _jit_dec, _jit_pre):
+        """Build every compiled program against the current topology.
+        Called once at construction and again only at an applied
+        placement re-plan (the quiesced barrier — a re-mesh is the one
+        event that legitimately re-pays the compile bill; trace
+        counters reset because a new program set is a new one-compile
+        epoch)."""
+        S, Vp = self.num_slots, self.cfg.padded_vocab_size
+        self._decode_traces = 0  # trace count — MUST stay 1 in steady state
+        # lengths (arg 4) chains device-side but is NOT donated: it is
+        # [S] int32 (nothing to save), and donating a buffer that the
+        # next chained call consumes while the previous one is still in
+        # flight hits the CPU jax 0.4.x donation-aliasing bug the
+        # rollback path in training/loop.py documents (observed here as
+        # rare wrong tokens on the 8-virtual-device CPU mesh)
+        self._decode = _jit_dec(self._decode_fn, n_array_args=11,
+                                donate_argnums=(1, 2, 3))
+        # speculative verify: ONE trace for the enabled k (drafts are
+        # a fixed [S, k] shape — k is a compile-time bucket), compiled
+        # alongside the decode step the first window dispatches it.
+        # Same donation set and the same lengths/rejects no-donate rule
+        # as _decode (both chain device-side across a window).
+        self._verify_traces = 0
+        self._verify = _jit_dec(self._verify_fn, n_array_args=14,
+                                donate_argnums=(1, 2, 3))
+        # resident grammar-neutral verify args (all-True per-position
+        # masks + no-guess sentinel): windows with no structured row
+        # dispatch these unchanged buffers, so the masked verify trace
+        # costs free traffic nothing
+        if self._spec_k:
+            self._d_free_dmask = jnp.ones((S, self._spec_k, Vp),
+                                          jnp.bool_)
+            self._d_no_guess = jnp.full((S,), -1, jnp.int32)
+        # one jit; jax retraces per (batch-bucket, padded prompt length)
+        # combo (both bucketed — _prefill_bucket / _batch_bucket — so
+        # the cache hits across request sizes and arrival bursts)
+        self._prefill = _jit_dec(self._prefill_fn, n_array_args=9,
+                                 donate_argnums=(1, 2, 3))
+        # prefix-cache / chunked-prefill programs (slot indices and
+        # offsets are traced scalars — one compile serves every slot):
+        # _slice reads a region out of the pool (the read half of
+        # kv_pool.clone_prefix; start=0 on a miss just yields a
+        # masked-garbage batch-1 cache at offset 0), _chunk_fwd appends
+        # one chunk at the sub-cache's offset (retraces per padded
+        # chunk length, same bucketing as _prefill), _insert is the
+        # write half — the whole region lands in the dst slot and the
+        # slot activates. `sub` is deliberately NOT donated across the
+        # _chunk_fwd chain: chained donation of a consumed-in-flight
+        # buffer hits the CPU jax 0.4.x aliasing bug documented at
+        # _decode above.
+        self._chunk_traces = 0
+        self._slice = _jit_dec(self._slice_fn, n_array_args=3)
+        # the chunk forward is the PREFILL-group program: on a
+        # disaggregated engine it compiles against the prefill mesh's
+        # weight copy (every other program below is decode-group)
+        self._chunk_fwd = _jit_pre(self._chunk_fwd_fn, n_array_args=6)
+        self._insert = _jit_dec(self._insert_fn, n_array_args=8,
+                                donate_argnums=(1, 2, 3))
+        # block-mode variants: slice by explicit physical-block list,
+        # insert through the slot's map row with the aliased-prefix
+        # copy-on-write boundary
+        self._slice_blk = _jit_dec(self._slice_blocks_fn,
+                                   n_array_args=3)
+        self._insert_blk = _jit_dec(self._insert_blocks_fn,
+                                    n_array_args=9,
+                                    donate_argnums=(1, 2, 3))
+        # disaggregated handoff programs: land the transferred live
+        # blocks on the decode group (pad-to-cap + insert_blocks +
+        # activation fused — one compile per live-block count), and
+        # widen a transferred prefix onto the prefill group for
+        # suffix chunks (the hit's decode->prefill ride)
+        self._handoff_insert = _jit_dec(self._handoff_insert_fn,
+                                        n_array_args=8,
+                                        donate_argnums=(1, 2, 3))
+        self._pad_sub_pre = _jit_pre(self._pad_sub_pre_fn,
+                                     n_array_args=2)
+
+    def _apply_placement(self, plan, params):
+        """Re-mesh the engine under `plan` and place `params` (the
+        just-staged host tree) on the new meshes — ONLY ever called
+        from the quiesced swap barrier (_apply_swap: no active slots,
+        no pending prefills, admissions held). Build order keeps the
+        refusal property: the new topology and both weight placements
+        are staged into LOCALS first, so a device failure leaves every
+        live ref (old topology, old programs, old weights) untouched
+        and the swap refuses typed. After the commit point the KV
+        arena reshards value-preservingly (device_put re-lays the
+        kv-head axis out for the new decode width — retained prefixes
+        and the block map survive verbatim), the adapter bank
+        re-commits per group, and the per-phase programs rebuild: the
+        recompile bill is paid HERE, at the barrier, never mid-serve."""
+        import dataclasses
+        from megatron_tpu.serving.topology import ServingTopology
+        planned = dataclasses.replace(self.serving,
+                                      prefill_tp=plan.prefill_tp,
+                                      decode_tp=plan.decode_tp)
+        topo = ServingTopology(planned, devices=self._device_window)
+        p_dec, psh_dec = topo.place_params(params, self.cfg,
+                                           topo.decode_mesh)
+        if topo.disaggregated:
+            p_pre, psh_pre = topo.place_params(params, self.cfg,
+                                               topo.prefill_mesh)
+        else:
+            p_pre, psh_pre = p_dec, psh_dec
+        jax.block_until_ready(p_dec)
+        if p_pre is not p_dec:
+            jax.block_until_ready(p_pre)
+        # COMMIT POINT — flip the topology and every placement with it
+        self._placement_plan = plan
+        self.topo = topo
+        self._disagg = topo.disaggregated
+        self._p_dec, self._psh_dec = p_dec, psh_dec
+        self._p_pre, self._psh_pre = p_pre, psh_pre
+        topo.place_pool(self.pool)
+        if self.adapters is not None:
+            self.adapters.reshard(
+                topo.adapter_shardings(),
+                topo.adapter_shardings(topo.prefill_mesh)
+                if topo.disaggregated else None)
+        self._sub0 = None  # zero template re-commits on the new mesh
+        # the per-slot device state chains through the old programs'
+        # outputs, so it sits COMMITTED on the old decode mesh — mixing
+        # it into the new programs is a device-mismatch error. The grid
+        # is quiet (every slot idle), so the values are the idle
+        # defaults plus sampling knobs: re-place them on the new mesh.
+        rep = topo.replicated(topo.decode_mesh)
+        for name in ("_last_logits", "_rngs", "_d_lengths", "_d_temps",
+                     "_d_top_ks", "_d_top_ps", "_d_reject",
+                     "_d_adapter_idx", "_d_masks"):
+            setattr(self, name,
+                    jax.device_put(getattr(self, name), rep))
+        # queued preemption victims hold parked sub-caches committed to
+        # the OLD mesh: drop the refs — they resume via the replay
+        # fallback (re-prefill from the effective prompt), which is
+        # token-exact by construction
+        self.scheduler.clear_parked()
+        self._compile_programs(*self._jit_factories())
+        d = topo.describe()
+        self.metrics.set_topology_gauges(
+            d["prefill_tp"], d["decode_tp"],
+            d["prefill_devices"], d["decode_devices"])
+        self.metrics.count("placement_replans")
+        print_rank_0(
+            "serving engine: placement re-planned to "
+            f"prefill_tp={plan.prefill_tp} decode_tp={plan.decode_tp} "
+            f"({plan.reason}) at the upgrade drain barrier")
 
     # ------------------------------------------------------------------
     # device programs

@@ -133,6 +133,12 @@ _BASE_COUNTERS = (
     # a replica through UP -> DOWN -> EJECTED
     "router_remote_timeouts", "router_remote_retries",
     "router_probe_failures",
+    # per-phase placement (serving/placement.py, docs/serving.md
+    # "Per-phase topology & placement"): placement_replans = times the
+    # optimizer's plan CHANGED the (prefill_tp, decode_tp) split and
+    # was applied — only ever at the rolling-upgrade drain barrier,
+    # never mid-serve (a held plan counts nothing)
+    "placement_replans",
 )
 
 
@@ -195,6 +201,16 @@ class ServingMetrics:
         self.handoff_bytes_per_req = 0
         self.prefill_group_busy = 0.0
         self.decode_group_busy = 0.0
+        # per-phase topology gauges (always present, 0 on
+        # topology-free engines): the tp width and device count of
+        # each phase group as CURRENTLY placed — the placement plan's
+        # observable footprint. A symmetric engine reports
+        # prefill == decode == serving_tp; the router's aggregate sums
+        # the device counts fleet-wide and maxes the widths.
+        self.prefill_tp = 0.0
+        self.decode_tp = 0.0
+        self.prefill_devices = 0.0
+        self.decode_devices = 0.0
         # live-weight serving: the checkpoint ITERATION currently on
         # the serving mesh (0 = unversioned startup weights). Always
         # present; the router's aggregate carries it as per-replica
@@ -256,6 +272,17 @@ class ServingMetrics:
         with self._lock:
             self.prefill_group_busy = float(prefill_busy)
             self.decode_group_busy = float(decode_busy)
+
+    def set_topology_gauges(self, prefill_tp: int, decode_tp: int,
+                            prefill_devices: int, decode_devices: int):
+        """Engine-pushed at build and at every applied placement
+        re-plan: the per-phase widths and device counts the compiled
+        programs currently run under (0s on topology-free engines)."""
+        with self._lock:
+            self.prefill_tp = float(prefill_tp)
+            self.decode_tp = float(decode_tp)
+            self.prefill_devices = float(prefill_devices)
+            self.decode_devices = float(decode_devices)
 
     def set_weight_version(self, iteration) -> None:
         """Engine-pushed at startup staging and every applied hot swap:
@@ -329,6 +356,10 @@ class ServingMetrics:
                           float(self.prefill_group_busy),
                       "decode_group_busy":
                           float(self.decode_group_busy),
+                      "prefill_tp": float(self.prefill_tp),
+                      "decode_tp": float(self.decode_tp),
+                      "prefill_devices": float(self.prefill_devices),
+                      "decode_devices": float(self.decode_devices),
                       "weight_version": float(self.weight_version),
                       "fleet_replicas_up":
                           float(self.fleet_replicas_up)}

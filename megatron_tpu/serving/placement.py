@@ -1,0 +1,191 @@
+"""Signal-driven placement: choose a disaggregated replica's
+prefill:decode device split and per-phase tp widths.
+
+PR 13's disaggregation fixed the split at (serving_tp, serving_tp);
+the per-phase topology (serving/topology.py) makes both widths free
+knobs — this module decides what to set them to. The decision inputs
+are exactly the signals the metrics already export: the two phase-busy
+duty cycles (`prefill_group_busy` / `decode_group_busy`), admission
+queue depth, and TTFT — prefill pressure shows up as high prefill
+duty + deep queue + rising TTFT (prompts wait for the prefill group),
+decode pressure as high decode duty (slots wait for step time). The
+optimizer turns that into a device share and picks the feasible
+(prefill_tp, decode_tp) split whose ratio best matches it.
+
+Two invocation moments, and ONLY two:
+
+- **engine build** (static plan): no signals exist yet, so the plan is
+  the explicit `prefill_tp`/`decode_tp` widths when they are feasible,
+  else the most symmetric maximal-utilization split of the budget
+  (decode gets the tie — it is the HBM-bound phase that holds the
+  grid). `prefill_tp == decode_tp == serving_tp` therefore stays the
+  bit-compatible default.
+
+- **the rolling-upgrade drain barrier**: the one moment a replica is
+  already quiesced (zero active slots, nothing prefilling), so
+  re-meshing costs no request a token. `ServingEngine.swap_weights`
+  re-plans there when `placement_auto` is set; a re-plan that changes
+  the split re-places weights/pool/programs under the new widths and
+  counts `placement_replans`. Never mid-serve: a mesh change
+  recompiles every program, and the barrier is where that bill is
+  already paid.
+
+The plan is observable end to end: `health()` carries `describe()`,
+the `prefill_devices`/`decode_devices`/`prefill_tp`/`decode_tp`
+gauges ride every snapshot, and the router aggregate sums the device
+gauges fleet-wide (docs/serving.md "Per-phase topology & placement").
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+# signal normalization constants: a queue as deep as the slot grid
+# (or a TTFT at the SLO) counts as full prefill pressure
+TTFT_SLO_MS = 2000.0
+# hysteresis: keep the current split unless a candidate beats it by
+# this much — upgrade-barrier signals are one window's sample, and a
+# re-plan costs a full recompile of every program
+REPLAN_MARGIN = 0.10
+
+
+class PlacementError(ValueError):
+    """No feasible prefill:decode split exists under the budget — the
+    LOUD refusal (device budget too small, or no width divides the
+    model's head counts / padded vocab)."""
+
+
+@dataclass(frozen=True)
+class PlacementPlan:
+    """One chosen layout: per-phase widths (== per-group device counts
+    for pure-tp groups), the budget they were chosen from, and why."""
+    prefill_tp: int
+    decode_tp: int
+    budget: int
+    reason: str = "static"
+
+    @property
+    def devices(self) -> int:
+        return self.prefill_tp + self.decode_tp
+
+    def split(self) -> tuple:
+        return (self.prefill_tp, self.decode_tp)
+
+    def describe(self) -> dict:
+        """The shape `health()["placement"]` exports."""
+        return {
+            "prefill_tp": self.prefill_tp,
+            "decode_tp": self.decode_tp,
+            "prefill_devices": self.prefill_tp,
+            "decode_devices": self.decode_tp,
+            "budget": self.budget,
+            "reason": self.reason,
+        }
+
+
+def _width_ok(width: int, model) -> bool:
+    if model is None:
+        return True
+    return (model.num_attention_heads % width == 0
+            and model.num_kv_heads % width == 0
+            and model.padded_vocab_size % width == 0)
+
+
+def feasible_splits(budget: int, model=None) -> list:
+    """Every (prefill_tp, decode_tp) the budget and the model's
+    divisibility rules admit — each width must divide the query/kv
+    head counts and the padded vocab (the same rules
+    `ServingConfig.validate` enforces for explicit widths)."""
+    out = []
+    for p in range(1, budget):
+        if not _width_ok(p, model):
+            continue
+        for d in range(1, budget - p + 1):
+            if _width_ok(d, model):
+                out.append((p, d))
+    return out
+
+
+def signals_from_snapshot(snap: dict) -> dict:
+    """Pull the optimizer's inputs out of a `ServingMetrics.snapshot()`
+    (or router-aggregate) flat dict — the seam `swap_weights` uses at
+    the drain barrier."""
+    return {
+        "prefill_group_busy": float(snap.get("prefill_group_busy", 0.0)),
+        "decode_group_busy": float(snap.get("decode_group_busy", 0.0)),
+        "queue_depth": float(snap.get("queue_depth", 0.0)),
+        "num_slots": float(snap.get("num_slots", 0.0)),
+        "ttft_p50_ms": float(snap.get("ttft_p50_ms", 0.0)),
+    }
+
+
+def _prefill_share(signals: Optional[dict]) -> float:
+    """Fraction of the device budget prefill pressure asks for, in
+    (0, 1). No signals -> 0.5 (the symmetric static plan)."""
+    if not signals:
+        return 0.5
+    busy_p = min(1.0, max(0.0, signals.get("prefill_group_busy", 0.0)))
+    busy_d = min(1.0, max(0.0, signals.get("decode_group_busy", 0.0)))
+    # queue depth and TTFT are prefill-side pressure: admitted work
+    # waits on the prefill group before it ever holds a decode slot
+    slots = max(1.0, signals.get("num_slots", 0.0) or 8.0)
+    queue = min(1.0, signals.get("queue_depth", 0.0) / slots)
+    ttft = min(1.0, signals.get("ttft_p50_ms", 0.0) / TTFT_SLO_MS)
+    pre = busy_p * (1.0 + queue + ttft)
+    dec = busy_d
+    if pre + dec <= 0.0:
+        return 0.5
+    return min(0.95, max(0.05, pre / (pre + dec)))
+
+
+def _score(split: tuple, budget: int, share: float) -> float:
+    """Higher is better: match the pressure share, then use the
+    budget, then give decode (the grid-holding phase) the tie."""
+    p, d = split
+    used = p + d
+    return (-abs(p / used - share)
+            + 0.02 * (used / budget)
+            + 0.001 * (d - p) / budget)
+
+
+def plan_placement(budget: int, model=None,
+                   signals: Optional[dict] = None,
+                   current: Optional[Sequence] = None) -> PlacementPlan:
+    """Choose (prefill_tp, decode_tp) under `budget` devices.
+
+    - `signals=None` (engine build): `current` — the explicit or
+      serving_tp-defaulted widths — wins whenever it is feasible; the
+      optimizer only steps in when no widths were configured for the
+      budget (placement_budget) or the configured ones do not fit.
+    - with signals (the upgrade barrier): best-scoring split, with
+      REPLAN_MARGIN hysteresis toward `current` so one noisy window
+      does not trigger a recompile-everything re-mesh.
+
+    Raises PlacementError when NOTHING fits — the loud refusal."""
+    assert budget >= 2, f"placement budget {budget} cannot be split"
+    splits = feasible_splits(budget, model)
+    if not splits:
+        raise PlacementError(
+            f"no feasible prefill:decode split under budget={budget}: "
+            "no width in range divides the model's head counts / "
+            "padded vocab — raise the budget or adjust "
+            "make_vocab_size_divisible_by")
+    cur = tuple(current) if current is not None else None
+    if cur is not None and cur not in splits:
+        cur = None
+    if signals is None:
+        if cur is not None:
+            return PlacementPlan(cur[0], cur[1], budget, reason="static")
+        share = 0.5
+        best = max(splits, key=lambda s: _score(s, budget, share))
+        return PlacementPlan(best[0], best[1], budget,
+                             reason="static:auto")
+    share = _prefill_share(signals)
+    best = max(splits, key=lambda s: _score(s, budget, share))
+    if cur is not None and cur != best:
+        if _score(best, budget, share) - _score(cur, budget, share) \
+                < REPLAN_MARGIN:
+            return PlacementPlan(cur[0], cur[1], budget,
+                                 reason=f"hold:share={share:.2f}")
+    return PlacementPlan(best[0], best[1], budget,
+                         reason=f"signals:share={share:.2f}")

@@ -57,14 +57,18 @@ from megatron_tpu.utils.logging import print_rank_0
 UP, DOWN, PROBING = "up", "down", "probing"
 
 # gauges summed across replicas in the aggregate /metrics snapshot
+# (prefill_devices/decode_devices: the fleet's per-phase chip
+# footprint — the placement plan's aggregate-visible shape)
 _SUM_GAUGES = ("queue_depth", "active_slots", "num_slots",
                "kv_blocks_used", "kv_blocks_retained", "kv_bytes_wasted",
-               "active_adapters")
+               "active_adapters", "prefill_devices", "decode_devices")
 # gauges reported as the WORST replica (max) — per-request /
 # per-group readings where summing fractions would be meaningless
-# (same treatment as the *_ms latency keys below)
+# (same treatment as the *_ms latency keys below). The per-phase tp
+# widths ride here too: summing widths across replicas would invent a
+# mesh no engine runs.
 _MAX_GAUGES = ("handoff_bytes_per_req", "prefill_group_busy",
-               "decode_group_busy")
+               "decode_group_busy", "prefill_tp", "decode_tp")
 
 
 class NoReplicaAvailableError(ServiceUnavailableError):
@@ -806,6 +810,10 @@ class EngineRouter:
                     # mixed-version visibility mid-rollout
                     "weight_version": h.get("weight_version",
                                             "unversioned"),
+                    # the per-phase placement plan each replica
+                    # currently runs (None on topology-free engines) —
+                    # a fleet mid-replan shows differing splits here
+                    "placement": h.get("placement"),
                     "upgrading": rep.upgrading,
                 })
         return {

@@ -26,7 +26,7 @@ is the serving-side mesh plane:
   decode is HBM-bound (stream all weights + KV to emit one token per
   slot) — so sharing chips means each phase stalls the other. The
   topology splits the serving devices into a (prefill-group,
-  decode-group) pair of tp-sized meshes: the batch-1 chunked prefill
+  decode-group) pair of meshes: the batch-1 chunked prefill
   (`generation.prefill_chunk` — already a standalone forward OUTSIDE
   the pool, exactly the unit to relocate) runs on the prefill group,
   and "hand off to decode" is a device-to-device copy of the
@@ -35,10 +35,24 @@ is the serving-side mesh plane:
   engine loop stays one host thread: prefill and decode dispatches are
   async, so the two groups genuinely overlap.
 
-Group layout over the engine's device list: `[decode group (tp), then
-prefill group (tp)]` — an `EngineRouter` replica over a disaggregated
-engine is a (prefill-group, decode-group) PAIR, and
-`inference/server.py` slices `jax.devices()` into
+- **Per-phase parallelism** (`ServingConfig.prefill_tp` /
+  `ServingConfig.decode_tp`, DistServe's second half): the opposite
+  rooflines also mean the optimal tp WIDTH differs per phase, so a
+  disaggregated engine's two meshes may have DIFFERENT shapes —
+  `prefill_tp=P` chips run the prefill group, `decode_tp=D` chips the
+  decode group (both default to `serving_tp`; equal widths are
+  bit-compatible with the symmetric layout). `place_params` places
+  one resident copy per group under its own width's rules, and the
+  handoff `device_put` now crosses SHARDINGS, not just meshes: the
+  kv-head axis of the live blocks reshards P→D inside the one
+  transfer (the KV logical spec is mesh-independent, so the same
+  `place_kv_tree` call does the re-layout). `serving/placement.py`
+  chooses the split from observed busy/queue/TTFT signals.
+
+Group layout over the engine's device list: `[decode group
+(decode_tp), then prefill group (prefill_tp)]` — an `EngineRouter`
+replica over a disaggregated engine is a (prefill-group, decode-group)
+PAIR, and `inference/server.py` slices `jax.devices()` into
 `num_replicas x devices_per_replica` windows.
 """
 from __future__ import annotations
@@ -55,47 +69,79 @@ from megatron_tpu.parallel.mesh import MESH_AXES, TENSOR_AXIS
 from megatron_tpu.parallel import sharding as shd
 
 
+def resolve_phase_tp(serving) -> tuple:
+    """(prefill_tp, decode_tp) a config resolves to: each phase's own
+    width when set, `serving_tp` otherwise — so legacy configs (and
+    `prefill_tp == decode_tp == serving_tp`) keep the symmetric layout
+    bit-identically."""
+    base = int(getattr(serving, "serving_tp", 1) or 1)
+    ptp = int(getattr(serving, "prefill_tp", None) or 0) or base
+    dtp = int(getattr(serving, "decode_tp", None) or 0) or base
+    return ptp, dtp
+
+
 def devices_per_engine(serving) -> int:
     """Devices ONE engine (router replica) occupies under `serving`'s
-    topology: serving_tp chips for the decode group, plus another
-    serving_tp for the prefill group when disaggregated. 1 for the
-    (default) no-topology engine."""
-    tp = int(getattr(serving, "serving_tp", 1) or 1)
-    return tp * (2 if getattr(serving, "disaggregate_prefill", False)
-                 else 1)
+    topology: decode_tp chips for the decode group, plus prefill_tp
+    more for the prefill group when disaggregated (a non-disaggregated
+    engine shares one mesh, so the two widths must agree — validate()
+    enforces it). 1 for the (default) no-topology engine. Under
+    `placement_auto` with an explicit `placement_budget`, the budget IS
+    the per-replica window (the optimizer picks a split inside it)."""
+    if getattr(serving, "placement_auto", False):
+        budget = getattr(serving, "placement_budget", None)
+        if budget:
+            return int(budget)
+    ptp, dtp = resolve_phase_tp(serving)
+    return dtp + (ptp if getattr(serving, "disaggregate_prefill", False)
+                  else 0)
 
 
 class ServingTopology:
-    """The serving mesh plane one engine runs on. Built only when
-    `serving_tp > 1` or `disaggregate_prefill` — `build_topology`
-    returns None otherwise and the engine keeps its topology-free
-    (single-device) code paths untouched."""
+    """The serving mesh plane one engine runs on. Built only when a
+    phase width exceeds 1 (`serving_tp`/`prefill_tp`/`decode_tp`) or
+    `disaggregate_prefill` — `build_topology` returns None otherwise
+    and the engine keeps its topology-free (single-device) code paths
+    untouched."""
 
     def __init__(self, serving, devices: Optional[Sequence] = None):
-        self.tp = int(getattr(serving, "serving_tp", 1) or 1)
+        self.prefill_tp, self.decode_tp = resolve_phase_tp(serving)
+        # legacy alias: the decode-group width (== serving_tp for
+        # every symmetric config; router/engine surfaces that predate
+        # per-phase widths read it)
+        self.tp = self.decode_tp
         self.disaggregated = bool(
             getattr(serving, "disaggregate_prefill", False))
+        assert self.disaggregated or self.prefill_tp == self.decode_tp, (
+            f"prefill_tp={self.prefill_tp} != decode_tp={self.decode_tp} "
+            "needs disaggregate_prefill — a shared mesh has one width")
         need = devices_per_engine(serving)
         if devices is None:
             devices = jax.devices()[:need]
         devices = list(devices)
         assert len(devices) >= need, (
             f"serving topology needs {need} devices "
-            f"(serving_tp={self.tp}"
-            f"{', disaggregated' if self.disaggregated else ''}) but "
-            f"only {len(devices)} were provided — lower serving_tp / "
+            f"(decode_tp={self.decode_tp}"
+            + (f" + prefill_tp={self.prefill_tp} for the disaggregated "
+               "prefill group" if self.disaggregated else "")
+            + f") but only {len(devices)} were provided — lower the "
+            "per-phase tp widths (prefill_tp/decode_tp/serving_tp) / "
             "num_replicas or disable disaggregate_prefill")
         self.devices = devices[:need]
 
-        def _mesh(devs):
-            return Mesh(np.asarray(devs).reshape(1, 1, 1, self.tp),
+        def _mesh(devs, width):
+            return Mesh(np.asarray(devs).reshape(1, 1, 1, width),
                         MESH_AXES)
 
         # decode group first: a non-disaggregated topology IS its
         # decode mesh (prefill shares it)
-        self.decode_mesh = _mesh(self.devices[:self.tp])
-        self.prefill_mesh = (_mesh(self.devices[self.tp:2 * self.tp])
-                             if self.disaggregated else self.decode_mesh)
+        self.decode_mesh = _mesh(self.devices[:self.decode_tp],
+                                 self.decode_tp)
+        self.prefill_mesh = (
+            _mesh(self.devices[self.decode_tp:
+                               self.decode_tp + self.prefill_tp],
+                  self.prefill_tp)
+            if self.disaggregated else self.decode_mesh)
         # the serving rules are the training rules (sequence_parallel
         # off — serving activations are tiny): 'heads'/'kv_heads'/
         # 'mlp'/'vocab' -> tp, everything else replicated
@@ -201,20 +247,41 @@ class ServingTopology:
         prefill→decode handoff copy): 5-dim KV leaves land in their
         kv-head-sharded layout, small leaves (logits rows, rng keys)
         replicate. A plain device_put — the only data that ever crosses
-        the group boundary."""
+        the group boundary. With per-phase widths the destination
+        sharding differs from the source's (kv-heads split prefill_tp
+        ways on one side, decode_tp ways on the other), so this one
+        transfer IS the P→D reshard — no extra copy, the logical spec
+        is mesh-independent."""
         return self.place_kv_tree(tree, self.decode_mesh)
 
     def to_prefill(self, tree):
         """Move a decode-group pytree onto the prefill group (the
-        prefix-hit's shared blocks, riding the other way)."""
+        prefix-hit's shared blocks, riding the other way — the D→P
+        reshard when the widths differ)."""
         return self.place_kv_tree(tree, self.prefill_mesh)
+
+    # ---- observability ----------------------------------------------
+    def describe(self) -> dict:
+        """The resolved per-phase layout, in the shape `health()` and
+        the topology gauges export (device counts are group sizes —
+        with pure-tp groups they equal the widths, but the two are
+        distinct knobs in the placement plan's vocabulary)."""
+        return {
+            "prefill_tp": self.prefill_tp,
+            "decode_tp": self.decode_tp,
+            "prefill_devices": (self.prefill_tp if self.disaggregated
+                                else self.decode_tp),
+            "decode_devices": self.decode_tp,
+            "disaggregated": self.disaggregated,
+        }
 
 
 def build_topology(serving, devices: Optional[Sequence] = None
                    ) -> Optional[ServingTopology]:
-    """None when `serving` asks for no topology (serving_tp == 1 and
-    no disaggregation) — the bit-identical default."""
-    tp = int(getattr(serving, "serving_tp", 1) or 1)
-    if tp == 1 and not getattr(serving, "disaggregate_prefill", False):
+    """None when `serving` asks for no topology (both phase widths
+    resolve to 1 and no disaggregation) — the bit-identical default."""
+    ptp, dtp = resolve_phase_tp(serving)
+    if (ptp == 1 and dtp == 1
+            and not getattr(serving, "disaggregate_prefill", False)):
         return None
     return ServingTopology(serving, devices=devices)

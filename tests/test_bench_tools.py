@@ -338,10 +338,41 @@ def test_bench_disagg_emits_ab_record(monkeypatch, tmp_path):
     assert rec["tp_arms"]["tp_speedup_x"] > 0
 
 
+def test_bench_phase_topology_emits_ab_record(monkeypatch, tmp_path):
+    """The symmetric-vs-asymmetric per-phase split A/B must run all
+    three disaggregated arms token-exact (the tool asserts agreement
+    and exits nonzero on divergence), keep the handoff byte pin across
+    DIFFERENT mesh widths (the P!=D reshard rides inside the one
+    device_put — no extra copy), and report the decode-heavy ITL /
+    prefill-heavy TTFT ratios the on-chip comparison keys on
+    (PERF_NOTES queue item 12)."""
+    import json
+    text = run_tool(monkeypatch, tmp_path, "bench_phase_topology.py",
+                    ["--smoke"])
+    rec = json.loads(text)
+    assert rec["bench"] == "phase_topology"
+    assert rec["greedy_arms_token_exact"] is True
+    # the tool forces a 4-virtual-device host: every arm must RUN
+    assert "skipped" not in rec and "asymmetric" not in rec
+    for name, ptp, dtp in (("symmetric", 1, 1), ("decode_heavy", 1, 2),
+                           ("prefill_heavy", 2, 1)):
+        arm = rec[name]
+        assert (arm["prefill_tp"], arm["decode_tp"]) == (ptp, dtp)
+        assert arm["handoffs"] == rec["requests"]
+        for key in ("ttft_p50_ms", "inter_token_p99_ms",
+                    "decode_tok_s"):
+            assert key in arm
+    # same byte count on every arm — the reshard added no copy
+    assert len({rec[n]["handoff_bytes_per_req"] for n in
+                ("symmetric", "decode_heavy", "prefill_heavy")}) == 1
+    assert rec["decode_heavy"]["itl_p99_vs_symmetric_x"] > 0
+    assert rec["prefill_heavy"]["ttft_vs_symmetric_x"] > 0
+
+
 @pytest.mark.slow
 def test_bench_serving_queue_runs_pending_abs(monkeypatch, tmp_path):
     """The one-window queue runner must execute every pending serving
-    A/B (PERF_NOTES items 8/9/10) as independent subprocesses and
+    A/B (PERF_NOTES items 8/9/10/12) as independent subprocesses and
     collect their records into one combined line — the single log a
     short tunnel window needs to clear the queue."""
     import json
@@ -351,10 +382,13 @@ def test_bench_serving_queue_runs_pending_abs(monkeypatch, tmp_path):
     assert rec["bench"] == "serving_queue"
     assert rec["all_green"] is True
     assert [r["name"] for r in rec["runs"]] == \
-        ["block_attn", "lora", "disagg", "structured"]
+        ["block_attn", "lora", "disagg", "phase_topology",
+         "structured"]
     assert rec["results"]["block_attn"]["bench"] == "block_native_attn"
     assert rec["results"]["lora"]["bench"] == "lora_adapters"
     assert rec["results"]["disagg"]["bench"] == "disagg_serving"
+    assert rec["results"]["phase_topology"]["bench"] == \
+        "phase_topology"
     assert rec["results"]["structured"]["bench"] == "structured_nbest"
 
 

@@ -1,0 +1,548 @@
+"""Per-phase serving topology + the signal-driven placement optimizer
+(ISSUE 18; serving/topology.py "Per-phase parallelism",
+serving/placement.py; docs/serving.md "Per-phase topology &
+placement").
+
+Acceptance pins, on the 8-virtual-device CPU mesh (conftest.py):
+
+- ASYMMETRIC splits serve TOKEN-EXACT: (prefill_tp=1, decode_tp=2) and
+  (prefill_tp=2, decode_tp=1) agree with the symmetric disaggregated
+  baseline for bf16 AND int8 pools — the P!=D handoff reshards the
+  kv-head axis inside its one device_put, and the handoff byte count
+  does not move (no hidden extra copy);
+- explicit `prefill_tp == decode_tp == serving_tp` resolves to the
+  SAME topology the legacy symmetric config builds (bit-compat with
+  the PR-13 layout);
+- each phase keeps ONE compile (decode trace count pinned at 1 on
+  asymmetric meshes);
+- the placement optimizer picks a static plan at engine build
+  (explicit widths win; a bare `placement_budget` gets the
+  most-symmetric split), re-plans ONLY at the rolling-upgrade drain
+  barrier (counting `placement_replans` and recompiling there — never
+  mid-serve), and the chosen plan is visible end to end: `health()`
+  carries it, the always-present topology gauges ride every snapshot,
+  and the router aggregate sums device counts / maxes widths;
+- the upgrade drill under live traffic with a barrier re-plan keeps
+  the zero-503 contract and every completion token-exact at its
+  admitted version.
+"""
+import threading
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from megatron_tpu.config import ModelConfig, ServingConfig
+from megatron_tpu.inference import Generator
+from megatron_tpu.models import language_model as lm
+from megatron_tpu.serving import (EngineRouter, PlacementError,
+                                  ServingEngine, ServingMetrics,
+                                  build_topology, devices_per_engine,
+                                  feasible_splits, plan_placement,
+                                  signals_from_snapshot)
+from megatron_tpu.serving.request import SamplingOptions
+
+GREEDY = SamplingOptions(temperature=0.0)
+
+
+def tiny_cfg(**overrides):
+    base = dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+                num_kv_heads=2, vocab_size=96, seq_length=64,
+                make_vocab_size_divisible_by=32, compute_dtype="float32")
+    base.update(overrides)
+    return ModelConfig(**base).derived()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = tiny_cfg()
+    params = lm.model_init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _gen(tiny_model, kv_dtype=None):
+    params, cfg = tiny_model
+    return Generator(params, cfg, eos_id=0, pad_id=0,
+                     kv_cache_dtype=(jnp.int8 if kv_dtype == "int8"
+                                     else jnp.bfloat16))
+
+
+# prompts: the second spans 2 live 16-token blocks (the handoff pin),
+# the third is chunk-length territory
+JOBS = [([5, 17, 3, 42], 6), (list(range(2, 22)), 6), ([7, 8, 9], 4)]
+
+
+def _serve(gen, cfg, jobs, **sv):
+    """(ordered outputs, final snapshot, evidence) under one engine."""
+    eng = ServingEngine(gen, ServingConfig(
+        num_slots=3, max_queue=32, max_len=64,
+        kv_block_size=16, **sv).validate(cfg))
+    try:
+        reqs = [eng.submit(p, n, GREEDY, seed=i)
+                for i, (p, n) in enumerate(jobs)]
+        outs = [r.result(timeout=300)[0] for r in reqs]
+        ev = dict(topo=eng.topo, decode_traces=eng._decode_traces,
+                  chunk_traces=eng._chunk_traces,
+                  health=eng.health(), plan=eng._placement_plan)
+        return outs, eng.metrics.snapshot(), ev
+    finally:
+        eng.close()
+
+
+class TestAsymmetricPhaseTopology:
+    """Tentpole acceptance: a P!=D split is a PLACEMENT change — the
+    handoff reshards, the tokens do not move."""
+
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_asymmetric_splits_token_exact(self, tiny_model, kv_dtype):
+        params, cfg = tiny_model
+        gen = _gen(tiny_model, kv_dtype)
+        # the legacy symmetric disagg engine (PR-13 layout) is the
+        # ground truth every per-phase arm must match
+        base, snap0, ev0 = _serve(gen, cfg, JOBS, kv_dtype=kv_dtype,
+                                  disaggregate_prefill=True)
+        from megatron_tpu.serving.kv_pool import SlotKVPool
+        pool = SlotKVPool(cfg, 1, 64,
+                          dtype=(jnp.int8 if kv_dtype else jnp.bfloat16),
+                          block_size=16)
+        # the LAST admission was the 3-token prompt: 1 live block
+        want = 16 * pool.bytes_per_token()
+        assert snap0["handoff_bytes_per_req"] == want
+        for ptp, dtp in ((1, 1), (1, 2), (2, 1)):
+            outs, snap, ev = _serve(gen, cfg, JOBS, kv_dtype=kv_dtype,
+                                    disaggregate_prefill=True,
+                                    prefill_tp=ptp, decode_tp=dtp)
+            assert outs == base, (
+                f"(prefill_tp={ptp}, decode_tp={dtp}) diverged from "
+                "the symmetric baseline: the cross-sharding handoff "
+                "is UNSOUND")
+            topo = ev["topo"]
+            assert topo.prefill_tp == ptp and topo.decode_tp == dtp
+            assert topo.tp == dtp  # legacy alias = decode width
+            assert len(topo.devices) == ptp + dtp
+            assert topo.decode_mesh.devices.size == dtp
+            assert topo.prefill_mesh.devices.size == ptp
+            # the P->D reshard rides INSIDE the existing device_put:
+            # byte count identical to the symmetric arm (no extra copy)
+            assert snap["handoffs"] == len(JOBS)
+            assert snap["handoff_bytes_per_req"] == want
+            # one-compile pins hold on asymmetric meshes
+            assert ev["decode_traces"] == 1
+            assert ev["chunk_traces"] == ev0["chunk_traces"]
+
+    def test_equal_widths_bitcompat_with_serving_tp(self, tiny_model):
+        """prefill_tp == decode_tp == serving_tp is the SAME topology
+        the legacy config builds — and on the slow 4-device layout the
+        explicit (2,2) split equals serving_tp=2 disagg."""
+        sv = ServingConfig(kv_block_size=16, disaggregate_prefill=True,
+                           serving_tp=2)
+        sv_explicit = ServingConfig(kv_block_size=16,
+                                    disaggregate_prefill=True,
+                                    prefill_tp=2, decode_tp=2)
+        t1 = build_topology(sv)
+        t2 = build_topology(sv_explicit)
+        assert (t1.prefill_tp, t1.decode_tp) == \
+            (t2.prefill_tp, t2.decode_tp) == (2, 2)
+        assert t1.devices == t2.devices
+        assert t1.describe() == t2.describe()
+
+    def test_health_and_gauges_carry_the_phase_topology(self,
+                                                        tiny_model):
+        params, cfg = tiny_model
+        gen = _gen(tiny_model)
+        _, snap, ev = _serve(gen, cfg, JOBS[:1],
+                             disaggregate_prefill=True,
+                             prefill_tp=1, decode_tp=2)
+        h = ev["health"]
+        assert h["prefill_tp"] == 1 and h["decode_tp"] == 2
+        assert h["prefill_devices"] == 1 and h["decode_devices"] == 2
+        assert h["serving_tp"] == 2  # legacy alias = decode width
+        assert h["placement"] == {
+            "prefill_tp": 1, "decode_tp": 2,
+            "prefill_devices": 1, "decode_devices": 2,
+            "disaggregated": True,
+            "budget": None, "reason": "explicit"}
+        # the gauges ride every snapshot with the same numbers
+        assert snap["prefill_tp"] == 1.0 and snap["decode_tp"] == 2.0
+        assert snap["prefill_devices"] == 1.0
+        assert snap["decode_devices"] == 2.0
+
+    def test_topology_free_engine_health(self, tiny_model):
+        params, cfg = tiny_model
+        gen = _gen(tiny_model)
+        eng = ServingEngine(gen, ServingConfig(num_slots=2, max_len=64),
+                            start=False)
+        try:
+            h = eng.health()
+            assert h["prefill_tp"] == h["decode_tp"] == 1
+            assert h["prefill_devices"] == h["decode_devices"] == 1
+            assert h["placement"] is None
+        finally:
+            eng.close()
+
+    def test_validate_rejections(self, tiny_model):
+        params, cfg = tiny_model
+        # unequal widths need their own meshes
+        with pytest.raises(AssertionError,
+                           match="disaggregate_prefill"):
+            ServingConfig(prefill_tp=2, decode_tp=1,
+                          kv_block_size=16).validate(cfg)
+        # per-phase widths obey the same divisibility rules
+        with pytest.raises(AssertionError, match="head count"):
+            ServingConfig(decode_tp=4, kv_block_size=16,
+                          disaggregate_prefill=True).validate(cfg)
+        # the optimizer knobs are gated loudly, not silently inert
+        with pytest.raises(AssertionError, match="placement_auto"):
+            ServingConfig(placement_budget=4, kv_block_size=16,
+                          disaggregate_prefill=True).validate(cfg)
+        with pytest.raises(AssertionError,
+                           match="disaggregate_prefill"):
+            ServingConfig(placement_auto=True).validate(cfg)
+        with pytest.raises(AssertionError, match="cannot fit"):
+            ServingConfig(placement_auto=True, placement_budget=1,
+                          kv_block_size=16,
+                          disaggregate_prefill=True).validate(cfg)
+
+    def test_devices_per_engine_per_phase(self):
+        assert devices_per_engine(ServingConfig(
+            prefill_tp=1, decode_tp=2, kv_block_size=16,
+            disaggregate_prefill=True)) == 3
+        assert devices_per_engine(ServingConfig(
+            prefill_tp=2, decode_tp=1, kv_block_size=16,
+            disaggregate_prefill=True)) == 3
+        # a non-disaggregated engine shares one mesh: decode width only
+        assert devices_per_engine(ServingConfig(
+            prefill_tp=2, decode_tp=2)) == 2
+        # placement_auto + budget: the budget IS the window
+        assert devices_per_engine(ServingConfig(
+            placement_auto=True, placement_budget=3, kv_block_size=16,
+            disaggregate_prefill=True)) == 3
+
+
+class TestPlacementPlanner:
+    """serving/placement.py unit pins — static plans, hysteresis, the
+    loud refusal."""
+
+    def test_feasible_splits_obey_divisibility(self, tiny_model):
+        params, cfg = tiny_model  # 4 q / 2 kv heads, padded vocab 96
+        splits = feasible_splits(4, cfg)
+        assert (1, 1) in splits and (2, 2) in splits
+        assert (1, 2) in splits and (2, 1) in splits
+        # width 3 divides neither head count: never offered
+        assert not any(3 in s for s in splits)
+        # budget respected
+        assert all(p + d <= 4 for p, d in splits)
+
+    def test_static_plan_explicit_widths_win(self, tiny_model):
+        params, cfg = tiny_model
+        plan = plan_placement(4, cfg, signals=None, current=(1, 2))
+        assert plan.split() == (1, 2) and plan.reason == "static"
+
+    def test_static_auto_picks_symmetric_maximal(self, tiny_model):
+        params, cfg = tiny_model
+        plan = plan_placement(4, cfg, signals=None, current=None)
+        assert plan.split() == (2, 2)
+        assert plan.reason == "static:auto"
+        assert plan.devices == 4 and plan.budget == 4
+
+    def test_infeasible_current_falls_back_to_auto(self, tiny_model):
+        params, cfg = tiny_model
+        # width 3 cannot shard the heads: the configured widths are
+        # infeasible, the optimizer steps in instead of crashing
+        plan = plan_placement(4, cfg, signals=None, current=(3, 1))
+        assert plan.reason == "static:auto"
+
+    def test_signals_replan_and_hysteresis(self, tiny_model):
+        params, cfg = tiny_model
+        # strong decode pressure: replan away from (1,1)
+        decode_heavy = {"prefill_group_busy": 0.05,
+                        "decode_group_busy": 1.0,
+                        "queue_depth": 0.0, "num_slots": 2.0,
+                        "ttft_p50_ms": 0.0}
+        plan = plan_placement(3, cfg, signals=decode_heavy,
+                              current=(1, 1))
+        assert plan.split() == (1, 2)
+        assert plan.reason.startswith("signals:")
+        # near-balanced signals: the better split wins by less than
+        # REPLAN_MARGIN -> hold the current one (one noisy window must
+        # not trigger a recompile-everything re-mesh)
+        mild = {"prefill_group_busy": 0.45, "decode_group_busy": 0.55,
+                "queue_depth": 0.0, "num_slots": 2.0,
+                "ttft_p50_ms": 0.0}
+        plan = plan_placement(4, cfg, signals=mild, current=(1, 2))
+        assert plan.split() == (1, 2)
+        assert plan.reason.startswith("hold:")
+
+    def test_queue_and_ttft_count_as_prefill_pressure(self, tiny_model):
+        params, cfg = tiny_model
+        flood = {"prefill_group_busy": 0.9, "decode_group_busy": 0.9,
+                 "queue_depth": 8.0, "num_slots": 2.0,
+                 "ttft_p50_ms": 4000.0}
+        plan = plan_placement(3, cfg, signals=flood, current=(1, 1))
+        assert plan.split() == (2, 1)  # prefill gets the extra device
+
+    def test_loud_refusal_and_bad_budget(self):
+        with pytest.raises(AssertionError):
+            plan_placement(1)
+        # a model no width divides (the stub's fractional head count
+        # fails even width 1): the refusal must be typed and loud
+        impossible = types.SimpleNamespace(
+            num_attention_heads=1.5, num_kv_heads=1.5,
+            padded_vocab_size=1.5)
+        with pytest.raises(PlacementError, match="no feasible"):
+            plan_placement(4, impossible)
+
+    def test_signals_from_snapshot_reads_flat_schema(self):
+        m = ServingMetrics()
+        m.set_group_gauges(0.25, 0.75)
+        sig = signals_from_snapshot(m.snapshot())
+        assert sig["prefill_group_busy"] == 0.25
+        assert sig["decode_group_busy"] == 0.75
+        assert set(sig) == {"prefill_group_busy", "decode_group_busy",
+                            "queue_depth", "num_slots", "ttft_p50_ms"}
+
+
+class TestMetricsAndAggregate:
+    """Schema pins: the per-phase gauges + replan counter are
+    always-present, and the router aggregate carries them (the PR-13
+    zeroed-gauge bug class)."""
+
+    def test_topology_gauges_in_base_schema(self):
+        fresh = ServingMetrics().snapshot()
+        for key in ("prefill_tp", "decode_tp", "prefill_devices",
+                    "decode_devices", "placement_replans"):
+            assert key in fresh and fresh[key] == 0.0, key
+
+    def test_router_aggregate_carries_topology_gauges(self):
+        class StubEngine:
+            max_len = 64
+
+            def __init__(self, ptp, dtp):
+                self.metrics = ServingMetrics()
+                self.metrics.set_topology_gauges(ptp, dtp, ptp, dtp)
+                self.metrics.count("placement_replans")
+
+        router = EngineRouter([StubEngine(1, 2), StubEngine(2, 1)])
+        agg = router.aggregate_snapshot()
+        # device counts SUM (fleet chip footprint)...
+        assert agg["prefill_devices"] == 3.0
+        assert agg["decode_devices"] == 3.0
+        # ...widths MAX (summing widths would invent a mesh no engine
+        # runs)...
+        assert agg["prefill_tp"] == 2.0
+        assert agg["decode_tp"] == 2.0
+        # ...and the replan counter sums like every counter
+        assert agg["placement_replans"] == 2.0
+
+
+class TestPlacementReplanAtBarrier:
+    """The optimizer's second (and only other) invocation moment: the
+    quiesced swap/upgrade barrier."""
+
+    def _versions(self, tmp_path, cfg):
+        from megatron_tpu.config import (MegatronConfig,
+                                         OptimizerConfig,
+                                         TrainingConfig)
+        from megatron_tpu.training.checkpointing import save_checkpoint
+        from megatron_tpu.training.train_step import TrainState
+        mega = MegatronConfig(
+            model=cfg, optimizer=OptimizerConfig(lr=1e-3),
+            training=TrainingConfig(micro_batch_size=1,
+                                    global_batch_size=2,
+                                    train_iters=1)).validate(n_devices=1)
+        p2 = lm.model_init(jax.random.PRNGKey(1), cfg)
+        d2 = save_checkpoint(
+            str(tmp_path), TrainState(params=p2, opt_state=None,
+                                      iteration=jnp.asarray(2,
+                                                            jnp.int32)),
+            mega, iteration=2)
+        return p2, d2
+
+    SV = dict(num_slots=2, max_queue=64, max_len=64, kv_block_size=16,
+              disaggregate_prefill=True, placement_auto=True,
+              placement_budget=3)
+
+    def test_engine_swap_replans_and_stays_exact(self, tiny_model,
+                                                 tmp_path,
+                                                 monkeypatch):
+        """A decode-heavy window at the barrier re-meshes (1,1)->(1,2):
+        placement_replans counts, health carries the signal plan, and
+        post-swap decode is token-exact vs the new weights' serial
+        oracle on the NEW mesh."""
+        params, cfg = tiny_model
+        p2, d2 = self._versions(tmp_path, cfg)
+        gen = _gen(tiny_model)
+        serving = ServingConfig(**self.SV).validate(cfg)
+        # deterministic barrier signals (real gauges are duty-cycle
+        # noise on the CPU harness): the seam _apply_swap reads
+        monkeypatch.setattr(
+            "megatron_tpu.serving.placement.signals_from_snapshot",
+            lambda snap: {"prefill_group_busy": 0.05,
+                          "decode_group_busy": 1.0, "queue_depth": 0.0,
+                          "num_slots": 2.0, "ttft_p50_ms": 0.0})
+        eng = ServingEngine(gen, serving)
+        try:
+            # static plan: bare budget -> most-symmetric split (1,1)
+            assert eng._placement_plan.split() == (1, 1)
+            assert eng._placement_plan.reason == "static:auto"
+            before = eng.submit(JOBS[0][0], 6, GREEDY,
+                                seed=0).result(timeout=300)[0]
+            v = eng.swap_weights(d2, timeout=300)
+            assert v.iteration == 2
+            # the barrier re-planned and re-meshed
+            assert (eng.topo.prefill_tp, eng.topo.decode_tp) == (1, 2)
+            assert eng._placement_plan.reason.startswith("signals:")
+            snap = eng.metrics.snapshot()
+            assert snap["placement_replans"] == 1.0
+            assert snap["decode_tp"] == 2.0
+            assert snap["prefill_devices"] == 1.0
+            h = eng.health()
+            assert h["placement"]["decode_tp"] == 2
+            assert h["placement"]["budget"] == 3
+            assert h["placement"]["reason"].startswith("signals:")
+            # post-swap decode on the re-meshed engine is pure N+1
+            gen2 = Generator(p2, cfg, eos_id=0, pad_id=0,
+                             kv_cache_dtype=jnp.bfloat16)
+            from megatron_tpu.inference import SamplingParams
+            t, lens, _ = gen2.generate([JOBS[0][0]], 6,
+                                       sampling=SamplingParams(
+                                           temperature=0.0), seed=0)
+            want = t[0, :lens[0]].tolist()
+            got = eng.submit(JOBS[0][0], 6, GREEDY,
+                             seed=0).result(timeout=300)[0]
+            assert got == want and got != before
+        finally:
+            eng.close()
+
+    def test_held_plan_keeps_mesh_and_counts_nothing(self, tiny_model,
+                                                     tmp_path,
+                                                     monkeypatch):
+        """Balanced signals at the barrier: the plan holds, the mesh
+        (and its compiled programs) survive, placement_replans stays
+        0 — the hysteresis contract."""
+        params, cfg = tiny_model
+        _, d2 = self._versions(tmp_path, cfg)
+        gen = _gen(tiny_model)
+        monkeypatch.setattr(
+            "megatron_tpu.serving.placement.signals_from_snapshot",
+            lambda snap: {"prefill_group_busy": 0.5,
+                          "decode_group_busy": 0.5, "queue_depth": 0.0,
+                          "num_slots": 2.0, "ttft_p50_ms": 0.0})
+        eng = ServingEngine(gen, ServingConfig(**self.SV).validate(cfg))
+        try:
+            topo0 = eng.topo
+            eng.submit(JOBS[0][0], 4, GREEDY,
+                       seed=0).result(timeout=300)
+            eng.swap_weights(d2, timeout=300)
+            assert eng.topo is topo0  # same object: no re-mesh
+            assert eng.metrics.snapshot()["placement_replans"] == 0.0
+            assert eng._decode_traces == 1  # programs survived
+        finally:
+            eng.close()
+
+    def test_rolling_upgrade_replan_drill_zero_503(self, tiny_model,
+                                                   tmp_path,
+                                                   monkeypatch):
+        """2-replica router, live traffic, decode-heavy barrier
+        signals: the rollout re-plans BOTH replicas at their drain
+        barriers with zero 503s, completions token-exact at their
+        admitted version, and the new splits visible in the aggregate
+        and per-replica health."""
+        params, cfg = tiny_model
+        p1 = params
+        p2, d2 = self._versions(tmp_path, cfg)
+        gen1 = Generator(p1, cfg, eos_id=-1, pad_id=0,
+                         kv_cache_dtype=jnp.bfloat16)
+        gen2 = Generator(p2, cfg, eos_id=-1, pad_id=0,
+                         kv_cache_dtype=jnp.bfloat16)
+        from megatron_tpu.inference import SamplingParams
+        SP = SamplingParams(temperature=0.0)
+        oracles = {}
+
+        def want(g, prompt, n, seed):
+            key = (id(g), tuple(prompt), n, seed)
+            if key not in oracles:
+                t, lens, _ = g.generate([list(prompt)], n, sampling=SP,
+                                        seed=seed)
+                oracles[key] = t[0, :lens[0]].tolist()
+            return oracles[key]
+
+        monkeypatch.setattr(
+            "megatron_tpu.serving.placement.signals_from_snapshot",
+            lambda snap: {"prefill_group_busy": 0.05,
+                          "decode_group_busy": 1.0, "queue_depth": 0.0,
+                          "num_slots": 2.0, "ttft_p50_ms": 0.0})
+        serving = ServingConfig(**self.SV).validate(cfg)
+        per = devices_per_engine(serving)
+        assert per == 3
+        devs = jax.devices()
+        engines = [ServingEngine(gen1, serving,
+                                 devices=devs[i * per:(i + 1) * per])
+                   for i in range(2)]
+        router = EngineRouter(engines, max_retries=2,
+                              heartbeat_timeout_s=3.0,
+                              probe_backoff_s=0.2)
+        results, stop = [], threading.Event()
+        lock = threading.Lock()
+
+        def worker(wid):
+            i = 0
+            while not stop.is_set():
+                p = [3 + (wid + i) % 5, 7, 11]
+                seed = 1000 * wid + i
+                try:
+                    r = router.submit(p, 6, GREEDY, seed=seed)
+                    toks, _ = r.result(timeout=300)
+                    with lock:
+                        results.append((p, seed, toks, None))
+                except Exception as e:  # noqa: BLE001 — counted below
+                    with lock:
+                        results.append((p, seed, None, e))
+                i += 1
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(2)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            v = router.rolling_upgrade(d2, swap_timeout_s=300)
+            assert v.iteration == 2
+            time.sleep(0.3)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        try:
+            errors = [e for *_, e in results if e is not None]
+            assert not errors, (
+                f"zero-503 contract broken across the re-plan: "
+                f"{len(errors)} failed ({errors[:3]})")
+            assert len(results) >= 2
+            for p, seed, toks, _ in results:
+                assert toks == want(gen1, p, 6, seed) \
+                    or toks == want(gen2, p, 6, seed), (
+                    "completion matches NEITHER version's oracle", p,
+                    seed)
+            # both replicas re-planned at their own drain barriers
+            for eng in engines:
+                assert (eng.topo.prefill_tp,
+                        eng.topo.decode_tp) == (1, 2)
+            agg = router.aggregate_snapshot()
+            assert agg["placement_replans"] == 2.0
+            assert agg["prefill_devices"] == 2.0
+            assert agg["decode_devices"] == 4.0
+            assert agg["decode_tp"] == 2.0
+            # the plan rides the router's per-replica health summary
+            h = router.health()
+            for rep in h["replicas"]:
+                assert rep["placement"]["decode_tp"] == 2
+                assert rep["placement"]["reason"].startswith("signals:")
+            # post-upgrade traffic is pure N+1 on the new meshes
+            r = router.submit([9, 9, 8], 6, GREEDY, seed=77)
+            assert r.result(timeout=300)[0] == want(gen2, [9, 9, 8],
+                                                    6, 77)
+        finally:
+            router.close()

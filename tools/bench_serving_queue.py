@@ -13,6 +13,8 @@ a single tunnel window captures every outstanding serving A/B:
             cost: base vs one vs mixed)
   item 10 — tools/bench_disagg.py     (interleave vs disaggregated +
             serving-tp decode scaling)
+  item 12 — tools/bench_phase_topology.py (symmetric vs asymmetric
+            prefill_tp:decode_tp splits on one device budget)
 
 Each tool runs as its own subprocess with an independent timeout (a
 wedge in one cannot eat the window), its one-line JSON record is
@@ -42,6 +44,9 @@ QUEUE = [
     ("block_attn", "bench_block_attn.py", ["--smoke"], []),
     ("lora", "bench_lora.py", ["--smoke"], []),
     ("disagg", "bench_disagg.py", ["--smoke"], []),
+    # per-phase topology splits (symmetric vs decode-heavy vs
+    # prefill-heavy on one budget; greedy arms token-agree)
+    ("phase_topology", "bench_phase_topology.py", ["--smoke"], []),
     # structured output + COW n-best (constrained-vs-free mask-upload
     # cadence, n=1x4-vs-n=4 one-prefill fan-out)
     ("structured", "bench_structured.py", ["--smoke"], []),

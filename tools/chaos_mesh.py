@@ -8,8 +8,10 @@ complement: from a single ``--seed`` it
 1. **samples a serving config** from the capability matrix — pool
    layout (whole-region / block / block-native kernel), prefix cache +
    chunked prefill + host tier, speculative decoding, adapters,
-   priorities/preemption/shedding, serving_tp, disaggregation,
-   replicas, int8 KV, rolling sliding-window models — driving the REAL
+   priorities/preemption/shedding, serving_tp, disaggregation with
+   per-phase widths (prefill_tp / decode_tp — asymmetric splits
+   included), replicas, int8 KV, rolling sliding-window models —
+   driving the REAL
    ``ServingConfig.validate()`` as the rejection filter, so illegal
    combinations (rolling x speculative, kernel x sliding-window, ...)
    are exercised as LOUD-rejection cases (recorded per run), never
@@ -69,7 +71,8 @@ N_DEVICES = 4  # forced host platform: disagg/tp configs need 2x2
 # swap, structured output, and n-best fan-out regardless of what the
 # bare seed would draw
 SMOKE_SEEDS = [(7, ("adapters",)), (11, ("disagg",)), (23, ("swap",)),
-               (31, ("structured",)), (43, ("fanout",))]
+               (31, ("structured",)), (43, ("fanout",)),
+               (53, ("phases",))]  # asymmetric per-phase disagg split
 
 # the seeded grammar pool: every entry compiles against the tiny
 # model's vocab-128 identity token table (token i <-> chr(i)), so
@@ -101,7 +104,7 @@ def sample_config(rng: random.Random, require=()):
     rejections = []
     for _ in range(200):
         rolling = rng.random() < 0.15 and "disagg" not in require \
-            and "tp" not in require
+            and "tp" not in require and "phases" not in require
         model_kwargs = dict(compute="float32", num_kv_heads=2)
         if rolling:
             model_kwargs.update(sliding_window=64,
@@ -123,6 +126,18 @@ def sample_config(rng: random.Random, require=()):
             disaggregate_prefill=rng.random() < 0.25,
             num_replicas=2 if rng.random() < 0.4 else 1,
         )
+        # per-phase widths (serving/topology.py): disaggregated configs
+        # draw independent prefill_tp/decode_tp — asymmetric splits are
+        # the point. A small slice deliberately draws ILL-FORMED
+        # corners: per-phase widths without disaggregation (unequal
+        # widths on a shared mesh) or a width that does not divide the
+        # tiny model's kv heads — both must come back as LOUD
+        # validate() rejections, never silent coercion.
+        if kw["disaggregate_prefill"] and rng.random() < 0.35:
+            kw["prefill_tp"] = rng.choice([1, 2])
+            kw["decode_tp"] = rng.choice([1, 2])
+        elif rng.random() < 0.08:
+            kw["prefill_tp"] = rng.choice([2, 3])
         if rng.random() < 0.5:
             kw.update(priority_levels=2,
                       preemption=rng.random() < 0.7)
@@ -141,19 +156,30 @@ def sample_config(rng: random.Random, require=()):
             kw["num_replicas"] = 2
         if "tp" in require:
             kw["serving_tp"] = 2
+        if "phases" in require:
+            # asymmetric per-phase disagg split (1 prefill chip : 2
+            # decode chips — fits the 4-device budget with slack)
+            kw.update(disaggregate_prefill=True, kv_block_size=16,
+                      serving_tp=1, prefill_tp=1, decode_tp=2,
+                      num_replicas=1)
         if "fanout" in require:
             # fan-out aggregates are engine-level (the router's retry
             # pump refuses best_of > 1 typed) — pin a bare engine so
             # the required n=2 specs actually admit
             kw["num_replicas"] = 1
         # resource clamp (not a matrix exclusion): N_DEVICES virtual
-        # devices must fit num_replicas x devices_per_engine
-        per = kw["serving_tp"] * (2 if kw["disaggregate_prefill"]
-                                  else 1)
+        # devices must fit num_replicas x devices_per_engine — the
+        # same per-phase arithmetic serving/topology.devices_per_engine
+        # resolves (decode width + prefill width when disaggregated)
+        ptp = kw.get("prefill_tp") or kw["serving_tp"]
+        dtp = kw.get("decode_tp") or kw["serving_tp"]
+        per = dtp + (ptp if kw["disaggregate_prefill"] else 0)
         if per * kw["num_replicas"] > N_DEVICES:
             kw["num_replicas"] = 1
         if per > N_DEVICES:
             kw["serving_tp"] = 1
+            kw.pop("prefill_tp", None)
+            kw.pop("decode_tp", None)
         model = cc.tiny_model_cfg(**model_kwargs)
         try:
             ServingConfig(**kw).validate(model)
@@ -301,8 +327,11 @@ def _build_target(model_kwargs: dict, serving_kw: dict):
     gen = cc.tiny_generator(model, seed=0)
     serving = ServingConfig(**serving_kw).validate(model)
     n_rep = serving_kw.get("num_replicas", 1)
-    per = serving_kw.get("serving_tp", 1) * (
-        2 if serving_kw.get("disaggregate_prefill") else 1)
+    # per-replica window size under the RESOLVED per-phase topology
+    # (decode_tp + prefill_tp when disaggregated — the same arithmetic
+    # inference/server.py slices with)
+    from megatron_tpu.serving.topology import devices_per_engine
+    per = devices_per_engine(serving)
     devs = jax.devices()
     if per > 1:
         engines = [ServingEngine(gen, serving,
@@ -649,7 +678,7 @@ def run_smoke(n_requests: int, new_tokens: int) -> dict:
         "value": sum(1 for r in runs if r["ok"]),
         "unit": (f"seeded configs with every invariant green "
                  f"(of {len(runs)}: adapters/disagg/live-swap/"
-                 f"structured/fanout corners)"),
+                 f"structured/fanout/asymmetric-phases corners)"),
         "vs_baseline": None,
         "completed": ok,
         "seed": SMOKE_SEEDS[0][0],
@@ -702,12 +731,14 @@ def main(argv=None) -> int:
     ap.add_argument("--require", type=str, default="",
                     help="comma-separated sampler biases (part of the "
                          "repro line): adapters, disagg, router, tp, "
-                         "swap, structured, fanout")
+                         "phases, swap, structured, fanout")
     ap.add_argument("--smoke", action="store_true",
-                    help="fixed seed set for bench extras / CI: >= 5 "
+                    help="fixed seed set for bench extras / CI: >= 6 "
                          "distinct configs covering adapters, "
                          "disaggregation, a live-weight swap, "
-                         "structured output, and n-best fan-out")
+                         "structured output, n-best fan-out, and an "
+                         "asymmetric per-phase (prefill_tp!=decode_tp) "
+                         "disagg split")
     ap.add_argument("--minutes", type=float, default=None,
                     help="soak mode: walk seeds until the wall-clock "
                          "budget expires; stop at the first violation")
