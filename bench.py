@@ -441,6 +441,15 @@ def _run_extras():
         # its repro line
         ("chaos_mesh.py", ["--smoke"],
          "/tmp/bench_extras_chaos_mesh.log"),
+        # seeded SLO-storm conformance (docs/serving.md "Overload,
+        # degradation & SLO conformance"): trace-driven load at
+        # 0.5x/1x/2x the calibrated sustainable rate against the
+        # brownout ladder — TTFT/ITL bounds, goodput floor, shed
+        # monotonicity, degrade-and-fully-revert, token-exact degraded
+        # completions, plus one injected SLO regression the perf laws
+        # must catch
+        ("chaos_storm.py", ["--smoke"],
+         "/tmp/bench_extras_chaos_storm.log"),
         # corrupt-dataset detection smoke: inject truncated-.bin /
         # garbage-.idx / out-of-range-pointer faults, prove each raises
         # a typed DatasetCorruptionError at open (docs/resilience.md

@@ -312,6 +312,26 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                         "estimated queue delay already exceeds its "
                         "deadline, instead of queue-then-504 "
                         "(docs/serving.md overload section)")
+    g.add_argument("--degrade_ladder", type=int, default=0,
+                   help="serving: graceful-degradation brownout ladder "
+                        "max level — under sustained overload walk "
+                        "1: no speculative decoding, 2: + cap "
+                        "best_of/max_new_tokens for new admissions, "
+                        "3: + shed lowest priority class, 4: shed all, "
+                        "with hysteresis on both edges (0 disables — "
+                        "bit-identical to the ladderless engine; "
+                        "docs/serving.md 'Overload, degradation & SLO "
+                        "conformance')")
+    g.add_argument("--slo_ttft_ms", type=float, default=None,
+                   help="serving: TTFT SLO target in ms — first tokens "
+                        "arriving later count slo_ttft_violations and "
+                        "the request's tokens leave goodput_tokens "
+                        "(observability only; None = unset)")
+    g.add_argument("--slo_itl_p99_ms", type=float, default=None,
+                   help="serving: inter-token-latency SLO target in ms "
+                        "— a host-visible token gap beyond it counts "
+                        "slo_itl_violations (observability only; "
+                        "None = unset)")
     g.add_argument("--preemption", action="store_true",
                    help="serving: a queued higher-priority request "
                         "with no allocatable slot evicts the lowest-"
@@ -755,6 +775,9 @@ def config_from_args(args: argparse.Namespace,
             speculative_k=args.speculative_k,
             priority_levels=args.priority_levels,
             shed_on_overload=args.shed_on_overload,
+            degrade_ladder=args.degrade_ladder,
+            slo_ttft_ms=args.slo_ttft_ms,
+            slo_itl_p99_ms=args.slo_itl_p99_ms,
             preemption=args.preemption,
             max_engine_restarts=args.max_engine_restarts,
             engine_step_timeout_s=args.engine_step_timeout_s,

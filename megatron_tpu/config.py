@@ -500,6 +500,42 @@ class ServingConfig:
     # queue and then 504. Only sheds once at least one completion has
     # been observed (the estimate needs a service-time sample).
     shed_on_overload: bool = False
+    # graceful degradation (serving/degrade.py, docs/serving.md
+    # "Overload, degradation & SLO conformance"): the brownout ladder's
+    # maximum level — under SUSTAINED overload the controller walks
+    # from full service toward shed one rung at a time (1: disable
+    # speculative decoding; 2: + cap best_of to n and max_new_tokens to
+    # degrade_max_new_tokens for NEW admissions; 3: + shed the lowest
+    # priority class; 4: shed all new admissions — today's cliff),
+    # lowering with hysteresis as pressure drains. 0 = no controller at
+    # all, behaviorally bit-identical to the pre-ladder engine
+    # (test-pinned).
+    degrade_ladder: int = 0
+    # per-level raise thresholds on the pressure signal
+    # (queue_depth/num_slots * occupancy) — None uses the built-in
+    # doubling ladder (degrade.DEFAULT_RAISE_AT) truncated to
+    # degrade_ladder levels; an explicit tuple must be strictly
+    # increasing with one entry per level
+    degrade_raise_at: Optional[tuple] = None
+    # the lower edge of each rung is hysteresis * its raise edge, and
+    # a transition needs this many CONSECUTIVE supervisor-loop
+    # evaluations past the edge — one bursty sync window can neither
+    # raise nor lower a level
+    degrade_hysteresis: float = 0.5
+    degrade_dwell_up: int = 2
+    degrade_dwell_down: int = 4
+    # level-2 cap on max_new_tokens for new admissions (the request's
+    # EFFECTIVE config — its serial oracle keys off the clamped value,
+    # so degraded completions stay token-exact)
+    degrade_max_new_tokens: int = 64
+    # SLO targets (None = unset, the counters stay 0): first token
+    # later than slo_ttft_ms counts slo_ttft_violations and excludes
+    # the request's tokens from goodput_tokens; a host-visible
+    # inter-token gap over slo_itl_p99_ms counts slo_itl_violations.
+    # Pure observability — neither changes scheduling; tools/
+    # chaos_storm.py turns them into per-seed perf laws.
+    slo_ttft_ms: Optional[float] = None
+    slo_itl_p99_ms: Optional[float] = None
     # priority preemption: a queued higher-priority request with no
     # allocatable slot evicts the lowest-priority running slot. The
     # victim's KV is PARKED in a batch-1 sub-cache (slice_slot — the
@@ -732,6 +768,43 @@ class ServingConfig:
             "preemption requires priority_levels >= 2: with one "
             "priority class every request clamps to priority 0 and "
             "no arrival can ever outrank a running slot")
+        # graceful degradation (serving/degrade.py): the ladder's
+        # shape is validated here so a bad spec fails at config time,
+        # not mid-storm
+        assert 0 <= self.degrade_ladder <= 4, (
+            f"degrade_ladder={self.degrade_ladder} must be in 0..4 "
+            "(0 disables; 4 is the full brownout ladder)")
+        if self.degrade_raise_at is not None:
+            assert self.degrade_ladder, (
+                "degrade_raise_at without degrade_ladder is inert: the "
+                "thresholds parameterize the controller — set "
+                "degrade_ladder >= 1 or drop the thresholds")
+            ra = tuple(self.degrade_raise_at)
+            assert len(ra) == self.degrade_ladder, (
+                f"degrade_raise_at needs one threshold per level: "
+                f"degrade_ladder={self.degrade_ladder} but got "
+                f"{len(ra)} thresholds")
+            assert all(x > 0 for x in ra) and \
+                all(b > a for a, b in zip(ra, ra[1:])), (
+                f"degrade_raise_at must be positive and strictly "
+                f"increasing (a monotone ladder), got {ra}")
+        if self.degrade_ladder:
+            assert 0.0 < self.degrade_hysteresis < 1.0, (
+                f"degrade_hysteresis={self.degrade_hysteresis} must be "
+                "a ratio in (0, 1): the lower edge of each rung is "
+                "hysteresis * its raise edge")
+            assert self.degrade_dwell_up >= 1 and \
+                self.degrade_dwell_down >= 1, (
+                "degrade dwell counts must be >= 1 supervisor-loop "
+                "evaluations")
+            assert self.degrade_max_new_tokens >= 1, (
+                f"degrade_max_new_tokens={self.degrade_max_new_tokens} "
+                "must be >= 1: level 2 clamps new admissions' "
+                "max_new_tokens to it")
+        assert self.slo_ttft_ms is None or self.slo_ttft_ms > 0.0, (
+            self.slo_ttft_ms)
+        assert self.slo_itl_p99_ms is None or \
+            self.slo_itl_p99_ms > 0.0, self.slo_itl_p99_ms
         assert self.max_engine_restarts >= 0, self.max_engine_restarts
         assert self.engine_step_timeout_s is None or \
             self.engine_step_timeout_s > 0.0, self.engine_step_timeout_s

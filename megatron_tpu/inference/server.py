@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import math
 import secrets
 import threading
 from typing import Optional, Tuple
@@ -551,8 +552,16 @@ class MegatronServer:
         if queue_depth is None:
             queue_depth = (self.engine.queue_depth()
                            if self.engine is not None else 0)
+        # ceil-clamp to >= 1s: a remote replica's hint arrives as a
+        # FLOAT, and int(0.5) == 0 would emit Retry-After: 0 — telling
+        # every shed client to retry immediately, a synchronized herd
+        # at the worst possible moment (and response_headers would
+        # drop the falsy header entirely). Sub-second estimates round
+        # UP; absent hints default to 1.
+        hint = (1 if retry_after is None
+                else max(1, int(math.ceil(float(retry_after)))))
         return {"message": message,
-                "retry_after": int(retry_after) if retry_after else 1,
+                "retry_after": hint,
                 "queue_depth": int(queue_depth)}
 
     @staticmethod

@@ -3,13 +3,16 @@ generator and the HTTP front end — see docs/serving.md)."""
 from megatron_tpu.serving.adapters import (  # noqa: F401
     AdapterBank, AdapterBankFullError, UnknownAdapterError,
     adapter_bank_nbytes, load_adapter_npz)
+from megatron_tpu.serving.degrade import (  # noqa: F401
+    DEFAULT_RAISE_AT, DegradeController)
 from megatron_tpu.serving.engine import (  # noqa: F401
     EngineHungError, ServingEngine)
 from megatron_tpu.serving.host_tier import HostKVTier  # noqa: F401
 from megatron_tpu.serving.invariants import (  # noqa: F401
-    InvariantViolation, check_all, check_grammar_validity,
-    check_kv_accounting, check_metrics_conservation, check_schema,
-    check_token_exact, resolve_terminals)
+    InvariantViolation, check_all, check_degrade_revert,
+    check_goodput_floor, check_grammar_validity, check_kv_accounting,
+    check_metrics_conservation, check_schema, check_shed_monotone,
+    check_slo_bounds, check_token_exact, resolve_terminals)
 from megatron_tpu.serving.router import (  # noqa: F401
     EngineRouter, NoReplicaAvailableError, RollingUpgradeError,
     RouterRequest)

@@ -132,6 +132,7 @@ from megatron_tpu.serving.kv_pool import (SlotKVPool, block_native_cache,
                                           pack_block_native, resolve_view,
                                           scatter_view, slice_blocks,
                                           slice_slot)
+from megatron_tpu.serving.degrade import DegradeController
 from megatron_tpu.serving.metrics import ServingMetrics
 from megatron_tpu.serving.prefix_index import PrefixIndex
 from megatron_tpu.serving.request import (FanoutRequest, GenRequest,
@@ -462,6 +463,21 @@ class ServingEngine:
         self.scheduler.active_fn = (
             lambda: int(self._active.sum()) + len(self._prefilling))
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        # graceful degradation (serving/degrade.py): None when the
+        # brownout ladder is disabled — the None path is the
+        # bit-identical pre-ladder engine (test-pinned). The
+        # controller is HOST state like the scheduler queue: it
+        # deliberately survives supervisor restarts (_restart_session
+        # rebuilds device state only) — a replica that wedged under
+        # overload must not come back at level 0 and re-admit the
+        # flood that wedged it.
+        self.degrade = DegradeController.from_config(self.serving)
+        # SLO targets in seconds (observability only — the counters
+        # and the goodput ledger, never scheduling)
+        self._slo_ttft_s = (self.serving.slo_ttft_ms / 1e3
+                            if self.serving.slo_ttft_ms else None)
+        self._slo_itl_s = (self.serving.slo_itl_p99_ms / 1e3
+                           if self.serving.slo_itl_p99_ms else None)
         self._writer = writer
         self._report_interval = max(report_interval, 1)
 
@@ -680,6 +696,17 @@ class ServingEngine:
             raise AdmissionError(
                 f"best_of={best_of} exceeds the engine's {self.num_slots}"
                 " slots: the fan-out could never decode concurrently")
+        # brownout level 2+ (serving/degrade.py): cap fan-out and
+        # length for NEW admissions — applied BEFORE the received count
+        # so accounting, the child requests and the serial oracle all
+        # see the same EFFECTIVE config (the clamped values ARE the
+        # request's config; token-exactness holds by construction).
+        # best_of clamps to n — the exploration samples beyond what the
+        # caller gets back are the first work to go.
+        if self.degrade is not None and self.degrade.cap_work():
+            best_of = n
+            max_new_tokens = min(int(max_new_tokens),
+                                 self.serving.degrade_max_new_tokens)
         # received is counted FIRST (once per SAMPLE — each child is a
         # unit of terminal accounting) so that every submit-time
         # refusal below (adapter 400, grammar 400, draining 429, queue
@@ -722,6 +749,21 @@ class ServingEngine:
                         f"response_format does not compile: {e}") from e
             priority = max(0, min(int(priority),
                                   self.serving.priority_levels - 1))
+            # brownout levels 3/4 (serving/degrade.py): shed the
+            # lowest priority class (3) or every new admission (4) —
+            # AFTER the received count, so the shed lands in
+            # requests_shed/requests_rejected against matching
+            # requests_received like every other submit-time refusal
+            if self.degrade is not None and self.degrade.shed_priority(
+                    priority, self.serving.priority_levels):
+                what = ("all new admissions shed"
+                        if self.degrade.level >= 4
+                        else "lowest-priority admissions shed")
+                raise OverloadShedError(
+                    f"brownout level {self.degrade.level}: {what} — "
+                    "retry later or against another replica",
+                    retry_after=self.scheduler.retry_after_hint(),
+                    queue_depth=self.scheduler.depth())
             children: List[GenRequest] = []
             for i in range(best_of):
                 req = GenRequest(list(prompt), max_new_tokens, sampling,
@@ -787,9 +829,20 @@ class ServingEngine:
         calls, they would double-count requests_completed and break
         the law."""
         if outcome == "completed":
+            # goodput ledger: a completed request whose first token
+            # blew the TTFT SLO delivered its tokens too late to be
+            # useful work — they count in tokens_generated but not
+            # goodput_tokens. Without an SLO every completed token is
+            # goodput (the gauge stays meaningful on any config).
+            gen = len(req.generated)
+            good = gen
+            ttft = req.ttft
+            if self._slo_ttft_s is not None and ttft is not None \
+                    and ttft > self._slo_ttft_s:
+                good = 0
             self.metrics.record_completed(
                 (req.finish_time or req.submit_time) - req.submit_time,
-                len(req.generated))
+                gen, good_tokens=good)
         else:
             self.metrics.count("requests_" + outcome)
 
@@ -878,6 +931,15 @@ class ServingEngine:
             "kv_blocks_retained": kv_retained,
             "service_time_ewma_ms":
                 self.scheduler.service_time_ewma() * 1e3,
+            # brownout ladder (serving/degrade.py): the router
+            # aggregates the bare level across replicas as MAX; 0 is
+            # both "full service" and the ladderless reading, so the
+            # schema never forks. "degrade" carries the controller's
+            # full shape (None when the ladder is disabled).
+            "degrade_level": (self.degrade.level
+                              if self.degrade is not None else 0),
+            "degrade": (self.degrade.describe()
+                        if self.degrade is not None else None),
             # adapter-locality routing signal (0 on adapterless
             # engines; cheap dict read, HTTP-thread safe)
             "active_adapters": (self.adapters.active_count()
@@ -2093,6 +2155,11 @@ class ServingEngine:
                        and not self._prefilling):
                     self._cond.wait(timeout=self._idle_wait)
                     self._heartbeat()  # idleness is not a hang
+                    # the brownout ladder must step DOWN on an idle
+                    # engine too — after a storm drains, the level
+                    # reverts without needing new traffic to drive
+                    # loop iterations (the monotone-revert law)
+                    self._evaluate_degrade()
                 if self._stop:
                     return True
                 if (self._draining and not self._active.any()
@@ -2109,6 +2176,10 @@ class ServingEngine:
             self._maybe_decay_restarts()
             self._reap_cancelled()
             self._reap_expired()
+            # one brownout-ladder evaluation per iteration (each one
+            # decode window apart — the dwell counts are calibrated in
+            # these units)
+            self._evaluate_degrade()
             if self._pending_swap is not None:
                 # SWAP BARRIER (docs/serving.md "Live weights"): hold
                 # NEW admissions — queued work simply WAITS, nothing is
@@ -2146,6 +2217,27 @@ class ServingEngine:
                     self._watchdog.start()
                 else:
                     self._watchdog.heartbeat()
+
+    def _evaluate_degrade(self):
+        """One brownout-ladder evaluation (engine thread only — the
+        controller is single-writer; HTTP submit threads read the
+        plain-int level lock-free). Transitions count
+        `degrade_transitions` and push the `degrade_level` gauge, so
+        the ladder's walk is fully reconstructible from /metrics."""
+        if self.degrade is None:
+            return
+        before = self.degrade.level
+        after = self.degrade.observe(
+            self.scheduler.depth(),
+            int(self._active.sum()) + len(self._prefilling),
+            self.num_slots)
+        if after != before:
+            self.metrics.count("degrade_transitions")
+            self.metrics.set_degrade_gauge(after)
+            print_rank_0(
+                f"serving engine: brownout level {before} -> {after} "
+                f"(pressure {self.degrade._last_pressure:.2f}, "
+                f"queue {self.scheduler.depth()})")
 
     # ------------------------------------------------------------------
     # supervisor: hang detection, restart, circuit breaker
@@ -2218,7 +2310,17 @@ class ServingEngine:
         queued requests REQUEUE losslessly (nothing irrecoverable lives
         on device for them — a replay recomputes their KV, and a
         preempted request's resume_rng is host-side). Parked preemption
-        buffers are dropped for the same reason; their owners replay."""
+        buffers are dropped for the same reason; their owners replay.
+
+        HOST state survives deliberately: the scheduler (and with it
+        the service-time EWMA — the shed estimate does not cold-start
+        on a supervisor restart) and the brownout ladder's level
+        (serving/degrade.py) — a replica that wedged UNDER overload
+        must not come back at level 0 and re-admit the flood that
+        wedged it. Both choices are test-pinned
+        (tests/test_resilience.py). A whole-PROCESS replica restart
+        does cold-start both: there the EWMA re-learns within one
+        sync window of its first completion."""
         for slot, req in enumerate(self._slot_req):
             if req is not None:
                 req.fail(f"engine step failed while this request was "
@@ -3381,6 +3483,18 @@ class ServingEngine:
             self._d_adapter_idx = jnp.asarray(self._adapter_idx)
             self._lengths_dirty = False
         spec_k = self._spec_k
+        if spec_k and self.degrade is not None \
+                and self.degrade.spec_disabled():
+            # brownout level 1+ (serving/degrade.py): speculative
+            # decoding is the first service to go — forcing the
+            # window's effective spec_k to 0 makes every round below
+            # take the plain _decode path, which is pinned
+            # bit-identical to a non-speculative engine (and consumes
+            # the residual carry), so running streams switch
+            # mid-window without a token changing. No draft building,
+            # no spec_rounds/spec_fallback_steps: a degraded window's
+            # metrics read exactly like a non-speculative engine's.
+            spec_k = 0
         spec_round = [False] * K
         grids = None
         guesses = None
@@ -3475,9 +3589,15 @@ class ServingEngine:
         active_slots = np.nonzero(self._active)[0]
         n_active = len(active_slots)
         consumed = np.zeros(K, np.int64)  # tokens delivered per step
+        # the host-visible commit moment for this whole sync window —
+        # what an SSE consumer's inter-token gap actually measures
+        # (per-token timestamps inside a window would be fiction: the
+        # K steps land on the host together)
+        commit_t = time.monotonic()
         for slot in active_slots:
             req = self._slot_req[slot]
             done = False
+            had_tokens = len(req.generated)
             for r in range(K):
                 if done:
                     break
@@ -3545,6 +3665,9 @@ class ServingEngine:
                     req.append_token(tok, lp)
                     if first:
                         self.metrics.record_first_token(req.ttft)
+                        if self._slo_ttft_s is not None \
+                                and req.ttft > self._slo_ttft_s:
+                            self.metrics.count("slo_ttft_violations")
                     self._lengths[slot] += 1
                     consumed[r] += 1
                     if j > 0:
@@ -3591,6 +3714,17 @@ class ServingEngine:
                         # NEW state; a self-loop (state unchanged)
                         # skips this — no upload next window
                         self._set_slot_mask(slot, req)
+            if self._slo_itl_s is not None \
+                    and len(req.generated) > had_tokens:
+                # inter-token-latency SLO: one check per slot per
+                # window against the gap since the slot's PREVIOUS
+                # commit window (the first window's gap is TTFT
+                # territory, counted above)
+                prev = getattr(req, "_last_commit_t", None)
+                if prev is not None \
+                        and commit_t - prev > self._slo_itl_s:
+                    self.metrics.count("slo_itl_violations")
+                req._last_commit_t = commit_t
         self._steps += K
         # attention-path A/B gauges: bytes any resolve/scatter
         # full-pool bracket moved this window, averaged per step.

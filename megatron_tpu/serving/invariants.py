@@ -58,6 +58,27 @@ The laws (each independently checkable, composed by `check_all`):
    storm; a grammar with no legal continuation fails TYPED
    (GrammarDeadEndError → 422), which rides law 2's taxonomy.
 
+PERF laws (8–11, tools/chaos_storm.py): the same machinery pointed at
+latency and goodput, so an SLO regression prints a seed repro line
+exactly like a correctness bug. These take HARNESS-side measurements
+(stream timings, per-arm shed fractions, a polled level series) rather
+than an engine object — the harness measures, the law judges:
+
+8.  **SLO bounds** — measured TTFT / inter-token-latency percentiles
+    sit under their bounds (p99 ITL bounded under burst, TTFT bounded
+    at target utilization; bounds are derived from a measured
+    calibration run, not guessed).
+9.  **Goodput floor** — `goodput_tokens` (completed work that met its
+    TTFT SLO) is at least a floor fraction of `tokens_generated`:
+    degradation must trade work AWAY, not burn it.
+10. **Shed monotonicity** — across offered-load arms of one seed, the
+    shed fraction never decreases as offered load rises (admission
+    control responds to load, it doesn't oscillate with it).
+11. **Degradation monotone-revert** — the brownout level stays within
+    the configured ladder, does not thrash (hysteresis bounds the
+    direction changes), and fully REVERTS to 0 after the storm
+    drains: a brownout is a mode, not a ratchet.
+
 Thread contract: the strict sweeps (`check_all(..., strict=True)`,
 `check_kv_accounting`) read engine-thread-owned accounting — run them
 against a QUIESCED engine (idle: every tracked future resolved and the
@@ -69,7 +90,7 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from megatron_tpu.serving.metrics import ServingMetrics
+from megatron_tpu.serving.metrics import ServingMetrics, _percentile
 from megatron_tpu.serving.request import (DeadlineExceededError,
                                           GrammarDeadEndError,
                                           RequestFailedError,
@@ -592,6 +613,113 @@ def _check_remote_engine(e, strict: bool, sw: _Sweep) -> dict:
         sw.violations.append((str(law),
                               f"replica {addr}: {detail}"))
     return {"remote": addr, "report": rep}
+
+
+# ---------------------------------------------------------------------
+# perf laws 8-11 (tools/chaos_storm.py): harness-measured inputs
+# ---------------------------------------------------------------------
+def check_slo_bounds(samples_ms: Dict[str, Sequence[float]],
+                     bounds_ms: Dict[str, Tuple[float, float]],
+                     sweep: Optional[_Sweep] = None) -> dict:
+    """Law 8: each named latency series (``"ttft_ms"``, ``"itl_ms"``,
+    ...) keeps its specified percentile under its bound.
+    `bounds_ms[name] = (quantile, bound_ms)` — e.g. ``{"itl_ms":
+    (0.99, 80.0)}`` states "p99 inter-token latency <= 80ms". An empty
+    series is vacuously fine (the harness decides whether zero samples
+    is itself an error). Returns per-series stats for the record."""
+    sw = sweep or _Sweep()
+    stats: dict = {}
+    for name, (q, bound) in bounds_ms.items():
+        vals = sorted(float(v) for v in samples_ms.get(name, ()))
+        got = _percentile(vals, q)
+        stats[name] = {"n": len(vals), "quantile": q,
+                       "value_ms": got, "bound_ms": float(bound)}
+        sw.note("slo_bounds", not vals or got <= bound,
+                f"{name} p{q * 100:g} = {got:.1f}ms exceeds the "
+                f"{bound:.1f}ms bound ({len(vals)} samples)")
+    if sweep is None:
+        sw.raise_if_violated()
+    return stats
+
+
+def check_goodput_floor(snapshot: Dict[str, float], floor: float,
+                        sweep: Optional[_Sweep] = None) -> dict:
+    """Law 9: ``goodput_tokens >= floor * tokens_generated`` — of the
+    work the engine actually decoded, at least `floor` was useful
+    (completed within its TTFT SLO). A degradation controller that
+    admits work it then serves too late to matter fails HERE even
+    though every correctness law holds."""
+    sw = sweep or _Sweep()
+    gen = float(snapshot.get("tokens_generated", 0.0))
+    good = float(snapshot.get("goodput_tokens", 0.0))
+    ratio = good / gen if gen else 1.0
+    sw.note("goodput_floor", ratio >= floor,
+            f"goodput {good:g} / generated {gen:g} = {ratio:.2f} "
+            f"below the {floor:.2f} floor — admitted work was decoded "
+            "but delivered too late to count")
+    if sweep is None:
+        sw.raise_if_violated()
+    return {"tokens_generated": gen, "goodput_tokens": good,
+            "ratio": ratio, "floor": floor}
+
+
+def check_shed_monotone(arms: Sequence[Tuple[float, float]],
+                        tolerance: float = 0.05,
+                        sweep: Optional[_Sweep] = None) -> list:
+    """Law 10: across `(offered_load, shed_fraction)` arms of ONE
+    seed, the shed fraction never DECREASES as offered load rises
+    (within `tolerance`, for sampling noise on small arms). A shed
+    rate that falls as load grows means admission control is keying
+    on something other than load."""
+    sw = sweep or _Sweep()
+    arms = sorted((float(l), float(s)) for l, s in arms)
+    for (l0, s0), (l1, s1) in zip(arms, arms[1:]):
+        sw.note("shed_monotone", s1 >= s0 - tolerance,
+                f"shed fraction fell {s0:.3f} -> {s1:.3f} as offered "
+                f"load rose {l0:g}x -> {l1:g}x (tolerance "
+                f"{tolerance:g})")
+    if sweep is None:
+        sw.raise_if_violated()
+    return list(arms)
+
+
+def check_degrade_revert(levels: Sequence[int], max_level: int,
+                         require_rise: bool = False,
+                         max_direction_changes: Optional[int] = None,
+                         sweep: Optional[_Sweep] = None) -> dict:
+    """Law 11 on a polled brownout-level series (storm through
+    post-storm quiesce): every reading within ``[0, max_level]``, the
+    FINAL reading 0 (a brownout is a mode, not a ratchet), optionally
+    a required rise (a 2x-overload arm that never degraded means the
+    controller is dead — checker-not-vacuous), and optionally a bound
+    on rise/fall direction changes (hysteresis must stop one storm
+    from thrashing the ladder; the theoretical minimum is 2: up once,
+    down once)."""
+    sw = sweep or _Sweep()
+    lv = [int(x) for x in levels]
+    peak = max(lv) if lv else 0
+    sw.note("degrade_revert",
+            all(0 <= x <= max_level for x in lv),
+            f"level left the ladder [0, {max_level}]: {lv}")
+    sw.note("degrade_revert", not lv or lv[-1] == 0,
+            f"level did not revert to 0 after the storm "
+            f"(final {lv[-1] if lv else '?'}; peak {peak})")
+    if require_rise:
+        sw.note("degrade_revert", peak > 0,
+                "level never rose under a storm that demanded "
+                "degradation — the controller is dead or the storm "
+                "vacuous")
+    if max_direction_changes is not None:
+        deltas = [b - a for a, b in zip(lv, lv[1:]) if b != a]
+        changes = 1 + sum(1 for a, b in zip(deltas, deltas[1:])
+                          if (a > 0) != (b > 0)) if deltas else 0
+        sw.note("degrade_revert", changes <= max_direction_changes,
+                f"ladder thrashed: {changes} direction changes "
+                f"(> {max_direction_changes}) in {lv}")
+    if sweep is None:
+        sw.raise_if_violated()
+    return {"peak": peak, "final": lv[-1] if lv else 0,
+            "samples": len(lv)}
 
 
 def check_all(target, requests: Sequence = (),

@@ -139,6 +139,37 @@ _BASE_COUNTERS = (
     # was applied — only ever at the rolling-upgrade drain barrier,
     # never mid-serve (a held plan counts nothing)
     "placement_replans",
+    # graceful degradation + SLO conformance (serving/degrade.py,
+    # docs/serving.md "Overload, degradation & SLO conformance"):
+    # degrade_transitions = brownout-ladder level changes (either
+    # direction — a storm that rises to level 3 and reverts counts 6),
+    # slo_ttft_violations = first tokens that arrived after
+    # `slo_ttft_ms`, slo_itl_violations = sync windows in which a
+    # slot's next committed token arrived more than `slo_itl_p99_ms`
+    # after its previous one (host-visible inter-token gap — what an
+    # SSE consumer actually sees), goodput_tokens = generated tokens of
+    # COMPLETED requests that met their TTFT SLO (with no SLO
+    # configured every completed request's tokens count — goodput then
+    # equals completed work, so the gauge is meaningful on any config)
+    "degrade_transitions", "slo_ttft_violations", "slo_itl_violations",
+    "goodput_tokens",
+)
+
+# gauges a snapshot always carries (0.0 before any traffic), by the
+# exact attribute name each is stored under — `snapshot()` builds its
+# gauge block from THIS tuple, so a gauge added to __init__ but not
+# listed here simply never reaches /metrics (loud in tests, not a
+# silent schema fork). The router's aggregation test walks this tuple
+# to prove every gauge survives a fleet scrape (the PR 13 lesson:
+# gauges in neither _SUM_GAUGES nor _MAX_GAUGES silently zero).
+_BASE_GAUGES = (
+    "queue_depth", "active_slots", "num_slots",
+    "kv_blocks_used", "kv_blocks_retained", "kv_bytes_wasted",
+    "kv_gather_bytes_per_step", "kv_attn_path",
+    "active_adapters", "handoff_bytes_per_req",
+    "prefill_group_busy", "decode_group_busy",
+    "prefill_tp", "decode_tp", "prefill_devices", "decode_devices",
+    "weight_version", "fleet_replicas_up", "degrade_level",
 )
 
 
@@ -222,6 +253,12 @@ class ServingMetrics:
         # so a fresh fleet scrape never mutates the schema; the
         # router's aggregate overwrites it with the live count)
         self.fleet_replicas_up = 0.0
+        # graceful degradation (serving/degrade.py): the brownout
+        # ladder's current level — 0 = full service (also the reading
+        # on ladder-disabled engines, so the schema never forks). The
+        # router aggregates it as MAX: a fleet scrape reports its
+        # most-degraded replica.
+        self.degrade_level = 0.0
 
     # ---- recording ---------------------------------------------------
     def count(self, name: str, n: int = 1):
@@ -237,10 +274,16 @@ class ServingMetrics:
         with self._lock:
             self._ttft.append(ttft_s)
 
-    def record_completed(self, latency_s: float, gen_tokens: int):
+    def record_completed(self, latency_s: float, gen_tokens: int,
+                         good_tokens: Optional[int] = None):
+        """`good_tokens` is the SLO-conformant share of `gen_tokens`
+        (the goodput ledger); callers without an SLO pass None and
+        every completed token counts as goodput."""
         with self._lock:
             self._counters["requests_completed"] += 1
             self._counters["tokens_generated"] += gen_tokens
+            self._counters["goodput_tokens"] += (
+                gen_tokens if good_tokens is None else good_tokens)
             self._req_latency.append(latency_s)
 
     def set_kv_gauges(self, blocks_used: int, blocks_retained: int,
@@ -296,6 +339,12 @@ class ServingMetrics:
         with self._lock:
             self.fleet_replicas_up = float(replicas_up)
 
+    def set_degrade_gauge(self, level: int) -> None:
+        """Engine-pushed on every brownout-ladder transition (and once
+        at build): the current degradation level."""
+        with self._lock:
+            self.degrade_level = float(level)
+
     def set_attn_gauges(self, gather_bytes_per_step: int, path: int):
         """Engine-pushed attention-path gauges (per sync window):
         bytes a resolve/scatter bracket moved per decode/verify step
@@ -337,32 +386,11 @@ class ServingMetrics:
             lat = sorted(self._req_latency)
             occ = (self._busy_slot_steps / self._total_slot_steps
                    if self._total_slot_steps else 0.0)
-            gauges = {"queue_depth": float(self.queue_depth),
-                      "active_slots": float(self.active_slots),
-                      "num_slots": float(self.num_slots),
-                      # always present (0.0 before traffic) like the
-                      # base counters: the /metrics schema never
-                      # mutates mid-run
-                      "kv_blocks_used": float(self.kv_blocks_used),
-                      "kv_blocks_retained": float(self.kv_blocks_retained),
-                      "kv_bytes_wasted": float(self.kv_bytes_wasted),
-                      "kv_gather_bytes_per_step":
-                          float(self.kv_gather_bytes_per_step),
-                      "kv_attn_path": float(self.kv_attn_path),
-                      "active_adapters": float(self.active_adapters),
-                      "handoff_bytes_per_req":
-                          float(self.handoff_bytes_per_req),
-                      "prefill_group_busy":
-                          float(self.prefill_group_busy),
-                      "decode_group_busy":
-                          float(self.decode_group_busy),
-                      "prefill_tp": float(self.prefill_tp),
-                      "decode_tp": float(self.decode_tp),
-                      "prefill_devices": float(self.prefill_devices),
-                      "decode_devices": float(self.decode_devices),
-                      "weight_version": float(self.weight_version),
-                      "fleet_replicas_up":
-                          float(self.fleet_replicas_up)}
+            # always present (0.0 before traffic) like the base
+            # counters: the /metrics schema never mutates mid-run.
+            # Built from _BASE_GAUGES so the gauge schema lives in ONE
+            # place — attribute names ARE the scrape keys.
+            gauges = {k: float(getattr(self, k)) for k in _BASE_GAUGES}
         out = {k: 0.0 for k in _BASE_COUNTERS}
         out.update({k: float(v) for k, v in counters.items()})
         out.update(gauges)

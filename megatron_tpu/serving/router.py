@@ -66,9 +66,17 @@ _SUM_GAUGES = ("queue_depth", "active_slots", "num_slots",
 # per-group readings where summing fractions would be meaningless
 # (same treatment as the *_ms latency keys below). The per-phase tp
 # widths ride here too: summing widths across replicas would invent a
-# mesh no engine runs.
+# mesh no engine runs. degrade_level is max by CONTRACT (serving/
+# degrade.py): a fleet scrape reports its most-degraded replica.
+# kv_gather_bytes_per_step / kv_attn_path were the PR 13 lesson's
+# recurrence — present in every engine snapshot but in NEITHER
+# aggregation list, so fleet scrapes silently zeroed them; the
+# metrics._BASE_GAUGES coverage test now pins that every
+# always-present gauge has an aggregation rule.
 _MAX_GAUGES = ("handoff_bytes_per_req", "prefill_group_busy",
-               "decode_group_busy", "prefill_tp", "decode_tp")
+               "decode_group_busy", "prefill_tp", "decode_tp",
+               "kv_gather_bytes_per_step", "kv_attn_path",
+               "degrade_level")
 
 
 class NoReplicaAvailableError(ServiceUnavailableError):
@@ -807,6 +815,10 @@ class EngineRouter:
                     "active_slots": int(h.get("active_slots", 0)),
                     "service_time_ewma_ms":
                         float(h.get("service_time_ewma_ms", 0.0)),
+                    # brownout visibility: which replicas are shedding
+                    # service (the aggregate /metrics reports the max;
+                    # here operators see WHICH replica it is)
+                    "degrade_level": int(h.get("degrade_level", 0)),
                     # mixed-version visibility mid-rollout
                     "weight_version": h.get("weight_version",
                                             "unversioned"),

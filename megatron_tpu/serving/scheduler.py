@@ -129,10 +129,22 @@ class AdmissionScheduler:
         return self._service_ewma * (ahead + busy) / self.num_slots
 
     def _retry_after_locked(self, depth: int) -> int:
+        """Backoff hint in whole seconds, ALWAYS >= 1: a sub-second
+        EWMA estimate must never truncate to 0 — Retry-After: 0 tells
+        every shed client to retry immediately, a synchronized herd at
+        the worst possible moment (the >= 1 floor is test-pinned at
+        this layer AND at the server's _backoff_body)."""
         if self._service_ewma is None:
             return 1
         est = self._service_ewma * max(depth, 1) / self.num_slots
         return max(1, min(int(math.ceil(est)), 60))
+
+    def retry_after_hint(self) -> int:
+        """Public backoff hint for refusals decided OUTSIDE the
+        scheduler (the engine's brownout sheds): the same clamped
+        [1, 60]s estimate queue-full refusals carry."""
+        with self._lock:
+            return self._retry_after_locked(len(self._q))
 
     # ---- admission ---------------------------------------------------
     def check_admissible(self, req: GenRequest):

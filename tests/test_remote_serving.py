@@ -302,6 +302,39 @@ class TestAggregateParity:
         assert sl["weight_version"] == 3.0
         assert sl["fleet_replicas_up"] == 2.0
 
+    def test_degrade_and_slo_keys_survive_the_wire(self):
+        """Fixed-schema pin, remote flavor: the brownout/SLO keys are
+        present at 0 on a fresh scrape served over HTTP (a
+        RemoteReplica /metrics GET), and aggregate with the contracted
+        semantics — degrade_level as fleet max (a scrape reports its
+        most degraded replica), the SLO/goodput counters as sums."""
+        from megatron_tpu.serving import EngineRouter
+        fresh = json.loads(json.dumps(ServingMetrics().snapshot()))
+        port = _serve_once(lambda conn: conn.sendall(
+            _http(json.dumps(fresh).encode())))
+        scraped = _rep(port).metrics.snapshot()
+        for key in ("degrade_transitions", "degrade_level",
+                    "slo_ttft_violations", "slo_itl_violations",
+                    "goodput_tokens"):
+            assert scraped[key] == 0.0, key
+        a, b = _fleet_snaps()
+        a.update({"degrade_level": 2.0, "degrade_transitions": 3.0,
+                  "slo_ttft_violations": 1.0, "goodput_tokens": 50.0})
+        b.update({"degrade_level": 1.0, "slo_itl_violations": 4.0,
+                  "goodput_tokens": 25.0})
+        router = EngineRouter(
+            [_StubEngine(json.loads(json.dumps(a))),
+             _StubEngine(json.loads(json.dumps(b)))])
+        try:
+            agg = router.aggregate_snapshot()
+        finally:
+            router.close()
+        assert agg["degrade_level"] == 2.0
+        assert agg["degrade_transitions"] == 3.0
+        assert agg["slo_ttft_violations"] == 1.0
+        assert agg["slo_itl_violations"] == 4.0
+        assert agg["goodput_tokens"] == 75.0
+
 
 # ---------------------------------------------------------------------
 # digest_peek: the remote affinity hint agrees with the engine

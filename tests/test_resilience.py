@@ -1566,6 +1566,74 @@ def test_chaos_mesh_smoke(tmp_path):
                    for a, v in run["action_log"]) for run in runs)
 
 
+class TestChaosStormTool:
+    """tools/chaos_storm.py in-process (tier-1): one seeded 2x-overload
+    storm with the brownout ladder rising and fully reverting, every
+    perf + structural law green — and the injected SLO regression
+    caught with its repro line (the ISSUE 19 acceptance pins)."""
+
+    def test_single_seed_green_with_rise_and_revert(self):
+        from tools.chaos_storm import run_one
+        record = run_one(17, n_requests=6, new_tokens=6)
+        assert record["ok"], record["violations"]
+        assert record["seed"] == 17
+        assert "--seed 17" in record["repro"]
+        # the 2x arm must actually climb the ladder, and the drained
+        # engine must walk it all the way back (brownout, not blackout)
+        assert record["degrade_peak"] >= 1
+        assert record["degrade_final"] == 0
+        assert all(a["stranded"] == 0 for a in record["arms"])
+        assert all(a["bad_retry_after"] == 0 for a in record["arms"])
+        # shed fraction monotone across the sorted arms (tolerance
+        # handled inside the law; here the record just carries them)
+        assert [a["mult"] for a in record["arms"]] == [0.5, 1.0, 2.0]
+        assert record["value"] >= 1  # completed requests, all exact
+
+    def test_injected_slo_regression_caught(self):
+        from tools.chaos_storm import run_one
+        record = run_one(17, n_requests=5, new_tokens=6,
+                         inject_slo_regression=True)
+        assert record["injected_caught"] is True
+        assert record["ok"] is True
+        assert "--inject_slo_regression" in record["repro"]
+
+
+@pytest.mark.slow
+def test_chaos_storm_smoke(tmp_path):
+    """tools/chaos_storm.py --smoke (subprocess, the bench-extras
+    entry): plain / speculative / adapter-skew storms plus one
+    injected-regression catch, every record carrying its repro
+    seed."""
+    import subprocess
+    import sys as _sys
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "chaos_storm.py")
+    out = str(tmp_path / "chaos_storm.json")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [_sys.executable, tool, "--smoke", "--requests", "6",
+         "--new_tokens", "6", "--out", out],
+        capture_output=True, text=True, env=env, timeout=900)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    with open(out) as f:
+        record = json.load(f)
+    assert record["completed"] is True
+    assert record["value"] == len(record["runs"])  # every storm green
+    assert "seed" in record
+    runs = record["runs"]
+    assert len(runs) >= 4
+    for run in runs:
+        assert run["ok"], run["violations"]
+        assert "seed" in run and "--seed" in run["repro"]
+    # fixed corner coverage: a speculative engine walked rung 1, an
+    # adapter-skewed storm ran, and the vacuity pin caught its stall
+    assert any(run["config"].get("speculative_k") for run in runs)
+    assert any(run["config"].get("adapter_slots") for run in runs)
+    assert any(run.get("injected_caught") for run in runs)
+
+
 @pytest.mark.slow
 def test_chaos_mesh_soak(tmp_path):
     """Soak mode (--minutes): walks seeds until the budget expires,

@@ -38,8 +38,9 @@ complement: from a single ``--seed`` it
 A failing run prints the one-line repro (``--seed S [--require ...]``)
 with the violated laws. ``--minutes N`` soak mode walks seeds until
 the budget expires; ``--smoke`` runs a small fixed seed set covering
-adapters, disaggregation, and a live-weight swap (bench extras + the
-slow-tier test run it); ``--inject_violation`` deliberately drops a
+adapters, disaggregation, a live-weight swap, and the brownout
+degradation ladder (bench extras + the slow-tier test run it);
+``--inject_violation`` deliberately drops a
 terminal transition after a green run to prove the checker is not
 vacuous (test-pinned).
 
@@ -72,7 +73,8 @@ N_DEVICES = 4  # forced host platform: disagg/tp configs need 2x2
 # bare seed would draw
 SMOKE_SEEDS = [(7, ("adapters",)), (11, ("disagg",)), (23, ("swap",)),
                (31, ("structured",)), (43, ("fanout",)),
-               (53, ("phases",))]  # asymmetric per-phase disagg split
+               (53, ("phases",)),  # asymmetric per-phase disagg split
+               (61, ("degrade",))]  # brownout ladder + SLO accounting
 
 # the seeded grammar pool: every entry compiles against the tiny
 # model's vocab-128 identity token table (token i <-> chr(i)), so
@@ -141,6 +143,15 @@ def sample_config(rng: random.Random, require=()):
         if rng.random() < 0.5:
             kw.update(priority_levels=2,
                       preemption=rng.random() < 0.7)
+        # brownout ladder + SLO accounting axis (serving/degrade.py):
+        # degraded admissions stay oracle-exact because the
+        # token-exact law keys off the request's EFFECTIVE
+        # max_new_tokens, not the spec it was submitted with
+        if rng.random() < 0.3:
+            kw.update(degrade_ladder=rng.choice([2, 4]),
+                      degrade_max_new_tokens=6)
+        if rng.random() < 0.25:
+            kw.update(slo_ttft_ms=30_000.0, slo_itl_p99_ms=30_000.0)
         if rng.random() < 0.35:
             kw["engine_step_timeout_s"] = 2.0
         if kw["enable_prefix_cache"] and kw["kv_block_size"] \
@@ -162,6 +173,16 @@ def sample_config(rng: random.Random, require=()):
             kw.update(disaggregate_prefill=True, kv_block_size=16,
                       serving_tp=1, prefill_tp=1, decode_tp=2,
                       num_replicas=1)
+        if "degrade" in require:
+            # full brownout ladder with hair-trigger raise edges and
+            # minimal dwell so the mesh storm actually walks it under
+            # a 2-slot engine, plus live SLO accounting
+            kw.update(degrade_ladder=4,
+                      degrade_raise_at=(0.25, 0.5, 1.0, 2.0),
+                      degrade_dwell_up=1, degrade_dwell_down=2,
+                      degrade_max_new_tokens=6,
+                      shed_on_overload=True, priority_levels=2,
+                      slo_ttft_ms=30_000.0, slo_itl_p99_ms=30_000.0)
         if "fanout" in require:
             # fan-out aggregates are engine-level (the router's retry
             # pump refuses best_of > 1 typed) — pin a bare engine so
@@ -678,7 +699,8 @@ def run_smoke(n_requests: int, new_tokens: int) -> dict:
         "value": sum(1 for r in runs if r["ok"]),
         "unit": (f"seeded configs with every invariant green "
                  f"(of {len(runs)}: adapters/disagg/live-swap/"
-                 f"structured/fanout/asymmetric-phases corners)"),
+                 f"structured/fanout/asymmetric-phases/degrade "
+                 f"corners)"),
         "vs_baseline": None,
         "completed": ok,
         "seed": SMOKE_SEEDS[0][0],
@@ -731,7 +753,7 @@ def main(argv=None) -> int:
     ap.add_argument("--require", type=str, default="",
                     help="comma-separated sampler biases (part of the "
                          "repro line): adapters, disagg, router, tp, "
-                         "phases, swap, structured, fanout")
+                         "phases, swap, structured, fanout, degrade")
     ap.add_argument("--smoke", action="store_true",
                     help="fixed seed set for bench extras / CI: >= 6 "
                          "distinct configs covering adapters, "
