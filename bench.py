@@ -391,6 +391,15 @@ def _run_extras():
         # ITL ratio + the prefill-heavy TTFT ratio vs symmetric
         ("bench_phase_topology.py", ["--smoke"],
          "/tmp/bench_extras_phase_topology.log"),
+        # pipeline-sharded serving A/B (PERF_NOTES queue item 13):
+        # mono vs serving_pp=2 at pp_waves 1 and 2 over one staggered
+        # workload — greedy arms assert token agreement (staging is a
+        # placement change, not a semantics change) and the
+        # pp_stage_bubble gauge is pinned to (S-1)/(W+S-1); ON CHIP
+        # the record is the staged tok/s tax vs the analytic bubble
+        # and whether the second wave claws it back
+        ("bench_pp_serving.py", ["--smoke"],
+         "/tmp/bench_extras_pp_serving.log"),
         # structured-output + n-best A/B (PERF_NOTES serving section):
         # constrained-vs-free decode (mask uploads ONLY on FSM state
         # change, outputs assert-parsed) and n=1x4-vs-n=4 COW fan-out

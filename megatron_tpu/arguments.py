@@ -432,6 +432,28 @@ def build_parser(extra_args_provider: Optional[Callable] = None
                    help="serving: tensor-parallel width of the DECODE "
                         "group (defaults to --serving_tp) — decode is "
                         "HBM-bound; see --prefill_tp")
+    g.add_argument("--serving_pp", type=int, default=1,
+                   help="serving: pipeline-stage count for the decode "
+                        "group — the group's devices split into "
+                        "serving_pp layer-stage sub-meshes (each "
+                        "decode_tp wide); stage i holds layers "
+                        "[i*L/S,(i+1)*L/S) plus embedding on stage 0 "
+                        "and head/final-norm on the last stage, the "
+                        "KV arena partitions on the layer axis, and "
+                        "decode runs as a staged program chain with "
+                        "one [slots, hidden] device_put between "
+                        "stages; needs --kv_block_size and "
+                        "num_layers divisible by serving_pp; 1 = no "
+                        "staged topology, bit-identical "
+                        "(docs/serving.md 'Pipeline-sharded serving')")
+    g.add_argument("--pp_waves", type=int, default=1,
+                   help="serving: interleaved wave count under "
+                        "--serving_pp (1F1B on the slot grid) — the "
+                        "slot grid splits into this many waves so "
+                        "stage i works wave k while stage i+1 works "
+                        "wave k-1; bubble fraction "
+                        "(S-1)/(W+S-1) exports as pp_stage_bubble; "
+                        "needs num_slots divisible by pp_waves")
     g.add_argument("--placement_auto", action="store_true",
                    help="serving: let serving/placement.py choose the "
                         "prefill:decode split and per-phase tp widths "
@@ -794,6 +816,8 @@ def config_from_args(args: argparse.Namespace,
             disaggregate_prefill=args.disaggregate_prefill,
             prefill_tp=args.prefill_tp,
             decode_tp=args.decode_tp,
+            serving_pp=args.serving_pp,
+            pp_waves=args.pp_waves,
             placement_auto=args.placement_auto,
             placement_budget=args.placement_budget,
             adapter_slots=args.adapter_slots,

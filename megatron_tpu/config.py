@@ -637,6 +637,36 @@ class ServingConfig:
     # and each width must divide the head counts and the padded vocab.
     prefill_tp: Optional[int] = None
     decode_tp: Optional[int] = None
+    # --- pipeline-sharded serving (docs/serving.md "Pipeline-sharded
+    # serving"; serving/topology.py + serving/pp.py) ------------------
+    # layer-stage count for the DECODE group: the group's devices
+    # split into serving_pp sub-meshes of decode_tp devices each,
+    # stage i holds layers [i*L/S, (i+1)*L/S) of the stacked pytree
+    # (parallel/pipeline.stage_params_reshape) plus the embedding on
+    # stage 0 and the final-norm/LM-head on stage S-1, and the
+    # per-layer KV arena partitions on the LAYER axis so each stage
+    # holds only its own layers' blocks. The decode step becomes a
+    # staged program chain — stage i's compiled segment runs its layer
+    # slice and the [num_slots, hidden] activation crosses to stage
+    # i+1 via one device_put (the P->D handoff seam) — while the block
+    # map, lengths, and sampling state stay replicated dispatch data,
+    # so decode/verify/prefill keep ONE compile each PER STAGE.
+    # Requires kv_block_size and num_layers % serving_pp == 0;
+    # composes with decode_tp/serving_tp (the per-stage width) and
+    # REJECTS disaggregate_prefill / explicit prefill_tp /
+    # block_native_attn / host_kv_bytes / placement_auto /
+    # sliding-window models loudly. 1 (default) builds no staged
+    # topology at all — bit-identical pre-pp code paths (test-pinned).
+    serving_pp: int = 1
+    # interleaved wave count (1F1B on the slot grid): split the
+    # num_slots slot grid into pp_waves micro-batches so stage i works
+    # wave k while stage i+1 works wave k-1 — depth becomes throughput
+    # instead of pure latency; the bubble fraction
+    # (serving_pp-1)/(pp_waves+serving_pp-1) exports as the
+    # pp_stage_bubble gauge. Requires serving_pp > 1 and
+    # num_slots % pp_waves == 0; rejects speculative_k (the verify
+    # chain runs whole-grid). 1 (default) = one wave, the plain chain.
+    pp_waves: int = 1
     # signal-driven placement (serving/placement.py): let the engine
     # choose the prefill:decode split and per-phase widths from its
     # device budget at build (and from the observed
@@ -908,6 +938,11 @@ class ServingConfig:
             self.decode_tp
         eff_pre = self.prefill_tp or self.serving_tp
         eff_dec = self.decode_tp or self.serving_tp
+        if self.serving_pp > 1:
+            # pipeline-sharded serving runs BOTH phases through the
+            # same stage chain at the per-stage width: there is no
+            # independent prefill width (prefill_tp is rejected below)
+            eff_pre = eff_dec
         if eff_pre != eff_dec:
             assert self.disaggregate_prefill, (
                 f"prefill_tp={eff_pre} != decode_tp={eff_dec} requires "
@@ -960,6 +995,71 @@ class ServingConfig:
                     "length block handoff is not defined — serve "
                     "rolling models single-group "
                     "(chunk-interleave fallback)")
+        # --- pipeline-sharded serving (serving/topology.py stages) ----
+        assert self.serving_pp >= 1, self.serving_pp
+        assert self.pp_waves >= 1, self.pp_waves
+        if self.serving_pp > 1:
+            assert not self.serial_fallback, (
+                "serving_pp > 1 requires the continuous-batching "
+                "engine: the serial fallback path builds no serving "
+                "mesh — drop serial_fallback or serving_pp")
+            assert self.kv_block_size is not None, (
+                "serving_pp requires kv_block_size: the per-layer KV "
+                "arena partitions on the LAYER axis across stages and "
+                "each stage's slice is a block arena — set "
+                "--kv_block_size or serve with serving_pp=1")
+            assert not self.disaggregate_prefill, (
+                "serving_pp does not compose with disaggregate_prefill"
+                ": the staged decode chain already owns the cross-mesh "
+                "activation seam, and a third (prefill) group would "
+                "need its own full-depth weight copy — pick pipeline "
+                "stages OR a disaggregated prefill group, not both")
+            assert self.prefill_tp is None, (
+                "serving_pp rejects an explicit prefill_tp: prefill "
+                "runs through the SAME stage chain as decode (each "
+                "stage is decode_tp wide) — drop prefill_tp; "
+                "decode_tp/serving_tp set the per-stage width")
+            assert not getattr(self, "block_native_attn", False), (
+                "serving_pp is unsupported with block_native_attn: "
+                "the staged arena slices dispatch through the "
+                "resolve/scatter bracket — drop block_native_attn or "
+                "serving_pp")
+            assert not self.host_kv_bytes, (
+                "host_kv_bytes is unsupported with serving_pp: the "
+                "host tier gathers/restores whole-depth block lists, "
+                "but a staged arena splits every block across stage "
+                "meshes — disable the host tier or serving_pp")
+            assert not self.placement_auto, (
+                "placement_auto is unsupported with serving_pp: the "
+                "barrier re-mesh re-plans tp widths only — the stage "
+                "depth is pinned from config (re-staging the layer "
+                "partition is not a placement decision); set "
+                "serving_pp explicitly")
+            if model is not None:
+                assert model.num_layers % self.serving_pp == 0, (
+                    f"serving_pp={self.serving_pp} must divide "
+                    f"num_layers={model.num_layers}: stages hold "
+                    "equal contiguous layer slices "
+                    "(parallel/pipeline.stage_params_reshape)")
+                assert model.sliding_window is None, (
+                    "serving_pp is unsupported on sliding-window "
+                    "models: the rolling ring's per-layer offset "
+                    "arithmetic does not survive the staged arena "
+                    "partition — serve with serving_pp=1")
+        if self.pp_waves > 1:
+            assert self.serving_pp > 1, (
+                "pp_waves > 1 without serving_pp > 1 is inert: waves "
+                "interleave the slot grid ACROSS stages — set "
+                "serving_pp or drop pp_waves")
+            assert self.num_slots % self.pp_waves == 0, (
+                f"pp_waves={self.pp_waves} must divide "
+                f"num_slots={self.num_slots}: each wave is an equal "
+                "slot-grid slice (the compiled per-stage programs "
+                "run at one wave shape)")
+            assert not self.speculative_k, (
+                "speculative_k is unsupported with pp_waves > 1: the "
+                "staged verify chain runs whole-grid (W=1) — drop "
+                "pp_waves or speculative decoding")
         # --- placement optimizer (serving/placement.py) ---------------
         if self.placement_budget is not None:
             assert self.placement_auto, (

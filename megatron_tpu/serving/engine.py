@@ -306,6 +306,14 @@ class ServingEngine:
                                    devices=devices)
         self._disagg = (self.topo is not None
                         and self.topo.disaggregated)
+        # pipeline-sharded decode (serving/pp.py; docs/serving.md
+        # "Pipeline-sharded serving"): S layer-stage sub-meshes, every
+        # compiled program a chain of per-stage segments. 1 = off — the
+        # staged machinery below never constructs and every code path
+        # is byte-for-byte the pre-pp engine.
+        self._pp = (self.topo.serving_pp if self.topo is not None else 1)
+        self._pp_waves = (self.topo.pp_waves if self.topo is not None
+                          else 1)
         if self.topo is not None:
             assert generator.mesh is None, (
                 "serving_tp/disaggregate_prefill build their own "
@@ -330,6 +338,13 @@ class ServingEngine:
                                dtype=kv_dtype,
                                retained_limit=self.serving.retained_slots,
                                block_size=self.serving.kv_block_size)
+        if self._pp > 1:
+            # fail BEFORE the staged pool placement tries to slice a
+            # block-less arena (pinned reasons below)
+            assert self.pool.blocks_enabled, (
+                "serving_pp > 1 requires kv_block_size — the per-layer "
+                "KV arena partitions on the layer axis at block "
+                "granularity; see ServingConfig.validate")
         if self.topo is not None:
             self.topo.place_pool(self.pool)
         # disaggregation re-asserts (engines can be constructed
@@ -342,6 +357,44 @@ class ServingEngine:
         assert not (self._disagg and self.pool.rolling), (
             "disaggregate_prefill is unsupported on ROLLING pools — "
             "see ServingConfig.validate")
+        # pipeline-sharded re-asserts (ServingConfig.validate's pinned
+        # reasons, repeated for engines constructed without it): staged
+        # decode partitions the BLOCK arena on layers and crosses the
+        # residual stream between stage meshes, so it needs blocks and
+        # excludes the paths that assume one whole-model mesh
+        if self._pp > 1:
+            assert self.pool.blocks_enabled, (
+                "serving_pp > 1 requires kv_block_size — the per-layer "
+                "KV arena partitions on the layer axis at block "
+                "granularity; see ServingConfig.validate")
+            assert not self._disagg, (
+                "serving_pp > 1 does not compose with "
+                "disaggregate_prefill — the staged decode group IS the "
+                "prefill group; see ServingConfig.validate")
+            assert not self.pool.rolling, (
+                "serving_pp > 1 is unsupported on ROLLING "
+                "(sliding-window) KV pools — see ServingConfig.validate")
+            assert not getattr(self.serving, "block_native_attn", False), (
+                "serving_pp > 1 keeps the resolve/scatter bracket — "
+                "block_native_attn is unsupported; see "
+                "ServingConfig.validate")
+            assert not int(getattr(self.serving, "host_kv_bytes", 0)
+                           or 0), (
+                "serving_pp > 1 does not compose with host_kv_bytes — "
+                "see ServingConfig.validate")
+            assert cfg.num_layers % self._pp == 0, (
+                f"serving_pp={self._pp} must divide "
+                f"num_layers={cfg.num_layers} — see "
+                "ServingConfig.validate")
+            assert self.num_slots % self._pp_waves == 0, (
+                f"pp_waves={self._pp_waves} must divide "
+                f"num_slots={self.num_slots} — see "
+                "ServingConfig.validate")
+            assert not (self._pp_waves > 1
+                        and int(self.serving.speculative_k or 0)), (
+                "pp_waves > 1 does not compose with speculative_k — "
+                "the verify window runs whole-grid; see "
+                "ServingConfig.validate")
         # block-granular pool: the static per-slot block map is
         # resolved at dispatch (kv_pool.resolve_view/scatter_view
         # bracket every compiled program), so the one-compile contract
@@ -591,6 +644,13 @@ class ServingEngine:
             self.metrics.set_topology_gauges(
                 d["prefill_tp"], d["decode_tp"],
                 d["prefill_devices"], d["decode_devices"])
+            from megatron_tpu.serving import pp as pps
+            self.metrics.set_pp_gauges(
+                d["serving_pp"], d["pp_waves"],
+                pps.pp_bubble(d["serving_pp"], d["pp_waves"]),
+                pps.activation_bytes_per_step(
+                    self.num_slots, cfg.hidden_size,
+                    cfg.compute_dtype, d["serving_pp"]))
         self._steps = 0
         self._cond = threading.Condition()
         self._stop = False
@@ -1318,7 +1378,15 @@ class ServingEngine:
                 else:
                     self._placement_plan = plan  # held — fresher reason
             if not replanned:
-                if self.topo is not None:
+                if self.topo is not None and self.topo.serving_pp > 1:
+                    # staged swap: the new tree splits and lands
+                    # stage-for-stage on the existing sub-meshes —
+                    # identical shapes/shardings, so the per-stage
+                    # programs cache-hit like the mono swap
+                    p_dec, _ = self.topo.place_stage_params(
+                        staged.params, self.cfg)
+                    p_pre = p_dec
+                elif self.topo is not None:
                     p_dec, _ = self.topo.place_params(
                         staged.params, self.cfg, self.topo.decode_mesh)
                     if self._disagg:
@@ -1468,6 +1536,20 @@ class ServingEngine:
                 f"({cfg.num_attention_heads} q / {cfg.num_kv_heads} "
                 f"kv) and the padded vocab ({cfg.padded_vocab_size}) "
                 "— see ServingConfig.validate")
+        if self.topo.serving_pp > 1:
+            # pipeline-sharded decode: the model tree splits into
+            # per-stage slices, each resident ONLY on its own stage
+            # sub-mesh (serving/pp.py) — no device ever holds another
+            # stage's layers. _p_dec/_psh_dec become stage-indexed
+            # lists; the prefill group aliases them (disaggregation is
+            # rejected under serving_pp) and the returned factories go
+            # unused — _compile_programs routes to
+            # _compile_pp_programs, which builds the per-stage jits
+            # directly.
+            self._p_dec, self._psh_dec = self.topo.place_stage_params(
+                params, cfg)
+            self._p_pre, self._psh_pre = self._p_dec, self._psh_dec
+            return self._jit_factories()
         self._p_dec, self._psh_dec = self.topo.place_params(
             params, cfg, self.topo.decode_mesh)
         if self._disagg:
@@ -1497,6 +1579,11 @@ class ServingEngine:
         event that legitimately re-pays the compile bill; trace
         counters reset because a new program set is a new one-compile
         epoch)."""
+        if self.topo is not None and self.topo.serving_pp > 1:
+            # pipeline-sharded decode: per-stage program chains behind
+            # wrappers with the EXACT mono signatures — every dispatch
+            # site below stays untouched
+            return self._compile_pp_programs()
         S, Vp = self.num_slots, self.cfg.padded_vocab_size
         self._decode_traces = 0  # trace count — MUST stay 1 in steady state
         # lengths (arg 4) chains device-side but is NOT donated: it is
@@ -1566,6 +1653,575 @@ class ServingEngine:
                                         donate_argnums=(1, 2, 3))
         self._pad_sub_pre = _jit_pre(self._pad_sub_pre_fn,
                                      n_array_args=2)
+
+    # ------------------------------------------------------------------
+    # pipeline-sharded program chains (serving_pp > 1)
+    # ------------------------------------------------------------------
+    def _pp_put(self, x, i):
+        """Replicate a dispatch-data array onto stage i's sub-mesh —
+        the [S, hidden] residual (and the few small metadata rows that
+        ride with it) crossing a stage seam via ONE device_put, the
+        same transfer primitive the disaggregated P→D handoff uses."""
+        if x is None:
+            return None
+        return jax.device_put(
+            x, self.topo.replicated(self.topo.stage_meshes[i]))
+
+    def _pp_stage_lora(self):
+        """Per-stage slices of the adapter bank's stacked factor tree
+        (serving/pp.py stage_lora), each resident on its own stage
+        sub-mesh under the bank's projection shardings. Re-sliced only
+        when the bank's stacked ref changed (loads replace it
+        functionally); [None]*S with adapters off."""
+        if not self._adapters_on:
+            return [None] * self._pp
+        src = self.adapters.stacked
+        if self._pp_lora_src is not src:
+            from megatron_tpu.serving import pp as pps
+            stages = []
+            for i, mesh in enumerate(self.topo.stage_meshes):
+                sliced = pps.stage_lora(src, self.cfg, self._pp, i)
+                stages.append(jax.device_put(
+                    sliced, self.topo.adapter_shardings(mesh)))
+            self._pp_lora_src = src
+            self._pp_lora = stages
+        return self._pp_lora
+
+    def _compile_pp_programs(self):
+        """Build the staged program set for `serving_pp = S > 1`: each
+        mono program becomes a chain of per-stage jitted segments —
+        stage i runs its own contiguous layer slice against its own
+        layer-partitioned KV arena slice on its own sub-mesh, and the
+        [rows, hidden] residual activation crosses each seam via one
+        `device_put`. The chains hide behind Python wrappers with the
+        EXACT mono signatures/returns, assigned to `self._decode` /
+        `_verify` / `_prefill` / `_chunk_fwd` / `_slice_blk` /
+        `_insert_blk`, so every dispatch site in the engine stays
+        byte-for-byte untouched; `self.pool.caches` and `st.sub` become
+        stage-indexed LISTS the wrappers thread through.
+
+        Chaining contiguous layer slices is bit-identical math to the
+        mono full-depth scan (two half-depth lax.scans chained == one),
+        which is what makes the serving_pp=2-vs-1 token-exactness gate
+        exact rather than approximate. Sampling, the accept logic, and
+        per-slot state live on stage 0 (intake) except the speculative
+        accept computation, which needs the head's logits and therefore
+        runs on stage S-1 with its outputs transferred back.
+
+        `pp_waves = W > 1` splits the slot grid into W row-waves of
+        S_slots/W rows: each stage segment compiles ONCE at the wave
+        width (the wave's row origin `w0` is a traced operand of the
+        wave_view/wave_scatter bracket) and the wrapper dispatches the
+        W waves back-to-back — async dispatch plus the functional
+        per-stage arena carry gives the 1F1B overlap (wave 1 runs
+        stage 0 while wave 0 runs stage 1), shrinking the idle bubble
+        to (S-1)/(W+S-1) (`pp_stage_bubble`)."""
+        from megatron_tpu.serving import kv_pool as kvp
+        from megatron_tpu.serving import pp as pps
+        topo, cfg, pool = self.topo, self.cfg, self.pool
+        S_pp, W = self._pp, self._pp_waves
+        S, Vp = self.num_slots, cfg.padded_vocab_size
+        Sw = S // W
+        Ls = cfg.num_layers // S_pp
+        max_len = self.max_len
+        adapters_on = self._adapters_on
+        rope = self.gen.rope
+
+        def _stage_jit(i, fn, n_array_args, donate_argnums=()):
+            return topo._jit(topo.stage_meshes[i], self._psh_dec[i],
+                             fn, n_array_args, donate_argnums)
+
+        # trace counters: the mono counters live on the stage-0
+        # segments (so the steady-state `decode_traces == 1` pin reads
+        # identically), and the per-stage lists pin ONE compile per
+        # stage per program
+        self._decode_traces = 0
+        self._verify_traces = 0
+        self._chunk_traces = 0
+        self._pp_decode_traces = [0] * S_pp
+        self._pp_verify_traces = [0] * S_pp
+        self._pp_lora_src = None
+        self._pp_lora = None
+        if self._spec_k:
+            self._d_free_dmask = jnp.ones((S, self._spec_k, Vp),
+                                          jnp.bool_)
+            self._d_no_guess = jnp.full((S,), -1, jnp.int32)
+
+        # ---- decode chain (one wave-width compile per stage) ---------
+        def _dec0(params0, bkv0, last_w, rngs_w, lengths_w, temps_w,
+                  top_ks_w, top_ps_w, rejects_w, masks_w, lora0,
+                  aidx_w, w0):
+            # stage 0 = the mono _decode_fn's sample + embed + first
+            # layer slice (same ops, same order — see _decode_fn for
+            # the semantics of every piece)
+            self._decode_traces += 1
+            self._pp_decode_traces[0] += 1
+            adapters = (lora0, aidx_w) if adapters_on else None
+            view = pps.wave_view(bkv0, w0, Sw, lengths=lengths_w)
+            split = jax.vmap(jax.random.split)(rngs_w)
+            new_rngs, step_keys = split[:, 0], split[:, 1]
+            toks = sample_batched(step_keys, last_w,
+                                  temperature=temps_w, top_k=top_ks_w,
+                                  top_p=top_ps_w,
+                                  vocab_size=cfg.vocab_size,
+                                  banned=rejects_w, mask=masks_w)
+            lp = jax.nn.log_softmax(last_w, axis=-1)
+            tok_lp = jnp.take_along_axis(lp, toks[:, None],
+                                         axis=-1)[:, 0]
+            x = pps.embed_tokens(params0, toks[:, None], cfg,
+                                 position_ids=lengths_w[:, None])
+            x, view = pps.stage_forward(params0, x, cfg, rope=rope,
+                                        kv_caches=view, layer_offset=0,
+                                        position_ids=lengths_w[:, None],
+                                        adapters=adapters)
+            bkv0 = pps.wave_scatter(bkv0, w0, view)
+            new_lengths = jnp.minimum(lengths_w + 1,
+                                      jnp.int32(max_len - 1))
+            return (bkv0, x, new_rngs, toks, tok_lp, new_lengths,
+                    jnp.full_like(rejects_w, -1))
+
+        def _make_dec_tail(si):
+            lo = si * Ls
+            is_last = si == S_pp - 1
+
+            def _dec_i(params_i, bkv_i, x, lengths_w, lora_i, aidx_w,
+                       w0):
+                self._pp_decode_traces[si] += 1
+                adapters = (lora_i, aidx_w) if adapters_on else None
+                view = pps.wave_view(bkv_i, w0, Sw, lengths=lengths_w)
+                x, view = pps.stage_forward(
+                    params_i, x, cfg, rope=rope, kv_caches=view,
+                    layer_offset=lo,
+                    position_ids=lengths_w[:, None], adapters=adapters)
+                bkv_i = pps.wave_scatter(bkv_i, w0, view)
+                if is_last:
+                    logits = pps.stage_head(params_i, x, cfg,
+                                            logits_dtype=jnp.float32)
+                    return bkv_i, logits[:, 0]
+                return bkv_i, x
+            return _dec_i
+
+        # stage 0 donates its KV slice and the rng state (both have
+        # same-shaped outputs); last_logits is NOT donated here — the
+        # fresh logits come off the LAST stage's head, so stage 0 has
+        # no output to alias the old buffer onto
+        self._pp_dec = [_stage_jit(0, _dec0, 12, (1, 3))] + [
+            _stage_jit(i, _make_dec_tail(i), 6, (1,))
+            for i in range(1, S_pp)]
+
+        def _decode_pp(params_u, pools, last_logits, rngs, lengths,
+                       temps, top_ks, top_ps, rejects, masks, lora_u,
+                       aidx):
+            lora_st = self._pp_stage_lora()
+            new_pools = list(pools)
+            outs = []
+            for w in range(W):
+                sl = slice(w * Sw, (w + 1) * Sw)
+
+                def ws(a):
+                    return a if (W == 1 or a is None) else a[sl]
+
+                w0 = jnp.int32(w * Sw)
+                out0 = self._pp_dec[0](
+                    self._p_dec[0], new_pools[0], ws(last_logits),
+                    ws(rngs), ws(lengths), ws(temps), ws(top_ks),
+                    ws(top_ps), ws(rejects), ws(masks), lora_st[0],
+                    ws(aidx), w0)
+                new_pools[0] = out0[0]
+                x, lw, ai = out0[1], ws(lengths), ws(aidx)
+                for i in range(1, S_pp):
+                    new_pools[i], x = self._pp_dec[i](
+                        self._p_dec[i], new_pools[i],
+                        self._pp_put(x, i), self._pp_put(lw, i),
+                        lora_st[i], self._pp_put(ai, i), w0)
+                outs.append((self._pp_put(x, 0),) + tuple(out0[2:]))
+            if W == 1:
+                last, new_rngs, toks, tok_lp, new_len, new_rej = outs[0]
+            else:
+                last, new_rngs, toks, tok_lp, new_len, new_rej = [
+                    jnp.concatenate([o[j] for o in outs], axis=0)
+                    for j in range(6)]
+            return (new_pools, last, new_rngs, toks, tok_lp, new_len,
+                    new_rej)
+
+        self._decode = _decode_pp
+
+        # ---- speculative verify chain (whole-grid: pp_waves > 1 is
+        # rejected with speculative_k) ---------------------------------
+        def _ver0(params0, bkv0, last, rngs, lengths, temps, top_ks,
+                  top_ps, drafts, rejects, t0_masks, lora0, aidx):
+            self._verify_traces += 1
+            self._pp_verify_traces[0] += 1
+            adapters = (lora0, aidx) if adapters_on else None
+            view = pps.wave_view(bkv0, jnp.int32(0), S, lengths=lengths)
+            split = jax.vmap(jax.random.split)(rngs)
+            new_rngs, step_keys = split[:, 0], split[:, 1]
+            toks0 = sample_batched(step_keys, last, temperature=temps,
+                                   top_k=top_ks, top_p=top_ps,
+                                   vocab_size=cfg.vocab_size,
+                                   banned=rejects, mask=t0_masks)
+            lp0 = jax.nn.log_softmax(last, axis=-1)
+            lp0 = jnp.take_along_axis(lp0, toks0[:, None], -1)[:, 0]
+            window = jnp.concatenate([toks0[:, None], drafts], axis=1)
+            w = window.shape[1]
+            positions = jnp.minimum(lengths[:, None] + jnp.arange(w),
+                                    jnp.int32(max_len - 1))
+            x = pps.embed_tokens(params0, window, cfg,
+                                 position_ids=positions)
+            x, view = pps.stage_forward(params0, x, cfg, rope=rope,
+                                        kv_caches=view, layer_offset=0,
+                                        position_ids=positions,
+                                        adapters=adapters)
+            bkv0 = pps.wave_scatter(bkv0, jnp.int32(0), view)
+            return bkv0, x, new_rngs, window, toks0, lp0, step_keys
+
+        def _make_ver_mid(si):
+            lo = si * Ls
+
+            def _ver_i(params_i, bkv_i, x, lengths, lora_i, aidx):
+                self._pp_verify_traces[si] += 1
+                adapters = (lora_i, aidx) if adapters_on else None
+                w = x.shape[1]
+                positions = jnp.minimum(
+                    lengths[:, None] + jnp.arange(w),
+                    jnp.int32(max_len - 1))
+                view = pps.wave_view(bkv_i, jnp.int32(0), S,
+                                     lengths=lengths)
+                x, view = pps.stage_forward(
+                    params_i, x, cfg, rope=rope, kv_caches=view,
+                    layer_offset=lo, position_ids=positions,
+                    adapters=adapters)
+                bkv_i = pps.wave_scatter(bkv_i, jnp.int32(0), view)
+                return bkv_i, x
+            return _ver_i
+
+        def _make_ver_last(si):
+            lo = si * Ls
+
+            def _ver_last(params_i, bkv_i, x, lengths, temps, top_ks,
+                          top_ps, drafts, draft_masks, guess0, toks0,
+                          lp0, step_keys, lora_i, aidx):
+                # stage S-1 = the mono _verify_fn's tail: last layer
+                # slice, head, and the full accept computation verbatim
+                # (see _verify_fn for the semantics)
+                self._pp_verify_traces[si] += 1
+                adapters = (lora_i, aidx) if adapters_on else None
+                k = drafts.shape[1]
+                w = x.shape[1]
+                positions = jnp.minimum(
+                    lengths[:, None] + jnp.arange(w),
+                    jnp.int32(max_len - 1))
+                view = pps.wave_view(bkv_i, jnp.int32(0), S,
+                                     lengths=lengths)
+                x, view = pps.stage_forward(
+                    params_i, x, cfg, rope=rope, kv_caches=view,
+                    layer_offset=lo, position_ids=positions,
+                    adapters=adapters)
+                bkv_i = pps.wave_scatter(bkv_i, jnp.int32(0), view)
+                logits = pps.stage_head(params_i, x, cfg,
+                                        logits_dtype=jnp.float32)
+                ctx = logits[:, :k]
+                probs, targets = verify_draft_probs(
+                    ctx, drafts, temperature=temps, top_k=top_ks,
+                    top_p=top_ps, vocab_size=cfg.vocab_size,
+                    mask=draft_masks)
+
+                def row_unifs(rk):
+                    return jax.vmap(lambda i: jax.random.uniform(
+                        jax.random.fold_in(rk, i)))(
+                            jnp.arange(1, k + 1))
+
+                u = jax.vmap(row_unifs)(step_keys)
+                greedy_rows = (temps == 0.0) | (top_ks == 1)
+                accept = jnp.where(greedy_rows[:, None],
+                                   drafts == targets,
+                                   u < probs) & (drafts >= 0)
+                gate_ok = (guess0 < 0) | (toks0 == guess0)
+                accept &= gate_ok[:, None]
+                allow = (lengths[:, None] + 1 + jnp.arange(k)[None, :]
+                         <= jnp.int32(max_len - 1))
+                acc = (accept & allow).astype(jnp.int32)
+                a = jnp.sum(jnp.cumprod(acc, axis=1), axis=1)
+                lp = jax.nn.log_softmax(ctx, axis=-1)
+                draft_lp = jnp.take_along_axis(
+                    lp, drafts[..., None], -1)[..., 0]
+                tok_lp = jnp.concatenate([lp0[:, None], draft_lp], 1)
+                new_last = jnp.take_along_axis(
+                    logits, a[:, None, None], 1)[:, 0].astype(
+                        jnp.float32)
+                a_idx = jnp.clip(a, 0, k - 1)
+                d_stop = jnp.take_along_axis(drafts,
+                                             a_idx[:, None], 1)[:, 0]
+                allow_stop = jnp.take_along_axis(allow,
+                                                 a_idx[:, None], 1)[:, 0]
+                new_rejects = jnp.where(
+                    gate_ok & (a < k) & allow_stop & (d_stop >= 0),
+                    d_stop, jnp.int32(-1)).astype(jnp.int32)
+                new_lengths = jnp.minimum(lengths + 1 + a,
+                                          jnp.int32(max_len - 1))
+                return (bkv_i, new_last, tok_lp, a, new_lengths,
+                        new_rejects)
+            return _ver_last
+
+        self._pp_ver = ([_stage_jit(0, _ver0, 12, (1, 3))]
+                        + [_stage_jit(i, _make_ver_mid(i), 6, (1,))
+                           for i in range(1, S_pp - 1)]
+                        + [_stage_jit(S_pp - 1,
+                                      _make_ver_last(S_pp - 1), 14,
+                                      (1,))])
+
+        def _verify_pp(params_u, pools, last_logits, rngs, lengths,
+                       temps, top_ks, top_ps, drafts, rejects, masks,
+                       d_masks, guess0, lora_u, aidx):
+            lora_st = self._pp_stage_lora()
+            new_pools = list(pools)
+            out0 = self._pp_ver[0](
+                self._p_dec[0], new_pools[0], last_logits, rngs,
+                lengths, temps, top_ks, top_ps, drafts, rejects,
+                masks, lora_st[0], aidx)
+            new_pools[0] = out0[0]
+            x = out0[1]
+            new_rngs, window, toks0, lp0, step_keys = out0[2:]
+            for i in range(1, S_pp - 1):
+                new_pools[i], x = self._pp_ver[i](
+                    self._p_dec[i], new_pools[i], self._pp_put(x, i),
+                    self._pp_put(lengths, i), lora_st[i],
+                    self._pp_put(aidx, i))
+            li = S_pp - 1
+            lout = self._pp_ver[li](
+                self._p_dec[li], new_pools[li], self._pp_put(x, li),
+                self._pp_put(lengths, li), self._pp_put(temps, li),
+                self._pp_put(top_ks, li), self._pp_put(top_ps, li),
+                self._pp_put(drafts, li), self._pp_put(d_masks, li),
+                self._pp_put(guess0, li), self._pp_put(toks0, li),
+                self._pp_put(lp0, li), self._pp_put(step_keys, li),
+                lora_st[li], self._pp_put(aidx, li))
+            new_pools[li] = lout[0]
+            return (new_pools, self._pp_put(lout[1], 0), new_rngs,
+                    window, self._pp_put(lout[2], 0),
+                    self._pp_put(lout[3], 0), self._pp_put(lout[4], 0),
+                    self._pp_put(lout[5], 0))
+
+        self._verify = _verify_pp
+
+        # ---- batched prefill chain -----------------------------------
+        def _pre0(params0, bkv0, tokens, plens, slots, lora0, aidxs):
+            adapters = (lora0, aidxs) if adapters_on else None
+            B = tokens.shape[0]
+            caches = pps.stage_kv(pool.make_prefill_caches(B), S_pp, 0)
+            x = pps.embed_tokens(params0, tokens, cfg,
+                                 offset=caches.offset[0])
+            x, caches = pps.stage_forward(params0, x, cfg, rope=rope,
+                                          kv_caches=caches,
+                                          layer_offset=0,
+                                          adapters=adapters)
+            view = pps.wave_view(bkv0, jnp.int32(0), S)
+            for i in range(B):
+                def row(t):
+                    return jax.lax.dynamic_slice_in_dim(t, i, 1, axis=1)
+                sub = caches._replace(
+                    k=row(caches.k), v=row(caches.v),
+                    k_scale=(None if caches.k_scale is None
+                             else row(caches.k_scale)),
+                    v_scale=(None if caches.v_scale is None
+                             else row(caches.v_scale)))
+                view = kvp.insert_prefill(view, sub, slots[i], plens[i])
+            bkv0 = pps.wave_scatter(bkv0, jnp.int32(0), view)
+            return bkv0, x
+
+        def _make_pre_tail(si):
+            lo = si * Ls
+            is_last = si == S_pp - 1
+
+            def _pre_i(params_i, bkv_i, x, plens, slots, lora_i, aidxs):
+                adapters = (lora_i, aidxs) if adapters_on else None
+                B = x.shape[0]
+                caches = pps.stage_kv(pool.make_prefill_caches(B),
+                                      S_pp, si)
+                x2, caches = pps.stage_forward(params_i, x, cfg,
+                                               rope=rope,
+                                               kv_caches=caches,
+                                               layer_offset=lo,
+                                               adapters=adapters)
+                view = pps.wave_view(bkv_i, jnp.int32(0), S)
+                for i in range(B):
+                    def row(t):
+                        return jax.lax.dynamic_slice_in_dim(t, i, 1,
+                                                            axis=1)
+                    sub = caches._replace(
+                        k=row(caches.k), v=row(caches.v),
+                        k_scale=(None if caches.k_scale is None
+                                 else row(caches.k_scale)),
+                        v_scale=(None if caches.v_scale is None
+                                 else row(caches.v_scale)))
+                    view = kvp.insert_prefill(view, sub, slots[i],
+                                              plens[i])
+                bkv_i = pps.wave_scatter(bkv_i, jnp.int32(0), view)
+                if is_last:
+                    logits = pps.stage_head(params_i, x2, cfg,
+                                            logits_dtype=jnp.float32)
+                    lasts = jnp.stack([
+                        jax.lax.dynamic_slice_in_dim(
+                            logits[i], plens[i] - 1, 1, 0)[0]
+                        for i in range(B)])
+                    return bkv_i, lasts
+                return bkv_i, x2
+            return _pre_i
+
+        def _pre_act0(params0, last_logits, rngs, lasts, slots, rng0s):
+            B = lasts.shape[0]
+            for i in range(B):
+                last_logits = last_logits.at[slots[i]].set(lasts[i])
+                rngs = rngs.at[slots[i]].set(rng0s[i])
+            return last_logits, rngs
+
+        self._pp_pre = [_stage_jit(0, _pre0, 6, (1,))] + [
+            _stage_jit(i, _make_pre_tail(i), 6, (1,))
+            for i in range(1, S_pp)]
+        self._pp_pre_act = _stage_jit(0, _pre_act0, 5, (1, 2))
+
+        def _prefill_pp(params_u, pools, last_logits, rngs, tokens,
+                        plens, slots, rng0s, lora_u, aidxs):
+            lora_st = self._pp_stage_lora()
+            new_pools = list(pools)
+            new_pools[0], x = self._pp_pre[0](
+                self._p_dec[0], new_pools[0], tokens, plens, slots,
+                lora_st[0], aidxs)
+            for i in range(1, S_pp):
+                new_pools[i], x = self._pp_pre[i](
+                    self._p_dec[i], new_pools[i], self._pp_put(x, i),
+                    self._pp_put(plens, i), self._pp_put(slots, i),
+                    lora_st[i], self._pp_put(aidxs, i))
+            last_logits, rngs = self._pp_pre_act(
+                self._p_dec[0], last_logits, rngs, self._pp_put(x, 0),
+                slots, rng0s)
+            return new_pools, last_logits, rngs
+
+        self._prefill = _prefill_pp
+
+        # ---- chunked-prefill chain (st.sub is a stage-indexed list) --
+        def _chunk0(params0, sub0, tokens, next_offset, lora0, aidx1):
+            self._chunk_traces += 1
+            adapters = (lora0, aidx1) if adapters_on else None
+            x = pps.embed_tokens(params0, tokens, cfg,
+                                 offset=sub0.offset[0])
+            x, sub0 = pps.stage_forward(params0, x, cfg, rope=rope,
+                                        kv_caches=sub0, layer_offset=0,
+                                        adapters=adapters)
+            sub0 = sub0._replace(
+                offset=jnp.full_like(sub0.offset, next_offset))
+            return sub0, x
+
+        def _make_chunk_tail(si):
+            lo = si * Ls
+            is_last = si == S_pp - 1
+
+            def _chunk_mid(params_i, sub_i, x, next_offset, lora_i,
+                           aidx1):
+                adapters = (lora_i, aidx1) if adapters_on else None
+                x, sub_i = pps.stage_forward(params_i, x, cfg,
+                                             rope=rope, kv_caches=sub_i,
+                                             layer_offset=lo,
+                                             adapters=adapters)
+                sub_i = sub_i._replace(
+                    offset=jnp.full_like(sub_i.offset, next_offset))
+                return sub_i, x
+
+            def _chunk_last(params_i, sub_i, x, next_offset, last_idx,
+                            lora_i, aidx1):
+                adapters = (lora_i, aidx1) if adapters_on else None
+                x, sub_i = pps.stage_forward(params_i, x, cfg,
+                                             rope=rope, kv_caches=sub_i,
+                                             layer_offset=lo,
+                                             adapters=adapters)
+                sub_i = sub_i._replace(
+                    offset=jnp.full_like(sub_i.offset, next_offset))
+                logits = pps.stage_head(params_i, x, cfg,
+                                        logits_dtype=jnp.float32)
+                last = jax.lax.dynamic_slice_in_dim(
+                    logits[0], last_idx, 1, 0)[0]
+                return sub_i, last
+            return _chunk_last if is_last else _chunk_mid
+
+        # `sub` is deliberately NOT donated across the chunk chain —
+        # the same CPU jax 0.4.x aliasing rule as the mono _chunk_fwd
+        self._pp_chunk = [_stage_jit(0, _chunk0, 5)] + [
+            _stage_jit(i, _make_chunk_tail(i),
+                       6 if i == S_pp - 1 else 5)
+            for i in range(1, S_pp)]
+
+        def _chunk_pp(params_u, subs, tokens, last_idx, next_offset,
+                      lora_u, aidx1):
+            lora_st = self._pp_stage_lora()
+            new_subs = list(subs)
+            new_subs[0], x = self._pp_chunk[0](
+                self._p_dec[0], new_subs[0], tokens, next_offset,
+                lora_st[0], aidx1)
+            for i in range(1, S_pp - 1):
+                new_subs[i], x = self._pp_chunk[i](
+                    self._p_dec[i], new_subs[i], self._pp_put(x, i),
+                    self._pp_put(next_offset, i), lora_st[i],
+                    self._pp_put(aidx1, i))
+            li = S_pp - 1
+            new_subs[li], last = self._pp_chunk[li](
+                self._p_dec[li], new_subs[li], self._pp_put(x, li),
+                self._pp_put(next_offset, li),
+                self._pp_put(last_idx, li), lora_st[li],
+                self._pp_put(aidx1, li))
+            return new_subs, self._pp_put(last, 0)
+
+        self._chunk_fwd = _chunk_pp
+
+        # ---- block slice / insert chains -----------------------------
+        def _slice_i(params_i, bkv_i, blocks, start):
+            return kvp.slice_blocks(bkv_i, blocks, start)
+
+        def _ins0(params0, bkv0, last_logits, rngs, sub0, slot, plen,
+                  pfx_blocks, last, rng0):
+            bkv0 = kvp.insert_blocks(bkv0, sub0, slot, plen, pfx_blocks)
+            last_logits = last_logits.at[slot].set(last)
+            rngs = rngs.at[slot].set(rng0)
+            return bkv0, last_logits, rngs
+
+        def _ins_i(params_i, bkv_i, sub_i, slot, plen, pfx_blocks):
+            return kvp.insert_blocks(bkv_i, sub_i, slot, plen,
+                                     pfx_blocks)
+
+        self._pp_slice = [_stage_jit(i, _slice_i, 3)
+                          for i in range(S_pp)]
+        self._pp_ins = [_stage_jit(0, _ins0, 9, (1, 2, 3))] + [
+            _stage_jit(i, _ins_i, 5, (1,)) for i in range(1, S_pp)]
+
+        def _slice_blk_pp(params_u, pools, blocks, start):
+            return [self._pp_slice[i](self._p_dec[i], pools[i],
+                                      self._pp_put(blocks, i),
+                                      self._pp_put(start, i))
+                    for i in range(S_pp)]
+
+        def _insert_blk_pp(params_u, pools, last_logits, rngs, subs,
+                           slot, plen, pfx_blocks, last, rng0):
+            new_pools = list(pools)
+            new_pools[0], last_logits, rngs = self._pp_ins[0](
+                self._p_dec[0], new_pools[0], last_logits, rngs,
+                subs[0], slot, plen, pfx_blocks, last, rng0)
+            for i in range(1, S_pp):
+                new_pools[i] = self._pp_ins[i](
+                    self._p_dec[i], new_pools[i], subs[i],
+                    self._pp_put(slot, i), self._pp_put(plen, i),
+                    self._pp_put(pfx_blocks, i))
+            return new_pools, last_logits, rngs
+
+        self._slice_blk = _slice_blk_pp
+        self._insert_blk = _insert_blk_pp
+
+        # unreachable under serving_pp (blocks are REQUIRED, so the
+        # whole-region slice/insert never dispatch; disaggregation and
+        # the host tier are rejected by validate + the constructor
+        # re-asserts) — None so an accidental dispatch fails loudly
+        self._slice = None
+        self._insert = None
+        self._handoff_insert = None
+        self._pad_sub_pre = None
 
     def _apply_placement(self, plan, params):
         """Re-mesh the engine under `plan` and place `params` (the
@@ -2865,8 +3521,18 @@ class ServingEngine:
                 # across admissions is safe because _chunk_fwd never
                 # donates its input — every chunk returns fresh buffers
                 if self._sub0 is None:
-                    self._sub0 = self.pool.make_prefill_caches(1)
-                    if self.topo is not None:
+                    full0 = self.pool.make_prefill_caches(1)
+                    if self._pp > 1:
+                        # staged template: stage i's [L/S]-layer zero
+                        # slice committed to stage i's sub-mesh — the
+                        # chunk chain consumes the list stage-for-stage
+                        from megatron_tpu.serving import pp as pps
+                        self._sub0 = [
+                            self.topo.place_kv_tree(
+                                pps.stage_kv(full0, self._pp, i), mesh)
+                            for i, mesh in enumerate(
+                                self.topo.stage_meshes)]
+                    elif self.topo is not None:
                         # commit the template to the PREFILL mesh once:
                         # left uncommitted, every miss admission's
                         # first chunk would re-transfer a full
@@ -2874,7 +3540,9 @@ class ServingEngine:
                         # the exact cross-group cap-region copy the
                         # disaggregation design exists to avoid
                         self._sub0 = self.topo.place_kv_tree(
-                            self._sub0, self.topo.prefill_mesh)
+                            full0, self.topo.prefill_mesh)
+                    else:
+                        self._sub0 = full0
                 sub = self._sub0
             rng0 = (jnp.asarray(req.resume_rng)
                     if req.resume_rng is not None

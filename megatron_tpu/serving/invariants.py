@@ -372,6 +372,45 @@ def check_kv_accounting(engine, sweep: Optional[_Sweep] = None) -> dict:
     import numpy as np
     rc, bmap, trash = acct["rc"], acct["map"], acct["trash"]
     total = acct["total_blocks"]
+    # staged arena (serving_pp > 1, serving/pp.py): the host books
+    # above govern ONE logical arena regardless of depth — the stages
+    # merely partition it on the layer axis. Three structural laws on
+    # top: the pool holds exactly S per-stage arenas, each stage's
+    # arena carries exactly num_layers/S layers (no layer lost or
+    # doubled across the partition), and every stage's DEVICE block map
+    # equals the host map (stages address the same logical blocks; a
+    # drifted stage map would read one slot's KV as another's).
+    caches = getattr(engine.pool, "caches", None)
+    if isinstance(caches, list):
+        import jax as _jax
+        pp = int(getattr(engine, "_pp", len(caches)) or len(caches))
+        sw.note("kv_accounting", len(caches) == pp,
+                f"staged pool holds {len(caches)} stage arenas but "
+                f"serving_pp={pp}")
+        num_layers = int(engine.cfg.num_layers)
+        per = num_layers // max(1, len(caches))
+        host_map = np.asarray(bmap)
+        # the device-map law only binds a LIVE engine: a dead-loop or
+        # breaker-tripped replica's pool buffers were donated into the
+        # crashed stage call and are gone by design (the chaos drills
+        # sweep ejected replicas too)
+        h = engine.health()
+        live = bool(h.get("loop_alive")
+                    and not h.get("circuit_breaker_open"))
+        for i, bkv in enumerate(caches):
+            ls = int(bkv.arena.k.shape[0])
+            sw.note("kv_accounting", ls == per,
+                    f"stage {i} arena holds {ls} layers, want "
+                    f"{per} (= num_layers {num_layers} / "
+                    f"{len(caches)} stages)")
+            if not live:
+                continue
+            stage_map = np.asarray(_jax.device_get(bkv.map))
+            sw.note("kv_accounting",
+                    np.array_equal(stage_map, host_map),
+                    f"stage {i} device block map drifted from the "
+                    "host map — stages must address identical "
+                    "logical blocks")
     expected = np.zeros(total, np.int64)
     ns_holders: Dict[int, set] = {}
 

@@ -496,6 +496,24 @@ class SlotKVPool:
         # as scratch and corrupt the host-side map mid-flight; and
         # host-side map surgery must never mutate the map an already
         # dispatched program is still consuming.
+        if isinstance(self.caches, list):
+            # pipeline-sharded serving (serving/topology.py place_pool
+            # under serving_pp>1): one BlockKV per layer stage, each
+            # carrying its OWN replicated copy of the map on its stage
+            # sub-mesh — block indices are dispatch data identical
+            # across stages, so every stage re-uploads the same host
+            # map (the per-stage invariant serving/invariants.py pins)
+            sh = (self._map_sharding
+                  if isinstance(self._map_sharding, list)
+                  else [self._map_sharding] * len(self.caches))
+            staged = []
+            for bkv, s in zip(self.caches, sh):
+                m = jnp.array(self._map)
+                if s is not None:
+                    m = jax.device_put(m, s)
+                staged.append(bkv._replace(map=m))
+            self.caches = staged
+            return
         m = jnp.array(self._map)
         if self._map_sharding is not None:
             m = jax.device_put(m, self._map_sharding)
@@ -833,6 +851,16 @@ class SlotKVPool:
         return self.num_slots - self.free_count()
 
     def nbytes(self) -> int:
+        # pipeline-sharded pools hold a per-stage list of layer-sliced
+        # arenas — the stages partition the layer axis, so their sum is
+        # the same total the single arena would report
+        if isinstance(self.caches, list):
+            def _one(c):
+                n = c.k.nbytes + c.v.nbytes
+                if c.k_scale is not None:
+                    n += c.k_scale.nbytes + c.v_scale.nbytes
+                return n
+            return sum(_one(b.arena) for b in self.caches)
         c = self.caches.arena if self.blocks_enabled else self.caches
         n = c.k.nbytes + c.v.nbytes
         if c.k_scale is not None:

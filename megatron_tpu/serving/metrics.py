@@ -169,6 +169,8 @@ _BASE_GAUGES = (
     "active_adapters", "handoff_bytes_per_req",
     "prefill_group_busy", "decode_group_busy",
     "prefill_tp", "decode_tp", "prefill_devices", "decode_devices",
+    "serving_pp", "pp_waves", "pp_stage_bubble",
+    "pp_activation_bytes_per_step",
     "weight_version", "fleet_replicas_up", "degrade_level",
 )
 
@@ -242,6 +244,17 @@ class ServingMetrics:
         self.decode_tp = 0.0
         self.prefill_devices = 0.0
         self.decode_devices = 0.0
+        # pipeline-sharded decode (serving/pp.py, docs/serving.md
+        # "Pipeline-sharded serving"): layer-stage count and wave
+        # count the staged programs run under (0s on topology-free
+        # engines, serving_pp=1 pp_waves=1 on a pure-tp topology),
+        # the 1F1B idle fraction (S-1)/(W+S-1), and the bytes the
+        # [rows, hidden] residual crosses stage seams per full decode
+        # step. Pushed once at build — static facts of the topology.
+        self.serving_pp = 0.0
+        self.pp_waves = 0.0
+        self.pp_stage_bubble = 0.0
+        self.pp_activation_bytes_per_step = 0.0
         # live-weight serving: the checkpoint ITERATION currently on
         # the serving mesh (0 = unversioned startup weights). Always
         # present; the router's aggregate carries it as per-replica
@@ -326,6 +339,19 @@ class ServingMetrics:
             self.decode_tp = float(decode_tp)
             self.prefill_devices = float(prefill_devices)
             self.decode_devices = float(decode_devices)
+
+    def set_pp_gauges(self, serving_pp: int, pp_waves: int,
+                      stage_bubble: float,
+                      activation_bytes: int) -> None:
+        """Engine-pushed at build: the pipeline-sharded decode layout
+        (stage count / wave count), its analytic 1F1B bubble fraction,
+        and the per-step residual-crossing traffic (0s at
+        serving_pp=1 — no seams, no bubble)."""
+        with self._lock:
+            self.serving_pp = float(serving_pp)
+            self.pp_waves = float(pp_waves)
+            self.pp_stage_bubble = float(stage_bubble)
+            self.pp_activation_bytes_per_step = float(activation_bytes)
 
     def set_weight_version(self, iteration) -> None:
         """Engine-pushed at startup staging and every applied hot swap:

@@ -58,15 +58,21 @@ class PlacementError(ValueError):
 @dataclass(frozen=True)
 class PlacementPlan:
     """One chosen layout: per-phase widths (== per-group device counts
-    for pure-tp groups), the budget they were chosen from, and why."""
+    for pure-tp groups), the pipeline depth the decode group is staged
+    at, the budget they were chosen from, and why. `serving_pp` is
+    pinned from config — it is a MODEL-SIZE constraint (does the stack
+    fit one chip group's HBM), not a load signal, so the optimizer
+    resolves (prefill_tp, decode_tp) UNDER a fixed depth and never
+    trades depth for width."""
     prefill_tp: int
     decode_tp: int
     budget: int
     reason: str = "static"
+    serving_pp: int = 1
 
     @property
     def devices(self) -> int:
-        return self.prefill_tp + self.decode_tp
+        return self.prefill_tp + self.decode_tp * self.serving_pp
 
     def split(self) -> tuple:
         return (self.prefill_tp, self.decode_tp)
@@ -77,7 +83,8 @@ class PlacementPlan:
             "prefill_tp": self.prefill_tp,
             "decode_tp": self.decode_tp,
             "prefill_devices": self.prefill_tp,
-            "decode_devices": self.decode_tp,
+            "decode_devices": self.decode_tp * self.serving_pp,
+            "serving_pp": self.serving_pp,
             "budget": self.budget,
             "reason": self.reason,
         }
@@ -91,16 +98,19 @@ def _width_ok(width: int, model) -> bool:
             and model.padded_vocab_size % width == 0)
 
 
-def feasible_splits(budget: int, model=None) -> list:
+def feasible_splits(budget: int, model=None, serving_pp: int = 1) -> list:
     """Every (prefill_tp, decode_tp) the budget and the model's
     divisibility rules admit — each width must divide the query/kv
     head counts and the padded vocab (the same rules
-    `ServingConfig.validate` enforces for explicit widths)."""
+    `ServingConfig.validate` enforces for explicit widths). Under
+    `serving_pp` > 1 the decode group spends `decode_tp * serving_pp`
+    devices (one tp-wide sub-mesh per layer stage), so the budget
+    feasibility is evaluated on the staged footprint."""
     out = []
     for p in range(1, budget):
         if not _width_ok(p, model):
             continue
-        for d in range(1, budget - p + 1):
+        for d in range(1, (budget - p) // serving_pp + 1):
             if _width_ok(d, model):
                 out.append((p, d))
     return out
@@ -138,20 +148,25 @@ def _prefill_share(signals: Optional[dict]) -> float:
     return min(0.95, max(0.05, pre / (pre + dec)))
 
 
-def _score(split: tuple, budget: int, share: float) -> float:
+def _score(split: tuple, budget: int, share: float,
+           serving_pp: int = 1) -> float:
     """Higher is better: match the pressure share, then use the
-    budget, then give decode (the grid-holding phase) the tie."""
+    budget, then give decode (the grid-holding phase) the tie. Under
+    pp the decode phase's device share is its STAGED footprint
+    (decode_tp * serving_pp) — depth is real silicon."""
     p, d = split
-    used = p + d
+    used = p + d * serving_pp
     return (-abs(p / used - share)
             + 0.02 * (used / budget)
-            + 0.001 * (d - p) / budget)
+            + 0.001 * (d * serving_pp - p) / budget)
 
 
 def plan_placement(budget: int, model=None,
                    signals: Optional[dict] = None,
-                   current: Optional[Sequence] = None) -> PlacementPlan:
-    """Choose (prefill_tp, decode_tp) under `budget` devices.
+                   current: Optional[Sequence] = None,
+                   serving_pp: int = 1) -> PlacementPlan:
+    """Choose (prefill_tp, decode_tp) under `budget` devices at the
+    pinned pipeline depth `serving_pp`.
 
     - `signals=None` (engine build): `current` — the explicit or
       serving_tp-defaulted widths — wins whenever it is feasible; the
@@ -161,31 +176,42 @@ def plan_placement(budget: int, model=None,
       REPLAN_MARGIN hysteresis toward `current` so one noisy window
       does not trigger a recompile-everything re-mesh.
 
+    `serving_pp` comes from config, never from the optimizer: whether
+    the layer stack needs staging is decided by HBM capacity, not by
+    duty cycles, so the plan resolves widths under the given depth and
+    carries it through `describe()` unchanged.
+
     Raises PlacementError when NOTHING fits — the loud refusal."""
     assert budget >= 2, f"placement budget {budget} cannot be split"
-    splits = feasible_splits(budget, model)
+    assert serving_pp >= 1, f"serving_pp={serving_pp} must be >= 1"
+    splits = feasible_splits(budget, model, serving_pp)
     if not splits:
         raise PlacementError(
-            f"no feasible prefill:decode split under budget={budget}: "
-            "no width in range divides the model's head counts / "
-            "padded vocab — raise the budget or adjust "
-            "make_vocab_size_divisible_by")
+            f"no feasible prefill:decode split under budget={budget} "
+            f"at serving_pp={serving_pp}: no width in range divides "
+            "the model's head counts / padded vocab (or the staged "
+            "decode footprint exceeds the budget) — raise the budget "
+            "or adjust make_vocab_size_divisible_by")
     cur = tuple(current) if current is not None else None
     if cur is not None and cur not in splits:
         cur = None
     if signals is None:
         if cur is not None:
-            return PlacementPlan(cur[0], cur[1], budget, reason="static")
+            return PlacementPlan(cur[0], cur[1], budget, reason="static",
+                                 serving_pp=serving_pp)
         share = 0.5
-        best = max(splits, key=lambda s: _score(s, budget, share))
+        best = max(splits,
+                   key=lambda s: _score(s, budget, share, serving_pp))
         return PlacementPlan(best[0], best[1], budget,
-                             reason="static:auto")
+                             reason="static:auto", serving_pp=serving_pp)
     share = _prefill_share(signals)
-    best = max(splits, key=lambda s: _score(s, budget, share))
+    best = max(splits, key=lambda s: _score(s, budget, share, serving_pp))
     if cur is not None and cur != best:
-        if _score(best, budget, share) - _score(cur, budget, share) \
-                < REPLAN_MARGIN:
+        if _score(best, budget, share, serving_pp) \
+                - _score(cur, budget, share, serving_pp) < REPLAN_MARGIN:
             return PlacementPlan(cur[0], cur[1], budget,
-                                 reason=f"hold:share={share:.2f}")
+                                 reason=f"hold:share={share:.2f}",
+                                 serving_pp=serving_pp)
     return PlacementPlan(best[0], best[1], budget,
-                         reason=f"signals:share={share:.2f}")
+                         reason=f"signals:share={share:.2f}",
+                         serving_pp=serving_pp)

@@ -1,0 +1,268 @@
+"""Pipeline-sharded serving: layer-stage slicing for the decode group.
+
+`--serving_pp S` splits a replica's decode devices into S layer-stage
+sub-meshes (serving/topology.py) and turns each compiled serving
+program into a chain of per-stage segments (serving/engine.py
+`_compile_pp_programs`). This module owns the PURE pieces of that
+split, so topology/engine/invariants all slice the same way:
+
+- `stage_params` / `stage_axes`: per-stage parameter trees built from
+  the stacked layer pytree via `parallel/pipeline.stage_params_reshape`
+  (contiguous [L/S]-layer slices), with the embedding on stage 0 and
+  the head + final norm on stage S-1 — the same layer->stage
+  assignment the training pipeline uses, so a trained pp checkpoint
+  maps 1:1 onto the serving stages.
+- `embed_tokens` / `stage_forward` / `stage_head`: the three phases of
+  `lm.model_forward` factored at the residual-stream seam. Chaining
+  them over contiguous layer slices is bit-identical math to the
+  single full-depth scan (lax.scan over [L] == two scans over [L/2]
+  chained), which is what makes the serving_pp=2-vs-1 token-exactness
+  gate achievable rather than merely approximate.
+- `stage_kv` / `stage_lora`: layer-axis slices of the per-layer KV
+  arena (each stage holds ONLY its own layers' blocks — that is the
+  HBM win) and of the stacked LoRA factor bank.
+
+Block map, per-slot lengths, and sampling state are NOT sliced: they
+stay replicated dispatch DATA on every stage, so each stage keeps one
+compile per program and `serving_pp=1` builds none of this.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import ModelConfig, as_dtype
+from megatron_tpu.models import language_model as lm
+from megatron_tpu.models import transformer as tfm
+from megatron_tpu.models.attention import KVCache
+from megatron_tpu.models.norms import norm_axes
+from megatron_tpu.parallel.pipeline import stage_params_reshape
+from megatron_tpu.parallel.sharding import constrain
+
+
+def stage_layers(cfg: ModelConfig, pp: int) -> int:
+    """Layers per stage — validate() pins divisibility, this re-derives."""
+    assert cfg.num_layers % pp == 0, (
+        f"serving_pp={pp} must divide num_layers={cfg.num_layers}")
+    return cfg.num_layers // pp
+
+
+def stage_params(params, cfg: ModelConfig, pp: int) -> List[dict]:
+    """Split a full model tree into `pp` per-stage trees.
+
+    Stage i carries transformer layers [i*L/S, (i+1)*L/S) (contiguous —
+    `stage_params_reshape`'s vpp=1 assignment). Stage 0 additionally
+    carries the embedding (word + optional position tables); stage S-1
+    carries the final norm and the LM head — for a TIED head that means
+    the word-embedding table lives on BOTH edge stages (the same
+    duplication the training pipeline's shard_map edge stages accept;
+    parallel/pipeline.py docstring), which `stage_head` consumes via
+    the unmodified `lm.head_logits` tied branch."""
+    staged = stage_params_reshape(params["transformer"], pp)
+    out = []
+    for i in range(pp):
+        tree = {"transformer": jax.tree.map(lambda x, i=i: x[i], staged)}
+        if i == 0:
+            tree["embedding"] = params["embedding"]
+        if i == pp - 1:
+            tree["final_norm"] = params["final_norm"]
+            if cfg.tie_embed_logits:
+                tree.setdefault("embedding", {})
+                tree["embedding"]["word_embeddings"] = (
+                    params["embedding"]["word_embeddings"])
+            else:
+                tree["lm_head"] = params["lm_head"]
+        out.append(tree)
+    return out
+
+
+def stage_axes(cfg: ModelConfig, pp: int) -> List[dict]:
+    """Logical-axis trees matching `stage_params` stage-for-stage.
+
+    The transformer sub-tree keeps `tfm.stack_axes`' leading 'layers'
+    axis — a [L/S, ...] slice shards exactly like the full [L, ...]
+    stack (layers is a replicated/None axis under the serving rules)."""
+    out = []
+    for i in range(pp):
+        axes = {"transformer": tfm.stack_axes(cfg)}
+        if i == 0:
+            axes["embedding"] = {"word_embeddings": ("vocab", "embed")}
+            if cfg.use_position_embedding:
+                axes["embedding"]["position_embeddings"] = (None, "embed")
+        if i == pp - 1:
+            axes["final_norm"] = norm_axes(cfg.norm_type)
+            if cfg.tie_embed_logits:
+                axes.setdefault("embedding", {})
+                axes["embedding"]["word_embeddings"] = ("vocab", "embed")
+            else:
+                axes["lm_head"] = ("embed", "vocab")
+        out.append(axes)
+    return out
+
+
+def embed_tokens(stage0_params, tokens, cfg: ModelConfig, *,
+                 position_ids=None, offset=None):
+    """Stage-0 intake: the embedding piece of `lm.model_forward`
+    (models/language_model.py) verbatim — gather, optional position
+    add, residual constrain. Serving is always deterministic, so the
+    embedding-dropout branch is dead and omitted.
+
+    `offset` replicates the position_ids=None fallback: positions
+    continue from the cache offset ([S] per-slot vector or scalar),
+    exactly as model_forward derives them from `kv_caches.offset[0]`."""
+    compute_dtype = as_dtype(cfg.compute_dtype)
+    x = stage0_params["embedding"]["word_embeddings"][tokens].astype(
+        compute_dtype)
+    if cfg.use_position_embedding:
+        if position_ids is None:
+            pos = jnp.arange(tokens.shape[1])[None, :]
+            if offset is not None:
+                pos = pos + (offset[:, None] if jnp.ndim(offset) == 1
+                             else offset)
+        else:
+            pos = position_ids
+        x = x + stage0_params["embedding"]["position_embeddings"][pos].astype(
+            compute_dtype)
+    return constrain(x, tfm.RESIDUAL_AXES)
+
+
+def stage_forward(stage_params_i, x, cfg: ModelConfig, *, rope,
+                  kv_caches, layer_offset: int, position_ids=None,
+                  adapters=None):
+    """One stage's layer slice over the residual stream — the
+    `tfm.stack_apply` piece of model_forward with `layer_offset`
+    pinning layer-number-dependent behavior (LIMA/drop-path ramps,
+    layer ids) to the stage's GLOBAL layer positions. `kv_caches` is
+    the stage's OWN [L/S]-layer slice; `adapters` (if any) must carry
+    the stage-sliced factor bank (`stage_lora`). Returns
+    (x, new_caches)."""
+    x, kv_caches, _ = tfm.stack_apply(
+        stage_params_i["transformer"], x, cfg,
+        rope_cos=rope.cos if rope else None,
+        rope_sin=rope.sin if rope else None,
+        position_ids=position_ids, kv_caches=kv_caches,
+        rng=None, deterministic=True, layer_offset=layer_offset,
+        adapters=adapters)
+    return x, kv_caches
+
+
+def stage_head(stage_last_params, x, cfg: ModelConfig, *,
+               logits_dtype=jnp.float32):
+    """Stage S-1 tail: final norm + LM head via the unmodified
+    `lm.head_logits` — the last stage's tree carries final_norm and
+    lm_head (or the tied embedding table), so the one shared head
+    implementation serves sequential, training-pp, AND serving-pp."""
+    return lm.head_logits(stage_last_params, x, cfg,
+                          logits_dtype=logits_dtype)
+
+
+def stage_lora(stacked_lora, cfg: ModelConfig, pp: int, stage: int):
+    """Slice the stacked LoRA factor bank ([L, n_slots, ...] leaves,
+    serving/adapters.py) to one stage's layers. None passes through
+    (adapters off)."""
+    if stacked_lora is None:
+        return None
+    ls = stage_layers(cfg, pp)
+    return jax.tree.map(lambda a: a[stage * ls:(stage + 1) * ls],
+                        stacked_lora)
+
+
+def stage_kv(caches, pp: int, stage: int):
+    """Slice a stacked-over-layers cache pytree (KVCache arena leaves
+    [L, ...], per-slot offsets [L, S]) to one stage's layers. Works on
+    a bare KVCache or a BlockKV's arena — leaves with a leading layer
+    dim slice, anything else (the block map) passes through untouched
+    via the caller. The layer count must divide."""
+    L = caches.k.shape[0]
+    assert L % pp == 0, f"serving_pp={pp} must divide kv layers={L}"
+    ls = L // pp
+    sl = slice(stage * ls, (stage + 1) * ls)
+    return caches._replace(
+        k=caches.k[sl], v=caches.v[sl], offset=caches.offset[sl],
+        k_scale=None if caches.k_scale is None else caches.k_scale[sl],
+        v_scale=None if caches.v_scale is None else caches.v_scale[sl])
+
+
+def wave_view(bkv, w0, rows: int, lengths=None) -> KVCache:
+    """Gather slot rows [w0, w0+rows) of a stage's block arena into a
+    contiguous [L_s, rows, cap, ...] view — `kv_pool.resolve_view`
+    restricted to one WAVE of the slot grid. `rows` is static (the
+    wave width), `w0` is traced, so ONE compile serves all W waves.
+
+    `lengths` (decode/verify dispatch) overrides the view offsets with
+    the broadcast per-row lengths — the same offset stomp the mono
+    `_decode_fn` does on the full grid — while `lengths=None` (prefill
+    landing) passes the arena's own offset columns through."""
+    _, nb = bkv.map.shape
+    w0 = jnp.asarray(w0, jnp.int32)
+    map_w = jax.lax.dynamic_slice(bkv.map, (w0, jnp.int32(0)), (rows, nb))
+    flat = map_w.reshape(-1)
+    a = bkv.arena
+    L = a.k.shape[0]
+
+    def g(x):
+        y = jnp.take(x, flat, axis=1)  # [L_s, rows*nb, B, ...]
+        return y.reshape(x.shape[0], rows, nb * x.shape[2], *x.shape[3:])
+
+    if lengths is not None:
+        offset = jnp.broadcast_to(
+            lengths[None, :], (L, rows)).astype(jnp.int32)
+    else:
+        offset = jax.lax.dynamic_slice(
+            a.offset, (jnp.int32(0), w0), (L, rows))
+    return KVCache(
+        k=g(a.k), v=g(a.v), offset=offset,
+        k_scale=None if a.k_scale is None else g(a.k_scale),
+        v_scale=None if a.v_scale is None else g(a.v_scale))
+
+
+def wave_scatter(bkv, w0, view: KVCache):
+    """Write an updated wave view back through its map slice — the
+    inverse of `wave_view`. Unlike `kv_pool.scatter_view` (which
+    replaces the arena offset WHOLESALE with the full-grid view's),
+    the wave's [L_s, rows] offsets land in their own columns via
+    dynamic_update_slice; other waves' offset columns are untouched."""
+    _, nb = bkv.map.shape
+    rows = view.k.shape[1]
+    w0 = jnp.asarray(w0, jnp.int32)
+    map_w = jax.lax.dynamic_slice(bkv.map, (w0, jnp.int32(0)), (rows, nb))
+    flat = map_w.reshape(-1)
+    a = bkv.arena
+
+    def s(ax, vx):
+        B = ax.shape[2]
+        blocks = vx.reshape(vx.shape[0], rows * nb, B, *vx.shape[3:])
+        return ax.at[:, flat].set(blocks.astype(ax.dtype))
+
+    offset = jax.lax.dynamic_update_slice(
+        a.offset, view.offset.astype(jnp.int32), (jnp.int32(0), w0))
+    arena = a._replace(
+        k=s(a.k, view.k), v=s(a.v, view.v), offset=offset,
+        k_scale=None if a.k_scale is None else s(a.k_scale, view.k_scale),
+        v_scale=None if a.v_scale is None else s(a.v_scale, view.v_scale))
+    return bkv._replace(arena=arena)
+
+
+def pp_bubble(pp: int, waves: int) -> float:
+    """Idle fraction of the staged chain: (S-1)/(W+S-1) — the 1F1B
+    bubble with the slot grid's W waves as micro-batches. 0.0 at S=1
+    (no pipeline, no bubble) — exported as the `pp_stage_bubble`
+    gauge."""
+    if pp <= 1:
+        return 0.0
+    return float(pp - 1) / float(waves + pp - 1)
+
+
+def activation_bytes_per_step(num_slots: int, hidden_size: int,
+                              compute_dtype, pp: int) -> int:
+    """Bytes the [S_slots, hidden] residual activation moves across
+    stage seams in ONE full decode step: (S-1) forward crossings plus
+    the final-logits return is dominated by the residual hops; the
+    gauge tracks the residual traffic ((S-1) * S_slots * hidden *
+    itemsize), 0 at S=1."""
+    if pp <= 1:
+        return 0
+    itemsize = jnp.dtype(as_dtype(compute_dtype)).itemsize
+    return (pp - 1) * num_slots * hidden_size * itemsize

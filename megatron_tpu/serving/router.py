@@ -76,7 +76,14 @@ _SUM_GAUGES = ("queue_depth", "active_slots", "num_slots",
 _MAX_GAUGES = ("handoff_bytes_per_req", "prefill_group_busy",
                "decode_group_busy", "prefill_tp", "decode_tp",
                "kv_gather_bytes_per_step", "kv_attn_path",
-               "degrade_level")
+               "degrade_level",
+               # pipeline-sharded decode: stage depth / wave count are
+               # per-replica mesh shapes (summing would invent a
+               # pipeline no engine runs), the bubble is an idle
+               # FRACTION, and the residual-crossing bytes are a
+               # per-step per-replica reading like the gather gauge
+               "serving_pp", "pp_waves", "pp_stage_bubble",
+               "pp_activation_bytes_per_step")
 
 
 class NoReplicaAvailableError(ServiceUnavailableError):

@@ -86,6 +86,8 @@ def devices_per_engine(serving) -> int:
     more for the prefill group when disaggregated (a non-disaggregated
     engine shares one mesh, so the two widths must agree — validate()
     enforces it). 1 for the (default) no-topology engine. Under
+    `serving_pp=S` the decode group is S layer-stage sub-meshes of
+    decode_tp chips each, so the decode side costs decode_tp*S. Under
     `placement_auto` with an explicit `placement_budget`, the budget IS
     the per-replica window (the optimizer picks a split inside it)."""
     if getattr(serving, "placement_auto", False):
@@ -93,8 +95,9 @@ def devices_per_engine(serving) -> int:
         if budget:
             return int(budget)
     ptp, dtp = resolve_phase_tp(serving)
-    return dtp + (ptp if getattr(serving, "disaggregate_prefill", False)
-                  else 0)
+    spp = int(getattr(serving, "serving_pp", 1) or 1)
+    return dtp * spp + (ptp if getattr(serving, "disaggregate_prefill",
+                                       False) else 0)
 
 
 class ServingTopology:
@@ -112,6 +115,18 @@ class ServingTopology:
         self.tp = self.decode_tp
         self.disaggregated = bool(
             getattr(serving, "disaggregate_prefill", False))
+        # pipeline-sharded decode: S layer-stage sub-meshes, each
+        # decode_tp wide (serving/pp.py owns the layer/param slicing)
+        self.serving_pp = int(getattr(serving, "serving_pp", 1) or 1)
+        self.pp_waves = int(getattr(serving, "pp_waves", 1) or 1)
+        if self.serving_pp > 1:
+            # prefill runs through the SAME stage chain as decode —
+            # its effective width IS the per-stage width (validate()
+            # rejects an explicit prefill_tp under serving_pp)
+            self.prefill_tp = self.decode_tp
+        assert self.serving_pp == 1 or not self.disaggregated, (
+            "serving_pp does not compose with disaggregate_prefill "
+            "(validate() rejects it before topology construction)")
         assert self.disaggregated or self.prefill_tp == self.decode_tp, (
             f"prefill_tp={self.prefill_tp} != decode_tp={self.decode_tp} "
             "needs disaggregate_prefill — a shared mesh has one width")
@@ -122,11 +137,13 @@ class ServingTopology:
         assert len(devices) >= need, (
             f"serving topology needs {need} devices "
             f"(decode_tp={self.decode_tp}"
+            + (f" x serving_pp={self.serving_pp} layer stages"
+               if self.serving_pp > 1 else "")
             + (f" + prefill_tp={self.prefill_tp} for the disaggregated "
                "prefill group" if self.disaggregated else "")
             + f") but only {len(devices)} were provided — lower the "
             "per-phase tp widths (prefill_tp/decode_tp/serving_tp) / "
-            "num_replicas or disable disaggregate_prefill")
+            "serving_pp / num_replicas or disable disaggregate_prefill")
         self.devices = devices[:need]
 
         def _mesh(devs, width):
@@ -134,12 +151,20 @@ class ServingTopology:
                         MESH_AXES)
 
         # decode group first: a non-disaggregated topology IS its
-        # decode mesh (prefill shares it)
-        self.decode_mesh = _mesh(self.devices[:self.decode_tp],
-                                 self.decode_tp)
+        # decode mesh (prefill shares it). Under serving_pp the decode
+        # group is a LIST of stage sub-meshes — stage i owns devices
+        # [i*decode_tp, (i+1)*decode_tp); `decode_mesh` stays the
+        # stage-0 mesh (intake: embedding, sampling state, per-slot
+        # dispatch data), so every pre-pp surface keeps working.
+        self.stage_meshes = [
+            _mesh(self.devices[i * self.decode_tp:
+                               (i + 1) * self.decode_tp],
+                  self.decode_tp)
+            for i in range(self.serving_pp)]
+        self.decode_mesh = self.stage_meshes[0]
+        dec_devs = self.decode_tp * self.serving_pp
         self.prefill_mesh = (
-            _mesh(self.devices[self.decode_tp:
-                               self.decode_tp + self.prefill_tp],
+            _mesh(self.devices[dec_devs:dec_devs + self.prefill_tp],
                   self.prefill_tp)
             if self.disaggregated else self.decode_mesh)
         # the serving rules are the training rules (sequence_parallel
@@ -175,6 +200,27 @@ class ServingTopology:
         sh = self.param_shardings(params, cfg, mesh)
         return jax.device_put(params, sh), sh
 
+    def place_stage_params(self, params, cfg):
+        """(placed_list, shardings_list): the full model tree split
+        into `serving_pp` per-stage trees (serving/pp.py — contiguous
+        layer slices, embedding on stage 0, head + final norm on stage
+        S-1) and each stage's slice placed on its own sub-mesh under
+        the same logical rules `place_params` uses. Host-staged NumPy
+        trees shard straight from host memory, stage by stage — no
+        stage ever holds another stage's layers, which is the whole
+        HBM point."""
+        from megatron_tpu.ops.quantized import quantize_axes
+        from megatron_tpu.serving import pp as pps
+        staged = pps.stage_params(params, cfg, self.serving_pp)
+        axes = pps.stage_axes(cfg, self.serving_pp)
+        placed, shards = [], []
+        for mesh, p, ax in zip(self.stage_meshes, staged, axes):
+            sh = shd.tree_logical_to_sharding(
+                mesh, quantize_axes(ax, p), self.rules)
+            placed.append(jax.device_put(p, sh))
+            shards.append(sh)
+        return placed, shards
+
     def replicated(self, mesh: Mesh) -> NamedSharding:
         return NamedSharding(mesh, P())
 
@@ -201,7 +247,31 @@ class ServingTopology:
         arena/region k/v (and int8 scales) sharded on kv-heads,
         offsets and the block map replicated. Also pins the pool's
         map re-upload sharding so `_sync_map` keeps the placement
-        stable across slot churn."""
+        stable across slot churn.
+
+        Under `serving_pp` the arena is PARTITIONED on the layer axis:
+        stage i's sub-mesh holds only its own layers' blocks (k/v,
+        scales, per-slot offsets all slice at [i*L/S, (i+1)*L/S)) while
+        the block map replicates onto EVERY stage — block indices are
+        dispatch data, identical across stages by construction
+        (serving/invariants.py law: per-stage maps are copies of the
+        host map). `pool.caches` / `pool._map_sharding` become
+        stage-indexed LISTS; the host-side accounting (maps, refcounts,
+        free lists) is layer-agnostic and stays single."""
+        if self.serving_pp > 1:
+            from megatron_tpu.serving import pp as pps
+            bkv = pool.caches
+            staged, map_sh = [], []
+            for i, mesh in enumerate(self.stage_meshes):
+                arena = self.place_kv_tree(
+                    pps.stage_kv(bkv.arena, self.serving_pp, i), mesh)
+                rep = self.replicated(mesh)
+                staged.append(bkv._replace(
+                    arena=arena, map=jax.device_put(bkv.map, rep)))
+                map_sh.append(rep)
+            pool.caches = staged
+            pool._map_sharding = map_sh
+            return
         pool.caches = self.place_kv_tree(pool.caches, self.decode_mesh)
         if pool.blocks_enabled:
             pool._map_sharding = self.replicated(self.decode_mesh)
@@ -271,17 +341,21 @@ class ServingTopology:
             "decode_tp": self.decode_tp,
             "prefill_devices": (self.prefill_tp if self.disaggregated
                                 else self.decode_tp),
-            "decode_devices": self.decode_tp,
+            "decode_devices": self.decode_tp * self.serving_pp,
             "disaggregated": self.disaggregated,
+            "serving_pp": self.serving_pp,
+            "pp_waves": self.pp_waves,
         }
 
 
 def build_topology(serving, devices: Optional[Sequence] = None
                    ) -> Optional[ServingTopology]:
     """None when `serving` asks for no topology (both phase widths
-    resolve to 1 and no disaggregation) — the bit-identical default."""
+    resolve to 1, serving_pp=1, and no disaggregation) — the
+    bit-identical default."""
     ptp, dtp = resolve_phase_tp(serving)
     if (ptp == 1 and dtp == 1
+            and int(getattr(serving, "serving_pp", 1) or 1) == 1
             and not getattr(serving, "disaggregate_prefill", False)):
         return None
     return ServingTopology(serving, devices=devices)

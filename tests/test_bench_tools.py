@@ -369,6 +369,41 @@ def test_bench_phase_topology_emits_ab_record(monkeypatch, tmp_path):
     assert rec["prefill_heavy"]["ttft_vs_symmetric_x"] > 0
 
 
+def test_bench_pp_serving_emits_ab_record(monkeypatch, tmp_path):
+    """The pipeline-sharded serving A/B must run the mono arm and both
+    staged arms token-exact (the tool asserts agreement and exits
+    nonzero on divergence), read the staged gauges off the live engine
+    snapshot — bubble pinned to (S-1)/(W+S-1), activation bytes > 0,
+    the mono arm all-zero on the same schema keys — and report the
+    per-arm decode tok/s ratio the on-chip comparison keys on
+    (PERF_NOTES queue item 13)."""
+    import json
+    text = run_tool(monkeypatch, tmp_path, "bench_pp_serving.py",
+                    ["--smoke"])
+    rec = json.loads(text)
+    assert rec["bench"] == "pp_serving"
+    assert rec["greedy_arms_token_exact"] is True
+    # the tool forces a 2-virtual-device host: every arm must RUN
+    assert "skipped" not in rec
+    for name, pp, waves, bubble in (("mono", 0, 0, 0.0),
+                                    ("pp2_w1", 2, 1, 0.5),
+                                    ("pp2_w2", 2, 2, 0.3333)):
+        arm = rec[name]
+        assert (arm["serving_pp"], arm["pp_waves"]) == (pp, waves)
+        assert arm["pp_stage_bubble"] == bubble
+        for key in ("ttft_p50_ms", "inter_token_p99_ms",
+                    "decode_tok_s"):
+            assert key in arm
+    # one [num_slots, hidden] activation per stage boundary — same
+    # bytes at W=1 and W=2 (waves re-time the crossing, not its size)
+    assert rec["pp2_w1"]["pp_activation_bytes_per_step"] > 0
+    assert (rec["pp2_w1"]["pp_activation_bytes_per_step"]
+            == rec["pp2_w2"]["pp_activation_bytes_per_step"])
+    assert rec["mono"]["pp_activation_bytes_per_step"] == 0.0
+    assert rec["pp2_w1"]["tok_s_vs_mono_x"] > 0
+    assert rec["pp2_w2"]["tok_s_vs_mono_x"] > 0
+
+
 @pytest.mark.slow
 def test_bench_serving_queue_runs_pending_abs(monkeypatch, tmp_path):
     """The one-window queue runner must execute every pending serving

@@ -162,7 +162,7 @@ class TestAsymmetricPhaseTopology:
         assert h["placement"] == {
             "prefill_tp": 1, "decode_tp": 2,
             "prefill_devices": 1, "decode_devices": 2,
-            "disaggregated": True,
+            "disaggregated": True, "serving_pp": 1, "pp_waves": 1,
             "budget": None, "reason": "explicit"}
         # the gauges ride every snapshot with the same numbers
         assert snap["prefill_tp"] == 1.0 and snap["decode_tp"] == 2.0

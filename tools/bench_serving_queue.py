@@ -15,6 +15,8 @@ a single tunnel window captures every outstanding serving A/B:
             serving-tp decode scaling)
   item 12 — tools/bench_phase_topology.py (symmetric vs asymmetric
             prefill_tp:decode_tp splits on one device budget)
+  item 13 — tools/bench_pp_serving.py  (layer-staged decode: pp=2 at
+            waves 1 and 2 vs the mono engine, bubble vs claw-back)
 
 Each tool runs as its own subprocess with an independent timeout (a
 wedge in one cannot eat the window), its one-line JSON record is
@@ -50,6 +52,9 @@ QUEUE = [
     # structured output + COW n-best (constrained-vs-free mask-upload
     # cadence, n=1x4-vs-n=4 one-prefill fan-out)
     ("structured", "bench_structured.py", ["--smoke"], []),
+    # pipeline-sharded serving (mono vs serving_pp=2 at waves 1 and 2;
+    # greedy arms token-agree, bubble gauge pinned to (S-1)/(W+S-1))
+    ("pp_serving", "bench_pp_serving.py", ["--smoke"], []),
 ]
 
 

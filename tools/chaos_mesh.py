@@ -10,7 +10,9 @@ complement: from a single ``--seed`` it
    chunked prefill + host tier, speculative decoding, adapters,
    priorities/preemption/shedding, serving_tp, disaggregation with
    per-phase widths (prefill_tp / decode_tp — asymmetric splits
-   included), replicas, int8 KV, rolling sliding-window models —
+   included), pipeline-sharded decode (serving_pp stage chains,
+   optionally wave-interleaved), replicas, int8 KV, rolling
+   sliding-window models —
    driving the REAL
    ``ServingConfig.validate()`` as the rejection filter, so illegal
    combinations (rolling x speculative, kernel x sliding-window, ...)
@@ -38,8 +40,9 @@ complement: from a single ``--seed`` it
 A failing run prints the one-line repro (``--seed S [--require ...]``)
 with the violated laws. ``--minutes N`` soak mode walks seeds until
 the budget expires; ``--smoke`` runs a small fixed seed set covering
-adapters, disaggregation, a live-weight swap, and the brownout
-degradation ladder (bench extras + the slow-tier test run it);
+adapters, disaggregation, a live-weight swap, the brownout
+degradation ladder, and a pipeline-sharded stage chain (bench extras
++ the slow-tier test run it);
 ``--inject_violation`` deliberately drops a
 terminal transition after a green run to prove the checker is not
 vacuous (test-pinned).
@@ -74,7 +77,8 @@ N_DEVICES = 4  # forced host platform: disagg/tp configs need 2x2
 SMOKE_SEEDS = [(7, ("adapters",)), (11, ("disagg",)), (23, ("swap",)),
                (31, ("structured",)), (43, ("fanout",)),
                (53, ("phases",)),  # asymmetric per-phase disagg split
-               (61, ("degrade",))]  # brownout ladder + SLO accounting
+               (61, ("degrade",)),  # brownout ladder + SLO accounting
+               (71, ("pp",))]  # pipeline-sharded (layer-staged) decode
 
 # the seeded grammar pool: every entry compiles against the tiny
 # model's vocab-128 identity token table (token i <-> chr(i)), so
@@ -106,7 +110,8 @@ def sample_config(rng: random.Random, require=()):
     rejections = []
     for _ in range(200):
         rolling = rng.random() < 0.15 and "disagg" not in require \
-            and "tp" not in require and "phases" not in require
+            and "tp" not in require and "phases" not in require \
+            and "pp" not in require
         model_kwargs = dict(compute="float32", num_kv_heads=2)
         if rolling:
             model_kwargs.update(sliding_window=64,
@@ -140,6 +145,17 @@ def sample_config(rng: random.Random, require=()):
             kw["decode_tp"] = rng.choice([1, 2])
         elif rng.random() < 0.08:
             kw["prefill_tp"] = rng.choice([2, 3])
+        # pipeline-sharded serving axis (serving/topology.py
+        # "Pipeline-sharded serving"): a slice draws a 2-stage
+        # layer-staged decode chain, half of it wave-interleaved. The
+        # draw deliberately lands on ILLEGAL pairings too (pp x
+        # disagg, pp x whole-region pool, pp x kernel, pp x host
+        # tier, waves x speculative) — all must come back as LOUD
+        # validate() rejections, never silent coercion.
+        if rng.random() < 0.2:
+            kw["serving_pp"] = 2
+            if rng.random() < 0.5:
+                kw["pp_waves"] = 2
         if rng.random() < 0.5:
             kw.update(priority_levels=2,
                       preemption=rng.random() < 0.7)
@@ -183,6 +199,19 @@ def sample_config(rng: random.Random, require=()):
                       degrade_max_new_tokens=6,
                       shed_on_overload=True, priority_levels=2,
                       slo_ttft_ms=30_000.0, slo_itl_p99_ms=30_000.0)
+        if "pp" in require:
+            # layer-staged decode chain (2 stages x width 1) with the
+            # second wave interleaved on the slot grid. The staged
+            # exclusions (disagg, kernel, host tier, explicit prefill
+            # width, speculative under waves) would validate()-reject,
+            # so pin the legal corner; the bare engine keeps fan-out
+            # admissible, exercising COW forks over the staged pool
+            kw.update(serving_pp=2, decode_tp=1, pp_waves=2,
+                      kv_block_size=16, block_native_attn=False,
+                      disaggregate_prefill=False, speculative_k=0,
+                      serving_tp=1, num_replicas=1)
+            kw.pop("prefill_tp", None)
+            kw.pop("host_kv_bytes", None)
         if "fanout" in require:
             # fan-out aggregates are engine-level (the router's retry
             # pump refuses best_of > 1 typed) — pin a bare engine so
@@ -194,13 +223,16 @@ def sample_config(rng: random.Random, require=()):
         # resolves (decode width + prefill width when disaggregated)
         ptp = kw.get("prefill_tp") or kw["serving_tp"]
         dtp = kw.get("decode_tp") or kw["serving_tp"]
-        per = dtp + (ptp if kw["disaggregate_prefill"] else 0)
+        per = dtp * kw.get("serving_pp", 1) \
+            + (ptp if kw["disaggregate_prefill"] else 0)
         if per * kw["num_replicas"] > N_DEVICES:
             kw["num_replicas"] = 1
         if per > N_DEVICES:
             kw["serving_tp"] = 1
             kw.pop("prefill_tp", None)
             kw.pop("decode_tp", None)
+            kw.pop("serving_pp", None)
+            kw.pop("pp_waves", None)
         model = cc.tiny_model_cfg(**model_kwargs)
         try:
             ServingConfig(**kw).validate(model)

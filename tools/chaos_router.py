@@ -41,6 +41,18 @@ model:
    (`handoffs` still advances). Skipped with a note when the backend
    has < 4 devices (2 replicas x 2 groups); the CPU smoke forces a
    4-virtual-device host platform.
+5. **kill-one-stage** (docs/serving.md "Pipeline-sharded serving"):
+   over a router of 2 PIPELINE-SHARDED replicas — each a serving_pp=2
+   stage chain of 2 devices — one replica permanently loses a layer
+   STAGE (its stage-1 decode program raises, the in-process analogue
+   of that stage's chip group dying). Contract: a chain with a dead
+   stage is a dead chain — the supervisor's restart re-crashes (the
+   compiled stage programs survive restarts, so the dead stage stays
+   dead), the breaker trips, the router ejects the replica, every
+   accepted request resolves token-exact on the surviving chain, and
+   the survivor still runs STAGED (its per-stage trace counters stay
+   [1, 1] — ejection caused zero recompiles). Skipped with a note
+   when the backend has < 4 devices (2 replicas x 2 stages).
 
 Every drill finishes with a system-wide `invariants.check_all` sweep
 (serving/invariants.py): per-replica request conservation + KV
@@ -334,6 +346,80 @@ def kill_half_drill(new_tokens: int, half: str) -> dict:
     }
 
 
+def kill_stage_drill(new_tokens: int) -> dict:
+    """Kill one replica's layer stage mid-traffic and pin token-exact
+    resubmission on the surviving stage chain."""
+    import jax
+
+    from megatron_tpu.serving import SamplingOptions
+
+    if len(jax.devices()) < 4:
+        return {"skipped": f"{len(jax.devices())} device(s) < 4 "
+                           "(2 pipeline-sharded replicas)", "ok": True}
+    # each replica is a 2-stage chain (1 device per stage); a dead
+    # stage keeps raising: one restart then the breaker
+    router, engines, gen = _tiny_router(
+        dict(num_slots=2, max_queue=64, max_len=128, kv_block_size=16,
+             serving_pp=2, decode_tp=1, max_engine_restarts=1),
+        heartbeat_s=2.0, probe_backoff_s=30.0, compute="bfloat16",
+        devices_per=2)
+    sampling = SamplingOptions(temperature=0.0)
+    want = _serial_oracle(gen)
+    try:
+        for eng in engines:
+            eng.generate([3, 1, 4], 2, sampling, seed=0)
+
+        def dead(*a, **k):
+            raise RuntimeError("injected: stage 1 down (stage chip "
+                               "group lost)")
+
+        # the stage dies PERMANENTLY: _restart_session keeps the
+        # compiled stage programs (no retrace on restart), so the
+        # patched program re-crashes the restarted loop and the
+        # breaker trips
+        engines[0]._pp_dec[1] = dead
+        reqs = []
+        for i in range(6):
+            p = [5 + i, 2, 7, 2, 7]
+            reqs.append((router.submit(p, new_tokens, sampling, seed=i),
+                         p, new_tokens))
+        outcomes, exact = _resolve_exact(reqs, want)
+        health = router.health()
+        snap = router.aggregate_snapshot()
+        # the surviving CHAIN still serves end-to-end — embedding on
+        # stage 0, activation crossing, head on stage 1
+        post = router.submit([9, 9, 8], 4, sampling, seed=99)
+        post_toks, _ = post.result(timeout=60)
+        post_exact = post_toks == want([9, 9, 8], 4)
+        survivor_traces = list(engines[1]._pp_decode_traces)
+        survivor_staged = isinstance(engines[1].pool.caches, list)
+        inv = invariant_sweep(router, [r for r, _, _ in reqs] + [post])
+    finally:
+        router.close()
+    return {
+        "submitted": len(reqs), "outcomes": outcomes,
+        "completed_token_exact": exact,
+        "router_failovers": int(snap["router_failovers"]),
+        "router_retries": int(snap["router_retries"]),
+        "health_state": health["state"],
+        "healthz_ready": bool(health["healthy"]),
+        "post_kill_serve_exact": post_exact,
+        "survivor_stage_traces": survivor_traces,
+        "survivor_staged": survivor_staged,
+        "serving_pp_gauge": float(snap["serving_pp"]),
+        "invariants_ok": inv["ok"],
+        "invariant_violations": inv["violations"],
+        "ok": (outcomes["stranded"] == 0 and outcomes["error"] == 0
+               and outcomes["ok"] == len(reqs) and exact
+               and int(snap["router_failovers"]) >= 1
+               and health["state"] == "degraded" and health["healthy"]
+               and post_exact and survivor_staged
+               and survivor_traces == [1, 1]
+               and float(snap["serving_pp"]) == 2.0
+               and inv["ok"]),
+    }
+
+
 def run_chaos(new_tokens: int, timeout_s: float, stall_s: float) -> dict:
     t0 = time.monotonic()
     kill = kill_drill(new_tokens)
@@ -341,9 +427,11 @@ def run_chaos(new_tokens: int, timeout_s: float, stall_s: float) -> dict:
     host = host_tier_drill(new_tokens)
     kill_prefill = kill_half_drill(new_tokens, "prefill")
     kill_decode = kill_half_drill(new_tokens, "decode")
+    kill_stage = kill_stage_drill(new_tokens)
     wall_s = time.monotonic() - t0
     ok = (kill["ok"] and wedge["ok"] and host["ok"]
-          and kill_prefill["ok"] and kill_decode["ok"])
+          and kill_prefill["ok"] and kill_decode["ok"]
+          and kill_stage["ok"])
     return {
         "metric": "router_chaos_failover_retries",
         "value": kill["router_retries"] + wedge["router_retries"],
@@ -356,6 +444,7 @@ def run_chaos(new_tokens: int, timeout_s: float, stall_s: float) -> dict:
         "host_tier": host,
         "kill_prefill_half": kill_prefill,
         "kill_decode_half": kill_decode,
+        "kill_stage": kill_stage,
         "wall_s": round(wall_s, 1),
     }
 
